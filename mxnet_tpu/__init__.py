@@ -1,0 +1,55 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+Apache MXNet 2.0 (reference: bgawrych/incubator-mxnet).
+
+Not a port: NDArrays are XLA/PJRT buffers, operators lower to XLA (jax.numpy/
+lax/Pallas), hybridized Gluon blocks compile to single XLA executables, and
+kvstore/Trainer data-parallelism rides XLA collectives over ICI via
+jax.sharding meshes.  See SURVEY.md for the reference layer map this mirrors.
+
+Import convention matches the reference: `import mxnet_tpu as mx` then
+`mx.np`, `mx.npx`, `mx.gluon`, `mx.autograd`, `mx.tpu(0)`.
+"""
+from __future__ import annotations
+
+__version__ = "2.0.0"  # capability-parity version (reference libinfo.py:150)
+
+from . import context
+from .context import Context, Device, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+
+from . import ndarray as _ndarray_mod
+from .ndarray import ndarray, NDArray, waitall
+
+from . import autograd
+from . import engine
+from . import util
+from . import runtime
+
+from . import numpy as np
+from . import numpy_extension as npx
+
+from . import _rng
+from . import random
+
+from . import initializer
+from .initializer import init  # alias namespace
+
+from . import lr_scheduler
+from . import optimizer
+from .optimizer import Optimizer
+
+from . import gluon
+from . import kvstore as kv
+from . import kvstore
+from . import parallel
+from . import profiler
+from . import amp
+
+from .util import is_np_array, is_np_shape, set_np, reset_np
+
+# legacy namespace: mx.nd mirrors mx.np plus waitall/load/save
+from . import nd
+
+
+def test_utils():  # lazily import to keep startup light
+    from . import test_utils as tu
+    return tu

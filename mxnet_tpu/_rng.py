@@ -1,0 +1,66 @@
+"""Global PRNG state for imperative random ops.
+
+Parity: reference `src/resource.cc` kRandom/kParallelRandom resources +
+`include/mxnet/random_generator.h` (per-device mt19937 / Philox states),
+seeded by mx.random.seed.
+
+TPU-native design: a single splittable JAX threefry key per process.  Every
+random op splits a fresh subkey (functional, reproducible).  Inside a
+HybridBlock trace (see gluon/block.py) keys must be *arguments* of the
+compiled program, not baked constants — `push_trace_key` installs a traced
+key so each invocation of the cached executable gets fresh randomness, the
+way the reference re-seeds cuDNN dropout descriptors per call.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_STATE = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _st():
+    if not hasattr(_STATE, "key"):
+        _STATE.key = jax.random.key(_DEFAULT_SEED)
+        _STATE.trace_stack = []
+    return _STATE
+
+
+def seed(seed_state, ctx="all"):
+    """mx.random.seed parity (python/mxnet/random.py)."""
+    st = _st()
+    st.key = jax.random.key(int(seed_state))
+
+
+def next_key():
+    """Return a fresh subkey; inside a trace, derive from the traced key."""
+    st = _st()
+    if st.trace_stack:
+        holder = st.trace_stack[-1]
+        holder["key"], sub = jax.random.split(holder["key"])
+        holder["count"] += 1
+        return sub
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+class trace_keys:
+    """Context manager installing a traced base key during HybridBlock
+    tracing; records how many keys the graph consumed."""
+
+    def __init__(self, base_key):
+        self.holder = {"key": base_key, "count": 0}
+
+    def __enter__(self):
+        _st().trace_stack.append(self.holder)
+        return self.holder
+
+    def __exit__(self, *exc):
+        _st().trace_stack.pop()
+        return False
+
+
+def current_key():
+    return _st().key

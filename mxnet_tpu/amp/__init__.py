@@ -1,0 +1,107 @@
+"""Automatic mixed precision (parity: python/mxnet/amp/ — op-list-driven
+casting, LossScaler).
+
+TPU-native: the target dtype is bfloat16 (the MXU's native input type), not
+fp16 — bf16 needs NO loss scaling (same exponent range as fp32), so
+`amp.init()` is dramatically simpler than the reference's monkey-patch +
+LossScaler machinery.  The fp16 path with dynamic loss scaling is kept for
+API parity (lists/symbol_fp16 analog) and for the all_finite flow.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+from .. import numpy_extension as npx
+from ..ndarray import ndarray, _wrap_value
+from . import lists  # noqa: F401
+from .loss_scaler import LossScaler  # noqa: F401
+
+_TARGET = None
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (reference amp.py:308).  On TPU this sets the default
+    matmul/conv compute dtype to bf16 via per-block conversion; use
+    convert_hybrid_block for whole-model casting."""
+    global _TARGET
+    _TARGET = onp.dtype(target_dtype) if target_dtype != "bfloat16" else jnp.bfloat16
+
+
+def init_trainer(trainer):
+    """Parity: amp.init_trainer (amp.py:374) — attaches a LossScaler for
+    fp16; bf16 needs none."""
+    if _TARGET == onp.float16:
+        trainer._amp_loss_scaler = LossScaler()
+    return trainer
+
+
+def scale_loss(loss, trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield_loss = loss
+    else:
+        yield_loss = loss * scaler.loss_scale
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        yield yield_loss
+
+    return ctx()
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is not None:
+        for p in trainer._params:
+            if p.grad_req != "null" and p._data is not None:
+                g = p.grad()
+                g._set_data(g._data / scaler.loss_scale)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
+                         fp32_ops=None, conditional_fp32_ops=None,
+                         excluded_sym_names=None, device=None,
+                         cast_params_offline=False):
+    """Convert a HybridBlock for mixed precision (reference amp.py:670).
+
+    bf16 flavor: parameters stay fp32 master copies; compute casts to bf16
+    at block boundaries (XLA keeps fused casts free).  When
+    cast_params_offline=True, weights themselves are cast (inference).
+    """
+    dt = jnp.bfloat16 if target_dtype in ("bfloat16", jnp.bfloat16) else onp.dtype(target_dtype)
+    if cast_params_offline:
+        block.cast(dt)
+        return block
+    return _AmpWrapper(block, dt)
+
+
+class _AmpWrapper:
+    """Wraps a block: casts inputs to the target dtype, output back to
+    fp32."""
+
+    def __init__(self, block, dtype):
+        self._block = block
+        self._dtype = dtype
+
+    def __getattr__(self, name):
+        return getattr(self._block, name)
+
+    def __call__(self, *args):
+        cast_args = [a.astype(self._dtype) if isinstance(a, ndarray)
+                     and a.dtype.kind == "f" else a for a in args]
+        out = self._block(*cast_args)
+        if isinstance(out, ndarray):
+            return out.astype(onp.float32)
+        if isinstance(out, (list, tuple)):
+            return type(out)(o.astype(onp.float32) if isinstance(o, ndarray)
+                             else o for o in out)
+        return out
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16", **kw):
+    raise NotImplementedError(
+        "symbolic convert_model is legacy; use convert_hybrid_block")

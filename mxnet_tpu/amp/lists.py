@@ -1,0 +1,30 @@
+"""AMP op lists (parity: python/mxnet/amp/lists/symbol_fp16.py /
+symbol_bf16.py).  On TPU the compiler decides per-fusion precision; these
+lists drive convert_hybrid_block's per-op casting decisions for parity."""
+
+# ops that are safe & profitable in low precision (matmul/conv family —
+# FP16_FUNCS analog, lists/symbol_fp16.py:25)
+TARGET_DTYPE_OPS = [
+    "fully_connected", "convolution", "deconvolution", "batch_dot", "dot",
+    "matmul", "einsum", "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt", "flash_attention", "rnn",
+]
+
+# ops that run in either precision (FP16_FP32_FUNCS analog :40)
+WIDEST_TYPE_CASTS = [
+    "add", "subtract", "multiply", "maximum", "minimum", "where",
+    "concatenate", "stack",
+]
+
+# ops forced to fp32 (FP32_FUNCS analog :464): reductions & normalizations
+FP32_OPS = [
+    "softmax", "log_softmax", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "lrn", "l2_normalization", "sum", "mean", "prod",
+    "exp", "log", "power", "norm", "var", "std", "erf", "erfinv",
+    "ctc_loss",
+]
+
+CONDITIONAL_FP32_OPS = [
+    ("activation", "act_type", ["softrelu"]),
+]

@@ -1,0 +1,37 @@
+"""Dynamic loss scaler (parity: python/mxnet/amp/loss_scaler.py:26 —
+init 2^16, x2 every 2000 overflow-free steps (cap 2^24), halve on overflow
+detected via all_finite)."""
+from __future__ import annotations
+
+from .. import numpy_extension as npx
+
+
+class LossScaler:
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000, max_scale=2 ** 24):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._max_scale = max_scale
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """Check grads for inf/nan (reference uses multi_all_finite)."""
+        grads = [p.grad() for p in params
+                 if p.grad_req != "null" and p._data is not None]
+        if not grads:
+            return False
+        ok = npx.all_finite(*grads)
+        return not bool(ok)
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale = min(self.loss_scale * self._scale_factor,
+                                      self._max_scale)
+                self._unskipped = 0
+        return self.loss_scale

@@ -1,0 +1,128 @@
+"""Device contexts for the TPU-native framework.
+
+Parity: reference `python/mxnet/context.py` and `include/mxnet/base.h:92`
+(``Context{dev_type, dev_id}``).  The reference enumerates kCPU/kGPU/
+kCPUPinned/kCPUShared; here the accelerator type is ``tpu`` and devices
+resolve to JAX/PJRT devices.  ``mx.gpu(i)`` is kept as a compatibility alias
+for ``mx.tpu(i)`` so reference scripts run unmodified.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_DEV_TYPES = ("cpu", "tpu", "cpu_pinned", "cpu_shared")
+
+
+class Context:
+    """A device context (device_type, device_id).
+
+    Supports use as a ``with`` block to set the default context, matching
+    reference ``python/mxnet/context.py`` semantics.
+    """
+
+    _default = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if device_type == "gpu":  # compat alias: reference scripts say mx.gpu(i)
+            device_type = "tpu"
+        if device_type not in _DEV_TYPES:
+            raise ValueError("unknown device_type %r" % (device_type,))
+        self.device_type = device_type
+        self.device_id = device_id
+        self._old = []
+
+    # -- resolution to a PJRT device -------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a jax.Device. ``tpu`` falls back to the default JAX
+        backend when no TPU platform is present (e.g. CPU test meshes)."""
+        if self.device_type == "tpu":
+            try:
+                devs = jax.devices()  # default backend (tpu when present)
+            except RuntimeError:
+                devs = jax.devices("cpu")
+        else:
+            devs = jax.devices("cpu")
+        return devs[self.device_id % len(devs)]
+
+    # -- comparison / hashing --------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    # -- scoping ----------------------------------------------------------
+    def __enter__(self):
+        self._old.append(getattr(Context._default, "ctx", None))
+        Context._default.ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        Context._default.ctx = self._old.pop()
+        return False
+
+    def empty_cache(self):
+        """Best effort HBM cache release (reference: Context.empty_cache)."""
+        for d in jax.live_arrays():
+            pass  # PJRT owns pooling; nothing to free eagerly.
+
+
+# Device is the mxnet-2.0 name for Context (python/mxnet/device.py)
+Device = Context
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Compatibility alias — maps to the TPU context."""
+    return Context("tpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def current_context():
+    ctx = getattr(Context._default, "ctx", None)
+    if ctx is None:
+        ctx = Context("tpu", 0) if num_tpus() else Context("cpu", 0)
+        Context._default.ctx = ctx
+    return ctx
+
+
+current_device = current_context
+
+
+def num_tpus():
+    """Number of accelerator devices visible (reference: mx.context.num_gpus)."""
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return 0
+    return sum(1 for d in devs if d.platform != "cpu")
+
+
+num_gpus = num_tpus
+
+
+def device_count():
+    return len(jax.devices())

@@ -1,0 +1,38 @@
+"""Engine semantics over PJRT async dispatch.
+
+Parity: reference `src/engine/` (ThreadedEnginePerDevice default,
+NaiveEngine debug mode, bulking, WaitForAll/WaitForVar).  TPU-native: PJRT
+already provides async dispatch with per-device program order, so the
+"engine" reduces to: (1) sync points (`waitall`, per-array wait_to_read),
+(2) a NaiveEngine debug mode that blocks after every op
+(`MXNET_ENGINE_TYPE=NaiveEngine`, matching src/engine/engine.cc:32), and
+(3) bulking hints, which XLA supersedes via whole-graph compilation under
+hybridize().
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from .ndarray import waitall as _waitall  # re-export
+
+
+def waitall():
+    _waitall()
+
+
+def engine_type():
+    return os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") or \
+        "ThreadedEnginePerDevice"
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Parity: mx.engine.bulk (python/mxnet/engine.py). Under XLA, op
+    coalescing happens at jit/hybridize time; eager ops are individually
+    async — the scope is accepted for API compatibility."""
+    yield
+
+
+def set_bulk_size(size):
+    return 0

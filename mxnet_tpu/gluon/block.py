@@ -1,0 +1,430 @@
+"""gluon.Block / HybridBlock (parity: python/mxnet/gluon/block.py).
+
+Block (:203) is the eager container; HybridBlock (:998) adds `hybridize()`:
+the reference traces `forward` via deferred-compute into an nnvm Symbol and
+executes it with CachedOp (static/dynamic executors, memory planning,
+fusion).
+
+TPU-native: `hybridize()` traces the same Python `forward` with jax.jit —
+the whole graph becomes ONE XLA executable (layout assignment, fusion,
+rematerialization subsume CachedOp's MXPlanMemory/CSE/pointwise-fusion
+passes).  Parameters enter as traced arguments; mutable aux state
+(BatchNorm running stats) is captured as extra outputs and written back
+after each call, preserving the reference's side-effecting op semantics.
+Autograd through a hybridized call records a single tape node whose VJP is
+the compiled backward program (pjit transpose), matching CachedOp::Backward.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .._rng import next_key, trace_keys
+from ..context import Context, current_context
+from ..ndarray import ndarray, _wrap_value, apply_op
+from .parameter import Parameter, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+def _flatten_arrays(obj, out):
+    if isinstance(obj, ndarray):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            _flatten_arrays(o, out)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            _flatten_arrays(o, out)
+
+
+class _BlockScope:
+    pass
+
+
+class Block:
+    """Base container (reference block.py:203)."""
+
+    def __init__(self):
+        self._children = OrderedDict()
+        self._reg_params = OrderedDict()
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._hook_id = 0
+
+    # -- attribute registration ------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            existing = self.__dict__.get("_reg_params")
+            if existing is not None:
+                existing[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    # -- parameter collection --------------------------------------------
+    def collect_params(self, select=None):
+        """Return {structural_name: Parameter} (reference collect_params).
+
+        Names are attribute paths like 'features.0.weight'."""
+        out = OrderedDict()
+
+        def walk(block, prefix):
+            for pname, p in block._reg_params.items():
+                full = prefix + pname if not prefix else prefix + "." + pname
+                p._structure_name = full if prefix else pname
+                out[p._structure_name] = p
+            for cname, child in block._children.items():
+                walk(child, (prefix + "." + cname) if prefix else cname)
+
+        walk(self, "")
+        if select is not None:
+            pat = re.compile(select)
+            out = OrderedDict((k, v) for k, v in out.items() if pat.match(k))
+        return out
+
+    @property
+    def params(self):
+        return self.collect_params()
+
+    # -- initialization ---------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False, device=None):
+        from .. import initializer as _initmod
+        init = init or _initmod.Uniform()
+        for name, p in self.collect_params().items():
+            p.initialize(init=p.init, ctx=ctx or device, default_init=init,
+                         force_reinit=force_reinit)
+
+    def setattr(self, name, value):
+        for p in self.collect_params().values():
+            setattr(p, name, value)
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for child in self._children.values():
+            pass  # params already collected recursively
+        self._on_cast(dtype)
+        return self
+
+    def _on_cast(self, dtype):
+        for c in self._children.values():
+            c._on_cast(dtype)
+
+    def reset_ctx(self, ctx):
+        for p in self.collect_params().values():
+            p.reset_ctx(ctx)
+
+    reset_device = reset_ctx
+
+    def zero_grad(self):
+        for p in self.collect_params().values():
+            p.zero_grad()
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_hook(self, hook):
+        self._hook_id += 1
+        self._forward_hooks[self._hook_id] = hook
+        return _HookHandle(self._forward_hooks, self._hook_id)
+
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return _HookHandle(self._forward_pre_hooks, self._hook_id)
+
+    def register_op_hook(self, callback, monitor_all=False):
+        pass  # per-op monitoring: profiler hooks land with profiler parity
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- serialization -----------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        """Save params as .npz (reference block.py:341 → npx.savez/cnpy)."""
+        params = self.collect_params()
+        arrays = {}
+        for name, p in params.items():
+            if p._data is not None:
+                arrays[name] = p.data().asnumpy()
+        onp.savez(filename, **arrays)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current", device=None):
+        loaded = dict(onp.load(filename))
+        params = self.collect_params()
+        for name, p in params.items():
+            key = name if name in loaded else name + ":0"
+            if key not in loaded:
+                if not allow_missing:
+                    raise ValueError("Parameter %s missing in file %s"
+                                     % (name, filename))
+                continue
+            arr = loaded[key]
+            p.set_data(_wrap_value(jnp.asarray(arr)))
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise ValueError("file %s has extra parameters %s"
+                                 % (filename, sorted(extra)))
+
+    def save(self, prefix):
+        self.save_parameters(prefix + "-model.params.npz")
+
+    def load(self, prefix):
+        self.load_parameters(prefix + "-model.params.npz")
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        """Print per-layer summary (reference block.summary)."""
+        rows = []
+
+        def hook(block, _, out):
+            outs = []
+            _flatten_arrays(out, outs)
+            rows.append((type(block).__name__,
+                         [o.shape for o in outs],
+                         sum(int(onp.prod(p.shape)) for p in
+                             block._reg_params.values() if p.shape)))
+
+        handles = []
+
+        def attach(b):
+            handles.append(b.register_forward_hook(hook))
+
+        self.apply(attach)
+        try:
+            self(*inputs)
+        finally:
+            for h in handles:
+                h.detach()
+        total = sum(int(onp.prod(p.shape)) for p in
+                    self.collect_params().values() if p.shape)
+        print("%-30s %-30s %s" % ("Layer", "Output shapes", "Params"))
+        for name, shapes, n in rows:
+            print("%-30s %-30s %d" % (name, shapes, n))
+        print("Total params: %d" % total)
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "("]
+        for name, child in self._children.items():
+            c = repr(child).replace("\n", "\n  ")
+            lines.append("  (%s): %s" % (name, c))
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class _HookHandle:
+    def __init__(self, hooks, hid):
+        self._hooks = hooks
+        self._id = hid
+
+    def detach(self):
+        self._hooks.pop(self._id, None)
+
+
+class HybridBlock(Block):
+    """Block with hybridize(): forward traces into one XLA executable
+    (reference block.py:998, CachedOp execution path)."""
+
+    def __init__(self):
+        super().__init__()
+        self._active = False
+        self._cached_graphs = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_graphs = {}
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Parity: block.py:1312 optimize_for — backend partitioning.  On
+        TPU the 'backend' is XLA itself; hybridize + warm the cache."""
+        self.hybridize(True)
+        self(x, *args)
+
+    def infer_shape(self, *args):
+        """Layers override to finalize deferred parameter shapes."""
+        pass
+
+    def _has_uninitialized_params(self):
+        return any(p._data is None for p in self.collect_params().values())
+
+    # -- the cached-graph machinery ---------------------------------------
+    def _signature(self, flat_inputs):
+        training = autograd.is_training()
+        return (tuple((a.shape, str(a.dtype)) for a in flat_inputs), training)
+
+    def _build_cache(self, args, kwargs, flat_inputs):
+        """Trace forward into a jitted pure function.
+
+        pure(param_vals, input_vals, key) -> (flat_outputs..., aux_updates...)
+        Reference analog: _build_cache (block.py:1135) deferred-compute
+        trace → Symbol → CachedOp.
+        """
+        params = self.collect_params()
+        live = OrderedDict((name, p) for name, p in params.items()
+                           if p._data is not None)
+        pnames = list(live)
+        outer_training = autograd.is_training()
+
+        tree_template = {}
+
+        def pure(pvals, ivals, key):
+            saved = [(p, p._data) for p in live.values()]
+            try:
+                wrappers = []
+                for name, v in zip(pnames, pvals):
+                    w = _wrap_value(v)
+                    live[name]._data = w
+                    wrappers.append((name, w, v))
+                # rebuild the input pytree with traced values
+                idx = [0]
+
+                def rebuild(obj):
+                    if isinstance(obj, ndarray):
+                        v = _wrap_value(ivals[idx[0]])
+                        idx[0] += 1
+                        return v
+                    if isinstance(obj, (list, tuple)):
+                        return type(obj)(rebuild(o) for o in obj)
+                    return obj
+
+                targs = [rebuild(a) for a in args]
+                tkwargs = {k: rebuild(v) for k, v in kwargs.items()}
+                with trace_keys(key):
+                    with autograd._RecordingStateScope(False, outer_training):
+                        out = self.forward(*targs, **tkwargs)
+                flat_out = []
+                _flatten_arrays(out, flat_out)
+                tree_template["out"] = out
+                # aux updates: params mutated during trace (BatchNorm
+                # running stats) become extra graph outputs
+                aux = []
+                aux_names = []
+                for name, w, v in wrappers:
+                    if w._data is not v:
+                        aux.append(w._data)
+                        aux_names.append(name)
+                tree_template["aux_names"] = aux_names
+                tree_template["n_out"] = len(flat_out)
+                return tuple(o._data for o in flat_out) + tuple(aux)
+            finally:
+                for p, old in saved:
+                    p._data = old
+
+        jitted = jax.jit(pure)
+        return {"fn": jitted, "live": live, "pnames": pnames,
+                "template": tree_template}
+
+    def _call_cached(self, args, kwargs):
+        flat_inputs = []
+        _flatten_arrays(list(args) + list(kwargs.values()), flat_inputs)
+        sig = self._signature(flat_inputs)
+        cache = self._cached_graphs.get(sig)
+        if cache is None:
+            cache = self._build_cache(args, kwargs, flat_inputs)
+            self._cached_graphs[sig] = cache
+        live, pnames = cache["live"], cache["pnames"]
+        fn = cache["fn"]
+        pvals = [live[n]._data._data for n in pnames]
+        ivals = [a._data for a in flat_inputs]
+        key = next_key()
+
+        diff_params = [live[n]._data for n in pnames]
+
+        def run(*vals):
+            np_ = len(pnames)
+            return fn(list(vals[:np_]), list(vals[np_:]), key)
+
+        results = apply_op(run, *(diff_params + flat_inputs))
+        template = cache["template"]
+        n_out = template["n_out"]
+        flat_out = list(results[:n_out])
+        aux_vals = results[n_out:]
+        for name, v in zip(template["aux_names"], aux_vals):
+            live[name]._data._set_data(v.detach()._data)
+
+        # rebuild output structure
+        idx = [0]
+
+        def rebuild(obj):
+            if isinstance(obj, ndarray):
+                v = flat_out[idx[0]]
+                idx[0] += 1
+                return v
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(rebuild(o) for o in obj)
+            return obj
+
+        return rebuild(template["out"])
+
+    def __call__(self, *args, **kwargs):
+        # first call with deferred params runs eagerly so each layer infers
+        # its shapes (reference: deferred init at first forward); subsequent
+        # calls hit the compiled cache
+        if self._active and not self._has_uninitialized_params():
+            for hook in self._forward_pre_hooks.values():
+                hook(self, args)
+            out = self._call_cached(args, kwargs)
+            for hook in self._forward_hooks.values():
+                hook(self, args, out)
+            return out
+        return super().__call__(*args, **kwargs)
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Deployment export (reference block.py:1514): saves params npz +
+        a JSON descriptor.  Graph JSON parity arrives with SymbolBlock."""
+        import json
+        self.save_parameters("%s-%04d.params.npz" % (path, epoch))
+        meta = {"format": "mxnet_tpu-hybridblock", "class": type(self).__name__}
+        with open(path + "-symbol.json", "w") as f:
+            json.dump(meta, f)
+        return path + "-symbol.json", "%s-%04d.params.npz" % (path, epoch)
+
+
+class SymbolBlock(HybridBlock):
+    """Placeholder for imported-graph execution (reference block.py:1716).
+    Full import lands with the serialization milestone."""
+
+    def __init__(self, outputs=None, inputs=None):
+        super().__init__()
+        raise NotImplementedError(
+            "SymbolBlock import arrives with graph serialization parity")

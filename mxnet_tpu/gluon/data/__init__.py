@@ -1,0 +1,7 @@
+"""gluon.data (parity: python/mxnet/gluon/data/)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset  # noqa: F401
+from .sampler import (  # noqa: F401
+    Sampler, SequentialSampler, RandomSampler, BatchSampler, FilterSampler,
+    IntervalSampler)
+from .dataloader import DataLoader, default_batchify_fn  # noqa: F401
+from . import vision  # noqa: F401

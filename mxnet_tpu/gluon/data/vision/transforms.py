@@ -1,0 +1,190 @@
+"""gluon.data.vision.transforms (parity: python/mxnet/gluon/data/vision/
+transforms.py backed by src/operator/image/).  Transforms run on host
+NumPy (they feed the input pipeline; the reference's C++ image ops are CPU
+too)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ....ndarray import ndarray
+from ...block import Block
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, ndarray) else onp.asarray(x)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self._transforms = transforms
+
+    def __call__(self, x, *args):
+        for t in self._transforms:
+            x = t(x)
+        return (x,) + args if args else x
+
+
+class Cast:
+    def __init__(self, dtype="float32"):
+        self._dtype = dtype
+
+    def __call__(self, x):
+        return _np(x).astype(self._dtype)
+
+
+class ToTensor:
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __call__(self, x):
+        x = _np(x)
+        if x.ndim == 3:
+            x = x.transpose(2, 0, 1)
+        elif x.ndim == 4:
+            x = x.transpose(0, 3, 1, 2)
+        return (x / 255.0).astype(onp.float32)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0):
+        self._mean = onp.asarray(mean, onp.float32)
+        self._std = onp.asarray(std, onp.float32)
+
+    def __call__(self, x):
+        x = _np(x).astype(onp.float32)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return (x - mean) / std
+
+
+def _resize_hwc(img, size):
+    """Nearest-neighbor resize on host (OpenCV-free)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        ow, oh = size, size
+    else:
+        ow, oh = size
+    ys = (onp.arange(oh) * (h / oh)).astype(onp.int64)
+    xs = (onp.arange(ow) * (w / ow)).astype(onp.int64)
+    return img[ys][:, xs]
+
+
+class Resize:
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        self._size = size
+
+    def __call__(self, x):
+        return _resize_hwc(_np(x), self._size)
+
+
+class CenterCrop:
+    def __init__(self, size, interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, x):
+        x = _np(x)
+        h, w = x.shape[:2]
+        cw, ch = self._size
+        x0 = max((w - cw) // 2, 0)
+        y0 = max((h - ch) // 2, 0)
+        out = x[y0:y0 + ch, x0:x0 + cw]
+        if out.shape[:2] != (ch, cw):
+            out = _resize_hwc(x, self._size)
+        return out
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def __call__(self, x):
+        x = _np(x)
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = onp.random.uniform(*self._scale) * area
+            ar = onp.random.uniform(*self._ratio)
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if cw <= w and ch <= h:
+                x0 = onp.random.randint(0, w - cw + 1)
+                y0 = onp.random.randint(0, h - ch + 1)
+                return _resize_hwc(x[y0:y0 + ch, x0:x0 + cw], self._size)
+        return _resize_hwc(x, self._size)
+
+
+class RandomFlipLeftRight:
+    def __call__(self, x):
+        x = _np(x)
+        return x[:, ::-1].copy() if onp.random.rand() < 0.5 else x
+
+
+class RandomFlipTopBottom:
+    def __call__(self, x):
+        x = _np(x)
+        return x[::-1].copy() if onp.random.rand() < 0.5 else x
+
+
+class RandomBrightness:
+    def __init__(self, brightness):
+        self._b = brightness
+
+    def __call__(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._b, self._b)
+        return onp.clip(_np(x).astype(onp.float32) * alpha, 0, 255)
+
+
+class RandomContrast:
+    def __init__(self, contrast):
+        self._c = contrast
+
+    def __call__(self, x):
+        x = _np(x).astype(onp.float32)
+        alpha = 1.0 + onp.random.uniform(-self._c, self._c)
+        gray = x.mean()
+        return onp.clip(x * alpha + gray * (1 - alpha), 0, 255)
+
+
+class RandomSaturation:
+    def __init__(self, saturation):
+        self._s = saturation
+
+    def __call__(self, x):
+        x = _np(x).astype(onp.float32)
+        alpha = 1.0 + onp.random.uniform(-self._s, self._s)
+        gray = x.mean(axis=-1, keepdims=True)
+        return onp.clip(x * alpha + gray * (1 - alpha), 0, 255)
+
+
+class RandomLighting:
+    def __init__(self, alpha):
+        self._a = alpha
+
+    def __call__(self, x):
+        x = _np(x).astype(onp.float32)
+        eig = onp.random.normal(0, self._a, 3)
+        return onp.clip(x + eig.reshape(1, 1, 3) * 25.5, 0, 255)
+
+
+class RandomColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        ts = []
+        if brightness:
+            ts.append(RandomBrightness(brightness))
+        if contrast:
+            ts.append(RandomContrast(contrast))
+        if saturation:
+            ts.append(RandomSaturation(saturation))
+        self._ts = ts
+
+    def __call__(self, x):
+        for t in self._ts:
+            x = t(x)
+        return x

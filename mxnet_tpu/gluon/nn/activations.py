@@ -1,0 +1,91 @@
+"""gluon.nn activations (parity: python/mxnet/gluon/nn/activations.py:
+Activation :29, LeakyReLU :62, PReLU :103, ELU :145, SELU :174, GELU :195,
+Swish/SiLU :216/:245)."""
+from __future__ import annotations
+
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "GELU",
+           "Swish", "SiLU"]
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation):
+        super().__init__()
+        self._act_type = activation
+
+    def forward(self, x):
+        return npx.activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return "Activation(%s)" % self._act_type
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="leaky", slope=self._alpha)
+
+    def __repr__(self):
+        return "LeakyReLU(%g)" % self._alpha
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1):
+        super().__init__()
+        from ... import initializer as initmod
+        self.alpha = Parameter("alpha", shape=(in_channels,),
+                               init=alpha_initializer or initmod.Constant(0.25))
+
+    def forward(self, x):
+        return npx.leaky_relu(x, self.alpha.data(), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf"):
+        super().__init__()
+        self._approx = approximation
+
+    def forward(self, x):
+        return npx.gelu(x, approximate=(self._approx == "tanh"))
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0):
+        super().__init__()
+        self._beta = beta
+
+    def forward(self, x):
+        if self._beta == 1.0:
+            return npx.activation(x, "swish")
+        return x * npx.sigmoid(self._beta * x)
+
+
+class SiLU(HybridBlock):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        return npx.activation(x, "silu")

@@ -1,0 +1,389 @@
+"""gluon.nn basic layers (parity: python/mxnet/gluon/nn/basic_layers.py:
+Sequential :37, HybridSequential :104, Dense :181, Dropout :266,
+BatchNorm :413, Embedding :541, Flatten :592, InstanceNorm :612,
+LayerNorm :708, GroupNorm :792, Lambda :883, HybridLambda :926,
+Concatenate :973, Identity :1051, SyncBatchNorm :1071)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import numpy_extension as npx
+from ... import numpy as np_mod
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "Embedding", "Flatten", "InstanceNorm", "LayerNorm", "GroupNorm",
+           "Lambda", "HybridLambda", "Concatenate", "Identity",
+           "SyncBatchNorm", "BatchNormReLU"]
+
+
+class Sequential(Block):
+    """Eager sequential container (basic_layers.py:37)."""
+
+    def __init__(self):
+        super().__init__()
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            self._layers.append(block)
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = []
+            if isinstance(x, (tuple, list)):
+                args = x[1:]
+                x = x[0]
+        if args:
+            return (x,) + tuple(args)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*layers)
+            return net
+        return layers
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        super().hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Hybridizable sequential container (basic_layers.py:104)."""
+
+    def __init__(self):
+        super().__init__()
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            self._layers.append(block)
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = []
+            if isinstance(x, (tuple, list)):
+                args = x[1:]
+                x = x[0]
+        if args:
+            return (x,) + tuple(args)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*layers)
+            return net
+        return layers
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (basic_layers.py:181) → npx.fully_connected
+    (one MXU matmul + fused bias/activation)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0):
+        super().__init__()
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter("weight", shape=(units, in_units),
+                                dtype=dtype, init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = (Parameter("bias", shape=(units,), dtype=dtype,
+                               init=_zeros_init(bias_initializer),
+                               allow_deferred_init=True)
+                     if use_bias else None)
+
+    def infer_shape(self, x):
+        in_units = int(onp.prod(x.shape[1:])) if self._flatten else x.shape[-1]
+        self.weight.shape_and_init((self._units, in_units))
+        if self.bias is not None:
+            self.bias.shape_and_init((self._units,))
+
+    def forward(self, x):
+        if self.weight._data is None:
+            self.infer_shape(x)
+        out = npx.fully_connected(
+            x, self.weight.data(), self.bias.data() if self.bias is not None else None,
+            num_hidden=self._units, no_bias=self.bias is None,
+            flatten=self._flatten)
+        if self._activation is not None:
+            out = npx.activation(out, self._activation)
+        return out
+
+    def __repr__(self):
+        return "Dense(%s -> %d, %s)" % (
+            self.weight.shape[1] if self.weight.shape else None,
+            self._units, self._activation)
+
+
+def _zeros_init(spec):
+    from ... import initializer as initmod
+    if spec is None or spec == "zeros":
+        return initmod.Zero()
+    if isinstance(spec, str):
+        return initmod.create(spec)
+    return spec
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return npx.dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return "Dropout(p=%g, axes=%s)" % (self._rate, self._axes)
+
+
+class BatchNorm(HybridBlock):
+    """BatchNorm (basic_layers.py:413) with mutable running stats."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__()
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape,
+                               grad_req="write" if scale else "null",
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape,
+                              grad_req="write" if center else "null",
+                              allow_deferred_init=True)
+        self.running_mean = Parameter("running_mean", shape=shape,
+                                      grad_req="null",
+                                      allow_deferred_init=True)
+        self.running_var = Parameter("running_var", shape=shape,
+                                     grad_req="null", allow_deferred_init=True)
+
+    def infer_shape(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape_and_init((c,))
+
+    def forward(self, x):
+        if self.gamma._data is None:
+            self.infer_shape(x)
+        return npx.batch_norm(
+            x, self.gamma.data(), self.beta.data(), self.running_mean.data(),
+            self.running_var.data(), eps=self._epsilon,
+            momentum=self._momentum, fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+
+
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm+ReLU (basic_layers.py:477) — XLA fuses the relu."""
+
+    def forward(self, x):
+        return npx.relu(super().forward(x))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (basic_layers.py:1071,
+    src/operator/contrib/sync_batch_norm.cc).  Under pjit/shard_map data
+    parallelism, batch statistics are computed over the global batch by XLA
+    collectives automatically (psum of moments); single-process semantics
+    equal BatchNorm."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class Embedding(HybridBlock):
+    """Embedding lookup (basic_layers.py:541) → gather on HBM."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False):
+        super().__init__()
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data(), input_dim=self._input_dim,
+                             output_dim=self._output_dim)
+
+    def __repr__(self):
+        return "Embedding(%d -> %d)" % (self._input_dim, self._output_dim)
+
+
+class Flatten(HybridBlock):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape,
+                               grad_req="write" if scale else "null",
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape,
+                              grad_req="write" if center else "null",
+                              allow_deferred_init=True)
+
+    def infer_shape(self, x):
+        c = x.shape[self._axis]
+        self.gamma.shape_and_init((c,))
+        self.beta.shape_and_init((c,))
+
+    def forward(self, x):
+        if self.gamma._data is None:
+            self.infer_shape(x)
+        return npx.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                 eps=self._epsilon)
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape,
+                               grad_req="write" if scale else "null",
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape,
+                              grad_req="write" if center else "null",
+                              allow_deferred_init=True)
+
+    def infer_shape(self, x):
+        c = x.shape[self._axis]
+        self.gamma.shape_and_init((c,))
+        self.beta.shape_and_init((c,))
+
+    def forward(self, x):
+        if self.gamma._data is None:
+            self.infer_shape(x)
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape,
+                               grad_req="write" if scale else "null",
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape,
+                              grad_req="write" if center else "null",
+                              allow_deferred_init=True)
+
+    def infer_shape(self, x):
+        c = x.shape[1]
+        self.gamma.shape_and_init((c,))
+        self.beta.shape_and_init((c,))
+
+    def forward(self, x):
+        if self.gamma._data is None:
+            self.infer_shape(x)
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._epsilon)
+
+
+class Lambda(Block):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            fn = getattr(npx, function, None) or getattr(np_mod, function)
+            self._func = fn
+            self._name = function
+        else:
+            self._func = function
+            self._name = getattr(function, "__name__", "lambda")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return "Lambda(%s)" % self._name
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            fn = getattr(npx, function, None) or getattr(np_mod, function)
+            self._func = fn
+            self._name = function
+        else:
+            self._func = function
+            self._name = getattr(function, "__name__", "lambda")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return "HybridLambda(%s)" % self._name
+
+
+class Concatenate(HybridSequential):
+    """Run children on the same input, concat outputs (basic_layers.py:973)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return np_mod.concatenate(out, axis=self._axis)
+
+
+class Identity(HybridBlock):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        return x

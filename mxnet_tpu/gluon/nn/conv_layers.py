@@ -1,0 +1,331 @@
+"""gluon.nn conv/pool layers (parity: python/mxnet/gluon/nn/conv_layers.py:
+Conv1-3D :182-348, Conv1-3DTranspose :433-616, Max/AvgPool1-3D :745-990,
+GlobalMax/AvgPool1-3D :1043-1179, ReflectionPad2D :1207, PixelShuffle1-3D
+:1634-1748).  Convs lower to lax.conv_general_dilated (MXU); pools to
+reduce_window."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import numpy as np_mod
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D",
+           "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="convolution", adj=None, dtype="float32"):
+        super().__init__()
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._stride = strides
+        self._pad = padding
+        self._dilate = dilation
+        self._groups = groups
+        self._layout = layout
+        self._activation = activation
+        self._op_name = op_name
+        self._adj = adj
+        if op_name == "convolution":
+            wshape = (channels, in_channels // groups if in_channels else 0) + kernel_size
+        else:  # deconvolution weight: (in_channels, channels//groups, *k)
+            wshape = (in_channels if in_channels else 0, channels // groups) + kernel_size
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        from .basic_layers import _zeros_init
+        self.bias = (Parameter("bias", shape=(channels,), dtype=dtype,
+                               init=_zeros_init(bias_initializer),
+                               allow_deferred_init=True)
+                     if use_bias else None)
+
+    def infer_shape(self, x):
+        c_axis = 1 if self._layout.startswith("NC") else -1
+        in_c = x.shape[c_axis]
+        if self._op_name == "convolution":
+            self.weight.shape_and_init(
+                (self._channels, in_c // self._groups) + self._kernel)
+        else:
+            self.weight.shape_and_init(
+                (in_c, self._channels // self._groups) + self._kernel)
+        if self.bias is not None:
+            self.bias.shape_and_init((self._channels,))
+
+    def forward(self, x):
+        if self.weight._data is None:
+            self.infer_shape(x)
+        bias = self.bias.data() if self.bias is not None else None
+        if self._op_name == "convolution":
+            out = npx.convolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._stride, dilate=self._dilate, pad=self._pad,
+                num_filter=self._channels, num_group=self._groups,
+                no_bias=bias is None, layout=self._layout)
+        else:
+            out = npx.deconvolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._stride, dilate=self._dilate, pad=self._pad,
+                adj=self._adj, num_filter=self._channels,
+                num_group=self._groups, no_bias=bias is None,
+                layout=self._layout)
+        if self._activation:
+            out = npx.activation(out, self._activation)
+        return out
+
+    def __repr__(self):
+        return "%s(%s, kernel=%s, stride=%s, pad=%s)" % (
+            type(self).__name__, self._channels, self._kernel, self._stride,
+            self._pad)
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kw):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
+                         _tup(padding, 1), _tup(dilation, 1), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kw)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kw):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
+                         _tup(padding, 2), _tup(dilation, 2), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kw)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kw):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
+                         _tup(padding, 3), _tup(dilation, 3), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kw)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kw):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
+                         _tup(padding, 1), _tup(dilation, 1), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="deconvolution", adj=_tup(output_padding, 1), **kw)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kw):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
+                         _tup(padding, 2), _tup(dilation, 2), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="deconvolution", adj=_tup(output_padding, 2), **kw)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kw):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
+                         _tup(padding, 3), _tup(dilation, 3), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="deconvolution", adj=_tup(output_padding, 3), **kw)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=True):
+        super().__init__()
+        self._kernel = pool_size
+        self._stride = strides if strides is not None else pool_size
+        self._pad = padding
+        self._pool_type = pool_type
+        self._global_pool = global_pool
+        self._convention = "full" if ceil_mode else "valid"
+        self._layout = layout
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return npx.pooling(
+            x, kernel=self._kernel, pool_type=self._pool_type,
+            stride=self._stride, pad=self._pad, global_pool=self._global_pool,
+            pooling_convention=self._convention,
+            count_include_pad=self._count_include_pad, layout=self._layout)
+
+    def __repr__(self):
+        return "%s(size=%s, stride=%s, padding=%s)" % (
+            type(self).__name__, self._kernel, self._stride, self._pad)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kw):
+        super().__init__(_tup(pool_size, 1),
+                         _tup(strides, 1) if strides is not None else None,
+                         _tup(padding, 1), ceil_mode, False, "max", layout)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kw):
+        super().__init__(_tup(pool_size, 2),
+                         _tup(strides, 2) if strides is not None else None,
+                         _tup(padding, 2), ceil_mode, False, "max", layout)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kw):
+        super().__init__(_tup(pool_size, 3),
+                         _tup(strides, 3) if strides is not None else None,
+                         _tup(padding, 3), ceil_mode, False, "max", layout)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kw):
+        super().__init__(_tup(pool_size, 1),
+                         _tup(strides, 1) if strides is not None else None,
+                         _tup(padding, 1), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True, **kw):
+        super().__init__(_tup(pool_size, 2),
+                         _tup(strides, 2) if strides is not None else None,
+                         _tup(padding, 2), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True, **kw):
+        super().__init__(_tup(pool_size, 3),
+                         _tup(strides, 3) if strides is not None else None,
+                         _tup(padding, 3), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class GlobalMaxPool1D(_Pool):
+    def __init__(self, layout="NCW", **kw):
+        super().__init__((1,), None, (0,), False, True, "max", layout)
+
+
+class GlobalMaxPool2D(_Pool):
+    def __init__(self, layout="NCHW", **kw):
+        super().__init__((1, 1), None, (0, 0), False, True, "max", layout)
+
+
+class GlobalMaxPool3D(_Pool):
+    def __init__(self, layout="NCDHW", **kw):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max", layout)
+
+
+class GlobalAvgPool1D(_Pool):
+    def __init__(self, layout="NCW", **kw):
+        super().__init__((1,), None, (0,), False, True, "avg", layout)
+
+
+class GlobalAvgPool2D(_Pool):
+    def __init__(self, layout="NCHW", **kw):
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", layout)
+
+
+class GlobalAvgPool3D(_Pool):
+    def __init__(self, layout="NCDHW", **kw):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg", layout)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = padding
+
+    def forward(self, x):
+        p = self._padding
+        pad_width = [(p[0], p[1]), (p[2], p[3]), (p[4], p[5]), (p[6], p[7])]
+        return np_mod.pad(x, pad_width, mode="reflect")
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim):
+        super().__init__()
+        self._factor = _tup(factor, ndim)
+        self._ndim = ndim
+
+    def __repr__(self):
+        return "%s(factor=%s)" % (type(self).__name__, self._factor)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+    def forward(self, x):
+        f = self._factor[0]
+        n, c, w = x.shape
+        x = x.reshape((n, c // f, f, w))
+        x = x.transpose((0, 1, 3, 2))
+        return x.reshape((n, c // f, w * f))
+
+
+class PixelShuffle2D(_PixelShuffle):
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+    def forward(self, x):
+        f1, f2 = self._factor
+        n, c, h, w = x.shape
+        x = x.reshape((n, c // (f1 * f2), f1, f2, h, w))
+        x = x.transpose((0, 1, 4, 2, 5, 3))
+        return x.reshape((n, c // (f1 * f2), h * f1, w * f2))
+
+
+class PixelShuffle3D(_PixelShuffle):
+    def __init__(self, factor):
+        super().__init__(factor, 3)
+
+    def forward(self, x):
+        f1, f2, f3 = self._factor
+        n, c, d, h, w = x.shape
+        x = x.reshape((n, c // (f1 * f2 * f3), f1, f2, f3, d, h, w))
+        x = x.transpose((0, 1, 5, 2, 6, 3, 7, 4))
+        return x.reshape((n, c // (f1 * f2 * f3), d * f1, h * f2, w * f3))

@@ -1,0 +1,197 @@
+"""gluon.Parameter (parity: python/mxnet/gluon/parameter.py).
+
+A Parameter owns an ndarray (PJRT buffer) plus grad/grad_req and supports
+deferred shape inference: layers may construct with unknown dims (-1/0) and
+the shape finalizes at the first forward (reference: deferred init via
+shape inference on HybridBlock).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+from .. import initializer as _init
+from ..context import Context, current_context
+from ..ndarray import ndarray, _wrap_value
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(Exception):
+    pass
+
+
+def _shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s is not None and s > 0 for s in shape)
+
+
+class Parameter:
+    def __init__(self, name="weight", grad_req="write", shape=None,
+                 dtype=onp.float32, lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        self._data = None
+        self._deferred_init = None  # (init, ctx)
+        self._structure_name = None  # set by Block registration
+
+    # ------------------------------------------------------------------
+    @property
+    def name(self):
+        return self._structure_name or self._name
+
+    @name.setter
+    def name(self, v):
+        self._name = v
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(
+            s1 in (0, -1, None) or s1 == s2
+            for s1, s2 in zip(self._shape, new_shape))
+        if not (len(self._shape) == len(new_shape) and unknown_ok):
+            raise AssertionError(
+                "Expected shape %s is incompatible with given shape %s for "
+                "Parameter %s" % (str(new_shape), str(self._shape), self.name))
+        self._shape = tuple(new_shape)
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False, device=None):
+        ctx = ctx or device
+        if self._data is not None and not force_reinit:
+            return
+        default_init = default_init or _init.Uniform()
+        if not _shape_is_known(self._shape):
+            if not self.allow_deferred_init:
+                raise ValueError(
+                    "Cannot initialize Parameter %s: unknown shape %s and "
+                    "deferred init not allowed" % (self.name, self._shape))
+            self._deferred_init = (init or self.init or default_init, ctx)
+            return
+        self._finish_init(init or self.init or default_init, ctx)
+
+    def _finish_init(self, initializer, ctx):
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else None
+        arr = _wrap_value(jnp.zeros(self._shape, self.dtype))
+        desc = _init.InitDesc(self.name, {"__init__": getattr(initializer, "dumps", lambda: "")()})
+        initializer(desc, arr)
+        if ctx is not None:
+            arr = arr.as_in_ctx(ctx)
+        self._data = arr
+        self._deferred_init = None
+        if self.grad_req != "null":
+            self._data.attach_grad(self.grad_req)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not _shape_is_known(self._shape):
+            raise DeferredInitializationError(
+                "Parameter %s has unknown shape %s at first forward"
+                % (self.name, self._shape))
+        initializer, ctx = self._deferred_init
+        self._finish_init(initializer, ctx)
+
+    def shape_and_init(self, inferred_shape):
+        """Called by layers at first forward with the inferred full shape."""
+        self.shape = inferred_shape
+        if self._deferred_init is not None:
+            self._finish_deferred_init()
+
+    # ------------------------------------------------------------------
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    "Parameter %s has not been initialized yet (deferred "
+                    "shape); run a forward pass first" % self.name)
+            raise RuntimeError(
+                "Parameter %s has not been initialized. Call .initialize()"
+                % self.name)
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def list_ctx(self):
+        return [self.data().ctx] if self._data is not None else []
+
+    def set_data(self, data):
+        data = data if isinstance(data, ndarray) else _wrap_value(jnp.asarray(data))
+        if self._data is None:
+            self._shape = data.shape
+            self._data = data.astype(self.dtype) if data.dtype != self.dtype else data
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+            self._deferred_init = None
+        else:
+            self._data._set_data(data._data.astype(self._data.dtype))
+
+    def grad(self, ctx=None):
+        d = self.data(ctx)
+        if d._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient of Parameter %s: grad_req='null'"
+                % self.name)
+        return d._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_ctx(ctx)
+
+    reset_device = reset_ctx
+
+    def cast(self, dtype):
+        self.dtype = onp.dtype(dtype)
+        if self._data is not None:
+            grad_req = self.grad_req
+            arr = self._data.astype(dtype)
+            self._data = arr
+            if grad_req != "null":
+                self._data.attach_grad(grad_req)
+
+    def var(self):
+        return self.data()
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self._shape, onp.dtype(self.dtype).name)
+
+
+class Constant(Parameter):
+    """Non-learnable parameter holding a constant (reference gluon Constant)."""
+
+    def __init__(self, value, name="const"):
+        if not isinstance(value, ndarray):
+            value = _wrap_value(jnp.asarray(value))
+        self.value = value
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=_init.Constant(value))

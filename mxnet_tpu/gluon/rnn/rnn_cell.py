@@ -1,0 +1,289 @@
+"""gluon.rnn cells (parity: python/mxnet/gluon/rnn/rnn_cell.py —
+RNNCell/LSTMCell/GRUCell + Sequential/Dropout/Zoneout/Residual/
+Bidirectional modifiers)."""
+from __future__ import annotations
+
+from ... import numpy as np_mod
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(np_mod.zeros(info["shape"]))
+        return states
+
+    def reset(self):
+        pass
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell over `length` steps (reference BaseRNNCell.unroll)."""
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            step = inputs[t] if axis == 0 else inputs[:, t]
+            out, states = self(step, states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            outputs = np_mod.stack(outputs, axis=axis)
+        if valid_length is not None:
+            outputs = npx.sequence_mask(outputs, valid_length,
+                                        use_sequence_length=True, axis=axis)
+        return outputs, states
+
+
+class _FusedBaseCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = self._num_gates
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(ng * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(ng * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        from ..nn.basic_layers import _zeros_init
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng * hidden_size,),
+                                  init=_zeros_init(i2h_bias_initializer),
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng * hidden_size,),
+                                  init=_zeros_init(h2h_bias_initializer),
+                                  allow_deferred_init=True)
+
+    def infer_shape(self, x, *a):
+        ng = self._num_gates
+        self.i2h_weight.shape_and_init((ng * self._hidden_size, x.shape[-1]))
+        self.h2h_weight.shape_and_init((ng * self._hidden_size, self._hidden_size))
+        self.i2h_bias.shape_and_init((ng * self._hidden_size,))
+        self.h2h_bias.shape_and_init((ng * self._hidden_size,))
+
+    def _gates_x(self, x):
+        if self.i2h_weight._data is None:
+            self.infer_shape(x)
+        return npx.fully_connected(x, self.i2h_weight.data(),
+                                   self.i2h_bias.data(),
+                                   num_hidden=self._num_gates * self._hidden_size,
+                                   flatten=False)
+
+    def _gates_h(self, h):
+        return npx.fully_connected(h, self.h2h_weight.data(),
+                                   self.h2h_bias.data(),
+                                   num_hidden=self._num_gates * self._hidden_size,
+                                   flatten=False)
+
+
+class RNNCell(_FusedBaseCell):
+    _num_gates = 1
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        out = npx.activation(self._gates_x(x) + self._gates_h(h),
+                             self._activation)
+        return out, [out]
+
+
+class LSTMCell(_FusedBaseCell):
+    _num_gates = 4
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        h, c = states
+        gates = self._gates_x(x) + self._gates_h(h)
+        H = self._hidden_size
+        i = npx.sigmoid(gates[:, :H])
+        f = npx.sigmoid(gates[:, H:2 * H])
+        u = np_mod.tanh(gates[:, 2 * H:3 * H])
+        o = npx.sigmoid(gates[:, 3 * H:])
+        next_c = f * c + i * u
+        next_h = o * np_mod.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(_FusedBaseCell):
+    _num_gates = 3
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        gx = self._gates_x(x)
+        gh = self._gates_h(h)
+        H = self._hidden_size
+        r = npx.sigmoid(gx[:, :H] + gh[:, :H])
+        z = npx.sigmoid(gx[:, H:2 * H] + gh[:, H:2 * H])
+        n = np_mod.tanh(gx[:, 2 * H:] + r * gh[:, 2 * H:])
+        next_h = (1 - z) * n + z * h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self):
+        super().__init__()
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return sum([c.state_info(batch_size) for c in self._cells], [])
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return sum([c.begin_state(batch_size, **kwargs)
+                    for c in self._cells], [])
+
+    def forward(self, x, states):
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            n = len(cell.state_info())
+            x, s = cell(x, states[pos:pos + n])
+            pos += n
+            next_states.extend(s)
+        return x, next_states
+
+    def __len__(self):
+        return len(self._cells)
+
+    def __getitem__(self, i):
+        return self._cells[i]
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, x, states):
+        if self._rate > 0:
+            x = npx.dropout(x, p=self._rate, axes=self._axes)
+        return x, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def forward(self, x, states):
+        out, next_states = self.base_cell(x, states)
+        if self._zo > 0:
+            mask = npx.dropout(np_mod.ones_like(out), p=self._zo)
+            prev = self._prev_output if self._prev_output is not None \
+                else np_mod.zeros_like(out)
+            out = np_mod.where(mask > 0, out, prev)
+        if self._zs > 0:
+            next_states = [
+                np_mod.where(npx.dropout(np_mod.ones_like(ns), p=self._zs) > 0,
+                             ns, s)
+                for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+    def reset(self):
+        self._prev_output = None
+
+
+class ResidualCell(_ModifierCell):
+    def forward(self, x, states):
+        out, next_states = self.base_cell(x, states)
+        return out + x, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.l_cell.begin_state(batch_size, **kwargs) + \
+            self.r_cell.begin_state(batch_size, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, True, valid_length)
+        rev = npx.sequence_reverse(inputs, valid_length,
+                                   use_sequence_length=valid_length is not None,
+                                   axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state[nl:], layout, True, valid_length)
+        r_out = npx.sequence_reverse(r_out, valid_length,
+                                     use_sequence_length=valid_length is not None,
+                                     axis=axis)
+        out = np_mod.concatenate([l_out, r_out], axis=-1)
+        return out, l_states + r_states

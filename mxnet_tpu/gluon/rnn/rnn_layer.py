@@ -1,0 +1,122 @@
+"""gluon.rnn fused layers (parity: python/mxnet/gluon/rnn/rnn_layer.py —
+RNN/LSTM/GRU backed by the fused rnn op `src/operator/rnn.cc`).
+
+TPU-native: the fused op is a lax.scan over precomputed input projections
+(ops/rnn.py); the whole stacked/bidirectional network compiles to one XLA
+program under hybridize()."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import numpy as np_mod
+from ... import numpy_extension as npx
+from ...ops.rnn import param_size
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32", **kwargs):
+        super().__init__()
+        assert layout in ("TNC", "NTC"), "layout must be TNC or NTC"
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        # single flattened parameter vector, matching the reference rnn op
+        shape = (param_size(mode, input_size, hidden_size, num_layers,
+                            bidirectional),) if input_size else (0,)
+        self.rnn_param = Parameter("rnn_param", shape=shape, dtype=dtype,
+                                   allow_deferred_init=True)
+
+    def infer_shape(self, x, *a):
+        in_size = x.shape[-1]
+        self._input_size = in_size
+        self.rnn_param.shape_and_init(
+            (param_size(self._mode, in_size, self._hidden_size,
+                        self._num_layers, self._dir == 2),))
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import numpy as mxnp
+        states = []
+        n = self._num_layers * self._dir
+        shapes = [(n, batch_size, self._hidden_size)]
+        if self._mode == "lstm":
+            shapes.append((n, batch_size, self._hidden_size))
+        for s in shapes:
+            states.append(mxnp.zeros(s, dtype=self._dtype))
+        return states
+
+    def forward(self, x, states=None):
+        if self.rnn_param._data is None:
+            self.infer_shape(x)
+        if self._layout == "NTC":
+            x = x.swapaxes(0, 1)
+        batch = x.shape[1]
+        ret_states = states is not None
+        if states is None:
+            states = self.begin_state(batch)
+        elif not isinstance(states, (list, tuple)):
+            states = [states]
+        if self._mode == "lstm":
+            out = npx.rnn(data=x, parameters=self.rnn_param.data(),
+                          state=states[0], state_cell=states[1],
+                          mode=self._mode, state_size=self._hidden_size,
+                          num_layers=self._num_layers,
+                          bidirectional=self._dir == 2, p=self._dropout,
+                          state_outputs=True)
+            out, hT, cT = out
+            new_states = [hT, cT]
+        else:
+            out, hT = npx.rnn(data=x, parameters=self.rnn_param.data(),
+                              state=states[0], mode=self._mode,
+                              state_size=self._hidden_size,
+                              num_layers=self._num_layers,
+                              bidirectional=self._dir == 2, p=self._dropout,
+                              state_outputs=True)
+            new_states = [hT]
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        if ret_states:
+            return out, new_states
+        return out
+
+    def __repr__(self):
+        return "%s(%s, hidden=%d, layers=%d%s)" % (
+            type(self).__name__, self._layout, self._hidden_size,
+            self._num_layers, ", bidirectional" if self._dir == 2 else "")
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

@@ -1,0 +1,121 @@
+"""gluon.Trainer (parity: python/mxnet/gluon/trainer.py: _init_kvstore :188,
+step :334, allreduce_grads :363, update :411).
+
+TPU-native: gradients are aggregated through the kvstore abstraction —
+"local"/"device" single-process stores, or "tpu_ici" which lowers pushpull
+to an XLA all-reduce over the ICI mesh (kvstore/ici.py).  The optimizer
+update itself is a fused XLA kernel per parameter (ops/optimizer_ops.py).
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..kvstore import create as kv_create, KVStoreBase
+from ..ndarray import ndarray
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, dict):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a dict/list of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise ValueError("invalid parameter %r" % (p,))
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        optimizer_params = optimizer_params or {}
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._compression_params = compression_params
+        self._states = {}
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+
+    def _init_kvstore(self):
+        if self._kvstore_type is None:
+            self._kvstore = None
+        elif isinstance(self._kvstore_type, KVStoreBase):
+            self._kvstore = self._kvstore_type
+        else:
+            self._kvstore = kv_create(self._kvstore_type)
+        self._kv_initialized = True
+        if self._kvstore is not None and self._update_on_kvstore:
+            self._kvstore.set_optimizer(self._optimizer)
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce grads then update (reference trainer.py:334)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None:
+                grads = p.list_grad()
+                self._kvstore.pushpull(str(i), grads[0], out=grads[0],
+                                       priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if i not in self._states:
+                self._states[i] = self._optimizer.create_state_multi_precision(
+                    i, p.data())
+            self._optimizer.update_multi_precision(
+                [i], [p.data()], [p.grad()], [self._states[i]])
+
+    def save_states(self, fname):
+        """Serialize optimizer states (reference Trainer.save_states)."""
+        updater = opt_mod.Updater(self._optimizer)
+        updater.states = self._states
+        with open(fname, "wb") as f:
+            f.write(updater.get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        updater = opt_mod.Updater(self._optimizer)
+        with open(fname, "rb") as f:
+            updater.set_states(f.read())
+        self._states = updater.states
+        self._optimizer = updater.optimizer
+        self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}

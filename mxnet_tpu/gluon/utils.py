@@ -1,0 +1,85 @@
+"""gluon.utils (parity: python/mxnet/gluon/utils.py: split_data,
+split_and_load, clip_global_norm, download helpers)."""
+from __future__ import annotations
+
+import os
+
+import numpy as onp
+
+from .. import numpy as np
+from ..context import Context
+from ..ndarray import ndarray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices"
+            % (str(data.shape), num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        sl = [slice(None)] * data.ndim
+        sl[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(sl)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, ndarray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_ctx(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_ctx(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the l2 norm of their concat is <= max_norm."""
+    assert len(arrays) > 0
+    total = 0.0
+    for a in arrays:
+        total = total + float(np.square(a).sum())
+    total_norm = total ** 0.5
+    if check_isfinite and not onp.isfinite(total_norm):
+        import warnings
+        warnings.warn("nan or inf found in clip_global_norm")
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download helper (no-network environments raise at call time)."""
+    import urllib.request
+    fname = path or url.split("/")[-1]
+    if os.path.isdir(fname):
+        fname = os.path.join(fname, url.split("/")[-1])
+    if overwrite or not os.path.exists(fname) or (
+            sha1_hash and not check_sha1(fname, sha1_hash)):
+        d = os.path.dirname(os.path.abspath(os.path.expanduser(fname)))
+        if not os.path.exists(d):
+            os.makedirs(d)
+        urllib.request.urlretrieve(url, fname)
+    return fname

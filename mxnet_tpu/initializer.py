@@ -1,0 +1,263 @@
+"""Weight initializers (parity: python/mxnet/initializer.py).
+
+Registry + the full reference set: Zero/One/Constant/Uniform/Normal/
+Orthogonal/Xavier/MSRAPrelu/Bilinear/LSTMBias/Mixed.  Samplers ride the
+global TPU PRNG (_rng.py).
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ._rng import next_key
+from .ndarray import ndarray, _wrap_value
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+class Initializer:
+    """Base initializer; callable on (name, arr) or InitDesc like the
+    reference."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr=None):
+        if arr is None:
+            raise ValueError("need array")
+        name = desc if isinstance(desc, str) else getattr(desc, "name", str(desc))
+        self.init_weight(name, arr)
+
+    def init_weight(self, name, arr):
+        if name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith("beta"):
+            self._init_zero(arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_zero(self, arr):
+        arr._set_data(jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_one(self, arr):
+        arr._set_data(jnp.ones(arr.shape, arr.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __repr__(self):
+        return "%s(%r)" % (self.__class__.__name__, self._kwargs)
+
+
+class InitDesc(str):
+    """Parameter-name descriptor carrying init attrs (reference InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        v = self.value
+        if isinstance(v, ndarray):
+            arr._set_data(jnp.broadcast_to(v._data, arr.shape).astype(arr.dtype))
+        else:
+            arr._set_data(jnp.full(arr.shape, v, arr.dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        k = next_key()
+        arr._set_data(jax.random.uniform(
+            k, arr.shape, jnp.float32, -self.scale, self.scale).astype(arr.dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        k = next_key()
+        arr._set_data((jax.random.normal(k, arr.shape, jnp.float32)
+                       * self.sigma).astype(arr.dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        k = next_key()
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(k, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(k, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._set_data((self.scale * q.reshape(arr.shape)).astype(arr.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference initializer.py Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError("Xavier requires ndim>=2 (param %s: %s)" % (name, shape))
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("bad factor_type %r" % (self.factor_type,))
+        scale = math.sqrt(self.magnitude / factor)
+        k = next_key()
+        if self.rnd_type == "uniform":
+            data = jax.random.uniform(k, shape, jnp.float32, -scale, scale)
+        elif self.rnd_type == "gaussian":
+            data = jax.random.normal(k, shape, jnp.float32) * scale
+        else:
+            raise ValueError("bad rnd_type %r" % (self.rnd_type,))
+        arr._set_data(data.astype(arr.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype=onp.float32)
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight.reshape(shape), arr.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = onp.zeros(arr.shape, onp.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._set_data(jnp.asarray(b, arr.dtype))
+
+
+class Mixed:
+    """Mix initializers by regex on param name (reference Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError("no initializer matched %r" % (name,))
+
+
+# alias namespace `mx.init.*` like the reference
+class _InitModule:
+    Initializer = Initializer
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Uniform = Uniform
+    Normal = Normal
+    Orthogonal = Orthogonal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    Mixed = Mixed
+    InitDesc = InitDesc
+
+
+init = _InitModule

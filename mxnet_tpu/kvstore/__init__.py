@@ -1,0 +1,249 @@
+"""kvstore — gradient aggregation / parameter synchronization.
+
+Parity: reference `src/kvstore/` + `python/mxnet/kvstore/`:
+`KVStoreBase` plugin registry (python/mxnet/kvstore/base.py), factory
+`create("local"/"device"/"dist_sync"/"dist_async"/"nccl"/"p3")`
+(src/kvstore/kvstore.cc:42), API Init/Push/Pull/PushPull/Broadcast
+(include/mxnet/kvstore.h:150-276).
+
+TPU-native mapping (SURVEY.md §5.8): the NCCL store becomes `tpu_ici` —
+reductions ride XLA collectives over ICI (single-process multi-device via
+jax.device_put + fused adds; pod-scale via the parallel/ SPMD path where
+GSPMD inserts all-reduces inside the compiled step).  The ps-lite
+parameter-server tier maps to `dist_sync`/`dist_async` over jax.distributed
+(DCN) — multi-process support lands with the launcher milestone.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import ndarray, _wrap_value
+
+__all__ = ["KVStore", "KVStoreBase", "create"]
+
+_REGISTRY = {}
+
+
+class KVStoreBase:
+    """Plugin registry base (parity: python/mxnet/kvstore/base.py)."""
+
+    OPTIMIZER = "optimizer"
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        _REGISTRY[name] = klass
+        return klass
+
+    # interface
+    def broadcast(self, key, value, out):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        return type(self).__name__.lower()
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+
+def _reduce(values):
+    """Sum a list of ndarrays (cross-device reduce).
+
+    Single-process analog of CommDevice::Reduce (src/kvstore/comm.h:452):
+    values living on different devices are gathered to the first value's
+    device and summed in one fused XLA add chain.
+    """
+    if isinstance(values, ndarray):
+        return values
+    if len(values) == 1:
+        return values[0]
+    dev = values[0]._data.devices().pop() if hasattr(values[0]._data, "devices") else None
+    total = values[0]._data
+    for v in values[1:]:
+        data = v._data
+        if dev is not None and hasattr(data, "devices") and data.devices() != {dev}:
+            data = jax.device_put(data, dev)
+        total = total + data
+    return _wrap_value(total)
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """'local'/'device' single-process store (kvstore_local.h/comm.h).
+
+    On TPU both flavors aggregate on-device (there is no separate "reduce
+    on CPU" win on a TPU host), so local==device.
+    """
+
+    def __init__(self, name="device"):
+        self._name = name
+        self._data = {}
+        self._updater = None
+        self._optimizer = None
+        self._opt_states = {}
+
+    @property
+    def type(self):
+        return self._name
+
+    def init(self, key, value):
+        self._data[str(key)] = value
+
+    def broadcast(self, key, value, out=None, priority=0):
+        v = value if isinstance(value, ndarray) else _reduce(value)
+        self._data[str(key)] = v
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                o._set_data(jax.device_put(v._data, o._data.devices().pop())
+                            if hasattr(o._data, "devices") else v._data)
+        return out
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        reduced = _reduce(value)
+        if self._updater is not None:
+            k = str(key)
+            if k not in self._data:
+                self._data[k] = reduced
+            else:
+                self._updater(int(key) if str(key).isdigit() else k, reduced,
+                              self._data[k])
+        else:
+            self._data[str(key)] = reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        v = self._data[str(key)]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._set_data(v._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            outs = out if out is not None else [None] * len(key)
+            for k, v, o in zip(key, value, outs):
+                self.pushpull(k, v, o, priority)
+            return
+        reduced = _reduce(value)
+        self._data[str(key)] = reduced
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                o._set_data(reduced._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        self.pull(key, out, priority)
+
+    def set_optimizer(self, optimizer):
+        from ..optimizer import Updater
+        self._optimizer = optimizer
+        self._updater = Updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = compression_params
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise ValueError("optimizer not set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise ValueError("optimizer not set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        from ..ndarray import waitall
+        waitall()
+
+
+@KVStoreBase.register
+class TpuIci(KVStore):
+    """kvstore=tpu_ici (SURVEY.md §5.8): the NCCL-store analog.
+
+    Single-process multi-device reductions are fused XLA adds + broadcast
+    (ICI transfers under PJRT); at pod scale, prefer the SPMD path
+    (mxnet_tpu.parallel) where GSPMD compiles the same pushpull into
+    all-reduce collectives inside the step — this store exists so
+    reference-style Trainer code runs unchanged.
+    """
+
+    def __init__(self):
+        super().__init__("tpu_ici")
+        self._devices = jax.devices()
+
+    @property
+    def num_workers(self):
+        try:
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    @property
+    def rank(self):
+        try:
+            return jax.process_index()
+        except Exception:
+            return 0
+
+
+def create(name="local"):
+    """Factory (parity: src/kvstore/kvstore.cc:42)."""
+    name = (name or "local").lower()
+    if name in ("local", "device", "local_allreduce_cpu",
+                "local_allreduce_device"):
+        return KVStore(name)
+    if name in ("tpu_ici", "nccl"):
+        return TpuIci()
+    if name in ("dist_sync", "dist_async", "dist_sync_device", "dist", "p3"):
+        # multi-process tier: requires jax.distributed initialization; in a
+        # single process it degrades to local semantics (reference runs the
+        # same code path with 1 worker)
+        store = TpuIci()
+        store._name = name
+        return store
+    if name in _REGISTRY:
+        return _REGISTRY[name]()
+    raise ValueError("unknown kvstore type %r" % (name,))

@@ -1,0 +1,12 @@
+"""mx.nd — legacy NDArray namespace (parity: python/mxnet/ndarray/).
+
+In the reference, mx.nd is the pre-NumPy op namespace; mxnet-2.0 steers
+users to mx.np.  Here mx.nd re-exports the mx.np surface plus the legacy
+entry points (waitall, load/save, NDArray) so reference scripts written
+against mx.nd keep running.
+"""
+from .numpy import *  # noqa: F401,F403
+from .numpy import random, linalg  # noqa: F401
+from .ndarray import ndarray as NDArray, array, waitall  # noqa: F401
+from .numpy_extension import save, load, savez  # noqa: F401
+from . import numpy_extension as contrib  # noqa: F401  (mx.nd.contrib.*)

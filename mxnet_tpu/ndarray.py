@@ -1,0 +1,684 @@
+"""ndarray: the imperative array type over XLA/PJRT buffers.
+
+Parity: reference `include/mxnet/ndarray.h:82` (NDArray = Chunk{storage,
+engine-var} + shape/dtype) and `python/mxnet/numpy/multiarray.py` (ndarray).
+
+TPU-native design: an ndarray owns a `jax.Array` (a PJRT buffer future).
+JAX/PJRT already provides the async-dispatch contract the reference builds
+with its threaded engine (`src/engine/threaded_engine.cc`): every op returns
+immediately with a buffer future, ordering is per-device program order, and
+`wait_to_read()`/`asnumpy()` are the sync points.  The host-side "engine" is
+therefore thin (see engine.py); `MXNET_ENGINE_TYPE=NaiveEngine` degrades to
+synchronous execution for debugging, matching `src/engine/naive_engine.cc`.
+
+Every operator goes through `apply_op`, the equivalent of
+`Imperative::Invoke` (src/imperative/imperative.cc:98): it unwraps inputs,
+runs the jnp/lax computation (XLA-compiled + cached per shape/dtype by JAX),
+and — when autograd is recording — captures a VJP closure on the tape
+(RecordOp analog).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .autograd import TapeNode
+from .context import Context, current_context
+
+__all__ = ["ndarray", "NDArray", "apply_op", "from_numpy", "waitall"]
+
+# --------------------------------------------------------------------------
+# engine shims: NaiveEngine mode + waitall tracking
+# --------------------------------------------------------------------------
+_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+_RECENT = deque(maxlen=128)  # recently produced buffers, for waitall()
+_RECENT_LOCK = threading.Lock()
+
+
+def _track(data):
+    if isinstance(data, jax.Array) and not isinstance(data, jax.core.Tracer):
+        try:
+            if _NAIVE:
+                jax.block_until_ready(data)
+            else:
+                with _RECENT_LOCK:
+                    _RECENT.append(data)
+        except Exception:
+            pass
+
+
+def waitall():
+    """Block until all pending async work completes.
+
+    Parity: mx.nd.waitall → Engine::WaitForAll
+    (src/engine/threaded_engine.cc:416). PJRT orders work per device, so
+    blocking on recently produced buffers drains the queues; exceptions
+    raised by async computations surface here (reference: engine
+    ExceptionRef rethrow at sync points).
+    """
+    with _RECENT_LOCK:
+        pending = list(_RECENT)
+        _RECENT.clear()
+    for buf in pending:
+        try:
+            jax.block_until_ready(buf)
+        except Exception:
+            raise
+
+
+# --------------------------------------------------------------------------
+# wrapping helpers
+# --------------------------------------------------------------------------
+def _unwrap(x):
+    return x._data if isinstance(x, ndarray) else x
+
+
+def _unwrap_deep(x):
+    if isinstance(x, ndarray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap_deep(v) for v in x)
+    if isinstance(x, slice):
+        return slice(_unwrap_deep(x.start), _unwrap_deep(x.stop), _unwrap_deep(x.step))
+    return x
+
+
+def _wrap_value(data, node=None, index=0):
+    arr = ndarray.__new__(ndarray)
+    arr._data = data
+    arr._node = node
+    arr._out_index = index
+    arr._marked = False
+    arr._grad = None
+    arr._grad_req = "write"
+    if node is None:
+        _track(data)
+    return arr
+
+
+def apply_op(fn, *args, **kwargs):
+    """Invoke op `fn(*vals, **kwargs)`; record VJP on the tape if needed.
+
+    `args` may mix ndarray and constants — only ndarray positions are
+    differentiable (the rest are closed over, like non-tensor NodeAttrs in
+    the reference op registry).
+    """
+    nd_idx = [i for i, a in enumerate(args) if isinstance(a, ndarray)]
+    nd_args = [args[i] for i in nd_idx]
+    vals = [a._data for a in nd_args]
+
+    recording = autograd.is_recording() and any(
+        a._node is not None or a._marked for a in nd_args
+    )
+
+    if recording:
+        template = list(args)
+
+        def closed(*vs):
+            full = list(template)
+            for i, v in zip(nd_idx, vs):
+                full[i] = v
+            return fn(*full, **kwargs)
+
+        out_vals, vjp_fn = jax.vjp(closed, *vals)
+    else:
+        full = list(args)
+        for i, v in zip(nd_idx, vals):
+            full[i] = v
+        out_vals = fn(*full, **kwargs)
+        vjp_fn = None
+
+    multi = isinstance(out_vals, (tuple, list))
+    outs = list(out_vals) if multi else [out_vals]
+
+    node = None
+    if recording:
+        node = TapeNode(
+            vjp_fn,
+            nd_args,
+            len(outs),
+            [o.shape for o in outs],
+            [o.dtype for o in outs],
+            out_is_tuple=multi,
+            fn=closed,
+        )
+    wrapped = [_wrap_value(o, node, i) for i, o in enumerate(outs)]
+    if multi:
+        return type(out_vals)(wrapped) if isinstance(out_vals, tuple) else wrapped
+    return wrapped[0]
+
+
+def _to_jax(obj, dtype=None, ctx=None):
+    if isinstance(obj, ndarray):
+        data = obj._data
+        if dtype is not None:
+            data = data.astype(dtype)
+    else:
+        data = jnp.asarray(obj, dtype=dtype)
+    if ctx is not None and isinstance(data, jax.Array):
+        dev = ctx.jax_device if isinstance(ctx, Context) else ctx
+        try:
+            if jax.core.is_concrete(data):
+                data = jax.device_put(data, dev)
+        except Exception:
+            pass
+    return data
+
+
+def array(obj, dtype=None, ctx=None, device=None):
+    """Create an ndarray (parity: mx.np.array)."""
+    ctx = ctx or device
+    if dtype is None and not hasattr(obj, "dtype"):
+        # match reference default_dtype: python floats -> float32
+        pass
+    return _wrap_value(_to_jax(obj, dtype=dtype, ctx=ctx))
+
+
+def from_numpy(a, zero_copy=False):
+    return array(a)
+
+
+# --------------------------------------------------------------------------
+# the ndarray class
+# --------------------------------------------------------------------------
+class ndarray:
+    """NumPy-compatible imperative array on TPU (mx.np.ndarray parity)."""
+
+    __slots__ = ("_data", "_node", "_out_index", "_marked", "_grad",
+                 "_grad_req", "__weakref__")
+
+    def __init__(self, data=None, dtype=None, ctx=None):
+        self._data = _to_jax(data if data is not None else (), dtype, ctx)
+        self._node = None
+        self._out_index = 0
+        self._marked = False
+        self._grad = None
+        self._grad_req = "write"
+
+    # -- properties -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(onp.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def itemsize(self):
+        return self.dtype.itemsize
+
+    @property
+    def T(self):
+        return apply_op(jnp.transpose, self)
+
+    @property
+    def ctx(self):
+        try:
+            dev = self._data.devices().pop()
+            dt = "tpu" if dev.platform != "cpu" else dev.platform
+            return Context(dt, dev.id)
+        except Exception:
+            return current_context()
+
+    context = ctx
+    device = ctx
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def stype(self):
+        return "default"
+
+    # -- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req="write"):
+        """Allocate gradient buffer & mark as autograd leaf
+        (parity: NDArray.attach_grad → MXAutogradMarkVariables)."""
+        self._marked = True
+        self._grad_req = grad_req
+        self._grad = _wrap_value(jnp.zeros(self.shape, self.dtype))
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad], retain_graph, train_mode)
+
+    def detach(self):
+        return _wrap_value(self._data)
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._data = jnp.zeros_like(self._grad._data)
+
+    # -- sync points ------------------------------------------------------
+    def wait_to_read(self):
+        try:
+            jax.block_until_ready(self._data)
+        except jax.errors.ConcretizationTypeError:
+            pass
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        self.wait_to_read()
+        return onp.asarray(self._data)
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kw):
+        return self._data.__dlpack__(**kw)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    # -- conversion / movement -------------------------------------------
+    def astype(self, dtype, copy=True):
+        if onp.dtype(dtype) == self.dtype and not copy:
+            return self
+        return apply_op(lambda x: x.astype(onp.dtype(dtype)), self)
+
+    def copy(self):
+        return apply_op(jnp.copy, self)
+
+    def copyto(self, other):
+        if isinstance(other, ndarray):
+            other._set_data(jnp.broadcast_to(self._data, other.shape).astype(other.dtype))
+            return other
+        if isinstance(other, Context):
+            return self.as_in_ctx(other)
+        raise TypeError("copyto: unsupported target %r" % (other,))
+
+    def as_in_ctx(self, ctx):
+        if not isinstance(ctx, Context):
+            raise TypeError("expected Context")
+        data = jax.device_put(self._data, ctx.jax_device)
+        return _wrap_value(data)
+
+    as_in_context = as_in_ctx
+    to_device = as_in_ctx
+    as_np_ndarray = lambda self: self
+    as_nd_ndarray = lambda self: self
+
+    # -- mutation ---------------------------------------------------------
+    def _set_data(self, data):
+        if autograd.is_recording() and (self._node is not None):
+            raise RuntimeError(
+                "in-place mutation of an array produced inside a record() "
+                "scope is not allowed (reference: kWriteInplace hazard)"
+            )
+        self._data = data
+        _track(data)
+
+    def __setitem__(self, key, value):
+        key = _unwrap_deep(key)
+        v = _unwrap(value)
+        if isinstance(key, tuple) and len(key) == 0:
+            key = Ellipsis
+        bkey = key
+        if isinstance(bkey, jax.Array) and bkey.dtype == jnp.bool_:
+            self._set_data(jnp.where(bkey, jnp.asarray(v, self._data.dtype), self._data)
+                           if onp.ndim(v) == 0 else self._data.at[bkey].set(v))
+            return
+        self._set_data(self._data.at[bkey].set(v))
+
+    def __getitem__(self, key):
+        key = _unwrap_deep(key)
+        return apply_op(lambda x: x[key], self)
+
+    # -- dunder scalars ----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of an ndarray with %d elements is "
+                "ambiguous. Use a.any() or a.all()." % self.size)
+        return bool(self.asnumpy().item())
+
+    def __float__(self):
+        return float(self.asnumpy().item())
+
+    def __int__(self):
+        return int(self.asnumpy().item())
+
+    def __index__(self):
+        return int(self.asnumpy().item())
+
+    def __repr__(self):
+        try:
+            s = str(self.asnumpy())
+        except Exception as e:  # tracers
+            s = "<abstract %s %s>" % (self._data.aval.str_short(), type(self._data).__name__)
+        return "array(%s, ctx=%s)" % (s.replace("\n", "\n      "), self.ctx)
+
+    __hash__ = None
+
+    # -- arithmetic -------------------------------------------------------
+    def _binary(self, other, fn, reverse=False):
+        if isinstance(other, (list, tuple, onp.ndarray)):
+            other = array(other)
+        if reverse:
+            return apply_op(lambda b, a: fn(a, b), self, other) if not isinstance(
+                other, ndarray) else apply_op(fn, other, self)
+        return apply_op(fn, self, other)
+
+    def __add__(self, o):
+        return self._binary(o, jnp.add)
+
+    def __radd__(self, o):
+        return self._binary(o, jnp.add, True)
+
+    def __sub__(self, o):
+        return self._binary(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._binary(o, jnp.subtract, True)
+
+    def __mul__(self, o):
+        return self._binary(o, jnp.multiply)
+
+    def __rmul__(self, o):
+        return self._binary(o, jnp.multiply, True)
+
+    def __truediv__(self, o):
+        return self._binary(o, jnp.true_divide)
+
+    def __rtruediv__(self, o):
+        return self._binary(o, jnp.true_divide, True)
+
+    def __floordiv__(self, o):
+        return self._binary(o, jnp.floor_divide)
+
+    def __rfloordiv__(self, o):
+        return self._binary(o, jnp.floor_divide, True)
+
+    def __mod__(self, o):
+        return self._binary(o, jnp.mod)
+
+    def __rmod__(self, o):
+        return self._binary(o, jnp.mod, True)
+
+    def __divmod__(self, o):
+        return self // o, self % o
+
+    def __pow__(self, o):
+        return self._binary(o, jnp.power)
+
+    def __rpow__(self, o):
+        return self._binary(o, jnp.power, True)
+
+    def __matmul__(self, o):
+        return self._binary(o, jnp.matmul)
+
+    def __rmatmul__(self, o):
+        return self._binary(o, jnp.matmul, True)
+
+    def __neg__(self):
+        return apply_op(jnp.negative, self)
+
+    def __pos__(self):
+        return self
+
+    def __abs__(self):
+        return apply_op(jnp.abs, self)
+
+    def __invert__(self):
+        return apply_op(jnp.invert, self)
+
+    def __and__(self, o):
+        return self._binary(o, jnp.bitwise_and)
+
+    def __or__(self, o):
+        return self._binary(o, jnp.bitwise_or)
+
+    def __xor__(self, o):
+        return self._binary(o, jnp.bitwise_xor)
+
+    def __rand__(self, o):
+        return self._binary(o, jnp.bitwise_and, True)
+
+    def __ror__(self, o):
+        return self._binary(o, jnp.bitwise_or, True)
+
+    def __rxor__(self, o):
+        return self._binary(o, jnp.bitwise_xor, True)
+
+    def __lshift__(self, o):
+        return self._binary(o, jnp.left_shift)
+
+    def __rshift__(self, o):
+        return self._binary(o, jnp.right_shift)
+
+    # comparisons
+    def __eq__(self, o):
+        return self._binary(o, jnp.equal)
+
+    def __ne__(self, o):
+        return self._binary(o, jnp.not_equal)
+
+    def __lt__(self, o):
+        return self._binary(o, jnp.less)
+
+    def __le__(self, o):
+        return self._binary(o, jnp.less_equal)
+
+    def __gt__(self, o):
+        return self._binary(o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._binary(o, jnp.greater_equal)
+
+    # in-place (real mutation, version-bump semantics)
+    def __iadd__(self, o):
+        self._set_data(self._data + _unwrap(o))
+        return self
+
+    def __isub__(self, o):
+        self._set_data(self._data - _unwrap(o))
+        return self
+
+    def __imul__(self, o):
+        self._set_data(self._data * _unwrap(o))
+        return self
+
+    def __itruediv__(self, o):
+        self._set_data(self._data / _unwrap(o))
+        return self
+
+    def __ifloordiv__(self, o):
+        self._set_data(self._data // _unwrap(o))
+        return self
+
+    def __imod__(self, o):
+        self._set_data(self._data % _unwrap(o))
+        return self
+
+    def __ipow__(self, o):
+        self._set_data(self._data ** _unwrap(o))
+        return self
+
+    # -- ndarray methods mirroring mx.np.ndarray --------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(int(s) for s in shape)
+        return apply_op(lambda x: jnp.reshape(x, shape), self)
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        axes = axes if axes else None
+        return apply_op(lambda x: jnp.transpose(x, axes), self)
+
+    def swapaxes(self, a, b):
+        return apply_op(lambda x: jnp.swapaxes(x, a, b), self)
+
+    def flatten(self):
+        return self.reshape(-1)
+
+    def ravel(self):
+        return self.reshape(-1)
+
+    def squeeze(self, axis=None):
+        return apply_op(lambda x: jnp.squeeze(x, axis), self)
+
+    def expand_dims(self, axis):
+        return apply_op(lambda x: jnp.expand_dims(x, axis), self)
+
+    def broadcast_to(self, shape):
+        return apply_op(lambda x: jnp.broadcast_to(x, shape), self)
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def repeat(self, repeats, axis=None):
+        return apply_op(lambda x: jnp.repeat(x, repeats, axis), self)
+
+    def tile(self, reps):
+        return apply_op(lambda x: jnp.tile(x, reps), self)
+
+    def take(self, indices, axis=None, mode="clip"):
+        idx = _unwrap(indices)
+        return apply_op(lambda x: jnp.take(x, idx, axis=axis, mode=mode), self)
+
+    def pick(self, index, axis=-1, keepdims=False, mode="clip"):
+        idx = _unwrap(index)
+        return apply_op(
+            lambda x: jnp.take_along_axis(
+                x, jnp.expand_dims(idx.astype(jnp.int32), axis), axis
+            ).squeeze(axis) if not keepdims else jnp.take_along_axis(
+                x, jnp.expand_dims(idx.astype(jnp.int32), axis), axis),
+            self)
+
+    def clip(self, a_min=None, a_max=None):
+        return apply_op(lambda x: jnp.clip(x, a_min, a_max), self)
+
+    def round(self, decimals=0):
+        return apply_op(lambda x: jnp.round(x, decimals), self)
+
+    def _reduce(self, fn, axis=None, dtype=None, keepdims=False):
+        def f(x):
+            r = fn(x, axis=axis, keepdims=keepdims)
+            return r.astype(dtype) if dtype is not None else r
+        return apply_op(f, self)
+
+    def sum(self, axis=None, dtype=None, keepdims=False, **kw):
+        return self._reduce(jnp.sum, axis, dtype, keepdims)
+
+    def mean(self, axis=None, dtype=None, keepdims=False, **kw):
+        return self._reduce(jnp.mean, axis, dtype, keepdims)
+
+    def prod(self, axis=None, dtype=None, keepdims=False, **kw):
+        return self._reduce(jnp.prod, axis, dtype, keepdims)
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.max, axis, None, keepdims)
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.min, axis, None, keepdims)
+
+    def std(self, axis=None, dtype=None, ddof=0, keepdims=False, **kw):
+        return apply_op(lambda x: jnp.std(x, axis=axis, ddof=ddof, keepdims=keepdims), self)
+
+    def var(self, axis=None, dtype=None, ddof=0, keepdims=False, **kw):
+        return apply_op(lambda x: jnp.var(x, axis=axis, ddof=ddof, keepdims=keepdims), self)
+
+    def argmax(self, axis=None, **kw):
+        return apply_op(lambda x: jnp.argmax(x, axis=axis), self)
+
+    def argmin(self, axis=None, **kw):
+        return apply_op(lambda x: jnp.argmin(x, axis=axis), self)
+
+    def argsort(self, axis=-1, is_ascend=True, **kw):
+        def f(x):
+            r = jnp.argsort(x, axis=axis)
+            return r if is_ascend else jnp.flip(r, axis=axis)
+        return apply_op(f, self)
+
+    def sort(self, axis=-1, **kw):
+        return apply_op(lambda x: jnp.sort(x, axis=axis), self)
+
+    def cumsum(self, axis=None, dtype=None):
+        return apply_op(lambda x: jnp.cumsum(x, axis=axis, dtype=dtype), self)
+
+    def dot(self, other):
+        return self._binary(other, jnp.dot)
+
+    def all(self, axis=None, keepdims=False):
+        return self._reduce(jnp.all, axis, None, keepdims)
+
+    def any(self, axis=None, keepdims=False):
+        return self._reduce(jnp.any, axis, None, keepdims)
+
+    def nonzero(self):
+        return apply_op(jnp.nonzero, self)
+
+    def abs(self):
+        return apply_op(jnp.abs, self)
+
+    def sqrt(self):
+        return apply_op(jnp.sqrt, self)
+
+    def square(self):
+        return apply_op(jnp.square, self)
+
+    def log(self):
+        return apply_op(jnp.log, self)
+
+    def exp(self):
+        return apply_op(jnp.exp, self)
+
+    def sigmoid(self):
+        return apply_op(jax.nn.sigmoid, self)
+
+    def tanh(self):
+        return apply_op(jnp.tanh, self)
+
+    def relu(self):
+        return apply_op(jax.nn.relu, self)
+
+    def slice_axis(self, axis, begin, end):
+        sl = [slice(None)] * self.ndim
+        sl[axis] = slice(begin, end)
+        return self[tuple(sl)]
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise NotImplementedError("sparse stypes arrive with mx.sparse")
+        return self
+
+
+NDArray = ndarray

@@ -1,0 +1,91 @@
+"""mx.np.linalg — linear algebra.
+
+Parity: reference `src/operator/numpy/linalg/` (cholesky/eig/svd/solve/...,
+hand-written LAPACK/cuSolver kernels) and `python/mxnet/numpy/linalg.py`.
+TPU-native: XLA's native decompositions via jax.numpy.linalg (cholesky, qr,
+triangular_solve lower to HLO; the rest are XLA custom calls on host like
+the reference's c_lapack_api.cc shim).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import apply_op, _unwrap
+
+
+def _wrap1(fn):
+    def f(a, *args, **kw):
+        return apply_op(lambda x: fn(x, *args, **kw), a)
+
+    f.__name__ = fn.__name__
+    return f
+
+
+norm_ = jnp.linalg.norm
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return apply_op(lambda v: jnp.linalg.norm(v, ord=ord, axis=axis, keepdims=keepdims), x)
+
+
+cholesky = _wrap1(jnp.linalg.cholesky)
+inv = _wrap1(jnp.linalg.inv)
+pinv = _wrap1(jnp.linalg.pinv)
+det = _wrap1(jnp.linalg.det)
+matrix_rank = _wrap1(jnp.linalg.matrix_rank)
+matrix_power = _wrap1(jnp.linalg.matrix_power)
+
+
+def slogdet(a):
+    return apply_op(lambda x: tuple(jnp.linalg.slogdet(x)), a)
+
+
+def svd(a):
+    """Returns (U, L, V) like the reference `_npi_svd` (V rows are right
+    singular vectors; reference layout ut, l, v)."""
+    return apply_op(lambda x: tuple(jnp.linalg.svd(x, full_matrices=False)), a)
+
+
+def qr(a, mode="reduced"):
+    return apply_op(lambda x: tuple(jnp.linalg.qr(x, mode=mode)), a)
+
+
+def eig(a):
+    return apply_op(lambda x: tuple(jnp.linalg.eig(x)), a)
+
+
+def eigh(a, UPLO="L"):
+    return apply_op(lambda x: tuple(jnp.linalg.eigh(x, UPLO=UPLO)), a)
+
+
+def eigvals(a):
+    return apply_op(jnp.linalg.eigvals, a)
+
+
+def eigvalsh(a, UPLO="L"):
+    return apply_op(lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO), a)
+
+
+def solve(a, b):
+    return apply_op(jnp.linalg.solve, a, b)
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond == "warn" else rcond
+    return apply_op(lambda x, y: tuple(jnp.linalg.lstsq(x, y, rcond=rc)), a, b)
+
+
+def tensorinv(a, ind=2):
+    return apply_op(lambda x: jnp.linalg.tensorinv(x, ind), a)
+
+
+def tensorsolve(a, b, axes=None):
+    return apply_op(lambda x, y: jnp.linalg.tensorsolve(x, y, axes), a, b)
+
+
+def multi_dot(arrays):
+    return apply_op(lambda *xs: jnp.linalg.multi_dot(xs), *arrays)
+
+
+def cond(x, p=None):
+    return apply_op(lambda v: jnp.linalg.cond(v, p), x)

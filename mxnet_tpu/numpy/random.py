@@ -1,0 +1,270 @@
+"""mx.np.random — NumPy-compatible samplers on the TPU PRNG.
+
+Parity: reference `python/mxnet/numpy/random.py` backed by
+`src/operator/random/` (sampler.h templates, curand Philox).  TPU-native:
+jax.random (threefry) with subkeys split from the global state in _rng.py.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .._rng import next_key, seed  # noqa: F401  (seed re-exported)
+from ..ndarray import ndarray, apply_op, _unwrap, _wrap_value
+
+
+def _size(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _sample(fn, *diff_args, **kw):
+    """Run sampler with a fresh subkey. diff_args participate in autograd
+    (reparameterized samplers are differentiable w.r.t. loc/scale)."""
+    key = next_key()
+    return apply_op(lambda *a: fn(key, *a, **kw), *diff_args)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    dtype = onp.dtype(dtype) if dtype is not None else onp.float32
+    shape = _size(size)
+
+    def fn(key, lo, hi):
+        lo = jnp.asarray(lo, dtype)
+        hi = jnp.asarray(hi, dtype)
+        s = shape if shape else jnp.broadcast_shapes(lo.shape, hi.shape)
+        return jax.random.uniform(key, s, dtype) * (hi - lo) + lo
+
+    res = _sample(fn, low, high)
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    dtype = onp.dtype(dtype) if dtype is not None else onp.float32
+    shape = _size(size)
+
+    def fn(key, mu, sigma):
+        mu = jnp.asarray(mu, dtype)
+        sigma = jnp.asarray(sigma, dtype)
+        s = shape if shape else jnp.broadcast_shapes(mu.shape, sigma.shape)
+        return jax.random.normal(key, s, dtype) * sigma + mu
+
+    res = _sample(fn, loc, scale)
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def randn(*size, **kwargs):
+    return normal(0.0, 1.0, size=size or None, **kwargs)
+
+
+def rand(*size, **kwargs):
+    return uniform(0.0, 1.0, size=size or None, **kwargs)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, device=None, out=None):
+    if high is None:
+        low, high = 0, low
+    dtype = onp.dtype(dtype) if dtype is not None else onp.int32
+    key = next_key()
+    res = _wrap_value(jax.random.randint(key, _size(size), int(low), int(high), dtype))
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None, out=None):
+    key = next_key()
+    aval = _unwrap(a) if isinstance(a, ndarray) else a
+    if isinstance(aval, int):
+        aval = jnp.arange(aval)
+    res = _wrap_value(jax.random.choice(key, aval, _size(size), replace, _unwrap(p)))
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (parity: mx.np.random.shuffle)."""
+    key = next_key()
+    x._set_data(jax.random.permutation(key, x._data, axis=0))
+
+
+def permutation(x, **kw):
+    key = next_key()
+    if isinstance(x, int):
+        return _wrap_value(jax.random.permutation(key, x))
+    return apply_op(lambda v: jax.random.permutation(key, v, axis=0), x)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None, device=None):
+    dtype = onp.dtype(dtype) if dtype is not None else onp.float32
+
+    def fn(key, av, bv):
+        s = _size(size) or jnp.broadcast_shapes(jnp.shape(av), jnp.shape(bv))
+        return jax.random.beta(key, av, bv, s, dtype)
+
+    return _sample(fn, a, b)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    dtype = onp.dtype(dtype) if dtype is not None else onp.float32
+
+    def fn(key, k, theta):
+        s = _size(size) or jnp.broadcast_shapes(jnp.shape(k), jnp.shape(theta))
+        return jax.random.gamma(key, jnp.asarray(k, dtype), s, dtype) * theta
+
+    return _sample(fn, shape, scale)
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    def fn(key, sc):
+        s = _size(size) or jnp.shape(sc)
+        return jax.random.exponential(key, s) * sc
+
+    return _sample(fn, scale)
+
+
+def poisson(lam=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    key = next_key()
+    s = _size(size) or jnp.shape(_unwrap(lam))
+    return _wrap_value(jax.random.poisson(key, _unwrap(lam), s))
+
+
+def multinomial(n, pvals, size=None):
+    key = next_key()
+    p = _unwrap(pvals)
+    s = _size(size)
+    counts = jax.random.multinomial(key, n, jnp.asarray(p), shape=s + jnp.shape(p) if s else None)
+    return _wrap_value(counts.astype(jnp.int32))
+
+
+def categorical(logits, shape=None):
+    key = next_key()
+    return apply_op(lambda l: jax.random.categorical(key, l, shape=_size(shape) or None), logits)
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
+    def fn(key, m, c):
+        return jax.random.multivariate_normal(key, m, c, _size(size) or None)
+
+    return _sample(fn, mean, cov)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None, device=None):
+    n = normal(mean, sigma, size=size, dtype=dtype)
+    return apply_op(jnp.exp, n)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, device=None, out=None):
+    def fn(key, mu, s):
+        shp = _size(size) or jnp.broadcast_shapes(jnp.shape(mu), jnp.shape(s))
+        return jax.random.logistic(key, shp) * s + mu
+
+    return _sample(fn, loc, scale)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, device=None, out=None):
+    def fn(key, mu, s):
+        shp = _size(size) or jnp.broadcast_shapes(jnp.shape(mu), jnp.shape(s))
+        return jax.random.gumbel(key, shp) * s + mu
+
+    return _sample(fn, loc, scale)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    def fn(key, mu, s):
+        shp = _size(size) or jnp.broadcast_shapes(jnp.shape(mu), jnp.shape(s))
+        return jax.random.laplace(key, shp) * s + mu
+
+    return _sample(fn, loc, scale)
+
+
+def rayleigh(scale=1.0, size=None, ctx=None, device=None, out=None):
+    def fn(key, s):
+        shp = _size(size) or jnp.shape(s)
+        u = jax.random.uniform(key, shp, minval=1e-7)
+        return s * jnp.sqrt(-2.0 * jnp.log(u))
+
+    return _sample(fn, scale)
+
+
+def weibull(a, size=None, ctx=None, device=None, out=None):
+    def fn(key, av):
+        shp = _size(size) or jnp.shape(av)
+        u = jax.random.uniform(key, shp, minval=1e-7)
+        return jnp.power(-jnp.log(u), 1.0 / av)
+
+    return _sample(fn, a)
+
+
+def pareto(a, size=None, ctx=None, device=None, out=None):
+    def fn(key, av):
+        shp = _size(size) or jnp.shape(av)
+        return jax.random.pareto(key, jnp.asarray(av, jnp.float32), shp)
+
+    return _sample(fn, a)
+
+
+def power(a, size=None, ctx=None, device=None, out=None):
+    def fn(key, av):
+        shp = _size(size) or jnp.shape(av)
+        u = jax.random.uniform(key, shp, minval=1e-7)
+        return jnp.power(u, 1.0 / av)
+
+    return _sample(fn, a)
+
+
+def chisquare(df, size=None, dtype=None, ctx=None, device=None):
+    return gamma(_unwrap(df) / 2.0, 2.0, size=size, dtype=dtype)
+
+
+def f(dfnum, dfden, size=None, ctx=None, device=None):
+    x1 = chisquare(dfnum, size=size)
+    x2 = chisquare(dfden, size=size)
+    return (x1 / dfnum) / (x2 / dfden)
+
+
+def binomial(n, p, size=None, dtype=None, ctx=None, device=None):
+    key = next_key()
+    s = _size(size) or jnp.broadcast_shapes(jnp.shape(_unwrap(n)), jnp.shape(_unwrap(p)))
+    return _wrap_value(jax.random.binomial(key, _unwrap(n), _unwrap(p), shape=s))
+
+
+def negative_binomial(n, p, size=None, dtype=None, ctx=None, device=None):
+    lam = gamma(n, (1.0 - _unwrap(p)) / _unwrap(p), size=size)
+    return poisson(lam)
+
+
+def geometric(p, size=None, ctx=None, device=None):
+    key = next_key()
+    s = _size(size) or jnp.shape(_unwrap(p))
+    return _wrap_value(jax.random.geometric(key, _unwrap(p), shape=s))
+
+
+def dirichlet(alpha, size=None, ctx=None, device=None):
+    key = next_key()
+    return _wrap_value(jax.random.dirichlet(key, _unwrap(alpha), _size(size) or None))
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None, device=None):
+    key = next_key()
+    if prob is None:
+        prob = jax.nn.sigmoid(_unwrap(logit))
+    else:
+        prob = _unwrap(prob)
+    s = _size(size) or jnp.shape(prob)
+    out = jax.random.bernoulli(key, prob, s)
+    return _wrap_value(out.astype(onp.dtype(dtype)) if dtype else out.astype(jnp.float32))

@@ -1,0 +1,520 @@
+"""mx.npx — NumPy-extension ops (the NN ops Gluon layers call).
+
+Parity: reference `python/mxnet/ndarray/numpy_extension/_op.py` (__all__ :27:
+softmax/masked_softmax, activation, batch_norm :243, fully_connected :347,
+convolution :482, pooling, dropout, rnn :890, embedding :1045, topk :1134,
+pick, one_hot, arange_like, sequence ops) backed by `src/operator/nn/`.
+
+TPU-native: thin autograd-recording wrappers (apply_op) over the pure-JAX
+kernels in ops/nn.py — each eager call is a cached per-shape XLA executable;
+under hybridize() the same code traces into the whole-graph program.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .._rng import next_key
+from ..ndarray import ndarray, apply_op, array, _unwrap, _wrap_value, waitall  # noqa: F401
+from ..ops import nn as _nn
+from ..ops import rnn as _rnn
+from ..ops import attention as _att
+from ..util import set_np, reset_np, is_np_array, is_np_shape  # noqa: F401
+
+__all__ = [
+    "activation", "relu", "sigmoid", "leaky_relu", "gelu", "softmax",
+    "log_softmax", "masked_softmax", "masked_log_softmax", "fully_connected",
+    "convolution", "deconvolution", "pooling", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "l2_normalization", "lrn", "dropout",
+    "embedding", "one_hot", "topk", "pick", "gather_nd", "scatter_nd",
+    "sequence_mask", "sequence_last", "sequence_reverse", "rnn", "ctc_loss",
+    "batch_dot", "arange_like", "reshape_like", "broadcast_like",
+    "smooth_l1", "multibox_prior", "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt", "flash_attention", "save", "load",
+    "savez", "set_np", "reset_np", "waitall", "all_finite",
+]
+
+
+# -- activations ------------------------------------------------------------
+def activation(data, act_type="relu", **kw):
+    return apply_op(lambda x: _nn.activation(x, act_type), data)
+
+
+def relu(data, **kw):
+    return apply_op(jax.nn.relu, data)
+
+
+def sigmoid(data, **kw):
+    return apply_op(jax.nn.sigmoid, data)
+
+
+def gelu(data, approximate=False, **kw):
+    return apply_op(lambda x: jax.nn.gelu(x, approximate=approximate), data)
+
+
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, **kw):
+    """LeakyReLU family (src/operator/leaky_relu.cc): leaky/prelu/elu/selu/
+    gelu/rrelu."""
+    if act_type == "leaky":
+        return apply_op(lambda x: _nn.leaky_relu(x, slope), data)
+    if act_type == "prelu":
+        return apply_op(_nn.prelu, data, gamma)
+    if act_type == "elu":
+        return apply_op(lambda x: _nn.elu(x, slope), data)
+    if act_type == "selu":
+        return apply_op(_nn.selu, data)
+    if act_type == "gelu":
+        return apply_op(lambda x: jax.nn.gelu(x, approximate=False), data)
+    if act_type == "rrelu":
+        if autograd.is_training():
+            key = next_key()
+            lo, hi = lower_bound, upper_bound
+
+            def f(x):
+                a = jax.random.uniform(key, x.shape, jnp.float32, lo, hi)
+                return jnp.where(x >= 0, x, a.astype(x.dtype) * x)
+
+            return apply_op(f, data)
+        s = (lower_bound + upper_bound) / 2
+        return apply_op(lambda x: _nn.leaky_relu(x, s), data)
+    raise ValueError(act_type)
+
+
+# -- softmax family ---------------------------------------------------------
+def softmax(data, length=None, axis=-1, temperature=None, use_length=False,
+            dtype=None, **kw):
+    if use_length and length is not None:
+        return apply_op(
+            lambda x, l: _nn.softmax(x, axis=axis, temperature=temperature,
+                                     length=l, use_length=True), data, length)
+    return apply_op(lambda x: _nn.softmax(x, axis=axis, temperature=temperature), data)
+
+
+def log_softmax(data, axis=-1, temperature=None, dtype=None, **kw):
+    return apply_op(lambda x: _nn.log_softmax(x, axis=axis, temperature=temperature), data)
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0, **kw):
+    return apply_op(lambda x, m: _nn.masked_softmax(x, m.astype(bool), axis, temperature),
+                    data, mask)
+
+
+def masked_log_softmax(data, mask, axis=-1, temperature=1.0, **kw):
+    return apply_op(lambda x, m: _nn.masked_log_softmax(x, m.astype(bool), axis, temperature),
+                    data, mask)
+
+
+def softmin(data, axis=-1, **kw):
+    return apply_op(lambda x: _nn.softmin(x, axis=axis), data)
+
+
+# -- dense / conv / pool ----------------------------------------------------
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kw):
+    if bias is None or no_bias:
+        return apply_op(lambda a, w: _nn.fully_connected(a, w, None, no_bias=True,
+                                                         flatten=flatten), x, weight)
+    return apply_op(lambda a, w, b: _nn.fully_connected(a, w, b, flatten=flatten),
+                    x, weight, bias)
+
+
+def convolution(data=None, weight=None, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=None, num_group=1,
+                no_bias=False, layout=None, **kw):
+    args = dict(kernel=kernel, stride=stride, dilate=dilate, pad=pad,
+                num_filter=num_filter, num_group=num_group, layout=layout)
+    if bias is None or no_bias:
+        return apply_op(lambda x, w: _nn.convolution(x, w, None, no_bias=True, **args),
+                        data, weight)
+    return apply_op(lambda x, w, b: _nn.convolution(x, w, b, **args),
+                    data, weight, bias)
+
+
+def deconvolution(data=None, weight=None, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=False, layout=None, target_shape=None, **kw):
+    args = dict(kernel=kernel, stride=stride, dilate=dilate, pad=pad, adj=adj,
+                num_filter=num_filter, num_group=num_group, layout=layout,
+                target_shape=target_shape)
+    if bias is None or no_bias:
+        return apply_op(lambda x, w: _nn.deconvolution(x, w, None, no_bias=True, **args),
+                        data, weight)
+    return apply_op(lambda x, w, b: _nn.deconvolution(x, w, b, **args),
+                    data, weight, bias)
+
+
+def pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
+            global_pool=False, pooling_convention="valid",
+            count_include_pad=True, layout=None, **kw):
+    return apply_op(
+        lambda x: _nn.pooling(x, kernel=kernel, pool_type=pool_type,
+                              stride=stride, pad=pad, global_pool=global_pool,
+                              pooling_convention=pooling_convention,
+                              count_include_pad=count_include_pad,
+                              layout=layout), data)
+
+
+# -- normalization ----------------------------------------------------------
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, **kw):
+    """BatchNorm with reference semantics: training mode (autograd
+    train-mode scope) uses batch stats and updates running stats in place;
+    inference uses running stats.  The in-place aux update is the one
+    side-effecting op in the framework (like the reference's mutable aux
+    states); HybridBlock tracing captures it as an extra graph output."""
+    training = autograd.is_training() and not use_global_stats
+    if training:
+        # one kernel returning (out, new_mean, new_var); the aux outputs
+        # ride the tape with zero cotangents and are written back detached
+        out, nm, nv = apply_op(
+            lambda xx, g, b: _nn.batch_norm_train(
+                xx, g, b, _unwrap(running_mean), _unwrap(running_var),
+                momentum=momentum, eps=eps, axis=axis, fix_gamma=fix_gamma),
+            x, gamma, beta)
+        running_mean._set_data(nm.detach()._data)
+        running_var._set_data(nv.detach()._data)
+        return out
+    return apply_op(
+        lambda xx, g, b: _nn.batch_norm_inference(
+            xx, g, b, _unwrap(running_mean), _unwrap(running_var),
+            eps=eps, axis=axis, fix_gamma=fix_gamma), x, gamma, beta)
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **kw):
+    return apply_op(lambda x, g, b: _nn.layer_norm(x, g, b, axis, eps),
+                    data, gamma, beta)
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **kw):
+    return apply_op(lambda x, g, b: _nn.group_norm(x, g, b, num_groups, eps),
+                    data, gamma, beta)
+
+
+def instance_norm(data, gamma, beta, eps=1e-5, **kw):
+    return apply_op(lambda x, g, b: _nn.instance_norm(x, g, b, eps),
+                    data, gamma, beta)
+
+
+def l2_normalization(data, eps=1e-10, mode="instance", **kw):
+    return apply_op(lambda x: _nn.l2_normalization(x, eps, mode), data)
+
+
+def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0, **kw):
+    return apply_op(lambda x: _nn.lrn(x, nsize, alpha, beta, knorm), data)
+
+
+# -- dropout ----------------------------------------------------------------
+def dropout(data, p=0.5, mode="training", axes=None, cudnn_off=False, **kw):
+    if not autograd.is_training() and mode != "always":
+        return data
+    if p <= 0:
+        return data
+    key = next_key()
+    return apply_op(lambda x: _nn.dropout(x, key, p=p, axes=axes), data)
+
+
+# -- indexing / embedding ---------------------------------------------------
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False, **kw):
+    return apply_op(lambda d, w: _nn.embedding(d, w), data, weight)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    return apply_op(lambda d: _nn.one_hot(d, depth, on_value, off_value, dtype), data)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32", **kw):
+    res = apply_op(lambda x: _nn.topk(x, axis, k, ret_typ, is_ascend, dtype), data)
+    return res
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip", **kw):
+    return apply_op(lambda d, i: _nn.pick(d, i, axis, keepdims, mode), data, index)
+
+
+def gather_nd(data, indices, **kw):
+    return apply_op(lambda d, i: _nn.gather_nd(d, i), data, indices)
+
+
+def scatter_nd(data, indices, shape, **kw):
+    return apply_op(lambda d, i: _nn.scatter_nd(d, i, shape), data, indices)
+
+
+# -- sequence ops -----------------------------------------------------------
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0, **kw):
+    if sequence_length is None:
+        return apply_op(lambda d: _nn.sequence_mask(d, None, False, value, axis), data)
+    return apply_op(lambda d, l: _nn.sequence_mask(d, l, use_sequence_length, value, axis),
+                    data, sequence_length)
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0, **kw):
+    if sequence_length is None:
+        return apply_op(lambda d: _nn.sequence_last(d, None, False, axis), data)
+    return apply_op(lambda d, l: _nn.sequence_last(d, l, use_sequence_length, axis),
+                    data, sequence_length)
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0, **kw):
+    if sequence_length is None:
+        return apply_op(lambda d: _nn.sequence_reverse(d, None, False, axis), data)
+    return apply_op(lambda d, l: _nn.sequence_reverse(d, l, use_sequence_length, axis),
+                    data, sequence_length)
+
+
+# -- fused RNN --------------------------------------------------------------
+def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=True, use_sequence_length=False, sequence_length=None,
+        **kw):
+    """Fused stacked RNN (parity: npx.rnn → src/operator/rnn.cc).
+
+    data: (T, B, I); parameters: flat vector; state: (L*D, B, H)."""
+    dropout_key = next_key() if (p > 0 and autograd.is_training()) else None
+
+    if mode == "lstm":
+        def f(x, params, h0, c0):
+            out, hT, cT = _rnn.rnn_forward(
+                x, params, h0, c0, mode, state_size, num_layers,
+                bidirectional, p if autograd.is_training() else 0.0, dropout_key)
+            return out, hT, cT
+
+        out, hT, cT = apply_op(f, data, parameters, state, state_cell)
+        return (out, hT, cT) if state_outputs else out
+
+    def f(x, params, h0):
+        out, hT, _ = _rnn.rnn_forward(
+            x, params, h0, None, mode, state_size, num_layers,
+            bidirectional, p if autograd.is_training() else 0.0, dropout_key)
+        return out, hT
+
+    out, hT = apply_op(f, data, parameters, state)
+    return (out, hT) if state_outputs else out
+
+
+# -- attention --------------------------------------------------------------
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads, **kw):
+    return apply_op(lambda x: _att.interleaved_matmul_selfatt_qk(x, heads),
+                    queries_keys_values)
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads, **kw):
+    return apply_op(lambda x, a: _att.interleaved_matmul_selfatt_valatt(x, a, heads),
+                    queries_keys_values, attention)
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads, **kw):
+    return apply_op(lambda q, kv: _att.interleaved_matmul_encdec_qk(q, kv, heads),
+                    queries, keys_values)
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads, **kw):
+    return apply_op(lambda kv, a: _att.interleaved_matmul_encdec_valatt(kv, a, heads),
+                    keys_values, attention)
+
+
+def flash_attention(q, k, v, causal=False, window=None, scale=None, **kw):
+    """TPU-native fused attention: q,k,v (B, H, L, D) → (B, H, L, D).
+
+    O(L) memory via the Pallas kernel (ops/pallas/flash_attention.py);
+    this supersedes the reference's interleaved_matmul_* + softmax chain."""
+    return apply_op(lambda a, b, c: _att.flash_attention(a, b, c, causal=causal,
+                                                         window=window, scale=scale),
+                    q, k, v)
+
+
+def sldwin_atten(q, k, v, window, symmetric=True, **kw):
+    return apply_op(lambda a, b, c: _att.sldwin_atten(a, b, c, window, symmetric),
+                    q, k, v)
+
+
+# -- misc tensor helpers ----------------------------------------------------
+def batch_dot(a, b, transpose_a=False, transpose_b=False, **kw):
+    def f(x, y):
+        if transpose_a:
+            x = jnp.swapaxes(x, -1, -2)
+        if transpose_b:
+            y = jnp.swapaxes(y, -1, -2)
+        return jnp.matmul(x, y)
+
+    return apply_op(f, a, b)
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **kw):
+    def f(x):
+        if axis is None:
+            n = x.size
+            out = start + step * jnp.arange(n, dtype=jnp.float32)
+            return out.reshape(x.shape)
+        n = x.shape[axis]
+        return start + step * jnp.arange(n, dtype=jnp.float32)
+
+    return apply_op(f, data)
+
+
+def reshape_like(lhs, rhs, **kw):
+    return apply_op(lambda a, b: jnp.reshape(a, b.shape), lhs, rhs)
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None, **kw):
+    return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), lhs, rhs)
+
+
+def smooth_l1(data, scalar=1.0, **kw):
+    def f(x):
+        s2 = scalar * scalar
+        return jnp.where(jnp.abs(x) < 1.0 / s2,
+                         0.5 * s2 * jnp.square(x),
+                         jnp.abs(x) - 0.5 / s2)
+
+    return apply_op(f, data)
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first", **kw):
+    blank = 0 if blank_label == "first" else data.shape[-1] - 1
+    arrays = [data, label]
+    if use_data_lengths and data_lengths is not None:
+        arrays.append(data_lengths)
+    if use_label_lengths and label_lengths is not None:
+        arrays.append(label_lengths)
+
+    def f(d, l, *rest):
+        i = 0
+        dl = rest[i] if use_data_lengths and data_lengths is not None else None
+        if dl is not None:
+            i += 1
+        ll = rest[i] if use_label_lengths and label_lengths is not None else None
+        return _nn.ctc_loss(d, l, dl, ll, blank)
+
+    return apply_op(f, *arrays)
+
+
+def all_finite(*arrays):
+    return apply_op(lambda *xs: _nn.all_finite(xs), *arrays)
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=None,
+                   offsets=(0.5, 0.5), **kw):
+    """SSD anchor generation (src/operator/contrib/multibox_prior.cc)."""
+    import numpy as np
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps else 1.0 / h
+    step_x = steps[1] if steps else 1.0 / w
+    anchors = []
+    for i in range(h):
+        cy = (i + offsets[0]) * step_y
+        for j in range(w):
+            cx = (j + offsets[1]) * step_x
+            for s in sizes:
+                anchors.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
+            for r in ratios[1:]:
+                s = sizes[0]
+                sr = np.sqrt(r)
+                anchors.append([cx - s * sr / 2, cy - s / sr / 2,
+                                cx + s * sr / 2, cy + s / sr / 2])
+    a = np.asarray(anchors, np.float32)
+    if clip:
+        a = np.clip(a, 0, 1)
+    return array(a[None])
+
+
+# -- serialization (parity: npx.save/savez/load → src/serialization/cnpy) ---
+def savez(file, *args, **kwargs):
+    arrays = {("arr_%d" % i): a.asnumpy() for i, a in enumerate(args)}
+    arrays.update({k: v.asnumpy() for k, v in kwargs.items()})
+    onp.savez(file, **arrays)
+
+
+def save(file, arr):
+    if isinstance(arr, dict):
+        savez(file, **arr)
+    elif isinstance(arr, (list, tuple)):
+        savez(file, *arr)
+    else:
+        savez(file, arr)
+
+
+def load(file):
+    with onp.load(file, allow_pickle=False) as data:
+        return {k: array(v) for k, v in data.items()}
+
+
+def gamma(data, **kw):
+    return apply_op(lambda x: jnp.exp(jax.scipy.special.gammaln(x)), data)
+
+
+def erf(data, **kw):
+    return apply_op(jax.scipy.special.erf, data)
+
+
+def erfinv(data, **kw):
+    return apply_op(jax.scipy.special.erfinv, data)
+
+
+def index_add(data, indices, value, **kw):
+    return apply_op(lambda d, v: d.at[tuple(_unwrap(indices).astype(jnp.int32))].add(v),
+                    data, value)
+
+
+def index_update(data, indices, value, **kw):
+    return apply_op(lambda d, v: d.at[tuple(_unwrap(indices).astype(jnp.int32))].set(v),
+                    data, value)
+
+
+def foreach(body, data, init_states):
+    """Control-flow: npx.foreach (python/mxnet/ndarray/contrib.py:139).
+    Eagerly loops in Python; under hybridize the trace unrolls via lax.scan
+    in gluon.contrib layers."""
+    states = init_states if isinstance(init_states, (list, tuple)) else [init_states]
+    outputs = []
+    seq = data if isinstance(data, (list, tuple)) else [data[i] for i in range(len(data))]
+    for x in seq:
+        out, states = body(x, states)
+        outputs.append(out)
+    from ..numpy import stack
+    if isinstance(outputs[0], (list, tuple)):
+        outs = tuple(stack([o[i] for o in outputs]) for i in range(len(outputs[0])))
+    else:
+        outs = stack(outputs)
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """npx.while_loop (contrib.py:233) — eager Python loop."""
+    steps = 0
+    outputs = []
+    vars_ = list(loop_vars)
+    while bool(cond(*vars_)) and (max_iterations is None or steps < max_iterations):
+        out, vars_ = func(*vars_)
+        outputs.append(out)
+        steps += 1
+    from ..numpy import stack
+    if outputs:
+        if isinstance(outputs[0], (list, tuple)):
+            outs = tuple(stack([o[i] for o in outputs]) for i in range(len(outputs[0])))
+        else:
+            outs = stack(outputs)
+    else:
+        outs = None
+    return outs, vars_
+
+
+def cond(pred, then_func, else_func):
+    """npx.cond (contrib.py:401)."""
+    return then_func() if bool(pred) else else_func()
+
+
+def seed(seed_state):
+    from .._rng import seed as _seed
+    _seed(seed_state)

@@ -1,0 +1,11 @@
+"""Pure-JAX op kernels shared by npx / gluon layers.
+
+This package is the TPU analog of the reference's `src/operator/` kernel
+library: functions here take/return raw jax.Arrays (no ndarray wrappers) so
+they can be called eagerly (per-op XLA executables, cached by shape/dtype)
+or inside a hybridize()/jit trace (fused whole-graph executable).
+"""
+from . import nn  # noqa: F401
+from . import attention  # noqa: F401
+from . import rnn  # noqa: F401
+from . import optimizer_ops  # noqa: F401

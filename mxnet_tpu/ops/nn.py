@@ -1,0 +1,584 @@
+"""Neural-net kernels in pure JAX/XLA.
+
+Parity: reference `src/operator/nn/` (~33k LoC of CPU/CUDA/oneDNN kernels:
+convolution.cc, fully_connected.cc, batch_norm.cc, layer_norm.cc, pooling.cc,
+softmax.cc, dropout.cc, activation.cc).  TPU-native: each op is a small
+composition of lax primitives; XLA lowers conv/matmul onto the MXU and fuses
+the elementwise epilogues (bias/activation/normalization) into the same
+kernel, which replaces the reference's hand-fused variants and the
+pointwise-fusion RTC pass.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# --------------------------------------------------------------------------
+# activations (src/operator/nn/activation.cc, leaky_relu.cc)
+# --------------------------------------------------------------------------
+def activation(x, act_type):
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "log_sigmoid":
+        return jax.nn.log_sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    if act_type == "mish":
+        return x * jnp.tanh(jax.nn.softplus(x))
+    if act_type in ("swish", "silu"):
+        return jax.nn.silu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError("unknown act_type %r" % (act_type,))
+
+
+def leaky_relu(x, slope=0.25):
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def prelu(x, alpha):
+    # alpha broadcast over channel axis 1 (reference leaky_relu.cc PReLU)
+    shape = [1] * x.ndim
+    if alpha.ndim == 1 and x.ndim > 1:
+        shape[1] = alpha.shape[0]
+        alpha = alpha.reshape(shape)
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def elu(x, alpha=1.0):
+    return jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+# --------------------------------------------------------------------------
+# softmax family (src/operator/nn/softmax.cc, masked_softmax,
+# MXNET_SAFE_ACCUMULATION → accumulate in fp32)
+# --------------------------------------------------------------------------
+def softmax(x, axis=-1, temperature=None, length=None, use_length=False):
+    dt = x.dtype
+    xf = x.astype(jnp.float32) if dt in (jnp.float16, jnp.bfloat16) else x
+    if temperature is not None and temperature != 1.0:
+        xf = xf / temperature
+    if use_length and length is not None:
+        mask = _length_mask(xf.shape, axis, length)
+        xf = jnp.where(mask, xf, -jnp.inf)
+        out = jax.nn.softmax(xf, axis=axis)
+        out = jnp.where(mask, out, 0.0)
+    else:
+        out = jax.nn.softmax(xf, axis=axis)
+    return out.astype(dt)
+
+
+def log_softmax(x, axis=-1, temperature=None):
+    dt = x.dtype
+    xf = x.astype(jnp.float32) if dt in (jnp.float16, jnp.bfloat16) else x
+    if temperature is not None and temperature != 1.0:
+        xf = xf / temperature
+    return jax.nn.log_softmax(xf, axis=axis).astype(dt)
+
+
+def masked_softmax(x, mask, axis=-1, temperature=1.0):
+    dt = x.dtype
+    xf = x.astype(jnp.float32) if dt in (jnp.float16, jnp.bfloat16) else x
+    if temperature != 1.0:
+        xf = xf / temperature
+    neg = jnp.finfo(xf.dtype).min
+    xf = jnp.where(mask, xf, neg)
+    out = jax.nn.softmax(xf, axis=axis)
+    out = jnp.where(mask, out, 0.0)
+    return out.astype(dt)
+
+
+def masked_log_softmax(x, mask, axis=-1, temperature=1.0):
+    dt = x.dtype
+    xf = x.astype(jnp.float32) if dt in (jnp.float16, jnp.bfloat16) else x
+    if temperature != 1.0:
+        xf = xf / temperature
+    neg = jnp.finfo(xf.dtype).min
+    xf = jnp.where(mask, xf, neg)
+    out = jax.nn.log_softmax(xf, axis=axis)
+    out = jnp.where(mask, out, -jnp.inf)
+    return out.astype(dt)
+
+
+def softmin(x, axis=-1):
+    return softmax(-x, axis=axis)
+
+
+def _length_mask(shape, axis, length):
+    axis = axis % len(shape)
+    L = shape[axis]
+    idx = lax.broadcasted_iota(jnp.int32, shape, axis)
+    # length has shape = shape without `axis` (typically (batch,))
+    l = length
+    for d in range(1, len(shape)):
+        if d != axis and l.ndim < len(shape):
+            l = jnp.expand_dims(l, d if d < axis else d)
+    while l.ndim < len(shape):
+        l = jnp.expand_dims(l, -1)
+    return idx < l.astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# fully connected (src/operator/nn/fully_connected.cc) — straight to MXU
+# --------------------------------------------------------------------------
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    if flatten:
+        x2 = x.reshape((x.shape[0], -1))
+    else:
+        x2 = x
+    # weight layout (num_hidden, in_units), matching the reference
+    y = jnp.matmul(x2, weight.T)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+# --------------------------------------------------------------------------
+# convolution (src/operator/nn/convolution.cc) via conv_general_dilated
+# --------------------------------------------------------------------------
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _conv_dn(ndim, layout):
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    spatial = layout[2:] if layout.startswith("NC") else layout[1:-1]
+    if layout.startswith("NC"):
+        lhs = layout
+        rhs = "OI" + spatial
+        out = layout
+    else:  # channels-last NWC/NHWC/NDHWC
+        lhs = layout
+        rhs = "OI" + spatial
+        out = layout
+    return lax.conv_dimension_numbers((1,) * (ndim + 2), (1,) * (ndim + 2),
+                                      (lhs, rhs, out)), layout
+
+
+def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None):
+    ndim = x.ndim - 2
+    stride = _tup(stride or 1, ndim)
+    dilate = _tup(dilate or 1, ndim)
+    pad = _tup(pad or 0, ndim)
+    dn, layout = _conv_dn(ndim, layout)
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if bias is not None and not no_bias:
+        bshape = [1] * out.ndim
+        bshape[1 if layout.startswith("NC") else -1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1,
+                  no_bias=False, layout=None, target_shape=None):
+    """Transposed convolution (src/operator/nn/deconvolution.cc) as the
+    gradient of convolution: lax.conv_transpose with IO weight layout."""
+    ndim = x.ndim - 2
+    stride = _tup(stride or 1, ndim)
+    dilate = _tup(dilate or 1, ndim)
+    pad = _tup(pad or 0, ndim)
+    adj = _tup(adj or 0, ndim)
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    spatial = layout[2:]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape, (layout, "IO" + spatial, layout))
+    # MXNet output size: out = (in-1)*s - 2p + dilate*(k-1) + adj + 1.
+    # Express as conv_transpose with per-dim (lo, hi) padding.
+    k = weight.shape[2:]
+    pads = []
+    for i in range(ndim):
+        eff_k = dilate[i] * (k[i] - 1) + 1
+        lo = eff_k - 1 - pad[i]
+        hi = eff_k - 1 - pad[i] + adj[i]
+        pads.append((lo, hi))
+    out = lax.conv_transpose(
+        x, weight, strides=stride,
+        padding=pads,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        transpose_kernel=True,
+    )
+    if bias is not None and not no_bias:
+        bshape = [1] * out.ndim
+        bshape[1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+# --------------------------------------------------------------------------
+# pooling (src/operator/nn/pooling.cc) via reduce_window
+# --------------------------------------------------------------------------
+def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
+            global_pool=False, pooling_convention="valid", count_include_pad=True,
+            layout=None):
+    ndim = x.ndim - 2
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    channels_first = layout.startswith("NC")
+    sp_axes = tuple(range(2, 2 + ndim)) if channels_first else tuple(range(1, 1 + ndim))
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(x, axis=sp_axes, keepdims=True)
+        if pool_type == "avg":
+            return jnp.mean(x, axis=sp_axes, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(x, axis=sp_axes, keepdims=True)
+        if pool_type == "lp":
+            return jnp.linalg.norm(x, ord=2, axis=sp_axes, keepdims=True)
+        raise ValueError(pool_type)
+
+    kernel = _tup(kernel, ndim)
+    stride = _tup(stride or kernel, ndim)
+    pad = _tup(pad or 0, ndim)
+
+    window = [1] * x.ndim
+    strides = [1] * x.ndim
+    pads = [(0, 0)] * x.ndim
+    for i, ax in enumerate(sp_axes):
+        window[ax] = kernel[i]
+        strides[ax] = stride[i]
+        lo = hi = pad[i]
+        if pooling_convention == "full":
+            # ceil division output: add extra high padding
+            size = x.shape[ax] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            if rem != 0:
+                hi += stride[i] - rem
+        pads[ax] = (lo, hi)
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0,
+                              lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = onp.prod(kernel)
+            return s / denom
+        ones = jnp.ones(x.shape, x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(x * x, 0.0, lax.add, window, strides, pads)
+        return jnp.sqrt(s)
+    raise ValueError("unknown pool_type %r" % (pool_type,))
+
+
+def adaptive_avg_pool2d(x, output_size):
+    """contrib AdaptiveAvgPooling2D (src/operator/contrib/adaptive_avg_pooling.cc)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    # integer bucketing identical to the reference kernel
+    out = jnp.zeros((n, c, oh, ow), x.dtype)
+    xs = jnp.asarray(x)
+    rows = [(int(onp.floor(i * h / oh)), int(onp.ceil((i + 1) * h / oh))) for i in range(oh)]
+    cols = [(int(onp.floor(j * w / ow)), int(onp.ceil((j + 1) * w / ow))) for j in range(ow)]
+    chunks = []
+    for r0, r1 in rows:
+        row = []
+        for c0, c1 in cols:
+            row.append(jnp.mean(xs[:, :, r0:r1, c0:c1], axis=(2, 3)))
+        chunks.append(jnp.stack(row, axis=-1))
+    return jnp.stack(chunks, axis=-2)
+
+
+# --------------------------------------------------------------------------
+# normalization (src/operator/nn/batch_norm.cc, layer_norm.cc, group_norm.cc,
+# instance_norm.cc, l2_normalization.cc, lrn.cc)
+# --------------------------------------------------------------------------
+def batch_norm_train(x, gamma, beta, running_mean, running_var, momentum=0.9,
+                     eps=1e-5, axis=1, fix_gamma=False):
+    """Returns (out, new_running_mean, new_running_var)."""
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = lax.rsqrt(var + eps)
+    out = (xf - mean.reshape(shape)) * inv.reshape(shape)
+    out = out * gamma.reshape(shape) + beta.reshape(shape)
+    new_mean = momentum * running_mean + (1 - momentum) * mean
+    new_var = momentum * running_var + (1 - momentum) * var
+    return out.astype(x.dtype), new_mean.astype(running_mean.dtype), new_var.astype(running_var.dtype)
+
+
+def batch_norm_inference(x, gamma, beta, running_mean, running_var, eps=1e-5,
+                         axis=1, fix_gamma=False):
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = lax.rsqrt(running_var.astype(jnp.float32) + eps)
+    scale = (gamma * inv).reshape(shape)
+    shift = (beta - running_mean * gamma * inv).reshape(shape)
+    return (x * scale + shift).astype(x.dtype)
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
+    axis_ = axis % x.ndim
+    shape = [1] * x.ndim
+    shape[axis_] = x.shape[axis_]
+    return (out * gamma.reshape(shape) + beta.reshape(shape)).astype(x.dtype)
+
+
+def group_norm(x, gamma, beta, num_groups, eps=1e-5):
+    # x: (N, C, ...) → groups over channel axis 1
+    n, c = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    xg = x.reshape((n, num_groups, c // num_groups) + rest).astype(jnp.float32)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = [1] * x.ndim
+    shape[1] = c
+    return (out * gamma.reshape(shape) + beta.reshape(shape)).astype(x.dtype)
+
+
+def instance_norm(x, gamma, beta, eps=1e-5):
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[1] = x.shape[1]
+    return (out * gamma.reshape(shape) + beta.reshape(shape)).astype(x.dtype)
+
+
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise ValueError(mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+def lrn(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    """Local response norm across channels (src/operator/nn/lrn.cc)."""
+    sq = jnp.square(x)
+    half = nsize // 2
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, half)
+    window = [1] * x.ndim
+    window[1] = nsize
+    ssum = lax.reduce_window(sq, 0.0, lax.add, window, [1] * x.ndim, pads)
+    return x / jnp.power(knorm + alpha * ssum / nsize, beta)
+
+
+# --------------------------------------------------------------------------
+# dropout (src/operator/nn/dropout.cc)
+# --------------------------------------------------------------------------
+def dropout(x, key, p=0.5, mode="training", axes=None):
+    if p <= 0.0:
+        return x
+    shape = list(x.shape)
+    if axes:
+        for ax in axes:
+            shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(x.dtype) / keep
+    return x * mask
+
+
+# --------------------------------------------------------------------------
+# embedding / indexing (src/operator/tensor/indexing_op.h)
+# --------------------------------------------------------------------------
+def embedding(data, weight, sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=onp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    axis = axis % data.ndim
+    moved = jnp.moveaxis(data, axis, -1)
+    src = -moved if is_ascend else moved
+    vals, idxs = lax.top_k(src, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis)
+    if ret_typ == "indices":
+        return idxs.astype(onp.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs.astype(onp.dtype(dtype))
+    if ret_typ == "mask":
+        flat_idx = jnp.moveaxis(idxs, axis, -1).reshape((-1, k)).astype(jnp.int32)
+        mask = jnp.zeros(moved.shape, onp.dtype(dtype)).reshape((-1, moved.shape[-1]))
+        mask = jax.vmap(lambda m, i: m.at[i].set(1))(mask, flat_idx)
+        return jnp.moveaxis(mask.reshape(moved.shape), -1, axis)
+    raise ValueError(ret_typ)
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis % data.ndim if axis is not None else -1)
+    out = jnp.take_along_axis(data, idx, axis)
+    return out if keepdims else jnp.squeeze(out, axis)
+
+
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+def scatter_nd(data, indices, shape):
+    out = jnp.zeros(shape, data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+# --------------------------------------------------------------------------
+# sequence ops (src/operator/sequence_*.cc)
+# --------------------------------------------------------------------------
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    # data: (L, B, ...) if axis==0, (B, L, ...) if axis==1
+    L = data.shape[axis]
+    idx = lax.broadcasted_iota(jnp.int32, data.shape, axis)
+    batch_axis = 1 - axis
+    l = sequence_length.astype(jnp.int32)
+    shape = [1] * data.ndim
+    shape[batch_axis] = data.shape[batch_axis]
+    mask = idx < l.reshape(shape)
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (L, B, ...)
+    return jax.vmap(lambda i, col: col[i], in_axes=(0, 1), out_axes=0)(last, moved)
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    L = moved.shape[0]
+    l = sequence_length.astype(jnp.int32)
+    idx = jnp.arange(L)
+
+    def rev_one(length, col):  # col: (L, ...)
+        src = jnp.where(idx < length, length - 1 - idx, idx)
+        return col[src]
+
+    out = jax.vmap(rev_one, in_axes=(0, 1), out_axes=1)(l, moved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+# --------------------------------------------------------------------------
+# losses / misc kernels
+# --------------------------------------------------------------------------
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, blank=0):
+    """CTC loss (src/operator/nn/ctc_loss.cc). data: (T, B, V) logits."""
+    T, B, V = data.shape
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    if data_lengths is None:
+        data_lengths = jnp.full((B,), T, jnp.int32)
+    if label_lengths is None:
+        # infer: padding slots are -1 or the blank symbol (reference
+        # contract when use_label_lengths=False: labels padded w/ -1/blank)
+        label_lengths = jnp.sum((label != -1) & (label != blank),
+                                axis=-1).astype(jnp.int32)
+
+    Lmax = label.shape[1]
+    S = 2 * Lmax + 1
+
+    def one(logp_b, lab, tlen, llen):
+        lab = lab.astype(jnp.int32)
+        ext = jnp.full((S,), blank, jnp.int32)
+        ext = ext.at[1::2].set(lab)
+        ninf = -1e30
+        alpha = jnp.full((S,), ninf)
+        alpha = alpha.at[0].set(logp_b[0, blank])
+        alpha = alpha.at[1].set(jnp.where(llen > 0, logp_b[0, ext[1]], ninf))
+
+        def step(alpha, lp):
+            prev1 = jnp.concatenate([jnp.full((1,), ninf), alpha[:-1]])
+            prev2 = jnp.concatenate([jnp.full((2,), ninf), alpha[:-2]])
+            skip_ok = (jnp.arange(S) % 2 == 1) & (ext != jnp.concatenate(
+                [jnp.full((2,), -1), ext[:-2]]))
+            m = jnp.maximum(alpha, prev1)
+            m = jnp.where(skip_ok, jnp.maximum(m, prev2), m)
+            comb = jnp.log(
+                jnp.exp(alpha - m) + jnp.exp(prev1 - m)
+                + jnp.where(skip_ok, jnp.exp(prev2 - m), 0.0)) + m
+            new = comb + lp[ext]
+            return new, new
+
+        _, alphas = lax.scan(step, alpha, logp_b[1:])
+        alphas = jnp.concatenate([alpha[None], alphas], axis=0)  # (T, S)
+        final = alphas[tlen - 1]
+        end = 2 * llen
+        a = final[end]
+        b = jnp.where(llen > 0, final[end - 1], ninf)
+        m = jnp.maximum(a, b)
+        ll = jnp.log(jnp.exp(a - m) + jnp.exp(b - m)) + m
+        return -ll
+
+    return jax.vmap(one, in_axes=(1, 0, 0, 0))(logp, label, data_lengths.astype(jnp.int32),
+                                               label_lengths.astype(jnp.int32))
+
+
+def all_finite(arrays):
+    """all_finite / multi_all_finite (src/operator/all_finite.cc)."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return ok

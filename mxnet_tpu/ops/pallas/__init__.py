@@ -1,0 +1,6 @@
+"""Pallas TPU kernels — the hand-tuned hot-op tier.
+
+Parity: this tier replaces the reference's cuDNN/fused-CUDA kernels
+(`src/operator/contrib/transformer.cu`, `rnn-inl.h` cuDNN path, fusion RTC)
+with TPU systolic-array kernels written in Pallas.
+"""

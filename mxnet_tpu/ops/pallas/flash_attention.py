@@ -1,0 +1,81 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Replaces the reference's O(L^2)-memory fused attention matmuls
+(`src/operator/contrib/transformer.cc:650` interleaved_matmul_selfatt_qk →
+softmax → valatt chain) and the sliding-window kernels
+(`transformer.cc:847` sldwin_atten_*) with one blockwise kernel:
+per q-block, stream k/v through VMEM, keep a running (max, sum) pair, never
+materialize the (L, L) score matrix in HBM.  Causal and banded
+(sliding-window) masking are flags on the same kernel.
+
+Layout: q, k, v are (B, H, L, D); D should be a multiple of 128 (MXU lane
+width) and block_q a multiple of 8 (f32 sublane) for best tiling.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, window,
+                 block_q, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
+    k = k_ref[0].astype(jnp.float32)          # (L, D)
+    v = v_ref[0].astype(jnp.float32)          # (L, D)
+
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (block_q, L)
+
+    if causal or window is not None:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = jnp.ones(s.shape, jnp.bool_)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (jnp.abs(q_pos - k_pos) <= window)
+        s = jnp.where(mask, s, -jnp.inf)
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)  # fully-masked rows
+    p = jnp.exp(s - m)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.dot(p, v, preferred_element_type=jnp.float32) / l
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "window", "scale",
+                                             "block_q", "interpret"))
+def flash_attention_tpu(q, k, v, causal=False, window=None, scale=None,
+                        block_q=128, interpret=False):
+    """q,k,v: (B, H, L, D) → (B, H, L, D)."""
+    B, H, L, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    block_q = min(block_q, L)
+    while L % block_q:
+        block_q //= 2
+    qr = q.reshape(B * H, L, D)
+    kr = k.reshape(B * H, L, D)
+    vr = v.reshape(B * H, L, D)
+
+    grid = (B * H, L // block_q)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale, causal=causal,
+                          window=window, block_q=block_q, seq_len=L),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, L, D)

@@ -1,0 +1,581 @@
+"""Optimizers (parity: python/mxnet/optimizer/ — 22 files: SGD, NAG, Adam,
+AdamW, AdaBelief, AdaGrad, AdaDelta, FTRL, LAMB, LARS, RMSProp, SGLD,
+Signum, Nadam/Adamax via Adam variants; registry + Updater; multi_precision
+master weights).
+
+TPU-native: each optimizer maps to a fused XLA update kernel in
+ops/optimizer_ops.py (the reference's fused `*_update` CUDA ops); the
+Trainer calls `update_multi_precision` per parameter, and each distinct
+(shape, dtype, hyperparam) signature compiles once.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .._rng import next_key
+from ..ndarray import ndarray, _wrap_value, _unwrap
+from ..ops import optimizer_ops as _ops
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _OPT_REGISTRY[name.lower()](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer/optimizer.py).
+
+    State per parameter index is created lazily by `create_state`; updates
+    run through fused XLA kernels and write back into the weight ndarray's
+    buffer (donation-style in-place semantics).
+    """
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, aggregate_num=0,
+                 use_fused_step=True, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.param_dict = param_dict or {}
+        self.param_idx2name = param_idx2name or {}
+        self.idx2name = self.param_idx2name
+        self.num_update = 0
+        self._index_update_count = {}
+        self.wd_mult = {}
+        self.lr_mult = {}
+
+    # -- hyperparameter resolution ---------------------------------------
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = 0
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.base_lr = lr
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == onp.float16:
+            master = _wrap_value(weight._data.astype(jnp.float32))
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # -- update -----------------------------------------------------------
+    def update(self, indices, weights, grads, states):
+        """Batched API (reference optimizer.update takes lists)."""
+        if not isinstance(indices, (list, tuple)):
+            indices, weights, grads, states = [indices], [weights], [grads], [states]
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self._update_count(i)
+            self.step_one(i, w, g, s)
+
+    def update_multi_precision(self, indices, weights, grads, states):
+        if not isinstance(indices, (list, tuple)):
+            indices, weights, grads, states = [indices], [weights], [grads], [states]
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self._update_count(i)
+            if self.multi_precision and w.dtype == onp.float16 and isinstance(s, tuple):
+                master, inner = s
+                self.step_one(i, master, g, inner)
+                w._set_data(master._data.astype(w._data.dtype))
+            else:
+                self.step_one(i, w, g, s)
+
+    def step_one(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    # -- serialization (Trainer.save_states) ------------------------------
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+
+
+@register
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _wrap_value(jnp.zeros(weight.shape, jnp.float32))
+        return None
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        if self.momentum == 0.0:
+            weight._set_data(_ops.sgd_update(
+                weight._data, grad._data, lr, wd, self.rescale_grad, clip))
+        else:
+            new_w, new_m = _ops.sgd_mom_update(
+                weight._data, grad._data, state._data, lr, self.momentum, wd,
+                self.rescale_grad, clip)
+            weight._set_data(new_w)
+            state._set_data(new_m)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, learning_rate=0.1, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return _wrap_value(jnp.zeros(weight.shape, jnp.float32))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        new_w, new_m = _ops.nag_mom_update(
+            weight._data, grad._data, state._data, lr, self.momentum, wd,
+            self.rescale_grad, clip)
+        weight._set_data(new_w)
+        state._set_data(new_m)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr = lr * (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        mean, var = state
+        new_w, new_m, new_v = _ops.adam_update(
+            weight._data, grad._data, mean._data, var._data, lr, self.beta1,
+            self.beta2, self.epsilon, wd, self.rescale_grad, clip)
+        weight._set_data(new_w)
+        mean._set_data(new_m)
+        var._set_data(new_v)
+
+
+@register
+class AdamW(Adam):
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr = lr * (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        mean, var = state
+        new_w, new_m, new_v = _ops.adamw_update(
+            weight._data, grad._data, mean._data, var._data, lr, 1.0,
+            self.beta1, self.beta2, self.epsilon, wd, self.rescale_grad, clip)
+        weight._set_data(new_w)
+        mean._set_data(new_m)
+        var._set_data(new_v)
+
+
+@register
+class AdaBelief(Adam):
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr = lr * (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        mean, var = state
+        new_w, new_m, new_v = _ops.adabelief_update(
+            weight._data, grad._data, mean._data, var._data, lr, self.beta1,
+            self.beta2, self.epsilon, wd, self.rescale_grad, clip)
+        weight._set_data(new_w)
+        mean._set_data(new_m)
+        var._set_data(new_v)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr = lr / (1.0 - self.beta1 ** t)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        m, u = state
+        g = grad._data.astype(jnp.float32) * self.rescale_grad
+        if clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * weight._data.astype(jnp.float32)
+        new_m = self.beta1 * m._data + (1 - self.beta1) * g
+        new_u = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._set_data((weight._data.astype(jnp.float32)
+                          - lr * new_m / (new_u + 1e-8)).astype(weight.dtype))
+        m._set_data(new_m)
+        u._set_data(new_u)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        g = grad._data.astype(jnp.float32) * self.rescale_grad
+        if clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * weight._data.astype(jnp.float32)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        new_m = self.beta1 * m._data + (1 - self.beta1) * g
+        new_v = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = new_m / (1.0 - m_schedule_next)
+        v_prime = new_v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._set_data((weight._data.astype(jnp.float32)
+                          - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+                          ).astype(weight.dtype))
+        m._set_data(new_m)
+        v._set_data(new_v)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return _wrap_value(jnp.zeros(weight.shape, jnp.float32))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        new_w, new_h = _ops.adagrad_update(
+            weight._data, grad._data, state._data, lr, self.epsilon, wd,
+            self.rescale_grad, clip)
+        weight._set_data(new_w)
+        state._set_data(new_h)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))
+
+    def step_one(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        acc_g, acc_d = state
+        new_w, new_g, new_d = _ops.adadelta_update(
+            weight._data, grad._data, acc_g._data, acc_d._data, self.rho,
+            self.epsilon, wd, self.rescale_grad, clip)
+        weight._set_data(new_w)
+        acc_g._set_data(new_g)
+        acc_d._set_data(new_d)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return tuple(_wrap_value(jnp.zeros(weight.shape, jnp.float32))
+                         for _ in range(3))
+        return _wrap_value(jnp.zeros(weight.shape, jnp.float32))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        cw = self.clip_weights if self.clip_weights else -1.0
+        if self.centered:
+            n, g_avg, delta = state
+            new_w, new_n, new_g, new_d = _ops.rmspropalex_update(
+                weight._data, grad._data, n._data, g_avg._data, delta._data,
+                lr, self.rho, self.momentum, self.epsilon, wd,
+                self.rescale_grad, clip, cw)
+            weight._set_data(new_w)
+            n._set_data(new_n)
+            g_avg._set_data(new_g)
+            delta._set_data(new_d)
+        else:
+            new_w, new_n = _ops.rmsprop_update(
+                weight._data, grad._data, state._data, lr, self.rho,
+                self.epsilon, wd, self.rescale_grad, clip, cw)
+            weight._set_data(new_w)
+            state._set_data(new_n)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        z, n = state
+        new_w, new_z, new_n = _ops.ftrl_update(
+            weight._data, grad._data, z._data, n._data, lr, self.lamda1,
+            self.beta, wd, self.rescale_grad, clip)
+        weight._set_data(new_w)
+        z._set_data(new_z)
+        n._set_data(new_n)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _wrap_value(jnp.zeros(weight.shape, jnp.float32))
+        return None
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        if state is None:
+            g = grad._data.astype(jnp.float32) * self.rescale_grad
+            if clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            new_w = ((1 - lr * (wd + self.wd_lh)) * weight._data.astype(jnp.float32)
+                     - lr * jnp.sign(g))
+            weight._set_data(new_w.astype(weight.dtype))
+        else:
+            new_w, new_m = _ops.signum_update(
+                weight._data, grad._data, state._data, lr, self.momentum, wd,
+                self.rescale_grad, clip, self.wd_lh)
+            weight._set_data(new_w)
+            state._set_data(new_m)
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        mean, var = state
+        new_w, new_m, new_v = _ops.lamb_update(
+            weight._data, grad._data, mean._data, var._data, lr, self.beta1,
+            self.beta2, self.epsilon, wd, t, self.bias_correction,
+            self.rescale_grad, clip, self.lower_bound, self.upper_bound)
+        weight._set_data(new_w)
+        mean._set_data(new_m)
+        var._set_data(new_v)
+
+
+@register
+class LARS(Optimizer):
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return _wrap_value(jnp.zeros(weight.shape, jnp.float32))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        new_w, new_m = _ops.lars_update(
+            weight._data, grad._data, state._data, lr, self.eta,
+            self.momentum, wd, self.epsilon, self.rescale_grad, clip)
+        weight._set_data(new_w)
+        state._set_data(new_m)
+
+
+@register
+class SGLD(Optimizer):
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        weight._set_data(_ops.sgld_update(
+            weight._data, grad._data, lr, next_key(), wd, self.rescale_grad,
+            clip))
+
+
+class Updater:
+    """kvstore-side updater wrapper (reference optimizer/updater.py)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            index, grad, weight = [index], [grad], [weight]
+        for i, g, w in zip(index, grad, weight):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+            # update_multi_precision advances the update count itself
+            self.optimizer.update_multi_precision([i], [w], [g], [self.states[i]])
+
+    def get_states(self, dump_optimizer=False):
+        states = {k: (tuple(s.asnumpy() for s in v) if isinstance(v, tuple)
+                      else (v.asnumpy() if v is not None else None))
+                  for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple):
+            states_np, self.optimizer = data
+        else:
+            states_np = data
+        from ..ndarray import array
+        out = {}
+        for k, v in states_np.items():
+            if v is None:
+                out[k] = None
+            elif isinstance(v, tuple):
+                out[k] = tuple(array(s) for s in v)
+            else:
+                out[k] = array(v)
+        self.states = out
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)
+
+
+# common lowercase aliases used by scripts (kvstore optimizer strings)
+sgd = SGD
+adam = Adam
+nag = NAG
+rmsprop = RMSProp
+adagrad = AdaGrad
+adadelta = AdaDelta
+ftrl = Ftrl
+signum = Signum
+lamb = LAMB
+lars = LARS
+sgld = SGLD
+adamw = AdamW

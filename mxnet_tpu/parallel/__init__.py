@@ -1,0 +1,232 @@
+"""mxnet_tpu.parallel — SPMD distributed training over device meshes.
+
+This is where the TPU build goes *beyond* the reference: the reference has
+data parallelism only (SURVEY.md §2.4 — kvstore + ps-lite/NCCL/Horovod).
+Here, parallelism is expressed as shardings over a `jax.sharding.Mesh`
+(dp/tp/pp/sp axes) and GSPMD/XLA inserts the collectives (all-reduce over
+ICI for dp gradients, all-gather/reduce-scatter for tp, ppermute rings for
+sequence parallelism — see ring_attention.py).
+
+Components:
+- make_mesh / MeshConfig: mesh construction helpers
+- functionalize(net): HybridBlock → pure (params, x) -> out function
+- DataParallelTrainer: whole-training-step compilation with dp sharding
+- sharded train step builders used by __graft_entry__.dryrun_multichip
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import autograd
+from .._rng import trace_keys
+from ..ndarray import ndarray, _wrap_value
+
+__all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "functionalize",
+           "DataParallelTrainer", "replicate", "shard_batch"]
+
+
+def make_mesh(shape=None, axis_names=("dp",), devices=None):
+    """Create a Mesh over local devices.  shape=None → all devices on the
+    first axis."""
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    arr = onp.array(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def functionalize(net, train=False):
+    """Extract a pure function from a Gluon block.
+
+    Returns (fn, params) with fn(param_vals: dict, *input_vals, key=None)
+    -> (out_vals_pytree, aux_updates: dict).  The same rebinding trick as
+    HybridBlock._build_cache — usable under jit/shard_map/grad.
+    """
+    params = OrderedDict((name, p) for name, p in net.collect_params().items()
+                         if p._data is not None)
+
+    def fn(param_vals, *input_vals, key=None):
+        saved = [(p, p._data) for p in params.values()]
+        wrappers = []
+        try:
+            for name, p in params.items():
+                w = _wrap_value(param_vals[name])
+                p._data = w
+                wrappers.append((name, w, param_vals[name]))
+            args = [_wrap_value(v) if isinstance(v, jax.Array) or hasattr(v, "shape")
+                    else v for v in input_vals]
+            ctx = trace_keys(key) if key is not None else None
+            if ctx is not None:
+                ctx.__enter__()
+            try:
+                with autograd._RecordingStateScope(False, train):
+                    out = net.forward(*args)
+            finally:
+                if ctx is not None:
+                    ctx.__exit__(None, None, None)
+            aux = {}
+            for name, w, v in wrappers:
+                if w._data is not v:
+                    aux[name] = w._data
+            if isinstance(out, (list, tuple)):
+                out_vals = type(out)(o._data for o in out)
+            else:
+                out_vals = out._data
+            return out_vals, aux
+        finally:
+            for p, old in saved:
+                p._data = old
+
+    return fn, params
+
+
+def replicate(x, mesh):
+    """Place an array replicated over the whole mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(x, sharding)
+
+
+def shard_batch(x, mesh, axis_name="dp"):
+    """Shard a batch along its leading axis over the named mesh axis."""
+    spec = P(axis_name)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+class DataParallelTrainer:
+    """Compiled data-parallel training step over a mesh.
+
+    TPU-native replacement for the reference's Trainer+kvstore loop: the
+    forward, backward, gradient all-reduce (GSPMD-inserted over ICI) and
+    optimizer update compile into ONE XLA executable with donated
+    param/state buffers.
+
+    loss_fn(out, *labels) must return a scalar ndarray expression built
+    from mx ops (it is traced).
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, train=True):
+        from .. import optimizer as opt_mod
+        self.net = net
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else make_mesh()
+        opt = (optimizer if isinstance(optimizer, opt_mod.Optimizer)
+               else opt_mod.create(optimizer, **(optimizer_params or {})))
+        self.optimizer = opt
+        self.train = train
+        self._step = None
+        self._fn, self._params = functionalize(net, train=train)
+        # optimizer state as pure pytree (fp32 slots like the reference's
+        # create_state)
+        self._opt_kind, self._hp = self._opt_signature(opt)
+
+    def _opt_signature(self, opt):
+        from .. import optimizer as opt_mod
+        if isinstance(opt, opt_mod.SGD):
+            return ("sgd_mom" if opt.momentum else "sgd",
+                    dict(momentum=getattr(opt, "momentum", 0.0), wd=opt.wd))
+        if isinstance(opt, opt_mod.Adam):
+            return ("adam", dict(beta1=opt.beta1, beta2=opt.beta2,
+                                 epsilon=opt.epsilon, wd=opt.wd))
+        raise NotImplementedError(
+            "DataParallelTrainer supports sgd/adam fused steps; got %r"
+            % type(opt).__name__)
+
+    def init_state(self):
+        pvals = {k: p._data._data for k, p in self._params.items()}
+        if self._opt_kind == "sgd":
+            slots = {}
+        elif self._opt_kind == "sgd_mom":
+            slots = {k: jnp.zeros(v.shape, jnp.float32) for k, v in pvals.items()}
+        else:  # adam
+            slots = {k: (jnp.zeros(v.shape, jnp.float32),
+                         jnp.zeros(v.shape, jnp.float32)) for k, v in pvals.items()}
+        return {"params": pvals, "slots": slots, "t": jnp.zeros((), jnp.int32)}
+
+    def build_step(self, donate=True):
+        fn = self._fn
+        loss_fn = self.loss_fn
+        kind, hp = self._opt_kind, self._hp
+        lr_holder = self
+
+        grad_names = [k for k, p in self._params.items()
+                      if p.grad_req != "null"]
+
+        def step(state, batch, labels, key, lr):
+            pvals = state["params"]
+
+            def loss_of(diff_pvals):
+                full = dict(pvals)
+                full.update(diff_pvals)
+                out, aux = fn(full, batch, key=key)
+                out_nd = (_wrap_value(out) if not isinstance(out, tuple)
+                          else tuple(_wrap_value(o) for o in out))
+                lbl_nd = tuple(_wrap_value(l) for l in labels) \
+                    if isinstance(labels, tuple) else (_wrap_value(labels),)
+                with autograd._RecordingStateScope(False, True):
+                    loss = loss_fn(out_nd, *lbl_nd)
+                loss_val = loss._data if isinstance(loss, ndarray) else loss
+                return jnp.mean(loss_val), aux
+
+            diff = {k: pvals[k] for k in grad_names}
+            (loss_val, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(diff)
+            t = state["t"] + 1
+            new_params = dict(pvals)
+            new_slots = dict(state["slots"])
+            for k in grad_names:
+                g = grads[k].astype(jnp.float32)
+                w = pvals[k].astype(jnp.float32)
+                g = g + hp.get("wd", 0.0) * w
+                if kind == "sgd":
+                    new_w = w - lr * g
+                elif kind == "sgd_mom":
+                    m = hp["momentum"] * new_slots[k] - lr * g
+                    new_slots[k] = m
+                    new_w = w + m
+                else:  # adam w/ bias correction in lr
+                    b1, b2, eps = hp["beta1"], hp["beta2"], hp["epsilon"]
+                    m, v = new_slots[k]
+                    m = b1 * m + (1 - b1) * g
+                    v = b2 * v + (1 - b2) * jnp.square(g)
+                    tf = t.astype(jnp.float32)
+                    lr_t = lr * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+                    new_slots[k] = (m, v)
+                    new_w = w - lr_t * m / (jnp.sqrt(v) + eps)
+                new_params[k] = new_w.astype(pvals[k].dtype)
+            for k, v in aux.items():
+                new_params[k] = v
+            return {"params": new_params, "slots": new_slots, "t": t}, loss_val
+
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        repl = NamedSharding(mesh, P())
+        data_sh = NamedSharding(mesh, P(axis))
+
+        self._step = jax.jit(
+            step,
+            in_shardings=(repl, data_sh, data_sh, repl, repl),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,) if donate else (),
+        )
+        return self._step
+
+    def step(self, state, batch, labels, key, lr):
+        if self._step is None:
+            self.build_step()
+        batch = batch._data if isinstance(batch, ndarray) else batch
+        if isinstance(labels, ndarray):
+            labels = labels._data
+        elif isinstance(labels, tuple):
+            labels = tuple(l._data if isinstance(l, ndarray) else l for l in labels)
+        return self._step(state, batch, labels, key, lr)
+
+    def write_back(self, state):
+        """Copy compiled-state params back into the Gluon Parameters."""
+        for k, p in self._params.items():
+            p._data._set_data(state["params"][k])

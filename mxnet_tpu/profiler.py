@@ -1,0 +1,160 @@
+"""Profiler (parity: python/mxnet/profiler.py + src/profiler/ chrome-trace).
+
+TPU-native: host-side scoped events (Task/Frame/Marker) are recorded to a
+chrome://tracing JSON like the reference's Profiler; device-side profiling
+delegates to the XLA/PJRT profiler (jax.profiler xplane traces), the moral
+equivalent of the reference's NVTX/VTune bridges.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_STATE = {
+    "config": {"filename": "profile.json", "profile_all": False},
+    "running": False,
+    "events": [],
+    "lock": threading.Lock(),
+    "device_dir": None,
+}
+
+
+def set_config(**kwargs):
+    """profiler.set_config(filename=..., profile_all=..., ...)"""
+    _STATE["config"].update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    _STATE["running"] = True
+    _STATE["start_ts"] = time.time()
+    aggregate = _STATE["config"].get("aggregate_stats", False)
+    dev_dir = _STATE["config"].get("xplane_dir")
+    if dev_dir:
+        import jax
+        jax.profiler.start_trace(dev_dir)
+        _STATE["device_dir"] = dev_dir
+
+
+def stop(profile_process="worker"):
+    _STATE["running"] = False
+    if _STATE["device_dir"]:
+        import jax
+        jax.profiler.stop_trace()
+        _STATE["device_dir"] = None
+
+
+def _emit(name, cat, ph, ts, args=None):
+    with _STATE["lock"]:
+        _STATE["events"].append({
+            "name": name, "cat": cat, "ph": ph, "pid": os.getpid(),
+            "tid": threading.get_ident(), "ts": ts * 1e6,
+            "args": args or {},
+        })
+
+
+def dump(finished=True, profile_process="worker"):
+    fname = _STATE["config"].get("filename", "profile.json")
+    with _STATE["lock"]:
+        events = list(_STATE["events"])
+    with open(fname, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return fname
+
+
+def dumps(reset=False):
+    with _STATE["lock"]:
+        s = json.dumps({"traceEvents": _STATE["events"]})
+        if reset:
+            _STATE["events"].clear()
+    return s
+
+
+def pause(profile_process="worker"):
+    _STATE["running"] = False
+
+
+def resume(profile_process="worker"):
+    _STATE["running"] = True
+
+
+class _Scoped:
+    _cat = "event"
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.time()
+        if _STATE["running"]:
+            _emit(self.name, self._cat, "B", self._t0)
+
+    def stop(self):
+        if _STATE["running"]:
+            _emit(self.name, self._cat, "E", time.time())
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class Task(_Scoped):
+    _cat = "task"
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Frame(_Scoped):
+    _cat = "frame"
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Event(_Scoped):
+    _cat = "event"
+
+
+class Counter:
+    def __init__(self, name, domain=None, value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+        if _STATE["running"]:
+            _emit(self.name, "counter", "C", time.time(),
+                  {"value": self.value})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _STATE["running"]:
+            _emit(self.name, "marker", "i", time.time())
+
+
+def scope(name="<unk>:"):
+    return Task(name)

@@ -1,0 +1,43 @@
+"""Runtime feature detection (parity: python/mxnet/runtime.py, src/libinfo.cc)."""
+from __future__ import annotations
+
+import jax
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "✔ %s" % self.name if self.enabled else "✖ %s" % self.name
+
+
+class Features(dict):
+    """mx.runtime.Features() — build/runtime feature flags."""
+
+    def __init__(self):
+        platforms = {d.platform for d in jax.devices()}
+        feats = {
+            "TPU": bool(platforms - {"cpu"}),
+            "CPU": True,
+            "XLA": True,
+            "PALLAS": True,
+            "BF16": True,
+            "INT64_TENSOR_SIZE": True,
+            "SIGNAL_HANDLER": False,
+            "CUDA": False,
+            "CUDNN": False,
+            "ONEDNN": False,
+            "TENSORRT": False,
+            "OPENMP": False,
+            "DIST_KVSTORE": True,
+        }
+        super().__init__({k: Feature(k, v) for k, v in feats.items()})
+
+    def is_enabled(self, name):
+        return self[name].enabled
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's CI strategy (SURVEY.md §4): the unit suite runs on
+CPU by default; multi-device/collective paths are exercised on a virtual
+8-device mesh (XLA host platform device count), the TPU analog of
+multi-process-on-one-host kvstore tests.
+
+Must run before any JAX backend initialization: the environment's axon
+bootstrap (sitecustomize) forces jax_platforms=axon,cpu, so we override the
+config here, not just the env var.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    """Per-test deterministic seeding (reference conftest.py:61 module-scoped
+    seeding fixture)."""
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    onp.random.seed(0)
+    yield

@@ -1,0 +1,248 @@
+"""Autograd tests (reference analog: tests/python/unittest/test_autograd.py)
+including finite-difference gradient checks (test_utils.check_numeric_gradient
+pattern)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central-difference gradient of scalar f at numpy x."""
+    g = onp.zeros_like(x)
+    it = onp.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_grad():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6], rtol=1e-5)
+
+
+def test_chain_and_branch():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 3
+        b = a * a + x  # dy/dx = 18x + 1
+    b.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [37.0], rtol=1e-5)
+
+
+def test_shared_subexpression():
+    x = np.array([1.5])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        y = a * a + a  # y = 4x^2 + 2x, dy = 8x + 2
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [14.0], rtol=1e-5)
+
+
+def test_grad_req_add():
+    x = np.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0, 12.0], rtol=1e-5)
+
+
+def test_grad_req_null():
+    x = np.array([1.0])
+    x.attach_grad(grad_req="null")
+    y_in = np.array([2.0])
+    y_in.attach_grad()
+    with autograd.record():
+        z = x * y_in
+    z.backward()
+    onp.testing.assert_allclose(y_in.grad.asnumpy(), [1.0])
+
+
+def test_multi_head_backward():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(np.array([1.0, 10.0]))
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 20.0])
+
+
+def test_detach_stops_grad():
+    x = np.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [9.0], rtol=1e-5)
+
+
+def test_pause_scope():
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            w = x * 10  # not recorded
+        z = y + w.detach()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+    assert w._node is None
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_grad_function():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 3
+    g = autograd.grad(y, x, retain_graph=True)
+    onp.testing.assert_allclose(g.asnumpy(), [12.0], rtol=1e-5)
+
+
+@pytest.mark.parametrize("op,ref_grad", [
+    (lambda x: np.exp(x), lambda x: onp.exp(x)),
+    (lambda x: np.log(x + 3), lambda x: 1 / (x + 3)),
+    (lambda x: np.tanh(x), lambda x: 1 - onp.tanh(x) ** 2),
+    (lambda x: npx.sigmoid(x), lambda x: (1 / (1 + onp.exp(-x))) * (1 - 1 / (1 + onp.exp(-x)))),
+    (lambda x: np.sqrt(x + 3), lambda x: 0.5 / onp.sqrt(x + 3)),
+])
+def test_elemwise_grads(op, ref_grad):
+    xv = onp.random.RandomState(0).uniform(-1, 1, (3, 4)).astype("float32")
+    x = np.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = op(x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), ref_grad(xv), rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_matmul_grad_numeric():
+    rng = onp.random.RandomState(0)
+    av = rng.randn(3, 4).astype("float32")
+    bv = rng.randn(4, 2).astype("float32")
+    a, b = np.array(av), np.array(bv)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        loss = (np.matmul(a, b) ** 2).sum()
+    loss.backward()
+    ga = numeric_grad(lambda x: float(((x @ bv) ** 2).sum()), av)
+    gb = numeric_grad(lambda x: float(((av @ x) ** 2).sum()), bv)
+    onp.testing.assert_allclose(a.grad.asnumpy(), ga, rtol=1e-2, atol=1e-2)
+    onp.testing.assert_allclose(b.grad.asnumpy(), gb, rtol=1e-2, atol=1e-2)
+
+
+def test_softmax_ce_grad_numeric():
+    rng = onp.random.RandomState(0)
+    xv = rng.randn(2, 5).astype("float32")
+    label = onp.array([1, 3])
+    x = np.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        logp = npx.log_softmax(x)
+        loss = -npx.pick(logp, np.array(label)).sum()
+    loss.backward()
+
+    def f(v):
+        e = onp.exp(v - v.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return float(-onp.log(p[onp.arange(2), label]).sum())
+
+    g = numeric_grad(f, xv)
+    onp.testing.assert_allclose(x.grad.asnumpy(), g, rtol=1e-2, atol=1e-2)
+
+
+def test_backward_without_record_raises():
+    x = np.array([1.0])
+    with pytest.raises(ValueError):
+        (x * 2).backward()
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = npx.sigmoid(x)
+            self.save = y
+            return y
+
+        def backward(self, dy):
+            y = self.save
+            return dy * y * (1 - y)
+
+    x = np.array([0.5, -0.5])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + onp.exp(-onp.array([0.5, -0.5])))
+    onp.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_mutation_during_record_raises():
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y[0] = 5.0
+
+
+def test_higher_order_grad():
+    # d2/dx2 of x^3 = 6x (reference: test_higher_order_grad.py)
+    x = np.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        z = gx.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [12.0, 18.0], rtol=1e-4)
+
+
+def test_bool_ambiguous_raises():
+    with pytest.raises(ValueError):
+        bool(np.array([1.0, 2.0]))
+
+
+def test_ctc_loss_padding():
+    # padded labels must not contribute (code-review regression)
+    from mxnet_tpu import gluon
+    T, B, V = 10, 2, 6
+    rng = onp.random.RandomState(0)
+    logits = np.array(rng.randn(B, T, V).astype("float32"))
+    # labels padded with -1; row 0 has 2 labels, row 1 has 3
+    labels = np.array(onp.array([[1, 2, -1, -1], [3, 4, 5, -1]], "float32"))
+    loss_fn = gluon.loss.CTCLoss()
+    l_pad = loss_fn(logits, labels).asnumpy()
+    ll = np.array(onp.array([2, 3], "float32"))
+    l_len = loss_fn(logits, labels, None, ll).asnumpy()
+    onp.testing.assert_allclose(l_pad, l_len, rtol=1e-4)

@@ -1,0 +1,141 @@
+"""ndarray basics (reference analog: tests/python/unittest/test_numpy_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+def test_creation():
+    a = np.array([[1, 2], [3, 4]], dtype="float32")
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    assert a.size == 4
+    assert a.ndim == 2
+    z = np.zeros((3, 4))
+    assert z.shape == (3, 4) and float(z.sum()) == 0
+    o = np.ones((2, 3), dtype="int32")
+    assert o.dtype == onp.int32
+    f = np.full((2, 2), 7.0)
+    assert float(f[0, 0]) == 7.0
+    r = np.arange(10)
+    assert r.shape == (10,)
+    l = np.linspace(0, 1, 5)
+    onp.testing.assert_allclose(l.asnumpy(), onp.linspace(0, 1, 5), rtol=1e-6)
+    e = np.eye(3)
+    onp.testing.assert_array_equal(e.asnumpy(), onp.eye(3, dtype=onp.float32))
+
+
+def test_arithmetic_and_broadcast():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.array([10.0, 20.0])
+    onp.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [13, 24]])
+    onp.testing.assert_allclose((a - 1).asnumpy(), [[0, 1], [2, 3]])
+    onp.testing.assert_allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    onp.testing.assert_allclose((a / 2).asnumpy(), [[0.5, 1], [1.5, 2]])
+    onp.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    onp.testing.assert_allclose((a @ a).asnumpy(), [[7, 10], [15, 22]])
+    onp.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    onp.testing.assert_allclose(abs(np.array([-1.0, 2.0])).asnumpy(), [1, 2])
+
+
+def test_comparison_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([2.0, 2.0, 2.0])
+    onp.testing.assert_array_equal((a < b).asnumpy(), [True, False, False])
+    onp.testing.assert_array_equal((a == b).asnumpy(), [False, True, False])
+    onp.testing.assert_array_equal((a >= b).asnumpy(), [False, True, True])
+
+
+def test_indexing():
+    a = np.arange(24).reshape(2, 3, 4)
+    assert a[1, 2, 3].item() == 23
+    onp.testing.assert_array_equal(a[0].asnumpy(),
+                                   onp.arange(12).reshape(3, 4))
+    onp.testing.assert_array_equal(a[:, 1].asnumpy(),
+                                   onp.arange(24).reshape(2, 3, 4)[:, 1])
+    onp.testing.assert_array_equal(a[..., -1].asnumpy(),
+                                   onp.arange(24).reshape(2, 3, 4)[..., -1])
+    # fancy indexing with ndarray indices
+    idx = np.array([1, 0], dtype="int32")
+    onp.testing.assert_array_equal(a[idx].asnumpy(),
+                                   onp.arange(24).reshape(2, 3, 4)[[1, 0]])
+
+
+def test_setitem():
+    a = np.zeros((3, 3))
+    a[1, 1] = 5.0
+    assert a[1, 1].item() == 5.0
+    a[0] = np.ones(3)
+    onp.testing.assert_array_equal(a[0].asnumpy(), [1, 1, 1])
+    a[:, 2] = 7
+    onp.testing.assert_array_equal(a[:, 2].asnumpy(), [7, 7, 7])
+
+
+def test_inplace_ops():
+    a = np.ones((2, 2))
+    orig = a
+    a += 1
+    assert orig is a
+    onp.testing.assert_array_equal(a.asnumpy(), [[2, 2], [2, 2]])
+    a *= 3
+    onp.testing.assert_array_equal(a.asnumpy(), [[6, 6], [6, 6]])
+
+
+def test_methods():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().item() == 10
+    assert a.mean().item() == 2.5
+    assert a.max().item() == 4
+    assert a.min().item() == 1
+    onp.testing.assert_array_equal(a.sum(axis=0).asnumpy(), [4, 6])
+    onp.testing.assert_array_equal(a.T.asnumpy(), [[1, 3], [2, 4]])
+    assert a.reshape(4).shape == (4,)
+    assert a.reshape(-1, 1).shape == (4, 1)
+    assert a.flatten().shape == (4,)
+    assert a.astype("int32").dtype == onp.int32
+    assert a.argmax().item() == 3
+
+
+def test_asnumpy_and_conversion():
+    a = np.array([1.5])
+    assert float(a) == 1.5
+    assert int(np.array([3])) == 3
+    assert bool(np.array([1]))
+    assert len(np.zeros((5, 2))) == 5
+    assert a.tolist() == [1.5]
+    assert onp.asarray(a).shape == (1,)
+
+
+def test_copy_and_context():
+    a = np.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert a.sum().item() == 4  # copy is deep
+    c = a.as_in_ctx(mx.cpu())
+    assert c.shape == (2, 2)
+    assert isinstance(a.ctx, mx.Context)
+
+
+def test_wait_and_sync():
+    a = np.ones((100, 100))
+    b = a @ a
+    b.wait_to_read()
+    mx.waitall()
+    assert b[0, 0].item() == 100
+
+
+def test_iter():
+    a = np.arange(6).reshape(3, 2)
+    rows = list(a)
+    assert len(rows) == 3
+    onp.testing.assert_array_equal(rows[1].asnumpy(), [2, 3])
+
+
+def test_detach():
+    a = np.ones((2,))
+    a.attach_grad()
+    with mx.autograd.record():
+        b = a * 2
+        c = b.detach()
+    assert c._node is None

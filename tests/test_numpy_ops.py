@@ -1,0 +1,239 @@
+"""mx.np operator coverage vs NumPy reference (reference analog:
+tests/python/unittest/test_numpy_op.py — numeric verification against
+NumPy)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+def _check(mx_fn, np_fn, *shapes, rtol=1e-5, atol=1e-6, dtype="float32",
+           positive=False):
+    rng = onp.random.RandomState(0)
+    args_np = []
+    for s in shapes:
+        a = rng.uniform(0.5 if positive else -2.0, 2.0, s).astype(dtype)
+        args_np.append(a)
+    args_mx = [np.array(a) for a in args_np]
+    out_mx = mx_fn(*args_mx)
+    out_np = np_fn(*args_np)
+    onp.testing.assert_allclose(out_mx.asnumpy(), out_np, rtol=rtol, atol=atol)
+
+
+UNARY_CASES = [
+    ("abs", None), ("sqrt", "pos"), ("square", None), ("exp", None),
+    ("log", "pos"), ("log2", "pos"), ("log10", "pos"), ("log1p", "pos"),
+    ("sin", None), ("cos", None), ("tan", None), ("tanh", None),
+    ("sinh", None), ("cosh", None), ("arctan", None), ("ceil", None),
+    ("floor", None), ("rint", None), ("sign", None), ("negative", None),
+    ("reciprocal", "pos"), ("expm1", None), ("cbrt", None),
+    ("degrees", None), ("radians", None),
+]
+
+
+@pytest.mark.parametrize("name,mode", UNARY_CASES)
+def test_unary(name, mode):
+    _check(getattr(np, name), getattr(onp, name), (3, 4),
+           positive=(mode == "pos"), rtol=1e-4, atol=1e-5)
+
+
+BINARY_CASES = ["add", "subtract", "multiply", "maximum", "minimum",
+                "arctan2", "hypot", "logaddexp", "copysign"]
+
+
+@pytest.mark.parametrize("name", BINARY_CASES)
+def test_binary(name):
+    _check(getattr(np, name), getattr(onp, name), (3, 4), (3, 4), rtol=1e-4)
+
+
+def test_divide_power():
+    _check(np.true_divide, onp.true_divide, (3, 4), (3, 4), positive=True)
+    _check(np.power, onp.power, (3, 4), (3, 4), positive=True, rtol=1e-3)
+    _check(np.mod, onp.mod, (3, 4), (3, 4), positive=True, rtol=1e-4)
+
+
+REDUCE_CASES = ["sum", "prod", "mean", "std", "var", "max", "min"]
+
+
+@pytest.mark.parametrize("name", REDUCE_CASES)
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 1)])
+def test_reductions(name, axis):
+    _check(lambda a: getattr(np, name)(a, axis=axis),
+           lambda a: getattr(onp, name)(a, axis=axis), (3, 4), rtol=1e-4)
+
+
+def test_argminmax_cumsum():
+    a = onp.random.RandomState(1).randn(4, 5).astype("float32")
+    m = np.array(a)
+    assert np.argmax(m).item() == a.argmax()
+    onp.testing.assert_array_equal(np.argmin(m, axis=1).asnumpy(),
+                                   a.argmin(axis=1))
+    onp.testing.assert_allclose(np.cumsum(m, axis=0).asnumpy(),
+                                a.cumsum(axis=0), rtol=1e-5)
+
+
+def test_shape_manipulation():
+    a = onp.arange(24, dtype="float32").reshape(2, 3, 4)
+    m = np.array(a)
+    onp.testing.assert_array_equal(np.reshape(m, (6, 4)).asnumpy(),
+                                   a.reshape(6, 4))
+    onp.testing.assert_array_equal(np.transpose(m, (2, 0, 1)).asnumpy(),
+                                   a.transpose(2, 0, 1))
+    onp.testing.assert_array_equal(np.swapaxes(m, 0, 2).asnumpy(),
+                                   a.swapaxes(0, 2))
+    onp.testing.assert_array_equal(np.moveaxis(m, 0, -1).asnumpy(),
+                                   onp.moveaxis(a, 0, -1))
+    onp.testing.assert_array_equal(np.expand_dims(m, 1).shape, (2, 1, 3, 4))
+    onp.testing.assert_array_equal(np.squeeze(np.expand_dims(m, 0)).asnumpy(), a)
+    onp.testing.assert_array_equal(np.flip(m, 1).asnumpy(), onp.flip(a, 1))
+    onp.testing.assert_array_equal(np.roll(m, 2, 1).asnumpy(), onp.roll(a, 2, 1))
+    onp.testing.assert_array_equal(np.tile(m, (1, 2, 1)).asnumpy(),
+                                   onp.tile(a, (1, 2, 1)))
+    onp.testing.assert_array_equal(np.repeat(m, 2, 0).asnumpy(),
+                                   onp.repeat(a, 2, 0))
+    onp.testing.assert_array_equal(np.broadcast_to(np.ones((1, 3)), (4, 3)).shape,
+                                   (4, 3))
+
+
+def test_concat_stack_split():
+    a = onp.ones((2, 3), "float32")
+    b = onp.zeros((2, 3), "float32")
+    ma, mb = np.array(a), np.array(b)
+    onp.testing.assert_array_equal(np.concatenate([ma, mb]).asnumpy(),
+                                   onp.concatenate([a, b]))
+    onp.testing.assert_array_equal(
+        np.concatenate([ma, mb], axis=1).asnumpy(),
+        onp.concatenate([a, b], axis=1))
+    onp.testing.assert_array_equal(np.stack([ma, mb]).asnumpy(),
+                                   onp.stack([a, b]))
+    onp.testing.assert_array_equal(np.vstack([ma, mb]).asnumpy(),
+                                   onp.vstack([a, b]))
+    onp.testing.assert_array_equal(np.hstack([ma, mb]).asnumpy(),
+                                   onp.hstack([a, b]))
+    parts = np.split(np.array(onp.arange(12.0)), 3)
+    assert len(parts) == 3
+    onp.testing.assert_array_equal(parts[1].asnumpy(), [4, 5, 6, 7])
+
+
+def test_linalg_family():
+    rng = onp.random.RandomState(0)
+    a = rng.randn(4, 4).astype("float32")
+    spd = a @ a.T + 4 * onp.eye(4, dtype="float32")
+    m = np.array(spd)
+    onp.testing.assert_allclose(np.linalg.det(m).item(),
+                                onp.linalg.det(spd), rtol=1e-3)
+    onp.testing.assert_allclose(np.linalg.inv(m).asnumpy(),
+                                onp.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    L = np.linalg.cholesky(m).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    b = rng.randn(4).astype("float32")
+    onp.testing.assert_allclose(
+        np.linalg.solve(m, np.array(b)).asnumpy(),
+        onp.linalg.solve(spd, b), rtol=1e-3, atol=1e-4)
+    onp.testing.assert_allclose(np.linalg.norm(m).item(),
+                                onp.linalg.norm(spd), rtol=1e-5)
+    u, s, v = np.linalg.svd(np.array(a))
+    onp.testing.assert_allclose(
+        (u.asnumpy() * s.asnumpy()) @ v.asnumpy(), a, rtol=1e-3, atol=1e-4)
+
+
+def test_einsum_dot():
+    rng = onp.random.RandomState(0)
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(4, 5).astype("float32")
+    onp.testing.assert_allclose(
+        np.einsum("ij,jk->ik", np.array(a), np.array(b)).asnumpy(),
+        onp.einsum("ij,jk->ik", a, b), rtol=1e-4)
+    onp.testing.assert_allclose(np.dot(np.array(a), np.array(b)).asnumpy(),
+                                a @ b, rtol=1e-4)
+    onp.testing.assert_allclose(
+        np.tensordot(np.array(a), np.array(b), axes=([1], [0])).asnumpy(),
+        onp.tensordot(a, b, axes=([1], [0])), rtol=1e-4)
+
+
+def test_where_clip_round():
+    a = onp.array([[-1.0, 2.0], [3.0, -4.0]], dtype="float32")
+    m = np.array(a)
+    onp.testing.assert_array_equal(
+        np.where(m > 0, m, np.zeros_like(m)).asnumpy(),
+        onp.where(a > 0, a, 0))
+    onp.testing.assert_array_equal(np.clip(m, -1, 1).asnumpy(),
+                                   a.clip(-1, 1))
+    onp.testing.assert_array_equal(np.round(m * 0.6).asnumpy(),
+                                   onp.round(a * 0.6))
+
+
+def test_sort_unique_searchsorted():
+    a = onp.array([3.0, 1.0, 2.0, 1.0], dtype="float32")
+    m = np.array(a)
+    onp.testing.assert_array_equal(np.sort(m).asnumpy(), onp.sort(a))
+    onp.testing.assert_array_equal(np.argsort(m).asnumpy(), onp.argsort(a))
+    u = np.unique(m)
+    onp.testing.assert_array_equal(u.asnumpy(), [1, 2, 3])
+    onp.testing.assert_array_equal(
+        np.searchsorted(np.array([1.0, 2.0, 3.0]), np.array([2.5])).asnumpy(),
+        [2])
+
+
+def test_creation_dtypes_and_constants():
+    assert np.pi == onp.pi
+    assert np.float32 is onp.float32
+    # TPU-native deviation: 64-bit ints truncate to int32 (the TPU ALU
+    # width); reference uses int64 indices on CPU/GPU.
+    z = np.zeros((2,), dtype=np.int32)
+    assert z.dtype == onp.int32
+    assert np.finfo(np.float32).eps == onp.finfo(onp.float32).eps
+
+
+def test_random_distributions_shapes():
+    assert np.random.uniform(0, 1, size=(3, 4)).shape == (3, 4)
+    assert np.random.normal(0, 1, size=5).shape == (5,)
+    assert np.random.randint(0, 10, size=(2, 2)).dtype == onp.int32
+    assert np.random.gamma(2.0, 1.0, size=(4,)).shape == (4,)
+    assert np.random.beta(2.0, 3.0, size=(4,)).shape == (4,)
+    assert np.random.exponential(1.0, size=(4,)).shape == (4,)
+    assert np.random.poisson(3.0, size=(4,)).shape == (4,)
+    assert np.random.choice(10, size=(3,)).shape == (3,)
+    assert np.random.laplace(size=(2, 2)).shape == (2, 2)
+    assert np.random.gumbel(size=(2,)).shape == (2,)
+    assert np.random.chisquare(3.0, size=(2,)).shape == (2,)
+
+
+def test_random_determinism():
+    mx.random.seed(42)
+    a = np.random.uniform(size=(4,)).asnumpy()
+    mx.random.seed(42)
+    b = np.random.uniform(size=(4,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+    c = np.random.uniform(size=(4,)).asnumpy()
+    assert not onp.array_equal(b, c)
+
+
+def test_random_moments():
+    mx.random.seed(0)
+    x = np.random.normal(2.0, 3.0, size=(20000,)).asnumpy()
+    assert abs(x.mean() - 2.0) < 0.1
+    assert abs(x.std() - 3.0) < 0.1
+    u = np.random.uniform(1.0, 5.0, size=(20000,)).asnumpy()
+    assert abs(u.mean() - 3.0) < 0.05
+    assert u.min() >= 1.0 and u.max() <= 5.0
+
+
+def test_histogram_bincount():
+    a = onp.array([0.5, 1.5, 1.6, 2.5], dtype="float32")
+    h, edges = np.histogram(np.array(a), bins=3, range=(0, 3))
+    onp.testing.assert_array_equal(h.asnumpy(), [1, 2, 1])
+    b = np.bincount(np.array([0, 1, 1, 2], dtype="int32"))
+    onp.testing.assert_array_equal(b.asnumpy(), [1, 2, 1])
+
+
+def test_diff_interp_trace():
+    a = onp.array([1.0, 3.0, 6.0], dtype="float32")
+    onp.testing.assert_array_equal(np.diff(np.array(a)).asnumpy(),
+                                   onp.diff(a))
+    onp.testing.assert_allclose(
+        np.interp(np.array([1.5]), np.array([1.0, 2.0]),
+                  np.array([10.0, 20.0])).asnumpy(), [15.0])
+    m = onp.arange(9.0, dtype="float32").reshape(3, 3)
+    assert np.trace(np.array(m)).item() == onp.trace(m)
