@@ -69,7 +69,8 @@ class Initializer:
 
     def dumps(self):
         import json
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs],
+                          default=lambda o: repr(o))
 
     def __repr__(self):
         return "%s(%r)" % (self.__class__.__name__, self._kwargs)

@@ -201,8 +201,10 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
 def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
                   pad=None, adj=None, num_filter=None, num_group=1,
                   no_bias=False, layout=None, target_shape=None):
-    """Transposed convolution (src/operator/nn/deconvolution.cc) as the
-    gradient of convolution: lax.conv_transpose with IO weight layout."""
+    """Transposed convolution (src/operator/nn/deconvolution.cc) expressed
+    as the gradient of convolution: lhs-dilated conv_general_dilated with
+    the kernel spatially flipped and channel dims swapped.  Weight layout
+    matches the reference: (in_channels, channels//groups, *k)."""
     ndim = x.ndim - 2
     stride = _tup(stride or 1, ndim)
     dilate = _tup(dilate or 1, ndim)
@@ -210,28 +212,47 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     adj = _tup(adj or 0, ndim)
     if layout is None:
         layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
-    spatial = layout[2:]
-    dn = lax.conv_dimension_numbers(
-        x.shape, weight.shape, (layout, "IO" + spatial, layout))
-    # MXNet output size: out = (in-1)*s - 2p + dilate*(k-1) + adj + 1.
-    # Express as conv_transpose with per-dim (lo, hi) padding.
+    channels_first = layout.startswith("NC")
+    spatial = layout[2:] if channels_first else layout[1:-1]
+    sp_axes = tuple(range(2, 2 + ndim)) if channels_first \
+        else tuple(range(1, 1 + ndim))
     k = weight.shape[2:]
+    in_c = weight.shape[0]
+    out_per_g = weight.shape[1]
+    g = num_group
+    # (in, out/g, *k) -> (g, in/g, out/g, *k) -> (g, out/g, in/g, *k)
+    # -> (out_total, in/g, *k), with spatial flip
+    w = weight.reshape((g, in_c // g, out_per_g) + k)
+    w = jnp.swapaxes(w, 1, 2).reshape((g * out_per_g, in_c // g) + k)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + ndim)))
+    if target_shape is not None:
+        # reference semantics: target_shape overrides padding —
+        # p = ((in-1)*s + eff_k + adj - target) / 2 per spatial dim
+        target_shape = _tup(target_shape, ndim)
+        pad = tuple(
+            ((x.shape[ax] - 1) * stride[i]
+             + dilate[i] * (k[i] - 1) + 1 + adj[i] - target_shape[i]) // 2
+            for i, ax in enumerate(sp_axes))
     pads = []
     for i in range(ndim):
         eff_k = dilate[i] * (k[i] - 1) + 1
         lo = eff_k - 1 - pad[i]
         hi = eff_k - 1 - pad[i] + adj[i]
         pads.append((lo, hi))
-    out = lax.conv_transpose(
-        x, weight, strides=stride,
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    (layout, "OI" + spatial, layout))
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=(1,) * ndim,
         padding=pads,
+        lhs_dilation=stride,
         rhs_dilation=dilate,
         dimension_numbers=dn,
-        transpose_kernel=True,
+        feature_group_count=g,
     )
     if bias is not None and not no_bias:
         bshape = [1] * out.ndim
-        bshape[1] = bias.shape[0]
+        bshape[1 if layout.startswith("NC") else -1] = bias.shape[0]
         out = out + bias.reshape(bshape)
     return out
 

@@ -111,7 +111,7 @@ class DataParallelTrainer:
     """
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, train=True):
+                 mesh=None, train=True, param_pspec=None, data_axis=None):
         from .. import optimizer as opt_mod
         self.net = net
         self.loss_fn = loss_fn
@@ -122,31 +122,58 @@ class DataParallelTrainer:
         self.train = train
         self._step = None
         self._fn, self._params = functionalize(net, train=train)
+        # param_pspec(name, shape) -> PartitionSpec for tensor parallelism
+        # (reference has no TP; this is the GSPMD extension slot, SURVEY §5.7)
+        self.param_pspec = param_pspec or (lambda name, shape: P())
+        self.data_axis = data_axis or self.mesh.axis_names[0]
         # optimizer state as pure pytree (fp32 slots like the reference's
         # create_state)
         self._opt_kind, self._hp = self._opt_signature(opt)
 
     def _opt_signature(self, opt):
         from .. import optimizer as opt_mod
+        common = dict(wd=opt.wd,
+                      clip_gradient=opt.clip_gradient or 0.0,
+                      rescale_grad=opt.rescale_grad)
         if isinstance(opt, opt_mod.SGD):
             return ("sgd_mom" if opt.momentum else "sgd",
-                    dict(momentum=getattr(opt, "momentum", 0.0), wd=opt.wd))
-        if isinstance(opt, opt_mod.Adam):
+                    dict(momentum=getattr(opt, "momentum", 0.0), **common))
+        if type(opt) is opt_mod.AdamW:
+            return ("adamw", dict(beta1=opt.beta1, beta2=opt.beta2,
+                                  epsilon=opt.epsilon, **common))
+        if type(opt) is opt_mod.Adam:
             return ("adam", dict(beta1=opt.beta1, beta2=opt.beta2,
-                                 epsilon=opt.epsilon, wd=opt.wd))
+                                 epsilon=opt.epsilon, **common))
         raise NotImplementedError(
-            "DataParallelTrainer supports sgd/adam fused steps; got %r"
+            "DataParallelTrainer supports sgd/sgd_mom/adam/adamw fused "
+            "steps; got %r (use gluon.Trainer for the others)"
             % type(opt).__name__)
 
+    def _param_sharding(self, name, shape):
+        return NamedSharding(self.mesh, self.param_pspec(name, shape))
+
     def init_state(self):
-        pvals = {k: p._data._data for k, p in self._params.items()}
+        """Build the (sharded) training state: params placed per
+        param_pspec (GSPMD lays out TP shards), fp32 optimizer slots
+        co-sharded with their parameter."""
+        pvals = {}
+        for k, p in self._params.items():
+            v = p._data._data
+            pvals[k] = jax.device_put(v, self._param_sharding(k, v.shape))
+        trainable = [k for k, p in self._params.items()
+                     if p.grad_req != "null"]
         if self._opt_kind == "sgd":
             slots = {}
         elif self._opt_kind == "sgd_mom":
-            slots = {k: jnp.zeros(v.shape, jnp.float32) for k, v in pvals.items()}
-        else:  # adam
-            slots = {k: (jnp.zeros(v.shape, jnp.float32),
-                         jnp.zeros(v.shape, jnp.float32)) for k, v in pvals.items()}
+            slots = {k: jax.device_put(jnp.zeros(pvals[k].shape, jnp.float32),
+                                       self._param_sharding(k, pvals[k].shape))
+                     for k in trainable}
+        else:  # adam/adamw
+            slots = {k: (jax.device_put(jnp.zeros(pvals[k].shape, jnp.float32),
+                                        self._param_sharding(k, pvals[k].shape)),
+                         jax.device_put(jnp.zeros(pvals[k].shape, jnp.float32),
+                                        self._param_sharding(k, pvals[k].shape)))
+                     for k in trainable}
         return {"params": pvals, "slots": slots, "t": jnp.zeros((), jnp.int32)}
 
     def build_step(self, donate=True):
@@ -179,17 +206,23 @@ class DataParallelTrainer:
             t = state["t"] + 1
             new_params = dict(pvals)
             new_slots = dict(state["slots"])
+            clip = hp.get("clip_gradient", 0.0)
+            rescale = hp.get("rescale_grad", 1.0)
+            wd = hp.get("wd", 0.0)
             for k in grad_names:
-                g = grads[k].astype(jnp.float32)
+                g = grads[k].astype(jnp.float32) * rescale
+                if clip and clip > 0:
+                    g = jnp.clip(g, -clip, clip)
                 w = pvals[k].astype(jnp.float32)
-                g = g + hp.get("wd", 0.0) * w
+                if kind != "adamw":
+                    g = g + wd * w
                 if kind == "sgd":
                     new_w = w - lr * g
                 elif kind == "sgd_mom":
                     m = hp["momentum"] * new_slots[k] - lr * g
                     new_slots[k] = m
                     new_w = w + m
-                else:  # adam w/ bias correction in lr
+                else:  # adam/adamw w/ bias correction in lr
                     b1, b2, eps = hp["beta1"], hp["beta2"], hp["epsilon"]
                     m, v = new_slots[k]
                     m = b1 * m + (1 - b1) * g
@@ -198,20 +231,34 @@ class DataParallelTrainer:
                     lr_t = lr * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
                     new_slots[k] = (m, v)
                     new_w = w - lr_t * m / (jnp.sqrt(v) + eps)
+                    if kind == "adamw":
+                        new_w = new_w - lr * wd * w
                 new_params[k] = new_w.astype(pvals[k].dtype)
             for k, v in aux.items():
                 new_params[k] = v
             return {"params": new_params, "slots": new_slots, "t": t}, loss_val
 
         mesh = self.mesh
-        axis = mesh.axis_names[0]
         repl = NamedSharding(mesh, P())
-        data_sh = NamedSharding(mesh, P(axis))
+        data_sh = NamedSharding(mesh, P(self.data_axis))
+
+        pvals = {k: p._data._data for k, p in self._params.items()}
+        param_sh = {k: self._param_sharding(k, v.shape)
+                    for k, v in pvals.items()}
+        trainable = [k for k, p in self._params.items()
+                     if p.grad_req != "null"]
+        if self._opt_kind == "sgd":
+            slot_sh = {}
+        elif self._opt_kind == "sgd_mom":
+            slot_sh = {k: param_sh[k] for k in trainable}
+        else:
+            slot_sh = {k: (param_sh[k], param_sh[k]) for k in trainable}
+        state_sh = {"params": param_sh, "slots": slot_sh, "t": repl}
 
         self._step = jax.jit(
             step,
-            in_shardings=(repl, data_sh, data_sh, repl, repl),
-            out_shardings=(repl, repl),
+            in_shardings=(state_sh, data_sh, data_sh, repl, repl),
+            out_shardings=(state_sh, repl),
             donate_argnums=(0,) if donate else (),
         )
         return self._step

@@ -1,0 +1,310 @@
+"""Gluon layer tests + the imperative-vs-hybridized consistency oracle
+(reference analog: tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+def check_layer(layer, in_shape, eval_mode=True, rtol=1e-4, atol=1e-5):
+    """The hybridize-consistency oracle: same outputs eager vs compiled."""
+    mx.random.seed(0)
+    layer.initialize()
+    x = np.random.uniform(-1, 1, size=in_shape)
+    if eval_mode:
+        eager = layer(x).asnumpy()
+        layer.hybridize()
+        hybrid = layer(x).asnumpy()
+        onp.testing.assert_allclose(eager, hybrid, rtol=rtol, atol=atol)
+        return eager
+    return layer(x).asnumpy()
+
+
+def test_dense():
+    out = check_layer(nn.Dense(8), (4, 6))
+    assert out.shape == (4, 8)
+    out = check_layer(nn.Dense(8, activation="relu", flatten=False), (2, 3, 6))
+    assert out.shape == (2, 3, 8)
+    assert (out >= 0).all()
+    out = check_layer(nn.Dense(5, use_bias=False), (4, 6))
+    assert out.shape == (4, 5)
+
+
+def test_dense_vs_numpy():
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = np.random.uniform(size=(2, 4))
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    expect = x.asnumpy() @ w.T + b
+    onp.testing.assert_allclose(net(x).asnumpy(), expect, rtol=1e-5)
+
+
+@pytest.mark.parametrize("layer_fn,shape", [
+    (lambda: nn.Conv1D(4, 3), (2, 3, 10)),
+    (lambda: nn.Conv2D(4, 3), (2, 3, 10, 10)),
+    (lambda: nn.Conv2D(4, 3, strides=2, padding=1), (2, 3, 10, 10)),
+    (lambda: nn.Conv2D(4, 3, dilation=2), (2, 3, 12, 12)),
+    (lambda: nn.Conv2D(4, 3, groups=2), (2, 4, 8, 8)),
+    (lambda: nn.Conv3D(4, 3), (2, 3, 6, 6, 6)),
+    (lambda: nn.Conv2DTranspose(4, 3), (2, 3, 8, 8)),
+    (lambda: nn.Conv2DTranspose(4, 3, strides=2), (2, 3, 8, 8)),
+])
+def test_conv_layers(layer_fn, shape):
+    check_layer(layer_fn(), shape)
+
+
+def test_conv2d_vs_numpy():
+    """Convolution numerical check vs explicit loop."""
+    net = nn.Conv2D(2, kernel_size=2, in_channels=1, use_bias=False)
+    net.initialize()
+    x = np.random.uniform(size=(1, 1, 4, 4))
+    w = net.weight.data().asnumpy()
+    xn = x.asnumpy()
+    out = net(x).asnumpy()
+    expect = onp.zeros((1, 2, 3, 3), "float32")
+    for oc in range(2):
+        for i in range(3):
+            for j in range(3):
+                expect[0, oc, i, j] = (xn[0, 0, i:i + 2, j:j + 2]
+                                       * w[oc, 0]).sum()
+    onp.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2dtranspose_shape():
+    # MXNet: out = (in-1)*s - 2p + k + adj
+    net = nn.Conv2DTranspose(3, kernel_size=4, strides=2, padding=1,
+                             output_padding=0)
+    net.initialize()
+    out = net(np.zeros((1, 2, 8, 8)))
+    assert out.shape == (1, 3, 16, 16)
+
+
+@pytest.mark.parametrize("layer_fn,shape,out_shape", [
+    (lambda: nn.MaxPool2D(2), (1, 2, 8, 8), (1, 2, 4, 4)),
+    (lambda: nn.MaxPool2D(3, 2, 1), (1, 2, 8, 8), (1, 2, 4, 4)),
+    (lambda: nn.AvgPool2D(2), (1, 2, 8, 8), (1, 2, 4, 4)),
+    (lambda: nn.MaxPool1D(2), (1, 2, 8), (1, 2, 4)),
+    (lambda: nn.AvgPool3D(2), (1, 2, 4, 4, 4), (1, 2, 2, 2, 2)),
+    (lambda: nn.GlobalAvgPool2D(), (1, 2, 8, 8), (1, 2, 1, 1)),
+    (lambda: nn.GlobalMaxPool2D(), (1, 2, 8, 8), (1, 2, 1, 1)),
+])
+def test_pool_layers(layer_fn, shape, out_shape):
+    out = check_layer(layer_fn(), shape)
+    assert out.shape == out_shape
+
+
+def test_pool_values():
+    x = np.array(onp.arange(16.0, dtype="float32").reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2)
+    onp.testing.assert_array_equal(mp(x).asnumpy().ravel(), [5, 7, 13, 15])
+    ap = nn.AvgPool2D(2)
+    onp.testing.assert_allclose(ap(x).asnumpy().ravel(), [2.5, 4.5, 10.5, 12.5])
+
+
+def test_pool_ceil_mode():
+    x = np.zeros((1, 1, 5, 5))
+    out = nn.MaxPool2D(2, strides=2, ceil_mode=True)(x)
+    assert out.shape == (1, 1, 3, 3)
+    out = nn.MaxPool2D(2, strides=2, ceil_mode=False)(x)
+    assert out.shape == (1, 1, 2, 2)
+
+
+def test_batchnorm_train_inference():
+    net = nn.BatchNorm()
+    net.initialize()
+    x = np.random.normal(3.0, 2.0, size=(16, 4, 5, 5))
+    # training: output should be ~normalized
+    with autograd.record():
+        out = net(x)
+    o = out.asnumpy()
+    assert abs(o.mean()) < 0.1
+    assert abs(o.std() - 1.0) < 0.1
+    # running stats moved toward batch stats
+    rm = net.running_mean.data().asnumpy()
+    assert abs(rm.mean() - 0.3) < 0.15  # momentum 0.9: 0.1 * ~3.0
+    # inference uses running stats (deterministic)
+    out1 = net(x).asnumpy()
+    out2 = net(x).asnumpy()
+    onp.testing.assert_array_equal(out1, out2)
+
+
+def test_layernorm_groupnorm_instancenorm():
+    out = check_layer(nn.LayerNorm(), (4, 10))
+    assert abs(out.mean()) < 1e-5
+    assert abs(out.std() - 1.0) < 0.05
+    check_layer(nn.GroupNorm(num_groups=2), (2, 4, 5, 5))
+    check_layer(nn.InstanceNorm(), (2, 4, 5, 5))
+
+
+def test_embedding():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    idx = np.array([[1, 2], [3, 9]], dtype="int32")
+    out = net(idx)
+    assert out.shape == (2, 2, 4)
+    w = net.weight.data().asnumpy()
+    onp.testing.assert_allclose(out.asnumpy()[0, 0], w[1], rtol=1e-6)
+
+
+def test_embedding_grad_accumulates():
+    net = nn.Embedding(5, 3)
+    net.initialize()
+    idx = np.array([0, 0, 1], dtype="int32")
+    with autograd.record():
+        out = net(idx).sum()
+    out.backward()
+    g = net.weight.grad().asnumpy()
+    onp.testing.assert_allclose(g[0], [2, 2, 2], rtol=1e-6)  # row 0 used twice
+    onp.testing.assert_allclose(g[1], [1, 1, 1], rtol=1e-6)
+    onp.testing.assert_allclose(g[2], [0, 0, 0], rtol=1e-6)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu",
+                                 "softsign", "gelu", "silu", "mish"])
+def test_activations(act):
+    check_layer(nn.Activation(act), (2, 5))
+
+
+def test_activation_classes():
+    check_layer(nn.LeakyReLU(0.1), (2, 5))
+    check_layer(nn.ELU(), (2, 5))
+    check_layer(nn.SELU(), (2, 5))
+    check_layer(nn.GELU(), (2, 5))
+    check_layer(nn.Swish(), (2, 5))
+    check_layer(nn.SiLU(), (2, 5))
+    check_layer(nn.PReLU(), (2, 5))
+
+
+def test_sequential_containers():
+    for cls in (nn.Sequential, nn.HybridSequential):
+        net = cls()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+        assert len(net) == 2
+        net.initialize()
+        out = net(np.ones((2, 6)))
+        assert out.shape == (2, 4)
+        assert isinstance(net[0], nn.Dense)
+
+
+def test_flatten_identity_lambda():
+    assert check_layer(nn.Flatten(), (2, 3, 4)).shape == (2, 12)
+    assert check_layer(nn.Identity(), (2, 3)).shape == (2, 3)
+    lam = nn.HybridLambda(lambda x: x * 2)
+    out = lam(np.ones((2, 2)))
+    onp.testing.assert_array_equal(out.asnumpy(), 2 * onp.ones((2, 2)))
+
+
+def test_dropout_modes():
+    net = nn.Dropout(0.5)
+    x = np.ones((10, 10))
+    out = net(x)  # inference: identity
+    onp.testing.assert_array_equal(out.asnumpy(), onp.ones((10, 10)))
+    with autograd.train_mode():
+        out = net(x).asnumpy()
+    assert (out == 0).any()
+    kept = out[out != 0]
+    onp.testing.assert_allclose(kept, 2.0 * onp.ones_like(kept), rtol=1e-6)
+
+
+def test_collect_params_naming():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    params = net.collect_params()
+    names = list(params)
+    assert any("0.weight" in n for n in names)
+    assert any("1.bias" in n for n in names)
+    sel = net.collect_params(".*weight")
+    assert all("weight" in n for n in sel)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    f = str(tmp_path / "p.npz")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.initialize()
+    net2.load_parameters(f)
+    x = np.random.uniform(size=(2, 3))
+    onp.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
+
+
+def test_deferred_init_then_train():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize()
+    # shapes unknown until first forward
+    assert net[0].weight._data is None
+    out = net(np.ones((2, 7)))
+    assert net[0].weight.shape == (4, 7)
+    assert out.shape == (2, 2)
+
+
+def test_shared_parameter_grads_sum():
+    d = nn.Dense(3, in_units=3)
+    d.initialize()
+    x = np.ones((1, 3))
+    with autograd.record():
+        y = d(d(x)).sum()
+    y.backward()
+    g = d.weight.grad().asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_hybridize_training_consistency():
+    """Eager and hybridized nets starting from identical params converge
+    identically under SGD (the strongest §4 oracle)."""
+    def build():
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        net(np.ones((2, 8)))  # init shapes
+        return net
+
+    x = np.random.uniform(size=(8, 8))
+    y = np.random.randint(0, 4, size=(8,))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    results = []
+    for hybrid in (False, True):
+        net = build()
+        if hybrid:
+            net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        losses = []
+        for _ in range(5):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+            losses.append(float(loss.mean()))
+        results.append(losses)
+    onp.testing.assert_allclose(results[0], results[1], rtol=1e-4, atol=1e-5)
+
+
+def test_constant_param():
+    c = gluon.Constant(np.array([1.0, 2.0]))
+    c.initialize()
+    onp.testing.assert_array_equal(c.data().asnumpy(), [1, 2])
+
+
+def test_cast_dtype():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == onp.float16
+
+
+def test_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net.summary(np.ones((1, 3)))
+    out = capsys.readouterr().out
+    assert "Total params" in out

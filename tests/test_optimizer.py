@@ -1,0 +1,120 @@
+"""Optimizer tests vs NumPy reference updates (reference analog:
+tests/python/unittest/test_optimizer.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, optimizer as opt
+
+
+def _setup(shape=(4, 3), seed=0):
+    rng = onp.random.RandomState(seed)
+    w = rng.randn(*shape).astype("float32")
+    g = rng.randn(*shape).astype("float32")
+    weight = np.array(w)
+    weight.attach_grad()
+    weight._grad = np.array(g)
+    return weight, w, g
+
+
+def test_sgd_matches_numpy():
+    weight, w, g = _setup()
+    o = opt.SGD(learning_rate=0.1, wd=0.01)
+    state = o.create_state(0, weight)
+    o.update(0, weight, weight._grad, state)
+    expect = w - 0.1 * (g + 0.01 * w)
+    onp.testing.assert_allclose(weight.asnumpy(), expect, rtol=1e-5)
+
+
+def test_sgd_momentum_matches_numpy():
+    weight, w, g = _setup()
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    state = o.create_state(0, weight)
+    mom = onp.zeros_like(w)
+    for _ in range(3):
+        o.update(0, weight, weight._grad, state)
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    onp.testing.assert_allclose(weight.asnumpy(), w, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    weight, w, g = _setup()
+    o = opt.Adam(learning_rate=0.01)
+    state = o.create_state(0, weight)
+    m = onp.zeros_like(w)
+    v = onp.zeros_like(w)
+    for t in range(1, 4):
+        o.update(0, weight, weight._grad, state)
+        lr_t = 0.01 * (1 - 0.999 ** t) ** 0.5 / (1 - 0.9 ** t)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        w = w - lr_t * m / (onp.sqrt(v) + 1e-8)
+    onp.testing.assert_allclose(weight.asnumpy(), w, rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop_decreases_loss():
+    for name in ["rmsprop", "adagrad", "adadelta", "ftrl", "signum", "nag",
+                 "lamb", "lars", "adamw", "adabelief", "adamax", "nadam"]:
+        o = opt.create(name)
+        w = np.array([5.0])
+        w.attach_grad()
+        state = o.create_state(0, w)
+        for _ in range(10):
+            w._grad = 2 * w.detach()  # grad of w^2
+            o.update(0, w, w._grad, state)
+        assert abs(float(w)) < 5.0, "%s failed to reduce |w|" % name
+
+
+def test_lr_scheduler_trainer():
+    from mxnet_tpu import lr_scheduler
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = np.array([1.0])
+    w.attach_grad()
+    w._grad = np.array([0.0])
+    lrs = []
+    for _ in range(6):
+        o.update(0, w, w._grad, None)
+        lrs.append(o.learning_rate)
+    assert lrs[-1] < lrs[0]
+
+
+def test_multi_precision_fp16():
+    w16 = np.array(onp.ones((3,), "float16"))
+    w16.attach_grad()
+    w16._grad = np.array(onp.full((3,), 1e-4, "float16"))
+    o = opt.SGD(learning_rate=1.0, multi_precision=True)
+    state = o.create_state_multi_precision(0, w16)
+    assert isinstance(state, tuple)  # (fp32 master, inner)
+    for _ in range(10):
+        o.update_multi_precision(0, w16, w16._grad, state)
+    master = state[0].asnumpy()
+    # fp32 master accumulated 10 * 1e-4 (would be lost at fp16 resolution)
+    onp.testing.assert_allclose(master, 1.0 - 10e-4 * onp.ones(3), rtol=1e-4)
+
+
+def test_updater_roundtrip():
+    o = opt.Adam(learning_rate=0.01)
+    up = opt.get_updater(o)
+    w = np.array([1.0, 2.0])
+    g = np.array([0.1, 0.1])
+    up(0, g, w)
+    states = up.get_states()
+    up2 = opt.get_updater(opt.Adam(learning_rate=0.01))
+    up2.set_states(states)
+    assert 0 in up2.states
+
+
+def test_lr_schedulers():
+    from mxnet_tpu import lr_scheduler as lrs
+    s = lrs.MultiFactorScheduler(step=[3, 6], factor=0.1, base_lr=1.0)
+    vals = [s(i) for i in range(1, 9)]
+    assert vals[0] == 1.0 and abs(vals[-1] - 0.01) < 1e-9
+    p = lrs.PolyScheduler(max_update=10, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0 and p(10) == 0.0
+    c = lrs.CosineScheduler(max_update=10, base_lr=1.0)
+    assert abs(c(10)) < 1e-9
+    wu = lrs.FactorScheduler(step=100, base_lr=1.0, warmup_steps=5,
+                             warmup_begin_lr=0.1)
+    assert wu(1) < 1.0
