@@ -1,8 +1,10 @@
 """gluon.rnn fused layers (parity: python/mxnet/gluon/rnn/rnn_layer.py —
 RNN/LSTM/GRU backed by the fused rnn op `src/operator/rnn.cc`).
 
-TPU-native: the fused op is a lax.scan over precomputed input projections
-(ops/rnn.py); the whole stacked/bidirectional network compiles to one XLA
+TPU-native: per-layer i2h/h2h Parameters (so initializers see proper 2-D
+shapes, like the reference's {l0..}_{i2h,h2h}_{weight,bias}) are packed
+into the fused kernel's flat vector at forward; the time loop is one
+lax.scan per layer/direction (ops/rnn.py), whole net compiles to one XLA
 program under hybridize()."""
 from __future__ import annotations
 
@@ -10,11 +12,12 @@ import numpy as onp
 
 from ... import numpy as np_mod
 from ... import numpy_extension as npx
-from ...ops.rnn import param_size
 from ..block import HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
 
 class _RNNLayer(HybridBlock):
@@ -32,35 +35,73 @@ class _RNNLayer(HybridBlock):
         self._dir = 2 if bidirectional else 1
         self._input_size = input_size
         self._dtype = dtype
-        # single flattened parameter vector, matching the reference rnn op
-        shape = (param_size(mode, input_size, hidden_size, num_layers,
-                            bidirectional),) if input_size else (0,)
-        self.rnn_param = Parameter("rnn_param", shape=shape, dtype=dtype,
-                                   allow_deferred_init=True)
+        ng = _GATES[mode]
+        from ..nn.basic_layers import _zeros_init
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * self._dir
+            for d in range(self._dir):
+                suffix = "_l%d%s" % (layer, "_r" if d else "")
+                setattr(self, "i2h_weight" + suffix, Parameter(
+                    "i2h_weight" + suffix,
+                    shape=(ng * hidden_size, in_sz if in_sz else 0),
+                    dtype=dtype, init=i2h_weight_initializer,
+                    allow_deferred_init=True))
+                setattr(self, "h2h_weight" + suffix, Parameter(
+                    "h2h_weight" + suffix,
+                    shape=(ng * hidden_size, hidden_size), dtype=dtype,
+                    init=h2h_weight_initializer))
+                setattr(self, "i2h_bias" + suffix, Parameter(
+                    "i2h_bias" + suffix, shape=(ng * hidden_size,),
+                    dtype=dtype, init=_zeros_init(i2h_bias_initializer)))
+                setattr(self, "h2h_bias" + suffix, Parameter(
+                    "h2h_bias" + suffix, shape=(ng * hidden_size,),
+                    dtype=dtype, init=_zeros_init(h2h_bias_initializer)))
+
+    def _suffixes(self):
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                yield "_l%d%s" % (layer, "_r" if d else "")
 
     def infer_shape(self, x, *a):
         in_size = x.shape[-1]
         self._input_size = in_size
-        self.rnn_param.shape_and_init(
-            (param_size(self._mode, in_size, self._hidden_size,
-                        self._num_layers, self._dir == 2),))
+        ng = _GATES[self._mode]
+        for layer in range(self._num_layers):
+            in_sz = in_size if layer == 0 else self._hidden_size * self._dir
+            for d in range(self._dir):
+                suffix = "_l%d%s" % (layer, "_r" if d else "")
+                getattr(self, "i2h_weight" + suffix).shape_and_init(
+                    (ng * self._hidden_size, in_sz))
+
+    def _flat_params(self):
+        """Pack per-layer params into the fused kernel's flat layout:
+        all weights (layer-major, direction-minor), then all biases
+        (rnn-inl.h layout)."""
+        chunks = []
+        for suffix in self._suffixes():
+            chunks.append(getattr(self, "i2h_weight" + suffix).data().reshape(-1))
+            chunks.append(getattr(self, "h2h_weight" + suffix).data().reshape(-1))
+        for suffix in self._suffixes():
+            chunks.append(getattr(self, "i2h_bias" + suffix).data())
+            chunks.append(getattr(self, "h2h_bias" + suffix).data())
+        return np_mod.concatenate(chunks, axis=0)
 
     def state_info(self, batch_size=0):
         raise NotImplementedError
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
-        from ... import numpy as mxnp
         states = []
         n = self._num_layers * self._dir
         shapes = [(n, batch_size, self._hidden_size)]
         if self._mode == "lstm":
             shapes.append((n, batch_size, self._hidden_size))
         for s in shapes:
-            states.append(mxnp.zeros(s, dtype=self._dtype))
+            states.append(np_mod.zeros(s, dtype=self._dtype))
         return states
 
     def forward(self, x, states=None):
-        if self.rnn_param._data is None:
+        first = getattr(self, "i2h_weight_l0")
+        if first._data is None:
             self.infer_shape(x)
         if self._layout == "NTC":
             x = x.swapaxes(0, 1)
@@ -70,22 +111,21 @@ class _RNNLayer(HybridBlock):
             states = self.begin_state(batch)
         elif not isinstance(states, (list, tuple)):
             states = [states]
+        params = self._flat_params()
         if self._mode == "lstm":
-            out = npx.rnn(data=x, parameters=self.rnn_param.data(),
-                          state=states[0], state_cell=states[1],
-                          mode=self._mode, state_size=self._hidden_size,
-                          num_layers=self._num_layers,
-                          bidirectional=self._dir == 2, p=self._dropout,
-                          state_outputs=True)
-            out, hT, cT = out
+            out, hT, cT = npx.rnn(
+                data=x, parameters=params, state=states[0],
+                state_cell=states[1], mode=self._mode,
+                state_size=self._hidden_size, num_layers=self._num_layers,
+                bidirectional=self._dir == 2, p=self._dropout,
+                state_outputs=True)
             new_states = [hT, cT]
         else:
-            out, hT = npx.rnn(data=x, parameters=self.rnn_param.data(),
-                              state=states[0], mode=self._mode,
-                              state_size=self._hidden_size,
-                              num_layers=self._num_layers,
-                              bidirectional=self._dir == 2, p=self._dropout,
-                              state_outputs=True)
+            out, hT = npx.rnn(
+                data=x, parameters=params, state=states[0], mode=self._mode,
+                state_size=self._hidden_size, num_layers=self._num_layers,
+                bidirectional=self._dir == 2, p=self._dropout,
+                state_outputs=True)
             new_states = [hT]
         if self._layout == "NTC":
             out = out.swapaxes(0, 1)

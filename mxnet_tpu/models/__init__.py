@@ -1,0 +1,4 @@
+"""Model families beyond the vision zoo (reference: BERT-class transformer
+workloads driven through gluon — BASELINE configs #3/#5)."""
+from . import bert  # noqa: F401
+from .bert import BERTModel, BERTEncoder, bert_base, bert_large, bert_tiny  # noqa: F401

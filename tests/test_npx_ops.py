@@ -1,0 +1,278 @@
+"""npx NN-op tests vs NumPy references (reference analog:
+tests/python/unittest/test_operator.py for nn ops)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+
+
+def test_softmax_matches_numpy():
+    x = onp.random.RandomState(0).randn(3, 5).astype("float32")
+    out = npx.softmax(np.array(x)).asnumpy()
+    e = onp.exp(x - x.max(-1, keepdims=True))
+    onp.testing.assert_allclose(out, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    out0 = npx.softmax(np.array(x), axis=0).asnumpy()
+    e0 = onp.exp(x - x.max(0, keepdims=True))
+    onp.testing.assert_allclose(out0, e0 / e0.sum(0, keepdims=True), rtol=1e-5)
+
+
+def test_softmax_with_length():
+    x = onp.random.RandomState(0).randn(2, 4).astype("float32")
+    length = np.array([2, 3], dtype="int32")
+    out = npx.softmax(np.array(x), length=length, use_length=True).asnumpy()
+    assert out[0, 2] == 0 and out[0, 3] == 0 and out[1, 3] == 0
+    onp.testing.assert_allclose(out.sum(-1), [1.0, 1.0], rtol=1e-5)
+
+
+def test_masked_softmax():
+    x = onp.random.RandomState(0).randn(2, 4).astype("float32")
+    mask = onp.array([[1, 1, 0, 0], [1, 1, 1, 0]], bool)
+    out = npx.masked_softmax(np.array(x), np.array(mask)).asnumpy()
+    assert (out[~mask] == 0).all()
+    onp.testing.assert_allclose(out.sum(-1), [1.0, 1.0], rtol=1e-5)
+
+
+def test_log_softmax_safe_accumulation():
+    # large fp16-range values shouldn't overflow (MXNET_SAFE_ACCUMULATION)
+    x = np.array(onp.array([[10000.0, 10001.0]], "float32"))
+    out = npx.log_softmax(x).asnumpy()
+    assert onp.isfinite(out).all()
+
+
+def test_one_hot_topk_pick():
+    oh = npx.one_hot(np.array([0, 2], dtype="int32"), 4).asnumpy()
+    onp.testing.assert_array_equal(oh, [[1, 0, 0, 0], [0, 0, 1, 0]])
+
+    x = onp.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], "float32")
+    idx = npx.topk(np.array(x), k=2, ret_typ="indices").asnumpy()
+    onp.testing.assert_array_equal(idx, [[0, 2], [1, 2]])
+    vals, idx2 = npx.topk(np.array(x), k=1, ret_typ="both")
+    onp.testing.assert_array_equal(vals.asnumpy(), [[3.0], [5.0]])
+    asc = npx.topk(np.array(x), k=1, is_ascend=True, ret_typ="value").asnumpy()
+    onp.testing.assert_array_equal(asc, [[1.0], [0.0]])
+
+    picked = npx.pick(np.array(x), np.array([2, 0])).asnumpy()
+    onp.testing.assert_array_equal(picked, [2.0, 0.0])
+
+
+def test_gather_scatter_nd():
+    data = np.array(onp.arange(12.0, dtype="float32").reshape(3, 4))
+    indices = np.array([[0, 2], [1, 3]], dtype="int32")  # rows then cols
+    out = npx.gather_nd(data, indices).asnumpy()
+    onp.testing.assert_array_equal(out, [1.0, 11.0])
+    sc = npx.scatter_nd(np.array([5.0, 6.0]), indices, (3, 4)).asnumpy()
+    assert sc[0, 1] == 5.0 and sc[2, 3] == 6.0
+
+
+def test_sequence_ops():
+    # data (L, B, D)
+    data = onp.arange(24.0, dtype="float32").reshape(4, 2, 3)
+    length = np.array([2, 3], dtype="int32")
+    masked = npx.sequence_mask(np.array(data), length,
+                               use_sequence_length=True, value=-1).asnumpy()
+    assert (masked[2:, 0] == -1).all()
+    assert (masked[3:, 1] == -1).all()
+    onp.testing.assert_array_equal(masked[:2], data[:2])
+
+    last = npx.sequence_last(np.array(data), length,
+                             use_sequence_length=True).asnumpy()
+    onp.testing.assert_array_equal(last[0], data[1, 0])
+    onp.testing.assert_array_equal(last[1], data[2, 1])
+
+    rev = npx.sequence_reverse(np.array(data), length,
+                               use_sequence_length=True).asnumpy()
+    onp.testing.assert_array_equal(rev[0, 0], data[1, 0])
+    onp.testing.assert_array_equal(rev[1, 0], data[0, 0])
+    onp.testing.assert_array_equal(rev[2, 0], data[2, 0])  # beyond len kept
+
+
+def test_batch_dot():
+    rng = onp.random.RandomState(0)
+    a = rng.randn(2, 3, 4).astype("float32")
+    b = rng.randn(2, 4, 5).astype("float32")
+    out = npx.batch_dot(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_allclose(out, a @ b, rtol=1e-5)
+    out_t = npx.batch_dot(np.array(a), np.array(b.transpose(0, 2, 1)),
+                          transpose_b=True).asnumpy()
+    onp.testing.assert_allclose(out_t, a @ b, rtol=1e-5)
+
+
+def test_arange_like_reshape_like():
+    x = np.zeros((2, 3))
+    al = npx.arange_like(x).asnumpy()
+    onp.testing.assert_array_equal(al, onp.arange(6.0).reshape(2, 3))
+    al0 = npx.arange_like(x, axis=0).asnumpy()
+    onp.testing.assert_array_equal(al0, [0.0, 1.0])
+    r = npx.reshape_like(np.arange(6.0), x).asnumpy()
+    assert r.shape == (2, 3)
+
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    out = npx.smooth_l1(x, scalar=1.0).asnumpy()
+    onp.testing.assert_allclose(out, [1.5, 0.125, 0.0, 0.125, 1.5], rtol=1e-6)
+
+
+def test_all_finite():
+    assert bool(npx.all_finite(np.ones((3,)), np.zeros((2,))))
+    assert not bool(npx.all_finite(np.array([1.0, onp.inf])))
+    assert not bool(npx.all_finite(np.array([onp.nan])))
+
+
+def test_embedding_op():
+    w = np.array(onp.eye(4, 3, dtype="float32"))
+    out = npx.embedding(np.array([1, 3], dtype="int32"), w).asnumpy()
+    onp.testing.assert_array_equal(out[0], [0, 1, 0])
+
+
+def test_activation_grads():
+    for act in ["relu", "sigmoid", "tanh", "softrelu", "gelu"]:
+        x = np.array([0.3, -0.7])
+        x.attach_grad()
+        with autograd.record():
+            y = npx.activation(x, act).sum()
+        y.backward()
+        assert onp.isfinite(x.grad.asnumpy()).all()
+
+
+def test_layer_norm_op_matches_numpy():
+    x = onp.random.RandomState(0).randn(4, 6).astype("float32")
+    g = onp.random.RandomState(1).rand(6).astype("float32")
+    b = onp.random.RandomState(2).rand(6).astype("float32")
+    out = npx.layer_norm(np.array(x), np.array(g), np.array(b)).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expect = (x - mu) / onp.sqrt(var + 1e-5) * g + b
+    onp.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_lrn():
+    x = onp.abs(onp.random.RandomState(0).randn(1, 4, 3, 3)).astype("float32")
+    out = npx.lrn(np.array(x), nsize=3).asnumpy()
+    assert out.shape == x.shape
+    assert (out <= x + 1e-6).all()  # LRN divides by >= 1
+
+
+def test_l2_normalization():
+    x = onp.random.RandomState(0).randn(2, 5).astype("float32")
+    out = npx.l2_normalization(np.array(x), mode="instance").asnumpy()
+    onp.testing.assert_allclose((out ** 2).sum(-1), [1.0, 1.0], rtol=1e-4)
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs.npz")
+    npx.save(f, {"a": np.ones((2,)), "b": np.zeros((3,))})
+    loaded = npx.load(f)
+    onp.testing.assert_array_equal(loaded["a"].asnumpy(), [1, 1])
+    onp.testing.assert_array_equal(loaded["b"].asnumpy(), [0, 0, 0])
+
+
+def test_control_flow():
+    # npx.foreach
+    def body(x, states):
+        return x * 2, [states[0] + x.sum()]
+
+    data = np.array(onp.arange(6.0, dtype="float32").reshape(3, 2))
+    outs, states = npx.foreach(body, data, [np.array(0.0)])
+    onp.testing.assert_array_equal(outs.asnumpy(), data.asnumpy() * 2)
+    assert float(states[0]) == 15.0
+
+    # npx.while_loop
+    def cond(i, s):
+        return i < 3
+
+    def func(i, s):
+        return s * 2, [i + 1, s * 2]
+
+    outs, (i, s) = npx.while_loop(cond, func, [np.array(0), np.array(1.0)],
+                                  max_iterations=10)
+    assert float(s) == 8.0
+
+    # npx.cond
+    r = npx.cond(np.array(True), lambda: np.array(1.0), lambda: np.array(2.0))
+    assert float(r) == 1.0
+
+
+def test_interleaved_matmul_attention():
+    """Fused attention projections vs explicit einsum reference
+    (src/operator/contrib/transformer.cc parity)."""
+    L, B, H, D = 5, 2, 2, 4
+    rng = onp.random.RandomState(0)
+    qkv = rng.randn(L, B, H * 3 * D).astype("float32")
+    att = npx.interleaved_matmul_selfatt_qk(np.array(qkv), heads=H)
+    assert att.shape == (B * H, L, L)
+    x = qkv.reshape(L, B, H, 3, D)
+    q, k = x[:, :, :, 0], x[:, :, :, 1]
+    expect = onp.einsum("lbhd,mbhd->bhlm", q / onp.sqrt(D), k).reshape(B * H, L, L)
+    onp.testing.assert_allclose(att.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+    probs = npx.softmax(att, axis=-1)
+    out = npx.interleaved_matmul_selfatt_valatt(np.array(qkv), probs, heads=H)
+    assert out.shape == (L, B, H * D)
+    v = x[:, :, :, 2]
+    p = probs.asnumpy().reshape(B, H, L, L)
+    expect_out = onp.einsum("bhlm,mbhd->lbhd", p, v).reshape(L, B, H * D)
+    onp.testing.assert_allclose(out.asnumpy(), expect_out, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_vs_reference():
+    from mxnet_tpu.ops.attention import attention_reference
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(0)
+    B, H, L, D = 2, 2, 64, 16
+    q = rng.randn(B, H, L, D).astype("float32")
+    k = rng.randn(B, H, L, D).astype("float32")
+    v = rng.randn(B, H, L, D).astype("float32")
+    ref = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    # pallas kernel in interpret mode on CPU
+    out = flash_attention_tpu(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              block_q=32, interpret=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-4, atol=1e-4)
+    # causal
+    refc = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                               causal=True)
+    outc = flash_attention_tpu(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                               causal=True, block_q=32, interpret=True)
+    onp.testing.assert_allclose(onp.asarray(outc), onp.asarray(refc),
+                                rtol=1e-4, atol=1e-4)
+    # sliding window
+    refw = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                               window=8)
+    outw = flash_attention_tpu(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                               window=8, block_q=32, interpret=True)
+    onp.testing.assert_allclose(onp.asarray(outw), onp.asarray(refw),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_npx_flash_attention_grad():
+    rng = onp.random.RandomState(0)
+    B, H, L, D = 1, 2, 16, 8
+    q = np.array(rng.randn(B, H, L, D).astype("float32"))
+    k = np.array(rng.randn(B, H, L, D).astype("float32"))
+    v = np.array(rng.randn(B, H, L, D).astype("float32"))
+    for a in (q, k, v):
+        a.attach_grad()
+    with autograd.record():
+        out = npx.flash_attention(q, k, v, causal=True)
+        loss = (out ** 2).sum()
+    loss.backward()
+    for a in (q, k, v):
+        g = a.grad.asnumpy()
+        assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
+
+
+def test_ctc_loss_simple():
+    # single perfect-prediction path
+    T, B, V = 4, 1, 3
+    logits = onp.full((T, B, V), -10.0, "float32")
+    # labels [1,2]; alignment 1,1,2,2 (no blanks needed)
+    logits[0, 0, 1] = 10
+    logits[1, 0, 1] = 10
+    logits[2, 0, 2] = 10
+    logits[3, 0, 2] = 10
+    label = np.array([[1, 2]], dtype="float32")
+    loss = npx.ctc_loss(np.array(logits), label).asnumpy()
+    assert loss[0] < 1.0  # high-probability path → small loss

@@ -1,0 +1,170 @@
+"""Distributed/SPMD tests on the 8-device virtual CPU mesh (reference
+analog: tests/nightly/dist_*_kvstore.py run multi-process-on-one-host;
+here: multi-device mesh in one process, SURVEY §4 implication (3))."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, gluon, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import (DataParallelTrainer, Mesh, P, make_mesh,
+                                functionalize)
+from mxnet_tpu.parallel.ring_attention import ring_attention
+from mxnet_tpu.ops.attention import attention_reference
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8-device mesh")
+
+
+def test_make_mesh():
+    mesh = make_mesh(axis_names=("dp",))
+    assert mesh.shape["dp"] == 8
+    mesh2 = make_mesh((4, 2), ("dp", "tp"))
+    assert mesh2.shape["dp"] == 4 and mesh2.shape["tp"] == 2
+
+
+def test_data_parallel_trainer_matches_single_device():
+    """DP over 8 devices must produce the same updates as one device."""
+    def run(mesh):
+        mx.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        x = np.random.uniform(size=(16, 8))
+        y = np.random.randint(0, 4, size=(16,))
+        net(x[:1])
+        loss_obj = gluon.loss.SoftmaxCrossEntropyLoss()
+        tr = DataParallelTrainer(net, lambda o, l: loss_obj(o, l), "sgd",
+                                 {"learning_rate": 0.1}, mesh=mesh)
+        state = tr.init_state()
+        tr.build_step(donate=False)
+        key = jax.random.key(0)
+        losses = []
+        for _ in range(3):
+            state, loss = tr.step(state, x, y, key, 0.1)
+            losses.append(float(loss))
+        return losses, {k: onp.asarray(v) for k, v in state["params"].items()}
+
+    l8, p8 = run(make_mesh((8,), ("dp",)))
+    l1, p1 = run(Mesh(onp.array(jax.devices()[:1]), ("dp",)))
+    onp.testing.assert_allclose(l8, l1, rtol=1e-5)
+    for k in p8:
+        onp.testing.assert_allclose(p8[k], p1[k], rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_parallel_matches_replicated():
+    mx.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = np.random.uniform(size=(8, 4))
+    y = np.random.randint(0, 8, size=(8,))
+    net(x[:1])
+    loss_obj = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh((4, 2), ("dp", "tp"))
+
+    def pspec(name, shape):
+        if name.endswith("weight") and len(shape) == 2 and shape[0] % 2 == 0:
+            return P("tp", None)
+        return P()
+
+    results = []
+    for spec_fn in (pspec, None):
+        mx.random.seed(1)
+        tr = DataParallelTrainer(net, lambda o, l: loss_obj(o, l), "sgd",
+                                 {"learning_rate": 0.1}, mesh=mesh,
+                                 param_pspec=spec_fn, data_axis="dp")
+        state = tr.init_state()
+        tr.build_step(donate=False)
+        losses = []
+        for _ in range(3):
+            state, loss = tr.step(state, x, y, jax.random.key(0), 0.1)
+            losses.append(float(loss))
+        results.append(losses)
+    onp.testing.assert_allclose(results[0], results[1], rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal,window", [(False, None), (True, None),
+                                           (False, 16)])
+def test_ring_attention_matches_reference(causal, window):
+    """Ring attention over an 8-way sequence shard == single-device
+    attention."""
+    rng = onp.random.RandomState(0)
+    B, H, L, D = 2, 2, 64, 8
+    q = jnp.asarray(rng.randn(B, H, L, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, L, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, L, D).astype("float32"))
+    mesh = make_mesh((8,), ("sp",))
+    out = ring_attention(q, k, v, mesh, seq_axis="sp", causal=causal,
+                         window=window)
+    ref = attention_reference(q, k, v, causal=causal, window=window)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_jit_grad():
+    """Ring attention is differentiable and jittable over the mesh."""
+    rng = onp.random.RandomState(0)
+    B, H, L, D = 1, 2, 32, 8
+    q = jnp.asarray(rng.randn(B, H, L, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, L, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, L, D).astype("float32"))
+    mesh = make_mesh((8,), ("sp",))
+
+    def loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for gi in g:
+        arr = onp.asarray(gi)
+        assert onp.isfinite(arr).all() and onp.abs(arr).sum() > 0
+
+
+def test_kvstore_multi_value_reduce():
+    from mxnet_tpu import kvstore
+    kv = kvstore.create("device")
+    vals = [np.ones((4,)) * i for i in range(4)]
+    out = np.zeros((4,))
+    kv.pushpull("w", vals, out=out)
+    onp.testing.assert_array_equal(out.asnumpy(), 6 * onp.ones(4))
+
+
+def test_kvstore_updater_path():
+    from mxnet_tpu import kvstore, optimizer
+    kv = kvstore.create("local")
+    kv.set_optimizer(optimizer.SGD(learning_rate=1.0))
+    w = np.ones((3,))
+    kv.init(0, w)
+    g = np.full((3,), 0.1)
+    kv.push(0, g)
+    out = np.zeros((3,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 0.9 * onp.ones(3), rtol=1e-6)
+
+
+def test_functionalize_roundtrip():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    fn, params = functionalize(net)
+    pvals = {k: p._data._data for k, p in params.items()}
+    x = jnp.ones((2, 3))
+    out, aux = fn(pvals, x)
+    assert out.shape == (2, 4)
+    assert aux == {}
+    # jittable
+    out2, _ = jax.jit(fn)(pvals, x)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(out2),
+                                rtol=1e-6)
+
+
+def test_split_and_load():
+    from mxnet_tpu.gluon.utils import split_and_load, split_data
+    x = np.arange(16).reshape(8, 2)
+    parts = split_data(x, 4)
+    assert len(parts) == 4 and parts[0].shape == (2, 2)
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    loaded = split_and_load(x, ctxs)
+    assert len(loaded) == 2
