@@ -12,8 +12,7 @@ from __future__ import annotations
 
 import math
 
-import numpy as onp
-
+from .. import autograd
 from .. import numpy as np
 from .. import numpy_extension as npx
 from ..gluon import nn
@@ -44,7 +43,11 @@ class MultiHeadAttention(HybridBlock):
         qkv = self.qkv(x)  # (B, L, 3C)
         qkv = qkv.reshape(B, L, 3, H, D).transpose(2, 0, 3, 1, 4)  # (3,B,H,L,D)
         q, k, v = qkv[0], qkv[1], qkv[2]
-        if mask is None and self._use_flash:
+        # flash path fuses softmax so attention-probability dropout can't be
+        # applied inside it; route through the unfused path whenever that
+        # dropout is active so both paths regularize identically
+        att_dropout_active = self._dropout and autograd.is_training()
+        if mask is None and self._use_flash and not att_dropout_active:
             out = npx.flash_attention(q, k, v)  # (B,H,L,D)
         else:
             att = npx.batch_dot(q.reshape(B * H, L, D),
@@ -109,11 +112,11 @@ class BERTEncoder(HybridBlock):
         self._units = units
         self.layers = nn.HybridSequential()
         for _ in range(num_layers):
-            self.layers.register_child(TransformerLayer(
+            self.layers.add(TransformerLayer(
                 units, hidden_size, num_heads, dropout, use_flash))
 
     def forward(self, x, mask=None):
-        for layer in self.layers._children.values():
+        for layer in self.layers:
             x = layer(x, mask)
         return x
 
@@ -163,9 +166,10 @@ class BERTModel(HybridBlock):
         h = npx.activation(self.mlm_dense(seq), "gelu")
         h = self.mlm_ln(h)
         if self._tie:
+            # jnp.matmul broadcasts the leading batch dim of 1 — no (B,V,C)
+            # materialization
             logits = npx.batch_dot(
-                h, self.word_embed.weight.data().expand_dims(0).broadcast_to(
-                    (B,) + self.word_embed.weight.shape),
+                h, self.word_embed.weight.data().expand_dims(0),
                 transpose_b=True) + self.mlm_bias.data()
         else:
             logits = self.mlm_decoder(h) + self.mlm_bias.data()

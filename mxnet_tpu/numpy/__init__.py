@@ -509,6 +509,50 @@ def topk(a, k, axis=-1, **kw):
     return _npx_topk(a, axis=axis, k=k, **kw)
 
 
+def _maybe_out(res, out):
+    if out is not None:
+        out._set_data(res._data.astype(out.dtype))
+        return out
+    return res
+
+
+def bitwise_not(x, out=None):
+    return _maybe_out(apply_op(jnp.bitwise_not, x), out)
+
+
+def fabs(x, out=None):
+    return _maybe_out(apply_op(jnp.fabs, x), out)
+
+
+def round_(a, decimals=0, out=None):
+    return _maybe_out(apply_op(lambda x: jnp.round(x, decimals), a), out)
+
+
+def diag_indices_from(arr):
+    if arr.ndim < 2 or len(set(arr.shape)) != 1:
+        raise ValueError("All dimensions of input must be of equal length")
+    return tuple(array(x) for x in onp.diag_indices(arr.shape[0], arr.ndim))
+
+
+def fill_diagonal(a, val, wrap=False):
+    """In-place diagonal fill (reference np.fill_diagonal); functional
+    under the hood — the ndarray's buffer is swapped (version bump)."""
+    a._set_data(jnp.fill_diagonal(a._data, _unwrap(val), wrap=wrap,
+                                  inplace=False))
+
+
+def hanning(M, dtype=None, ctx=None, device=None):
+    return array(onp.hanning(M), dtype=dtype or float32, ctx=ctx or device)
+
+
+def hamming(M, dtype=None, ctx=None, device=None):
+    return array(onp.hamming(M), dtype=dtype or float32, ctx=ctx or device)
+
+
+def blackman(M, dtype=None, ctx=None, device=None):
+    return array(onp.blackman(M), dtype=dtype or float32, ctx=ctx or device)
+
+
 def multi_dot(arrays):
     return apply_op(lambda *xs: jnp.linalg.multi_dot(xs), *arrays)
 

@@ -472,47 +472,7 @@ def index_update(data, indices, value, **kw):
                     data, value)
 
 
-def foreach(body, data, init_states):
-    """Control-flow: npx.foreach (python/mxnet/ndarray/contrib.py:139).
-    Eagerly loops in Python; under hybridize the trace unrolls via lax.scan
-    in gluon.contrib layers."""
-    states = init_states if isinstance(init_states, (list, tuple)) else [init_states]
-    outputs = []
-    seq = data if isinstance(data, (list, tuple)) else [data[i] for i in range(len(data))]
-    for x in seq:
-        out, states = body(x, states)
-        outputs.append(out)
-    from ..numpy import stack
-    if isinstance(outputs[0], (list, tuple)):
-        outs = tuple(stack([o[i] for o in outputs]) for i in range(len(outputs[0])))
-    else:
-        outs = stack(outputs)
-    return outs, states
-
-
-def while_loop(cond, func, loop_vars, max_iterations=None):
-    """npx.while_loop (contrib.py:233) — eager Python loop."""
-    steps = 0
-    outputs = []
-    vars_ = list(loop_vars)
-    while bool(cond(*vars_)) and (max_iterations is None or steps < max_iterations):
-        out, vars_ = func(*vars_)
-        outputs.append(out)
-        steps += 1
-    from ..numpy import stack
-    if outputs:
-        if isinstance(outputs[0], (list, tuple)):
-            outs = tuple(stack([o[i] for o in outputs]) for i in range(len(outputs[0])))
-        else:
-            outs = stack(outputs)
-    else:
-        outs = None
-    return outs, vars_
-
-
-def cond(pred, then_func, else_func):
-    """npx.cond (contrib.py:401)."""
-    return then_func() if bool(pred) else else_func()
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402,F401
 
 
 def seed(seed_state):

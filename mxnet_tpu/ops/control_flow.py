@@ -1,0 +1,250 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Parity: reference `src/operator/control_flow.cc` (`_foreach` :1096,
+`_while_loop` :1157, `_cond` :1218) and the Python frontend
+`python/mxnet/ndarray/contrib.py:139/:233/:401`.
+
+TPU-native design: in the reference these are stateful ops that run a
+sub-CachedOp per iteration on the engine.  Here the loop body itself is
+traced and compiled: `foreach` lowers to `lax.scan` (one fused XLA loop —
+the MXU stays busy across iterations, no per-step dispatch), `cond` lowers
+to `lax.cond` when traced, and `while_loop` runs as an eager Python loop in
+imperative mode (matching the reference's imperative semantics with a truly
+dynamic trip count) but lowers to a masked `lax.scan` over `max_iterations`
+when traced inside `hybridize()`/`jit` (XLA needs static shapes).
+Gradients flow through `jax.vjp` of the whole scanned program, which is the
+moral equivalent of the reference's per-iteration backward CachedOp chain —
+but XLA gets to optimize across iterations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from ..ndarray import ndarray, apply_op, _wrap_value
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+# -- pytree helpers over nested list/tuple of ndarray ----------------------
+def _flatten(obj, out):
+    if isinstance(obj, ndarray):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            _flatten(o, out)
+    elif obj is not None:
+        raise TypeError("control-flow states must be ndarrays or nested "
+                        "lists/tuples of ndarrays, got %r" % (type(obj),))
+    return out
+
+
+def _rebuild(template, values, idx):
+    if isinstance(template, ndarray):
+        v = values[idx[0]]
+        idx[0] += 1
+        return v
+    if isinstance(template, (list, tuple)):
+        return type(template)(_rebuild(t, values, idx) for t in template)
+    return template
+
+
+def _wrap_tree(template, raw_values):
+    idx = [0]
+
+    def go(t):
+        if isinstance(t, ndarray):
+            v = _wrap_value(raw_values[idx[0]])
+            idx[0] += 1
+            return v
+        if isinstance(t, (list, tuple)):
+            return type(t)(go(x) for x in t)
+        return t
+
+    return go(template)
+
+
+def _is_traced(arrs):
+    return any(isinstance(a._data, jax.core.Tracer) for a in arrs)
+
+
+def foreach(body, data, init_states):
+    """Run `body(data_slice, states) -> (outputs, new_states)` over axis 0.
+
+    Parity: `mx.nd.contrib.foreach` (python/mxnet/ndarray/contrib.py:139,
+    op `_foreach` src/operator/control_flow.cc:1096).  Lowered to
+    `lax.scan`: one compiled XLA loop instead of one engine push per step.
+    """
+    flat_data = _flatten(data, [])
+    flat_states = _flatten(init_states, [])
+    n_data, n_states = len(flat_data), len(flat_states)
+
+    if not _is_traced(flat_data + flat_states):
+        # Imperative mode: a real Python loop, like the reference's
+        # NDArray-mode `_foreach` — the body may branch on values,
+        # call .item()/.asnumpy(), and the tape sees closure-captured
+        # arrays.  The fused lax.scan path below is used when tracing
+        # (hybridize/jit), where captured Parameters are tracers and
+        # gradients flow through the compiled scan.
+        states = init_states
+        outputs = []
+        length = flat_data[0].shape[0]
+        for t in range(length):
+            slc = _rebuild(data, [d[t] for d in flat_data], [0])
+            out, states = body(slc, states)
+            outputs.append(out)
+        from ..numpy import stack as _stack
+        flat_outs = [_flatten(o, []) for o in outputs]
+        stacked = [_stack([fo[i] for fo in flat_outs])
+                   for i in range(len(flat_outs[0]))]
+        return _rebuild(outputs[0], stacked, [0]), states
+
+    template = {}
+
+    def run(*vals):
+        xs_vals = list(vals[:n_data])
+        st_vals = list(vals[n_data:])
+
+        def step(carry, xs):
+            states = _wrap_tree(init_states, list(carry))
+            slc = _wrap_tree(data, list(xs))
+            with autograd._RecordingStateScope(False, autograd.is_training()):
+                out, new_states = body(slc, states)
+            flat_out = _flatten(out, [])
+            flat_new = _flatten(new_states, [])
+            if len(flat_new) != n_states:
+                raise ValueError(
+                    "foreach body returned %d states, expected %d"
+                    % (len(flat_new), n_states))
+            template.setdefault("out", out)
+            template.setdefault("states", new_states)
+            return tuple(s._data for s in flat_new), tuple(o._data for o in flat_out)
+
+        final_carry, stacked = lax.scan(step, tuple(st_vals), tuple(xs_vals))
+        return tuple(stacked) + tuple(final_carry)
+
+    results = apply_op(run, *(flat_data + flat_states))
+    if not isinstance(results, (list, tuple)):
+        results = [results]
+    n_out = len(results) - n_states
+    out_tree = _rebuild(template["out"], list(results[:n_out]), [0])
+    state_tree = _rebuild(template["states"], list(results[n_out:]), [0])
+    return out_tree, state_tree
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """`while cond_fn(*loop_vars): outputs, loop_vars = func(*loop_vars)`.
+
+    Parity: `mx.nd.contrib.while_loop` (python/mxnet/ndarray/contrib.py:233,
+    op `_while_loop` src/operator/control_flow.cc:1157).  Imperative mode
+    runs a real Python loop (dynamic trip count, like the reference's
+    NDArray-mode op); under tracing it becomes a masked `lax.scan` over
+    `max_iterations` — outputs beyond the exit step are zero-padded, and
+    `max_iterations` is required (XLA static shapes).
+    """
+    flat_vars = _flatten(loop_vars, [])
+    if max_iterations is None:
+        raise ValueError("max_iterations should be specified")
+    max_iterations = int(max_iterations)
+
+    if not _is_traced(flat_vars):
+        # imperative: true dynamic loop; tape records every op (reference
+        # imperative semantics).  Outputs are stacked and padded to
+        # max_iterations rows, matching contrib.py:233's NDArray mode; zero
+        # iterations returns empty outputs ("we assume step_output is
+        # empty", contrib.py docstring).
+        steps = 0
+        outputs = []
+        cur = loop_vars
+        while steps < max_iterations and bool(cond_fn(*cur)):
+            out, cur = func(*cur)
+            if not isinstance(cur, (list, tuple)):
+                cur = [cur]
+            outputs.append(out)
+            steps += 1
+        if not outputs:
+            return [], list(cur)
+        from ..numpy import stack as _stack, zeros as _zeros, concatenate as _concat
+        flat_outs = [_flatten(o, []) for o in outputs]
+        stacked = []
+        for i in range(len(flat_outs[0])):
+            s = _stack([fo[i] for fo in flat_outs])
+            if steps != max_iterations:
+                pad = _zeros((max_iterations - steps,) + s.shape[1:],
+                             dtype=s.dtype)
+                s = _concat([s, pad], axis=0)
+            stacked.append(s)
+        out_tree = _rebuild(outputs[0], stacked, [0])
+        return out_tree, list(cur)
+
+    # traced: masked scan
+    n_vars = len(flat_vars)
+    template = {}
+
+    def run(*vals):
+        def step(carry, _):
+            done, var_vals = carry[0], list(carry[1:])
+            vars_w = _wrap_tree(list(loop_vars), var_vals)
+            with autograd._RecordingStateScope(False, autograd.is_training()):
+                pred = cond_fn(*vars_w)
+                out, new_vars = func(*vars_w)
+            if not isinstance(new_vars, (list, tuple)):
+                new_vars = [new_vars]
+            active = jnp.logical_and(jnp.logical_not(done),
+                                     pred._data.astype(jnp.bool_).reshape(()))
+            flat_new = [n._data for n in _flatten(list(new_vars), [])]
+            kept = [jnp.where(active, n, v) for n, v in zip(flat_new, var_vals)]
+            flat_out = [o._data for o in _flatten(out, [])]
+            masked_out = [jnp.where(active, o, jnp.zeros_like(o)) for o in flat_out]
+            template.setdefault("out", out)
+            template.setdefault("vars", list(new_vars))
+            new_done = jnp.logical_or(done, jnp.logical_not(
+                pred._data.astype(jnp.bool_).reshape(())))
+            return (new_done,) + tuple(kept), tuple(masked_out)
+
+        carry0 = (jnp.asarray(False),) + tuple(vals)
+        final_carry, stacked = lax.scan(step, carry0, None,
+                                        length=max_iterations)
+        return tuple(stacked) + tuple(final_carry[1:])
+
+    results = apply_op(run, *flat_vars)
+    n_out = len(results) - n_vars
+    out_tree = _rebuild(template["out"], list(results[:n_out]), [0])
+    var_tree = _rebuild(template["vars"], list(results[n_out:]), [0])
+    return out_tree, list(var_tree)
+
+
+def cond(pred, then_func, else_func):
+    """`then_func() if pred else else_func()`.
+
+    Parity: `mx.nd.contrib.cond` (python/mxnet/ndarray/contrib.py:401, op
+    `_cond` src/operator/control_flow.cc:1218).  Imperative mode evaluates
+    the predicate and runs one branch eagerly; under tracing both branches
+    lower into a single `lax.cond` (XLA select of compiled branches).
+    """
+    pred_arr = pred if isinstance(pred, ndarray) else None
+    if pred_arr is None or not isinstance(pred_arr._data, jax.core.Tracer):
+        take_then = bool(pred) if not isinstance(pred, ndarray) else bool(
+            pred.asnumpy().reshape(()).item())
+        return then_func() if take_then else else_func()
+
+    template = {}
+
+    def run(p):
+        def mk(branch, name):
+            def f(_):
+                with autograd._RecordingStateScope(False, autograd.is_training()):
+                    out = branch()
+                template.setdefault(name, out)
+                return tuple(o._data for o in _flatten(out, []))
+            return f
+
+        return lax.cond(p.astype(jnp.bool_).reshape(()),
+                        mk(then_func, "out"), mk(else_func, "else_out"), 0)
+
+    results = apply_op(run, pred_arr)
+    if not isinstance(results, (list, tuple)):
+        results = [results]
+    return _rebuild(template["out"], list(results), [0])

@@ -13,12 +13,11 @@ the kernel is a shard_map over the same mesh.
 """
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 
@@ -27,7 +26,6 @@ def _flash_block(q, k_blk, v_blk, o, m, l, scale, q_start, k_start,
     """One blockwise-attention accumulation step (fp32 accumulators)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
                    preferred_element_type=jnp.float32) * scale
-    Lq, Lk = s.shape[-2], s.shape[-1]
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
     k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     mask = jnp.ones(s.shape, jnp.bool_)

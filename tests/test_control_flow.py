@@ -1,0 +1,182 @@
+"""Control-flow op tests.
+
+Mirrors reference tests/python/unittest/test_contrib_control_flow.py:
+foreach/while_loop/cond forward + gradient, eager and inside hybridize.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, npx
+from mxnet_tpu.gluon import HybridBlock
+
+
+def test_foreach_cumsum():
+    data = mx.np.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    init = mx.np.zeros((3,))
+
+    def body(x, states):
+        s = states[0] + x
+        return s, [s]
+
+    outs, final = npx.foreach(body, data, [init])
+    expect = onp.cumsum(data.asnumpy(), axis=0)
+    onp.testing.assert_allclose(outs.asnumpy(), expect, rtol=1e-6)
+    onp.testing.assert_allclose(final[0].asnumpy(), expect[-1], rtol=1e-6)
+
+
+def test_foreach_grad():
+    data = mx.np.array(onp.random.rand(5, 2).astype("float32"))
+    w = mx.np.array(onp.random.rand(2).astype("float32"))
+    w.attach_grad()
+
+    def body(x, states):
+        s = states[0] + x * w
+        return s * 2.0, [s]
+
+    with autograd.record():
+        outs, final = npx.foreach(body, data, [mx.np.zeros((2,))])
+        loss = outs.sum() + final[0].sum()
+    loss.backward()
+    # analytic: d loss / dw = sum over steps of contributions
+    g = w.grad.asnumpy()
+    # finite difference
+    eps = 1e-3
+    wn = w.asnumpy()
+
+    def f(wv):
+        s = onp.zeros(2, "float32")
+        tot = 0.0
+        for i in range(5):
+            s = s + data.asnumpy()[i] * wv
+            tot += (2 * s).sum()
+        return tot + s.sum()
+
+    for j in range(2):
+        wp, wm = wn.copy(), wn.copy()
+        wp[j] += eps
+        wm[j] -= eps
+        fd = (f(wp) - f(wm)) / (2 * eps)
+        onp.testing.assert_allclose(g[j], fd, rtol=1e-2)
+
+
+def test_foreach_multi_output_multi_state():
+    data = mx.np.array(onp.ones((3, 2), "float32"))
+
+    def body(x, states):
+        a, b = states
+        return (a + x, b * 2.0), [a + x, b * 2.0]
+
+    (o1, o2), (s1, s2) = npx.foreach(
+        body, data, [mx.np.zeros((2,)), mx.np.ones((2,))])
+    assert o1.shape == (3, 2) and o2.shape == (3, 2)
+    onp.testing.assert_allclose(s1.asnumpy(), [3.0, 3.0])
+    onp.testing.assert_allclose(s2.asnumpy(), [8.0, 8.0])
+
+
+def test_while_loop_eager():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s + i, [i + 1, s + i]
+
+    outs, (i_f, s_f) = npx.while_loop(
+        cond_fn, func, [mx.np.array(0.0), mx.np.array(0.0)], max_iterations=10)
+    assert int(i_f.item()) == 5
+    assert float(s_f.item()) == 0 + 1 + 2 + 3 + 4
+    # reference pads stacked outputs to max_iterations rows (contrib.py:233)
+    assert outs.shape[0] == 10
+    onp.testing.assert_allclose(outs.asnumpy()[:5], [0., 1., 3., 6., 10.])
+
+
+def test_while_loop_zero_iterations():
+    outs, vars_ = npx.while_loop(
+        lambda i: i < 0, lambda i: (i, [i + 1]),
+        [mx.np.array(5.0)], max_iterations=4)
+    assert outs == []
+    assert float(vars_[0].item()) == 5.0
+
+
+def test_while_loop_requires_max_iterations():
+    with pytest.raises(ValueError):
+        npx.while_loop(lambda i: i < 1, lambda i: (i, [i + 1]),
+                       [mx.np.array(0.0)])
+
+
+def test_while_loop_traced_inside_hybrid():
+    class Loop(HybridBlock):
+        def forward(self, x):
+            def cond_fn(i, s):
+                return i < 3
+
+            def func(i, s):
+                return s, [i + 1, s + x.sum()]
+
+            # loop vars derive from the traced input so the masked-scan
+            # path runs under hybridize
+            zero = x.sum() * 0.0
+            outs, (i_f, s_f) = npx.while_loop(
+                cond_fn, func, [zero, zero], max_iterations=6)
+            return outs, s_f
+
+        def infer_shape(self, *a):
+            pass
+
+    net = Loop()
+    x = mx.np.ones((2, 2))
+    eager_outs, eager_s = net(x)
+    net.hybridize()
+    hybrid_outs, hybrid_s = net(x)
+    hybrid_outs2, hybrid_s2 = net(x)
+    onp.testing.assert_allclose(eager_s.asnumpy(), 12.0)
+    onp.testing.assert_allclose(hybrid_s.asnumpy(), 12.0)
+    onp.testing.assert_allclose(hybrid_s2.asnumpy(), 12.0)
+    # eager and traced agree on padded stacked outputs (6 rows, 3 live)
+    assert eager_outs.shape == hybrid_outs.shape == (6,)
+    onp.testing.assert_allclose(eager_outs.asnumpy(), hybrid_outs.asnumpy())
+
+
+def test_cond_eager_and_grad():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        out = npx.cond(x.sum() > 1.0, lambda: x * 3.0, lambda: x * 5.0)
+    out.backward()
+    onp.testing.assert_allclose(out.asnumpy(), [6.0])
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3.0])
+
+
+def test_cond_traced():
+    class C(HybridBlock):
+        def forward(self, x):
+            return npx.cond(x.sum() > 0.0, lambda: x * 2.0, lambda: -x)
+
+        def infer_shape(self, *a):
+            pass
+
+    net = C()
+    net.hybridize()
+    pos = net(mx.np.array([1.0, 2.0]))
+    neg = net(mx.np.array([-1.0, -2.0]))
+    onp.testing.assert_allclose(pos.asnumpy(), [2.0, 4.0])
+    onp.testing.assert_allclose(neg.asnumpy(), [1.0, 2.0])
+
+
+def test_foreach_rnn_style():
+    # reference test: foreach implementing an RNN over time steps
+    T, B, H = 4, 2, 3
+    xs = mx.np.array(onp.random.rand(T, B, H).astype("float32"))
+    wh = mx.np.array(onp.random.rand(H, H).astype("float32") * 0.1)
+
+    def body(x, states):
+        h = mx.np.tanh(x + states[0] @ wh)
+        return h, [h]
+
+    outs, final = npx.foreach(body, xs, [mx.np.zeros((B, H))])
+    # manual loop
+    h = onp.zeros((B, H), "float32")
+    for t in range(T):
+        h = onp.tanh(xs.asnumpy()[t] + h @ wh.asnumpy())
+    onp.testing.assert_allclose(final[0].asnumpy(), h, rtol=1e-5, atol=1e-6)
+    assert outs.shape == (T, B, H)
