@@ -50,6 +50,4 @@ from .util import is_np_array, is_np_shape, set_np, reset_np
 from . import nd
 
 
-def test_utils():  # lazily import to keep startup light
-    from . import test_utils as tu
-    return tu
+from . import test_utils

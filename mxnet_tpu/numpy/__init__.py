@@ -563,3 +563,45 @@ def rot90_(m, k=1, axes=(0, 1)):
 
 _NP_VERSION = "2.0.0"  # API-parity version string (libinfo.py:150)
 __version__ = _NP_VERSION
+
+
+def ravel_multi_index(multi_index, dims, mode="raise", order="C"):
+    idx = tuple(_unwrap(i) for i in multi_index) if isinstance(
+        multi_index, (tuple, list)) else _unwrap(multi_index)
+    return apply_op(lambda: jnp.ravel_multi_index(idx, dims, mode="clip" if mode != "raise" else "wrap"))
+
+
+def sort_complex(a):
+    return apply_op(lambda x: jnp.sort_complex(x), a)
+
+
+def msort(a):
+    return apply_op(lambda x: jnp.sort(x, axis=0), a)
+
+
+def place(arr, mask, vals):
+    arr._set_data(jnp.place(arr._data, _unwrap(mask), _unwrap(vals),
+                            inplace=False))
+
+
+def put(a, ind, v, mode="clip"):
+    a._set_data(jnp.put(a._data, _unwrap(ind), _unwrap(v), mode=mode,
+                        inplace=False))
+
+
+def choose(a, choices, out=None, mode="raise"):
+    ch = [_unwrap(c) for c in choices]
+    return _maybe_out(apply_op(lambda x: jnp.choose(x.astype(jnp.int32), ch,
+                                                    mode="clip"), a), out)
+
+
+def bartlett(M, dtype=None, ctx=None, device=None):
+    return array(onp.bartlett(M), dtype=dtype or float32, ctx=ctx or device)
+
+
+def kaiser(M, beta, dtype=None, ctx=None, device=None):
+    return array(onp.kaiser(M, beta), dtype=dtype or float32, ctx=ctx or device)
+
+
+def require(a, dtype=None, requirements=None):
+    return asarray(a, dtype=dtype)

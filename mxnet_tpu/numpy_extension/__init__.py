@@ -478,3 +478,46 @@ from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402,F401
 def seed(seed_state):
     from .._rng import seed as _seed
     _seed(seed_state)
+
+
+def shape_array(data, **kw):
+    """Shape of input as a 1-D int64 array (reference npx.shape_array)."""
+    return array(onp.array(data.shape, dtype="int64"))
+
+
+def cast(data, dtype, **kw):
+    return data.astype(dtype)
+
+
+_pyslice = slice
+
+
+def slice(data, begin, end, step=None, **kw):  # noqa: A001
+    """Parity: npx.slice (src/operator/tensor/matrix_op.cc Slice)."""
+    nd = data.ndim
+    begin = list(begin) + [None] * (nd - len(begin))
+    end = list(end) + [None] * (nd - len(end))
+    step = (list(step) + [None] * (nd - len(step))) if step else [None] * nd
+    key = tuple(_pyslice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[key]
+
+
+def slice_axis(data, axis, begin, end, **kw):
+    return data.slice_axis(axis, begin, end)
+
+
+def slice_like(data, shape_like, axes=None, **kw):
+    tgt = shape_like.shape
+    key = [_pyslice(0, tgt[ax]) if (axes is None or ax in axes) else _pyslice(None)
+           for ax in range(data.ndim)]
+    return data[tuple(key)]
+
+
+def current_device():
+    from ..context import current_context
+    return current_context()
+
+
+def num_gpus():
+    from ..context import num_gpus as _n
+    return _n()
