@@ -42,6 +42,8 @@ class Parameter:
         self.allow_deferred_init = allow_deferred_init
         self.grad_req = grad_req if differentiable else "null"
         self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
         self._data = None
         self._deferred_init = None  # (init, ctx)
         self._structure_name = None  # set by Block registration

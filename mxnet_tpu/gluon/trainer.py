@@ -102,8 +102,24 @@ class Trainer:
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state_multi_precision(
                     i, p.data())
+            grad = p.grad()
+            if getattr(p, "_grad_stype", "default") == "row_sparse":
+                # sparse_grad path (Embedding): hand the optimizer a
+                # row_sparse view so only touched rows update (reference
+                # lazy_update kernels, src/operator/optimizer_op.cc).
+                # Only a per-row bool mask crosses to host (input_dim
+                # bytes), not the full gradient; rows gather on-device.
+                import numpy as onp
+                import jax.numpy as jnp
+                from ..sparse import RowSparseNDArray
+                gv = grad._data
+                mask = onp.asarray(jnp.any(
+                    gv != 0, axis=tuple(range(1, gv.ndim))))
+                rows = onp.nonzero(mask)[0].astype("int32")
+                grad = RowSparseNDArray(gv[rows], rows, grad.shape,
+                                        grad.dtype)
             self._optimizer.update_multi_precision(
-                [i], [p.data()], [p.grad()], [self._states[i]])
+                [i], [p.data()], [grad], [self._states[i]])
 
     def save_states(self, fname):
         """Serialize optimizer states (reference Trainer.save_states)."""

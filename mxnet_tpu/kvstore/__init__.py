@@ -78,10 +78,18 @@ def _reduce(values):
     values living on different devices are gathered to the first value's
     device and summed in one fused XLA add chain.
     """
-    if isinstance(values, ndarray):
+    from ..sparse import BaseSparseNDArray, elemwise_add
+    if isinstance(values, (ndarray, BaseSparseNDArray)):
         return values
     if len(values) == 1:
         return values[0]
+    if any(isinstance(v, BaseSparseNDArray) for v in values):
+        # sparse aggregation: union of stored rows (reference CommCPU
+        # ReduceRowSparse, src/kvstore/comm.h)
+        total = values[0]
+        for v in values[1:]:
+            total = elemwise_add(total, v)
+        return total
     dev = values[0]._data.devices().pop() if hasattr(values[0]._data, "devices") else None
     total = values[0]._data
     for v in values[1:]:
@@ -120,9 +128,23 @@ class KVStore(KVStoreBase):
         if out is not None:
             outs = out if isinstance(out, (list, tuple)) else [out]
             for o in outs:
-                o._set_data(jax.device_put(v._data, o._data.devices().pop())
-                            if hasattr(o._data, "devices") else v._data)
+                self._write_out(o, v)
         return out
+
+    @staticmethod
+    def _write_out(o, v):
+        """Copy stored value v into destination o, densifying/sparsifying
+        as the destination's stype demands."""
+        from ..sparse import BaseSparseNDArray
+        if isinstance(o, BaseSparseNDArray):
+            src = v if isinstance(v, BaseSparseNDArray) else v.tostype(o.stype)
+            src.tostype(o.stype).copyto(o)
+            return
+        data = v.todense()._data if isinstance(v, BaseSparseNDArray) else v._data
+        if hasattr(o._data, "devices") and hasattr(data, "devices") \
+                and data.devices() != o._data.devices():
+            data = jax.device_put(data, o._data.devices().pop())
+        o._set_data(data)
 
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
@@ -146,9 +168,16 @@ class KVStore(KVStoreBase):
                 self.pull(k, o, priority)
             return
         v = self._data[str(key)]
+        from ..sparse import BaseSparseNDArray
+        if ignore_sparse and isinstance(v, BaseSparseNDArray):
+            # reference pull skips sparse values unless ignore_sparse=False
+            # (python/mxnet/kvstore/kvstore.py pull docstring)
+            raise ValueError(
+                "pull with ignore_sparse=True on a row_sparse value; use "
+                "row_sparse_pull or pass ignore_sparse=False")
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
-            o._set_data(v._data)
+            self._write_out(o, v)
 
     def pushpull(self, key, value, out=None, priority=0):
         if isinstance(key, (list, tuple)):
@@ -161,10 +190,30 @@ class KVStore(KVStoreBase):
         if out is not None:
             outs = out if isinstance(out, (list, tuple)) else [out]
             for o in outs:
-                o._set_data(reduced._data)
+                self._write_out(o, reduced)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        self.pull(key, out, priority)
+        """Pull only the rows in `row_ids` as a RowSparseNDArray
+        (parity: KVStore::PullRowSparse, include/mxnet/kvstore.h:276)."""
+        if out is None:
+            raise ValueError("row_sparse_pull requires out=")
+        if row_ids is None:
+            return self.pull(key, out, priority, ignore_sparse=False)
+        from ..sparse import RowSparseNDArray, retain
+        v = self._data[str(key)]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(outs)
+        for o, rid in zip(outs, rids):
+            if isinstance(v, RowSparseNDArray):
+                res = retain(v, rid)
+            else:
+                import numpy as onp
+                rows = onp.unique(onp.asarray(rid.asnumpy(), dtype="int64"))
+                res = RowSparseNDArray(v._data[rows], rows, v.shape, v.dtype)
+            if isinstance(o, RowSparseNDArray):
+                o.__dict__.update(res.__dict__)
+            else:
+                o._set_data(res.todense()._data)
 
     def set_optimizer(self, optimizer):
         from ..optimizer import Updater

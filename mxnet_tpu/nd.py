@@ -10,3 +10,4 @@ from .numpy import random, linalg  # noqa: F401
 from .ndarray import ndarray as NDArray, array, waitall  # noqa: F401
 from .numpy_extension import save, load, savez  # noqa: F401
 from . import numpy_extension as contrib  # noqa: F401  (mx.nd.contrib.*)
+from . import sparse  # noqa: F401  (mx.nd.sparse.*)

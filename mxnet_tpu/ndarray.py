@@ -676,9 +676,10 @@ class ndarray:
         return self[tuple(sl)]
 
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError("sparse stypes arrive with mx.sparse")
-        return self
+        if stype == "default":
+            return self
+        from . import sparse
+        return sparse.cast_storage(self, stype)
 
 
 NDArray = ndarray

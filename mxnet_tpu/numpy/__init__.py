@@ -568,7 +568,8 @@ __version__ = _NP_VERSION
 def ravel_multi_index(multi_index, dims, mode="raise", order="C"):
     idx = tuple(_unwrap(i) for i in multi_index) if isinstance(
         multi_index, (tuple, list)) else _unwrap(multi_index)
-    return apply_op(lambda: jnp.ravel_multi_index(idx, dims, mode="clip" if mode != "raise" else "wrap"))
+    return apply_op(lambda: jnp.ravel_multi_index(
+        idx, dims, mode=mode if mode != "raise" else "clip"))
 
 
 def sort_complex(a):

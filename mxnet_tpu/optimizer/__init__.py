@@ -158,6 +158,16 @@ class Optimizer:
         self.__dict__.update(d)
 
 
+def _rsp_prologue(grad, rescale, clip):
+    """Shared row_sparse-update prologue: stored rows + rescaled/clipped
+    gradient values (reference optimizer_op.cc rsp kernel preamble)."""
+    rows = grad._indices
+    g = grad._values * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return rows, g
+
+
 @register
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
@@ -174,6 +184,25 @@ class SGD(Optimizer):
     def step_one(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
         clip = self.clip_gradient if self.clip_gradient else -1.0
+        from ..sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            if not self.lazy_update:
+                grad = grad.todense()
+                return self.step_one(index, weight, grad, state)
+            # row_sparse lazy update (reference optimizer_op.cc sgd rsp
+            # kernels): touch only the stored rows via scatter
+            rows, g = _rsp_prologue(grad, self.rescale_grad, clip)
+            if self.momentum == 0.0:
+                wrows = weight._data[rows]
+                upd = wrows - lr * (g + wd * wrows)
+                weight._set_data(weight._data.at[rows].set(upd))
+            else:
+                mrows = state._data[rows]
+                wrows = weight._data[rows]
+                m = self.momentum * mrows - lr * (g + wd * wrows)
+                state._set_data(state._data.at[rows].set(m))
+                weight._set_data(weight._data.at[rows].set(wrows + m))
+            return
         if self.momentum == 0.0:
             weight._set_data(_ops.sgd_update(
                 weight._data, grad._data, lr, wd, self.rescale_grad, clip))
@@ -210,6 +239,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=False, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),
@@ -221,6 +251,23 @@ class Adam(Optimizer):
         lr = lr * (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
         clip = self.clip_gradient if self.clip_gradient else -1.0
         mean, var = state
+        from ..sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            if not self.lazy_update:
+                grad = grad.todense()
+                return self.step_one(index, weight, grad, state)
+            # row_sparse lazy Adam (reference adam rsp kernel): only stored
+            # rows advance their moments
+            rows, g = _rsp_prologue(grad, self.rescale_grad, clip)
+            wrows = weight._data[rows]
+            g = g + wd * wrows
+            m = self.beta1 * mean._data[rows] + (1 - self.beta1) * g
+            v = self.beta2 * var._data[rows] + (1 - self.beta2) * jnp.square(g)
+            mean._set_data(mean._data.at[rows].set(m))
+            var._set_data(var._data.at[rows].set(v))
+            weight._set_data(weight._data.at[rows].set(
+                wrows - lr * m / (jnp.sqrt(v) + self.epsilon)))
+            return
         new_w, new_m, new_v = _ops.adam_update(
             weight._data, grad._data, mean._data, var._data, lr, self.beta1,
             self.beta2, self.epsilon, wd, self.rescale_grad, clip)
@@ -340,6 +387,15 @@ class AdaGrad(Optimizer):
     def step_one(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
         clip = self.clip_gradient if self.clip_gradient else -1.0
+        from ..sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            rows, g = _rsp_prologue(grad, self.rescale_grad, clip)
+            g = g + wd * weight._data[rows]
+            h = state._data[rows] + jnp.square(g)
+            state._set_data(state._data.at[rows].set(h))
+            weight._set_data(weight._data.at[rows].set(
+                weight._data[rows] - lr * g / (jnp.sqrt(h) + self.epsilon)))
+            return
         new_w, new_h = _ops.adagrad_update(
             weight._data, grad._data, state._data, lr, self.epsilon, wd,
             self.rescale_grad, clip)

@@ -1,0 +1,362 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Parity: reference `include/mxnet/ndarray.h:63-65` (kRowSparseStorage,
+kCSRStorage), `python/mxnet/ndarray/sparse.py` (RowSparseNDArray :571,
+CSRNDArray :345, row_sparse_array :1053, csr_matrix :817), cast_storage
+(`src/operator/tensor/cast_storage-inl.h`), sparse dot
+(`src/operator/tensor/dot-inl.h`), sparse_retain
+(`src/operator/tensor/sparse_retain-inl.h`).
+
+TPU-native design (SURVEY §7): TPUs have no native sparse formats, so a
+sparse array is a pair/triple of **dense** XLA buffers —
+row_sparse = (indices[int64 K], values[K, ...cols]) and
+csr = (indptr[int64 R+1], indices[int64 NNZ], data[NNZ]) — and every op
+lowers to gather/scatter/segment-sum HLO, which XLA maps onto the VPU.
+The dense shape is carried host-side; `todense()` is one scatter.
+This keeps the reference's storage-type plumbing (stype attribute,
+tostype(), storage-type-aware optimizer updates and kvstore paths)
+without pretending the hardware has CSR kernels.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import ndarray, array, _wrap_value, _unwrap
+
+__all__ = [
+    "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+    "row_sparse_array", "csr_matrix", "zeros", "empty", "array_sparse",
+    "cast_storage", "dot", "retain", "add", "elemwise_add",
+]
+
+
+class BaseSparseNDArray:
+    """Common sparse behavior (reference sparse.py BaseSparseNDArray :85)."""
+
+    stype = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._dtype)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def context(self):
+        from .context import current_context
+        return current_context()
+
+    ctx = context
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def as_np_ndarray(self):
+        return self.todense()
+
+    def wait_to_read(self):
+        pass
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__, self._shape, self.stype)
+
+    def copyto(self, other):
+        if isinstance(other, BaseSparseNDArray):
+            other.__dict__.update(self.__dict__)
+            return other
+        return self.todense().copyto(other)
+
+    def astype(self, dtype):
+        out = self.copy()
+        out._dtype = onp.dtype(dtype)
+        return out
+
+    def __eq__(self, other):  # dense compare semantics
+        return self.todense() == (other.todense() if isinstance(
+            other, BaseSparseNDArray) else other)
+
+    __hash__ = None
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: a subset of rows is stored (reference sparse.py:571).
+
+    data = values[K, *shape[1:]], indices = sorted unique row ids [K].
+    The canonical gradient type for embeddings/sparse features."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, dtype=None):
+        self._values = jnp.asarray(_unwrap(data))
+        self._indices = jnp.asarray(_unwrap(indices)).astype(jnp.int32)
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = onp.dtype(dtype or self._values.dtype)
+
+    @property
+    def data(self):
+        return _wrap_value(self._values)
+
+    @property
+    def indices(self):
+        return _wrap_value(self._indices)
+
+    @property
+    def num_rows_stored(self):
+        return int(self._indices.shape[0])
+
+    def copy(self):
+        return RowSparseNDArray(self._values, self._indices, self._shape,
+                                self._dtype)
+
+    def todense(self):
+        out = jnp.zeros(self._shape, self._dtype)
+        if self._indices.shape[0]:
+            out = out.at[self._indices].set(
+                self._values.astype(self._dtype))
+        return _wrap_value(out)
+
+    tostype_dense = todense
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        if stype == "csr":
+            return cast_storage(self.todense(), "csr")
+        raise ValueError(stype)
+
+    def retain(self, rsp_indices):
+        return retain(self, rsp_indices)
+
+    def __getitem__(self, key):
+        return self.todense()[key]
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """csr: compressed sparse row 2-D matrix (reference sparse.py:345)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indptr, indices, shape, dtype=None):
+        self._data = jnp.asarray(_unwrap(data))
+        self._indptr = jnp.asarray(_unwrap(indptr)).astype(jnp.int32)
+        self._indices = jnp.asarray(_unwrap(indices)).astype(jnp.int32)
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = onp.dtype(dtype or self._data.dtype)
+
+    @property
+    def data(self):
+        return _wrap_value(self._data)
+
+    @property
+    def indices(self):
+        return _wrap_value(self._indices)
+
+    @property
+    def indptr(self):
+        return _wrap_value(self._indptr)
+
+    @property
+    def nnz(self):
+        return int(self._data.shape[0])
+
+    def copy(self):
+        return CSRNDArray(self._data, self._indptr, self._indices,
+                          self._shape, self._dtype)
+
+    def _row_ids(self):
+        """Expand indptr to one row id per stored element (host-free)."""
+        nnz = self._data.shape[0]
+        if nnz == 0:
+            return jnp.zeros((0,), jnp.int32)
+        # row_ids[j] = #{i : indptr[i+1] <= j}  via searchsorted
+        return (jnp.searchsorted(self._indptr, jnp.arange(nnz), side="right")
+                - 1).astype(jnp.int32)
+
+    def todense(self):
+        out = jnp.zeros(self._shape, self._dtype)
+        if self.nnz:
+            out = out.at[self._row_ids(), self._indices].set(
+                self._data.astype(self._dtype))
+        return _wrap_value(out)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        if stype == "row_sparse":
+            return cast_storage(self.todense(), "row_sparse")
+        raise ValueError(stype)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            # row slicing stays sparse (reference CSRNDArray.__getitem__)
+            start, stop, step = key.indices(self._shape[0])
+            if step != 1:
+                raise ValueError("csr slicing requires step 1")
+            lo = int(self._indptr[start])
+            hi = int(self._indptr[stop])
+            return CSRNDArray(self._data[lo:hi],
+                              self._indptr[start:stop + 1] - lo,
+                              self._indices[lo:hi],
+                              (stop - start, self._shape[1]), self._dtype)
+        return self.todense()[key]
+
+
+# --------------------------------------------------------------------------
+# constructors (reference sparse.py row_sparse_array :1053 / csr_matrix :817)
+# --------------------------------------------------------------------------
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not onp.isscalar(arg1[0]):
+        data, indices = arg1
+        if shape is None:
+            d = onp.asarray(_unwrap(data))
+            idx = onp.asarray(_unwrap(indices))
+            nrows = int(idx.max()) + 1 if idx.size else 0
+            shape = (nrows,) + d.shape[1:]
+        return RowSparseNDArray(data, indices, shape, dtype)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1.copy()
+    dense = arg1 if isinstance(arg1, ndarray) else array(arg1, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise ValueError("csr_matrix from (data, indices, indptr) needs shape")
+        return CSRNDArray(data, indptr, indices, shape, dtype)
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2:
+        data, (row, col) = arg1[0], arg1[1]
+        if shape is None:
+            raise ValueError("coo csr_matrix needs shape")
+        dense = onp.zeros(shape, dtype or onp.asarray(data).dtype)
+        dense[onp.asarray(row), onp.asarray(col)] = onp.asarray(data)
+        return cast_storage(array(dense), "csr")
+    if isinstance(arg1, CSRNDArray):
+        return arg1.copy()
+    dense = arg1 if isinstance(arg1, ndarray) else array(arg1, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = onp.dtype(dtype or "float32")
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
+                                jnp.zeros((0,), jnp.int32), shape, dtype)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype),
+                          jnp.zeros((shape[0] + 1,), jnp.int32),
+                          jnp.zeros((0,), jnp.int32), shape, dtype)
+    from . import numpy as mxnp
+    return mxnp.zeros(shape, dtype=dtype)
+
+
+empty = zeros
+
+
+def array_sparse(source, ctx=None, dtype=None):
+    if isinstance(source, BaseSparseNDArray):
+        return source.copy()
+    return array(source, dtype=dtype)
+
+
+# --------------------------------------------------------------------------
+# cast_storage (reference src/operator/tensor/cast_storage-inl.h)
+# --------------------------------------------------------------------------
+def cast_storage(arr, stype):
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    a = onp.asarray(arr.asnumpy())  # host pass: format conversion is a
+    # data-dependent-shape operation, done host-side like the reference's
+    # CPU cast_storage; the result's buffers live on device again.
+    if stype == "row_sparse":
+        nz_rows = onp.nonzero(a.reshape(a.shape[0], -1).any(axis=1))[0]
+        return RowSparseNDArray(a[nz_rows], nz_rows.astype("int64"),
+                                a.shape, a.dtype)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise ValueError("csr requires 2-D")
+        rows, cols = onp.nonzero(a)
+        data = a[rows, cols]
+        indptr = onp.zeros(a.shape[0] + 1, "int64")
+        onp.add.at(indptr, rows + 1, 1)
+        indptr = onp.cumsum(indptr)
+        return CSRNDArray(data, indptr, cols.astype("int64"), a.shape,
+                          a.dtype)
+    raise ValueError(stype)
+
+
+# --------------------------------------------------------------------------
+# sparse ops (reference dot-inl.h, sparse_retain-inl.h, elemwise sum)
+# --------------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot. csr·dense, csr^T·dense (→ used for embedding-style
+    grads), rsp·dense, dense·dense fall through."""
+    if isinstance(lhs, CSRNDArray):
+        dense_r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+        rv = _unwrap(dense_r)
+        row_ids = lhs._row_ids()
+        if not transpose_a:
+            # out[r, :] = sum_j data[j] * rhs[col[j], :] for j in row r
+            gathered = rv[lhs._indices] * lhs._data[:, None]
+            out = jax.ops.segment_sum(gathered, row_ids,
+                                      num_segments=lhs._shape[0])
+            return _wrap_value(out.astype(lhs._dtype))
+        # csr^T · dense: out[col[j], :] += data[j] * rhs[row[j], :]
+        gathered = rv[row_ids] * lhs._data[:, None]
+        out = jax.ops.segment_sum(gathered, lhs._indices,
+                                  num_segments=lhs._shape[1])
+        return _wrap_value(out.astype(lhs._dtype))
+    if isinstance(lhs, RowSparseNDArray):
+        lhs = lhs.todense()
+    if isinstance(rhs, BaseSparseNDArray):
+        rhs = rhs.todense()
+    lv, rv = _unwrap(lhs), _unwrap(rhs)
+    if transpose_a:
+        lv = lv.T
+    if transpose_b:
+        rv = rv.T
+    return _wrap_value(jnp.dot(lv, rv))
+
+
+def retain(rsp, indices):
+    """Keep only the requested rows (reference sparse_retain)."""
+    want = jnp.asarray(_unwrap(indices)).astype(jnp.int32)
+    # membership mask over stored indices
+    stored = rsp._indices
+    keep = jnp.isin(stored, want)
+    k = onp.asarray(keep)  # host: result shape is data-dependent
+    new_idx = onp.asarray(stored)[k]
+    new_val = onp.asarray(rsp._values)[k]
+    return RowSparseNDArray(new_val, new_idx, rsp._shape, rsp._dtype)
+
+
+def elemwise_add(a, b):
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        idx = onp.union1d(onp.asarray(a._indices), onp.asarray(b._indices))
+        out = jnp.zeros((len(idx),) + a._shape[1:], a._dtype)
+        pos_a = onp.searchsorted(idx, onp.asarray(a._indices))
+        pos_b = onp.searchsorted(idx, onp.asarray(b._indices))
+        out = out.at[pos_a].add(a._values).at[pos_b].add(b._values)
+        return RowSparseNDArray(out, idx.astype("int64"), a._shape, a._dtype)
+    da = a.todense() if isinstance(a, BaseSparseNDArray) else a
+    db = b.todense() if isinstance(b, BaseSparseNDArray) else b
+    return da + db
+
+
+add = elemwise_add

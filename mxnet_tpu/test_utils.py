@@ -91,8 +91,9 @@ def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
                         equal_nan=False):
     """Assert arrays near-equal with max-violation report (reference :655)."""
     an, bn = _asnumpy(a), _asnumpy(b)
-    if an.shape != bn.shape and an.size == bn.size:
-        bn = bn.reshape(an.shape)
+    if an.shape != bn.shape:
+        raise AssertionError("shape mismatch: %s is %s, %s is %s"
+                             % (names[0], an.shape, names[1], bn.shape))
     if onp.allclose(an, bn, rtol=rtol, atol=atol, equal_nan=equal_nan):
         return
     diff = onp.abs(an - bn)

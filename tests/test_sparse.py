@@ -1,0 +1,158 @@
+"""Sparse storage types (reference tests/python/unittest/test_sparse_ndarray.py
+and test_sparse_operator.py, condensed)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu import sparse
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _rand_dense(shape, density=0.4):
+    a = onp.random.uniform(-1, 1, size=shape).astype("float32")
+    a *= onp.random.uniform(size=shape) < density
+    return a
+
+
+def test_cast_storage_roundtrip_rsp():
+    a = _rand_dense((6, 4))
+    rsp = mnp.array(a).tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert_almost_equal(rsp.todense(), a)
+    back = rsp.tostype("default")
+    assert_almost_equal(back, a)
+
+
+def test_cast_storage_roundtrip_csr():
+    a = _rand_dense((5, 7))
+    csr = mnp.array(a).tostype("csr")
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.todense(), a)
+    assert csr.nnz == int((a != 0).sum())
+
+
+def test_row_sparse_array_ctor():
+    data = onp.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    idx = onp.array([1, 3], dtype="int64")
+    rsp = sparse.row_sparse_array((data, idx), shape=(5, 2))
+    dense = rsp.todense().asnumpy()
+    assert dense.shape == (5, 2)
+    assert_almost_equal(dense[1], data[0])
+    assert_almost_equal(dense[3], data[1])
+    assert dense[0].sum() == 0
+
+
+def test_csr_matrix_ctor_and_slice():
+    a = _rand_dense((6, 5))
+    csr = sparse.csr_matrix(a)
+    sl = csr[1:4]
+    assert sl.stype == "csr"
+    assert_almost_equal(sl.todense(), a[1:4])
+
+
+def test_sparse_dot_csr_dense():
+    a = _rand_dense((4, 6))
+    b = onp.random.uniform(size=(6, 3)).astype("float32")
+    csr = sparse.csr_matrix(a)
+    out = sparse.dot(csr, mnp.array(b))
+    assert_almost_equal(out, a @ b, rtol=1e-4, atol=1e-5)
+    # transpose_a: csr^T . dense
+    c = onp.random.uniform(size=(4, 3)).astype("float32")
+    out_t = sparse.dot(csr, mnp.array(c), transpose_a=True)
+    assert_almost_equal(out_t, a.T @ c, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_retain():
+    a = _rand_dense((8, 3), density=1.0)
+    rsp = mnp.array(a).tostype("row_sparse")
+    kept = sparse.retain(rsp, mnp.array([1, 5], dtype="int64"))
+    dense = kept.todense().asnumpy()
+    assert_almost_equal(dense[1], a[1])
+    assert_almost_equal(dense[5], a[5])
+    assert dense[0].sum() == 0 and dense[2].sum() == 0
+
+
+def test_sparse_elemwise_add():
+    a, b = _rand_dense((6, 2)), _rand_dense((6, 2))
+    out = sparse.elemwise_add(mnp.array(a).tostype("row_sparse"),
+                              mnp.array(b).tostype("row_sparse"))
+    assert out.stype == "row_sparse"
+    assert_almost_equal(out.todense(), a + b, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.todense().asnumpy().sum() == 0
+    zc = sparse.zeros("csr", (4, 3))
+    assert zc.todense().asnumpy().sum() == 0
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam", "adagrad"])
+def test_sparse_optimizer_update_matches_dense(opt_name):
+    from mxnet_tpu import optimizer as opt_mod
+    onp.random.seed(0)
+    w0 = onp.random.uniform(size=(6, 4)).astype("float32")
+    g = onp.zeros((6, 4), dtype="float32")
+    g[[1, 4]] = onp.random.uniform(-1, 1, size=(2, 4)).astype("float32")
+
+    def run(sparse_grad):
+        kwargs = {"learning_rate": 0.1}
+        if opt_name in ("sgd", "adam"):
+            kwargs["lazy_update"] = True
+        if opt_name == "sgd":
+            kwargs["momentum"] = 0.9
+        o = opt_mod.create(opt_name, **kwargs)
+        w = mnp.array(w0.copy())
+        s = o.create_state(0, w)
+        grad = mnp.array(g).tostype("row_sparse") if sparse_grad else mnp.array(g)
+        o.update([0], [w], [grad], [s])
+        o.update([0], [w], [grad], [s])
+        return w.asnumpy()
+
+    dense_w = run(False)
+    sparse_w = run(True)
+    # rows 1 and 4 must match the dense update; untouched rows unchanged
+    assert_almost_equal(sparse_w[[1, 4]], dense_w[[1, 4]], rtol=1e-5,
+                        atol=1e-6)
+    assert_almost_equal(sparse_w[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])
+
+
+def test_kvstore_sparse_push_rowsparse_pull():
+    kv = mx.kv.create("local")
+    a = _rand_dense((6, 2))
+    b = _rand_dense((6, 2))
+    kv.init("w", mnp.array(onp.zeros((6, 2), dtype="float32")))
+    kv.push("w", [mnp.array(a).tostype("row_sparse"),
+                  mnp.array(b).tostype("row_sparse")])
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=mnp.array([0, 1, 2, 3, 4, 5],
+                                                       dtype="int64"))
+    assert_almost_equal(out.todense(), a + b, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_sparse_grad_training():
+    from mxnet_tpu.gluon import nn, Trainer
+    from mxnet_tpu import autograd
+    net = nn.Embedding(10, 4, sparse_grad=True)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    w_before = net.weight.data().asnumpy().copy()
+    x = mnp.array([1, 3], dtype="int32")
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    assert not onp.allclose(w_after[1], w_before[1])
+    assert not onp.allclose(w_after[3], w_before[3])
+    assert_almost_equal(w_after[[0, 2, 4, 5, 6, 7, 8, 9]],
+                        w_before[[0, 2, 4, 5, 6, 7, 8, 9]])
+
+
+def test_rand_ndarray_sparse():
+    from mxnet_tpu.test_utils import rand_ndarray
+    r = rand_ndarray((5, 4), stype="row_sparse", density=0.5)
+    assert r.stype == "row_sparse"
+    c = rand_ndarray((5, 4), stype="csr", density=0.5)
+    assert c.stype == "csr"
