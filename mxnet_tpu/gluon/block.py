@@ -397,6 +397,14 @@ class HybridBlock(Block):
         return rebuild(template["out"])
 
     def __call__(self, *args, **kwargs):
+        # remember the call signature so export() can re-trace without the
+        # user passing example inputs (reference: export requires a prior
+        # forward to have fixed the graph)
+        flat = []
+        _flatten_arrays(list(args) + list(kwargs.values()), flat)
+        if flat:
+            self._last_input_avals = [
+                {"shape": list(a.shape), "dtype": str(a.dtype)} for a in flat]
         # first call with deferred params runs eagerly so each layer infers
         # its shapes (reference: deferred init at first forward); subsequent
         # calls hit the compiled cache
@@ -410,21 +418,54 @@ class HybridBlock(Block):
         return super().__call__(*args, **kwargs)
 
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """Deployment export (reference block.py:1514): saves params npz +
-        a JSON descriptor.  Graph JSON parity arrives with SymbolBlock."""
-        import json
-        self.save_parameters("%s-%04d.params.npz" % (path, epoch))
-        meta = {"format": "mxnet_tpu-hybridblock", "class": type(self).__name__}
-        with open(path + "-symbol.json", "w") as f:
-            json.dump(meta, f)
-        return path + "-symbol.json", "%s-%04d.params.npz" % (path, epoch)
+        """Deployment export (reference block.py:1514): writes the
+        `-symbol.json` (StableHLO program + signature, see symbol.py) and
+        `-NNNN.params.npz` artifact pair.  The block must have been called
+        at least once so the input signature is known."""
+        if not getattr(self, "_last_input_avals", None):
+            raise ValueError(
+                "export requires the block to have been run at least once "
+                "(reference: HybridBlock.export after a forward)")
+        from ..symbol import trace_block
+        sym = trace_block(self, self._last_input_avals, train=False)
+        sym.save(path + "-symbol.json")
+        params_file = "%s-%04d.params.npz" % (path, epoch)
+        self.save_parameters(params_file)
+        return path + "-symbol.json", params_file
 
 
 class SymbolBlock(HybridBlock):
-    """Placeholder for imported-graph execution (reference block.py:1716).
-    Full import lands with the serialization milestone."""
+    """Run an imported serialized graph (reference block.py:1716).
 
-    def __init__(self, outputs=None, inputs=None):
+    forward() executes the deserialized StableHLO program — inference
+    deployment path; gradients flow when the artifact was produced in
+    this process, while a cold-loaded artifact is inference-only."""
+
+    def __init__(self, symbol, params=None):
         super().__init__()
-        raise NotImplementedError(
-            "SymbolBlock import arrives with graph serialization parity")
+        self._symbol = symbol
+        self._param_vals = params or {}
+
+    @staticmethod
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None,
+                device=None, allow_missing_params=False):
+        """Load -symbol.json (+ params npz) into a runnable block
+        (parity: SymbolBlock.imports)."""
+        from ..symbol import Symbol
+        sym = Symbol.load(symbol_file)
+        params = {}
+        if param_file:
+            loaded = onp.load(param_file)
+            params = {k: jnp.asarray(loaded[k]) for k in loaded.files}
+        missing = set(sym.param_avals) - set(params)
+        if missing and not allow_missing_params:
+            raise ValueError("missing parameters: %s" % sorted(missing))
+        return SymbolBlock(sym, params)
+
+    def forward(self, *args):
+        return apply_op(lambda *iv: self._symbol(self._param_vals, *iv),
+                        *args)
+
+    def collect_params(self, select=None):
+        # imported params are plain buffers, not trainable Parameters
+        return OrderedDict()

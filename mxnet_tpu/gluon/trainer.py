@@ -103,7 +103,8 @@ class Trainer:
                 self._states[i] = self._optimizer.create_state_multi_precision(
                     i, p.data())
             grad = p.grad()
-            if getattr(p, "_grad_stype", "default") == "row_sparse":
+            if (getattr(p, "_grad_stype", "default") == "row_sparse"
+                    and getattr(self._optimizer, "lazy_update", False)):
                 # sparse_grad path (Embedding): hand the optimizer a
                 # row_sparse view so only touched rows update (reference
                 # lazy_update kernels, src/operator/optimizer_op.cc).

@@ -165,7 +165,7 @@ class KVStore(KVStoreBase):
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
             for k, o in zip(key, out):
-                self.pull(k, o, priority)
+                self.pull(k, o, priority, ignore_sparse)
             return
         v = self._data[str(key)]
         from ..sparse import BaseSparseNDArray

@@ -8,6 +8,37 @@ against mx.nd keep running.
 from .numpy import *  # noqa: F401,F403
 from .numpy import random, linalg  # noqa: F401
 from .ndarray import ndarray as NDArray, array, waitall  # noqa: F401
-from .numpy_extension import save, load, savez  # noqa: F401
+from .numpy_extension import savez  # noqa: F401
+
+
+def save(fname, data):
+    """Save a list or dict of arrays to one file (parity: mx.nd.save,
+    reference NDArray binary container src/ndarray/ndarray.cc:1720;
+    here an npz container with a list/dict marker)."""
+    import numpy as _onp
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        arrays = {"__mx_list_%d" % i: a.asnumpy() for i, a in enumerate(data)}
+    elif isinstance(data, dict):
+        arrays = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise TypeError("save expects NDArray, list, or dict")
+    _onp.savez(fname, **arrays)
+
+
+def load(fname):
+    """Load arrays saved by mx.nd.save → list or dict (parity: mx.nd.load)."""
+    import numpy as _onp
+    try:
+        data = _onp.load(fname, allow_pickle=False)
+    except FileNotFoundError:
+        data = _onp.load(fname + ".npz", allow_pickle=False)
+    import builtins
+    keys = list(data.files)
+    if keys and builtins.all(k.startswith("__mx_list_") for k in keys):
+        keys.sort(key=lambda k: int(k.rsplit("_", 1)[1]))
+        return [array(data[k]) for k in keys]
+    return {k: array(data[k]) for k in keys}
 from . import numpy_extension as contrib  # noqa: F401  (mx.nd.contrib.*)
 from . import sparse  # noqa: F401  (mx.nd.sparse.*)

@@ -77,7 +77,12 @@ class BaseSparseNDArray:
 
     def astype(self, dtype):
         out = self.copy()
-        out._dtype = onp.dtype(dtype)
+        dt = onp.dtype(dtype)
+        out._dtype = dt
+        if hasattr(out, "_values"):
+            out._values = out._values.astype(dt)
+        if hasattr(out, "_data"):
+            out._data = out._data.astype(dt)
         return out
 
     def __eq__(self, other):  # dense compare semantics
@@ -218,7 +223,9 @@ class CSRNDArray(BaseSparseNDArray):
 # constructors (reference sparse.py row_sparse_array :1053 / csr_matrix :817)
 # --------------------------------------------------------------------------
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
-    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not onp.isscalar(arg1[0]):
+    # only a *tuple* is the (data, indices) pair form, as in the reference;
+    # lists are dense array literals
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not onp.isscalar(arg1[0]):
         data, indices = arg1
         if shape is None:
             d = onp.asarray(_unwrap(data))
@@ -233,12 +240,12 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
-    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+    if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         if shape is None:
             raise ValueError("csr_matrix from (data, indices, indptr) needs shape")
         return CSRNDArray(data, indptr, indices, shape, dtype)
-    if isinstance(arg1, (tuple, list)) and len(arg1) == 2:
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not onp.isscalar(arg1[0]):
         data, (row, col) = arg1[0], arg1[1]
         if shape is None:
             raise ValueError("coo csr_matrix needs shape")
@@ -310,17 +317,24 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     if isinstance(lhs, CSRNDArray):
         dense_r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
         rv = _unwrap(dense_r)
+        if transpose_b:
+            rv = rv.T
+        vec = rv.ndim == 1
+        if vec:
+            rv = rv[:, None]
         row_ids = lhs._row_ids()
         if not transpose_a:
             # out[r, :] = sum_j data[j] * rhs[col[j], :] for j in row r
             gathered = rv[lhs._indices] * lhs._data[:, None]
             out = jax.ops.segment_sum(gathered, row_ids,
                                       num_segments=lhs._shape[0])
-            return _wrap_value(out.astype(lhs._dtype))
-        # csr^T · dense: out[col[j], :] += data[j] * rhs[row[j], :]
-        gathered = rv[row_ids] * lhs._data[:, None]
-        out = jax.ops.segment_sum(gathered, lhs._indices,
-                                  num_segments=lhs._shape[1])
+        else:
+            # csr^T · dense: out[col[j], :] += data[j] * rhs[row[j], :]
+            gathered = rv[row_ids] * lhs._data[:, None]
+            out = jax.ops.segment_sum(gathered, lhs._indices,
+                                      num_segments=lhs._shape[1])
+        if vec:
+            out = out[:, 0]
         return _wrap_value(out.astype(lhs._dtype))
     if isinstance(lhs, RowSparseNDArray):
         lhs = lhs.todense()

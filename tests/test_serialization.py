@@ -1,0 +1,109 @@
+"""Export/import + save/load serialization (reference: HybridBlock.export,
+SymbolBlock.imports, mx.nd.save/load, Block.save_parameters)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import SymbolBlock
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _small_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def test_export_and_symbolblock_imports(tmp_path):
+    net = _small_net()
+    x = mnp.random.uniform(size=(2, 8))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "model")
+    sym_file, params_file = net.export(prefix, epoch=3)
+    assert sym_file.endswith("-symbol.json")
+    assert params_file.endswith("-0003.params.npz")
+    assert os.path.exists(sym_file) and os.path.exists(params_file)
+
+    imported = SymbolBlock.imports(sym_file, param_file=params_file)
+    out = imported(x).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_symbol_introspection(tmp_path):
+    net = _small_net()
+    x = mnp.random.uniform(size=(2, 8))
+    net(x)
+    from mxnet_tpu.symbol import trace_block, Symbol
+    sym = trace_block(net, [{"shape": [2, 8], "dtype": "float32"}])
+    pshapes, ishapes = sym.infer_shape()
+    assert ishapes == [(2, 8)]
+    assert any(s == (16, 8) for s in pshapes.values())
+    assert "stablehlo" in sym.mlir_module or "func" in sym.mlir_module
+    # json round-trip
+    sym2 = Symbol.fromjson(sym.tojson())
+    assert sym2.infer_shape() == sym.infer_shape()
+
+
+def test_export_requires_prior_forward(tmp_path):
+    net = _small_net()
+    with pytest.raises(ValueError):
+        net.export(str(tmp_path / "m"))
+
+
+def test_symbolblock_missing_params(tmp_path):
+    net = _small_net()
+    x = mnp.random.uniform(size=(1, 8))
+    net(x)
+    sym_file, params_file = net.export(str(tmp_path / "m"))
+    with pytest.raises(ValueError):
+        SymbolBlock.imports(sym_file)  # no params given
+
+
+def test_nd_save_load_list(tmp_path):
+    a = mnp.random.uniform(size=(3, 2))
+    b = mnp.arange(5)
+    fname = str(tmp_path / "arrays.npz")
+    mx.nd.save(fname, [a, b])
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    assert_almost_equal(loaded[0], a)
+    assert_almost_equal(loaded[1], b)
+
+
+def test_nd_save_load_dict(tmp_path):
+    d = {"w": mnp.random.uniform(size=(2, 2)), "b": mnp.zeros((2,))}
+    fname = str(tmp_path / "named.npz")
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, dict) and set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"])
+
+
+def test_save_load_parameters_roundtrip(tmp_path):
+    net = _small_net()
+    x = mnp.random.uniform(size=(2, 8))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "p.npz")
+    net.save_parameters(f)
+    net2 = _small_net()
+    net2(x)  # finalize shapes
+    net2.load_parameters(f)
+    assert_almost_equal(net2(x), ref, rtol=1e-6, atol=1e-7)
+
+
+def test_export_conv_model(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2), nn.Flatten(),
+            nn.Dense(3))
+    net.initialize()
+    x = mnp.random.uniform(size=(2, 3, 8, 8))
+    ref = net(x).asnumpy()
+    sym_file, params_file = net.export(str(tmp_path / "conv"))
+    imported = SymbolBlock.imports(sym_file, param_file=params_file)
+    assert_almost_equal(imported(x), ref, rtol=1e-5, atol=1e-5)

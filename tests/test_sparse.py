@@ -156,3 +156,32 @@ def test_rand_ndarray_sparse():
     assert r.stype == "row_sparse"
     c = rand_ndarray((5, 4), stype="csr", density=0.5)
     assert c.stype == "csr"
+
+
+def test_sparse_dot_vector_and_transpose_b():
+    a = _rand_dense((4, 6))
+    v = onp.random.uniform(size=(6,)).astype("float32")
+    csr = sparse.csr_matrix(a)
+    out = sparse.dot(csr, mnp.array(v))
+    assert out.shape == (4,)
+    assert_almost_equal(out, a @ v, rtol=1e-4, atol=1e-5)
+    b = onp.random.uniform(size=(3, 6)).astype("float32")
+    out_tb = sparse.dot(csr, mnp.array(b), transpose_b=True)
+    assert_almost_equal(out_tb, a @ b.T, rtol=1e-4, atol=1e-5)
+
+
+def test_dense_list_literal_constructors():
+    rsp = sparse.row_sparse_array([[0.0, 0.0], [1.0, 2.0]])
+    assert rsp.stype == "row_sparse"
+    assert_almost_equal(rsp.todense(), onp.array([[0.0, 0.0], [1.0, 2.0]]))
+    csr = sparse.csr_matrix([[1.0, 0.0], [0.0, 1.0]])
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.todense(), onp.eye(2, dtype="float32"))
+
+
+def test_sparse_astype_casts_buffers():
+    rsp = sparse.row_sparse_array((onp.ones((1, 2), "float32"),
+                                   onp.array([0], "int64")), shape=(2, 2))
+    r16 = rsp.astype("float16")  # float16: cast works without x64 mode
+    assert r16.data.dtype == onp.float16
+    assert r16.dtype == onp.float16
