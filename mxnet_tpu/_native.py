@@ -1,0 +1,160 @@
+"""ctypes bindings for the native host runtime (libmxtpu_core.so).
+
+The C++ core (src/mxtpu/) re-provides the reference's native runtime
+pieces — dependency engine (reference src/engine/threaded_engine.cc),
+pooled storage (src/storage/pooled_storage_manager.h), recordio
+(dmlc-core recordio + python/mxnet/recordio.py), threaded prefetch
+(src/io/iter_prefetcher.h) — behind a plain C ABI.  This module loads the
+shared object (building it on first use when a toolchain is present) and
+exposes typed wrappers.  Every consumer has a pure-Python fallback so the
+framework still works without a C++ toolchain; `lib() is None` is the
+feature probe (surfaced via mx.runtime.Features 'NATIVE_RUNTIME').
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libmxtpu_core.so")
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "src")
+
+# callback: int fn(void* ctx, char* err_buf, int err_len).  err_buf is
+# declared void* — with c_char_p ctypes would hand the callback an immutable
+# bytes copy instead of the writable native buffer.
+ASYNC_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                            ctypes.c_void_p, ctypes.c_int)
+
+
+def _declare(lib):
+    u64 = ctypes.c_uint64
+    i64 = ctypes.c_int64
+    p = ctypes.c_void_p
+    lib.MXTEngineCreate.restype = p
+    lib.MXTEngineCreate.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.MXTEngineDestroy.argtypes = [p]
+    lib.MXTEngineNewVar.restype = u64
+    lib.MXTEngineNewVar.argtypes = [p]
+    lib.MXTEngineDeleteVar.restype = ctypes.c_int
+    lib.MXTEngineDeleteVar.argtypes = [p, u64]
+    lib.MXTEnginePushAsync.restype = ctypes.c_int
+    lib.MXTEnginePushAsync.argtypes = [p, ASYNC_FN, p,
+                                       ctypes.POINTER(u64), ctypes.c_int,
+                                       ctypes.POINTER(u64), ctypes.c_int,
+                                       ctypes.c_int]
+    lib.MXTEngineWaitForVar.restype = ctypes.c_int
+    lib.MXTEngineWaitForVar.argtypes = [p, u64, ctypes.c_char_p, ctypes.c_int]
+    lib.MXTEngineWaitForAll.argtypes = [p]
+    lib.MXTEnginePendingCount.restype = ctypes.c_int
+    lib.MXTEnginePendingCount.argtypes = [p]
+
+    lib.MXTStorageCreate.restype = p
+    lib.MXTStorageCreate.argtypes = [ctypes.c_int, u64, u64]
+    lib.MXTStorageDestroy.argtypes = [p]
+    lib.MXTStorageAlloc.restype = p
+    lib.MXTStorageAlloc.argtypes = [p, u64]
+    lib.MXTStorageFree.argtypes = [p, p]
+    lib.MXTStorageDirectFree.argtypes = [p, p]
+    lib.MXTStorageReleaseAll.argtypes = [p]
+    lib.MXTStorageStats.argtypes = [p, ctypes.POINTER(u64)]
+
+    lib.MXTRecordIOWriterCreate.restype = p
+    lib.MXTRecordIOWriterCreate.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.MXTRecordIOWriterWrite.restype = ctypes.c_int
+    lib.MXTRecordIOWriterWrite.argtypes = [p, ctypes.c_char_p, u64]
+    lib.MXTRecordIOWriterTell.restype = i64
+    lib.MXTRecordIOWriterTell.argtypes = [p]
+    lib.MXTRecordIOWriterDestroy.argtypes = [p]
+    lib.MXTRecordIOReaderCreate.restype = p
+    lib.MXTRecordIOReaderCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordIOReaderNext.restype = ctypes.c_int
+    lib.MXTRecordIOReaderNext.argtypes = [p, ctypes.POINTER(ctypes.c_void_p),
+                                          ctypes.POINTER(u64)]
+    lib.MXTRecordIOReaderSeek.restype = ctypes.c_int
+    lib.MXTRecordIOReaderSeek.argtypes = [p, i64]
+    lib.MXTRecordIOReaderTell.restype = i64
+    lib.MXTRecordIOReaderTell.argtypes = [p]
+    lib.MXTRecordIOReaderDestroy.argtypes = [p]
+    lib.MXTRecordIOFreeBuffer.argtypes = [ctypes.c_void_p]
+
+    lib.MXTQueueCreate.restype = p
+    lib.MXTQueueCreate.argtypes = [u64]
+    lib.MXTQueueDestroy.argtypes = [p]
+    lib.MXTQueuePush.restype = ctypes.c_int
+    lib.MXTQueuePush.argtypes = [p, ctypes.c_char_p, u64]
+    lib.MXTQueuePop.restype = ctypes.c_int
+    lib.MXTQueuePop.argtypes = [p, ctypes.POINTER(ctypes.c_void_p),
+                                ctypes.POINTER(u64)]
+    lib.MXTQueueClose.argtypes = [p]
+    lib.MXTQueueSize.restype = u64
+    lib.MXTQueueSize.argtypes = [p]
+
+    lib.MXTPrefetcherCreate.restype = p
+    lib.MXTPrefetcherCreate.argtypes = [ctypes.c_char_p, u64,
+                                        ctypes.POINTER(i64), u64]
+    lib.MXTPrefetcherPop.restype = ctypes.c_int
+    lib.MXTPrefetcherPop.argtypes = [p, ctypes.POINTER(ctypes.c_void_p),
+                                     ctypes.POINTER(u64)]
+    lib.MXTPrefetcherDestroy.argtypes = [p]
+    return lib
+
+
+def _try_build():
+    if not os.path.isdir(_SRC_DIR):
+        return False
+    try:
+        subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                       timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _stale():
+    """True when any C++ source is newer than the built .so."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    so_mtime = os.path.getmtime(_LIB_PATH)
+    mx_dir = os.path.join(_SRC_DIR, "mxtpu")
+    if not os.path.isdir(mx_dir):
+        return False
+    for name in os.listdir(mx_dir):
+        if name.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(mx_dir, name)) > so_mtime:
+                return True
+    return False
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("MXNET_TPU_DISABLE_NATIVE", "") == "1":
+            return None
+        if _stale():
+            _try_build()
+        if os.path.exists(_LIB_PATH):
+            try:
+                _LIB = _declare(ctypes.CDLL(_LIB_PATH))
+            except Exception:
+                _LIB = None
+        return _LIB
+
+
+def read_buffer(ptr, size):
+    """Copy a malloc'd native buffer into bytes and free it."""
+    L = lib()
+    data = ctypes.string_at(ptr, size)
+    L.MXTRecordIOFreeBuffer(ctypes.cast(ptr, ctypes.c_void_p))
+    return data

@@ -1,29 +1,203 @@
-"""Engine semantics over PJRT async dispatch.
+"""Engine semantics over PJRT async dispatch + the native host engine.
 
 Parity: reference `src/engine/` (ThreadedEnginePerDevice default,
-NaiveEngine debug mode, bulking, WaitForAll/WaitForVar).  TPU-native: PJRT
-already provides async dispatch with per-device program order, so the
-"engine" reduces to: (1) sync points (`waitall`, per-array wait_to_read),
-(2) a NaiveEngine debug mode that blocks after every op
-(`MXNET_ENGINE_TYPE=NaiveEngine`, matching src/engine/engine.cc:32), and
-(3) bulking hints, which XLA supersedes via whole-graph compilation under
-hybridize().
+NaiveEngine debug mode, bulking, WaitForAll/WaitForVar,
+include/mxnet/engine.h:155-264 interface).
+
+TPU-native split of responsibilities:
+- *Device-side* ordering (op after op on the chip) is PJRT's contract —
+  every JAX dispatch returns a buffer future, ordering is per-device
+  program order, sync points are wait_to_read()/asnumpy()/waitall().
+- *Host-side* ordering (IO, host reduces, checkpoint writes, python
+  callbacks racing with each other) is this module: `Engine` wraps the
+  native C++ dependency scheduler (src/mxtpu/engine.cc — the ThreadedVar
+  read/write protocol of src/engine/threaded_engine.h:120-229 with worker
+  thread pools, exception transport and NaiveEngine mode), falling back to
+  a synchronous pure-Python engine when the native library is unavailable.
 """
 from __future__ import annotations
 
 import contextlib
+import ctypes
 import os
+import threading
 
+from ._native import ASYNC_FN, lib as _native_lib
 from .ndarray import waitall as _waitall  # re-export
 
 
 def waitall():
     _waitall()
+    eng = _default_engine
+    if eng is not None:
+        eng.wait_for_all()
 
 
 def engine_type():
     return os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") or \
         "ThreadedEnginePerDevice"
+
+
+class EngineError(RuntimeError):
+    """Exception rethrown at a sync point for a failed async op
+    (parity: engine ExceptionRef rethrow, src/engine/threaded_engine.cc:496)."""
+
+
+class Engine:
+    """Host-side dependency engine (reference Engine ABC,
+    include/mxnet/engine.h).
+
+    push(fn, const_vars, mutable_vars) schedules `fn()` to run on a native
+    worker thread once every listed var is available under the read/write
+    protocol; exceptions raised by `fn` poison the op's mutable vars and
+    re-raise at wait_for_var().
+    """
+
+    def __init__(self, num_workers=0, naive=None):
+        if naive is None:
+            naive = engine_type() == "NaiveEngine"
+        self._naive = naive
+        self._lib = _native_lib()
+        self._cb_lock = threading.Lock()
+        self._callbacks = {}  # cid -> python fn, until executed
+        self._cb_id = 0
+        if self._lib is not None:
+            # ONE persistent ctypes trampoline for the engine's lifetime; the
+            # native side passes the callback id through ctx.  (A per-push
+            # CFuncPtr would have to be freed by the callback itself, which
+            # frees the libffi closure out from under the in-flight call.)
+            self._trampoline = ASYNC_FN(self._dispatch)
+            self._handle = self._lib.MXTEngineCreate(num_workers, int(naive))
+        else:
+            self._handle = None
+            self._py_vars = {}
+            self._py_next = 1
+
+    # -- vars -------------------------------------------------------------
+    def new_variable(self):
+        if self._handle is not None:
+            return self._lib.MXTEngineNewVar(self._handle)
+        v = self._py_next
+        self._py_next += 1
+        self._py_vars[v] = None  # None = clean, else error message
+        return v
+
+    def delete_variable(self, var):
+        if self._handle is not None:
+            self._lib.MXTEngineDeleteVar(self._handle, var)
+        else:
+            self._py_vars.pop(var, None)
+
+    # -- push -------------------------------------------------------------
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        """Schedule fn() honoring read deps (const_vars) and write deps
+        (mutable_vars).  Parity: Engine::PushAsync
+        (src/engine/threaded_engine.cc:318)."""
+        if self._handle is None:
+            # synchronous fallback engine (NaiveEngine semantics)
+            for v in const_vars:
+                err = self._py_vars.get(v)
+                if err:
+                    for m in mutable_vars:
+                        self._py_vars[m] = err
+                    return
+            try:
+                fn()
+                for m in mutable_vars:
+                    self._py_vars[m] = None
+            except Exception as e:  # poison
+                for m in mutable_vars:
+                    self._py_vars[m] = str(e)
+            return
+
+        with self._cb_lock:
+            self._cb_id += 1
+            cid = self._cb_id
+            self._callbacks[cid] = fn
+        n_c, n_m = len(const_vars), len(mutable_vars)
+        c_arr = (ctypes.c_uint64 * max(n_c, 1))(*const_vars)
+        m_arr = (ctypes.c_uint64 * max(n_m, 1))(*mutable_vars)
+        rc = self._lib.MXTEnginePushAsync(
+            self._handle, self._trampoline, ctypes.c_void_p(cid),
+            c_arr, n_c, m_arr, n_m, priority)
+        if rc != 0:
+            with self._cb_lock:
+                self._callbacks.pop(cid, None)
+            raise EngineError("PushAsync failed (unknown variable?)")
+
+    push_async = push
+
+    def _dispatch(self, ctx, err_buf, err_len):
+        """Runs on a native worker thread (ctypes re-acquires the GIL)."""
+        with self._cb_lock:
+            fn = self._callbacks.pop(ctx, None)
+        if fn is None:
+            return 0
+        try:
+            fn()
+            return 0
+        except Exception as e:
+            msg = ("%s: %s" % (type(e).__name__, e)).encode()[: err_len - 1]
+            ctypes.memmove(err_buf, msg + b"\x00", len(msg) + 1)
+            return 1
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        """PushSync parity (include/mxnet/engine.h:264): schedule and wait."""
+        self.push(fn, const_vars, mutable_vars, priority)
+        for v in mutable_vars:
+            self.wait_for_var(v)
+
+    # -- sync -------------------------------------------------------------
+    def wait_for_var(self, var):
+        if self._handle is None:
+            # poison persists until the next successful write, matching the
+            # native engine / reference rethrow contract
+            err = self._py_vars.get(var)
+            if err:
+                raise EngineError(err)
+            return
+        buf = ctypes.create_string_buffer(1024)
+        rc = self._lib.MXTEngineWaitForVar(self._handle, var, buf, 1024)
+        if rc == -1:
+            raise EngineError(buf.value.decode(errors="replace"))
+        if rc == -2:
+            raise EngineError("unknown engine variable %d" % var)
+
+    def wait_for_all(self):
+        if self._handle is not None:
+            self._lib.MXTEngineWaitForAll(self._handle)
+
+    @property
+    def pending(self):
+        if self._handle is not None:
+            return self._lib.MXTEnginePendingCount(self._handle)
+        return 0
+
+    @property
+    def is_native(self):
+        return self._handle is not None
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None) is not None:
+                self._lib.MXTEngineDestroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+_default_engine = None
+_default_lock = threading.Lock()
+
+
+def default_engine():
+    """Process-global host engine (parity: Engine::Get())."""
+    global _default_engine
+    if _default_engine is None:
+        with _default_lock:
+            if _default_engine is None:
+                _default_engine = Engine()
+    return _default_engine
 
 
 @contextlib.contextmanager

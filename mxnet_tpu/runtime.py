@@ -17,10 +17,12 @@ class Features(dict):
     """mx.runtime.Features() — build/runtime feature flags."""
 
     def __init__(self):
+        from ._native import lib as _native_lib
         platforms = {d.platform for d in jax.devices()}
         feats = {
             "TPU": bool(platforms - {"cpu"}),
             "CPU": True,
+            "NATIVE_RUNTIME": _native_lib() is not None,
             "XLA": True,
             "PALLAS": True,
             "BF16": True,

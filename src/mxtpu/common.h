@@ -1,0 +1,35 @@
+// common.h — shared helpers for the mxtpu native runtime.
+//
+// TPU-native core runtime (SURVEY.md §2.8): the C++ layer under the Python
+// frontend.  The compute path is XLA/PJRT (driven from Python via JAX); this
+// library provides the host-side runtime the reference implements in
+// src/engine/, src/storage/, src/io/ — dependency scheduling, pooled host
+// memory, record IO and prefetching — as native code, exported through a
+// plain C ABI consumed with ctypes.
+#ifndef MXTPU_COMMON_H_
+#define MXTPU_COMMON_H_
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#if defined(_WIN32)
+#define MXTPU_API extern "C" __declspec(dllexport)
+#else
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace mxtpu {
+
+// copy an error message into a caller-provided buffer (always NUL-terminated)
+inline void CopyErr(const std::string& msg, char* buf, int buf_len) {
+  if (buf == nullptr || buf_len <= 0) return;
+  int n = static_cast<int>(msg.size());
+  if (n >= buf_len) n = buf_len - 1;
+  std::memcpy(buf, msg.data(), n);
+  buf[n] = '\0';
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_COMMON_H_

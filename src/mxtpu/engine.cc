@@ -1,0 +1,425 @@
+// engine.cc — the dependency-scheduling engine, native.
+//
+// Re-provides the reference's threaded engine semantics
+// (reference: src/engine/threaded_engine.{h,cc} — ThreadedVar with
+// AppendReadDependency/AppendWriteDependency/CompleteReadDependency/
+// CompleteWriteDependency, OprBlock wait counters, per-device thread pools in
+// src/engine/threaded_engine_perdevice.cc, NaiveEngine in
+// src/engine/naive_engine.cc, exception transport via ExceptionRef rethrown
+// at WaitForVar, src/engine/threaded_engine.cc:496) as a TPU-native host
+// scheduler.  Device-side ordering is PJRT's job; this engine orders *host*
+// actions — IO, host reduces, checkpoint writes, python callbacks — by the
+// same read/write variable protocol, so compute/communication/IO overlap
+// without data races.
+//
+// Design (not a translation): a Var is a small queued readers-writer state
+// machine guarded by its own mutex (the reference uses a lock-free linked
+// list; a per-var mutex is simpler and the contention profile on host-side
+// ops is negligible).  An Opr carries an atomic wait counter initialized to
+// 1 + (#vars it must queue behind); the final decrement schedules it onto a
+// priority thread pool.  Callbacks are C function pointers (ctypes acquires
+// the GIL when the callback re-enters Python).  A callback returning nonzero
+// poisons the op's mutable vars; poisoned vars fail WaitForVar and propagate
+// to downstream ops, which skip execution — the ExceptionRef analog.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "common.h"
+
+namespace mxtpu {
+namespace engine {
+
+// callback signature: fn(ctx, err_buf, err_buf_len) -> 0 on success
+typedef int (*AsyncFn)(void* ctx, char* err_buf, int err_buf_len);
+
+struct Opr;
+
+struct Var {
+  std::mutex mu;
+  // ops queued behind the currently running ones, in program order
+  struct Pending {
+    Opr* opr;
+    bool is_write;
+  };
+  std::deque<Pending> queue;
+  int dispatched_reads = 0;   // readers dispatched & not yet completed
+  bool dispatched_write = false;
+  bool poisoned = false;      // an op writing this var failed
+  std::string poison_msg;
+};
+
+struct Opr {
+  AsyncFn fn = nullptr;
+  void* ctx = nullptr;
+  // shared ownership: a Var stays alive while any queued/running op (or the
+  // id map) references it, so DeleteVariable can never free it under a
+  // concurrent PushAsync/WaitForVar (the reference reaches the same safety
+  // via its object pool + delete-var engine op)
+  std::vector<std::shared_ptr<Var>> const_vars;
+  std::vector<std::shared_ptr<Var>> mutable_vars;
+  std::atomic<int> wait{1};
+  int priority = 0;
+  uint64_t seq = 0;
+  bool is_delete = false;  // DeleteVariable sentinel
+  bool no_skip = false;    // run fn even when inputs are poisoned
+                           // (reference: FnProperty::kNoSkip — WaitForVar
+                           // probes must always fire)
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers, bool naive)
+      : naive_(naive), shutdown_(false), pending_(0) {
+    if (num_workers <= 0) {
+      const char* env = std::getenv("MXNET_CPU_WORKER_NTHREADS");
+      num_workers = env ? std::atoi(env) : 0;
+      if (num_workers <= 0) {
+        num_workers = static_cast<int>(std::thread::hardware_concurrency());
+        if (num_workers > 4) num_workers = 4;  // host-op pool, not compute
+        if (num_workers < 1) num_workers = 1;
+      }
+    }
+    if (!naive_) {
+      for (int i = 0; i < num_workers; ++i) {
+        workers_.emplace_back([this]() { this->WorkerLoop(); });
+      }
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      shutdown_ = true;
+    }
+    pool_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    vars_.clear();
+  }
+
+  uint64_t NewVariable() {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    uint64_t id = next_var_id_++;
+    vars_[id] = std::make_shared<Var>();
+    return id;
+  }
+
+  std::shared_ptr<Var> Lookup(uint64_t id) {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    auto it = vars_.find(id);
+    return it == vars_.end() ? nullptr : it->second;
+  }
+
+  // Push an operation.  Ownership of nothing is transferred; `ctx` must stay
+  // alive until the callback runs (Python side keeps a reference).
+  int PushAsync(AsyncFn fn, void* ctx, const uint64_t* cvars, int n_const,
+                const uint64_t* mvars, int n_mut, int priority,
+                bool no_skip = false) {
+    Opr* opr = new Opr();
+    opr->fn = fn;
+    opr->ctx = ctx;
+    opr->no_skip = no_skip;
+    opr->priority = priority;
+    opr->seq = seq_.fetch_add(1);
+    // dedupe: a var both read and written is a write dep only, and repeats
+    // within either list are dropped — a duplicate registration would make
+    // the op queue behind itself and deadlock (reference CHECKs disjointness
+    // in ThreadedEngine::PushAsync; we pre-dedupe the way
+    // Imperative::SetDependencies does)
+    std::unordered_set<uint64_t> muts(mvars, mvars + n_mut);
+    std::unordered_set<uint64_t> seen;
+    {
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      for (int i = 0; i < n_const; ++i) {
+        if (muts.count(cvars[i]) || !seen.insert(cvars[i]).second) continue;
+        auto it = vars_.find(cvars[i]);
+        if (it == vars_.end()) { delete opr; return -2; }
+        opr->const_vars.push_back(it->second);
+      }
+      for (int i = 0; i < n_mut; ++i) {
+        if (!seen.insert(mvars[i]).second) continue;
+        auto it = vars_.find(mvars[i]);
+        if (it == vars_.end()) { delete opr; return -2; }
+        opr->mutable_vars.push_back(it->second);
+      }
+    }
+    pending_.fetch_add(1);
+    // register read deps (AppendReadDependency analog).  NOTE: the wait
+    // counter is bumped BEFORE the op is visible in a var queue — a
+    // concurrent DrainLocked may fetch_sub the moment it sees the entry.
+    for (auto& v : opr->const_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (!v->dispatched_write && v->queue.empty()) {
+        v->dispatched_reads += 1;  // can run immediately
+      } else {
+        opr->wait.fetch_add(1);
+        v->queue.push_back({opr, false});
+      }
+    }
+    // register write deps (AppendWriteDependency analog)
+    for (auto& v : opr->mutable_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (!v->dispatched_write && v->dispatched_reads == 0 && v->queue.empty()) {
+        v->dispatched_write = true;
+      } else {
+        opr->wait.fetch_add(1);
+        v->queue.push_back({opr, true});
+      }
+    }
+    if (opr->wait.fetch_sub(1) == 1) Schedule(opr);
+    return 0;
+  }
+
+  int DeleteVariable(uint64_t var_id) {
+    std::shared_ptr<Var> v = Lookup(var_id);
+    if (v == nullptr) return -2;
+    // scheduled as a write op so deletion happens after all pending users
+    // (reference: FnProperty::kDeleteVar, threaded_engine_perdevice.cc:97);
+    // the final free happens when the last shared_ptr drops.
+    Opr* opr = new Opr();
+    opr->is_delete = true;
+    opr->seq = seq_.fetch_add(1);
+    opr->mutable_vars.push_back(v);
+    pending_.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (!v->dispatched_write && v->dispatched_reads == 0 && v->queue.empty()) {
+        v->dispatched_write = true;
+      } else {
+        opr->wait.fetch_add(1);
+        v->queue.push_back({opr, true});
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      vars_.erase(var_id);  // no new ops may reference it
+    }
+    if (opr->wait.fetch_sub(1) == 1) Schedule(opr);
+    return 0;
+  }
+
+  // Block until `var` is produced; returns 0, or -1 with the poison message
+  // (the rethrow-at-sync-point contract, threaded_engine.cc:379,:496).
+  int WaitForVar(uint64_t var_id, char* err_buf, int err_len) {
+    std::shared_ptr<Var> v = Lookup(var_id);
+    if (v == nullptr) return -2;
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    struct WaitCtx { std::mutex* m; std::condition_variable* cv; bool* done; };
+    WaitCtx wctx{&m, &cv, &done};
+    AsyncFn fn = [](void* c, char*, int) -> int {
+      WaitCtx* w = static_cast<WaitCtx*>(c);
+      std::lock_guard<std::mutex> lk(*w->m);
+      *w->done = true;
+      w->cv->notify_all();
+      return 0;
+    };
+    int rc = PushAsync(fn, &wctx, &var_id, 1, nullptr, 0, 1 << 20,
+                       /*no_skip=*/true);
+    if (rc != 0) return rc;
+    {
+      std::unique_lock<std::mutex> lk(m);
+      cv.wait(lk, [&]() { return done; });
+    }
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->poisoned) {
+      CopyErr(v->poison_msg, err_buf, err_len);
+      return -1;
+    }
+    return 0;
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(all_mu_);
+    all_cv_.wait(lk, [&]() { return pending_.load() == 0; });
+  }
+
+  int PendingCount() { return pending_.load(); }
+
+ private:
+  void Schedule(Opr* opr) {
+    if (naive_) {
+      Execute(opr);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      pool_.push(opr);
+    }
+    pool_cv_.notify_one();
+  }
+
+  struct OprCmp {
+    bool operator()(const Opr* a, const Opr* b) const {
+      if (a->priority != b->priority) return a->priority < b->priority;
+      return a->seq > b->seq;  // FIFO within a priority class
+    }
+  };
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* opr = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(pool_mu_);
+        pool_cv_.wait(lk, [&]() { return shutdown_ || !pool_.empty(); });
+        if (shutdown_ && pool_.empty()) return;
+        opr = pool_.top();
+        pool_.pop();
+      }
+      Execute(opr);
+    }
+  }
+
+  void Execute(Opr* opr) {
+    // propagate poison from inputs: skip body, taint outputs
+    // (reference: ThreadedEngine::ExecuteOprBlock exception shortcut)
+    std::string inherited;
+    bool skip = false;
+    for (auto& v : opr->const_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (v->poisoned) { skip = true; inherited = v->poison_msg; break; }
+    }
+    if (skip && opr->no_skip) skip = false;
+    if (!skip && opr->fn != nullptr) {
+      char err[1024];
+      err[0] = '\0';
+      int rc = opr->fn(opr->ctx, err, sizeof(err));
+      if (rc != 0) {
+        skip = true;
+        inherited = err[0] ? err : "operator failed";
+      } else {
+        // a successful write clears previous poison (new value produced)
+        for (auto& v : opr->mutable_vars) {
+          std::lock_guard<std::mutex> lk(v->mu);
+          v->poisoned = false;
+          v->poison_msg.clear();
+        }
+      }
+    }
+    if (skip) {
+      for (auto& v : opr->mutable_vars) {
+        std::lock_guard<std::mutex> lk(v->mu);
+        v->poisoned = true;
+        v->poison_msg = inherited;
+      }
+    }
+    OnComplete(opr);
+  }
+
+  // CompleteReadDependency / CompleteWriteDependency analogs
+  // (threaded_engine.h:163-229): release this op's hold on each var and
+  // dispatch whatever became runnable.
+  void OnComplete(Opr* opr) {
+    std::vector<Opr*> ready;
+    for (auto& v : opr->const_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->dispatched_reads -= 1;
+      DrainLocked(v.get(), &ready);
+    }
+    for (auto& v : opr->mutable_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->dispatched_write = false;
+      DrainLocked(v.get(), &ready);
+    }
+    delete opr;  // drops its shared_ptr refs; a deleted var frees here
+    if (pending_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(all_mu_);
+      all_cv_.notify_all();
+    }
+    for (Opr* r : ready) {
+      if (r->wait.fetch_sub(1) == 1) Schedule(r);
+    }
+  }
+
+  // with v->mu held: dispatch queued ops now unblocked on v
+  void DrainLocked(Var* v, std::vector<Opr*>* ready) {
+    while (!v->queue.empty()) {
+      Var::Pending& front = v->queue.front();
+      if (front.is_write) {
+        if (v->dispatched_reads == 0 && !v->dispatched_write) {
+          v->dispatched_write = true;
+          ready->push_back(front.opr);
+          v->queue.pop_front();
+        }
+        break;  // writer pending: later readers must queue behind it
+      } else {
+        if (v->dispatched_write) break;
+        v->dispatched_reads += 1;
+        ready->push_back(front.opr);
+        v->queue.pop_front();
+        // keep draining consecutive readers
+      }
+    }
+  }
+
+  bool naive_;
+  std::vector<std::thread> workers_;
+  std::mutex pool_mu_;
+  std::condition_variable pool_cv_;
+  std::priority_queue<Opr*, std::vector<Opr*>, OprCmp> pool_;
+  bool shutdown_;
+
+  std::mutex vars_mu_;
+  std::unordered_map<uint64_t, std::shared_ptr<Var>> vars_;
+  uint64_t next_var_id_ = 1;
+  std::atomic<uint64_t> seq_{0};
+
+  std::atomic<int> pending_;
+  std::mutex all_mu_;
+  std::condition_variable all_cv_;
+};
+
+}  // namespace engine
+}  // namespace mxtpu
+
+using mxtpu::engine::AsyncFn;
+using mxtpu::engine::Engine;
+
+MXTPU_API void* MXTEngineCreate(int num_workers, int naive) {
+  return new Engine(num_workers, naive != 0);
+}
+
+MXTPU_API void MXTEngineDestroy(void* h) { delete static_cast<Engine*>(h); }
+
+MXTPU_API uint64_t MXTEngineNewVar(void* h) {
+  return static_cast<Engine*>(h)->NewVariable();
+}
+
+MXTPU_API int MXTEngineDeleteVar(void* h, uint64_t var) {
+  return static_cast<Engine*>(h)->DeleteVariable(var);
+}
+
+MXTPU_API int MXTEnginePushAsync(void* h, AsyncFn fn, void* ctx,
+                                 const uint64_t* cvars, int n_const,
+                                 const uint64_t* mvars, int n_mut,
+                                 int priority) {
+  return static_cast<Engine*>(h)->PushAsync(fn, ctx, cvars, n_const, mvars,
+                                            n_mut, priority);
+}
+
+MXTPU_API int MXTEngineWaitForVar(void* h, uint64_t var, char* err_buf,
+                                  int err_len) {
+  return static_cast<Engine*>(h)->WaitForVar(var, err_buf, err_len);
+}
+
+MXTPU_API void MXTEngineWaitForAll(void* h) {
+  static_cast<Engine*>(h)->WaitForAll();
+}
+
+MXTPU_API int MXTEnginePendingCount(void* h) {
+  return static_cast<Engine*>(h)->PendingCount();
+}

@@ -1,0 +1,209 @@
+// queue.cc — bounded blocking byte-buffer queue + threaded record prefetcher.
+//
+// Re-provides the reference's prefetching pipeline machinery
+// (dmlc::ThreadedIter<DataBatch> double-buffering used by
+// src/io/iter_prefetcher.h:154, and the decode/read-ahead thread pool of
+// src/io/iter_image_recordio_2.cc) for the TPU data path.  Keeping the TPU
+// fed is a host-bandwidth problem: record reads and buffer handoffs happen
+// on native threads with the GIL released; Python only pays a memcpy when
+// it pops a finished buffer.
+//
+// Two exports:
+//  - MXTQueue*: generic MPMC bounded queue of malloc'd byte buffers
+//    (DataLoader worker→pin→device handoff).
+//  - MXTPrefetcher*: a reader thread that pulls records from a RecordIO
+//    file in order (optionally a subset given by an offset list, for
+//    sharded/shuffled epochs) and fills an MXTQueue ahead of the consumer.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+// recordio.cc exports (same shared object)
+extern "C" {
+void* MXTRecordIOReaderCreate(const char* path);
+int MXTRecordIOReaderNext(void* h, char** out, uint64_t* out_size);
+int MXTRecordIOReaderSeek(void* h, int64_t pos);
+void MXTRecordIOReaderDestroy(void* h);
+}
+
+namespace mxtpu {
+namespace queue {
+
+struct Buffer {
+  char* data;
+  size_t size;
+};
+
+class ByteQueue {
+ public:
+  explicit ByteQueue(size_t capacity) : cap_(capacity ? capacity : 1) {}
+
+  ~ByteQueue() {
+    Close();
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& b : q_) std::free(b.data);
+    q_.clear();
+  }
+
+  // push a copy of data; blocks while full. returns 0, or -1 if closed.
+  int Push(const char* data, size_t size) {
+    char* copy = static_cast<char*>(std::malloc(size ? size : 1));
+    if (copy == nullptr) return -1;
+    std::memcpy(copy, data, size);
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&]() { return closed_ || q_.size() < cap_; });
+    if (closed_) {
+      std::free(copy);
+      return -1;
+    }
+    q_.push_back({copy, size});
+    not_empty_.notify_one();
+    return 0;
+  }
+
+  // pop; blocks while empty. returns 1 with buffer, 0 if closed+drained.
+  int Pop(char** out, size_t* out_size) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&]() { return closed_ || !q_.empty(); });
+    if (q_.empty()) return 0;
+    Buffer b = q_.front();
+    q_.pop_front();
+    not_full_.notify_one();
+    *out = b.data;
+    *out_size = b.size;
+    return 1;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  size_t cap_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<Buffer> q_;
+  bool closed_ = false;
+};
+
+// Reader thread: recordio file → queue.
+class Prefetcher {
+ public:
+  Prefetcher(const char* path, size_t queue_cap, const int64_t* offsets,
+             size_t n_offsets)
+      : queue_(queue_cap) {
+    if (offsets != nullptr && n_offsets > 0) {
+      offsets_.assign(offsets, offsets + n_offsets);
+    }
+    reader_ = MXTRecordIOReaderCreate(path);
+    if (reader_ != nullptr) {
+      thread_ = std::thread([this]() { this->Loop(); });
+      started_ = true;
+    }
+  }
+
+  ~Prefetcher() {
+    stop_.store(true);
+    queue_.Close();
+    if (started_) thread_.join();
+    if (reader_ != nullptr) MXTRecordIOReaderDestroy(reader_);
+  }
+
+  bool ok() const { return reader_ != nullptr; }
+
+  int Pop(char** out, size_t* out_size) { return queue_.Pop(out, out_size); }
+
+ private:
+  void Loop() {
+    size_t idx = 0;
+    for (;;) {
+      if (stop_.load()) return;
+      if (!offsets_.empty()) {
+        if (idx >= offsets_.size()) break;
+        if (MXTRecordIOReaderSeek(reader_, offsets_[idx++]) != 0) break;
+      }
+      char* buf = nullptr;
+      uint64_t size = 0;
+      int rc = MXTRecordIOReaderNext(reader_, &buf, &size);
+      if (rc != 1) break;  // EOF or error → close queue below
+      int prc = queue_.Push(buf, size);
+      std::free(buf);
+      if (prc != 0) return;  // consumer closed
+    }
+    queue_.Close();
+  }
+
+  ByteQueue queue_;
+  void* reader_ = nullptr;
+  std::vector<int64_t> offsets_;
+  std::thread thread_;
+  bool started_ = false;
+  std::atomic<bool> stop_{false};
+};
+
+}  // namespace queue
+}  // namespace mxtpu
+
+using mxtpu::queue::ByteQueue;
+using mxtpu::queue::Prefetcher;
+
+MXTPU_API void* MXTQueueCreate(uint64_t capacity) {
+  return new ByteQueue(capacity);
+}
+
+MXTPU_API void MXTQueueDestroy(void* h) { delete static_cast<ByteQueue*>(h); }
+
+MXTPU_API int MXTQueuePush(void* h, const char* data, uint64_t size) {
+  return static_cast<ByteQueue*>(h)->Push(data, size);
+}
+
+MXTPU_API int MXTQueuePop(void* h, char** out, uint64_t* out_size) {
+  size_t sz = 0;
+  int rc = static_cast<ByteQueue*>(h)->Pop(out, &sz);
+  *out_size = sz;
+  return rc;
+}
+
+MXTPU_API void MXTQueueClose(void* h) { static_cast<ByteQueue*>(h)->Close(); }
+
+MXTPU_API uint64_t MXTQueueSize(void* h) {
+  return static_cast<ByteQueue*>(h)->Size();
+}
+
+MXTPU_API void* MXTPrefetcherCreate(const char* path, uint64_t queue_cap,
+                                    const int64_t* offsets,
+                                    uint64_t n_offsets) {
+  Prefetcher* p = new Prefetcher(path, queue_cap, offsets, n_offsets);
+  if (!p->ok()) {
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+MXTPU_API int MXTPrefetcherPop(void* h, char** out, uint64_t* out_size) {
+  size_t sz = 0;
+  int rc = static_cast<Prefetcher*>(h)->Pop(out, &sz);
+  *out_size = sz;
+  return rc;
+}
+
+MXTPU_API void MXTPrefetcherDestroy(void* h) {
+  delete static_cast<Prefetcher*>(h);
+}

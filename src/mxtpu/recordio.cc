@@ -1,0 +1,218 @@
+// recordio.cc — RecordIO binary record format, reader/writer.
+//
+// Re-provides the reference's record container (dmlc-core recordio, used
+// via python/mxnet/recordio.py MXRecordIO/MXIndexedRecordIO and the C++
+// image pipeline src/io/iter_image_recordio_2.cc).  On-disk format is
+// byte-compatible with dmlc recordio so .rec files made by the reference's
+// tools/im2rec.py are readable:
+//
+//   each record: [uint32 magic=0xced7230a][uint32 lrec][data][pad to 4B]
+//   lrec: upper 3 bits = cflag, lower 29 bits = length of this chunk.
+//   cflag: 0 = whole record, 1 = first chunk, 2 = last chunk, 3 = middle
+//   (records containing the magic bytes are split into chunks so a reader
+//   can resynchronize; see dmlc-core/src/recordio.cc).
+//
+// The TPU-relevant part: feeding a v5e chip requires host-side IO that
+// never holds the Python GIL — this reader is called from native prefetch
+// threads (queue.cc) and from ctypes with the GIL released.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace mxtpu {
+namespace recordio {
+
+static const uint32_t kMagic = 0xced7230a;
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t length) {
+  return (cflag << 29U) | length;
+}
+inline uint32_t DecodeFlag(uint32_t rec) { return (rec >> 29U) & 7U; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & ((1U << 29U) - 1U); }
+
+class Writer {
+ public:
+  explicit Writer(const char* path, const char* mode) {
+    fp_ = std::fopen(path, mode);
+  }
+  ~Writer() { Close(); }
+  bool ok() const { return fp_ != nullptr; }
+
+  void Close() {
+    if (fp_ != nullptr) {
+      std::fclose(fp_);
+      fp_ = nullptr;
+    }
+  }
+
+  int64_t Tell() { return fp_ ? std::ftell(fp_) : -1; }
+
+  // split payload on embedded magics, exactly like dmlc recordio
+  int Write(const char* data, size_t size) {
+    if (fp_ == nullptr) return -1;
+    // chunk lengths must fit the 29-bit lrec field; reject up front rather
+    // than silently corrupting the stream (dmlc recordio CHECKs the same)
+    if (size >= (1ULL << 29)) return -2;
+    const uint32_t umagic = kMagic;
+    // find magic positions
+    std::vector<size_t> magic_pos;
+    if (size >= 4) {
+      for (size_t i = 0; i + 4 <= size; i += 4) {
+        uint32_t v;
+        std::memcpy(&v, data + i, 4);
+        if (v == umagic) magic_pos.push_back(i);
+      }
+    }
+    size_t nchunk = magic_pos.size() + 1;
+    size_t begin = 0;
+    for (size_t c = 0; c < nchunk; ++c) {
+      size_t end = (c < magic_pos.size()) ? magic_pos[c] : size;
+      uint32_t cflag;
+      if (nchunk == 1) cflag = 0;
+      else if (c == 0) cflag = 1;
+      else if (c == nchunk - 1) cflag = 2;
+      else cflag = 3;
+      uint32_t len = static_cast<uint32_t>(end - begin);
+      uint32_t lrec = EncodeLRec(cflag, len);
+      if (std::fwrite(&umagic, 4, 1, fp_) != 1) return -1;
+      if (std::fwrite(&lrec, 4, 1, fp_) != 1) return -1;
+      if (len != 0 && std::fwrite(data + begin, 1, len, fp_) != len) return -1;
+      size_t pad = (4 - (len & 3U)) & 3U;
+      if (pad != 0) {
+        const char zeros[4] = {0, 0, 0, 0};
+        if (std::fwrite(zeros, 1, pad, fp_) != pad) return -1;
+      }
+      begin = end + 4;  // skip the magic bytes themselves (re-inserted on read)
+      if (c < magic_pos.size()) {
+        // embedded magic is carried implicitly by the chunk boundary
+      }
+    }
+    return 0;
+  }
+
+ private:
+  FILE* fp_ = nullptr;
+};
+
+class Reader {
+ public:
+  explicit Reader(const char* path) { fp_ = std::fopen(path, "rb"); }
+  ~Reader() { Close(); }
+  bool ok() const { return fp_ != nullptr; }
+
+  void Close() {
+    if (fp_ != nullptr) {
+      std::fclose(fp_);
+      fp_ = nullptr;
+    }
+  }
+
+  int64_t Tell() { return fp_ ? std::ftell(fp_) : -1; }
+  int Seek(int64_t pos) {
+    return fp_ ? std::fseek(fp_, static_cast<long>(pos), SEEK_SET) : -1;
+  }
+
+  // read next logical record into out (malloc'd; caller frees via
+  // MXTRecordIOFreeBuffer).  returns 1 on success, 0 on EOF, -1 on error.
+  int Next(char** out, size_t* out_size) {
+    if (fp_ == nullptr) return -1;
+    std::string buf;
+    bool in_record = false;
+    for (;;) {
+      uint32_t magic, lrec;
+      if (std::fread(&magic, 4, 1, fp_) != 1) return in_record ? -1 : 0;
+      if (magic != kMagic) return -1;
+      if (std::fread(&lrec, 4, 1, fp_) != 1) return -1;
+      uint32_t cflag = DecodeFlag(lrec);
+      uint32_t len = DecodeLength(lrec);
+      size_t old = buf.size();
+      if (in_record) {
+        // chunk continuation: re-insert the magic that split the record
+        char m[4];
+        std::memcpy(m, &magic, 4);
+        buf.append(m, 4);
+        old = buf.size();
+      }
+      buf.resize(old + len);
+      if (len != 0 && std::fread(&buf[old], 1, len, fp_) != len) return -1;
+      size_t pad = (4 - (len & 3U)) & 3U;
+      if (pad != 0) {
+        char tmp[4];
+        if (std::fread(tmp, 1, pad, fp_) != pad) return -1;
+      }
+      if (cflag == 0 || cflag == 2) break;  // whole record or last chunk
+      in_record = true;
+    }
+    *out_size = buf.size();
+    *out = static_cast<char*>(std::malloc(buf.size() ? buf.size() : 1));
+    if (*out == nullptr) return -1;
+    std::memcpy(*out, buf.data(), buf.size());
+    return 1;
+  }
+
+ private:
+  FILE* fp_ = nullptr;
+};
+
+}  // namespace recordio
+}  // namespace mxtpu
+
+using mxtpu::recordio::Reader;
+using mxtpu::recordio::Writer;
+
+MXTPU_API void* MXTRecordIOWriterCreate(const char* path, const char* mode) {
+  Writer* w = new Writer(path, mode);
+  if (!w->ok()) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+MXTPU_API int MXTRecordIOWriterWrite(void* h, const char* data,
+                                     uint64_t size) {
+  return static_cast<Writer*>(h)->Write(data, size);
+}
+
+MXTPU_API int64_t MXTRecordIOWriterTell(void* h) {
+  return static_cast<Writer*>(h)->Tell();
+}
+
+MXTPU_API void MXTRecordIOWriterDestroy(void* h) {
+  delete static_cast<Writer*>(h);
+}
+
+MXTPU_API void* MXTRecordIOReaderCreate(const char* path) {
+  Reader* r = new Reader(path);
+  if (!r->ok()) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+MXTPU_API int MXTRecordIOReaderNext(void* h, char** out, uint64_t* out_size) {
+  size_t sz = 0;
+  int rc = static_cast<Reader*>(h)->Next(out, &sz);
+  *out_size = sz;
+  return rc;
+}
+
+MXTPU_API int MXTRecordIOReaderSeek(void* h, int64_t pos) {
+  return static_cast<Reader*>(h)->Seek(pos);
+}
+
+MXTPU_API int64_t MXTRecordIOReaderTell(void* h) {
+  return static_cast<Reader*>(h)->Tell();
+}
+
+MXTPU_API void MXTRecordIOReaderDestroy(void* h) {
+  delete static_cast<Reader*>(h);
+}
+
+MXTPU_API void MXTRecordIOFreeBuffer(char* p) { std::free(p); }

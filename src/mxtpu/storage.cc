@@ -1,0 +1,180 @@
+// storage.cc — pooled host storage manager.
+//
+// Re-provides the reference's storage layer (src/storage/
+// pooled_storage_manager.h — PooledStorageManager templated on bucketing
+// strategy: RoundMultiple page rounding at :250 vs RoundPower2 buckets;
+// selection via MXNET_GPU_MEM_POOL_TYPE ∈ {Naive, Round, Unpooled},
+// docs env_var.md:85-101) for the TPU build's host side.  Device (HBM)
+// memory is owned by PJRT — XLA's allocator already pools and reuses
+// buffers — so this manager serves the host staging path: pinned-style
+// batch buffers for the data pipeline, recordio chunk buffers, and
+// serialization scratch.  Free blocks are kept in per-bucket free lists and
+// reused without hitting malloc; statistics mirror the reference's storage
+// profiler counters (src/profiler/storage_profiler.h).
+
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace mxtpu {
+namespace storage {
+
+enum Strategy {
+  kUnpooled = 0,
+  kRoundMultiple = 1,  // round to multiple of page_size
+  kRoundPower2 = 2,    // round to next power of two
+};
+
+class Pool {
+ public:
+  Pool(int strategy, size_t page_size, size_t max_pool_bytes)
+      : strategy_(static_cast<Strategy>(strategy)),
+        page_size_(page_size ? page_size : 4096),
+        max_pool_bytes_(max_pool_bytes) {}
+
+  ~Pool() { ReleaseAll(); }
+
+  size_t RoundSize(size_t n) const {
+    if (n == 0) n = 1;
+    switch (strategy_) {
+      case kRoundMultiple:
+        return ((n + page_size_ - 1) / page_size_) * page_size_;
+      case kRoundPower2: {
+        size_t r = 1;
+        while (r < n) r <<= 1;
+        return r;
+      }
+      default:
+        return n;
+    }
+  }
+
+  void* Alloc(size_t n) {
+    size_t sz = RoundSize(n);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ++alloc_count_;
+      auto it = free_.find(sz);
+      if (it != free_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= sz;
+        ++pool_hits_;
+        sizes_[p] = sz;
+        used_bytes_ += sz;
+        if (used_bytes_ > peak_bytes_) peak_bytes_ = used_bytes_;
+        return p;
+      }
+    }
+    void* p = std::malloc(sz);
+    if (p == nullptr) {
+      // Reclaim the pool and retry — the reference's ReleaseAll-then-retry
+      // on cudaMalloc failure (pooled_storage_manager.h).
+      ReleaseAll();
+      p = std::malloc(sz);
+      if (p == nullptr) return nullptr;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    sizes_[p] = sz;
+    used_bytes_ += sz;
+    if (used_bytes_ > peak_bytes_) peak_bytes_ = used_bytes_;
+    return p;
+  }
+
+  void Free(void* p) {  // return to pool
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) return;
+    size_t sz = it->second;
+    sizes_.erase(it);
+    used_bytes_ -= sz;
+    if (strategy_ == kUnpooled ||
+        (max_pool_bytes_ && pooled_bytes_ + sz > max_pool_bytes_)) {
+      std::free(p);
+      return;
+    }
+    free_[sz].push_back(p);
+    pooled_bytes_ += sz;
+  }
+
+  void DirectFree(void* p) {  // bypass pool
+    if (p == nullptr) return;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = sizes_.find(p);
+      if (it != sizes_.end()) {
+        used_bytes_ -= it->second;
+        sizes_.erase(it);
+      }
+    }
+    std::free(p);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : free_)
+      for (void* p : kv.second) std::free(p);
+    free_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  void Stats(uint64_t* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    out[0] = used_bytes_;
+    out[1] = pooled_bytes_;
+    out[2] = peak_bytes_;
+    out[3] = alloc_count_;
+    out[4] = pool_hits_;
+  }
+
+ private:
+  Strategy strategy_;
+  size_t page_size_;
+  size_t max_pool_bytes_;
+  std::mutex mu_;
+  std::map<size_t, std::vector<void*>> free_;
+  std::unordered_map<void*, size_t> sizes_;
+  size_t used_bytes_ = 0;
+  size_t pooled_bytes_ = 0;
+  size_t peak_bytes_ = 0;
+  uint64_t alloc_count_ = 0;
+  uint64_t pool_hits_ = 0;
+};
+
+}  // namespace storage
+}  // namespace mxtpu
+
+using mxtpu::storage::Pool;
+
+MXTPU_API void* MXTStorageCreate(int strategy, uint64_t page_size,
+                                 uint64_t max_pool_bytes) {
+  return new Pool(strategy, page_size, max_pool_bytes);
+}
+
+MXTPU_API void MXTStorageDestroy(void* h) { delete static_cast<Pool*>(h); }
+
+MXTPU_API void* MXTStorageAlloc(void* h, uint64_t nbytes) {
+  return static_cast<Pool*>(h)->Alloc(nbytes);
+}
+
+MXTPU_API void MXTStorageFree(void* h, void* p) {
+  static_cast<Pool*>(h)->Free(p);
+}
+
+MXTPU_API void MXTStorageDirectFree(void* h, void* p) {
+  static_cast<Pool*>(h)->DirectFree(p);
+}
+
+MXTPU_API void MXTStorageReleaseAll(void* h) {
+  static_cast<Pool*>(h)->ReleaseAll();
+}
+
+// out: [used, pooled, peak, alloc_count, pool_hits]
+MXTPU_API void MXTStorageStats(void* h, uint64_t* out) {
+  static_cast<Pool*>(h)->Stats(out);
+}

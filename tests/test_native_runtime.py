@@ -1,0 +1,402 @@
+"""Tests for the native host runtime: dependency engine, storage pool,
+recordio, prefetch queue.
+
+Modeled on the reference's engine/storage gtests
+(tests/cpp/engine/threaded_engine_test.cc, tests/cpp/storage/storage_test.cc)
+and recordio unittests, exercised through the Python bindings.
+"""
+import os
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import engine as eng_mod
+from mxnet_tpu import recordio
+from mxnet_tpu._native import lib as native_lib
+
+
+native_only = pytest.mark.skipif(native_lib() is None,
+                                 reason="native runtime not built")
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+def test_engine_basic_ordering():
+    e = eng_mod.Engine()
+    v = e.new_variable()
+    out = []
+    for i in range(50):
+        e.push(lambda i=i: out.append(i), mutable_vars=[v])
+    e.wait_for_var(v)
+    # writes on one var serialize in push order
+    assert out == list(range(50))
+
+
+@native_only
+def test_engine_read_write_protocol():
+    e = eng_mod.Engine()
+    data = e.new_variable()
+    # writer bumps a counter; concurrent readers must never observe a
+    # half-done write (the ThreadedVar protocol guarantee)
+    state = {"val": 0, "dirty": False}
+    errors = []
+
+    def writer():
+        state["dirty"] = True
+        time.sleep(0.001)
+        state["val"] += 1
+        state["dirty"] = False
+
+    def reader():
+        if state["dirty"]:
+            errors.append("read during write")
+
+    for _ in range(30):
+        e.push(writer, mutable_vars=[data])
+        for _ in range(3):
+            e.push(reader, const_vars=[data])
+    e.wait_for_var(data)
+    e.wait_for_all()
+    assert not errors
+    assert state["val"] == 30
+
+
+@native_only
+def test_engine_parallel_reads():
+    e = eng_mod.Engine(num_workers=4)
+    v = e.new_variable()
+    barrier = threading.Barrier(2, timeout=10)
+
+    def blocked_read():
+        barrier.wait()  # both readers must be in flight at once
+
+    e.push(blocked_read, const_vars=[v])
+    e.push(blocked_read, const_vars=[v])
+    e.wait_for_all()  # deadlocks (barrier timeout) if reads serialized
+
+
+def test_engine_exception_propagation():
+    e = eng_mod.Engine()
+    v = e.new_variable()
+
+    def boom():
+        raise ValueError("kaboom")
+
+    e.push(boom, mutable_vars=[v])
+    with pytest.raises(eng_mod.EngineError, match="kaboom"):
+        e.wait_for_var(v)
+    # a successful write clears the poison (new value produced)
+    e.push(lambda: None, mutable_vars=[v])
+    e.wait_for_var(v)
+
+
+@native_only
+def test_engine_poison_propagates_downstream():
+    e = eng_mod.Engine()
+    a, b = e.new_variable(), e.new_variable()
+    ran = []
+
+    def boom():
+        raise RuntimeError("upstream died")
+
+    e.push(boom, mutable_vars=[a])
+    e.push(lambda: ran.append(1), const_vars=[a], mutable_vars=[b])
+    with pytest.raises(eng_mod.EngineError, match="upstream died"):
+        e.wait_for_var(b)
+    assert ran == []  # downstream op skipped
+
+
+@native_only
+def test_engine_cross_var_dependency_chain():
+    e = eng_mod.Engine(num_workers=4)
+    n = 20
+    vars_ = [e.new_variable() for _ in range(n)]
+    order = []
+    lock = threading.Lock()
+
+    def step(i):
+        with lock:
+            order.append(i)
+
+    # op i reads var[i-1], writes var[i] → forced serialization
+    e.push(lambda: step(0), mutable_vars=[vars_[0]])
+    for i in range(1, n):
+        e.push(lambda i=i: step(i), const_vars=[vars_[i - 1]],
+               mutable_vars=[vars_[i]])
+    e.wait_for_var(vars_[-1])
+    assert order == list(range(n))
+
+
+@native_only
+def test_engine_delete_variable():
+    e = eng_mod.Engine()
+    v = e.new_variable()
+    done = []
+    e.push(lambda: done.append(1), mutable_vars=[v])
+    e.delete_variable(v)  # scheduled after the pending write
+    e.wait_for_all()
+    assert done == [1]
+
+
+@native_only
+def test_engine_duplicate_vars_no_deadlock():
+    e = eng_mod.Engine()
+    v = e.new_variable()
+    out = []
+    # duplicate ids within/across lists must not queue the op behind itself
+    e.push(lambda: out.append(1), const_vars=[v, v], mutable_vars=[v, v])
+    e.wait_for_var(v)
+    assert out == [1]
+
+
+def test_recordio_oversize_record_rejected(tmp_path):
+    w = recordio.MXRecordIO(str(tmp_path / "big.rec"), "w")
+    class FakeBytes(bytes):
+        def __len__(self):
+            return 1 << 29
+    with pytest.raises((ValueError, IOError)):
+        # 512MB of real memory is wasteful; the bound check only consults len
+        w.write(FakeBytes())
+    w.close()
+
+
+def test_engine_push_sync():
+    e = eng_mod.Engine()
+    v = e.new_variable()
+    out = []
+    e.push_sync(lambda: out.append(1), mutable_vars=[v])
+    assert out == [1]
+
+
+def test_waitall_includes_host_engine():
+    e = eng_mod.default_engine()
+    v = e.new_variable()
+    out = []
+    e.push(lambda: out.append(1), mutable_vars=[v])
+    eng_mod.waitall()
+    assert out == [1]
+
+
+# ---------------------------------------------------------------------------
+# storage pool
+# ---------------------------------------------------------------------------
+@native_only
+def test_storage_pool_reuse():
+    import ctypes
+    lib = native_lib()
+    pool = lib.MXTStorageCreate(2, 4096, 0)  # RoundPower2
+    try:
+        p1 = lib.MXTStorageAlloc(pool, 1000)
+        assert p1
+        lib.MXTStorageFree(pool, p1)
+        p2 = lib.MXTStorageAlloc(pool, 900)  # same pow2 bucket → pool hit
+        stats = (ctypes.c_uint64 * 5)()
+        lib.MXTStorageStats(pool, stats)
+        used, pooled, peak, allocs, hits = stats
+        assert p2 == p1
+        assert hits == 1
+        assert allocs == 2
+        assert used == 1024 and peak >= 1024
+        lib.MXTStorageDirectFree(pool, p2)
+    finally:
+        lib.MXTStorageDestroy(pool)
+
+
+@native_only
+def test_storage_round_multiple():
+    import ctypes
+    lib = native_lib()
+    pool = lib.MXTStorageCreate(1, 4096, 0)  # RoundMultiple of 4096
+    try:
+        p = lib.MXTStorageAlloc(pool, 1)
+        stats = (ctypes.c_uint64 * 5)()
+        lib.MXTStorageStats(pool, stats)
+        assert stats[0] == 4096  # rounded up to one page
+        lib.MXTStorageFree(pool, p)
+    finally:
+        lib.MXTStorageDestroy(pool)
+
+
+# ---------------------------------------------------------------------------
+# recordio
+# ---------------------------------------------------------------------------
+def _roundtrip_records(tmp_path, records):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for r in records:
+        w.write(r)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    r.close()
+    return got
+
+
+def test_recordio_roundtrip(tmp_path):
+    recs = [b"hello", b"world" * 100, b"", b"\x00\x01\x02\x03" * 7]
+    assert _roundtrip_records(tmp_path, recs) == recs
+
+
+def test_recordio_embedded_magic(tmp_path):
+    # payload containing the magic at an aligned offset must survive
+    magic = (0xCED7230A).to_bytes(4, "little")
+    recs = [b"abcd" + magic + b"efgh", magic * 3, b"xy" + magic]
+    assert _roundtrip_records(tmp_path, recs) == recs
+
+
+@native_only
+def test_recordio_native_python_compat(tmp_path):
+    """Files written by the native writer parse with the pure-python reader
+    and vice versa (both must match the dmlc on-disk format)."""
+    recs = [b"native", b"\x00" * 33, (0xCED7230A).to_bytes(4, "little") + b"!"]
+    npath = str(tmp_path / "n.rec")
+    w = recordio.MXRecordIO(npath, "w")  # native path
+    for r in recs:
+        w.write(r)
+    w.close()
+    pr = recordio._PyReader(npath)
+    got = []
+    while True:
+        rec = pr.read()
+        if rec is None:
+            break
+        got.append(rec)
+    pr.close()
+    assert got == recs
+
+    ppath = str(tmp_path / "p.rec")
+    pw = recordio._PyWriter(ppath, "wb")
+    for r in recs:
+        pw.write(r)
+    pw.close()
+    r = recordio.MXRecordIO(ppath, "r")  # native reader
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    r.close()
+    assert got == recs
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, b"payload-%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"payload-7"
+    assert r.read_idx(2) == b"payload-2"
+    r.close()
+
+
+def test_irheader_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"imgbytes")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"imgbytes"
+    assert h2.label == 3.0 and h2.id == 7
+
+    hv = recordio.IRHeader(0, onp.array([1.0, 2.0, 5.0], onp.float32), 9, 0)
+    s = recordio.pack(hv, b"x")
+    h3, payload = recordio.unpack(s)
+    assert h3.flag == 3
+    onp.testing.assert_array_equal(h3.label, [1.0, 2.0, 5.0])
+
+
+def test_pack_img_raw_fallback():
+    img = onp.arange(5 * 4 * 3, dtype=onp.uint8).reshape(5, 4, 3)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img)
+    hdr, dec = recordio.unpack_img(s)
+    assert dec.shape[0] == 5 and dec.shape[1] == 4
+
+
+# ---------------------------------------------------------------------------
+# queue + prefetcher
+# ---------------------------------------------------------------------------
+@native_only
+def test_byte_queue():
+    import ctypes
+    lib = native_lib()
+    q = lib.MXTQueueCreate(4)
+    try:
+        lib.MXTQueuePush(q, b"abc", 3)
+        lib.MXTQueuePush(q, b"\x00def", 4)
+        ptr = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        assert lib.MXTQueuePop(q, ctypes.byref(ptr), ctypes.byref(size)) == 1
+        from mxnet_tpu._native import read_buffer
+        assert read_buffer(ptr, size.value) == b"abc"
+        assert lib.MXTQueuePop(q, ctypes.byref(ptr), ctypes.byref(size)) == 1
+        assert read_buffer(ptr, size.value) == b"\x00def"
+        lib.MXTQueueClose(q)
+        assert lib.MXTQueuePop(q, ctypes.byref(ptr), ctypes.byref(size)) == 0
+    finally:
+        lib.MXTQueueDestroy(q)
+
+
+@native_only
+def test_prefetcher_streams_records(tmp_path):
+    import ctypes
+    lib = native_lib()
+    path = str(tmp_path / "pf.rec")
+    w = recordio.MXRecordIO(path, "w")
+    recs = [b"r%04d" % i * 10 for i in range(100)]
+    for r in recs:
+        w.write(r)
+    w.close()
+
+    pf = lib.MXTPrefetcherCreate(path.encode(), 8, None, 0)
+    assert pf
+    try:
+        from mxnet_tpu._native import read_buffer
+        got = []
+        ptr = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        while lib.MXTPrefetcherPop(pf, ctypes.byref(ptr),
+                                   ctypes.byref(size)) == 1:
+            got.append(read_buffer(ptr, size.value))
+        assert got == recs
+    finally:
+        lib.MXTPrefetcherDestroy(pf)
+
+
+@native_only
+def test_prefetcher_with_offsets(tmp_path):
+    """Offset list drives order — the shuffled-epoch path."""
+    import ctypes
+    lib = native_lib()
+    path = str(tmp_path / "pfo.rec")
+    w = recordio.MXRecordIO(path, "w")
+    offsets = []
+    for i in range(10):
+        offsets.append(w.tell())
+        w.write(b"rec-%d" % i)
+    w.close()
+
+    order = [7, 1, 3]
+    arr = (ctypes.c_int64 * len(order))(*[offsets[i] for i in order])
+    pf = lib.MXTPrefetcherCreate(path.encode(), 4, arr, len(order))
+    try:
+        from mxnet_tpu._native import read_buffer
+        got = []
+        ptr = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        while lib.MXTPrefetcherPop(pf, ctypes.byref(ptr),
+                                   ctypes.byref(size)) == 1:
+            got.append(read_buffer(ptr, size.value))
+        assert got == [b"rec-7", b"rec-1", b"rec-3"]
+    finally:
+        lib.MXTPrefetcherDestroy(pf)
