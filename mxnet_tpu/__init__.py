@@ -48,6 +48,8 @@ from .util import is_np_array, is_np_shape, set_np, reset_np
 
 # legacy namespace: mx.nd mirrors mx.np plus waitall/load/save
 from . import nd
+from . import recordio
+from . import io
 from . import sparse
 from . import symbol
 from . import symbol as sym

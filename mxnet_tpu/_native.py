@@ -24,11 +24,12 @@ _LOCK = threading.Lock()
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libmxtpu_core.so")
 _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "src")
 
-# callback: int fn(void* ctx, char* err_buf, int err_len).  err_buf is
-# declared void* — with c_char_p ctypes would hand the callback an immutable
-# bytes copy instead of the writable native buffer.
+# callback: int fn(void* ctx, char* err_buf, int err_len, int skipped).
+# err_buf is declared void* — with c_char_p ctypes would hand the callback an
+# immutable bytes copy instead of the writable native buffer.  skipped=1 is a
+# notify-only call (poisoned inputs): release resources, don't run the body.
 ASYNC_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
-                            ctypes.c_void_p, ctypes.c_int)
+                            ctypes.c_void_p, ctypes.c_int, ctypes.c_int)
 
 
 def _declare(lib):

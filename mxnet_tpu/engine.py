@@ -94,7 +94,11 @@ class Engine:
         (mutable_vars).  Parity: Engine::PushAsync
         (src/engine/threaded_engine.cc:318)."""
         if self._handle is None:
-            # synchronous fallback engine (NaiveEngine semantics)
+            # synchronous fallback engine (NaiveEngine semantics); unknown or
+            # deleted vars are an error, matching the native engine's rc -2
+            for v in list(const_vars) + list(mutable_vars):
+                if v not in self._py_vars:
+                    raise EngineError("PushAsync failed (unknown variable?)")
             for v in const_vars:
                 err = self._py_vars.get(v)
                 if err:
@@ -127,11 +131,11 @@ class Engine:
 
     push_async = push
 
-    def _dispatch(self, ctx, err_buf, err_len):
+    def _dispatch(self, ctx, err_buf, err_len, skipped):
         """Runs on a native worker thread (ctypes re-acquires the GIL)."""
         with self._cb_lock:
             fn = self._callbacks.pop(ctx, None)
-        if fn is None:
+        if fn is None or skipped:
             return 0
         try:
             fn()
@@ -150,6 +154,8 @@ class Engine:
     # -- sync -------------------------------------------------------------
     def wait_for_var(self, var):
         if self._handle is None:
+            if var not in self._py_vars:
+                raise EngineError("unknown engine variable %d" % var)
             # poison persists until the next successful write, matching the
             # native engine / reference rethrow contract
             err = self._py_vars.get(var)

@@ -1,0 +1,618 @@
+"""mx.io — legacy data-iterator API.
+
+Parity: reference `python/mxnet/io/io.py` (DataIter :179, DataDesc :58,
+DataBatch :126, NDArrayIter :672, CSVIter/ImageRecordIter ctypes wrappers
+over the C++ iterators of src/io/ — MXNET_REGISTER_IO_ITER registry,
+prefetch decorator iter_prefetcher.h, batch loader iter_batchloader.h,
+image pipeline iter_image_recordio_2.cc:887).
+
+TPU-native: iterators produce host numpy batches and convert to device
+ndarrays at the batch boundary (one H2D per batch — PJRT overlaps the
+transfer with compute).  ImageRecordIter reads reference-format .rec
+files through the native recordio reader and read-ahead prefetcher
+(src/mxtpu/{recordio,queue}.cc) so record IO runs off the GIL.
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as onp
+
+from ..ndarray import array as _nd_array
+from ..ndarray import ndarray
+from .. import recordio as _recordio
+from .._native import lib as _native_lib
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ImageRecordIter", "MNISTIter", "ResizeIter", "PrefetchingIter"]
+
+
+class DataDesc:
+    """Data layout descriptor (parity: io.py DataDesc :58)."""
+
+    def __init__(self, name, shape, dtype=onp.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = onp.dtype(dtype)
+        self.layout = layout
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    def __iter__(self):  # tuple-compat (name, shape)
+        return iter((self.name, self.shape))
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    """One batch (parity: io.py DataBatch :126): .data/.label are lists of
+    ndarrays; .pad counts padded trailing examples; .index holds example
+    ids when available."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [getattr(d, "shape", None) for d in (self.data or [])]
+        lshapes = [getattr(l, "shape", None) for l in (self.label or [])]
+        return "DataBatch: data shapes: %s label shapes: %s" % (shapes, lshapes)
+
+
+class DataIter:
+    """Iterator base (parity: io.py DataIter :179)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(), self.getpad(),
+                             self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+    @property
+    def provide_data(self):
+        return None
+
+    @property
+    def provide_label(self):
+        return None
+
+
+def _as_list_of_pairs(data, default_name):
+    """Normalize data=ndarray | numpy | dict | list → [(name, numpy)]."""
+    if data is None:
+        return []
+    if isinstance(data, (ndarray, onp.ndarray)):
+        return [(default_name, _to_numpy(data))]
+    if isinstance(data, dict):
+        return [(k, _to_numpy(v)) for k, v in data.items()]
+    if isinstance(data, (list, tuple)):
+        return [("%s_%d" % (default_name, i) if len(data) > 1 else default_name,
+                 _to_numpy(v)) for i, v in enumerate(data)]
+    raise TypeError("unsupported data type %r" % type(data))
+
+
+def _to_numpy(a):
+    if isinstance(a, ndarray):
+        return a.asnumpy()
+    return onp.asarray(a)
+
+
+class NDArrayIter(DataIter):
+    """Batch iterator over in-memory arrays
+    (parity: io.py NDArrayIter :672 incl. last_batch_handle semantics).
+
+    last_batch_handle: 'pad' (wrap around; .pad reports the overlap),
+    'discard' (drop the tail), 'roll_over' (carry the tail into the next
+    epoch).
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _as_list_of_pairs(data, data_name)
+        self.label = _as_list_of_pairs(label, label_name)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        for _, arr in self.data + self.label:
+            if arr.shape[0] != self.num_data:
+                raise ValueError("all arrays must share the batch dimension")
+        if last_batch_handle == "discard":
+            if self.num_data < batch_size:
+                raise ValueError("batch_size larger than dataset for 'discard'")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self._rollover_tail = None  # indices deferred to the next epoch
+        self._idx = onp.arange(self.num_data)
+        self.reset()
+
+    def reset(self):
+        # capture the unconsumed tail BEFORE reshuffling, so roll_over hands
+        # over the genuinely skipped examples (not slots of the new order)
+        tail = self._rollover_tail
+        self._rollover_tail = None
+        if self.shuffle:
+            onp.random.shuffle(self._idx)
+        if self.last_batch_handle == "roll_over" and tail is not None \
+                and len(tail) > 0:
+            self._pending_tail = tail
+            self.cursor = -len(tail)
+        else:
+            self._pending_tail = None
+            self.cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:], a.dtype)
+                for n, a in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:], a.dtype)
+                for n, a in self.label]
+
+    def iter_next(self):
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        start = self.cursor
+        self.cursor += self.batch_size
+        pad = 0
+        end = start + self.batch_size
+        if end > self.num_data:
+            if self.last_batch_handle == "pad":
+                pad = end - self.num_data
+            elif self.last_batch_handle == "roll_over":
+                # defer the tail examples to the next epoch
+                self._rollover_tail = self._idx[start:].copy()
+                raise StopIteration
+        sel = self._take(start, end)
+        data = [_nd_array(a) for a in sel[0]]
+        label = [_nd_array(a) for a in sel[1]]
+        index = self._index_slice(start, end)
+        return DataBatch(data, label, pad, index,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def _index_slice(self, start, end):
+        if start < 0:  # roll_over head
+            parts = [self._pending_tail]
+            if end > 0:
+                parts.append(self._idx[:end])
+            return onp.concatenate(parts)
+        idx = self._idx[start:min(end, self.num_data)]
+        if end > self.num_data and self.last_batch_handle == "pad":
+            idx = onp.concatenate([idx, self._idx[:end - self.num_data]])
+        return idx
+
+    def _take(self, start, end):
+        out_d, out_l = [], []
+        for group, out in ((self.data, out_d), (self.label, out_l)):
+            for _, arr in group:
+                if start < 0:  # roll_over head: the deferred examples
+                    head = arr[self._pending_tail]
+                    rest = arr[self._idx[:end]] if end > 0 else head[:0]
+                    out.append(onp.concatenate([head, rest]))
+                elif end <= self.num_data:
+                    out.append(arr[self._idx[start:end]])
+                else:  # pad: wrap
+                    main = arr[self._idx[start:]]
+                    wrap = arr[self._idx[:end - self.num_data]]
+                    out.append(onp.concatenate([main, wrap]))
+        return out_d, out_l
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor > self.num_data:
+            return self.cursor - self.num_data
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (parity: src/io/iter_csv.cc registered CSVIter).
+
+    data_csv/label_csv: paths; data_shape/label_shape: per-example shapes.
+    """
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype=onp.float32,
+                                ndmin=2).reshape((-1,) + tuple(label_shape))
+        else:
+            label = onp.zeros((data.shape[0], 1), onp.float32)
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class ImageRecordIter(DataIter):
+    """Image iterator over reference-format .rec files
+    (parity: src/io/iter_image_recordio_2.cc ImageRecordIter :887 —
+    recordio chunks → decode+augment → batch → prefetch).
+
+    Augmentations follow image_aug_default.cc's common subset: resize,
+    rand_crop, rand_mirror, mean/std normalization.  Decoding uses
+    cv2/PIL when present, else the raw MXTRAW00 payload format
+    (recordio.pack_img fallback).  Record read-ahead rides the native
+    prefetcher thread when libmxtpu_core.so is available.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1,
+                 path_imgidx=None, shuffle=False, rand_crop=False,
+                 rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 round_batch=True, prefetch_buffer=4, seed=0, **kwargs):
+        super().__init__(batch_size)
+        self.path_imgrec = str(path_imgrec)
+        self.data_shape = tuple(data_shape)
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.mean = onp.array([mean_r, mean_g, mean_b], onp.float32)
+        self.std = onp.array([std_r, std_g, std_b], onp.float32)
+        self.round_batch = round_batch
+        self.prefetch_buffer = prefetch_buffer
+        self._rng = onp.random.RandomState(seed)
+        self._offsets = None
+        if path_imgidx and os.path.isfile(str(path_imgidx)):
+            idx = _recordio.MXIndexedRecordIO(str(path_imgidx),
+                                              self.path_imgrec, "r")
+            self._offsets = [idx.idx[k] for k in idx.keys]
+            idx.close()
+        elif shuffle:
+            # no idx sidecar: scan the rec once for offsets so shuffle still
+            # shuffles (silent in-order "shuffle" would quietly break
+            # class-sorted datasets)
+            self._offsets = self._scan_offsets()
+        self._pf = None
+        self._reader = None
+        self.reset()
+
+    def _scan_offsets(self):
+        reader = _recordio.MXRecordIO(self.path_imgrec, "r")
+        offsets = []
+        try:
+            while True:
+                pos = reader.tell()
+                if reader.read() is None:
+                    break
+                offsets.append(pos)
+        finally:
+            reader.close()
+        return offsets
+
+    def reset(self):
+        self._close()
+        lib = _native_lib()
+        offsets = self._offsets
+        if offsets is not None and self.shuffle:
+            offsets = list(offsets)
+            self._rng.shuffle(offsets)
+        if lib is not None:
+            import ctypes
+            if offsets:
+                arr = (ctypes.c_int64 * len(offsets))(*offsets)
+                self._pf = lib.MXTPrefetcherCreate(
+                    self.path_imgrec.encode(), self.prefetch_buffer,
+                    arr, len(offsets))
+            else:
+                self._pf = lib.MXTPrefetcherCreate(
+                    self.path_imgrec.encode(), self.prefetch_buffer, None, 0)
+            if not self._pf:
+                raise IOError("cannot open %s" % self.path_imgrec)
+        else:
+            self._reader = _recordio.MXRecordIO(self.path_imgrec, "r")
+            self._pending_offsets = list(offsets) if offsets else None
+
+    def _close(self):
+        lib = _native_lib()
+        if self._pf is not None and lib is not None:
+            lib.MXTPrefetcherDestroy(self._pf)
+            self._pf = None
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+
+    def _next_record(self):
+        if self._pf is not None:
+            import ctypes
+            from .._native import read_buffer
+            lib = _native_lib()
+            ptr = ctypes.c_void_p()
+            size = ctypes.c_uint64()
+            rc = lib.MXTPrefetcherPop(self._pf, ctypes.byref(ptr),
+                                      ctypes.byref(size))
+            if rc != 1:
+                return None
+            return read_buffer(ptr, size.value)
+        if self._pending_offsets is not None:
+            if not self._pending_offsets:
+                return None
+            self._reader.seek(self._pending_offsets.pop(0))
+        return self._reader.read()
+
+    def _decode_example(self, rec):
+        header, img = _recordio.unpack_img(rec)
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            img = _resize_short(img, self.resize)
+        # honor the requested channel count (provide_data contract)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if img.shape[-1] == 4:  # drop alpha
+            img = img[:, :, :3]
+        if c == 1 and img.shape[-1] == 3:
+            img = img.mean(axis=-1, keepdims=True)
+        elif c == 3 and img.shape[-1] == 1:
+            img = onp.repeat(img, 3, axis=-1)
+        elif img.shape[-1] != c:
+            raise ValueError("record has %d channels, data_shape wants %d"
+                             % (img.shape[-1], c))
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            img = _resize_to(img, max(h, ih), max(w, iw))
+            if img.ndim == 2:
+                img = img[:, :, None]
+            ih, iw = img.shape[:2]
+        if self.rand_crop and (ih > h or iw > w):
+            y = self._rng.randint(0, ih - h + 1)
+            x = self._rng.randint(0, iw - w + 1)
+        else:  # center crop
+            y, x = (ih - h) // 2, (iw - w) // 2
+        img = img[y:y + h, x:x + w]
+        if self.rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img.astype(onp.float32)
+        if c == 3:
+            img = (img - self.mean) / self.std
+        elif c == 1:
+            img = (img - self.mean[0]) / self.std[0]
+        label = header.label
+        if isinstance(label, onp.ndarray) and label.size == 1:
+            label = float(label.reshape(-1)[0])
+        return onp.transpose(img, (2, 0, 1)), label
+
+    def next(self):
+        imgs, labels = [], []
+        while len(imgs) < self.batch_size:
+            rec = self._next_record()
+            if rec is None:
+                break
+            im, lb = self._decode_example(rec)
+            imgs.append(im)
+            labels.append(lb)
+        if not imgs:
+            raise StopIteration
+        pad = 0
+        if len(imgs) < self.batch_size:
+            if not self.round_batch:
+                raise StopIteration
+            pad = self.batch_size - len(imgs)
+            while len(imgs) < self.batch_size:  # pad by repeating from start
+                imgs.append(imgs[len(imgs) % max(1, self.batch_size - pad)])
+                labels.append(labels[len(labels) % max(1, self.batch_size - pad)])
+        data = _nd_array(onp.stack(imgs))
+        label = _nd_array(onp.asarray(labels, onp.float32))
+        return DataBatch([data], [label], pad, None)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def __del__(self):
+        try:
+            self._close()
+        except Exception:
+            pass
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST iterator (parity: src/io/iter_mnist.cc:260) over the gluon
+    dataset loader (falls back to a deterministic synthetic set offline)."""
+
+    def __init__(self, batch_size=128, train=True, shuffle=True, **kwargs):
+        from ..gluon.data.vision import MNIST
+        ds = MNIST(train=train)
+        # (n, 28, 28, 1) HWC → NCHW
+        x = ds._data.astype(onp.float32).transpose(0, 3, 1, 2) / 255.0
+        y = ds._label.astype(onp.float32)
+        super().__init__(x, y, batch_size, shuffle=shuffle,
+                         last_batch_handle="discard")
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches
+    (parity: io.py ResizeIter :543)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch decorator
+    (parity: io.py PrefetchingIter :611 / src/io/iter_prefetcher.h): the
+    wrapped iterator runs in a producer thread, batches are handed over a
+    bounded queue so augmentation overlaps the training step."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        import queue as _q
+        import threading
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        assert len(iters) == 1, "single inner iterator supported"
+        self.data_iter = iters[0]
+        super().__init__(self.data_iter.batch_size)
+        self._qmod = _q
+        self._depth = prefetch_depth
+        self._threading = threading
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        self._stop = False
+        self._exhausted = False
+        self._q = self._qmod.Queue(maxsize=self._depth)
+
+        def _put(item):
+            while not self._stop:
+                try:
+                    self._q.put(item, timeout=0.05)
+                    return True
+                except self._qmod.Full:
+                    continue
+            return False
+
+        def run():
+            try:
+                for batch in self.data_iter:
+                    if not _put(batch):
+                        return
+            finally:
+                _put(None)  # end-of-epoch sentinel
+        self._thread = self._threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None:
+            self._stop = True  # unblocks a producer stuck on a full queue
+            while self._thread.is_alive():
+                try:
+                    self._q.get(timeout=0.05)
+                except self._qmod.Empty:
+                    pass
+            self._thread.join()
+        self.data_iter.reset()
+        self._start()
+
+    def next(self):
+        if self._exhausted:
+            raise StopIteration
+        batch = self._q.get()
+        if batch is None:
+            self._exhausted = True  # keep raising until reset()
+            raise StopIteration
+        return batch
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+
+# -- image resize helpers (cv2/PIL when present, numpy fallback) -----------
+def _resize_to(img, h, w):
+    try:
+        import cv2
+        return cv2.resize(img, (w, h), interpolation=cv2.INTER_LINEAR)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        return onp.asarray(Image.fromarray(img).resize((w, h)))
+    except ImportError:
+        ys = (onp.arange(h) * img.shape[0] / h).astype(int)
+        xs = (onp.arange(w) * img.shape[1] / w).astype(int)
+        return img[ys][:, xs]
+
+
+def _resize_short(img, size):
+    h, w = img.shape[:2]
+    if h < w:
+        return _resize_to(img, size, int(w * size / h))
+    return _resize_to(img, int(h * size / w), size)

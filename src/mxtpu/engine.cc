@@ -41,8 +41,11 @@
 namespace mxtpu {
 namespace engine {
 
-// callback signature: fn(ctx, err_buf, err_buf_len) -> 0 on success
-typedef int (*AsyncFn)(void* ctx, char* err_buf, int err_buf_len);
+// callback signature: fn(ctx, err_buf, err_buf_len, skipped) -> 0 on success.
+// skipped=1 means inputs were poisoned and the op body must NOT run — the
+// call only lets the binding release per-op resources (Python closure refs).
+typedef int (*AsyncFn)(void* ctx, char* err_buf, int err_buf_len,
+                       int skipped);
 
 struct Opr;
 
@@ -221,7 +224,7 @@ class Engine {
     bool done = false;
     struct WaitCtx { std::mutex* m; std::condition_variable* cv; bool* done; };
     WaitCtx wctx{&m, &cv, &done};
-    AsyncFn fn = [](void* c, char*, int) -> int {
+    AsyncFn fn = [](void* c, char*, int, int) -> int {
       WaitCtx* w = static_cast<WaitCtx*>(c);
       std::lock_guard<std::mutex> lk(*w->m);
       *w->done = true;
@@ -297,7 +300,7 @@ class Engine {
     if (!skip && opr->fn != nullptr) {
       char err[1024];
       err[0] = '\0';
-      int rc = opr->fn(opr->ctx, err, sizeof(err));
+      int rc = opr->fn(opr->ctx, err, sizeof(err), /*skipped=*/0);
       if (rc != 0) {
         skip = true;
         inherited = err[0] ? err : "operator failed";
@@ -309,6 +312,10 @@ class Engine {
           v->poison_msg.clear();
         }
       }
+    } else if (skip && opr->fn != nullptr) {
+      // notify-only call so the binding can drop the op's closure
+      char err[1] = {'\0'};
+      opr->fn(opr->ctx, err, 1, /*skipped=*/1);
     }
     if (skip) {
       for (auto& v : opr->mutable_vars) {

@@ -142,6 +142,30 @@ def test_engine_delete_variable():
 
 
 @native_only
+def test_engine_skipped_op_releases_callback():
+    e = eng_mod.Engine()
+    a, b = e.new_variable(), e.new_variable()
+
+    def boom():
+        raise RuntimeError("die")
+
+    e.push(boom, mutable_vars=[a])
+    for _ in range(5):  # each is skipped (poisoned input)
+        e.push(lambda: None, const_vars=[a], mutable_vars=[b])
+    with pytest.raises(eng_mod.EngineError):
+        e.wait_for_var(b)
+    e.wait_for_all()
+    # skipped ops must still release their closures (no leak)
+    assert len(e._callbacks) == 0
+
+
+def test_engine_unknown_var_rejected_fallback_and_native():
+    e = eng_mod.Engine()
+    with pytest.raises(eng_mod.EngineError):
+        e.push(lambda: None, mutable_vars=[999999])
+
+
+@native_only
 def test_engine_duplicate_vars_no_deadlock():
     e = eng_mod.Engine()
     v = e.new_variable()

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""im2rec — pack an image directory / list file into RecordIO
+(parity: reference tools/im2rec.py).
+
+Usage:
+  python tools/im2rec.py prefix imgdir            # make .lst then .rec/.idx
+  python tools/im2rec.py --list prefix imgdir     # only the .lst file
+
+The .lst format matches the reference: `index\\tlabel\\trelative-path` per
+line.  The .rec/.idx pair is readable by mx.io.ImageRecordIter and the
+reference's iterator alike (same recordio + IRHeader layout).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(prefix, root, recursive=True):
+    entries = []
+    label_map = {}
+    for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+        for fname in sorted(filenames):
+            if os.path.splitext(fname)[1].lower() not in _EXTS:
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fname), root)
+            cls = os.path.dirname(rel) or "."
+            label = label_map.setdefault(cls, len(label_map))
+            entries.append((rel, label))
+        if not recursive:
+            break
+    lst_path = prefix + ".lst"
+    with open(lst_path, "w") as f:
+        for i, (rel, label) in enumerate(entries):
+            f.write("%d\t%f\t%s\n" % (i, float(label), rel))
+    return lst_path
+
+
+def read_list(lst_path):
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), float(parts[1]), parts[-1]
+
+
+def make_rec(prefix, root, lst_path, quality=95):
+    rec_path = prefix + ".rec"
+    idx_path = prefix + ".idx"
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    n = 0
+    for idx, label, rel in read_list(lst_path):
+        path = os.path.join(root, rel)
+        with open(path, "rb") as f:
+            payload = f.read()
+        header = recordio.IRHeader(0, label, idx, 0)
+        writer.write_idx(idx, recordio.pack(header, payload))
+        n += 1
+    writer.close()
+    return rec_path, idx_path, n
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image directory root")
+    ap.add_argument("--list", action="store_true",
+                    help="only generate the .lst file")
+    ap.add_argument("--no-recursive", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    args = ap.parse_args()
+
+    lst = args.prefix + ".lst"
+    if not os.path.exists(lst):
+        lst = make_list(args.prefix, args.root,
+                        recursive=not args.no_recursive)
+        print("wrote", lst)
+    if not args.list:
+        rec, idx, n = make_rec(args.prefix, args.root, lst,
+                               quality=args.quality)
+        print("wrote %s + %s (%d records)" % (rec, idx, n))
+
+
+if __name__ == "__main__":
+    main()
