@@ -1,0 +1,21 @@
+"""gluon.probability — distributions, transformations, stochastic blocks.
+
+Parity: reference `python/mxnet/gluon/probability/` (~25 distributions,
+bijectors, KL registry, StochasticBlock).  See distributions.py for the
+TPU-native design notes.
+"""
+from .distributions import *  # noqa: F401,F403
+from .distributions import __all__ as _dist_all
+from .divergence import kl_divergence, register_kl, empirical_kl
+from .transformation import (
+    Transformation, ExpTransform, AffineTransform, SigmoidTransform,
+    SoftmaxTransform, AbsTransform, PowerTransform, ComposeTransform,
+    TransformedDistribution)
+from .stochastic_block import StochasticBlock, StochasticSequential
+
+__all__ = list(_dist_all) + [
+    "kl_divergence", "register_kl", "empirical_kl",
+    "Transformation", "ExpTransform", "AffineTransform", "SigmoidTransform",
+    "SoftmaxTransform", "AbsTransform", "PowerTransform", "ComposeTransform",
+    "TransformedDistribution", "StochasticBlock", "StochasticSequential",
+]

@@ -1,0 +1,1269 @@
+"""Probability distributions.
+
+Parity: reference `python/mxnet/gluon/probability/distributions/` — one
+class per file there (bernoulli.py, normal.py, gamma.py, …, ~25
+distributions with sample/sample_n/log_prob/cdf/icdf/mean/variance/
+entropy, lazy F-dispatch).  TPU-native: a single module; every method is
+ndarray→ndarray through apply_op (autograd-recorded, XLA-compiled),
+samplers draw threefry subkeys from mx.random's functional PRNG, and
+reparameterized samplers (normal/gamma/beta/…) are differentiable the
+same way the reference marks `has_grad`.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ...ndarray import ndarray, apply_op
+from .utils import as_nd, sample_op, size2shape
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "HalfNormal", "Laplace", "Cauchy",
+    "HalfCauchy", "Uniform", "Exponential", "Gamma", "Beta", "Dirichlet",
+    "Poisson", "Bernoulli", "Binomial", "NegativeBinomial", "Geometric",
+    "Categorical", "OneHotCategorical", "Multinomial", "MultivariateNormal",
+    "StudentT", "Chi2", "FisherSnedecor", "Gumbel", "Weibull", "Pareto",
+    "RelaxedBernoulli", "RelaxedOneHotCategorical", "Independent",
+    "MixtureSameFamily",
+]
+
+_EULER = 0.5772156649015329
+_LOG_SQRT_2PI = 0.5 * math.log(2 * math.pi)
+
+
+def _bshape(*vals):
+    shp = ()
+    for v in vals:
+        shp = onp.broadcast_shapes(shp, getattr(v, "shape", ()))
+    return shp
+
+
+class Distribution:
+    """Base class (parity: distributions/distribution.py Distribution).
+
+    `event_dim` counts trailing event dimensions; `has_grad` marks
+    reparameterized (pathwise-differentiable) samplers.
+    """
+
+    has_grad = False
+    event_dim = 0
+    # trailing parameter dims that are NOT batch dims (e.g. the category
+    # axis of Categorical's prob/logit, MVN's loc/cov axes)
+    _param_event = {}
+
+    def __init__(self, **params):
+        # subclasses normalize with as_nd before calling super()
+        self._params = dict(params)
+        for k, v in self._params.items():
+            setattr(self, k, v)
+
+    # -- core API ---------------------------------------------------------
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, size=None):
+        """Draw `size` iid samples batched on the left
+        (reference sample_n semantics)."""
+        return self.sample(size)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(jnp.exp, self.log_prob(value))
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return apply_op(jnp.sqrt, self.variance)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def perplexity(self):
+        return apply_op(jnp.exp, self.entropy())
+
+    # broadcast batch shape of parameters
+    @property
+    def batch_shape(self):
+        vals = [v for v in self._params.values() if isinstance(v, ndarray)]
+        shp = _bshape(*vals)
+        return shp[:len(shp) - self.event_dim] if self.event_dim else shp
+
+    def broadcast_to(self, batch_shape):
+        """Broadcast parameter batch dims to `batch_shape`.  Works by
+        shallow-copying the instance (ctor signatures differ from _params —
+        e.g. dual prob/logit parameterizations — so a type(self)(**params)
+        round-trip would reject)."""
+        import copy
+        batch_shape = tuple(batch_shape)
+        new = copy.copy(self)
+        new._params = {}
+        for k, v in self._params.items():
+            if isinstance(v, ndarray):
+                pe = self._param_event.get(k, self.event_dim)
+                ev = v.shape[len(v.shape) - pe:] if pe else ()
+                v = v.broadcast_to(batch_shape + ev)
+            new._params[k] = v
+            setattr(new, k, v)
+        return new
+
+    def __repr__(self):
+        args = ", ".join("%s=%s" % (k, getattr(v, "shape", v))
+                         for k, v in self._params.items())
+        return "%s(%s)" % (type(self).__name__, args)
+
+
+# ---------------------------------------------------------------------------
+# continuous, location-scale
+# ---------------------------------------------------------------------------
+class Normal(Distribution):
+    """Gaussian (reference distributions/normal.py)."""
+
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=as_nd(loc), scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, l, s: l + s * jax.random.normal(
+                key, shape + _bshape(l, s), l.dtype),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, l, s: -0.5 * ((v - l) / s) ** 2 - jnp.log(s)
+            - _LOG_SQRT_2PI, as_nd(value), self.loc, self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, l, s: 0.5 * (1 + jax.scipy.special.erf(
+                (v - l) / (s * math.sqrt(2)))),
+            as_nd(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return apply_op(
+            lambda v, l, s: l + s * math.sqrt(2)
+            * jax.scipy.special.erfinv(2 * v - 1),
+            as_nd(value), self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_op(jnp.square, self.scale)
+
+    def entropy(self):
+        return apply_op(lambda s: 0.5 + _LOG_SQRT_2PI + jnp.log(s), self.scale)
+
+
+class LogNormal(Distribution):
+    """exp(Normal) (reference distributions/lognormal.py)."""
+
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=as_nd(loc), scale=as_nd(scale))
+
+    @property
+    def _base(self):
+        # derived lazily so broadcast_to's shallow copy stays consistent
+        return Normal(self.loc, self.scale)
+
+    def sample(self, size=None):
+        return apply_op(jnp.exp, self._base.sample(size))
+
+    def log_prob(self, value):
+        v = as_nd(value)
+        return apply_op(lambda lp, x: lp - jnp.log(x),
+                        self._base.log_prob(apply_op(jnp.log, v)), v)
+
+    @property
+    def mean(self):
+        return apply_op(lambda l, s: jnp.exp(l + s * s / 2),
+                        self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s),
+            self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            lambda l, s: 0.5 + _LOG_SQRT_2PI + jnp.log(s) + l,
+            self.loc, self.scale)
+
+
+class HalfNormal(Distribution):
+    """|Normal(0, scale)| (reference distributions/half_normal.py)."""
+
+    has_grad = True
+
+    def __init__(self, scale=1.0):
+        super().__init__(scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, s: jnp.abs(s * jax.random.normal(
+                key, shape + s.shape, s.dtype)), self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, s: -0.5 * (v / s) ** 2 - jnp.log(s) - _LOG_SQRT_2PI
+            + math.log(2), as_nd(value), self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, s: jax.scipy.special.erf(v / (s * math.sqrt(2))),
+            as_nd(value), self.scale)
+
+    def icdf(self, value):
+        return apply_op(
+            lambda v, s: s * math.sqrt(2) * jax.scipy.special.erfinv(v),
+            as_nd(value), self.scale)
+
+    @property
+    def mean(self):
+        return apply_op(lambda s: s * math.sqrt(2 / math.pi), self.scale)
+
+    @property
+    def variance(self):
+        return apply_op(lambda s: s * s * (1 - 2 / math.pi), self.scale)
+
+    def entropy(self):
+        return apply_op(
+            lambda s: 0.5 * math.log(math.pi / 2) + 0.5 + jnp.log(s),
+            self.scale)
+
+
+class Laplace(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=as_nd(loc), scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, l, s: l + s * jax.random.laplace(
+                key, shape + _bshape(l, s), l.dtype),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            as_nd(value), self.loc, self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, l, s: 0.5 - 0.5 * jnp.sign(v - l)
+            * jnp.expm1(-jnp.abs(v - l) / s),
+            as_nd(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return apply_op(
+            lambda p, l, s: l - s * jnp.sign(p - 0.5)
+            * jnp.log1p(-2 * jnp.abs(p - 0.5)),
+            as_nd(value), self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_op(lambda s: 2 * s * s, self.scale)
+
+    def entropy(self):
+        return apply_op(lambda s: 1 + jnp.log(2 * s), self.scale)
+
+
+class Cauchy(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=as_nd(loc), scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, l, s: l + s * jax.random.cauchy(
+                key, shape + _bshape(l, s), l.dtype),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, l, s: -math.log(math.pi) - jnp.log(s)
+            - jnp.log1p(((v - l) / s) ** 2),
+            as_nd(value), self.loc, self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+            as_nd(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return apply_op(
+            lambda p, l, s: l + s * jnp.tan(math.pi * (p - 0.5)),
+            as_nd(value), self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return apply_op(lambda l: jnp.full(l.shape, jnp.nan), self.loc)
+
+    @property
+    def variance(self):
+        return apply_op(lambda l: jnp.full(l.shape, jnp.nan), self.loc)
+
+    def entropy(self):
+        return apply_op(lambda s: math.log(4 * math.pi) + jnp.log(s),
+                        self.scale)
+
+
+class HalfCauchy(Distribution):
+    has_grad = True
+
+    def __init__(self, scale=1.0):
+        super().__init__(scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, s: jnp.abs(s * jax.random.cauchy(
+                key, shape + s.shape, s.dtype)), self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, s: math.log(2 / math.pi) - jnp.log(s)
+            - jnp.log1p((v / s) ** 2), as_nd(value), self.scale)
+
+    def cdf(self, value):
+        return apply_op(lambda v, s: 2 / math.pi * jnp.arctan(v / s),
+                        as_nd(value), self.scale)
+
+    def icdf(self, value):
+        return apply_op(lambda p, s: s * jnp.tan(math.pi * p / 2),
+                        as_nd(value), self.scale)
+
+
+class Uniform(Distribution):
+    has_grad = True
+
+    def __init__(self, low=0.0, high=1.0):
+        super().__init__(low=as_nd(low), high=as_nd(high))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, lo, hi: lo + (hi - lo) * jax.random.uniform(
+                key, shape + _bshape(lo, hi), lo.dtype),
+            self.low, self.high)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, lo, hi: jnp.where((v >= lo) & (v <= hi),
+                                        -jnp.log(hi - lo), -jnp.inf),
+            as_nd(value), self.low, self.high)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, lo, hi: jnp.clip((v - lo) / (hi - lo), 0.0, 1.0),
+            as_nd(value), self.low, self.high)
+
+    def icdf(self, value):
+        return apply_op(lambda p, lo, hi: lo + p * (hi - lo),
+                        as_nd(value), self.low, self.high)
+
+    @property
+    def mean(self):
+        return apply_op(lambda lo, hi: (lo + hi) / 2, self.low, self.high)
+
+    @property
+    def variance(self):
+        return apply_op(lambda lo, hi: (hi - lo) ** 2 / 12,
+                        self.low, self.high)
+
+    def entropy(self):
+        return apply_op(lambda lo, hi: jnp.log(hi - lo), self.low, self.high)
+
+
+# ---------------------------------------------------------------------------
+# positive-support
+# ---------------------------------------------------------------------------
+class Exponential(Distribution):
+    has_grad = True
+
+    def __init__(self, scale=1.0):
+        # reference parameterizes by scale (mean), rate = 1/scale
+        super().__init__(scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, s: s * jax.random.exponential(
+                key, shape + s.shape, s.dtype), self.scale)
+
+    def log_prob(self, value):
+        return apply_op(lambda v, s: -v / s - jnp.log(s),
+                        as_nd(value), self.scale)
+
+    def cdf(self, value):
+        return apply_op(lambda v, s: -jnp.expm1(-v / s),
+                        as_nd(value), self.scale)
+
+    def icdf(self, value):
+        return apply_op(lambda p, s: -s * jnp.log1p(-p),
+                        as_nd(value), self.scale)
+
+    @property
+    def mean(self):
+        return self.scale
+
+    @property
+    def variance(self):
+        return apply_op(jnp.square, self.scale)
+
+    def entropy(self):
+        return apply_op(lambda s: 1 + jnp.log(s), self.scale)
+
+
+class Gamma(Distribution):
+    has_grad = True  # jax.random.gamma has implicit reparameterization grads
+
+    def __init__(self, shape=1.0, scale=1.0):
+        super().__init__(shape=as_nd(shape), scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shp = size2shape(size)
+        return sample_op(
+            lambda key, a, s: s * jax.random.gamma(
+                key, a, shp + _bshape(a, s), a.dtype),
+            self.shape, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, a, s: (a - 1) * jnp.log(v) - v / s
+            - jax.scipy.special.gammaln(a) - a * jnp.log(s),
+            as_nd(value), self.shape, self.scale)
+
+    @property
+    def mean(self):
+        return apply_op(jnp.multiply, self.shape, self.scale)
+
+    @property
+    def variance(self):
+        return apply_op(lambda a, s: a * s * s, self.shape, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            lambda a, s: a + jnp.log(s) + jax.scipy.special.gammaln(a)
+            + (1 - a) * jax.scipy.special.digamma(a),
+            self.shape, self.scale)
+
+
+class Chi2(Gamma):
+    def __init__(self, df):
+        df = as_nd(df)
+        super().__init__(shape=apply_op(lambda d: d / 2, df), scale=2.0)
+        self.df = df
+
+
+class Beta(Distribution):
+    has_grad = True
+
+    def __init__(self, alpha=1.0, beta=1.0):
+        super().__init__(alpha=as_nd(alpha), beta=as_nd(beta))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, a, b: jax.random.beta(
+                key, a, b, shape + _bshape(a, b), a.dtype),
+            self.alpha, self.beta)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, a, b: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+               - jax.scipy.special.gammaln(a + b)),
+            as_nd(value), self.alpha, self.beta)
+
+    @property
+    def mean(self):
+        return apply_op(lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+            self.alpha, self.beta)
+
+    def entropy(self):
+        def f(a, b):
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            dg = jax.scipy.special.digamma
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return apply_op(f, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    has_grad = True
+    event_dim = 1
+
+    def __init__(self, alpha):
+        super().__init__(alpha=as_nd(alpha))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, a: jax.random.dirichlet(key, a, shape + a.shape[:-1]),
+            self.alpha)
+
+    def log_prob(self, value):
+        def f(v, a):
+            lnB = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                   - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+            return jnp.sum((a - 1) * jnp.log(v), -1) - lnB
+        return apply_op(f, as_nd(value), self.alpha)
+
+    @property
+    def mean(self):
+        return apply_op(lambda a: a / jnp.sum(a, -1, keepdims=True),
+                        self.alpha)
+
+    @property
+    def variance(self):
+        def f(a):
+            a0 = jnp.sum(a, -1, keepdims=True)
+            return a * (a0 - a) / (a0 ** 2 * (a0 + 1))
+        return apply_op(f, self.alpha)
+
+    def entropy(self):
+        def f(a):
+            k = a.shape[-1]
+            a0 = jnp.sum(a, -1)
+            lnB = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                   - jax.scipy.special.gammaln(a0))
+            dg = jax.scipy.special.digamma
+            return (lnB + (a0 - k) * dg(a0)
+                    - jnp.sum((a - 1) * dg(a), -1))
+        return apply_op(f, self.alpha)
+
+
+class Weibull(Distribution):
+    has_grad = True
+
+    def __init__(self, concentration, scale=1.0):
+        super().__init__(concentration=as_nd(concentration),
+                         scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, k, s: s * jax.random.weibull_min(
+                key, 1.0, k, shape + _bshape(k, s), k.dtype),
+            self.concentration, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, k, s: jnp.log(k / s) + (k - 1) * jnp.log(v / s)
+            - (v / s) ** k,
+            as_nd(value), self.concentration, self.scale)
+
+    def cdf(self, value):
+        return apply_op(lambda v, k, s: -jnp.expm1(-(v / s) ** k),
+                        as_nd(value), self.concentration, self.scale)
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda k, s: s * jnp.exp(jax.scipy.special.gammaln(1 + 1 / k)),
+            self.concentration, self.scale)
+
+    @property
+    def variance(self):
+        def f(k, s):
+            g1 = jnp.exp(jax.scipy.special.gammaln(1 + 1 / k))
+            g2 = jnp.exp(jax.scipy.special.gammaln(1 + 2 / k))
+            return s * s * (g2 - g1 * g1)
+        return apply_op(f, self.concentration, self.scale)
+
+
+class Pareto(Distribution):
+    has_grad = True
+
+    def __init__(self, alpha, scale=1.0):
+        super().__init__(alpha=as_nd(alpha), scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, a, s: s * jnp.exp(jax.random.exponential(
+                key, shape + _bshape(a, s), a.dtype) / a),
+            self.alpha, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, a, s: jnp.log(a) + a * jnp.log(s)
+            - (a + 1) * jnp.log(v),
+            as_nd(value), self.alpha, self.scale)
+
+    def cdf(self, value):
+        return apply_op(lambda v, a, s: 1 - (s / v) ** a,
+                        as_nd(value), self.alpha, self.scale)
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda a, s: jnp.where(a > 1, a * s / (a - 1), jnp.inf),
+            self.alpha, self.scale)
+
+
+class Gumbel(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=as_nd(loc), scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, l, s: l + s * jax.random.gumbel(
+                key, shape + _bshape(l, s), l.dtype),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -z - jnp.exp(-z) - jnp.log(s)
+        return apply_op(f, as_nd(value), self.loc, self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, l, s: jnp.exp(-jnp.exp(-(v - l) / s)),
+            as_nd(value), self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return apply_op(lambda l, s: l + s * _EULER, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply_op(lambda s: (math.pi ** 2 / 6) * s * s, self.scale)
+
+    def entropy(self):
+        return apply_op(lambda s: jnp.log(s) + 1 + _EULER, self.scale)
+
+
+class StudentT(Distribution):
+    has_grad = True
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        super().__init__(df=as_nd(df), loc=as_nd(loc), scale=as_nd(scale))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, df, l, s: l + s * jax.random.t(
+                key, df, shape + _bshape(df, l, s), l.dtype),
+            self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, df, l, s):
+            z = (v - l) / s
+            return (jax.scipy.special.gammaln((df + 1) / 2)
+                    - jax.scipy.special.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+        return apply_op(f, as_nd(value), self.df, self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return apply_op(lambda df, l: jnp.where(df > 1, l, jnp.nan),
+                        self.df, self.loc)
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda df, s: jnp.where(df > 2, s * s * df / (df - 2),
+                                    jnp.where(df > 1, jnp.inf, jnp.nan)),
+            self.df, self.scale)
+
+
+class FisherSnedecor(Distribution):
+    """F-distribution (reference distributions/fishersnedecor.py)."""
+
+    has_grad = True
+
+    def __init__(self, df1, df2):
+        super().__init__(df1=as_nd(df1), df2=as_nd(df2))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+
+        def f(key, d1, d2):
+            k1, k2 = jax.random.split(key)
+            s = shape + _bshape(d1, d2)
+            x1 = 2 * jax.random.gamma(k1, d1 / 2, s, jnp.float32)
+            x2 = 2 * jax.random.gamma(k2, d2 / 2, s, jnp.float32)
+            return (x1 / d1) / (x2 / d2)
+        return sample_op(f, self.df1, self.df2)
+
+    def log_prob(self, value):
+        def f(v, d1, d2):
+            lbeta = (jax.scipy.special.gammaln(d1 / 2)
+                     + jax.scipy.special.gammaln(d2 / 2)
+                     - jax.scipy.special.gammaln((d1 + d2) / 2))
+            return (d1 / 2 * jnp.log(d1 / d2) + (d1 / 2 - 1) * jnp.log(v)
+                    - (d1 + d2) / 2 * jnp.log1p(d1 * v / d2) - lbeta)
+        return apply_op(f, as_nd(value), self.df1, self.df2)
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda d2: jnp.where(d2 > 2, d2 / (d2 - 2), jnp.nan), self.df2)
+
+
+# ---------------------------------------------------------------------------
+# discrete
+# ---------------------------------------------------------------------------
+def _logits_from_probs(probs, binary=False):
+    if binary:
+        return apply_op(lambda p: jnp.log(p) - jnp.log1p(-p), probs)
+    return apply_op(lambda p: jnp.log(p), probs)
+
+
+def _probs_from_logits(logits, binary=False):
+    if binary:
+        return apply_op(jax.nn.sigmoid, logits)
+    return apply_op(jax.nn.softmax, logits)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("pass exactly one of prob/logit")
+        if prob is not None:
+            prob = as_nd(prob)
+            logit = _logits_from_probs(prob, True)
+        else:
+            logit = as_nd(logit)
+            prob = _probs_from_logits(logit, True)
+        super().__init__(prob=prob, logit=logit)
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, p: jax.random.bernoulli(
+                key, p, shape + p.shape).astype(p.dtype), self.prob)
+
+    def log_prob(self, value):
+        # numerically stable via logits: v*logit - softplus(logit)
+        return apply_op(
+            lambda v, z: v * z - jax.nn.softplus(z), as_nd(value), self.logit)
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: p * (1 - p), self.prob)
+
+    def entropy(self):
+        return apply_op(
+            lambda z: jax.nn.softplus(z) - z * jax.nn.sigmoid(z), self.logit)
+
+
+class Geometric(Distribution):
+    """#failures before first success (support {0,1,2,...})."""
+
+    def __init__(self, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("pass exactly one of prob/logit")
+        if prob is not None:
+            prob = as_nd(prob)
+            logit = _logits_from_probs(prob, True)
+        else:
+            logit = as_nd(logit)
+            prob = _probs_from_logits(logit, True)
+        super().__init__(prob=prob, logit=logit)
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, p: jnp.floor(
+                jnp.log1p(-jax.random.uniform(key, shape + p.shape))
+                / jnp.log1p(-p)), self.prob)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+            as_nd(value), self.prob)
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: (1 - p) / p, self.prob)
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: (1 - p) / (p * p), self.prob)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        super().__init__(rate=as_nd(rate))
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, r: jax.random.poisson(
+                key, r, shape + r.shape).astype(r.dtype), self.rate)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, r: v * jnp.log(r) - r
+            - jax.scipy.special.gammaln(v + 1), as_nd(value), self.rate)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+
+class Binomial(Distribution):
+    def __init__(self, n=1, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("pass exactly one of prob/logit")
+        if prob is not None:
+            prob = as_nd(prob)
+            logit = _logits_from_probs(prob, True)
+        else:
+            logit = as_nd(logit)
+            prob = _probs_from_logits(logit, True)
+        super().__init__(prob=prob, logit=logit)
+        self.n = n
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        n = int(self.n)
+
+        def f(key, p):
+            u = jax.random.uniform(key, (n,) + shape + p.shape)
+            return jnp.sum((u < p).astype(p.dtype), axis=0)
+        return sample_op(f, self.prob)
+
+    def log_prob(self, value):
+        n = float(self.n)
+
+        def f(v, p):
+            logc = (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1))
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return apply_op(f, as_nd(value), self.prob)
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: self.n * p, self.prob)
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: self.n * p * (1 - p), self.prob)
+
+
+class NegativeBinomial(Distribution):
+    """#failures until n-th success."""
+
+    def __init__(self, n, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("pass exactly one of prob/logit")
+        if prob is not None:
+            prob = as_nd(prob)
+            logit = _logits_from_probs(prob, True)
+        else:
+            logit = as_nd(logit)
+            prob = _probs_from_logits(logit, True)
+        super().__init__(prob=prob, logit=logit)
+        self.n = as_nd(n)
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+
+        def f(key, n, p):
+            k1, k2 = jax.random.split(key)
+            # gamma-poisson mixture
+            lam = jax.random.gamma(k1, n, shape + _bshape(n, p)) \
+                * (1 - p) / p
+            return jax.random.poisson(k2, lam).astype(p.dtype)
+        return sample_op(f, self.n, self.prob)
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            logc = (jax.scipy.special.gammaln(v + n)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n))
+            return logc + n * jnp.log(p) + v * jnp.log1p(-p)
+        return apply_op(f, as_nd(value), self.n, self.prob)
+
+    @property
+    def mean(self):
+        return apply_op(lambda n, p: n * (1 - p) / p, self.n, self.prob)
+
+    @property
+    def variance(self):
+        return apply_op(lambda n, p: n * (1 - p) / (p * p),
+                        self.n, self.prob)
+
+
+class Categorical(Distribution):
+    """Index-valued categorical (reference distributions/categorical.py)."""
+
+    _param_event = {"prob": 1, "logit": 1}
+
+    def __init__(self, num_events=None, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("pass exactly one of prob/logit")
+        if prob is not None:
+            prob = as_nd(prob)
+            logit = apply_op(lambda p: jnp.log(p), prob)
+        else:
+            logit = as_nd(logit)
+            prob = apply_op(jax.nn.softmax, logit)
+        super().__init__(prob=prob, logit=logit)
+        self.num_events = num_events or prob.shape[-1]
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        return sample_op(
+            lambda key, z: jax.random.categorical(
+                key, z, shape=shape + z.shape[:-1]).astype(jnp.float32),
+            self.logit)
+
+    def log_prob(self, value):
+        def f(v, z):
+            logp = jax.nn.log_softmax(z)
+            # batch dims of value broadcast against the distribution's
+            logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
+            idx = v.astype(jnp.int32)
+            return jnp.take_along_axis(logp, idx[..., None], -1)[..., 0]
+        return apply_op(f, as_nd(value), self.logit)
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda p: jnp.sum(p * jnp.arange(p.shape[-1], dtype=p.dtype), -1),
+            self.prob)
+
+    def entropy(self):
+        return apply_op(
+            lambda z: -jnp.sum(jax.nn.softmax(z) * jax.nn.log_softmax(z), -1),
+            self.logit)
+
+
+class OneHotCategorical(Categorical):
+    event_dim = 1
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+
+        def f(key, z):
+            idx = jax.random.categorical(key, z, shape=shape + z.shape[:-1])
+            return jax.nn.one_hot(idx, z.shape[-1], dtype=z.dtype)
+        return sample_op(f, self.logit)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, z: jnp.sum(v * jax.nn.log_softmax(z), -1),
+            as_nd(value), self.logit)
+
+
+class Multinomial(Distribution):
+    event_dim = 1
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 total_count=1):
+        if (prob is None) == (logit is None):
+            raise ValueError("pass exactly one of prob/logit")
+        if prob is not None:
+            prob = as_nd(prob)
+            logit = apply_op(lambda p: jnp.log(p), prob)
+        else:
+            logit = as_nd(logit)
+            prob = apply_op(jax.nn.softmax, logit)
+        super().__init__(prob=prob, logit=logit)
+        self.total_count = int(total_count)
+        self.num_events = num_events or prob.shape[-1]
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        n = self.total_count
+
+        def f(key, z):
+            idx = jax.random.categorical(
+                key, z, shape=(n,) + shape + z.shape[:-1])
+            return jnp.sum(jax.nn.one_hot(idx, z.shape[-1], dtype=z.dtype),
+                           axis=0)
+        return sample_op(f, self.logit)
+
+    def log_prob(self, value):
+        def f(v, z):
+            logp = jax.nn.log_softmax(z)
+            logc = (jax.scipy.special.gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1), -1))
+            return logc + jnp.sum(v * logp, -1)
+        return apply_op(f, as_nd(value), self.logit)
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: self.total_count * p, self.prob)
+
+
+class RelaxedBernoulli(Distribution):
+    """Gumbel-sigmoid relaxation (reparameterized, reference
+    distributions/relaxed_bernoulli.py)."""
+
+    has_grad = True
+
+    def __init__(self, T=1.0, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("pass exactly one of prob/logit")
+        if prob is not None:
+            prob = as_nd(prob)
+            logit = _logits_from_probs(prob, True)
+        else:
+            logit = as_nd(logit)
+            prob = _probs_from_logits(logit, True)
+        super().__init__(prob=prob, logit=logit)
+        self.T = T
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        T = float(self.T)
+
+        def f(key, z):
+            u = jax.random.uniform(key, shape + z.shape,
+                                   minval=1e-6, maxval=1 - 1e-6)
+            L = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((z + L) / T)
+        return sample_op(f, self.logit)
+
+    def log_prob(self, value):
+        T = float(self.T)
+
+        def f(v, z):
+            diff = z - T * (jnp.log(v) - jnp.log1p(-v))
+            return (math.log(T) + diff - 2 * jax.nn.softplus(diff)
+                    - jnp.log(v * (1 - v)))
+        return apply_op(f, as_nd(value), self.logit)
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """Gumbel-softmax relaxation."""
+
+    has_grad = True
+    event_dim = 1
+
+    def __init__(self, T=1.0, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("pass exactly one of prob/logit")
+        if prob is not None:
+            prob = as_nd(prob)
+            logit = apply_op(lambda p: jnp.log(p), prob)
+        else:
+            logit = as_nd(logit)
+            prob = apply_op(jax.nn.softmax, logit)
+        super().__init__(prob=prob, logit=logit)
+        self.T = T
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        T = float(self.T)
+
+        def f(key, z):
+            g = jax.random.gumbel(key, shape + z.shape, z.dtype)
+            return jax.nn.softmax((z + g) / T, axis=-1)
+        return sample_op(f, self.logit)
+
+    def log_prob(self, value):
+        T = float(self.T)
+
+        def f(v, z):
+            k = z.shape[-1]
+            logc = jax.scipy.special.gammaln(jnp.asarray(float(k)))
+            score = jnp.sum(z - (T + 1) * jnp.log(v), -1)
+            norm = -k * jnp.log(
+                jnp.sum(jnp.exp(z) / (v ** T), -1))
+            return logc + (k - 1) * math.log(T) + score + norm
+        return apply_op(f, as_nd(value), self.logit)
+
+
+# ---------------------------------------------------------------------------
+# multivariate + combinators
+# ---------------------------------------------------------------------------
+class MultivariateNormal(Distribution):
+    has_grad = True
+    event_dim = 1
+    _param_event = {"loc": 1, "cov": 2, "scale_tril": 2}
+
+    def __init__(self, loc, cov=None, scale_tril=None):
+        if (cov is None) == (scale_tril is None):
+            raise ValueError("pass exactly one of cov/scale_tril")
+        loc = as_nd(loc)
+        if scale_tril is None:
+            scale_tril = apply_op(
+                lambda c: jnp.linalg.cholesky(c), as_nd(cov))
+            cov = as_nd(cov)
+        else:
+            scale_tril = as_nd(scale_tril)
+            cov = apply_op(
+                lambda L: L @ jnp.swapaxes(L, -1, -2), scale_tril)
+        super().__init__(loc=loc, cov=cov, scale_tril=scale_tril)
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+
+        def f(key, l, L):
+            eps = jax.random.normal(
+                key, shape + l.shape, l.dtype)
+            return l + jnp.einsum("...ij,...j->...i", L, eps)
+        return sample_op(f, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        def f(v, l, L):
+            d = v - l
+            # solve L y = d  (lower triangular)
+            y = jax.scipy.linalg.solve_triangular(L, d[..., None],
+                                                  lower=True)[..., 0]
+            k = l.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return (-0.5 * jnp.sum(y * y, -1) - logdet
+                    - k * _LOG_SQRT_2PI)
+        return apply_op(f, as_nd(value), self.loc, self.scale_tril)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda c: jnp.diagonal(c, axis1=-2, axis2=-1), self.cov)
+
+    def entropy(self):
+        def f(L):
+            k = L.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return k / 2 * (1 + math.log(2 * math.pi)) + logdet
+        return apply_op(f, self.scale_tril)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims
+    (reference distributions/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base_dist = base
+        self.num_dims = reinterpreted_batch_ndims
+        self.event_dim = base.event_dim + reinterpreted_batch_ndims
+        self._params = {}
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def log_prob(self, value):
+        lp = self.base_dist.log_prob(value)
+        return apply_op(
+            lambda x: jnp.sum(x, axis=tuple(range(-self.num_dims, 0))), lp)
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        e = self.base_dist.entropy()
+        return apply_op(
+            lambda x: jnp.sum(x, axis=tuple(range(-self.num_dims, 0))), e)
+
+
+class MixtureSameFamily(Distribution):
+    """Mixture with shared component family
+    (reference distributions/mixture_same_family.py)."""
+
+    def __init__(self, mixture_logits, component):
+        self.mixture_logits = as_nd(mixture_logits)
+        self.components = component  # batch shape [..., K] + event
+        self.event_dim = component.event_dim
+        self._params = {}
+
+    def sample(self, size=None):
+        shape = size2shape(size)
+        comp = self.components.sample(size)  # [..., K, event...]
+        k_axis = comp.ndim - self.event_dim - 1
+
+        def f(key, z, c):
+            idx = jax.random.categorical(key, z, shape=shape + z.shape[:-1])
+            idx = idx.reshape(idx.shape + (1,) * (c.ndim - idx.ndim))
+            return jnp.take_along_axis(c, idx.astype(jnp.int32),
+                                       axis=k_axis)[..., 0, :] \
+                if self.event_dim else jnp.take_along_axis(
+                    c, idx.astype(jnp.int32), axis=k_axis).squeeze(k_axis)
+        return sample_op(f, self.mixture_logits, comp)
+
+    def log_prob(self, value):
+        v = as_nd(value)
+        vexp = apply_op(
+            lambda x: jnp.expand_dims(x, -1 - self.event_dim), v)
+        lp = self.components.log_prob(vexp)  # [..., K]
+        return apply_op(
+            lambda l, z: jax.scipy.special.logsumexp(
+                l + jax.nn.log_softmax(z), axis=-1),
+            lp, self.mixture_logits)
+
+    @property
+    def mean(self):
+        m = self.components.mean
+        return apply_op(
+            lambda mu, z: jnp.sum(
+                mu * jnp.expand_dims(jax.nn.softmax(z), tuple(
+                    range(-self.event_dim, 0)) if self.event_dim else -1)
+                if self.event_dim else mu * jax.nn.softmax(z),
+                axis=-1 - self.event_dim),
+            m, self.mixture_logits)

@@ -1,0 +1,179 @@
+"""KL divergence registry.
+
+Parity: reference `python/mxnet/gluon/probability/distributions/divergence.py`
+(`kl_divergence(p, q)` + `register_kl` decorator dispatching on the class
+pair; `empirical_kl` Monte-Carlo fallback).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ndarray import apply_op
+from . import distributions as D
+
+__all__ = ["kl_divergence", "register_kl", "empirical_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return decorator
+
+
+def _dispatch(type_p, type_q):
+    # walk the MROs for the most specific registered pair
+    matches = []
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if issubclass(type_p, tp) and issubclass(type_q, tq):
+            matches.append((tp, tq, fn))
+    if not matches:
+        return None
+    matches.sort(key=lambda m: (type_p.__mro__.index(m[0]),
+                                type_q.__mro__.index(m[1])))
+    return matches[0][2]
+
+
+def kl_divergence(p, q):
+    """KL(p ‖ q).  Exact when a rule is registered, else raises
+    (use empirical_kl for a Monte-Carlo estimate)."""
+    fn = _dispatch(type(p), type(q))
+    if fn is None:
+        raise NotImplementedError(
+            "no KL rule for (%s, %s)" % (type(p).__name__, type(q).__name__))
+    return fn(p, q)
+
+
+def empirical_kl(p, q, n_samples=1):
+    """Monte-Carlo KL: E_p[log p(x) - log q(x)]."""
+    x = p.sample((n_samples,)) if n_samples > 1 else p.sample()
+    diff = apply_op(jnp.subtract, p.log_prob(x), q.log_prob(x))
+    if n_samples > 1:
+        return apply_op(lambda d: jnp.mean(d, axis=0), diff)
+    return diff
+
+
+@register_kl(D.Normal, D.Normal)
+def _kl_normal_normal(p, q):
+    return apply_op(
+        lambda lp, sp, lq, sq: (jnp.log(sq / sp)
+                                + (sp ** 2 + (lp - lq) ** 2) / (2 * sq ** 2)
+                                - 0.5),
+        p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(D.Uniform, D.Uniform)
+def _kl_uniform_uniform(p, q):
+    return apply_op(
+        lambda pl, ph, ql, qh: jnp.where(
+            (ql <= pl) & (ph <= qh),
+            jnp.log((qh - ql) / (ph - pl)), jnp.inf),
+        p.low, p.high, q.low, q.high)
+
+
+@register_kl(D.Exponential, D.Exponential)
+def _kl_exp_exp(p, q):
+    # rate r = 1/scale
+    return apply_op(
+        lambda sp, sq: jnp.log(sq / sp) + sp / sq - 1, p.scale, q.scale)
+
+
+@register_kl(D.Laplace, D.Laplace)
+def _kl_laplace_laplace(p, q):
+    return apply_op(
+        lambda lp, sp, lq, sq: (jnp.log(sq / sp)
+                                + (sp * jnp.exp(-jnp.abs(lp - lq) / sp)
+                                   + jnp.abs(lp - lq)) / sq - 1),
+        p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(D.Bernoulli, D.Bernoulli)
+def _kl_bern_bern(p, q):
+    return apply_op(
+        lambda pp, qp: (jax.scipy.special.xlogy(pp, pp / qp)
+                        + jax.scipy.special.xlogy(1 - pp,
+                                                  (1 - pp) / (1 - qp))),
+        p.prob, q.prob)
+
+
+@register_kl(D.Categorical, D.Categorical)
+def _kl_cat_cat(p, q):
+    return apply_op(
+        lambda zp, zq: jnp.sum(jax.nn.softmax(zp)
+                               * (jax.nn.log_softmax(zp)
+                                  - jax.nn.log_softmax(zq)), -1),
+        p.logit, q.logit)
+
+
+@register_kl(D.Gamma, D.Gamma)
+def _kl_gamma_gamma(p, q):
+    def f(ap, sp, aq, sq):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        return ((ap - aq) * dg(ap) - gl(ap) + gl(aq)
+                + aq * (jnp.log(sq) - jnp.log(sp))
+                + ap * (sp / sq - 1))
+    return apply_op(f, p.shape, p.scale, q.shape, q.scale)
+
+
+@register_kl(D.Beta, D.Beta)
+def _kl_beta_beta(p, q):
+    def f(a1, b1, a2, b2):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        lbeta1 = gl(a1) + gl(b1) - gl(a1 + b1)
+        lbeta2 = gl(a2) + gl(b2) - gl(a2 + b2)
+        return (lbeta2 - lbeta1 + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+    return apply_op(f, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(D.Dirichlet, D.Dirichlet)
+def _kl_dir_dir(p, q):
+    def f(ap, aq):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        a0 = jnp.sum(ap, -1)
+        return (gl(a0) - jnp.sum(gl(ap), -1)
+                - jax.scipy.special.gammaln(jnp.sum(aq, -1))
+                + jnp.sum(gl(aq), -1)
+                + jnp.sum((ap - aq) * (dg(ap) - dg(a0)[..., None]), -1))
+    return apply_op(f, p.alpha, q.alpha)
+
+
+@register_kl(D.Poisson, D.Poisson)
+def _kl_poisson_poisson(p, q):
+    return apply_op(
+        lambda rp, rq: rp * jnp.log(rp / rq) - rp + rq, p.rate, q.rate)
+
+
+@register_kl(D.Geometric, D.Geometric)
+def _kl_geom_geom(p, q):
+    return apply_op(
+        lambda pp, qp: jnp.log(pp / qp)
+        + (1 - pp) / pp * jnp.log((1 - pp) / (1 - qp)),
+        p.prob, q.prob)
+
+
+@register_kl(D.MultivariateNormal, D.MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    def f(lp, Lp, lq, Lq):
+        k = lp.shape[-1]
+        logdet_p = 2 * jnp.sum(jnp.log(jnp.diagonal(Lp, axis1=-2,
+                                                    axis2=-1)), -1)
+        logdet_q = 2 * jnp.sum(jnp.log(jnp.diagonal(Lq, axis1=-2,
+                                                    axis2=-1)), -1)
+        # tr(Σq⁻¹ Σp) = ‖Lq⁻¹ Lp‖_F²
+        M = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+        tr = jnp.sum(M * M, axis=(-2, -1))
+        d = lq - lp
+        y = jax.scipy.linalg.solve_triangular(Lq, d[..., None],
+                                              lower=True)[..., 0]
+        maha = jnp.sum(y * y, -1)
+        return 0.5 * (logdet_q - logdet_p - k + tr + maha)
+    return apply_op(f, p.loc, p.scale_tril, q.loc, q.scale_tril)

@@ -1,0 +1,82 @@
+"""StochasticBlock — Gluon blocks with auxiliary (KL/entropy) losses.
+
+Parity: reference `python/mxnet/gluon/probability/block/stochastic_block.py`
+(StochasticBlock.collectLoss decorator captures `add_loss` terms during
+forward; StochasticSequential chains them).  Used for VAEs / bayesian
+layers where the forward pass contributes regularizer terms.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    """HybridBlock that can `add_loss()` during forward; losses are
+    collected when the block is called through `collectLoss`."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+        self._flag = False
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @staticmethod
+    def collectLoss(forward_fn):
+        """Decorator for `forward`: returns (out, losses)."""
+        @functools.wraps(forward_fn)
+        def wrapped(self, *args, **kwargs):
+            self._losscache = []
+            out = forward_fn(self, *args, **kwargs)
+            self._losses = list(self._losscache)
+            self._losscache = []
+            self._flag = True
+            return out
+        wrapped._collect_loss = True
+        return wrapped
+
+    def __call__(self, *args, **kwargs):
+        self._flag = False
+        out = super().__call__(*args, **kwargs)
+        return out
+
+    @property
+    def losses(self):
+        return self._losses
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential container aggregating child StochasticBlock losses
+    (reference block/stochastic_block.py StochasticSequential)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        self._losscache = []
+        for block in self._layers:
+            x = block(x)
+            if isinstance(block, StochasticBlock):
+                for l in block.losses:
+                    self.add_loss(l)
+        self._losses = list(self._losscache)
+        self._losscache = []
+        return x
+
+    def __getitem__(self, i):
+        return self._layers[i]
+
+    def __len__(self):
+        return len(self._layers)

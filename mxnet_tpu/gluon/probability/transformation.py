@@ -1,0 +1,240 @@
+"""Bijective transformations + TransformedDistribution.
+
+Parity: reference `python/mxnet/gluon/probability/transformation/` —
+Transformation base with forward/inv/log_det_jacobian,
+{Exp,Affine,Sigmoid,Softmax,Abs,Power,Compose}Transform — and
+`distributions/transformed_distribution.py` (pushforward log_prob via the
+change-of-variables formula).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ndarray import apply_op
+from .utils import as_nd
+from .distributions import Distribution
+
+__all__ = ["Transformation", "ExpTransform", "AffineTransform",
+           "SigmoidTransform", "SoftmaxTransform", "AbsTransform",
+           "PowerTransform", "ComposeTransform", "TransformedDistribution"]
+
+
+def _mul_signs(signs):
+    """Product of +1/-1/ndarray monotonicity signs."""
+    total = 1
+    for s in signs:
+        if isinstance(total, int) and isinstance(s, int):
+            total = total * s
+        else:
+            a = as_nd(float(total)) if isinstance(total, int) else total
+            b = as_nd(float(s)) if isinstance(s, int) else s
+            total = apply_op(jnp.multiply, a, b)
+    return total
+
+
+class Transformation:
+    """Bijector base (reference transformation/transformation.py)."""
+
+    bijective = True
+    event_dim = 0
+
+    @property
+    def sign(self):
+        """+1 for increasing, -1 for decreasing transforms (may be an
+        ndarray for elementwise-signed transforms like negative-scale
+        affine)."""
+        return 1
+
+    def __call__(self, x):
+        return self._forward_compute(x)
+
+    def _forward_compute(self, x):
+        raise NotImplementedError
+
+    def inv(self, y):
+        raise NotImplementedError
+
+    def log_det_jacobian(self, x, y):
+        """log |dy/dx| at x (y = forward(x) passed to avoid recompute)."""
+        raise NotImplementedError
+
+
+class ExpTransform(Transformation):
+    def _forward_compute(self, x):
+        return apply_op(jnp.exp, as_nd(x))
+
+    def inv(self, y):
+        return apply_op(jnp.log, as_nd(y))
+
+    def log_det_jacobian(self, x, y):
+        return as_nd(x)
+
+
+class AffineTransform(Transformation):
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc = as_nd(loc)
+        self.scale = as_nd(scale)
+
+    def _forward_compute(self, x):
+        return apply_op(lambda v, l, s: l + s * v, as_nd(x),
+                        self.loc, self.scale)
+
+    def inv(self, y):
+        return apply_op(lambda v, l, s: (v - l) / s, as_nd(y),
+                        self.loc, self.scale)
+
+    def log_det_jacobian(self, x, y):
+        return apply_op(
+            lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)), v.shape),
+            as_nd(x), self.scale)
+
+    @property
+    def sign(self):
+        return apply_op(jnp.sign, self.scale)
+
+
+class SigmoidTransform(Transformation):
+    def _forward_compute(self, x):
+        return apply_op(jax.nn.sigmoid, as_nd(x))
+
+    def inv(self, y):
+        return apply_op(lambda v: jnp.log(v) - jnp.log1p(-v), as_nd(y))
+
+    def log_det_jacobian(self, x, y):
+        return apply_op(
+            lambda v: -jax.nn.softplus(v) - jax.nn.softplus(-v), as_nd(x))
+
+
+class SoftmaxTransform(Transformation):
+    bijective = False
+    event_dim = 1
+
+    def _forward_compute(self, x):
+        return apply_op(lambda v: jax.nn.softmax(v, axis=-1), as_nd(x))
+
+    def inv(self, y):
+        return apply_op(jnp.log, as_nd(y))
+
+
+class AbsTransform(Transformation):
+    bijective = False
+
+    def _forward_compute(self, x):
+        return apply_op(jnp.abs, as_nd(x))
+
+    def inv(self, y):
+        return as_nd(y)
+
+
+class PowerTransform(Transformation):
+    def __init__(self, exponent):
+        self.exponent = as_nd(exponent)
+
+    def _forward_compute(self, x):
+        return apply_op(lambda v, e: v ** e, as_nd(x), self.exponent)
+
+    def inv(self, y):
+        return apply_op(lambda v, e: v ** (1.0 / e), as_nd(y), self.exponent)
+
+    def log_det_jacobian(self, x, y):
+        return apply_op(
+            lambda v, e: jnp.log(jnp.abs(e * v ** (e - 1))),
+            as_nd(x), self.exponent)
+
+
+class ComposeTransform(Transformation):
+    def __init__(self, parts):
+        self.parts = list(parts)
+        self.event_dim = max((p.event_dim for p in self.parts), default=0)
+
+    def _forward_compute(self, x):
+        for p in self.parts:
+            x = p(x)
+        return x
+
+    def inv(self, y):
+        for p in reversed(self.parts):
+            y = p.inv(y)
+        return y
+
+    @property
+    def sign(self):
+        return _mul_signs(p.sign for p in self.parts)
+
+    def log_det_jacobian(self, x, y):
+        total = None
+        for p in self.parts:
+            px = p(x)
+            ld = p.log_det_jacobian(x, px)
+            if p.event_dim < self.event_dim:
+                ld = apply_op(
+                    lambda v: jnp.sum(v, axis=tuple(
+                        range(-(self.event_dim - p.event_dim), 0))), ld)
+            total = ld if total is None else apply_op(jnp.add, total, ld)
+            x = px
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward of `base` through `transforms`
+    (reference distributions/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self.base_dist = base
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        self.event_dim = max(
+            [base.event_dim] + [t.event_dim for t in self.transforms])
+        self._params = {}
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    def sample(self, size=None):
+        x = self.base_dist.sample(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def log_prob(self, value):
+        """change of variables: log p(y) = log p_base(x) - Σ log|J|."""
+        y = as_nd(value)
+        lp_parts = []
+        # invert the chain, accumulating jacobians
+        xs = [y]
+        for t in reversed(self.transforms):
+            xs.append(t.inv(xs[-1]))
+        xs.reverse()  # xs[0] = base sample, xs[-1] = y
+        lp = self.base_dist.log_prob(xs[0])
+        if self.base_dist.event_dim < self.event_dim:
+            extra = self.event_dim - self.base_dist.event_dim
+            lp = apply_op(
+                lambda v: jnp.sum(v, axis=tuple(range(-extra, 0))), lp)
+        for t, x_in, x_out in zip(self.transforms, xs[:-1], xs[1:]):
+            ld = t.log_det_jacobian(x_in, x_out)
+            if t.event_dim < self.event_dim:
+                extra = self.event_dim - t.event_dim
+                ld = apply_op(
+                    lambda v: jnp.sum(v, axis=tuple(range(-extra, 0))), ld)
+            lp = apply_op(jnp.subtract, lp, ld)
+        return lp
+
+    def cdf(self, value):
+        """F_Y(y) = F_X(g⁻¹(y)) for increasing g; 1 - F_X(g⁻¹(y)) for
+        decreasing (continuous base)."""
+        y = as_nd(value)
+        for t in reversed(self.transforms):
+            y = t.inv(y)
+        sign = _mul_signs(t.sign for t in self.transforms)
+        base_cdf = self.base_dist.cdf(y)
+        if isinstance(sign, int):
+            if sign >= 0:
+                return base_cdf
+            return apply_op(lambda c: 1.0 - c, base_cdf)
+        return apply_op(
+            lambda c, s: jnp.where(s >= 0, c, 1.0 - c), base_cdf, sign)

@@ -1,0 +1,62 @@
+"""Shared helpers for gluon.probability.
+
+Parity: reference `python/mxnet/gluon/probability/distributions/utils.py`
+(getF/sample_n_shape glue — not needed here since there is no nd/sym
+split: every op funnels through ndarray.apply_op, which both executes on
+XLA and records autograd VJPs).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ...ndarray import ndarray, apply_op, array as nd_array
+from ..._rng import next_key
+
+__all__ = ["op", "sample_op", "as_nd", "const", "size2shape", "gammaln",
+           "digamma", "erf", "erfinv", "xlogy", "logsumexp"]
+
+
+def as_nd(x):
+    return x if isinstance(x, ndarray) else nd_array(onp.asarray(x, onp.float32))
+
+
+def op(fn, *args):
+    """apply_op alias: ndarray-in/ndarray-out, autograd-recorded."""
+    return apply_op(fn, *args)
+
+
+def sample_op(fn, *diff_args):
+    """Run `fn(key, *arg_values)` with a fresh PRNG subkey; differentiable
+    w.r.t. diff_args (reparameterized samplers)."""
+    key = next_key()
+    return apply_op(lambda *a: fn(key, *a), *diff_args)
+
+
+def const(value):
+    return float(value)
+
+
+def size2shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(int(s) for s in size)
+
+
+# special functions (jax.scipy) — exposed for distribution math
+gammaln = jax.scipy.special.gammaln
+digamma = jax.scipy.special.digamma
+erf = jax.scipy.special.erf
+erfinv = jax.scipy.special.erfinv
+
+
+def xlogy(x, y):
+    return jax.scipy.special.xlogy(x, y)
+
+
+def logsumexp(a, axis=None):
+    return jax.scipy.special.logsumexp(a, axis=axis)

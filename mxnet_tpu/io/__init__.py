@@ -363,6 +363,7 @@ class ImageRecordIter(DataIter):
         else:
             self._reader = _recordio.MXRecordIO(self.path_imgrec, "r")
             self._pending_offsets = list(offsets) if offsets else None
+            self._offset_cursor = 0
 
     def _close(self):
         lib = _native_lib()
@@ -386,9 +387,10 @@ class ImageRecordIter(DataIter):
                 return None
             return read_buffer(ptr, size.value)
         if self._pending_offsets is not None:
-            if not self._pending_offsets:
+            if self._offset_cursor >= len(self._pending_offsets):
                 return None
-            self._reader.seek(self._pending_offsets.pop(0))
+            self._reader.seek(self._pending_offsets[self._offset_cursor])
+            self._offset_cursor += 1
         return self._reader.read()
 
     def _decode_example(self, rec):
@@ -560,8 +562,10 @@ class PrefetchingIter(DataIter):
                 for batch in self.data_iter:
                     if not _put(batch):
                         return
-            finally:
-                _put(None)  # end-of-epoch sentinel
+            except BaseException as e:  # propagate to the consumer
+                _put(e)
+                return
+            _put(None)  # end-of-epoch sentinel
         self._thread = self._threading.Thread(target=run, daemon=True)
         self._thread.start()
 
@@ -584,7 +588,21 @@ class PrefetchingIter(DataIter):
         if batch is None:
             self._exhausted = True  # keep raising until reset()
             raise StopIteration
+        if isinstance(batch, BaseException):
+            self._exhausted = True
+            raise batch  # error from the producer thread
         return batch
+
+    def close(self):
+        """Stop the producer thread (also called on GC — an abandoned
+        prefetcher must not busy-poll forever)."""
+        self._stop = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):

@@ -147,6 +147,27 @@ def test_prefetching_iter():
     assert len(got2) == 4
 
 
+def test_prefetching_iter_propagates_producer_error():
+    class BoomIter(mio.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+
+        def __next__(self):
+            self.n += 1
+            if self.n > 2:
+                raise RuntimeError("corrupt record")
+            return mio.DataBatch([], [])
+        next = __next__
+
+    it = mio.PrefetchingIter(BoomIter())
+    got = 0
+    with pytest.raises(RuntimeError, match="corrupt record"):
+        for _ in it:
+            got += 1
+    assert got == 2
+
+
 def test_prefetching_iter_re_exhaustion():
     x = onp.arange(10, dtype=onp.float32)[:, None]
     it = mio.PrefetchingIter(mio.NDArrayIter(x, None, batch_size=5))

@@ -52,20 +52,49 @@ def read_list(lst_path):
             yield int(parts[0]), float(parts[1]), parts[-1]
 
 
-def make_rec(prefix, root, lst_path, quality=95):
+def make_rec(prefix, root, lst_path, quality=None, resize=0):
+    """Pack images into .rec/.idx.  quality/resize trigger a decode +
+    re-encode pass (reference im2rec behavior); otherwise source bytes are
+    stored verbatim (faster, lossless)."""
     rec_path = prefix + ".rec"
     idx_path = prefix + ".idx"
     writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
     n = 0
     for idx, label, rel in read_list(lst_path):
         path = os.path.join(root, rel)
-        with open(path, "rb") as f:
-            payload = f.read()
         header = recordio.IRHeader(0, label, idx, 0)
-        writer.write_idx(idx, recordio.pack(header, payload))
+        if quality is not None or resize:
+            img = _load_image(path)
+            if resize:
+                img = _resize_short_np(img, resize)
+            rec = recordio.pack_img(header, img, quality=quality or 95,
+                                    img_fmt=".jpg")
+        else:
+            with open(path, "rb") as f:
+                rec = recordio.pack(header, f.read())
+        writer.write_idx(idx, rec)
         n += 1
     writer.close()
     return rec_path, idx_path, n
+
+
+def _load_image(path):
+    try:
+        import cv2
+        import numpy as onp
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:
+            raise IOError("cannot decode %s" % path)
+        return img
+    except ImportError:
+        from PIL import Image
+        import numpy as onp
+        return onp.asarray(Image.open(path).convert("RGB"))
+
+
+def _resize_short_np(img, size):
+    from mxnet_tpu.io import _resize_short
+    return _resize_short(img, size)
 
 
 def main():
@@ -75,7 +104,12 @@ def main():
     ap.add_argument("--list", action="store_true",
                     help="only generate the .lst file")
     ap.add_argument("--no-recursive", action="store_true")
-    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--quality", type=int, default=None,
+                    help="re-encode as JPEG at this quality (default: store "
+                         "source bytes verbatim)")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side before packing (implies "
+                         "re-encode)")
     args = ap.parse_args()
 
     lst = args.prefix + ".lst"
@@ -85,7 +119,7 @@ def main():
         print("wrote", lst)
     if not args.list:
         rec, idx, n = make_rec(args.prefix, args.root, lst,
-                               quality=args.quality)
+                               quality=args.quality, resize=args.resize)
         print("wrote %s + %s (%d records)" % (rec, idx, n))
 
 
