@@ -50,6 +50,7 @@ from .util import is_np_array, is_np_shape, set_np, reset_np
 from . import nd
 from . import recordio
 from . import io
+from . import contrib
 from . import sparse
 from . import symbol
 from . import symbol as sym

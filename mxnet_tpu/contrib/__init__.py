@@ -1,0 +1,2 @@
+"""mx.contrib — contributed subsystems (parity: python/mxnet/contrib/)."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,406 @@
+"""INT8 post-training quantization.
+
+Parity: reference `python/mxnet/contrib/quantization.py` (quantize_net
+:755 — the Gluon PTQ driver; calib modes naive/entropy :498-509; KL
+threshold search :262) over `src/operator/quantization/` (quantize_v2/
+dequantize/requantize ops, QuantizeGraph pass, calibrate.cc entropy
+calibration, oneDNN int8 kernels).
+
+TPU-native design: instead of a graph pass inserting quantize/dequantize
+nodes around oneDNN kernels, quantization is a *block rewrite* —
+Dense/Conv are swapped for Quantized blocks holding pre-quantized int8
+weights; their forward quantizes activations with calibrated ranges,
+runs the int8 matmul/conv with int32 accumulation (XLA lowers int8 dots
+onto the MXU the way oneDNN uses VNNI), and rescales back to fp32.
+Calibration runs forward hooks collecting min/max (naive) or histograms
+(entropy: KL-divergence-optimal thresholds, mirroring calibrate.cc).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import ndarray, apply_op, array as nd_array
+from ..gluon.block import HybridBlock
+from ..gluon import nn as _nn
+from ..ops.nn import activation as _act_fn
+
+__all__ = ["quantize_v2", "dequantize", "requantize", "quantize_net",
+           "QuantizedDense", "QuantizedConv2D", "CalibrationCollector"]
+
+_INT8_MAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize / requantize ops
+# ---------------------------------------------------------------------------
+def _scale_for(min_range, max_range):
+    return max(abs(float(min_range)), abs(float(max_range))) / _INT8_MAX
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """Quantize fp32 → int8 with symmetric scale
+    (parity: _contrib_quantize_v2, quantize_v2.cc).  Returns
+    (quantized, min_range, max_range)."""
+    assert out_type in ("int8", "auto")
+    if min_calib_range is None or max_calib_range is None:
+        mn = float(data.min().asnumpy())
+        mx = float(data.max().asnumpy())
+    else:
+        mn, mx = float(min_calib_range), float(max_calib_range)
+    scale = _scale_for(mn, mx) or 1.0
+    q = apply_op(
+        lambda x: jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8),
+        data)
+    return q, nd_array(onp.float32(mn)), nd_array(onp.float32(mx))
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 → fp32 (parity: dequantize.cc)."""
+    scale = _scale_for(float(min_range.asnumpy() if isinstance(min_range, ndarray) else min_range),
+                       float(max_range.asnumpy() if isinstance(max_range, ndarray) else max_range)) or 1.0
+    return apply_op(lambda q: q.astype(jnp.float32) * scale, data)
+
+
+def requantize(data, min_range, max_range, min_calib_range,
+               max_calib_range):
+    """int32 accum → int8 with a new calibrated range
+    (parity: requantize.cc)."""
+    in_scale = max(abs(float(min_range)), abs(float(max_range))) / (2**31 - 1)
+    out_scale = _scale_for(min_calib_range, max_calib_range) or 1.0
+    ratio = in_scale / out_scale
+    q = apply_op(
+        lambda x: jnp.clip(jnp.round(x.astype(jnp.float32) * ratio),
+                           -127, 127).astype(jnp.int8), data)
+    return (q, nd_array(onp.float32(min_calib_range)),
+            nd_array(onp.float32(max_calib_range)))
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+class _LayerStats:
+    __slots__ = ("min", "max", "hist", "edges")
+
+    def __init__(self):
+        self.min = onp.inf
+        self.max = -onp.inf
+        self.hist = None
+        self.edges = None
+
+
+class CalibrationCollector:
+    """Collects per-layer input ranges via forward pre-hooks
+    (parity: _LayerOutputCollector / _LayerOutputMinMaxCollector in
+    contrib/quantization.py)."""
+
+    NUM_BINS = 2048  # calibrate.cc default histogram size
+
+    def __init__(self, mode="naive"):
+        assert mode in ("naive", "entropy")
+        self.mode = mode
+        self.stats = OrderedDict()
+        self._handles = []
+
+    def attach(self, layers):
+        for name, layer in layers.items():
+            self.stats[name] = _LayerStats()
+
+            def hook(block, inputs, _name=name):
+                x = inputs[0]
+                a = x.asnumpy() if isinstance(x, ndarray) else onp.asarray(x)
+                st = self.stats[_name]
+                st.min = min(st.min, float(a.min()))
+                st.max = max(st.max, float(a.max()))
+                if self.mode == "entropy":
+                    amax = float(onp.abs(a).max())
+                    if st.hist is None:
+                        st.edges = onp.linspace(0, max(amax, 1e-8),
+                                                self.NUM_BINS + 1)
+                        st.hist = onp.zeros(self.NUM_BINS)
+                    elif amax > st.edges[-1]:
+                        # rebin the old histogram onto wider edges
+                        new_edges = onp.linspace(0, amax, self.NUM_BINS + 1)
+                        centers = (st.edges[:-1] + st.edges[1:]) / 2
+                        new_hist, _ = onp.histogram(centers, bins=new_edges,
+                                                    weights=st.hist)
+                        st.edges, st.hist = new_edges, new_hist
+                    h, _ = onp.histogram(onp.abs(a), bins=st.edges)
+                    st.hist += h
+
+            self._handles.append(layer.register_forward_pre_hook(hook))
+
+    def detach(self):
+        for h in self._handles:
+            h.detach()
+        self._handles = []
+
+    def thresholds(self):
+        """name → (min_range, max_range) for activation quantization."""
+        out = {}
+        for name, st in self.stats.items():
+            if self.mode == "naive" or st.hist is None:
+                out[name] = (st.min, st.max)
+            else:
+                t = _optimal_threshold_kl(st.hist, st.edges)
+                out[name] = (-t, t) if st.min < 0 else (0.0, t)
+        return out
+
+
+def _smooth(d, eps=0.0001):
+    """Move eps mass onto zero bins (calibrate.cc SmoothDistribution).
+    Falls back to smaller eps when a nonzero bin holds less mass than the
+    redistribution share (a lone outlier count would otherwise make every
+    candidate unsmoothable and disable clipping entirely)."""
+    is_zero = d == 0
+    n_zeros = int(is_zero.sum())
+    n_nonzeros = d.size - n_zeros
+    if n_nonzeros == 0:
+        return None
+    out = d.astype(onp.float64).copy()
+    if n_zeros:
+        for e in (eps, eps / 100, eps / 10000):
+            eps1 = e * n_zeros / n_nonzeros
+            if (out[~is_zero] > eps1).all():
+                out[is_zero] = e
+                out[~is_zero] -= eps1
+                return out
+        return None
+    return out
+
+
+def _optimal_threshold_kl(hist, edges, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| from an |activation| histogram
+    (parity: calibrate.cc ComputeEntropy / quantization.py
+    _get_optimal_threshold :262).  Key detail from the reference: the
+    candidate distribution p carries the clipped outlier mass in its last
+    bin, while q is quantized from the histogram WITHOUT that mass — so
+    aggressive clipping pays a KL penalty."""
+    num_bins = len(hist)
+    assert num_bins >= num_quantized_bins
+    best_kl = onp.inf
+    best_t = float(edges[-1])
+    total = hist.sum()
+    if total == 0:
+        return best_t
+    step = max(1, (num_bins - num_quantized_bins) // 128)
+    for i in range(num_quantized_bins, num_bins + 1, step):
+        sliced = hist[:i].astype(onp.float64)
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()  # clip outliers into the last bin
+        # quantize the *unaugmented* slice into num_quantized_bins and
+        # expand back over p's nonzero support
+        chunks = onp.array_split(onp.arange(i), num_quantized_bins)
+        q = onp.zeros(i)
+        for ch in chunks:
+            csum = sliced[ch].sum()
+            nz = (sliced[ch] > 0).sum()
+            if nz:
+                q[ch] = onp.where(sliced[ch] > 0, csum / nz, 0)
+        pn = _smooth(p / p.sum())
+        qs = q.sum()
+        if qs == 0 or pn is None:
+            continue
+        qn = _smooth(q / qs)
+        if qn is None:
+            continue
+        kl = float((pn * onp.log(pn / qn)).sum())
+        if kl < best_kl:
+            best_kl = kl
+            best_t = float(edges[i])
+    return best_t
+
+
+# ---------------------------------------------------------------------------
+# quantized blocks
+# ---------------------------------------------------------------------------
+def _quantize_weight(w):
+    """Per-output-channel symmetric int8 quantization of a weight array
+    (axis 0 = output channels, matching oneDNN's per-oc scales)."""
+    a = w.asnumpy()
+    amax = onp.abs(a.reshape(a.shape[0], -1)).max(axis=1)
+    scale = onp.where(amax > 0, amax / _INT8_MAX, 1.0).astype(onp.float32)
+    q = onp.clip(onp.round(a / scale.reshape((-1,) + (1,) * (a.ndim - 1))),
+                 -127, 127).astype(onp.int8)
+    return q, scale
+
+
+class QuantizedDense(HybridBlock):
+    """int8 Dense (parity: quantized_fully_connected.cc).  Built from a
+    calibrated fp32 Dense."""
+
+    def __init__(self, dense, min_range, max_range):
+        super().__init__()
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._activation = dense._activation
+        qw, wscale = _quantize_weight(dense.weight.data())
+        self._qweight = jnp.asarray(qw)
+        self._wscale = jnp.asarray(wscale)
+        self._bias = (dense.bias.data()._data
+                      if dense.bias is not None else None)
+        self._in_scale = _scale_for(min_range, max_range) or 1.0
+        self.min_range = min_range
+        self.max_range = max_range
+
+    def forward(self, x):
+        in_scale = self._in_scale
+        qw, ws, b = self._qweight, self._wscale, self._bias
+        flatten = self._flatten
+        act = self._activation
+
+        def f(xv):
+            if flatten and xv.ndim > 2:
+                xv = xv.reshape(xv.shape[0], -1)
+            qx = jnp.clip(jnp.round(xv / in_scale), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                qx, qw, (((qx.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (in_scale * ws)
+            if b is not None:
+                y = y + b
+            if act:
+                y = _act_fn(y, act)  # same mapping as the fp32 layers
+            return y
+        return apply_op(f, x)
+
+    def __repr__(self):
+        return "QuantizedDense(%d, int8)" % self._units
+
+
+class QuantizedConv2D(HybridBlock):
+    """int8 Conv2D (parity: quantized_conv.cc)."""
+
+    def __init__(self, conv, min_range, max_range):
+        super().__init__()
+        assert conv._op_name == "convolution"
+        self._channels = conv._channels
+        self._kernel = conv._kernel
+        self._stride = conv._stride
+        self._pad = conv._pad
+        self._dilate = conv._dilate
+        self._groups = conv._groups
+        self._layout = conv._layout
+        self._activation = conv._activation
+        qw, wscale = _quantize_weight(conv.weight.data())
+        self._qweight = jnp.asarray(qw)
+        self._wscale = jnp.asarray(wscale)
+        self._bias = (conv.bias.data()._data
+                      if conv.bias is not None else None)
+        self._in_scale = _scale_for(min_range, max_range) or 1.0
+        self.min_range = min_range
+        self.max_range = max_range
+
+    def forward(self, x):
+        in_scale = self._in_scale
+        qw, ws, b = self._qweight, self._wscale, self._bias
+        stride, pad, dilate = self._stride, self._pad, self._dilate
+        groups, act = self._groups, self._activation
+        assert self._layout == "NCHW", "quantized conv supports NCHW"
+
+        def f(xv):
+            qx = jnp.clip(jnp.round(xv / in_scale), -127, 127).astype(jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                qx, qw, window_strides=stride,
+                padding=[(p, p) for p in pad],
+                rhs_dilation=dilate, feature_group_count=groups,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (in_scale
+                                           * ws.reshape(1, -1, 1, 1))
+            if b is not None:
+                y = y + b.reshape(1, -1, 1, 1)
+            if act:
+                y = _act_fn(y, act)
+            return y
+        return apply_op(f, x)
+
+    def __repr__(self):
+        return "QuantizedConv2D(%d, int8)" % self._channels
+
+
+# ---------------------------------------------------------------------------
+# the PTQ driver
+# ---------------------------------------------------------------------------
+def _walk_quantizable(block, prefix=""):
+    """Yield (parent, attr_name, child, path) for quantizable layers."""
+    for name, child in list(block._children.items()):
+        path = prefix + "." + name if prefix else name
+        if isinstance(child, (_nn.Dense, _nn.Conv2D)):
+            yield block, name, child, path
+        else:
+            yield from _walk_quantizable(child, path)
+
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 exclude_layers_match=None, logger=None):
+    """Post-training-quantize a Gluon network in place and return it
+    (parity: contrib/quantization.py quantize_net :755).
+
+    calib_data: iterable of input batches (ndarray or tuple); required for
+    calib_mode 'naive'/'entropy'.
+    """
+    assert quantized_dtype in ("int8", "auto")
+    exclude_layers = set(exclude_layers or [])
+    targets = OrderedDict()
+    for parent, name, child, path in _walk_quantizable(network):
+        if path in exclude_layers:
+            continue
+        if exclude_layers_match and any(m in path
+                                        for m in exclude_layers_match):
+            continue
+        if isinstance(child, _nn.Conv2D) and child._layout != "NCHW":
+            continue
+        targets[path] = (parent, name, child)
+
+    if not targets:
+        return network
+
+    # 1) calibration pass
+    if calib_data is None:
+        raise ValueError("calib_data is required for calibration")
+    collector = CalibrationCollector(mode=calib_mode)
+    collector.attach(OrderedDict((p, c) for p, (_, _, c)
+                                 in targets.items()))
+    try:
+        for batch in calib_data:
+            if isinstance(batch, (tuple, list)):
+                batch = batch[0]
+            network(batch)
+    finally:
+        collector.detach()  # never leave stats hooks on the user's net
+    thresholds = collector.thresholds()
+
+    # 2) swap in quantized blocks
+    for path, (parent, name, child) in targets.items():
+        mn, mx = thresholds[path]
+        if not (onp.isfinite(mn) and onp.isfinite(mx)):
+            # layer never exercised by calib_data (conditional branch /
+            # unused head): leave it fp32 rather than poison with inf scale
+            if logger is not None:
+                logger.warning("skipping uncalibrated layer %s", path)
+            continue
+        if isinstance(child, _nn.Dense):
+            q = QuantizedDense(child, mn, mx)
+        else:
+            q = QuantizedConv2D(child, mn, mx)
+        _swap(parent, name, child, q)
+    return network
+
+
+def _swap(parent, name, old, new):
+    parent._children[name] = new
+    # attribute reference (e.g. self.fc = Dense(...))
+    for attr, val in list(parent.__dict__.items()):
+        if val is old:
+            object.__setattr__(parent, attr, new)
+        elif isinstance(val, list):
+            for i, item in enumerate(val):
+                if item is old:
+                    val[i] = new

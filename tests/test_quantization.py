@@ -1,0 +1,149 @@
+"""INT8 quantization tests (reference:
+tests/python/quantization/test_quantization.py — quantized op vs fp32
+within tolerance, calibration modes, quantize_net driver)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon import nn
+
+
+def setup_module():
+    mx.random.seed(3)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mxnp.array(onp.random.RandomState(0).randn(64).astype(onp.float32))
+    qx, mn, mx_ = q.quantize_v2(x, -3.0, 3.0)
+    assert qx.dtype == onp.int8
+    back = q.dequantize(qx, mn, mx_)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                                atol=3.0 / 127 + 1e-6)
+
+
+def test_quantize_auto_range():
+    x = mxnp.array([1.0, -2.0, 0.5])
+    qx, mn, mx_ = q.quantize_v2(x)
+    assert float(mn.asnumpy()) == -2.0 and float(mx_.asnumpy()) == 1.0
+    onp.testing.assert_allclose(q.dequantize(qx, mn, mx_).asnumpy(),
+                                x.asnumpy(), atol=2 / 127 + 1e-6)
+
+
+def test_requantize():
+    acc = mxnp.array(onp.array([2**20, -2**21, 100], onp.int32))
+    qx, mn, mx_ = q.requantize(acc, -(2.0**31 - 1) * 1e-7,
+                               (2.0**31 - 1) * 1e-7, -0.3, 0.3)
+    assert qx.dtype == onp.int8
+
+
+def test_quantized_dense_close_to_fp32():
+    rng = onp.random.RandomState(1)
+    layer = nn.Dense(32, in_units=16, use_bias=True)
+    layer.initialize(mx.init.Xavier())
+    x = mxnp.array(rng.rand(8, 16).astype(onp.float32) * 2 - 1)
+    ref = layer(x).asnumpy()
+    qd = q.QuantizedDense(layer, -1.0, 1.0)
+    out = qd(x).asnumpy()
+    # int8 symmetric quantization error bound
+    err = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert err < 0.05, err
+
+
+def test_quantized_conv_close_to_fp32():
+    rng = onp.random.RandomState(2)
+    conv = nn.Conv2D(8, 3, padding=1, in_channels=4)
+    conv.initialize(mx.init.Xavier())
+    x = mxnp.array(rng.rand(2, 4, 10, 10).astype(onp.float32) * 2 - 1)
+    ref = conv(x).asnumpy()
+    qc = q.QuantizedConv2D(conv, -1.0, 1.0)
+    out = qc(x).asnumpy()
+    err = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert err < 0.05, err
+
+
+def _make_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2), nn.Flatten(),
+            nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+@pytest.mark.parametrize("mode", ["naive", "entropy"])
+def test_quantize_net(mode):
+    rng = onp.random.RandomState(0)
+    net = _make_net()
+    calib = [mxnp.array(rng.rand(4, 3, 12, 12).astype(onp.float32))
+             for _ in range(4)]
+    x = mxnp.array(rng.rand(4, 3, 12, 12).astype(onp.float32))
+    ref = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=calib, calib_mode=mode)
+    out = qnet(x).asnumpy()
+    # quantized net stays close and predicts the same argmax mostly
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert rel < 0.15, rel
+    agree = (out.argmax(1) == ref.argmax(1)).mean()
+    assert agree >= 0.75
+    # layers actually swapped
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert "QuantizedConv2D" in kinds
+    assert "QuantizedDense" in kinds
+
+
+def test_quantize_net_exclude():
+    rng = onp.random.RandomState(0)
+    net = _make_net()
+    calib = [mxnp.array(rng.rand(2, 3, 12, 12).astype(onp.float32))]
+    q.quantize_net(net, calib_data=calib, exclude_layers=["4"])
+    kinds = {n: type(c).__name__ for n, c in net._children.items()}
+    assert kinds["4"] == "Dense"  # excluded final classifier stays fp32
+
+
+def test_hybrid_sequential_forward_after_swap():
+    """Sequential containers must route through the swapped blocks."""
+    rng = onp.random.RandomState(0)
+    net = _make_net()
+    calib = [mxnp.array(rng.rand(2, 3, 12, 12).astype(onp.float32))]
+    q.quantize_net(net, calib_data=calib)
+    x = mxnp.array(rng.rand(2, 3, 12, 12).astype(onp.float32))
+    out = net(x)
+    assert out.shape == (2, 10)
+
+
+def test_quantized_dense_softrelu_activation():
+    layer = nn.Dense(8, in_units=4, activation="softrelu")
+    layer.initialize(mx.init.Xavier())
+    x = mxnp.array(onp.random.RandomState(0).rand(2, 4).astype(onp.float32))
+    ref = layer(x).asnumpy()
+    out = q.QuantizedDense(layer, -1.0, 1.0)(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, atol=0.05)
+
+
+def test_uncalibrated_layer_stays_fp32():
+    # a net whose forward skips a child leaves that child uncalibrated
+    class SkipSecond(nn.HybridSequential):
+        def forward(self, x):
+            return self._layers[0](x)
+    s = SkipSecond()
+    s.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    s.initialize(mx.init.Xavier())
+    calib = [mxnp.array(onp.random.RandomState(0).rand(2, 4)
+                        .astype(onp.float32))]
+    q.quantize_net(s, calib_data=calib)
+    kinds = {n: type(c).__name__ for n, c in s._children.items()}
+    assert kinds["0"] == "QuantizedDense"
+    assert kinds["1"] == "Dense"  # uncalibrated → left fp32, no NaN scale
+
+
+def test_kl_threshold_reasonable():
+    # activations ~ N(0,1) with a single huge outlier: KL threshold must
+    # ignore the outlier, naive must not
+    rng = onp.random.RandomState(0)
+    a = rng.randn(20000).astype(onp.float32)
+    a[0] = 80.0
+    hist, edges = onp.histogram(onp.abs(a), bins=2048, range=(0, 80.0))
+    t = q._optimal_threshold_kl(hist, edges)
+    assert t < 20.0  # clipped well below the outlier
