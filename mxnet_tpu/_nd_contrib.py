@@ -1,0 +1,15 @@
+"""mx.nd.contrib — the legacy contrib op namespace.
+
+Parity: reference `python/mxnet/ndarray/contrib.py` (foreach :139,
+while_loop :233, cond :401) plus the `_contrib_*` registered ops
+(bounding boxes, ROI, STN, masking — src/operator/contrib/).
+"""
+# npx extension ops first (arange_like, sldwin_atten, ...), then the
+# dedicated contrib ops override same-named entries (multibox_prior here
+# is the full anchor generator)
+from .numpy_extension import *  # noqa: F401,F403
+from .ops.control_flow import foreach, while_loop, cond  # noqa: F401
+from .contrib.ops import *  # noqa: F401,F403
+from .contrib.ops import __all__ as _ops_all
+
+__all__ = ["foreach", "while_loop", "cond"] + list(_ops_all)

@@ -1,0 +1,552 @@
+"""Contrib operators: bounding boxes, NMS, ROI pooling, STN, masking.
+
+Parity: reference `src/operator/contrib/` — bounding_box.cc (box_nms
+:158, box_iou, bipartite_matching), roi_align.cc, ../roi_pooling.cc,
+boolean_mask.cc, index_copy.cc, index_array.cc, allclose_op.cc,
+gradient_multiplier_op.cc, multibox_prior/target/detection (SSD heads),
+../grid_generator.cc + ../bilinear_sampler.cc (STN family),
+quadratic_op.cc (the tutorial op).
+
+TPU-native: everything is branch-free jnp/lax with static shapes —
+suppression masks instead of dynamic lists (box_nms keeps the reference's
+"-1 means suppressed" output convention precisely so shapes stay static
+under jit), lax.scan for the sequential greedy steps, gather-based
+bilinear sampling for ROIAlign/STN.  boolean_mask is eager-only by
+nature (dynamic output shape) like the reference's dynamic-shape op.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray import ndarray, apply_op, array as nd_array, _unwrap
+
+__all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
+           "roi_pooling", "boolean_mask", "index_copy", "index_array",
+           "allclose", "gradientmultiplier", "multibox_prior",
+           "multibox_target", "multibox_detection", "grid_generator",
+           "bilinear_sampler", "spatial_transformer", "quadratic"]
+
+
+def _corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    # center: (cx, cy, w, h) → corners
+    cx, cy, w, h = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                    boxes[..., 3])
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def _pair_iou(a, b):
+    """IoU between [..., M, 4] and [..., N, 4] corner boxes →
+    [..., M, N]."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    """Pairwise IoU (parity: _contrib_box_iou, bounding_box.cc)."""
+    fmt = format
+    return apply_op(
+        lambda a, b: _pair_iou(_corner(a, fmt), _corner(b, fmt)), lhs, rhs)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """Non-max suppression (parity: _contrib_box_nms, bounding_box.cc:158).
+
+    data: [..., N, K] rows of (id?, score, x1, y1, x2, y2, ...).
+    Suppressed/invalid rows have all fields set to -1 in the output (the
+    reference convention), keeping shapes static for XLA.
+    """
+    fmt, cs, si, ii = in_format, coord_start, score_index, id_index
+
+    def f(d):
+        scores = d[..., si]
+        boxes = _corner(d[..., cs:cs + 4], fmt)
+        cls = d[..., ii] if ii >= 0 else jnp.zeros_like(scores)
+        valid = scores > valid_thresh
+        if ii >= 0 and background_id >= 0:
+            valid &= cls != background_id
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=-1)
+        n = d.shape[-2]
+        if topk > 0:
+            rank = jnp.argsort(order, axis=-1)
+            valid &= rank < topk
+        iou = _pair_iou(boxes, boxes)
+        if ii >= 0 and not force_suppress:
+            # only same-class pairs suppress each other
+            same_cls = cls[..., :, None] == cls[..., None, :]
+            suppress_pair = (iou > overlap_thresh) & same_cls
+        else:
+            suppress_pair = iou > overlap_thresh
+
+        def body(keep_sup, idx):
+            keep, sup = keep_sup
+            # idx: the next-highest-score candidate
+            ok = jnp.take_along_axis(valid & ~sup, idx[..., None],
+                                     -1)[..., 0]
+            keep = jnp.where(
+                jax.nn.one_hot(idx, n, dtype=bool) & ok[..., None],
+                True, keep)
+            row = jnp.take_along_axis(
+                suppress_pair, idx[..., None, None], -2)[..., 0, :]
+            sup = sup | (row & ok[..., None])
+            sup = jnp.where(jax.nn.one_hot(idx, n, dtype=bool), False, sup)
+            return (keep, sup), None
+
+        keep0 = jnp.zeros(d.shape[:-1], dtype=bool)
+        sup0 = jnp.zeros(d.shape[:-1], dtype=bool)
+        order_t = jnp.moveaxis(order, -1, 0)  # scan over candidates
+        (keep, _sup), _ = lax.scan(body, (keep0, sup0), order_t)
+        keep &= valid
+        out = d
+        if out_format != fmt:
+            if out_format == "corner":
+                coords = boxes  # already converted
+            else:  # corner → center
+                c = d[..., cs:cs + 4]
+                coords = jnp.stack(
+                    [(c[..., 0] + c[..., 2]) / 2,
+                     (c[..., 1] + c[..., 3]) / 2,
+                     c[..., 2] - c[..., 0], c[..., 3] - c[..., 1]], -1)
+            out = out.at[..., cs:cs + 4].set(coords)
+        return jnp.where(keep[..., None], out, -jnp.ones_like(out))
+
+    return apply_op(f, data)
+
+
+def bipartite_matching(data, threshold=1e-12, is_ascend=False, topk=-1):
+    """Greedy bipartite matching (parity: _contrib_bipartite_matching).
+
+    data: [..., M, N] affinity matrix.  Returns (row_match [..., M],
+    col_match [..., N]) with -1 for unmatched."""
+    def f(d):
+        m, n = d.shape[-2], d.shape[-1]
+        steps = min(m, n) if topk <= 0 else min(topk, min(m, n))
+        sign = 1.0 if is_ascend else -1.0
+        big = jnp.inf
+
+        def body(state, _):
+            dd, row_m, col_m = state
+            flat = (sign * dd).reshape(dd.shape[:-2] + (m * n,))
+            idx = jnp.argmin(flat, axis=-1)
+            val = sign * jnp.take_along_axis(flat, idx[..., None],
+                                             -1)[..., 0]
+            r, c = idx // n, idx % n
+            # descending: scores below threshold don't match; ascending:
+            # costs above threshold don't match
+            ok = (val < threshold) if is_ascend else (val > threshold)
+            rmask = jax.nn.one_hot(r, m, dtype=bool)
+            cmask = jax.nn.one_hot(c, n, dtype=bool)
+            row_m = jnp.where(rmask & ok[..., None], c[..., None].astype(
+                row_m.dtype), row_m)
+            col_m = jnp.where(cmask & ok[..., None], r[..., None].astype(
+                col_m.dtype), col_m)
+            dd = jnp.where(rmask[..., :, None] | cmask[..., None, :],
+                           sign * big, dd)
+            return (dd, row_m, col_m), None
+
+        row0 = -jnp.ones(d.shape[:-1], jnp.float32)
+        col0 = -jnp.ones(d.shape[:-2] + (n,), jnp.float32)
+        (dd, row_m, col_m), _ = lax.scan(body, (d, row0, col0), None,
+                                         length=steps)
+        return row_m, col_m
+    return apply_op(f, data)
+
+
+def _bilinear_at(img, y, x):
+    """Sample img [C, H, W] at fractional (y, x) grids of any shape."""
+    H, W = img.shape[-2], img.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1, wx1 = y - y0, x - x0
+    wy0, wx0 = 1 - wy1, 1 - wx1
+
+    def at(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = img[..., yi, xi]
+        inside = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        return jnp.where(inside, v, 0.0)
+
+    return (at(y0, x0) * wy0 * wx0 + at(y0, x1) * wy0 * wx1
+            + at(y1, x0) * wy1 * wx0 + at(y1, x1) * wy1 * wx1)
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2,
+              position_sensitive=False, aligned=True):
+    """ROI Align (parity: _contrib_ROIAlign, roi_align.cc).
+
+    data: [B, C, H, W]; rois: [R, 5] of (batch_idx, x1, y1, x2, y2).
+    """
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    sr = max(int(sample_ratio), 1)
+
+    def f(x, r):
+        off = 0.5 if aligned else 0.0
+        bidx = r[:, 0].astype(jnp.int32)
+        x1 = r[:, 1] * spatial_scale - off
+        y1 = r[:, 2] * spatial_scale - off
+        x2 = r[:, 3] * spatial_scale - off
+        y2 = r[:, 4] * spatial_scale - off
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sample grid: [R, ph, sr] y-coords × [R, pw, sr] x-coords
+        iy = (jnp.arange(sr) + 0.5) / sr
+        gy = y1[:, None, None] + bin_h[:, None, None] * (
+            jnp.arange(ph)[None, :, None] + iy[None, None, :])
+        gx = x1[:, None, None] + bin_w[:, None, None] * (
+            jnp.arange(pw)[None, :, None] + iy[None, None, :])
+
+        def per_roi(b, yy, xx):
+            img = x[b]  # [C, H, W]
+            # yy [ph, sr], xx [pw, sr] → grid [ph, sr, pw, sr]
+            Y = yy[:, :, None, None]
+            X = xx[None, None, :, :]
+            vals = _bilinear_at(img, jnp.broadcast_to(
+                Y, (yy.shape[0], sr, xx.shape[0], sr)),
+                jnp.broadcast_to(X, (yy.shape[0], sr, xx.shape[0], sr)))
+            out = vals.mean(axis=(-3, -1))  # [C, ph, pw] avg over samples
+            if position_sensitive:
+                # PSROIAlign (R-FCN): C = K*ph*pw; bin (i, j) of output
+                # channel k reads input channel k*ph*pw + i*pw + j
+                K = out.shape[0] // (ph * pw)
+                g = out.reshape(K, ph, pw, ph, pw)
+                ii = jnp.arange(ph)[:, None]
+                jj = jnp.arange(pw)[None, :]
+                return g[:, ii, jj, ii, jj]
+            return out
+
+        return jax.vmap(per_roi)(bidx, gy, gx)
+    return apply_op(f, data, rois)
+
+
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0):
+    """ROI max pooling (parity: roi_pooling.cc ROIPooling)."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+
+    def f(x, r):
+        H, W = x.shape[-2], x.shape[-1]
+        bidx = r[:, 0].astype(jnp.int32)
+        x1 = jnp.round(r[:, 1] * spatial_scale)
+        y1 = jnp.round(r[:, 2] * spatial_scale)
+        x2 = jnp.round(r[:, 3] * spatial_scale)
+        y2 = jnp.round(r[:, 4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+
+        def per_roi(b, xx1, yy1, hh, ww):
+            img = x[b]  # [C,H,W]
+            out = []
+            # membership masks per pooled cell (static ph/pw loops)
+            rows = []
+            for i in range(ph):
+                lo = jnp.floor(yy1 + i * hh / ph)
+                hi = jnp.ceil(yy1 + (i + 1) * hh / ph)
+                rows.append((ys[None, :] >= lo) & (ys[None, :] < hi))
+            cols = []
+            for j in range(pw):
+                lo = jnp.floor(xx1 + j * ww / pw)
+                hi = jnp.ceil(xx1 + (j + 1) * ww / pw)
+                cols.append((xs[None, :] >= lo) & (xs[None, :] < hi))
+            for i in range(ph):
+                row = []
+                for j in range(pw):
+                    mask = rows[i][0][:, None] & cols[j][0][None, :]
+                    v = jnp.where(mask[None], img, -jnp.inf).max(
+                        axis=(-2, -1))
+                    row.append(jnp.where(jnp.isfinite(v), v, 0.0))
+                out.append(jnp.stack(row, -1))
+            return jnp.stack(out, -2)  # [C, ph, pw]
+        return jax.vmap(per_roi)(bidx, x1, y1, rh, rw)
+    return apply_op(f, data, rois)
+
+
+def boolean_mask(data, index, axis=0):
+    """Dynamic-shape row selection (parity: _contrib_boolean_mask,
+    boolean_mask.cc).  Eager-only (output shape depends on values), like
+    the reference's dynamic-shape op."""
+    mask = (index.asnumpy() if isinstance(index, ndarray)
+            else onp.asarray(index)).astype(bool)
+    d = data.asnumpy() if isinstance(data, ndarray) else onp.asarray(data)
+    return nd_array(onp.compress(mask, d, axis=axis))
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Copy rows of new_tensor into old_tensor at index_vector
+    (parity: _contrib_index_copy)."""
+    idx = _unwrap(index_vector)
+    return apply_op(
+        lambda old, new: old.at[idx.astype(jnp.int32)].set(new),
+        old_tensor, new_tensor)
+
+
+def index_array(data, axes=None):
+    """Per-element index coordinates (parity: _contrib_index_array; the
+    reference emits int64 — here int32, JAX's widest enabled integer)."""
+    def f(x):
+        idx = jnp.stack(jnp.meshgrid(
+            *[jnp.arange(s) for s in x.shape], indexing="ij"), -1)
+        if axes is not None:
+            idx = idx[..., list(axes)]
+        return idx.astype(jnp.int32)
+    return apply_op(f, data)
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    """1.0 if all close else 0.0 (parity: _contrib_allclose)."""
+    return apply_op(
+        lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan).astype(jnp.float32),
+        a, b)
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, grad × scalar backward
+    (parity: gradient_multiplier_op.cc — the GRL building block)."""
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scalar,)
+    f.defvjp(fwd, bwd)
+    return apply_op(f, data)
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x² + b*x + c (parity: quadratic_op.cc — the tutorial op)."""
+    return apply_op(lambda x: a * x * x + b * x + c, data)
+
+
+# ---------------------------------------------------------------------------
+# SSD heads (multibox_*)
+# ---------------------------------------------------------------------------
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation (parity: multibox_prior.cc).  data: [B, C, H, W]
+    → [1, H*W*(S+R-1), 4] corner anchors."""
+    def f(x):
+        H, W = x.shape[-2], x.shape[-1]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / H
+        step_x = steps[1] if steps[1] > 0 else 1.0 / W
+        cy = (jnp.arange(H) + offsets[0]) * step_y
+        cx = (jnp.arange(W) + offsets[1]) * step_x
+        cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # H,W,2
+        whs = []
+        for s in sizes:
+            whs.append((s * onp.sqrt(ratios[0]), s / onp.sqrt(ratios[0])))
+        for r in ratios[1:]:
+            whs.append((sizes[0] * onp.sqrt(r), sizes[0] / onp.sqrt(r)))
+        whs = jnp.asarray(whs)  # [A, 2] (w, h)
+        cyx = jnp.broadcast_to(cyx[:, :, None, :],
+                               (H, W, whs.shape[0], 2))
+        w = whs[None, None, :, 0]
+        h = whs[None, None, :, 1]
+        boxes = jnp.stack([cyx[..., 1] - w / 2, cyx[..., 0] - h / 2,
+                           cyx[..., 1] + w / 2, cyx[..., 0] + h / 2], -1)
+        boxes = boxes.reshape(1, -1, 4)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return boxes
+    return apply_op(f, data)
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, variances=(0.1, 0.1,
+                                                           0.2, 0.2)):
+    """Assign anchors to ground truth (parity: multibox_target.cc).
+
+    anchor: [1, N, 4]; label: [B, M, 5] (cls, x1, y1, x2, y2), cls<0 =
+    padding.  Returns (loc_target [B, N*4], loc_mask [B, N*4],
+    cls_target [B, N])."""
+    v = variances
+
+    def f(anc, lab, cp):
+        a = anc[0]  # [N, 4]
+        n = a.shape[0]
+
+        def per_batch(lb):
+            gt_valid = lb[:, 0] >= 0
+            gt_boxes = lb[:, 1:5]
+            iou = _pair_iou(a, gt_boxes)  # [N, M]
+            iou = jnp.where(gt_valid[None, :], iou, 0.0)
+            best_gt = jnp.argmax(iou, -1)
+            best_iou = jnp.max(iou, -1)
+            # anchors matching best for each gt are positive too; .max so a
+            # padding gt row (argmax lands on anchor 0) can't clobber a
+            # valid gt's forced match on the same anchor
+            best_anchor_for_gt = jnp.argmax(iou, 0)  # [M]
+            forced = jnp.zeros(n, bool).at[best_anchor_for_gt].max(
+                gt_valid)
+            pos = (best_iou >= overlap_threshold) | forced
+            matched = gt_boxes[best_gt]  # [N, 4]
+            # encode regression targets (center/size with variances)
+            aw = a[:, 2] - a[:, 0]
+            ah = a[:, 3] - a[:, 1]
+            acx = (a[:, 0] + a[:, 2]) / 2
+            acy = (a[:, 1] + a[:, 3]) / 2
+            gw = jnp.maximum(matched[:, 2] - matched[:, 0], 1e-8)
+            gh = jnp.maximum(matched[:, 3] - matched[:, 1], 1e-8)
+            gcx = (matched[:, 0] + matched[:, 2]) / 2
+            gcy = (matched[:, 1] + matched[:, 3]) / 2
+            tx = (gcx - acx) / (aw * v[0])
+            ty = (gcy - acy) / (ah * v[1])
+            tw = jnp.log(gw / aw) / v[2]
+            th = jnp.log(gh / ah) / v[3]
+            loc_t = jnp.stack([tx, ty, tw, th], -1).reshape(-1)
+            loc_m = jnp.repeat(pos.astype(jnp.float32), 4)
+            cls_t = jnp.where(pos, lb[best_gt, 0] + 1, 0.0)
+            return loc_t * loc_m, loc_m, cls_t, pos
+        loc_t, loc_m, cls_t, pos = jax.vmap(per_batch)(lab)
+        if negative_mining_ratio > 0:
+            # hard negative mining (multibox_target.cc): keep only the
+            # ratio*num_pos highest-confidence negatives; the rest are
+            # ignore_label
+            fg_conf = jnp.max(cp[:, 1:, :], axis=1) if cp.shape[1] > 1 \
+                else cp[:, 0, :]
+            neg = ~pos
+            num_pos = pos.sum(-1, keepdims=True)
+            quota = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32), 1)
+            score = jnp.where(neg, fg_conf, -jnp.inf)
+            order = jnp.argsort(-score, axis=-1)
+            rank = jnp.argsort(order, axis=-1)
+            keep_neg = neg & (rank < quota)
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0.0, ignore_label))
+        return loc_t, loc_m, cls_t
+    return apply_op(f, anchor, label, cls_pred)
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS (parity: multibox_detection.cc).
+
+    cls_prob [B, C, N], loc_pred [B, N*4], anchor [1, N, 4] →
+    [B, N, 6] rows (cls_id, score, x1, y1, x2, y2), -1 = suppressed."""
+    vr = variances
+
+    def f(cp, lp, anc):
+        a = anc[0]
+        n = a.shape[0]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = (a[:, 0] + a[:, 2]) / 2
+        acy = (a[:, 1] + a[:, 3]) / 2
+
+        def per_batch(cprob, loc):
+            loc = loc.reshape(n, 4)
+            cx = loc[:, 0] * vr[0] * aw + acx
+            cy = loc[:, 1] * vr[1] * ah + acy
+            w = jnp.exp(loc[:, 2] * vr[2]) * aw
+            h = jnp.exp(loc[:, 3] * vr[3]) * ah
+            boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                               cx + w / 2, cy + h / 2], -1)
+            if clip:
+                boxes = jnp.clip(boxes, 0.0, 1.0)
+            # best non-background class per anchor; output ids are
+            # 0-based over non-background classes (reference convention)
+            fg = jnp.concatenate([cprob[:background_id],
+                                  cprob[background_id + 1:]], 0)
+            cid = jnp.argmax(fg, 0)
+            score = jnp.max(fg, 0)
+            out = jnp.concatenate([cid[:, None].astype(jnp.float32),
+                                   score[:, None], boxes], -1)
+            return out
+        dets = jax.vmap(per_batch)(cp, lp)
+        return dets
+    raw = apply_op(f, cls_prob, loc_pred, anchor)
+    return box_nms(raw, overlap_thresh=nms_threshold,
+                   valid_thresh=threshold, topk=nms_topk, coord_start=2,
+                   score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# STN family
+# ---------------------------------------------------------------------------
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """Sampling-grid generation (parity: grid_generator.cc).
+
+    affine: data [B, 6] + target_shape (H, W) → grid [B, 2, H, W] of
+    (x, y) in [-1, 1].  warp: data is a pixel-unit flow [B, 2, H, W]
+    (H, W taken from the flow itself) added to the identity grid."""
+    if transform_type == "affine":
+        H, W = target_shape
+
+        def f(theta):
+            ys = jnp.linspace(-1, 1, H)
+            xs = jnp.linspace(-1, 1, W)
+            Y, X = jnp.meshgrid(ys, xs, indexing="ij")
+            ones = jnp.ones_like(X)
+            base = jnp.stack([X, Y, ones], 0).reshape(3, -1)  # [3, H*W]
+            t = theta.reshape(-1, 2, 3)
+            out = jnp.einsum("bij,jk->bik", t, base)  # [B, 2, H*W]
+            return out.reshape(-1, 2, H, W)
+        return apply_op(f, data)
+
+    # warp: normalized grid = ((x + flow_x) * 2/(W-1) - 1, ...) like the
+    # reference's pixel-unit flow semantics
+    def fw(flow):
+        H, W = flow.shape[-2], flow.shape[-1]
+        ys = jnp.arange(H, dtype=flow.dtype)
+        xs = jnp.arange(W, dtype=flow.dtype)
+        Y, X = jnp.meshgrid(ys, xs, indexing="ij")
+        gx = (X[None] + flow[:, 0]) * 2.0 / max(W - 1, 1) - 1.0
+        gy = (Y[None] + flow[:, 1]) * 2.0 / max(H - 1, 1) - 1.0
+        return jnp.stack([gx, gy], 1)
+    return apply_op(fw, data)
+
+
+def bilinear_sampler(data, grid, cudnn_off=None):
+    """Sample data at grid locations (parity: bilinear_sampler.cc).
+
+    data [B, C, H, W]; grid [B, 2, H', W'] (x, y) in [-1, 1] →
+    [B, C, H', W']."""
+    def f(x, g):
+        H, W = x.shape[-2], x.shape[-1]
+        gx = (g[:, 0] + 1) * (W - 1) / 2
+        gy = (g[:, 1] + 1) * (H - 1) / 2
+
+        def per_b(img, yy, xx):
+            return _bilinear_at(img, yy, xx)
+        return jax.vmap(per_b)(x, gy, gx)
+    return apply_op(f, data, grid)
+
+
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=None):
+    """Affine STN = grid_generator + bilinear_sampler
+    (parity: spatial_transformer.cc)."""
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)

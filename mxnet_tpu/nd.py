@@ -9,6 +9,8 @@ from .numpy import *  # noqa: F401,F403
 from .numpy import random, linalg  # noqa: F401
 from .ndarray import ndarray as NDArray, array, waitall  # noqa: F401
 from .numpy_extension import savez  # noqa: F401
+# mx.nd.contrib.{box_nms, roi_align, foreach, while_loop, cond, ...}
+from . import _nd_contrib as contrib  # noqa: F401
 
 
 def save(fname, data):
@@ -40,5 +42,4 @@ def load(fname):
         keys.sort(key=lambda k: int(k.rsplit("_", 1)[1]))
         return [array(data[k]) for k in keys]
     return {k: array(data[k]) for k in keys}
-from . import numpy_extension as contrib  # noqa: F401  (mx.nd.contrib.*)
 from . import sparse  # noqa: F401  (mx.nd.sparse.*)

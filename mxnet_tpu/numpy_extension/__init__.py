@@ -406,27 +406,12 @@ def all_finite(*arrays):
 
 def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=None,
                    offsets=(0.5, 0.5), **kw):
-    """SSD anchor generation (src/operator/contrib/multibox_prior.cc)."""
-    import numpy as np
-    h, w = data.shape[2], data.shape[3]
-    step_y = steps[0] if steps else 1.0 / h
-    step_x = steps[1] if steps else 1.0 / w
-    anchors = []
-    for i in range(h):
-        cy = (i + offsets[0]) * step_y
-        for j in range(w):
-            cx = (j + offsets[1]) * step_x
-            for s in sizes:
-                anchors.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
-            for r in ratios[1:]:
-                s = sizes[0]
-                sr = np.sqrt(r)
-                anchors.append([cx - s * sr / 2, cy - s / sr / 2,
-                                cx + s * sr / 2, cy + s / sr / 2])
-    a = np.asarray(anchors, np.float32)
-    if clip:
-        a = np.clip(a, 0, 1)
-    return array(a[None])
+    """SSD anchor generation (src/operator/contrib/multibox_prior.cc).
+    Delegates to the vectorized contrib implementation (one source of
+    truth; imported lazily to avoid a package import cycle)."""
+    from ..contrib.ops import multibox_prior as _impl
+    return _impl(data, sizes=sizes, ratios=ratios, clip=clip,
+                 steps=steps if steps else (-1.0, -1.0), offsets=offsets)
 
 
 # -- serialization (parity: npx.save/savez/load → src/serialization/cnpy) ---

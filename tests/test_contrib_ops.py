@@ -1,0 +1,276 @@
+"""Contrib operator tests vs hand-computed/numpy references
+(reference: tests/python/unittest/test_contrib_operator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp, nd, autograd
+from mxnet_tpu.contrib import ops as cops
+
+
+def _a(x):
+    return mxnp.array(onp.asarray(x, onp.float32))
+
+
+def test_box_iou():
+    lhs = _a([[0, 0, 2, 2]])
+    rhs = _a([[1, 1, 3, 3], [0, 0, 2, 2], [10, 10, 11, 11]])
+    iou = cops.box_iou(lhs, rhs).asnumpy()
+    onp.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_box_iou_center_format():
+    lhs = _a([[1, 1, 2, 2]])   # center (1,1), w=h=2 → corners (0,0,2,2)
+    rhs = _a([[1, 1, 2, 2]])
+    iou = cops.box_iou(lhs, rhs, format="center").asnumpy()
+    onp.testing.assert_allclose(iou[0], [1.0], rtol=1e-6)
+
+
+def test_box_nms_basic():
+    # rows: (score, x1, y1, x2, y2) — coord_start=1, score_index=0
+    data = _a([[0.9, 0, 0, 2, 2],
+               [0.8, 0.1, 0.1, 2.1, 2.1],   # overlaps the first → out
+               [0.7, 5, 5, 6, 6]])
+    out = cops.box_nms(data, overlap_thresh=0.5, coord_start=1,
+                       score_index=0, id_index=-1).asnumpy()
+    assert out[0][0] == pytest.approx(0.9)
+    assert (out[1] == -1).all()
+    assert out[2][0] == pytest.approx(0.7)
+
+
+def test_box_nms_class_aware():
+    # same boxes, different class ids → no suppression unless forced
+    data = _a([[0, 0.9, 0, 0, 2, 2],
+               [1, 0.8, 0, 0, 2, 2]])
+    out = cops.box_nms(data, overlap_thresh=0.5, coord_start=2,
+                       score_index=1, id_index=0).asnumpy()
+    assert (out != -1).all()
+    out = cops.box_nms(data, overlap_thresh=0.5, coord_start=2,
+                       score_index=1, id_index=0,
+                       force_suppress=True).asnumpy()
+    assert (out[1] == -1).all()
+
+
+def test_box_nms_batch_and_topk():
+    rng = onp.random.RandomState(0)
+    data = rng.rand(2, 8, 5).astype(onp.float32)
+    data[..., 1:] = data[..., 1:] * 4  # boxes
+    data[..., 3:] = data[..., 1:3] + 1 + data[..., 3:] * 0.1
+    out = cops.box_nms(_a(data), overlap_thresh=0.5, topk=2,
+                       coord_start=1, score_index=0).asnumpy()
+    for b in range(2):
+        kept = (out[b, :, 0] != -1).sum()
+        assert kept <= 2
+
+
+def test_bipartite_matching():
+    score = _a([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]])
+    row, col = cops.bipartite_matching(score, threshold=1e-12)
+    row, col = row.asnumpy(), col.asnumpy()
+    # greedy: (0,1)=0.6 first, then (2,0)=0.3
+    onp.testing.assert_array_equal(row, [1, -1, 0])
+    onp.testing.assert_array_equal(col, [2, 0])
+
+
+def test_roi_align_identity():
+    # a 1x1 ROI aligned on a constant image returns the constant
+    x = mxnp.ones((1, 2, 8, 8))
+    rois = _a([[0, 0, 0, 7, 7]])
+    out = cops.roi_align(x, rois, pooled_size=(2, 2),
+                         spatial_scale=1.0).asnumpy()
+    onp.testing.assert_allclose(out, onp.ones((1, 2, 2, 2)), rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    x = mxnp.random.uniform(size=(1, 1, 6, 6))
+    x.attach_grad()
+    rois = _a([[0, 1, 1, 4, 4]])
+    with autograd.record():
+        out = cops.roi_align(x, rois, pooled_size=(2, 2))
+        loss = out.sum()
+    loss.backward()
+    assert float(onp.abs(x.grad.asnumpy()).sum()) > 0
+
+
+def test_roi_pooling():
+    img = onp.arange(16, dtype=onp.float32).reshape(1, 1, 4, 4)
+    rois = _a([[0, 0, 0, 3, 3]])
+    out = cops.roi_pooling(mxnp.array(img), rois, pooled_size=(2, 2),
+                           spatial_scale=1.0).asnumpy()
+    onp.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_boolean_mask():
+    data = _a([[1, 2], [3, 4], [5, 6]])
+    mask = _a([1, 0, 1])
+    out = cops.boolean_mask(data, mask).asnumpy()
+    onp.testing.assert_array_equal(out, [[1, 2], [5, 6]])
+
+
+def test_index_copy_and_index_array():
+    old = mxnp.zeros((4, 2))
+    new = _a([[1, 1], [2, 2]])
+    idx = _a([3, 0])
+    out = cops.index_copy(old, idx, new).asnumpy()
+    onp.testing.assert_array_equal(out, [[2, 2], [0, 0], [0, 0], [1, 1]])
+    ia = cops.index_array(mxnp.zeros((2, 3))).asnumpy()
+    assert ia.shape == (2, 3, 2)
+    onp.testing.assert_array_equal(ia[1, 2], [1, 2])
+
+
+def test_allclose_and_quadratic():
+    a = _a([1.0, 2.0])
+    assert float(cops.allclose(a, a).asnumpy()) == 1.0
+    assert float(cops.allclose(a, a + 1).asnumpy()) == 0.0
+    q = cops.quadratic(_a([2.0]), a=1.0, b=2.0, c=3.0).asnumpy()
+    onp.testing.assert_allclose(q, [4 + 4 + 3])
+
+
+def test_gradient_multiplier():
+    x = _a([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = cops.gradientmultiplier(x, scalar=-0.5)
+        loss = (y * y).sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [-2.0, -3.0], rtol=1e-6)
+
+
+def test_multibox_prior():
+    x = mxnp.zeros((1, 3, 4, 4))
+    anchors = cops.multibox_prior(x, sizes=(0.5, 0.25),
+                                  ratios=(1, 2)).asnumpy()
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    # first anchor centered at ((0+.5)/4, (0+.5)/4) with w=h=0.5
+    onp.testing.assert_allclose(anchors[0, 0],
+                                [0.125 - 0.25, 0.125 - 0.25,
+                                 0.125 + 0.25, 0.125 + 0.25], atol=1e-6)
+
+
+def test_multibox_target_and_detection_pipeline():
+    anchors = cops.multibox_prior(mxnp.zeros((1, 3, 4, 4)),
+                                  sizes=(0.4,), ratios=(1.0,))
+    # one gt box matching an anchor near center
+    label = _a([[[0, 0.3, 0.3, 0.7, 0.7]]])
+    cls_pred = mxnp.zeros((1, 2, 16))
+    loc_t, loc_mask, cls_t = cops.multibox_target(anchors, label, cls_pred)
+    loc_t, loc_mask, cls_t = (loc_t.asnumpy(), loc_mask.asnumpy(),
+                              cls_t.asnumpy())
+    assert loc_t.shape == (1, 64) and cls_t.shape == (1, 16)
+    assert (cls_t == 1).sum() >= 1  # at least the forced best anchor
+    assert loc_mask.sum() == (cls_t == 1).sum() * 4
+
+    # detection decode: feed probabilities strongly favoring class 1 at
+    # the matched anchor
+    probs = onp.full((1, 2, 16), 0.0, onp.float32)
+    probs[0, 0] = 0.9  # background everywhere
+    matched = int(onp.argmax(cls_t[0]))
+    probs[0, 0, matched] = 0.1
+    probs[0, 1, matched] = 0.9
+    loc_pred = mxnp.zeros((1, 64))
+    det = cops.multibox_detection(mxnp.array(probs), loc_pred, anchors,
+                                  threshold=0.5).asnumpy()
+    kept = det[0][det[0, :, 0] != -1]
+    assert len(kept) == 1
+    assert kept[0, 1] == pytest.approx(0.9)
+
+
+def test_multibox_target_padding_rows_keep_forced_match():
+    anchors = mxnp.array(onp.array(
+        [[[0, 0, 0.1, 0.1], [0.5, 0.5, 0.6, 0.6]]], onp.float32))
+    # gt box overlapping anchor 0 weakly (forced match), plus a padding row
+    label = _a([[[0, 0.0, 0.0, 0.3, 0.3], [-1, 0, 0, 0, 0]]])
+    cls_pred = mxnp.zeros((1, 2, 2))
+    _lt, _lm, cls_t = cops.multibox_target(anchors, label, cls_pred)
+    onp.testing.assert_array_equal(cls_t.asnumpy(), [[1.0, 0.0]])
+
+
+def test_multibox_target_negative_mining():
+    anchors = cops.multibox_prior(mxnp.zeros((1, 3, 4, 4)), sizes=(0.4,))
+    label = _a([[[0, 0.3, 0.3, 0.7, 0.7]]])
+    probs = onp.zeros((1, 2, 16), onp.float32)
+    probs[0, 1] = onp.linspace(0, 1, 16)  # fg confidence ramp
+    _lt, _lm, cls_t = cops.multibox_target(
+        anchors, label, mxnp.array(probs), negative_mining_ratio=2.0,
+        ignore_label=-1.0)
+    c = cls_t.asnumpy()[0]
+    n_pos = (c == 1).sum()
+    n_neg = (c == 0).sum()
+    n_ign = (c == -1).sum()
+    assert n_neg <= max(2 * n_pos, 1)
+    assert n_ign > 0  # the easy negatives got ignored
+
+
+def test_box_nms_out_format_conversion():
+    data = _a([[0.9, 1.0, 1.0, 2.0, 2.0]])  # center format box
+    out = cops.box_nms(data, coord_start=1, score_index=0,
+                       in_format="center", out_format="corner").asnumpy()
+    onp.testing.assert_allclose(out[0], [0.9, 0, 0, 2, 2], atol=1e-6)
+
+
+def test_grid_generator_warp():
+    flow = mxnp.zeros((1, 2, 5, 5))
+    grid = cops.grid_generator(flow, "warp").asnumpy()
+    # zero flow → identity normalized grid
+    onp.testing.assert_allclose(grid[0, 0, 0], onp.linspace(-1, 1, 5),
+                                atol=1e-6)
+    onp.testing.assert_allclose(grid[0, 1, :, 0], onp.linspace(-1, 1, 5),
+                                atol=1e-6)
+    # one-pixel x flow moves the grid by 2/(W-1)
+    f2 = onp.zeros((1, 2, 5, 5), onp.float32)
+    f2[0, 0] = 1.0
+    g2 = cops.grid_generator(mxnp.array(f2), "warp").asnumpy()
+    onp.testing.assert_allclose(g2[0, 0] - grid[0, 0], 0.5, atol=1e-6)
+
+
+def test_ps_roi_align():
+    ph = pw = 2
+    K = 3
+    # each channel constant = its index; PS mapping selects channel
+    # k*ph*pw + i*pw + j for output [k, i, j]
+    C = K * ph * pw
+    img = onp.zeros((1, C, 8, 8), onp.float32)
+    for c in range(C):
+        img[0, c] = c
+    rois = _a([[0, 0, 0, 7, 7]])
+    out = cops.roi_align(mxnp.array(img), rois, pooled_size=(ph, pw),
+                         position_sensitive=True).asnumpy()
+    assert out.shape == (1, K, ph, pw)
+    for k in range(K):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, k, i, j] == pytest.approx(k * ph * pw
+                                                        + i * pw + j)
+
+
+def test_npx_multibox_prior_delegates():
+    from mxnet_tpu import npx
+    x = mxnp.zeros((1, 3, 2, 2))
+    a1 = npx.multibox_prior(x, sizes=(0.5,), ratios=(1.0, 2.0)).asnumpy()
+    a2 = cops.multibox_prior(x, sizes=(0.5,), ratios=(1.0, 2.0)).asnumpy()
+    onp.testing.assert_allclose(a1, a2)
+
+
+def test_bilinear_sampler_identity():
+    x = mxnp.random.uniform(size=(1, 1, 5, 5))
+    # identity affine: [1 0 0; 0 1 0]
+    theta = _a([[1, 0, 0, 0, 1, 0]])
+    grid = cops.grid_generator(theta, "affine", target_shape=(5, 5))
+    out = cops.bilinear_sampler(x, grid).asnumpy()
+    onp.testing.assert_allclose(out, x.asnumpy(), atol=1e-5)
+
+
+def test_spatial_transformer_shift():
+    img = onp.zeros((1, 1, 5, 5), onp.float32)
+    img[0, 0, 2, 2] = 1.0
+    # sampling grid shifted +0.5 normalized (= +1 px): out(y,x) samples
+    # img(y, x+1), so the spike at img[2,2] lands at out[2,1]
+    theta = _a([[1, 0, 0.5, 0, 1, 0]])
+    out = cops.spatial_transformer(mxnp.array(img), theta,
+                                   target_shape=(5, 5)).asnumpy()
+    assert out[0, 0, 2, 1] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_nd_contrib_namespace():
+    assert nd.contrib.box_nms is cops.box_nms
+    assert callable(nd.contrib.foreach)
