@@ -1,0 +1,34 @@
+/* Example native extension (parity: reference
+ * example/extensions/lib_custom_op/ — ABI-stable external ops loaded at
+ * runtime, include/mxnet/lib_api.h).  Build:
+ *     gcc -O2 -fPIC -shared -o librelu_ext.so relu_ext.c
+ * Load:
+ *     mx.library.load("librelu_ext.so")   → registers op "ext_relu6"
+ */
+#include <stdint.h>
+
+#define EXPORT __attribute__((visibility("default")))
+
+EXPORT int mxtpu_ext_num_ops(void) { return 1; }
+
+EXPORT const char* mxtpu_ext_op_name(int i) {
+  (void)i;
+  return "ext_relu6";
+}
+
+EXPORT void mxtpu_ext_op_compute(int i, const float* in, float* out,
+                                 int64_t n) {
+  (void)i;
+  for (int64_t k = 0; k < n; ++k) {
+    float v = in[k];
+    out[k] = v < 0.f ? 0.f : (v > 6.f ? 6.f : v);
+  }
+}
+
+EXPORT void mxtpu_ext_op_grad(int i, const float* in, const float* gout,
+                              float* gin, int64_t n) {
+  (void)i;
+  for (int64_t k = 0; k < n; ++k) {
+    gin[k] = (in[k] > 0.f && in[k] < 6.f) ? gout[k] : 0.f;
+  }
+}

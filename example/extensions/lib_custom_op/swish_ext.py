@@ -1,0 +1,30 @@
+"""Example Python extension (parity: reference example/extensions/
+lib_custom_op/ custom ops defined in Python).  Load with
+mx.library.load(".../swish_ext.py") — registers op "ext_swish"."""
+import numpy as onp
+
+
+def register_ops(mx):
+    @mx.operator.register("ext_swish")
+    class SwishProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return Swish()
+
+    class Swish(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0].asnumpy()
+            sig = 1.0 / (1.0 + onp.exp(-x))
+            self.assign(out_data[0], req[0], x * sig)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            x = in_data[0].asnumpy()
+            g = out_grad[0].asnumpy()
+            sig = 1.0 / (1.0 + onp.exp(-x))
+            self.assign(in_grad[0], req[0],
+                        g * (sig + x * sig * (1 - sig)))

@@ -51,6 +51,9 @@ from . import nd
 from . import recordio
 from . import io
 from . import contrib
+from . import operator
+from . import library
+from . import subgraph
 from . import sparse
 from . import symbol
 from . import symbol as sym

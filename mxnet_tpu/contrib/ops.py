@@ -124,7 +124,12 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
                      (c[..., 1] + c[..., 3]) / 2,
                      c[..., 2] - c[..., 0], c[..., 3] - c[..., 1]], -1)
             out = out.at[..., cs:cs + 4].set(coords)
-        return jnp.where(keep[..., None], out, -jnp.ones_like(out))
+        out = jnp.where(keep[..., None], out, -jnp.ones_like(out))
+        # reference contract (bounding_box.cc:43): output sorted by score
+        # descending, suppressed (-1) rows at the end
+        final_key = jnp.where(keep, scores, -jnp.inf)
+        final_order = jnp.argsort(-final_key, axis=-1)
+        return jnp.take_along_axis(out, final_order[..., None], -2)
 
     return apply_op(f, data)
 
@@ -188,15 +193,25 @@ def _bilinear_at(img, y, x):
             + at(y1, x0) * wy1 * wx0 + at(y1, x1) * wy1 * wx1)
 
 
-def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2,
-              position_sensitive=False, aligned=True):
-    """ROI Align (parity: _contrib_ROIAlign, roi_align.cc).
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
+              position_sensitive=False, aligned=False):
+    """ROI Align (parity: _contrib_ROIAlign, roi_align.cc; defaults match
+    the reference: sample_ratio=-1, no half-pixel alignment).
 
     data: [B, C, H, W]; rois: [R, 5] of (batch_idx, x1, y1, x2, y2).
+    sample_ratio<=0: the reference samples adaptively per ROI
+    (ceil(roi_size/pooled_size), roi_align.cc:199); XLA needs static
+    shapes, so we use a static grid sized for the whole feature map,
+    capped at 8 — a superset of the reference's sampling density for
+    typical ROIs.
     """
     ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
               else (pooled_size, pooled_size))
-    sr = max(int(sample_ratio), 1)
+    if sample_ratio > 0:
+        sr = int(sample_ratio)
+    else:
+        H = data.shape[-2]
+        sr = int(min(8, max(1, -(-H // ph))))
 
     def f(x, r):
         off = 0.5 if aligned else 0.0
@@ -358,11 +373,16 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
         cy = (jnp.arange(H) + offsets[0]) * step_y
         cx = (jnp.arange(W) + offsets[1]) * step_x
         cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # H,W,2
+        # anchor widths carry the feature-map aspect correction
+        # (multibox_prior.cc:51: w = size * in_h/in_w * sqrt(ratio))
+        aspect = H / W
         whs = []
         for s in sizes:
-            whs.append((s * onp.sqrt(ratios[0]), s / onp.sqrt(ratios[0])))
+            whs.append((s * aspect * onp.sqrt(ratios[0]),
+                        s / onp.sqrt(ratios[0])))
         for r in ratios[1:]:
-            whs.append((sizes[0] * onp.sqrt(r), sizes[0] / onp.sqrt(r)))
+            whs.append((sizes[0] * aspect * onp.sqrt(r),
+                        sizes[0] / onp.sqrt(r)))
         whs = jnp.asarray(whs)  # [A, 2] (w, h)
         cyx = jnp.broadcast_to(cyx[:, :, None, :],
                                (H, W, whs.shape[0], 2))

@@ -175,7 +175,10 @@ class Block:
         for name, p in params.items():
             if p._data is not None:
                 arrays[name] = p.data().asnumpy()
-        onp.savez(filename, **arrays)
+        # write to the exact filename (reference uses .params; bare
+        # onp.savez would append .npz)
+        with open(filename, "wb") as f:
+            onp.savez(f, **arrays)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
@@ -275,9 +278,22 @@ class HybridBlock(Block):
                           static_shape=static_shape, **kwargs)
 
     def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
-        """Parity: block.py:1312 optimize_for — backend partitioning.  On
-        TPU the 'backend' is XLA itself; hybridize + warm the cache."""
-        self.hybridize(True)
+        """Parity: block.py:1312 optimize_for — backend partitioning via
+        the subgraph-backend registry (mxnet_tpu.subgraph).  Default
+        backend is XLA whole-graph compilation; backends like INT8 may
+        rewrite children (the BuildSubgraph analog)."""
+        from ..subgraph import get_backend
+        be = get_backend(backend if backend is not None else "XLA")
+        ret = be.optimize(self, x, *args, **kwargs)
+        if ret is not None and ret is not self:
+            raise ValueError(
+                "subgraph backend %r returned a new block; backends must "
+                "rewrite the block in place (the MXOptimizeForBackend "
+                "contract)" % (backend,))
+        if clear:
+            self._cached_graphs = {}
+        if not self._active:
+            self.hybridize(True)
         self(x, *args)
 
     def infer_shape(self, *args):

@@ -1,0 +1,3 @@
+"""gluon.contrib.estimator (parity: python/mxnet/gluon/contrib/estimator)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import *  # noqa: F401,F403

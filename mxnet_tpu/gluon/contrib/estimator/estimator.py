@@ -1,0 +1,131 @@
+"""Estimator — the high-level training-loop harness.
+
+Parity: reference `python/mxnet/gluon/contrib/estimator/estimator.py`
+(Estimator.fit with event handlers; prepare_loss/evaluate/fit_batch).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .... import autograd
+from ...trainer import Trainer
+from ... import loss as gloss
+from ... import metric as gmetric
+from .event_handler import (MetricHandler, LoggingHandler, StoppingHandler,
+                            ValidationHandler, TrainBegin, TrainEnd,
+                            EpochBegin, EpochEnd, BatchBegin, BatchEnd)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Train/evaluate a Gluon net with pluggable event handlers."""
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, device=None):
+        self.net = net
+        if isinstance(loss, gloss.Loss):
+            self.loss = loss
+        else:
+            raise ValueError("loss must be a gluon.loss.Loss")
+        self.train_metrics = _as_list(train_metrics) or [gmetric.Accuracy()]
+        self.val_metrics = _as_list(val_metrics) or \
+            [type(m)() for m in self.train_metrics]
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.01})
+        # loss running averages tracked alongside metrics
+        self.train_loss_metric = gmetric.Loss("loss")
+        self.val_loss_metric = gmetric.Loss("val_loss")
+
+    # -- evaluation -------------------------------------------------------
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            x, y = batch[0], batch[1]
+            pred = self.net(x)
+            loss = self.loss(pred, y)
+            for m in self.val_metrics:
+                m.update(y, pred)
+            self.val_loss_metric.update(0, loss)
+        return {m.get()[0]: m.get()[1]
+                for m in self.val_metrics + [self.val_loss_metric]}
+
+    # -- training ---------------------------------------------------------
+    def fit_batch(self, batch, batch_axis=0):
+        x, y = batch[0], batch[1]
+        with autograd.record():
+            pred = self.net(x)
+            loss = self.loss(pred, y)
+        loss.backward()
+        self.trainer.step(x.shape[batch_axis])
+        return x, y, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = self._prepare_handlers(val_data, event_handlers,
+                                          epochs, batches)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize(handlers)
+
+        for h in train_begin:
+            h.train_begin(self)
+        stop = False
+        while not stop:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            for batch in train_data:
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                x, y, pred, loss = self.fit_batch(batch, batch_axis)
+                # loss metric updates flow through MetricHandler (single
+                # ownership, matching the reference)
+                for h in batch_end:
+                    if h.batch_end(self, batch=batch, pred=pred, label=y,
+                                   loss=loss):
+                        stop = True
+                if stop:
+                    break
+            for h in epoch_end:
+                if h.epoch_end(self):
+                    stop = True
+        for h in train_end:
+            h.train_end(self)
+
+    # -- plumbing ---------------------------------------------------------
+    def _prepare_handlers(self, val_data, event_handlers, epochs, batches):
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                self.train_metrics + [self.train_loss_metric]))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=self.train_metrics + [self.train_loss_metric]))
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return handlers
+
+    @staticmethod
+    def _categorize(handlers):
+        cats = ([], [], [], [], [], [])
+        kinds = (TrainBegin, EpochBegin, BatchBegin, BatchEnd, EpochEnd,
+                 TrainEnd)
+        for h in handlers:
+            for bucket, kind in zip(cats, kinds):
+                if isinstance(h, kind):
+                    bucket.append(h)
+        return cats
+
+
+def _as_list(x):
+    if x is None:
+        return None
+    return list(x) if isinstance(x, (list, tuple)) else [x]

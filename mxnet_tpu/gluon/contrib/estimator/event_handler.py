@@ -1,0 +1,272 @@
+"""Estimator event handlers.
+
+Parity: reference `python/mxnet/gluon/contrib/estimator/event_handler.py`
+(TrainBegin/TrainEnd/EpochBegin/EpochEnd/BatchBegin/BatchEnd mixins;
+StoppingHandler, MetricHandler, ValidationHandler, LoggingHandler,
+CheckpointHandler, EarlyStoppingHandler).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as onp
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch/max_batch (reference StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Update train metrics every batch (reference MetricHandler)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            if getattr(m, "name", "").startswith("loss") or \
+                    type(m).__name__ == "Loss":
+                if loss is not None:
+                    m.update(0, loss)
+            elif pred is not None and label is not None:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation periodically (reference ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Log metrics (reference LoggingHandler)."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=-1000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training done in %.1fs",
+                         time.time() - self.train_start)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.batch_index = 0
+
+    def _fmt_metrics(self):
+        parts = []
+        for m in self.metrics:
+            name, val = m.get()
+            if isinstance(val, float) and not onp.isnan(val):
+                parts.append("%s: %.4f" % (name, val))
+        return ", ".join(parts)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            self.logger.info("[Epoch %d][Batch %d] %s", self.current_epoch,
+                             self.batch_index, self._fmt_metrics())
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.logger.info("[Epoch %d] %s", self.current_epoch,
+                         self._fmt_metrics())
+        self.current_epoch += 1
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save parameters periodically; keep the best by a monitored metric
+    (reference CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="auto", epoch_period=1, batch_period=None,
+                 save_best=False, max_checkpoints=5):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.save_best = save_best
+        self.max_checkpoints = max_checkpoints
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.saved = []
+        if mode == "auto" and monitor is not None:
+            name = monitor.get()[0]
+            mode = "max" if "acc" in name or "f1" in name else "min"
+        self.mode = mode
+        self.best = -onp.inf if self.mode == "max" else onp.inf
+
+    def _save(self, estimator, tag):
+        os.makedirs(self.model_dir, exist_ok=True)
+        path = os.path.join(self.model_dir,
+                            "%s-%s.params" % (self.model_prefix, tag))
+        estimator.net.save_parameters(path)
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+        return path
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self._save(estimator, "batch%d" % self.current_batch)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, "epoch%d" % self.current_epoch)
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            better = val > self.best if self.mode == "max" else \
+                val < self.best
+            if better:
+                self.best = val
+                os.makedirs(self.model_dir, exist_ok=True)
+                estimator.net.save_parameters(os.path.join(
+                    self.model_dir, "%s-best.params" % self.model_prefix))
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when a monitored metric stops improving
+    (reference EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if mode == "auto":
+            name = monitor.get()[0]
+            mode = "max" if "acc" in name or "f1" in name else "min"
+        self.mode = mode
+        if baseline is not None:
+            self.best = baseline  # must beat the baseline to count
+        else:
+            self.best = -onp.inf if self.mode == "max" else onp.inf
+
+    def _improved(self, val):
+        if self.mode == "max":
+            return val > self.best + self.min_delta
+        return val < self.best - self.min_delta
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, val = self.monitor.get()
+        self.current_epoch += 1
+        if onp.isnan(val):
+            return self.stop_training
+        if self._improved(val):
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        return self.stop_training

@@ -1,0 +1,128 @@
+"""mx.library — runtime loading of extension libraries.
+
+Parity: reference `python/mxnet/library.py` (load :32 → MXLoadLib,
+src/c_api/c_api.cc:1522) and the ABI-stable plugin interface
+`include/mxnet/lib_api.h` (CustomOp :751, REGISTER_OP :932) that lets
+external .so files contribute operators without rebuilding the framework.
+
+TPU-native ABI (simplified lib_api): a native extension exports
+
+    int          mxtpu_ext_num_ops(void);
+    const char*  mxtpu_ext_op_name(int i);
+    void         mxtpu_ext_op_compute(int i, const float* in, float* out,
+                                      int64_t n);           // elementwise
+    void         mxtpu_ext_op_grad(int i, const float* in,
+                                   const float* gout, float* gin,
+                                   int64_t n);               // optional
+
+Loaded ops are registered as Custom ops (host callbacks through
+jax.pure_callback, so they compose with jit like every Custom op).
+Python extensions (.py files defining `register_ops(mx)`) are also
+accepted — the frontend-level plugin path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as onp
+
+from . import operator as _operator
+from .ndarray import ndarray
+
+__all__ = ["load", "loaded_libraries"]
+
+_LOADED = {}
+
+
+def loaded_libraries():
+    return dict(_LOADED)
+
+
+def load(path, verbose=True):
+    """Load an extension library (.so native ABI or .py module).
+
+    Returns the list of op names registered by the library."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise OSError("library %s not found" % path)
+    if path.endswith(".py"):
+        names = _load_python(path)
+    else:
+        names = _load_native(path)
+    _LOADED[path] = names
+    if verbose and names:
+        print("loaded library %s: ops %s" % (os.path.basename(path), names))
+    return names
+
+
+def _load_python(path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_ext_%s" % os.path.basename(path)[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "register_ops"):
+        raise ValueError("python extension must define register_ops(mx)")
+    import mxnet_tpu as mx
+    before = set(_operator.get_all_registered_operators())
+    mod.register_ops(mx)
+    after = set(_operator.get_all_registered_operators())
+    return sorted(after - before)
+
+
+def _load_native(path):
+    lib = ctypes.CDLL(path)
+    lib.mxtpu_ext_num_ops.restype = ctypes.c_int
+    lib.mxtpu_ext_op_name.restype = ctypes.c_char_p
+    lib.mxtpu_ext_op_name.argtypes = [ctypes.c_int]
+    lib.mxtpu_ext_op_compute.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    has_grad = hasattr(lib, "mxtpu_ext_op_grad")
+    if has_grad:
+        lib.mxtpu_ext_op_grad.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    names = []
+    for i in range(lib.mxtpu_ext_num_ops()):
+        name = lib.mxtpu_ext_op_name(i).decode()
+        names.append(name)
+        _register_native_op(lib, i, name, has_grad)
+    return names
+
+
+def _register_native_op(lib, op_index, name, has_grad):
+    fptr = ctypes.POINTER(ctypes.c_float)
+
+    class _NativeOp(_operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = onp.ascontiguousarray(in_data[0].asnumpy(), onp.float32)
+            out = onp.empty_like(x)
+            lib.mxtpu_ext_op_compute(
+                op_index, x.ctypes.data_as(fptr), out.ctypes.data_as(fptr),
+                x.size)
+            self.assign(out_data[0], req[0], out)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            if not has_grad:
+                raise NotImplementedError(
+                    "extension op %s has no gradient" % name)
+            x = onp.ascontiguousarray(in_data[0].asnumpy(), onp.float32)
+            g = onp.ascontiguousarray(out_grad[0].asnumpy(), onp.float32)
+            gin = onp.empty_like(x)
+            lib.mxtpu_ext_op_grad(
+                op_index, x.ctypes.data_as(fptr), g.ctypes.data_as(fptr),
+                gin.ctypes.data_as(fptr), x.size)
+            self.assign(in_grad[0], req[0], gin)
+
+    class _NativeProp(_operator.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _NativeOp()
+
+    _operator.register(name)(_NativeProp)

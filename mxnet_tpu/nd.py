@@ -11,6 +11,7 @@ from .ndarray import ndarray as NDArray, array, waitall  # noqa: F401
 from .numpy_extension import savez  # noqa: F401
 # mx.nd.contrib.{box_nms, roi_align, foreach, while_loop, cond, ...}
 from . import _nd_contrib as contrib  # noqa: F401
+from .operator import Custom  # noqa: F401  (mx.nd.Custom)
 
 
 def save(fname, data):

@@ -1,0 +1,163 @@
+"""mx.operator — Python-defined custom operators.
+
+Parity: reference `python/mxnet/operator.py` (CustomOp :155, CustomOpProp
+:674, register :744) backed by `src/operator/custom/custom.cc` (the
+NNVM_REGISTER_OP(Custom) :526 op whose kernels call back into Python on a
+dedicated worker thread, custom-inl.h:52).
+
+TPU-native design: the Python body runs on the host via
+`jax.pure_callback` — so a Custom op composes with jit/hybridize where
+the backend supports host callbacks (CPU; TPU runtimes without host
+send/recv must call Custom ops eagerly, outside hybridized blocks) —
+and the user-defined backward is attached with `jax.custom_vjp`, which
+the autograd tape (ndarray.apply_op → jax.vjp) picks up transparently.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .ndarray import apply_op, array as nd_array, ndarray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "Custom", "get_all_registered_operators"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom op kernels (reference operator.py:155)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError(
+            "custom op has no backward; wrap calls in autograd.pause() or "
+            "implement backward()")
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the grad_req
+        (reference CustomOp.assign)."""
+        if req == "null":
+            return
+        src = src if isinstance(src, ndarray) else nd_array(src)
+        if req in ("write", "inplace"):
+            dst._set_data(jnp.asarray(src._data, dst._data.dtype))
+        elif req == "add":
+            dst._set_data(dst._data + jnp.asarray(src._data,
+                                                  dst._data.dtype))
+        else:
+            raise ValueError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Op metadata provider (reference operator.py:674)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp
+    (reference operator.py:744)."""
+    def decorator(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return decorator
+
+
+def get_all_registered_operators():
+    return list(_REGISTRY)
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """Invoke a registered custom op (parity: mx.nd.Custom).
+
+    Forward/backward run as host callbacks; gradients flow through the
+    user's backward() when autograd is recording.
+    """
+    if op_type is None:
+        raise ValueError("op_type is required")
+    if op_type not in _REGISTRY:
+        raise ValueError("custom op %r not registered (have %s)"
+                         % (op_type, sorted(_REGISTRY)))
+    prop = _REGISTRY[op_type](**{k: str(v) for k, v in kwargs.items()})
+
+    in_shapes = [tuple(x.shape) for x in inputs]
+    in_shapes2, out_shapes, _aux_shapes = prop.infer_shape(in_shapes)
+    in_dtypes = [x.dtype for x in inputs]
+    _, out_dtypes, _ = prop.infer_type(in_dtypes)
+    op = prop.create_operator(None, in_shapes2, in_dtypes)
+    n_out = len(out_shapes)
+    is_train = autograd.is_training()
+
+    result_spec = tuple(jax.ShapeDtypeStruct(tuple(s), onp.dtype(d))
+                        for s, d in zip(out_shapes, out_dtypes))
+    in_spec = tuple(jax.ShapeDtypeStruct(tuple(s), onp.dtype(d))
+                    for s, d in zip(in_shapes2, in_dtypes))
+
+    def host_forward(*arrs):
+        ins = [nd_array(onp.asarray(a)) for a in arrs]
+        outs = [nd_array(onp.zeros(tuple(s), onp.dtype(d)))
+                for s, d in zip(out_shapes, out_dtypes)]
+        op.forward(is_train, ["write"] * n_out, ins, outs, [])
+        return tuple(o.asnumpy() for o in outs)
+
+    def host_backward(*arrs):
+        k = len(inputs)
+        grads = [onp.asarray(a) for a in arrs[:n_out]]
+        ins_np = arrs[n_out:n_out + k]
+        outs_np = arrs[n_out + k:]
+        out_grad = [nd_array(g) for g in grads]
+        in_data = [nd_array(onp.asarray(a)) for a in ins_np]
+        out_data = [nd_array(onp.asarray(a)) for a in outs_np]
+        in_grad = [nd_array(onp.zeros(tuple(s), onp.dtype(d)))
+                   for s, d in zip(in_shapes2, in_dtypes)]
+        op.backward(["write"] * len(in_grad), out_grad, in_data, out_data,
+                    in_grad, [])
+        return tuple(g.asnumpy() for g in in_grad)
+
+    @jax.custom_vjp
+    def f(*vals):
+        return jax.pure_callback(host_forward, result_spec, *vals)
+
+    def fwd(*vals):
+        outs = jax.pure_callback(host_forward, result_spec, *vals)
+        return outs, (vals, outs)
+
+    def bwd(res, gouts):
+        vals, outs = res
+        gin = jax.pure_callback(host_backward, in_spec, *gouts, *vals,
+                                *outs)
+        return tuple(gin)
+
+    f.defvjp(fwd, bwd)
+
+    out = apply_op(f, *inputs)
+    if n_out == 1:
+        return out[0] if isinstance(out, (tuple, list)) else out
+    return out

@@ -1,0 +1,79 @@
+"""Subgraph backend registry — the `optimize_for` plugin seam.
+
+Parity: reference `src/operator/subgraph/` (SubgraphProperty plugin API
+subgraph_property.h:252, MXNET_REGISTER_SUBGRAPH_BACKEND, BuildSubgraph
+pass build_subgraph.cc:823) surfaced through
+`HybridBlock.optimize_for(backend=...)` (python block.py:1312 →
+MXOptimizeForBackend).
+
+TPU-native design: XLA already does the fusion the oneDNN/TensorRT
+subgraph backends exist for, so a "backend" here is a *block-rewrite
+hook*: it receives the block and sample inputs and may swap children
+(the INT8 backend quantizes), tune flags, or just warm the XLA cache
+(the default backend).  Backends registered here become valid
+`backend=` arguments to `HybridBlock.optimize_for`.
+"""
+from __future__ import annotations
+
+__all__ = ["register_backend", "get_backend", "list_backends",
+           "SubgraphBackend"]
+
+_BACKENDS = {}
+
+
+class SubgraphBackend:
+    """Backend base: override optimize(block, *sample_args, **kwargs)."""
+
+    name = None
+
+    def optimize(self, block, *args, **kwargs):
+        raise NotImplementedError
+
+
+def register_backend(name):
+    def decorator(cls):
+        inst = cls()
+        inst.name = name
+        _BACKENDS[name.upper()] = inst
+        return cls
+    return decorator
+
+
+def get_backend(name):
+    key = str(name).upper()
+    if key not in _BACKENDS:
+        raise ValueError("unknown subgraph backend %r (have %s)"
+                         % (name, sorted(_BACKENDS)))
+    return _BACKENDS[key]
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+@register_backend("XLA")
+class _XLABackend(SubgraphBackend):
+    """Default backend: whole-graph XLA compilation (hybridize + warm),
+    the TPU analog of the static-shape subgraph property used by
+    optimize_for in the reference."""
+
+    def optimize(self, block, *args, **kwargs):
+        block.hybridize(True, **{k: v for k, v in kwargs.items()
+                                 if k in ("static_alloc", "static_shape")})
+        if args:
+            block(*args)
+        return block
+
+
+@register_backend("INT8")
+class _Int8Backend(SubgraphBackend):
+    """INT8 PTQ backend (the ONEDNN-quantization analog): calibrates on
+    the sample input and swaps Dense/Conv2D children for int8 blocks."""
+
+    def optimize(self, block, *args, calib_data=None, calib_mode="naive",
+                 **kwargs):
+        from .contrib.quantization import quantize_net
+        if calib_data is None:
+            calib_data = [args[0]] if args else None
+        return quantize_net(block, calib_data=calib_data,
+                            calib_mode=calib_mode)

@@ -33,9 +33,10 @@ def test_box_nms_basic():
                [0.7, 5, 5, 6, 6]])
     out = cops.box_nms(data, overlap_thresh=0.5, coord_start=1,
                        score_index=0, id_index=-1).asnumpy()
+    # output is score-descending with suppressed rows (-1) at the end
     assert out[0][0] == pytest.approx(0.9)
-    assert (out[1] == -1).all()
-    assert out[2][0] == pytest.approx(0.7)
+    assert out[1][0] == pytest.approx(0.7)
+    assert (out[2] == -1).all()
 
 
 def test_box_nms_class_aware():

@@ -1,0 +1,101 @@
+"""Estimator + event handler tests (reference:
+tests/python/unittest/test_gluon_estimator.py,
+test_gluon_event_handler.py)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp, gluon
+from mxnet_tpu.gluon import nn, metric
+from mxnet_tpu.gluon.contrib.estimator import (
+    Estimator, CheckpointHandler, EarlyStoppingHandler, LoggingHandler,
+    StoppingHandler)
+from mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+
+
+def _toy_data(n=64, d=8, classes=3, seed=0):
+    rng = onp.random.RandomState(seed)
+    x = rng.rand(n, d).astype(onp.float32)
+    y = rng.randint(0, classes, n).astype(onp.float32)
+    return ArrayDataset(mxnp.array(x), mxnp.array(y))
+
+
+def _net(classes=3):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_estimator_fit_and_evaluate():
+    ds = _toy_data()
+    loader = DataLoader(ds, batch_size=16)
+    net = _net()
+    est = Estimator(net=net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.05}))
+    est.fit(train_data=loader, epochs=5)
+    name, acc = est.train_metrics[0].get()
+    assert name == "accuracy"
+    assert acc > 0.4  # learned something on random-but-fixed labels
+    res = est.evaluate(DataLoader(ds, batch_size=16))
+    assert "accuracy" in res and "val_loss" in res
+
+
+def test_estimator_max_batches():
+    ds = _toy_data()
+    loader = DataLoader(ds, batch_size=8)
+    net = _net()
+    est = Estimator(net=net, loss=gluon.loss.SoftmaxCrossEntropyLoss())
+    stopper = StoppingHandler(max_batch=3)
+    est.fit(train_data=loader, batches=3, event_handlers=[stopper])
+    assert stopper.current_batch == 3
+
+
+def test_checkpoint_handler(tmp_path):
+    ds = _toy_data(n=32)
+    loader = DataLoader(ds, batch_size=16)
+    net = _net()
+    est = Estimator(net=net, loss=gluon.loss.SoftmaxCrossEntropyLoss())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                             epoch_period=1, max_checkpoints=2)
+    est.fit(train_data=loader, epochs=3, event_handlers=[ckpt])
+    files = sorted(os.listdir(tmp_path))
+    # max_checkpoints=2 keeps only the last two
+    assert files == ["toy-epoch2.params", "toy-epoch3.params"]
+    # checkpoint loads back
+    net2 = _net()
+    net2.load_parameters(os.path.join(str(tmp_path), "toy-epoch3.params"))
+
+
+def test_early_stopping_handler():
+    class FakeMetric:
+        """Metric that stops improving after 2 epochs."""
+        def __init__(self):
+            self.vals = [0.5, 0.6, 0.6, 0.6, 0.6, 0.6]
+            self.i = 0
+
+        def get(self):
+            v = self.vals[min(self.i, len(self.vals) - 1)]
+            self.i += 1
+            return "accuracy", v
+
+    m = FakeMetric()
+    h = EarlyStoppingHandler(monitor=m, patience=2)
+    m.i = 0  # reset after mode-detection get()
+    ds = _toy_data(n=16)
+    loader = DataLoader(ds, batch_size=8)
+    net = _net()
+    est = Estimator(net=net, loss=gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(train_data=loader, epochs=10, event_handlers=[h])
+    assert h.stop_training
+    assert h.stopped_epoch <= 5
+
+
+def test_onnx_gate():
+    from mxnet_tpu.contrib import onnx as monnx
+    with pytest.raises(ImportError, match="StableHLO"):
+        monnx.export_model(None, None)

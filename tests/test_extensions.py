@@ -1,0 +1,198 @@
+"""Custom ops + extension library + subgraph backends (reference:
+tests/python/unittest/test_operator.py custom-op section and
+test_extensions.py, test_subgraph_op.py)."""
+import os
+import subprocess
+import shutil
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp, nd, autograd
+from mxnet_tpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# mx.operator custom ops
+# ---------------------------------------------------------------------------
+@mx.operator.register("test_sigmoid_op")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return SigmoidOp()
+
+
+class SigmoidOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + onp.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g * y * (1 - y))
+
+
+def test_custom_op_forward():
+    x = mxnp.array([[0.0, 1.0], [-1.0, 2.0]])
+    y = nd.Custom(x, op_type="test_sigmoid_op")
+    ref = 1.0 / (1.0 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-5)
+
+
+def test_custom_op_backward():
+    x = mxnp.array([0.5, -0.5, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid_op")
+        loss = y.sum()
+    loss.backward()
+    s = 1.0 / (1.0 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-4)
+
+
+def test_custom_op_multi_output():
+    @mx.operator.register("test_split2")
+    class Split2Prop(mx.operator.CustomOpProp):
+        def list_outputs(self):
+            return ["a", "b"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0], in_shape[0]], []
+
+        def create_operator(self, ctx, s, d):
+            class Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0].asnumpy()
+                    self.assign(out_data[0], req[0], x * 2)
+                    self.assign(out_data[1], req[1], x + 1)
+            return Op()
+
+    x = mxnp.array([1.0, 2.0])
+    a, b = nd.Custom(x, op_type="test_split2")
+    onp.testing.assert_allclose(a.asnumpy(), [2.0, 4.0])
+    onp.testing.assert_allclose(b.asnumpy(), [2.0, 3.0])
+
+
+def test_custom_op_unknown_raises():
+    with pytest.raises(ValueError, match="not registered"):
+        nd.Custom(mxnp.zeros(2), op_type="no_such_op")
+
+
+def test_custom_op_in_gluon_block():
+    class CustomActNet(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Dense(4)
+
+        def forward(self, x):
+            return nd.Custom(self.fc(x), op_type="test_sigmoid_op")
+
+    net = CustomActNet()
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(3, 5))
+    out = net(x)
+    assert out.shape == (3, 4)
+    assert (out.asnumpy() > 0).all() and (out.asnumpy() < 1).all()
+
+
+# ---------------------------------------------------------------------------
+# mx.library extension loading
+# ---------------------------------------------------------------------------
+def test_python_extension():
+    path = os.path.join(REPO, "example", "extensions", "lib_custom_op",
+                        "swish_ext.py")
+    names = mx.library.load(path, verbose=False)
+    assert "ext_swish" in names
+    x = mxnp.array([0.0, 1.0, -1.0])
+    y = nd.Custom(x, op_type="ext_swish")
+    ref = x.asnumpy() / (1.0 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-5)
+    # gradient via the extension's backward
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.Custom(x, op_type="ext_swish").sum()
+    loss.backward()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None and
+                    shutil.which("g++") is None,
+                    reason="no C compiler")
+def test_native_extension(tmp_path):
+    src = os.path.join(REPO, "example", "extensions", "lib_custom_op",
+                       "relu_ext.c")
+    so = str(tmp_path / "librelu_ext.so")
+    cc = shutil.which("gcc") or shutil.which("g++")
+    subprocess.run([cc, "-O2", "-fPIC", "-shared", "-o", so, src],
+                   check=True)
+    names = mx.library.load(so, verbose=False)
+    assert names == ["ext_relu6"]
+    x = mxnp.array([-1.0, 3.0, 8.0])
+    y = nd.Custom(x, op_type="ext_relu6")
+    onp.testing.assert_allclose(y.asnumpy(), [0.0, 3.0, 6.0])
+    x.attach_grad()
+    with autograd.record():
+        loss = (nd.Custom(x, op_type="ext_relu6") * 2).sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0.0, 2.0, 0.0])
+    assert so in mx.library.loaded_libraries()
+
+
+# ---------------------------------------------------------------------------
+# subgraph backends / optimize_for
+# ---------------------------------------------------------------------------
+def test_subgraph_backend_registry():
+    assert "XLA" in mx.subgraph.list_backends()
+    assert "INT8" in mx.subgraph.list_backends()
+    with pytest.raises(ValueError, match="unknown subgraph backend"):
+        mx.subgraph.get_backend("TENSORRT_NOPE")
+
+
+def test_optimize_for_default_backend():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(4, 6))
+    net.optimize_for(x)  # default XLA backend: hybridize + warm
+    assert net._active
+    out = net(x)
+    assert out.shape == (4, 2)
+
+
+def test_optimize_for_int8_backend():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(4, 6))
+    ref = net(x).asnumpy()
+    net.optimize_for(x, backend="INT8")
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert "QuantizedDense" in kinds
+    out = net(x).asnumpy()
+    assert onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9) < 0.1
+
+
+def test_custom_backend_registration():
+    calls = []
+
+    @mx.subgraph.register_backend("TESTBACKEND")
+    class TB(mx.subgraph.SubgraphBackend):
+        def optimize(self, block, *args, **kwargs):
+            calls.append((block, args))
+            return block
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(1, 3))
+    net.optimize_for(x, backend="TESTBACKEND")
+    assert len(calls) == 1
