@@ -284,17 +284,19 @@ class HybridBlock(Block):
         rewrite children (the BuildSubgraph analog)."""
         from ..subgraph import get_backend
         be = get_backend(backend if backend is not None else "XLA")
+        if clear:
+            # clear BEFORE the backend runs so its warm-up compile is the
+            # one that's kept
+            self._cached_graphs = {}
         ret = be.optimize(self, x, *args, **kwargs)
         if ret is not None and ret is not self:
             raise ValueError(
                 "subgraph backend %r returned a new block; backends must "
                 "rewrite the block in place (the MXOptimizeForBackend "
                 "contract)" % (backend,))
-        if clear:
-            self._cached_graphs = {}
         if not self._active:
             self.hybridize(True)
-        self(x, *args)
+        self(x, *args)  # cache hit if the backend already warmed
 
     def infer_shape(self, *args):
         """Layers override to finalize deferred parameter shapes."""

@@ -28,9 +28,12 @@ class Estimator:
             self.loss = loss
         else:
             raise ValueError("loss must be a gluon.loss.Loss")
+        import copy
         self.train_metrics = _as_list(train_metrics) or [gmetric.Accuracy()]
+        # deepcopy keeps configuration (top_k, feval, ...) that type(m)()
+        # would lose or crash on
         self.val_metrics = _as_list(val_metrics) or \
-            [type(m)() for m in self.train_metrics]
+            [copy.deepcopy(m) for m in self.train_metrics]
         self.trainer = trainer or Trainer(
             net.collect_params(), "sgd", {"learning_rate": 0.01})
         # loss running averages tracked alongside metrics
@@ -77,7 +80,9 @@ class Estimator:
         while not stop:
             for h in epoch_begin:
                 h.epoch_begin(self)
+            epoch_batches = 0
             for batch in train_data:
+                epoch_batches += 1
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
                 x, y, pred, loss = self.fit_batch(batch, batch_axis)
@@ -89,6 +94,10 @@ class Estimator:
                         stop = True
                 if stop:
                     break
+            if epoch_batches == 0:
+                raise ValueError(
+                    "train_data yielded no batches — with only a batch "
+                    "limit this would loop forever")
             for h in epoch_end:
                 if h.epoch_end(self):
                     stop = True

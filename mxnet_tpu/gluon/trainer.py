@@ -57,8 +57,24 @@ class Trainer:
         else:
             self._kvstore = kv_create(self._kvstore_type)
         self._kv_initialized = True
-        if self._kvstore is not None and self._update_on_kvstore:
-            self._kvstore.set_optimizer(self._optimizer)
+        kv = self._kvstore
+        if kv is not None and (kv.num_workers > 1 or
+                               self._update_on_kvstore):
+            # seed the store with the params: multi-worker replicas start
+            # identical, and the update-on-kvstore path needs the weights
+            # resident server-side before the first push
+            # (reference _init_kvstore broadcast, trainer.py:188)
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    kv.broadcast(str(i), p.data(), out=p.data())
+        if kv is not None and self._update_on_kvstore:
+            # server-side optimizer: workers push pre-scaled grads, the
+            # server runs the update (reference set_updater path)
+            import copy
+            opt = copy.copy(self._optimizer)
+            opt.rescale_grad = 1.0
+            opt.param_dict = {}
+            kv.set_optimizer(opt)
 
     @property
     def learning_rate(self):
@@ -80,6 +96,18 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is not None and self._update_on_kvstore:
+            # push pre-scaled grads; server sums across workers and
+            # updates; pull fresh weights.  Same sum semantics as the
+            # allreduce path (reference: gradients are summed, batch_size
+            # is the per-worker batch).
+            scale = self._scale / batch_size
+            for i, p in enumerate(self._params):
+                if p.grad_req == "null" or p._data is None:
+                    continue
+                self._kvstore.push(str(i), p.grad() * scale, priority=-i)
+                self._kvstore.pull(str(i), out=p.data(), priority=-i)
+            return
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad)
 

@@ -287,8 +287,13 @@ def create(name="local"):
     if name in ("tpu_ici", "nccl"):
         return TpuIci()
     if name in ("dist_sync", "dist_async", "dist_sync_device", "dist", "p3"):
-        # multi-process tier: requires jax.distributed initialization; in a
-        # single process it degrades to local semantics (reference runs the
+        import os
+        if os.environ.get("DMLC_PS_ROOT_URI"):
+            # real parameter-server tier over TCP (DCN; SURVEY.md §5.8)
+            from .dist import KVStoreDist
+            return KVStoreDist("dist_async" if name == "dist_async"
+                               else "dist_sync")
+        # no cluster env: degrade to local semantics (reference runs the
         # same code path with 1 worker)
         store = TpuIci()
         store._name = name
@@ -296,3 +301,21 @@ def create(name="local"):
     if name in _REGISTRY:
         return _REGISTRY[name]()
     raise ValueError("unknown kvstore type %r" % (name,))
+
+
+def _init_kvstore_server_module():
+    """Run the server/scheduler role when DMLC_ROLE says so
+    (parity: python/mxnet/kvstore/kvstore_server.py:29)."""
+    import os
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server":
+        from .dist import run_server
+        run_server()
+        return True
+    if role == "scheduler":
+        # rendezvous is static (ports assigned by the launcher); the
+        # scheduler just stays alive until the launcher kills it
+        import time
+        while True:
+            time.sleep(1)
+    return False

@@ -1,0 +1,397 @@
+"""Distributed kvstore: TCP parameter server (dist_sync / dist_async).
+
+Parity: reference `src/kvstore/kvstore_dist.h` (worker: PSKV key sharding
+:162, big-array splitting, ZPush/ZPull via ps-lite) and
+`src/kvstore/kvstore_dist_server.h` (KVStoreDistServer :155 —
+DataHandleEx :325 dispatch, ApplyUpdates :346 waiting for
+`ps::NumWorkers()` pushes in sync mode, async applies immediately;
+server-side optimizer via set_updater), driven by DMLC_* env vars
+(`python/mxnet/kvstore/kvstore_server.py:29`).
+
+TPU-native design: the DCN tier of SURVEY.md §5.8.  ps-lite's ZeroMQ RPC
+is replaced with a framed-pickle TCP protocol (zero external deps);
+in-process aggregation before pushing rides XLA (the ICI tier), so only
+one per-host gradient crosses the network — exactly how the reference
+layers CommDevice under kvstore_dist.  Roles come from the same DMLC_*
+envs and are launched by tools/launch.py (dmlc-tracker local-mode
+analog).
+
+Wire protocol: 8-byte big-endian length + pickled dict.
+  {"op": "init"|"push"|"pull"|"barrier"|"set_optimizer"|"stop", ...}
+Sync mode: the server buffers one push per worker per round, then
+aggregates (and applies the optimizer if set); pulls block until the
+puller's round is applied.  Async mode: pushes apply immediately.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+from ..ndarray import ndarray, array as nd_array
+from . import KVStoreBase, _reduce
+
+__all__ = ["KVStoreDist", "KVStoreDistServer", "run_server"]
+
+_LEN = struct.Struct(">Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _env(name, default=None):
+    v = os.environ.get(name)
+    return v if v is not None else default
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+class KVStoreDistServer:
+    """One parameter-server shard (reference kvstore_dist_server.h:155)."""
+
+    def __init__(self, port=None, num_workers=None, sync=None):
+        self.num_workers = int(num_workers
+                               if num_workers is not None
+                               else _env("DMLC_NUM_WORKER", "1"))
+        if sync is None:
+            sync = _env("MXNET_KVSTORE_SYNC", "1") == "1"
+        self.sync = sync
+        self.port = int(port if port is not None
+                        else _env("DMLC_SERVER_PORT",
+                                  _env("DMLC_PS_ROOT_PORT", "9090")))
+        self.store = {}          # key -> onp.ndarray
+        self.updater = None
+        self.buf = {}            # key -> {rank: grad}
+        self.applied_round = {}  # key -> completed rounds
+        self.cond = threading.Condition()
+        self.barrier_count = 0
+        self.barrier_gen = 0
+        self._stop = False
+        self._sock = None
+        self._threads = []
+
+    def serve(self, ready_event=None):
+        """Blocking accept loop (reference server main in
+        kvstore_server.py:74)."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", self.port))
+        self._sock.listen(64)
+        self._sock.settimeout(0.2)
+        if ready_event is not None:
+            ready_event.set()
+        while not self._stop:
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._sock.close()
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop:
+                try:
+                    msg = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # report, don't kill the conn —
+                    # a swallowed server error would hang every sync
+                    # puller waiting on applied_round forever
+                    import traceback
+                    reply = {"ok": False,
+                             "error": "%s\n%s" % (e,
+                                                  traceback.format_exc())}
+                if reply is not None:
+                    _send_msg(conn, reply)
+                if msg.get("op") == "stop":
+                    return
+        finally:
+            conn.close()
+
+    def _handle(self, msg):
+        op = msg["op"]
+        if op == "init":
+            with self.cond:
+                key = msg["key"]
+                if key not in self.store:  # first init wins (worker 0)
+                    self.store[key] = onp.asarray(msg["value"])
+                    self.applied_round[key] = 0
+            return {"ok": True}
+        if op == "push":
+            return self._handle_push(msg)
+        if op == "pull":
+            return self._handle_pull(msg)
+        if op == "barrier":
+            with self.cond:
+                gen = self.barrier_gen
+                self.barrier_count += 1
+                if self.barrier_count == self.num_workers:
+                    self.barrier_count = 0
+                    self.barrier_gen += 1
+                    self.cond.notify_all()
+                else:
+                    while self.barrier_gen == gen and not self._stop:
+                        self.cond.wait(0.2)
+            return {"ok": True}
+        if op == "set_optimizer":
+            from ..optimizer import Updater
+            optimizer = pickle.loads(msg["optimizer"])
+            with self.cond:
+                self.updater = Updater(optimizer)
+            return {"ok": True}
+        if op == "stop":
+            with self.cond:
+                self._stop = True
+                self.cond.notify_all()
+            return {"ok": True}
+        return {"ok": False, "error": "unknown op %r" % op}
+
+    def _apply(self, key, agg):
+        """Aggregate applied: run server-side optimizer or store the sum
+        (reference ApplyUpdates :346 / MergeUpdates)."""
+        if self.updater is not None:
+            weight = nd_array(self.store[key])
+            self.updater(int(key) if key.isdigit() else key,
+                         nd_array(agg), weight)
+            self.store[key] = weight.asnumpy()
+        else:
+            self.store[key] = agg
+        self.applied_round[key] = self.applied_round.get(key, 0) + 1
+
+    def _handle_push(self, msg):
+        key, value, rank = msg["key"], onp.asarray(msg["value"]), msg["rank"]
+        with self.cond:
+            if not self.sync:
+                # async: apply immediately (reference async mode)
+                if self.updater is not None:
+                    self._apply(key, value)
+                else:
+                    base = self.store.get(key)
+                    self.store[key] = value if base is None else base + value
+                    self.applied_round[key] = \
+                        self.applied_round.get(key, 0) + 1
+                self.cond.notify_all()
+                return {"ok": True}
+            self.buf.setdefault(key, {})[rank] = value
+            if len(self.buf[key]) == self.num_workers:
+                vals = list(self.buf[key].values())
+                agg = vals[0]
+                for v in vals[1:]:
+                    agg = agg + v
+                self.buf[key] = {}
+                self._apply(key, agg)
+                self.cond.notify_all()
+        return {"ok": True}
+
+    def _handle_pull(self, msg):
+        key = msg["key"]
+        want_round = msg.get("round", 0)
+        with self.cond:
+            while (self.sync
+                   and self.applied_round.get(key, 0) < want_round
+                   and not self._stop):
+                self.cond.wait(0.2)
+            if key not in self.store:
+                return {"ok": False, "error": "unknown key %r" % key}
+            return {"ok": True, "value": self.store[key]}
+
+
+def run_server():
+    """Run the server role for this process (reference
+    kvstore_server.py:29 _init_kvstore_server_module)."""
+    server = KVStoreDistServer()
+    server.serve()
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+class _ServerConn:
+    """One persistent, locked connection to a server shard."""
+
+    def __init__(self, host, port, timeout=60.0):
+        self.lock = threading.Lock()
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                self.sock = socket.create_connection((host, port),
+                                                     timeout=300)
+                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                     1)
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        raise ConnectionError("cannot reach server %s:%d (%s)"
+                              % (host, port, last))
+
+    def request(self, msg):
+        with self.lock:
+            _send_msg(self.sock, msg)
+            return _recv_msg(self.sock)
+
+    def send_only(self, msg):
+        with self.lock:
+            _send_msg(self.sock, msg)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@KVStoreBase.register
+class KVStoreDist(KVStoreBase):
+    """Worker-side dist store (reference kvstore_dist.h:44).
+
+    Keys are sharded across servers by int(key) % num_servers (the PSKV
+    analog); values pushed are first reduced in-process (ICI tier)."""
+
+    def __init__(self, name="dist_sync"):
+        self._name = name
+        self._sync = not name.endswith("async")
+        self._rank = int(_env("DMLC_WORKER_ID", "0"))
+        self._num_workers = int(_env("DMLC_NUM_WORKER", "1"))
+        self._num_servers = int(_env("DMLC_NUM_SERVER", "1"))
+        host = _env("DMLC_PS_ROOT_URI", "127.0.0.1")
+        base_port = int(_env("DMLC_PS_ROOT_PORT", "9090"))
+        self._conns = [_ServerConn(host, base_port + s)
+                       for s in range(self._num_servers)]
+        self._push_round = {}  # key -> rounds this worker pushed
+
+    # -- plumbing ---------------------------------------------------------
+    def _conn_for(self, key):
+        try:
+            shard = int(key) % self._num_servers
+        except ValueError:
+            shard = hash(key) % self._num_servers
+        return self._conns[shard]
+
+    @property
+    def type(self):
+        return self._name
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    # -- API --------------------------------------------------------------
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        key = str(key)
+        if self._rank == 0:
+            v = value.asnumpy() if isinstance(value, ndarray) else \
+                onp.asarray(value)
+            r = self._conn_for(key).request(
+                {"op": "init", "key": key, "value": v})
+            assert r["ok"], r
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        key = str(key)
+        reduced = _reduce(value) if isinstance(value, (list, tuple)) \
+            else value
+        r = self._conn_for(key).request(
+            {"op": "push", "key": key, "rank": self._rank,
+             "value": reduced.asnumpy()})
+        if not r["ok"]:
+            raise RuntimeError("dist push failed: %s" % r.get("error"))
+        self._push_round[key] = self._push_round.get(key, 0) + 1
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority, ignore_sparse)
+            return
+        key = str(key)
+        r = self._conn_for(key).request(
+            {"op": "pull", "key": key,
+             "round": self._push_round.get(key, 0)})
+        if not r["ok"]:
+            raise KeyError(r.get("error", "pull failed"))
+        value = r["value"]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._set_data(jnp.asarray(value, o._data.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+        return out
+
+    def set_optimizer(self, optimizer):
+        if self._rank == 0:
+            blob = pickle.dumps(optimizer)
+            for c in self._conns:
+                r = c.request({"op": "set_optimizer", "optimizer": blob})
+                assert r["ok"], r
+        self.barrier()
+
+    def barrier(self):
+        # the root server coordinates barriers (reference uses the
+        # scheduler; one shard suffices for correctness)
+        r = self._conns[0].request({"op": "barrier", "rank": self._rank})
+        assert r["ok"], r
+
+    def stop_servers(self):
+        """Ask every server shard to exit (launcher/worker-0 teardown)."""
+        if self._rank == 0:
+            for c in self._conns:
+                try:
+                    c.request({"op": "stop"})
+                except ConnectionError:
+                    pass
+
+    def close(self):
+        for c in self._conns:
+            c.close()

@@ -113,6 +113,9 @@ def Custom(*inputs, op_type=None, **kwargs):
     _, out_dtypes, _ = prop.infer_type(in_dtypes)
     op = prop.create_operator(None, in_shapes2, in_dtypes)
     n_out = len(out_shapes)
+    # captured at call time; under hybridize this is the mode being
+    # traced, and cached graphs are keyed on the training flag
+    # (HybridBlock._signature), so each mode's cache bakes its own value
     is_train = autograd.is_training()
 
     result_spec = tuple(jax.ShapeDtypeStruct(tuple(s), onp.dtype(d))

@@ -1,0 +1,102 @@
+"""Worker script for distributed kvstore tests — launched as real
+processes by tools/launch.py (the reference pattern:
+tests/nightly/dist_sync_kvstore.py run via the dmlc local tracker; no
+mocked network)."""
+import json
+import os
+import sys
+
+import numpy as onp
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import np as mxnp, autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "kv"
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    result = {"rank": rank, "num_workers": nw}
+
+    if mode == "kv":
+        # plain push/pull aggregation
+        kv.init("3", mxnp.ones((2, 3)))
+        out = mxnp.zeros((2, 3))
+        kv.pull("3", out=out)
+        assert (out.asnumpy() == 1).all()
+        kv.push("3", mxnp.ones((2, 3)) * (rank + 1))
+        kv.pull("3", out=out)
+        # sum over ranks: 1+2+...+nw
+        expect = nw * (nw + 1) / 2
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    onp.full((2, 3), expect))
+        # second round
+        kv.push("3", mxnp.ones((2, 3)))
+        kv.pull("3", out=out)
+        onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), nw))
+        # multi-key + barrier
+        kv.init(["10", "11"], [mxnp.zeros(4), mxnp.zeros(4)])
+        kv.barrier()
+        result["kv_ok"] = True
+
+    elif mode == "trainer":
+        # data-parallel training: every worker sees different data, all
+        # replicas must stay bit-identical after N steps
+        mx.random.seed(100 + rank)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+        mx.random.seed(7)  # identical init on every worker
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=kv)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        rng = onp.random.RandomState(1234 + rank)  # different data
+        for step in range(5):
+            x = mxnp.array(rng.rand(8, 6).astype(onp.float32))
+            y = mxnp.array(rng.randint(0, 2, 8).astype(onp.float32))
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+        params = {k: p.data().asnumpy().tolist()
+                  for k, p in net.collect_params().items()}
+        result["params_digest"] = sum(
+            float(onp.abs(onp.asarray(v)).sum()) for v in params.values())
+        result["params"] = params
+
+    elif mode == "server_opt":
+        # update_on_kvstore: optimizer runs server-side
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(4, in_units=3))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore=kv,
+                                update_on_kvstore=True)
+        rng = onp.random.RandomState(99 + rank)
+        for step in range(3):
+            x = mxnp.array(rng.rand(4, 3).astype(onp.float32))
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            trainer.step(4)
+        result["params_digest"] = sum(
+            float(onp.abs(p.data().asnumpy()).sum())
+            for p in net.collect_params().values())
+
+    kv.barrier()
+    with open(os.path.join(out_dir, "worker%d.json" % rank), "w") as f:
+        json.dump(result, f)
+    if mode != "kv":
+        kv.barrier()
+    if rank == 0:
+        kv.stop_servers()
+
+
+if __name__ == "__main__":
+    main()

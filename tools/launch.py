@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Cluster launcher for distributed training.
+
+Parity: reference `tools/launch.py` + dmlc-tracker local launcher
+(spawns scheduler/servers/workers with DMLC_* envs; see
+tests/nightly/test_distributed_training-gpu.sh for the multi-process-on-
+one-host pattern).
+
+Usage:
+  python tools/launch.py -n 2 -s 1 python train.py --kv-store dist_sync
+
+Spawns -s server processes and -n worker processes on this host (the
+`local` launcher; ssh/mpi cluster modes hand the same env contract to a
+remote shell).  Env contract (same names as the reference):
+  DMLC_ROLE          worker | server | scheduler
+  DMLC_PS_ROOT_URI   server host (this host for local mode)
+  DMLC_PS_ROOT_PORT  base port; server shard i listens on port+i
+  DMLC_NUM_WORKER / DMLC_NUM_SERVER
+  DMLC_WORKER_ID / DMLC_SERVER_ID
+  MXNET_KVSTORE_SYNC 1 for dist_sync semantics (default), 0 for async
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--launcher", default="local", choices=["local"])
+    ap.add_argument("--sync-dst-dir", default=None, help="unused (parity)")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--async", dest="async_mode", action="store_true")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    port = args.port or _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+        "MXNET_KVSTORE_SYNC": "0" if args.async_mode else "1",
+    })
+
+    procs = []
+    try:
+        # servers first (workers block connecting until they're up)
+        for sid in range(args.num_servers):
+            env = dict(base_env)
+            env.update({"DMLC_ROLE": "server", "DMLC_SERVER_ID": str(sid),
+                        "DMLC_SERVER_PORT": str(port + sid)})
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 "import mxnet_tpu as mx;"
+                 "mx.kvstore._init_kvstore_server_module()"], env=env))
+        workers = []
+        for wid in range(args.num_workers):
+            env = dict(base_env)
+            env.update({"DMLC_ROLE": "worker", "DMLC_WORKER_ID": str(wid)})
+            workers.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        for w in workers:
+            rc |= w.wait()
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
