@@ -54,6 +54,9 @@ from . import contrib
 from . import operator
 from . import library
 from . import subgraph
+from . import image
+from . import visualization
+from . import callback
 from . import sparse
 from . import symbol
 from . import symbol as sym

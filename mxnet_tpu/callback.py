@@ -1,0 +1,83 @@
+"""Training callbacks (parity: python/mxnet/callback.py — Speedometer,
+do_checkpoint, log_train_metric, ProgressBar)."""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "ProgressBar"]
+
+
+class Speedometer:
+    """Log throughput every `frequent` batches
+    (parity: callback.py Speedometer).  Call with an object exposing
+    .epoch/.nbatch/.eval_metric (BatchEndParam analog)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0
+        self.last_count = 0
+        self.logger = logging.getLogger("mxnet_tpu.speedometer")
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (
+                    param.epoch, count, speed)
+                if param.eval_metric is not None:
+                    name, value = param.eval_metric.get()
+                    msg += "\t%s=%f" % (name, value)
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                self.logger.info(msg)
+                self.last_speed = speed
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end checkpoint callback (parity: callback.py do_checkpoint).
+    Works with objects exposing .net (gluon) — saves parameters."""
+    def _callback(epoch, net=None, *args):
+        if (epoch + 1) % period == 0 and net is not None:
+            net.save_parameters("%s-%04d.params" % (prefix, epoch + 1))
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    logger = logging.getLogger("mxnet_tpu.metric")
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name, value = param.eval_metric.get()
+            logger.info("Iter[%d] Batch[%d] Train-%s=%f",
+                        param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+    return _callback
+
+
+class ProgressBar:
+    """Text progress bar (parity: callback.py ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.total = total
+        self.length = length
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.length * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.length - filled)
+        print("[%s] %s%%" % (bar, pct))

@@ -62,18 +62,32 @@ class Trainer:
                                self._update_on_kvstore):
             # seed the store with the params: multi-worker replicas start
             # identical, and the update-on-kvstore path needs the weights
-            # resident server-side before the first push
+            # resident server-side before the first push.  One batched
+            # broadcast (single barrier) — per-param broadcasts would pay
+            # one cluster barrier per parameter.
             # (reference _init_kvstore broadcast, trainer.py:188)
+            keys, vals, outs = [], [], []
             for i, p in enumerate(self._params):
                 if p._data is not None:
-                    kv.broadcast(str(i), p.data(), out=p.data())
+                    keys.append(str(i))
+                    vals.append(p.data())
+                    outs.append(p.data())
+            if keys:
+                kv.broadcast(keys, vals, out=outs)
         if kv is not None and self._update_on_kvstore:
             # server-side optimizer: workers push pre-scaled grads, the
-            # server runs the update (reference set_updater path)
+            # server runs the update (reference set_updater path).  The
+            # copy keeps per-parameter lr_mult/wd_mult as plain
+            # namespaces (Parameters hold device buffers and don't
+            # pickle).
             import copy
+            from types import SimpleNamespace
             opt = copy.copy(self._optimizer)
             opt.rescale_grad = 1.0
-            opt.param_dict = {}
+            opt.param_dict = {
+                i: SimpleNamespace(lr_mult=getattr(p, "lr_mult", 1.0),
+                                   wd_mult=getattr(p, "wd_mult", 1.0))
+                for i, p in enumerate(self._params)}
             kv.set_optimizer(opt)
 
     @property

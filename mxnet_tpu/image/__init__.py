@@ -1,0 +1,351 @@
+"""mx.image — legacy image loading/augmentation API.
+
+Parity: reference `python/mxnet/image/image.py` (imdecode, imresize,
+resize_short, fixed_crop, center_crop, random_crop, color_normalize,
+Augmenter classes, CreateAugmenter, ImageIter) and `detection.py`
+(detection augmenters).  The decode/resize primitives use cv2/PIL when
+available (as the reference uses OpenCV) with numpy fallbacks; arrays
+are HWC uint8/float32 ndarrays like the reference.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+
+import numpy as onp
+
+from ..ndarray import ndarray, array as nd_array
+from .. import recordio as _recordio
+from ..io import DataIter, DataBatch, DataDesc, _resize_to, _resize_short
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "Augmenter",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "CreateAugmenter", "ImageIter"]
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+def imdecode(buf, to_rgb=1, flag=1, **kwargs):
+    """Decode an encoded image byte buffer → HWC ndarray
+    (parity: image.py imdecode)."""
+    arr = _recordio._decode_img(bytes(buf), 1 if flag else 0)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if to_rgb and arr.shape[-1] == 3:
+        try:
+            import cv2  # cv2 decodes BGR; reference converts to RGB
+            arr = arr[:, :, ::-1]
+        except ImportError:
+            pass
+    return nd_array(onp.ascontiguousarray(arr))
+
+
+def imread(filename, flag=1, to_rgb=1):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), to_rgb=to_rgb, flag=flag)
+
+
+def imresize(src, w, h, interp=1):
+    a = src.asnumpy() if isinstance(src, ndarray) else onp.asarray(src)
+    out = _resize_to(a, h, w)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd_array(out)
+
+
+def resize_short(src, size, interp=1):
+    a = src.asnumpy() if isinstance(src, ndarray) else onp.asarray(src)
+    out = _resize_short(a, size)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd_array(out)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    a = src.asnumpy() if isinstance(src, ndarray) else onp.asarray(src)
+    out = a[y0:y0 + h, x0:x0 + w]
+    if size is not None and (size[0] != w or size[1] != h):
+        out = _resize_to(out, size[1], size[0])
+    return nd_array(out)
+
+
+def center_crop(src, size, interp=1):
+    a = src.asnumpy() if isinstance(src, ndarray) else onp.asarray(src)
+    h, w = a.shape[:2]
+    cw, ch = size
+    x0 = max((w - cw) // 2, 0)
+    y0 = max((h - ch) // 2, 0)
+    out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size)
+    return out, (x0, y0, cw, ch)
+
+
+def random_crop(src, size, interp=1):
+    a = src.asnumpy() if isinstance(src, ndarray) else onp.asarray(src)
+    h, w = a.shape[:2]
+    cw, ch = size
+    x0 = pyrandom.randint(0, max(w - cw, 0))
+    y0 = pyrandom.randint(0, max(h - ch, 0))
+    out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size)
+    return out, (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None):
+    a = src.asnumpy().astype(onp.float32) if isinstance(src, ndarray) \
+        else onp.asarray(src, onp.float32)
+    mean = onp.asarray(mean.asnumpy() if isinstance(mean, ndarray) else mean)
+    a = a - mean
+    if std is not None:
+        std = onp.asarray(std.asnumpy() if isinstance(std, ndarray) else std)
+        a = a / std
+    return nd_array(a)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (parity: image.py Augmenter hierarchy)
+# ---------------------------------------------------------------------------
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size  # (w, h)
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1])
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return nd_array(src.asnumpy()[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class _JitterAug(Augmenter):
+    def __init__(self, jitter):
+        super().__init__(jitter=jitter)
+        self.jitter = jitter
+
+    def _alpha(self):
+        return 1.0 + pyrandom.uniform(-self.jitter, self.jitter)
+
+
+class BrightnessJitterAug(_JitterAug):
+    def __call__(self, src):
+        return nd_array(src.asnumpy().astype(onp.float32) * self._alpha())
+
+
+class ContrastJitterAug(_JitterAug):
+    _coef = onp.array([0.299, 0.587, 0.114], onp.float32)
+
+    def __call__(self, src):
+        a = src.asnumpy().astype(onp.float32)
+        alpha = self._alpha()
+        gray = (a * self._coef).sum(-1, keepdims=True)
+        return nd_array(a * alpha + gray.mean() * (1 - alpha))
+
+
+class SaturationJitterAug(_JitterAug):
+    _coef = onp.array([0.299, 0.587, 0.114], onp.float32)
+
+    def __call__(self, src):
+        a = src.asnumpy().astype(onp.float32)
+        alpha = self._alpha()
+        gray = (a * self._coef).sum(-1, keepdims=True)
+        return nd_array(a * alpha + gray * (1 - alpha))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter pipeline factory (parity: image.py
+    CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std if std is not None
+                                         else onp.ones(3)))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter
+# ---------------------------------------------------------------------------
+class ImageIter(DataIter):
+    """Image iterator over .rec files or an image list
+    (parity: image.py ImageIter :1280).  Produces NCHW float batches."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
+                                           if k in ("resize", "rand_crop",
+                                                    "rand_mirror", "mean",
+                                                    "std")})
+        self.shuffle = shuffle
+        self._recs = None
+        self._list = None
+        if path_imgrec:
+            self._rec_path = str(path_imgrec)
+            reader = _recordio.MXRecordIO(self._rec_path, "r")
+            self._recs = []
+            while True:
+                pos = reader.tell()
+                if reader.read() is None:
+                    break
+                self._recs.append(pos)
+            reader.close()
+            self._reader = _recordio.MXRecordIO(self._rec_path, "r")
+        elif imglist is not None or path_imglist:
+            if path_imglist:
+                imglist = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        imglist.append((float(parts[1]), parts[-1]))
+            self._list = [(lbl, os.path.join(path_root or "", p))
+                          for lbl, p in imglist]
+        else:
+            raise ValueError("need path_imgrec, path_imglist, or imglist")
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        n = len(self._recs) if self._recs is not None else len(self._list)
+        self._order = list(range(n))
+        if self.shuffle:
+            pyrandom.shuffle(self._order)
+        self._cursor = 0
+
+    def _read_example(self, idx):
+        if self._recs is not None:
+            self._reader.seek(self._recs[idx])
+            header, img = _recordio.unpack_img(self._reader.read())
+            label = header.label
+            if isinstance(label, onp.ndarray) and label.size == 1:
+                label = float(label.reshape(-1)[0])
+            return nd_array(img), label
+        label, path = self._list[idx]
+        return imread(path), label
+
+    def next(self):
+        c, h, w = self.data_shape
+        imgs, labels = [], []
+        while len(imgs) < self.batch_size and \
+                self._cursor < len(self._order):
+            img, label = self._read_example(self._order[self._cursor])
+            self._cursor += 1
+            for aug in self.auglist:
+                img = aug(img)
+            a = img.asnumpy().astype(onp.float32)
+            if a.ndim == 2:
+                a = a[:, :, None]
+            if a.shape[-1] != c and c == 3 and a.shape[-1] == 1:
+                a = onp.repeat(a, 3, -1)
+            imgs.append(onp.transpose(a, (2, 0, 1)))
+            labels.append(label)
+        if not imgs:
+            raise StopIteration
+        pad = self.batch_size - len(imgs)
+        while len(imgs) < self.batch_size:
+            imgs.append(imgs[-1])
+            labels.append(labels[-1])
+        return DataBatch([nd_array(onp.stack(imgs))],
+                         [nd_array(onp.asarray(labels, onp.float32))],
+                         pad, None)

@@ -123,6 +123,11 @@ class KVStore(KVStoreBase):
         self._data[str(key)] = value
 
     def broadcast(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            outs = out if out is not None else [None] * len(key)
+            for k, v, o in zip(key, value, outs):
+                self.broadcast(k, v, o, priority)
+            return out
         v = value if isinstance(value, ndarray) else _reduce(value)
         self._data[str(key)] = v
         if out is not None:

@@ -190,9 +190,19 @@ class KVStoreDistServer:
         self.applied_round[key] = self.applied_round.get(key, 0) + 1
 
     def _handle_push(self, msg):
-        key, value, rank = msg["key"], onp.asarray(msg["value"]), msg["rank"]
+        key, rank = msg["key"], msg["rank"]
+        if msg.get("compressed"):
+            from .gradient_compression import GradientCompression
+            value = GradientCompression.decompress(
+                onp.asarray(msg["value"]), msg["meta"])
+        else:
+            value = onp.asarray(msg["value"])
+        # the worker's store type decides sync vs async per message
+        # (create('dist_async') must not silently run synchronous); the
+        # launcher env is only the default for old-style pushes
+        sync = msg.get("sync", self.sync)
         with self.cond:
-            if not self.sync:
+            if not sync:
                 # async: apply immediately (reference async mode)
                 if self.updater is not None:
                     self._apply(key, value)
@@ -203,13 +213,19 @@ class KVStoreDistServer:
                         self.applied_round.get(key, 0) + 1
                 self.cond.notify_all()
                 return {"ok": True}
-            self.buf.setdefault(key, {})[rank] = value
-            if len(self.buf[key]) == self.num_workers:
-                vals = list(self.buf[key].values())
-                agg = vals[0]
-                for v in vals[1:]:
-                    agg = agg + v
-                self.buf[key] = {}
+            # per-rank queues: a worker may push the same key again before
+            # the round completes; overwriting would lose a gradient and
+            # desync rounds forever
+            q = self.buf.setdefault(key, {})
+            q.setdefault(rank, []).append(value)
+            while len(q) == self.num_workers and \
+                    all(len(v) > 0 for v in q.values()):
+                agg = None
+                for r in list(q):
+                    v = q[r].pop(0)
+                    agg = v if agg is None else agg + v
+                    if not q[r]:
+                        del q[r]
                 self._apply(key, agg)
                 self.cond.notify_all()
         return {"ok": True}
@@ -291,13 +307,24 @@ class KVStoreDist(KVStoreBase):
         self._conns = [_ServerConn(host, base_port + s)
                        for s in range(self._num_servers)]
         self._push_round = {}  # key -> rounds this worker pushed
+        self._gc = None  # optional GradientCompression
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit/1-bit push compression with error feedback
+        (parity: KVStore::SetGradientCompression, gradient_compression.h)."""
+        from .gradient_compression import GradientCompression
+        params = dict(compression_params or {})
+        self._gc = GradientCompression(
+            type=params.get("type", "2bit"),
+            threshold=float(params.get("threshold", 0.5)))
 
     # -- plumbing ---------------------------------------------------------
     def _conn_for(self, key):
         try:
             shard = int(key) % self._num_servers
         except ValueError:
-            shard = hash(key) % self._num_servers
+            import zlib  # stable across processes (hash() is randomized)
+            shard = zlib.crc32(key.encode()) % self._num_servers
         return self._conns[shard]
 
     @property
@@ -314,17 +341,18 @@ class KVStoreDist(KVStoreBase):
 
     # -- API --------------------------------------------------------------
     def init(self, key, value):
-        if isinstance(key, (list, tuple)):
-            for k, v in zip(key, value):
-                self.init(k, v)
-            return
-        key = str(key)
+        # batched: all inits then ONE barrier (per-key barriers dominate
+        # startup for models with many parameters)
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(key, (list, tuple)) else [value]
         if self._rank == 0:
-            v = value.asnumpy() if isinstance(value, ndarray) else \
-                onp.asarray(value)
-            r = self._conn_for(key).request(
-                {"op": "init", "key": key, "value": v})
-            assert r["ok"], r
+            for k, v in zip(keys, values):
+                k = str(k)
+                v = v.asnumpy() if isinstance(v, ndarray) else \
+                    onp.asarray(v)
+                r = self._conn_for(k).request(
+                    {"op": "init", "key": k, "value": v})
+                assert r["ok"], r
         self.barrier()
 
     def push(self, key, value, priority=0):
@@ -335,9 +363,15 @@ class KVStoreDist(KVStoreBase):
         key = str(key)
         reduced = _reduce(value) if isinstance(value, (list, tuple)) \
             else value
-        r = self._conn_for(key).request(
-            {"op": "push", "key": key, "rank": self._rank,
-             "value": reduced.asnumpy()})
+        if self._gc is not None:
+            packed, meta = self._gc.compress(key, reduced.asnumpy())
+            msg = {"op": "push", "key": key, "rank": self._rank,
+                   "value": packed, "meta": meta, "compressed": True,
+                   "sync": self._sync}
+        else:
+            msg = {"op": "push", "key": key, "rank": self._rank,
+                   "value": reduced.asnumpy(), "sync": self._sync}
+        r = self._conn_for(key).request(msg)
         if not r["ok"]:
             raise RuntimeError("dist push failed: %s" % r.get("error"))
         self._push_round[key] = self._push_round.get(key, 0) + 1

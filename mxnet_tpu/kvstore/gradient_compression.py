@@ -1,0 +1,89 @@
+"""Gradient compression: 1-bit / 2-bit quantization with error feedback.
+
+Parity: reference `src/kvstore/gradient_compression.{h,cc,cu}`
+(CompressionType :38 — OneBit/TwoBit; Quantize/Dequantize :117-127;
+residual error feedback kept worker-side) applied on dist pushes,
+configured via `kvstore.set_gradient_compression({'type': '2bit',
+'threshold': t})`.
+
+TPU-native: compression runs in numpy at the network boundary (the DCN
+hop is the bandwidth bottleneck it exists for — on-chip ICI reductions
+ride XLA uncompressed, like the reference compresses only dist pushes).
+2-bit packs 4 values/byte {0: zero, 1: +threshold, 2: -threshold};
+1-bit packs 8 values/byte {sign}, dequantizing to ±threshold.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["GradientCompression"]
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):  # noqa: A002
+        if type not in ("1bit", "2bit"):
+            raise ValueError("compression type must be '1bit' or '2bit'")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residual = {}  # key -> error feedback
+
+    # -- worker side ------------------------------------------------------
+    def compress(self, key, grad):
+        """grad (numpy) → (packed uint8, meta).  Residual accumulates the
+        quantization error (reference error feedback)."""
+        g = grad.astype(onp.float32)
+        r = self._residual.get(key)
+        if r is None:
+            r = onp.zeros_like(g)
+        g = g + r
+        t = self.threshold
+        if self.type == "2bit":
+            pos = g >= t
+            neg = g <= -t
+            q = onp.zeros(g.shape, onp.uint8)
+            q[pos] = 1
+            q[neg] = 2
+            deq = onp.where(pos, t, onp.where(neg, -t, 0.0)).astype(
+                onp.float32)
+            packed = _pack_base4(q.ravel())
+        else:  # 1bit: sign quantization around 0 → ±threshold
+            pos = g >= 0
+            q = pos.astype(onp.uint8)
+            deq = onp.where(pos, t, -t).astype(onp.float32)
+            packed = onp.packbits(q.ravel())
+        self._residual[key] = g - deq
+        meta = {"type": self.type, "threshold": t, "shape": g.shape}
+        return packed, meta
+
+    # -- server side ------------------------------------------------------
+    @staticmethod
+    def decompress(packed, meta):
+        t = meta["threshold"]
+        shape = tuple(meta["shape"])
+        n = int(onp.prod(shape)) if shape else 1
+        if meta["type"] == "2bit":
+            q = _unpack_base4(packed, n)
+            out = onp.where(q == 1, t, onp.where(q == 2, -t, 0.0))
+        else:
+            bits = onp.unpackbits(packed)[:n]
+            out = onp.where(bits == 1, t, -t)
+        return out.astype(onp.float32).reshape(shape)
+
+
+def _pack_base4(q):
+    """Pack values in {0,1,2,3} at 4 per byte."""
+    pad = (-len(q)) % 4
+    if pad:
+        q = onp.concatenate([q, onp.zeros(pad, onp.uint8)])
+    q = q.reshape(-1, 4)
+    return (q[:, 0] | (q[:, 1] << 2) | (q[:, 2] << 4)
+            | (q[:, 3] << 6)).astype(onp.uint8)
+
+
+def _unpack_base4(p, n):
+    out = onp.empty((len(p), 4), onp.uint8)
+    out[:, 0] = p & 3
+    out[:, 1] = (p >> 2) & 3
+    out[:, 2] = (p >> 4) & 3
+    out[:, 3] = (p >> 6) & 3
+    return out.ravel()[:n]

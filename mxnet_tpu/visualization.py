@@ -1,0 +1,70 @@
+"""Network visualization (parity: python/mxnet/visualization.py —
+print_summary tabular layer listing; plot_network degrades gracefully
+without graphviz)."""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _param_count(block):
+    total = 0
+    for p in block._reg_params.values():
+        if p.shape and all(s > 0 for s in p.shape):
+            total += int(onp.prod(p.shape))
+    return total
+
+
+def print_summary(block, input_shape=None, line_length=88):
+    """Print a per-layer summary table for a Gluon block
+    (parity: visualization.py print_summary; the reference walks the
+    symbol graph, here the block tree).  Returns total param count."""
+    rows = []
+
+    def walk(b, name, depth):
+        own = _param_count(b)
+        shapes = {n: tuple(p.shape) for n, p in b._reg_params.items()}
+        rows.append(("  " * depth + (name or type(b).__name__),
+                     type(b).__name__, own, shapes))
+        for cname, child in b._children.items():
+            walk(child, cname, depth + 1)
+
+    walk(block, type(block).__name__, 0)
+    sep = "=" * line_length
+    print(sep)
+    print("%-40s %-20s %12s" % ("Layer", "Type", "Params"))
+    print(sep)
+    total = 0
+    for name, typ, count, shapes in rows:
+        total += count
+        extra = " ".join("%s%s" % (n, s) for n, s in shapes.items())
+        print("%-40s %-20s %12d  %s" % (name[:40], typ[:20], count,
+                                        extra[:40]))
+    print(sep)
+    print("Total params: %d" % total)
+    print(sep)
+    return total
+
+
+def plot_network(block, title="plot", save_format="pdf", shape=None,
+                 **kwargs):
+    """Graphviz rendering when available (parity: plot_network)."""
+    try:
+        import graphviz
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the graphviz package; "
+            "use print_summary for a text rendering") from e
+    dot = graphviz.Digraph(name=title)
+
+    def walk(b, name, parent):
+        nid = name or type(b).__name__
+        dot.node(nid, "%s\n%s" % (nid, type(b).__name__), shape="box")
+        if parent:
+            dot.edge(parent, nid)
+        for cname, child in b._children.items():
+            walk(child, "%s.%s" % (nid, cname), nid)
+
+    walk(block, type(block).__name__, None)
+    return dot

@@ -69,6 +69,21 @@ def main():
             float(onp.abs(onp.asarray(v)).sum()) for v in params.values())
         result["params"] = params
 
+    elif mode == "gc":
+        # compressed pushes over the wire: each worker pushes a gradient
+        # quantized to ±threshold with error feedback
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("7", mxnp.zeros(8))
+        g = onp.full(8, 0.7 if rank == 0 else -0.7, onp.float32)
+        kv.push("7", mxnp.array(g))
+        out = mxnp.zeros(8)
+        kv.pull("7", out=out)
+        # each worker's quantized push is ±0.5 → sum over 2 workers = 0
+        expect = 0.0 if nw == 2 else None
+        if expect is not None:
+            onp.testing.assert_allclose(out.asnumpy(), expect, atol=1e-6)
+        result["gc_ok"] = True
+
     elif mode == "server_opt":
         # update_on_kvstore: optimizer runs server-side
         mx.random.seed(7)

@@ -1,0 +1,144 @@
+"""mx.image, visualization, callback, gradient compression tests
+(reference: tests/python/unittest/test_image.py patterns)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp, image as mimg, recordio
+from mxnet_tpu.gluon import nn
+
+
+def _img(h=16, w=12, c=3, seed=0):
+    rng = onp.random.RandomState(seed)
+    return (rng.rand(h, w, c) * 255).astype(onp.uint8)
+
+
+def test_imresize_and_resize_short():
+    a = mimg.imresize(mxnp.array(_img()), 6, 8)
+    assert a.shape == (8, 6, 3)
+    b = mimg.resize_short(mxnp.array(_img(16, 12)), 8)
+    assert min(b.shape[:2]) == 8
+
+
+def test_crops():
+    src = mxnp.array(_img(16, 16))
+    out, rect = mimg.center_crop(src, (8, 8))
+    assert out.shape == (8, 8, 3)
+    assert rect == (4, 4, 8, 8)
+    out, rect = mimg.random_crop(src, (8, 8))
+    assert out.shape == (8, 8, 3)
+    fc = mimg.fixed_crop(src, 2, 3, 4, 5)
+    onp.testing.assert_array_equal(fc.asnumpy(),
+                                   src.asnumpy()[3:8, 2:6])
+
+
+def test_color_normalize():
+    src = mxnp.array(_img())
+    out = mimg.color_normalize(src, mean=onp.array([10., 20., 30.]),
+                               std=onp.array([2., 2., 2.]))
+    ref = (src.asnumpy().astype(onp.float32)
+           - onp.array([10., 20., 30.])) / 2.0
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+
+def test_augmenters():
+    src = mxnp.array(_img(20, 20))
+    for aug in mimg.CreateAugmenter((3, 8, 8), rand_crop=True,
+                                    rand_mirror=True, mean=True, std=True,
+                                    brightness=0.1, contrast=0.1,
+                                    saturation=0.1):
+        src = aug(src)
+    assert src.shape[:2] == (8, 8)
+    assert src.dtype == onp.float32
+
+
+def test_image_iter_from_rec(tmp_path):
+    rec = str(tmp_path / "imgs.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(10):
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i % 2), i, 0),
+                                  _img(14, 14, seed=i)))
+    w.close()
+    it = mimg.ImageIter(4, (3, 10, 10), path_imgrec=rec, shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 10, 10)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_iter_from_imglist(tmp_path):
+    try:
+        from PIL import Image
+    except ImportError:
+        pytest.skip("needs PIL")
+    paths = []
+    for i in range(4):
+        p = str(tmp_path / ("i%d.png" % i))
+        Image.fromarray(_img(10, 10, seed=i)).save(p)
+        paths.append((float(i % 2), p))
+    it = mimg.ImageIter(2, (3, 8, 8), imglist=paths)
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 8, 8)
+
+
+def test_print_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    total = mx.visualization.print_summary(net)
+    out = capsys.readouterr().out
+    assert "Dense" in out
+    assert total == (8 * 16 + 16) + (16 * 4 + 4)
+
+
+def test_speedometer(caplog):
+    sm = mx.callback.Speedometer(batch_size=32, frequent=2)
+
+    class P:
+        epoch = 0
+        nbatch = 0
+        eval_metric = None
+    p = P()
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.speedometer"):
+        for i in range(1, 5):
+            p.nbatch = i
+            sm(p)
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+@pytest.mark.parametrize("ctype", ["2bit", "1bit"])
+def test_gradient_compression_roundtrip(ctype):
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    gc = GradientCompression(type=ctype, threshold=0.5)
+    rng = onp.random.RandomState(0)
+    g = rng.randn(37).astype(onp.float32)
+    packed, meta = gc.compress("k", g)
+    # compression ratio: 2bit → 4x less than int8; 1bit → 8x
+    assert packed.dtype == onp.uint8
+    assert len(packed) <= (len(g) + 7) // (4 if ctype == "2bit" else 8) + 1
+    deq = GradientCompression.decompress(packed, meta)
+    assert deq.shape == g.shape
+    assert set(onp.unique(deq)) <= {-0.5, 0.0, 0.5}
+    # error feedback: residual carries the difference
+    onp.testing.assert_allclose(gc._residual["k"], g - deq, atol=1e-6)
+
+
+def test_gradient_compression_error_feedback_converges():
+    """With error feedback, the *accumulated* dequantized sum tracks the
+    accumulated gradient (the property that makes 2-bit training work)."""
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    gc = GradientCompression(type="2bit", threshold=0.1)
+    rng = onp.random.RandomState(1)
+    total_g = onp.zeros(16)
+    total_d = onp.zeros(16)
+    for _ in range(300):
+        g = rng.randn(16).astype(onp.float32) * 0.05
+        packed, meta = gc.compress("k", g)
+        total_g += g
+        total_d += GradientCompression.decompress(packed, meta)
+    # residual is bounded by the threshold
+    assert onp.abs(total_g - total_d).max() <= 0.1 + 1e-6
