@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Distributed data-parallel training with dist_sync kvstore (parity:
+reference example/distributed_training/cifar10_dist.py).
+
+Launch:
+  python tools/launch.py -n 2 -s 1 \
+      python example/distributed_training/cifar10_dist.py --epochs 1
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="per-worker batch size")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--kvstore", default="dist_sync")
+    ap.add_argument("--max-batches", type=int, default=8)
+    args = ap.parse_args()
+
+    kv = mx.kv.create(args.kvstore)
+    print("worker %d/%d" % (kv.rank, kv.num_workers))
+
+    mx.random.seed(42)  # identical init on every worker
+    net = get_model(args.model, classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9},
+                            kvstore=kv)
+
+    # each worker trains on ITS shard (CIFAR10 when present, else synthetic)
+    try:
+        tf = gluon.data.vision.transforms.ToTensor()
+        ds = gluon.data.vision.CIFAR10(train=True).transform_first(tf)
+    except Exception:
+        ds = None
+    rng = onp.random.RandomState(1000 + kv.rank)
+
+    for epoch in range(args.epochs):
+        tic = time.time()
+        n_img = 0
+        for i in range(args.max_batches):
+            if ds is not None:
+                idx = rng.randint(0, len(ds), args.batch_size)
+                xs = onp.stack([ds[j][0].asnumpy() for j in idx])
+                ys = onp.array([float(ds[j][1]) for j in idx], onp.float32)
+            else:
+                xs = rng.rand(args.batch_size, 3, 32, 32).astype(onp.float32)
+                ys = rng.randint(0, 10, args.batch_size).astype(onp.float32)
+            x, y = mxnp.array(xs), mxnp.array(ys)
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            n_img += args.batch_size
+        mx.waitall()
+        print("worker %d epoch %d: %.1f img/s (aggregate throughput = "
+              "x%d workers)" % (kv.rank, epoch,
+                                n_img / (time.time() - tic),
+                                kv.num_workers))
+    kv.barrier()
+    if kv.rank == 0 and hasattr(kv, "stop_servers"):
+        kv.stop_servers()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Profiler usage (parity: reference example/profiler/profiler_executor.py
+family): scoped host events + chrome-trace dump, with the XLA device
+trace (xplane) enabled by config when a directory is given.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, profiler
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.gluon import nn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="profile.json")
+    ap.add_argument("--xplane-dir", default=None,
+                    help="also capture an XLA device trace here")
+    args = ap.parse_args()
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    cfg = {"filename": args.out}
+    if args.xplane_dir:
+        cfg["xplane_dir"] = args.xplane_dir
+    profiler.set_config(**cfg)
+    profiler.start()
+
+    x = mxnp.random.uniform(size=(32, 20))
+    y = mxnp.random.randint(0, 10, size=(32,))
+    for step in range(5):
+        with profiler.Task("train_step_%d" % step):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(32)
+    mx.waitall()
+    profiler.stop()
+    path = profiler.dump()
+    print("chrome trace written to", path,
+          "(open in chrome://tracing or perfetto)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""INT8 post-training quantization driver (parity: reference
+example/quantization/imagenet_gen_qsym_onednn.py + imagenet_inference.py
+collapsed into one Gluon-era script).
+
+Calibrates a model-zoo network on sample data, quantizes Dense/Conv to
+int8, and reports accuracy agreement + throughput vs fp32.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--calib-mode", default="entropy",
+                    choices=["naive", "entropy"])
+    ap.add_argument("--num-calib-batches", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--image-shape", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    net = get_model(args.model, classes=10)
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(0)
+
+    def batch():
+        return mxnp.array(rng.rand(args.batch_size, 3, args.image_shape,
+                                   args.image_shape).astype(onp.float32))
+
+    x = batch()
+    ref = net(x).asnumpy()
+    t0 = time.time()
+    for _ in range(args.iters):
+        net(x).wait_to_read()
+    fp32_ips = args.iters * args.batch_size / (time.time() - t0)
+
+    calib = [batch() for _ in range(args.num_calib_batches)]
+    q.quantize_net(net, calib_data=calib, calib_mode=args.calib_mode)
+    out = net(x).asnumpy()
+    agree = (out.argmax(1) == ref.argmax(1)).mean()
+
+    net(x).wait_to_read()
+    t0 = time.time()
+    for _ in range(args.iters):
+        net(x).wait_to_read()
+    int8_ips = args.iters * args.batch_size / (time.time() - t0)
+
+    print("calib_mode=%s  top1 agreement=%.3f" % (args.calib_mode, agree))
+    print("fp32: %.1f img/s   int8: %.1f img/s" % (fp32_ips, int8_ips))
+
+
+if __name__ == "__main__":
+    main()

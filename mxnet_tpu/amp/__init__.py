@@ -76,29 +76,44 @@ def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
     if cast_params_offline:
         block.cast(dt)
         return block
-    return _AmpWrapper(block, dt)
+    # the scope's op-set: the default bf16 list plus user overrides
+    # (reference target_dtype_ops/fp32_ops arguments, amp.py:670)
+    opset = set(lists.TARGET_DTYPE_OPS)
+    opset |= set(target_dtype_ops or [])
+    opset -= set(fp32_ops or [])
+    opset -= set(excluded_sym_names or [])
+    return _AmpWrapper(block, dt, frozenset(opset))
 
 
 class _AmpWrapper:
-    """Wraps a block: casts inputs to the target dtype, output back to
-    fp32."""
+    """Wraps a block: activates the AMP op-list scope during forward —
+    MXU-bound ops (matmul/conv) cast operands to the target dtype while
+    parameters stay fp32 master copies (reference FP16/BF16 op-list
+    design, amp/lists/symbol_bf16.py); outputs return as fp32."""
 
-    def __init__(self, block, dtype):
+    def __init__(self, block, dtype, opset=None):
         self._block = block
         self._dtype = dtype
+        self._opset = opset if opset is not None \
+            else frozenset(lists.TARGET_DTYPE_OPS)
 
     def __getattr__(self, name):
         return getattr(self._block, name)
 
     def __call__(self, *args):
-        cast_args = [a.astype(self._dtype) if isinstance(a, ndarray)
-                     and a.dtype.kind == "f" else a for a in args]
-        out = self._block(*cast_args)
+        from ..ops import nn as _ops_nn
+        prev = _ops_nn._amp_state()
+        _ops_nn._amp_set((self._dtype, self._opset))
+        try:
+            out = self._block(*args)
+        finally:
+            _ops_nn._amp_set(prev)
         if isinstance(out, ndarray):
-            return out.astype(onp.float32)
+            return out.astype(onp.float32) if out.dtype != onp.float32 \
+                else out
         if isinstance(out, (list, tuple)):
             return type(out)(o.astype(onp.float32) if isinstance(o, ndarray)
-                             else o for o in out)
+                             and o.dtype != onp.float32 else o for o in out)
         return out
 
 

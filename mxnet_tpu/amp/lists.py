@@ -3,10 +3,13 @@ symbol_bf16.py).  On TPU the compiler decides per-fusion precision; these
 lists drive convert_hybrid_block's per-op casting decisions for parity."""
 
 # ops that are safe & profitable in low precision (matmul/conv family —
-# FP16_FUNCS analog, lists/symbol_fp16.py:25)
+# FP16_FUNCS analog, lists/symbol_fp16.py:25).  Generic math entry points
+# (np.dot/np.matmul) intentionally stay fp32: they serve loss/metric math
+# as much as NN layers; the NN-layer MXU ops below are the ones the
+# op-list scope casts.
 TARGET_DTYPE_OPS = [
-    "fully_connected", "convolution", "deconvolution", "batch_dot", "dot",
-    "matmul", "einsum", "interleaved_matmul_selfatt_qk",
+    "fully_connected", "convolution", "deconvolution", "batch_dot",
+    "einsum", "interleaved_matmul_selfatt_qk",
     "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
     "interleaved_matmul_encdec_valatt", "flash_attention", "rnn",
 ]

@@ -24,6 +24,10 @@ class LossScaler:
         ok = npx.all_finite(*grads)
         return not bool(ok)
 
+    @property
+    def scale_window(self):
+        return self._scale_window
+
     def update_scale(self, overflow):
         if overflow:
             self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)

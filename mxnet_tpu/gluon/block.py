@@ -308,7 +308,11 @@ class HybridBlock(Block):
     # -- the cached-graph machinery ---------------------------------------
     def _signature(self, flat_inputs):
         training = autograd.is_training()
-        return (tuple((a.shape, str(a.dtype)) for a in flat_inputs), training)
+        from ..ops import nn as _ops_nn
+        amp = _ops_nn._amp_state()  # amp scope traces its own graph
+        amp_key = (str(amp[0]), amp[1]) if amp is not None else None
+        return (tuple((a.shape, str(a.dtype)) for a in flat_inputs),
+                training, amp_key)
 
     def _build_cache(self, args, kwargs, flat_inputs):
         """Trace forward into a jitted pure function.

@@ -166,7 +166,12 @@ def fix(x, out=None):
 # einsum: operands after the subscript string
 def einsum(subscripts, *operands, **kwargs):
     kwargs.pop("optimize", None)
-    return apply_op(lambda *ops: jnp.einsum(subscripts, *ops), *operands)
+
+    def f(*ops):
+        from ..ops.nn import _amp_cast1
+        ops = [_amp_cast1("einsum", o) for o in ops]
+        return jnp.einsum(subscripts, *ops)
+    return apply_op(f, *operands)
 
 
 def sigmoid(x):
@@ -606,3 +611,33 @@ def kaiser(M, beta, dtype=None, ctx=None, device=None):
 
 def require(a, dtype=None, requirements=None):
     return asarray(a, dtype=dtype)
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    """Trapezoidal integration (parity: np.trapz via numpy fallback list,
+    python/mxnet/numpy/fallback.py)."""
+    fn = getattr(jnp, "trapezoid", None) or getattr(jnp, "trapz")
+    if x is not None:
+        return apply_op(lambda yy, xx: fn(yy, xx, axis=axis), y, x)
+    return apply_op(lambda yy: fn(yy, dx=dx, axis=axis), y)
+
+
+def polyadd(a1, a2):
+    return apply_op(jnp.polyadd, asarray(a1), asarray(a2))
+
+
+def polysub(a1, a2):
+    return apply_op(jnp.polysub, asarray(a1), asarray(a2))
+
+
+def polymul(a1, a2):
+    return apply_op(jnp.polymul, asarray(a1), asarray(a2))
+
+
+def polydiv(u, v):
+    return apply_op(jnp.polydiv, asarray(u), asarray(v))
+
+
+def roots(p):
+    """Polynomial roots (host LAPACK path like the reference fallback)."""
+    return array(onp.roots(onp.asarray(_unwrap(asarray(p)))))

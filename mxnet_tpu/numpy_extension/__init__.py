@@ -284,6 +284,8 @@ def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
 
     if mode == "lstm":
         def f(x, params, h0, c0):
+            from ..ops.nn import _amp_cast2
+            x, params = _amp_cast2("rnn", x, params)
             out, hT, cT = _rnn.rnn_forward(
                 x, params, h0, c0, mode, state_size, num_layers,
                 bidirectional, p if autograd.is_training() else 0.0, dropout_key)
@@ -293,6 +295,8 @@ def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
         return (out, hT, cT) if state_outputs else out
 
     def f(x, params, h0):
+        from ..ops.nn import _amp_cast2
+        x, params = _amp_cast2("rnn", x, params)
         out, hT, _ = _rnn.rnn_forward(
             x, params, h0, None, mode, state_size, num_layers,
             bidirectional, p if autograd.is_training() else 0.0, dropout_key)
@@ -304,22 +308,30 @@ def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
 
 # -- attention --------------------------------------------------------------
 def interleaved_matmul_selfatt_qk(queries_keys_values, heads, **kw):
-    return apply_op(lambda x: _att.interleaved_matmul_selfatt_qk(x, heads),
+    from ..ops.nn import _amp_cast1
+    return apply_op(lambda x: _att.interleaved_matmul_selfatt_qk(
+        _amp_cast1("interleaved_matmul_selfatt_qk", x), heads),
                     queries_keys_values)
 
 
 def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads, **kw):
-    return apply_op(lambda x, a: _att.interleaved_matmul_selfatt_valatt(x, a, heads),
+    from ..ops.nn import _amp_cast2
+    return apply_op(lambda x, a: _att.interleaved_matmul_selfatt_valatt(
+        *_amp_cast2("interleaved_matmul_selfatt_valatt", x, a), heads),
                     queries_keys_values, attention)
 
 
 def interleaved_matmul_encdec_qk(queries, keys_values, heads, **kw):
-    return apply_op(lambda q, kv: _att.interleaved_matmul_encdec_qk(q, kv, heads),
+    from ..ops.nn import _amp_cast2
+    return apply_op(lambda q, kv: _att.interleaved_matmul_encdec_qk(
+        *_amp_cast2("interleaved_matmul_encdec_qk", q, kv), heads),
                     queries, keys_values)
 
 
 def interleaved_matmul_encdec_valatt(keys_values, attention, heads, **kw):
-    return apply_op(lambda kv, a: _att.interleaved_matmul_encdec_valatt(kv, a, heads),
+    from ..ops.nn import _amp_cast2
+    return apply_op(lambda kv, a: _att.interleaved_matmul_encdec_valatt(
+        *_amp_cast2("interleaved_matmul_encdec_valatt", kv, a), heads),
                     keys_values, attention)
 
 
@@ -328,9 +340,14 @@ def flash_attention(q, k, v, causal=False, window=None, scale=None, **kw):
 
     O(L) memory via the Pallas kernel (ops/pallas/flash_attention.py);
     this supersedes the reference's interleaved_matmul_* + softmax chain."""
-    return apply_op(lambda a, b, c: _att.flash_attention(a, b, c, causal=causal,
-                                                         window=window, scale=scale),
-                    q, k, v)
+    from ..ops.nn import _amp_cast1
+    def f(a, b, c):
+        a = _amp_cast1("flash_attention", a)
+        b = _amp_cast1("flash_attention", b)
+        c = _amp_cast1("flash_attention", c)
+        return _att.flash_attention(a, b, c, causal=causal,
+                                    window=window, scale=scale)
+    return apply_op(f, q, k, v)
 
 
 def sldwin_atten(q, k, v, window, symmetric=True, **kw):
@@ -345,6 +362,8 @@ def batch_dot(a, b, transpose_a=False, transpose_b=False, **kw):
             x = jnp.swapaxes(x, -1, -2)
         if transpose_b:
             y = jnp.swapaxes(y, -1, -2)
+        from ..ops.nn import _amp_cast2
+        x, y = _amp_cast2("batch_dot", x, y)
         return jnp.matmul(x, y)
 
     return apply_op(f, a, b)

@@ -16,6 +16,40 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# AMP hook: when mxnet_tpu.amp activates a scope (thread-local — a
+# concurrent fp32 model on another thread must not be affected), ops
+# listed in the scope's op-set (amp/lists.py TARGET_DTYPE_OPS plus user
+# overrides) cast operands to the scope dtype; everything else stays at
+# fp32 master precision.
+import threading as _threading
+
+_AMP = _threading.local()
+
+
+def _amp_state():
+    """(dtype, frozenset(op_names)) when an AMP scope is active."""
+    return getattr(_AMP, "state", None)
+
+
+def _amp_set(state):
+    _AMP.state = state
+
+
+def _amp_cast2(op, a, b):
+    st = _amp_state()
+    if st is not None and op in st[1] and \
+            jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+        return jnp.asarray(a).astype(st[0]), jnp.asarray(b).astype(st[0])
+    return a, b
+
+
+def _amp_cast1(op, a):
+    st = _amp_state()
+    if st is not None and op in st[1] and \
+            jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+        return jnp.asarray(a).astype(st[0])
+    return a
+
 
 # --------------------------------------------------------------------------
 # activations (src/operator/nn/activation.cc, leaky_relu.cc)
@@ -144,6 +178,7 @@ def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
     else:
         x2 = x
     # weight layout (num_hidden, in_units), matching the reference
+    x2, weight = _amp_cast2("fully_connected", x2, weight)
     y = jnp.matmul(x2, weight.T)
     if bias is not None and not no_bias:
         y = y + bias
@@ -183,6 +218,7 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _tup(dilate or 1, ndim)
     pad = _tup(pad or 0, ndim)
     dn, layout = _conv_dn(ndim, layout)
+    x, weight = _amp_cast2("convolution", x, weight)
     out = lax.conv_general_dilated(
         x, weight,
         window_strides=stride,
@@ -205,6 +241,7 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     as the gradient of convolution: lhs-dilated conv_general_dilated with
     the kernel spatially flipped and channel dims swapped.  Weight layout
     matches the reference: (in_channels, channels//groups, *k)."""
+    x, weight = _amp_cast2("deconvolution", x, weight)
     ndim = x.ndim - 2
     stride = _tup(stride or 1, ndim)
     dilate = _tup(dilate or 1, ndim)

@@ -1,0 +1,135 @@
+"""AMP tests (reference: tests/python/gpu/test_amp.py adapted to the
+bf16-first TPU design)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.gluon import nn
+
+
+def setup_module():
+    mx.random.seed(11)
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, activation="relu"),
+            nn.Flatten(), nn.Dense(8), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return net
+
+
+def test_convert_hybrid_block_bf16_close():
+    net = _net()
+    x = mxnp.random.uniform(size=(2, 3, 8, 8))
+    ref = net(x).asnumpy()
+    amp_net = amp.convert_hybrid_block(net)
+    out = amp_net(x)
+    assert out.dtype == onp.float32  # outputs come back fp32
+    rel = onp.abs(out.asnumpy() - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert 0 < rel < 0.02  # bf16 differs but stays close
+
+
+def test_amp_and_fp32_graphs_are_isolated():
+    net = _net()
+    x = mxnp.random.uniform(size=(2, 3, 8, 8))
+    ref = net(x).asnumpy()
+    amp_net = amp.convert_hybrid_block(net)
+    amp_net(x)
+    back = net(x).asnumpy()
+    onp.testing.assert_array_equal(back, ref)  # fp32 graph untouched
+
+
+def test_amp_training_converges():
+    net = _net()
+    amp_net = amp.convert_hybrid_block(net)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = onp.random.RandomState(0)
+    x = mxnp.array(rng.rand(16, 3, 8, 8).astype(onp.float32))
+    y = mxnp.array(rng.randint(0, 3, 16).astype(onp.float32))
+    first = last = None
+    for _ in range(30):
+        with autograd.record():
+            loss = loss_fn(amp_net(x), y).mean()
+        loss.backward()
+        trainer.step(16)
+        v = float(loss.asnumpy())
+        if first is None:
+            first = v
+        last = v
+    assert last < first * 0.5
+    # master weights stayed fp32
+    for p in net.collect_params().values():
+        assert p.data().dtype == onp.float32
+
+
+def test_cast_params_offline():
+    net = _net()
+    net(mxnp.random.uniform(size=(1, 3, 8, 8)))  # finalize deferred shapes
+    amp.convert_hybrid_block(net, cast_params_offline=True)
+    import jax.numpy as jnp
+    for p in net.collect_params().values():
+        assert p.data().dtype == jnp.bfloat16
+
+
+def test_amp_covers_attention_and_batch_dot():
+    from mxnet_tpu import npx
+
+    class AttnBlock(nn.HybridBlock):
+        def forward(self, x):
+            # batch_dot under the AMP scope must run in bf16
+            return npx.batch_dot(x, x, transpose_b=True)
+
+    blk = AttnBlock()
+    x = mxnp.random.uniform(size=(2, 4, 8))
+    ref = blk(x).asnumpy()
+    amp_blk = amp.convert_hybrid_block(blk)
+    out = amp_blk(x).asnumpy()
+    dev = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert 0 < dev < 0.02  # bf16 ran (deviation present but small)
+
+
+def test_amp_user_fp32_override():
+    class FcBlock(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Dense(16)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    blk = FcBlock()
+    blk.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(4, 32))
+    ref = blk(x).asnumpy()
+    # excluding fully_connected from the target set → pure fp32
+    amp_blk = amp.convert_hybrid_block(blk, fp32_ops=["fully_connected"])
+    out = amp_blk(x).asnumpy()
+    onp.testing.assert_array_equal(out, ref)
+
+
+def test_loss_scaler_dynamics():
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    ls = LossScaler()
+    s0 = ls.loss_scale
+    assert s0 == 2.0 ** 16
+    ls.update_scale(overflow=True)
+    assert ls.loss_scale == s0 / 2
+    for _ in range(ls.scale_window):
+        ls.update_scale(overflow=False)
+    assert ls.loss_scale == s0  # doubled back after a clean window
+
+
+def test_init_trainer_attaches_scaler_for_fp16():
+    net = _net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init(target_dtype="float16")
+    amp.init_trainer(trainer)
+    assert hasattr(trainer, "_amp_loss_scaler")
+    amp.init(target_dtype="bfloat16")  # reset global for other tests
