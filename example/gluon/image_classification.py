@@ -60,13 +60,14 @@ def main():
     ap.add_argument("--epochs", type=int, default=1)
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--kvstore", default="device")
-    ap.add_argument("--hybridize", action="store_true", default=True)
+    ap.add_argument("--no-hybridize", action="store_true",
+                    help="run eagerly instead of whole-graph XLA")
     ap.add_argument("--max-batches", type=int, default=0)
     args = ap.parse_args()
 
     net = get_model(args.model, classes=args.classes)
     net.initialize(mx.init.Xavier())
-    if args.hybridize:
+    if not args.no_hybridize:
         net.hybridize()
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = gluon.Trainer(net.collect_params(), "sgd",

@@ -57,6 +57,10 @@ from . import subgraph
 from . import image
 from . import visualization
 from . import callback
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from . import rtc
 from . import sparse
 from . import symbol
 from . import symbol as sym

@@ -81,8 +81,40 @@ def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
     opset = set(lists.TARGET_DTYPE_OPS)
     opset |= set(target_dtype_ops or [])
     opset -= set(fp32_ops or [])
-    opset -= set(excluded_sym_names or [])
+    # excluded_sym_names are LAYER paths (e.g. 'output.0'), not op names:
+    # suspend the amp scope while those children run so they stay fp32
+    if excluded_sym_names:
+        _attach_exclusions(block, set(excluded_sym_names))
     return _AmpWrapper(block, dt, frozenset(opset))
+
+
+def _attach_exclusions(block, names):
+    from ..ops import nn as _ops_nn
+    matched = set()
+
+    def walk(blk, path):
+        if path in names:
+            matched.add(path)
+            saved = []
+
+            def pre(b, inputs):
+                saved.append(_ops_nn._amp_state())
+                _ops_nn._amp_set(None)
+
+            def post(b, inputs, output):
+                _ops_nn._amp_set(saved.pop() if saved else None)
+
+            blk.register_forward_pre_hook(pre)
+            blk.register_forward_hook(post)
+        for cname, child in blk._children.items():
+            walk(child, "%s.%s" % (path, cname) if path else cname)
+
+    walk(block, "")
+    unmatched = names - matched
+    if unmatched:
+        import warnings
+        warnings.warn("excluded_sym_names not found in the block tree: %s"
+                      % sorted(unmatched))
 
 
 class _AmpWrapper:

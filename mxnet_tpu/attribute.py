@@ -1,0 +1,48 @@
+"""AttrScope (parity: python/mxnet/attribute.py) — scoped attribute
+dictionaries attached to symbols/blocks created within the scope."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_STATE = threading.local()
+
+
+def _stack():
+    if not hasattr(_STATE, "stack"):
+        _STATE.stack = [None]
+    return _STATE.stack
+
+
+class AttrScope:
+    """with AttrScope(key=value): blocks/symbols pick up the attrs."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("attributes must be strings")
+        self._attr = kwargs
+
+    def get(self, attr=None):
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        parent = _stack()[-1]
+        merged = dict(parent._attr) if parent is not None else {}
+        merged.update(self._attr)
+        scope = AttrScope(**merged)
+        _stack().append(scope)
+        return scope
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def current():
+    """The active AttrScope (or None)."""
+    return _stack()[-1]

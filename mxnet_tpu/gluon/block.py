@@ -48,6 +48,26 @@ class _BlockScope:
     pass
 
 
+class _OpHookHandle:
+    """Detaches a register_op_hook group in one call."""
+
+    def __init__(self, handles, blocks):
+        self._handles = handles
+        self._blocks = blocks
+
+    def detach(self):
+        for h in self._handles:
+            h.detach()
+        self._handles = []
+        for b in self._blocks:
+            b._op_hooks_active = max(
+                getattr(b, "_op_hooks_active", 1) - 1, 0)
+        self._blocks = []
+
+    def __iter__(self):  # back-compat with list-returning callers
+        return iter(self._handles)
+
+
 class Block:
     """Base container (reference block.py:203)."""
 
@@ -147,7 +167,43 @@ class Block:
         return _HookHandle(self._forward_pre_hooks, self._hook_id)
 
     def register_op_hook(self, callback, monitor_all=False):
-        pass  # per-op monitoring: profiler hooks land with profiler parity
+        """Monitor child-block outputs (and inputs with monitor_all)
+        during forward (parity: block.py:869 register_op_hook → CachedOp
+        _register_op_hook; here the monitored unit is the child block —
+        the graph node granularity of this framework).
+
+        callback(name, opr_name, array) is called eagerly per forward.
+        While hooks are attached, hybridized blocks run the eager path so
+        every call reaches the callbacks with concrete arrays (the
+        reference's CachedOp monitors compiled-graph tensors via engine
+        callbacks; here the compiled graph has no per-op host callbacks,
+        so monitoring implies eager).  Returns one handle; detach() it to
+        restore compiled execution.
+        """
+        handles = []
+        blocks = []
+
+        def attach(blk, path):
+            def fwd_hook(b, inputs, output, _path=path):
+                outs = output if isinstance(output, (list, tuple)) \
+                    else [output]
+                for i, o in enumerate(outs):
+                    if o is not None and hasattr(o, "shape"):
+                        callback("%s_output%d" % (_path, i),
+                                 type(b).__name__, o)
+                if monitor_all:
+                    for i, a in enumerate(inputs):
+                        if hasattr(a, "shape"):
+                            callback("%s_input%d" % (_path, i),
+                                     type(b).__name__, a)
+            handles.append(blk.register_forward_hook(fwd_hook))
+            blk._op_hooks_active = getattr(blk, "_op_hooks_active", 0) + 1
+            blocks.append(blk)
+            for cname, child in blk._children.items():
+                attach(child, "%s.%s" % (path, cname) if path else cname)
+
+        attach(self, "")
+        return _OpHookHandle(handles, blocks)
 
     def apply(self, fn):
         for child in self._children.values():
@@ -429,8 +485,10 @@ class HybridBlock(Block):
                 {"shape": list(a.shape), "dtype": str(a.dtype)} for a in flat]
         # first call with deferred params runs eagerly so each layer infers
         # its shapes (reference: deferred init at first forward); subsequent
-        # calls hit the compiled cache
-        if self._active and not self._has_uninitialized_params():
+        # calls hit the compiled cache.  Active op hooks force eager so
+        # monitors see concrete arrays every call.
+        if self._active and not self._has_uninitialized_params() \
+                and not getattr(self, "_op_hooks_active", 0):
             for hook in self._forward_pre_hooks.values():
                 hook(self, args)
             out = self._call_cached(args, kwargs)

@@ -294,8 +294,11 @@ def create(name="local"):
     if name in ("dist_sync", "dist_async", "dist_sync_device", "dist", "p3"):
         import os
         if os.environ.get("DMLC_PS_ROOT_URI"):
-            # real parameter-server tier over TCP (DCN; SURVEY.md §5.8)
+            # real parameter-server tier over TCP (DCN; SURVEY.md §5.8);
+            # "p3" keeps its name to enable big-array slice scheduling
             from .dist import KVStoreDist
+            if name == "p3":
+                return KVStoreDist("p3")
             return KVStoreDist("dist_async" if name == "dist_async"
                                else "dist_sync")
         # no cluster env: degrade to local semantics (reference runs the

@@ -243,6 +243,32 @@ class KVStoreDistServer:
             return {"ok": True, "value": self.store[key]}
 
 
+def _grouped_requests(conn_msgs):
+    """Run (conn, msg) pairs pipelined: ALL sends go out (to every server
+    stream) before any reply is awaited, so slices progress on all shards
+    in parallel instead of one blocking round trip each.  Per-conn locks
+    are held across send+recv (acquired in a fixed order) so concurrent
+    callers can't interleave on a stream."""
+    by_conn = {}
+    for pos, (conn, msg) in enumerate(conn_msgs):
+        by_conn.setdefault(id(conn), (conn, []))[1].append((pos, msg))
+    groups = sorted(by_conn.items())  # deterministic lock order
+    replies = [None] * len(conn_msgs)
+    try:
+        for _cid, (conn, entries) in groups:
+            conn.lock.acquire()
+        for _cid, (conn, entries) in groups:  # phase 1: send everywhere
+            for _pos, m in entries:
+                _send_msg(conn.sock, m)
+        for _cid, (conn, entries) in groups:  # phase 2: collect replies
+            for pos, _m in entries:
+                replies[pos] = _recv_msg(conn.sock)
+    finally:
+        for _cid, (conn, entries) in groups:
+            conn.lock.release()
+    return replies
+
+
 def run_server():
     """Run the server role for this process (reference
     kvstore_server.py:29 _init_kvstore_server_module)."""
@@ -278,6 +304,14 @@ class _ServerConn:
             _send_msg(self.sock, msg)
             return _recv_msg(self.sock)
 
+    def request_many(self, msgs):
+        """Pipeline: send all, then read the replies in order (one TCP
+        stream — the server answers sequentially per connection)."""
+        with self.lock:
+            for m in msgs:
+                _send_msg(self.sock, m)
+            return [_recv_msg(self.sock) for _ in msgs]
+
     def send_only(self, msg):
         with self.lock:
             _send_msg(self.sock, msg)
@@ -299,6 +333,14 @@ class KVStoreDist(KVStoreBase):
     def __init__(self, name="dist_sync"):
         self._name = name
         self._sync = not name.endswith("async")
+        # P3-style slicing (reference p3store_dist.h:40 + PSKV big-array
+        # splitting, kvstore_dist.h:58): arrays above the threshold are
+        # pushed/pulled as independent slices spread round-robin across
+        # server shards, so one huge layer doesn't serialize on one server
+        self._slice_threshold = int(_env(
+            "MXNET_KVSTORE_SLICE_THRESHOLD",
+            "40000" if name == "p3" else "0")) or (
+                int(_env("MXNET_KVSTORE_BIGARRAY_BOUND", "0")) or 0)
         self._rank = int(_env("DMLC_WORKER_ID", "0"))
         self._num_workers = int(_env("DMLC_NUM_WORKER", "1"))
         self._num_servers = int(_env("DMLC_NUM_SERVER", "1"))
@@ -308,6 +350,8 @@ class KVStoreDist(KVStoreBase):
                        for s in range(self._num_servers)]
         self._push_round = {}  # key -> rounds this worker pushed
         self._gc = None  # optional GradientCompression
+
+    _server_opt = False
 
     def set_gradient_compression(self, compression_params):
         """2-bit/1-bit push compression with error feedback
@@ -340,6 +384,26 @@ class KVStoreDist(KVStoreBase):
         return self._num_workers
 
     # -- API --------------------------------------------------------------
+    def _slice_plan(self, key, size):
+        """[(slice_key, start, stop, conn)] for big arrays, else None.
+        Slices go round-robin across server shards starting from the
+        parent key's shard — the cross-server parallelism P3 exists for.
+        Disabled while a server-side optimizer is set: per-slice updates
+        would change norm-based optimizer semantics (trust ratios over
+        ||slice|| instead of ||weight||)."""
+        t = self._slice_threshold
+        if not t or size <= t or getattr(self, "_server_opt", False):
+            return None
+        try:
+            base = int(key) % self._num_servers
+        except ValueError:
+            import zlib
+            base = zlib.crc32(key.encode()) % self._num_servers
+        n = -(-size // t)
+        return [("%s#%d" % (key, i), i * t, min((i + 1) * t, size),
+                 self._conns[(base + i) % self._num_servers])
+                for i in range(n)]
+
     def init(self, key, value):
         # batched: all inits then ONE barrier (per-key barriers dominate
         # startup for models with many parameters)
@@ -350,9 +414,18 @@ class KVStoreDist(KVStoreBase):
                 k = str(k)
                 v = v.asnumpy() if isinstance(v, ndarray) else \
                     onp.asarray(v)
-                r = self._conn_for(k).request(
-                    {"op": "init", "key": k, "value": v})
-                assert r["ok"], r
+                plan = self._slice_plan(k, v.size)
+                if plan is None:
+                    r = self._conn_for(k).request(
+                        {"op": "init", "key": k, "value": v})
+                    assert r["ok"], r
+                else:
+                    flat = v.ravel()
+                    for r in _grouped_requests(
+                            [(c, {"op": "init", "key": sk,
+                                  "value": flat[a:b]})
+                             for sk, a, b, c in plan]):
+                        assert r["ok"], r
         self.barrier()
 
     def push(self, key, value, priority=0):
@@ -363,18 +436,28 @@ class KVStoreDist(KVStoreBase):
         key = str(key)
         reduced = _reduce(value) if isinstance(value, (list, tuple)) \
             else value
-        if self._gc is not None:
-            packed, meta = self._gc.compress(key, reduced.asnumpy())
-            msg = {"op": "push", "key": key, "rank": self._rank,
-                   "value": packed, "meta": meta, "compressed": True,
-                   "sync": self._sync}
+        arr = reduced.asnumpy()
+        plan = self._slice_plan(key, arr.size)
+        if plan is None:
+            items = [(key, arr, self._conn_for(key))]
         else:
-            msg = {"op": "push", "key": key, "rank": self._rank,
-                   "value": reduced.asnumpy(), "sync": self._sync}
-        r = self._conn_for(key).request(msg)
-        if not r["ok"]:
-            raise RuntimeError("dist push failed: %s" % r.get("error"))
-        self._push_round[key] = self._push_round.get(key, 0) + 1
+            flat = arr.ravel()
+            items = [(sk, flat[a:b], c) for sk, a, b, c in plan]
+        conn_msgs = []
+        for sk, sv, conn in items:
+            if self._gc is not None:
+                packed, meta = self._gc.compress(sk, sv)
+                msg = {"op": "push", "key": sk, "rank": self._rank,
+                       "value": packed, "meta": meta, "compressed": True,
+                       "sync": self._sync}
+            else:
+                msg = {"op": "push", "key": sk, "rank": self._rank,
+                       "value": sv, "sync": self._sync}
+            conn_msgs.append((conn, msg))
+            self._push_round[sk] = self._push_round.get(sk, 0) + 1
+        for r in _grouped_requests(conn_msgs):
+            if not r["ok"]:
+                raise RuntimeError("dist push failed: %s" % r.get("error"))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -382,13 +465,26 @@ class KVStoreDist(KVStoreBase):
                 self.pull(k, o, priority, ignore_sparse)
             return
         key = str(key)
-        r = self._conn_for(key).request(
-            {"op": "pull", "key": key,
-             "round": self._push_round.get(key, 0)})
-        if not r["ok"]:
-            raise KeyError(r.get("error", "pull failed"))
-        value = r["value"]
         outs = out if isinstance(out, (list, tuple)) else [out]
+        plan = self._slice_plan(key, outs[0].size)
+        if plan is None:
+            r = self._conn_for(key).request(
+                {"op": "pull", "key": key,
+                 "round": self._push_round.get(key, 0)})
+            if not r["ok"]:
+                raise KeyError(r.get("error", "pull failed"))
+            value = r["value"]
+        else:
+            replies = _grouped_requests(
+                [(c, {"op": "pull", "key": sk,
+                      "round": self._push_round.get(sk, 0)})
+                 for sk, _a, _b, c in plan])
+            parts = []
+            for r in replies:
+                if not r["ok"]:
+                    raise KeyError(r.get("error", "pull failed"))
+                parts.append(onp.asarray(r["value"]).ravel())
+            value = onp.concatenate(parts).reshape(outs[0].shape)
         for o in outs:
             o._set_data(jnp.asarray(value, o._data.dtype))
 
@@ -404,6 +500,8 @@ class KVStoreDist(KVStoreBase):
         return out
 
     def set_optimizer(self, optimizer):
+        self._server_opt = True  # disables big-array slicing (see
+        # _slice_plan: per-slice updates break norm-based optimizers)
         if self._rank == 0:
             blob = pickle.dumps(optimizer)
             for c in self._conns:

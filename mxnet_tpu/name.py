@@ -1,0 +1,52 @@
+"""NameManager / Prefix (parity: python/mxnet/name.py) — automatic
+unique naming for created symbols/blocks."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+_STATE = threading.local()
+
+
+def _stack():
+    if not hasattr(_STATE, "stack"):
+        _STATE.stack = [NameManager()]
+    return _STATE.stack
+
+
+class NameManager:
+    """Assigns hint0, hint1, ... unique names."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return "%s%d" % (hint, n)
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+    @staticmethod
+    def current():
+        return _stack()[-1]
+
+
+class Prefix(NameManager):
+    """Prefixes every generated name (reference name.py Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)

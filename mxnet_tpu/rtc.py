@@ -1,0 +1,39 @@
+"""Runtime kernel compilation (parity: python/mxnet/rtc.py — CudaModule/
+CudaKernel over NVRTC, include/mxnet/rtc.h:39).
+
+TPU-native: there is no CUDA RTC on TPU; the equivalent capability —
+user-authored fused kernels compiled at runtime — is Pallas
+(mxnet_tpu/ops/pallas/, see flash_attention.py for the pattern, and
+/opt/skills/guides/pallas_guide.md).  `PallasModule` is the supported
+path; `CudaModule` raises with that pointer so reference code fails
+loudly rather than silently.
+"""
+from __future__ import annotations
+
+__all__ = ["CudaModule", "PallasModule"]
+
+
+class CudaModule:
+    def __init__(self, source, options=(), exports=()):
+        raise NotImplementedError(
+            "CUDA RTC is not available on TPU. Write a Pallas kernel "
+            "instead (jax.experimental.pallas): see "
+            "mxnet_tpu/ops/pallas/flash_attention.py and rtc.PallasModule."
+        )
+
+
+class PallasModule:
+    """Wrap a pallas_call-built kernel as a named module
+    (the CudaModule analog: hand it a function built with
+    jax.experimental.pallas.pallas_call)."""
+
+    def __init__(self, fn, name="pallas_kernel"):
+        self._fn = fn
+        self.name = name
+
+    def get_kernel(self, name=None, signature=None):
+        return self._fn
+
+    def __call__(self, *args, **kwargs):
+        from .ndarray import apply_op
+        return apply_op(self._fn, *args, **kwargs)

@@ -69,6 +69,24 @@ def main():
             float(onp.abs(onp.asarray(v)).sum()) for v in params.values())
         result["params"] = params
 
+    elif mode == "p3":
+        # big-array slicing: value larger than the slice threshold moves
+        # as independent slices across server shards
+        os.environ["MXNET_KVSTORE_SLICE_THRESHOLD"] = "100"
+        kv2 = mx.kv.create("p3")
+        big = onp.arange(512, dtype=onp.float32).reshape(16, 32)
+        kv2.init("9", mxnp.array(big))
+        out = mxnp.zeros((16, 32))
+        kv2.pull("9", out=out)
+        onp.testing.assert_allclose(out.asnumpy(), big)
+        kv2.push("9", mxnp.array(onp.ones((16, 32), onp.float32)
+                                 * (rank + 1)))
+        kv2.pull("9", out=out)
+        expect = kv2.num_workers * (kv2.num_workers + 1) / 2
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    onp.full((16, 32), expect))
+        result["p3_ok"] = True
+
     elif mode == "gc":
         # compressed pushes over the wire: each worker pushes a gradient
         # quantized to ±threshold with error feedback

@@ -55,6 +55,11 @@ def test_dist_trainer_replicas_stay_identical(tmp_path):
                                     err_msg="replica divergence in %s" % k)
 
 
+def test_dist_p3_sliced_arrays(tmp_path):
+    results = _launch(tmp_path, "p3", n=2, s=2)
+    assert all(r["p3_ok"] for r in results)
+
+
 def test_dist_gradient_compression(tmp_path):
     results = _launch(tmp_path, "gc", n=2, s=1)
     assert all(r["gc_ok"] for r in results)

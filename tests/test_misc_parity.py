@@ -1,0 +1,132 @@
+"""Misc parity: register_op_hook, AttrScope, NameManager, rtc gate
+(reference: tests for block op hooks in test_gluon.py, attribute/name
+unit coverage)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.gluon import nn
+
+
+def test_register_op_hook_monitors_outputs():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    seen = []
+    net.register_op_hook(lambda name, op, arr: seen.append((name, op)))
+    net(mxnp.random.uniform(size=(2, 3)))
+    names = [n for n, _ in seen]
+    assert any("0_output0" in n for n in names)
+    assert any("1_output0" in n for n in names)
+
+
+def test_register_op_hook_monitor_all_inputs():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    seen = []
+    net.register_op_hook(lambda name, op, arr: seen.append(name),
+                         monitor_all=True)
+    net(mxnp.random.uniform(size=(1, 3)))
+    assert any("input0" in n for n in seen)
+
+
+def test_register_op_hook_hybridized_and_detach():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mxnp.random.uniform(size=(2, 3))
+    net(x)  # compile the cached graph first
+    seen = []
+    handle = net.register_op_hook(
+        lambda name, op, arr: seen.append(float(arr.asnumpy().sum())))
+    net(x)  # hooks force eager: concrete arrays reach the callback
+    assert len(seen) >= 2
+    n1 = len(seen)
+    net(x)  # fires on EVERY call, not just the trace
+    assert len(seen) == 2 * n1
+    handle.detach()
+    net(x)  # compiled path again, no more callbacks
+    assert len(seen) == 2 * n1
+
+
+def test_amp_excluded_sym_names_layer_path():
+    from mxnet_tpu import amp
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(2, 8))
+    ref = net(x).asnumpy()
+    # exclude the whole net's children by path: stays pure fp32
+    amp_net = amp.convert_hybrid_block(net,
+                                       excluded_sym_names=["0", "1"])
+    out = amp_net(x).asnumpy()
+    onp.testing.assert_array_equal(out, ref)
+    # unknown name warns
+    import warnings
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        amp.convert_hybrid_block(net, excluded_sym_names=["nope"])
+        assert any("not found" in str(x.message) for x in w)
+
+
+def test_attr_scope():
+    from mxnet_tpu import AttrScope
+    from mxnet_tpu.attribute import current
+    assert current() is None
+    with AttrScope(ctx_group="dev1"):
+        assert current().get() == {"ctx_group": "dev1"}
+        with AttrScope(lr_mult="0.5"):
+            assert current().get() == {"ctx_group": "dev1",
+                                       "lr_mult": "0.5"}
+        assert current().get() == {"ctx_group": "dev1"}
+    assert current() is None
+    with pytest.raises(ValueError):
+        AttrScope(x=1)  # non-string attr
+
+
+def test_name_manager():
+    from mxnet_tpu.name import NameManager, Prefix
+    nm = NameManager()
+    assert nm.get(None, "dense") == "dense0"
+    assert nm.get(None, "dense") == "dense1"
+    assert nm.get("explicit", "dense") == "explicit"
+    with Prefix("model_") as p:
+        assert NameManager.current() is p
+        assert p.get(None, "conv") == "model_conv0"
+    assert NameManager.current() is not None
+
+
+def test_rtc_gate_and_pallas_module():
+    with pytest.raises(NotImplementedError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k() {}")
+    import jax.numpy as jnp
+    mod = mx.rtc.PallasModule(lambda x: x * 2, name="double")
+    out = mod(mxnp.array([1.0, 2.0]))
+    onp.testing.assert_allclose(out.asnumpy(), [2.0, 4.0])
+
+
+def test_dist_slice_plan():
+    """P3 slicing plan math (wire-level covered by dist tests)."""
+    import os
+    os.environ["DMLC_PS_ROOT_URI"] = ""  # ensure no accidental connect
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    store = KVStoreDist.__new__(KVStoreDist)
+    store._slice_threshold = 10
+    store._num_servers = 4
+    store._conns = ["c0", "c1", "c2", "c3"]
+    plan = store._slice_plan("3", 25)
+    assert [(k, a, b) for k, a, b, _c in plan] == [
+        ("3#0", 0, 10), ("3#1", 10, 20), ("3#2", 20, 25)]
+    # slices rotate round-robin across shards starting at the key's shard
+    assert [c for _k, _a, _b, c in plan] == ["c3", "c0", "c1"]
+    assert store._slice_plan("3", 10) is None
+    # server-side optimizer disables slicing (per-slice norms would
+    # change optimizer semantics)
+    store._server_opt = True
+    assert store._slice_plan("3", 25) is None
+    store._server_opt = False
+    store._slice_threshold = 0
+    assert store._slice_plan("3", 10**9) is None
