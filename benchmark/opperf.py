@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Operator micro-benchmark harness.
+
+Parity: reference `benchmark/opperf/opperf.py` — per-operator fwd/bwd
+latency across the registered op surface, used as the perf-regression
+harness (SURVEY.md §4/§6).
+
+Usage:
+  python benchmark/opperf.py                  # standard op set
+  python benchmark/opperf.py --ops add,dot    # subset
+  python benchmark/opperf.py --json out.json  # machine-readable dump
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import npx
+
+
+def _u(shape):
+    return mxnp.random.uniform(size=shape)
+
+
+# (name, forward_closure_factory, differentiable_inputs_factory)
+def _registry(large):
+    n = 1024 if large else 256
+    c = 64 if large else 16
+    img = (32, c, 28, 28) if large else (8, c, 14, 14)
+    OPS = {
+        # elemwise / broadcast
+        "add": lambda: (lambda a, b: a + b, [_u((n, n)), _u((n, n))]),
+        "multiply": lambda: (lambda a, b: a * b, [_u((n, n)), _u((n, n))]),
+        "exp": lambda: (mxnp.exp, [_u((n, n))]),
+        "tanh": lambda: (mxnp.tanh, [_u((n, n))]),
+        # reductions
+        "sum": lambda: (lambda a: a.sum(), [_u((n, n))]),
+        "mean_axis": lambda: (lambda a: a.mean(axis=1), [_u((n, n))]),
+        # matmul family
+        "dot": lambda: (mxnp.dot, [_u((n, n)), _u((n, n))]),
+        "batch_dot": lambda: (npx.batch_dot, [_u((16, n // 4, n // 4)),
+                                              _u((16, n // 4, n // 4))]),
+        "einsum_bij_bjk": lambda: (
+            lambda a, b: mxnp.einsum("bij,bjk->bik", a, b),
+            [_u((16, n // 4, n // 4)), _u((16, n // 4, n // 4))]),
+        # nn
+        "fully_connected": lambda: (
+            lambda x, w, b: npx.fully_connected(x, w, b, num_hidden=n),
+            [_u((128, n)), _u((n, n)), _u((n,))]),
+        "convolution": lambda: (
+            lambda x, w: npx.convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                                         num_filter=c, no_bias=True),
+            [_u(img), _u((c, c, 3, 3))]),
+        "pooling": lambda: (
+            lambda x: npx.pooling(x, kernel=(2, 2), stride=(2, 2)),
+            [_u(img)]),
+        "softmax": lambda: (npx.softmax, [_u((n, n))]),
+        "layer_norm": lambda: (
+            lambda x, g, b: npx.layer_norm(x, g, b),
+            [_u((n, n)), _u((n,)), _u((n,))]),
+        "batch_norm_inf": lambda: (
+            lambda x, g, b, m, v: npx.batch_norm(x, g, b, m, v,
+                                                 use_global_stats=True),
+            [_u(img), _u((c,)), _u((c,)), _u((c,)), _u((c,))]),
+        # indexing / shapes
+        "transpose": lambda: (lambda a: a.transpose(), [_u((n, n))]),
+        "take": lambda: (
+            lambda a: a.take(mxnp.array(onp.arange(64)), axis=0),
+            [_u((n, n))]),
+        "concat": lambda: (
+            lambda a, b: mxnp.concatenate([a, b], axis=1),
+            [_u((n, n)), _u((n, n))]),
+        # attention
+        "flash_attention": lambda: (
+            npx.flash_attention,
+            [_u((4, 8, 128, 64)), _u((4, 8, 128, 64)),
+             _u((4, 8, 128, 64))]),
+    }
+    return OPS
+
+
+def bench_op(make, warmup=3, iters=20, backward=True):
+    fn, inputs = make()
+    for x in inputs:
+        x.attach_grad()
+    # forward timing
+    for _ in range(warmup):
+        out = fn(*inputs)
+    out.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*inputs)
+    out.wait_to_read()
+    fwd_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    bwd_ms = None
+    if backward:
+        def run_bwd():
+            with autograd.record():
+                o = fn(*inputs)
+                loss = o.sum() if hasattr(o, "sum") else o
+            loss.backward()
+            inputs[0].grad.wait_to_read()
+        try:
+            for _ in range(warmup):
+                run_bwd()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run_bwd()
+            bwd_ms = (time.perf_counter() - t0) / iters * 1e3
+        except Exception:
+            bwd_ms = None
+    return fwd_ms, bwd_ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None, help="comma-separated subset")
+    ap.add_argument("--large", action="store_true")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    registry = _registry(args.large)
+    names = args.ops.split(",") if args.ops else list(registry)
+    rows = []
+    print("%-20s %12s %12s" % ("op", "fwd (ms)", "fwd+bwd (ms)"))
+    print("-" * 48)
+    for name in names:
+        if name not in registry:
+            print("%-20s %12s" % (name, "unknown"))
+            continue
+        fwd, bwd = bench_op(registry[name], iters=args.iters)
+        rows.append({"op": name, "fwd_ms": round(fwd, 4),
+                     "fwd_bwd_ms": round(bwd, 4) if bwd else None})
+        print("%-20s %12.4f %12s" % (
+            name, fwd, "%.4f" % bwd if bwd else "n/a"))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+        print("wrote", args.json)
+
+
+if __name__ == "__main__":
+    main()

@@ -82,15 +82,24 @@ def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
     opset |= set(target_dtype_ops or [])
     opset -= set(fp32_ops or [])
     # excluded_sym_names are LAYER paths (e.g. 'output.0'), not op names:
-    # suspend the amp scope while those children run so they stay fp32
-    if excluded_sym_names:
-        _attach_exclusions(block, set(excluded_sym_names))
+    # suspend the amp scope while those children run so they stay fp32.
+    # Always (re)attach so a convert without exclusions clears hooks left
+    # by a previous convert on the same block.
+    _attach_exclusions(block, set(excluded_sym_names or []))
     return _AmpWrapper(block, dt, frozenset(opset))
 
 
 def _attach_exclusions(block, names):
     from ..ops import nn as _ops_nn
     matched = set()
+    handles = []
+
+    # repeated converts must not stack exclusion hooks on the same tree
+    old = getattr(block, "_amp_exclusion_handles", None)
+    if old:
+        for h in old:
+            h.detach()
+    block._amp_exclusion_handles = handles
 
     def walk(blk, path):
         if path in names:
@@ -98,14 +107,18 @@ def _attach_exclusions(block, names):
             saved = []
 
             def pre(b, inputs):
+                # a raised forward can strand an entry; a fresh call
+                # starts from a clean slate (these blocks aren't
+                # re-entrant)
+                saved.clear()
                 saved.append(_ops_nn._amp_state())
                 _ops_nn._amp_set(None)
 
             def post(b, inputs, output):
                 _ops_nn._amp_set(saved.pop() if saved else None)
 
-            blk.register_forward_pre_hook(pre)
-            blk.register_forward_hook(post)
+            handles.append(blk.register_forward_pre_hook(pre))
+            handles.append(blk.register_forward_hook(post))
         for cname, child in blk._children.items():
             walk(child, "%s.%s" % (path, cname) if path else cname)
 
@@ -115,6 +128,7 @@ def _attach_exclusions(block, names):
         import warnings
         warnings.warn("excluded_sym_names not found in the block tree: %s"
                       % sorted(unmatched))
+    return handles
 
 
 class _AmpWrapper:

@@ -177,8 +177,10 @@ class Block:
         every call reaches the callbacks with concrete arrays (the
         reference's CachedOp monitors compiled-graph tensors via engine
         callbacks; here the compiled graph has no per-op host callbacks,
-        so monitoring implies eager).  Returns one handle; detach() it to
-        restore compiled execution.
+        so monitoring implies eager).  Attach the hook on the OUTERMOST
+        block you call — hooking only an inner child of a compiled parent
+        cannot bypass the parent's cached graph.  Returns one handle;
+        detach() it to restore compiled execution.
         """
         handles = []
         blocks = []

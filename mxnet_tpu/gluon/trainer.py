@@ -58,6 +58,19 @@ class Trainer:
             self._kvstore = kv_create(self._kvstore_type)
         self._kv_initialized = True
         kv = self._kvstore
+        if kv is not None and self._update_on_kvstore:
+            # set the optimizer BEFORE seeding params: dist stores disable
+            # big-array slicing under a server-side optimizer, and the
+            # init must use the same (unsliced) key layout as later pushes
+            import copy
+            from types import SimpleNamespace
+            opt = copy.copy(self._optimizer)
+            opt.rescale_grad = 1.0
+            opt.param_dict = {
+                i: SimpleNamespace(lr_mult=getattr(p, "lr_mult", 1.0),
+                                   wd_mult=getattr(p, "wd_mult", 1.0))
+                for i, p in enumerate(self._params)}
+            kv.set_optimizer(opt)
         if kv is not None and (kv.num_workers > 1 or
                                self._update_on_kvstore):
             # seed the store with the params: multi-worker replicas start
@@ -74,21 +87,6 @@ class Trainer:
                     outs.append(p.data())
             if keys:
                 kv.broadcast(keys, vals, out=outs)
-        if kv is not None and self._update_on_kvstore:
-            # server-side optimizer: workers push pre-scaled grads, the
-            # server runs the update (reference set_updater path).  The
-            # copy keeps per-parameter lr_mult/wd_mult as plain
-            # namespaces (Parameters hold device buffers and don't
-            # pickle).
-            import copy
-            from types import SimpleNamespace
-            opt = copy.copy(self._optimizer)
-            opt.rescale_grad = 1.0
-            opt.param_dict = {
-                i: SimpleNamespace(lr_mult=getattr(p, "lr_mult", 1.0),
-                                   wd_mult=getattr(p, "wd_mult", 1.0))
-                for i, p in enumerate(self._params)}
-            kv.set_optimizer(opt)
 
     @property
     def learning_rate(self):

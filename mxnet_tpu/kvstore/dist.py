@@ -254,9 +254,11 @@ def _grouped_requests(conn_msgs):
         by_conn.setdefault(id(conn), (conn, []))[1].append((pos, msg))
     groups = sorted(by_conn.items())  # deterministic lock order
     replies = [None] * len(conn_msgs)
+    acquired = []
     try:
         for _cid, (conn, entries) in groups:
             conn.lock.acquire()
+            acquired.append(conn.lock)
         for _cid, (conn, entries) in groups:  # phase 1: send everywhere
             for _pos, m in entries:
                 _send_msg(conn.sock, m)
@@ -264,8 +266,8 @@ def _grouped_requests(conn_msgs):
             for pos, _m in entries:
                 replies[pos] = _recv_msg(conn.sock)
     finally:
-        for _cid, (conn, entries) in groups:
-            conn.lock.release()
+        for lock in acquired:  # only locks actually taken
+            lock.release()
     return replies
 
 
@@ -303,14 +305,6 @@ class _ServerConn:
         with self.lock:
             _send_msg(self.sock, msg)
             return _recv_msg(self.sock)
-
-    def request_many(self, msgs):
-        """Pipeline: send all, then read the replies in order (one TCP
-        stream — the server answers sequentially per connection)."""
-        with self.lock:
-            for m in msgs:
-                _send_msg(self.sock, m)
-            return [_recv_msg(self.sock) for _ in msgs]
 
     def send_only(self, msg):
         with self.lock:
@@ -363,13 +357,17 @@ class KVStoreDist(KVStoreBase):
             threshold=float(params.get("threshold", 0.5)))
 
     # -- plumbing ---------------------------------------------------------
-    def _conn_for(self, key):
+    def _shard_of(self, key):
+        """Stable shard index for a key (hash() is per-process
+        randomized; PSKV analog, kvstore_dist.h:162)."""
         try:
-            shard = int(key) % self._num_servers
+            return int(key) % self._num_servers
         except ValueError:
-            import zlib  # stable across processes (hash() is randomized)
-            shard = zlib.crc32(key.encode()) % self._num_servers
-        return self._conns[shard]
+            import zlib
+            return zlib.crc32(key.encode()) % self._num_servers
+
+    def _conn_for(self, key):
+        return self._conns[self._shard_of(key)]
 
     @property
     def type(self):
@@ -394,11 +392,7 @@ class KVStoreDist(KVStoreBase):
         t = self._slice_threshold
         if not t or size <= t or getattr(self, "_server_opt", False):
             return None
-        try:
-            base = int(key) % self._num_servers
-        except ValueError:
-            import zlib
-            base = zlib.crc32(key.encode()) % self._num_servers
+        base = self._shard_of(key)
         n = -(-size // t)
         return [("%s#%d" % (key, i), i * t, min((i + 1) * t, size),
                  self._conns[(base + i) % self._num_servers])
@@ -454,10 +448,15 @@ class KVStoreDist(KVStoreBase):
                 msg = {"op": "push", "key": sk, "rank": self._rank,
                        "value": sv, "sync": self._sync}
             conn_msgs.append((conn, msg))
-            self._push_round[sk] = self._push_round.get(sk, 0) + 1
-        for r in _grouped_requests(conn_msgs):
+        replies = _grouped_requests(conn_msgs)
+        for r in replies:
             if not r["ok"]:
                 raise RuntimeError("dist push failed: %s" % r.get("error"))
+        # only count rounds for pushes the servers actually accepted —
+        # bumping early would make a later pull wait forever on a round
+        # that never applied
+        for sk, _sv, _c in items:
+            self._push_round[sk] = self._push_round.get(sk, 0) + 1
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
