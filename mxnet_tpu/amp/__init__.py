@@ -72,6 +72,10 @@ def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
     at block boundaries (XLA keeps fused casts free).  When
     cast_params_offline=True, weights themselves are cast (inference).
     """
+    if isinstance(block, _AmpWrapper):
+        # converting an already-converted wrapper: operate on the real
+        # block so exclusion-hook bookkeeping has a single home
+        block = block._block
     dt = jnp.bfloat16 if target_dtype in ("bfloat16", jnp.bfloat16) else onp.dtype(target_dtype)
     if cast_params_offline:
         block.cast(dt)

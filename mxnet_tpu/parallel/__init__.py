@@ -277,3 +277,5 @@ class DataParallelTrainer:
         """Copy compiled-state params back into the Gluon Parameters."""
         for k, p in self._params.items():
             p._data._set_data(state["params"][k])
+
+from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401,E402

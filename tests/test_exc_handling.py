@@ -1,0 +1,76 @@
+"""Async exception propagation (reference:
+tests/python/unittest/test_exc_handling.py — exceptions inside engine
+closures surface at sync points, not at op-issue time)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp, autograd
+from mxnet_tpu import engine as eng
+from mxnet_tpu.gluon import nn
+
+
+def test_nan_inf_propagate_through_async_chain():
+    """Invalid math doesn't raise mid-chain; values surface at fetch
+    (XLA semantics — the analog of the reference's deferred error
+    surfacing at WaitToRead)."""
+    a = mxnp.array([1.0, -1.0])
+    out = mxnp.log(a)  # -1 → nan, async
+    out2 = out * 2 + 1
+    v = out2.asnumpy()  # sync point
+    assert onp.isnan(v[1])
+    assert onp.isfinite(v[0])
+
+
+def test_host_engine_exception_at_sync_point():
+    e = eng.Engine()
+    v = e.new_variable()
+
+    def bad():
+        raise ValueError("async boom")
+
+    # push succeeds (async); the exception surfaces at the sync point
+    e.push(bad, mutable_vars=[v])
+    with pytest.raises(eng.EngineError, match="async boom"):
+        e.wait_for_var(v)
+
+
+def test_exception_in_hybridized_forward_surfaces():
+    class Bad(nn.HybridBlock):
+        def forward(self, x):
+            raise RuntimeError("forward exploded")
+
+    b = Bad()
+    b.hybridize()
+    with pytest.raises(RuntimeError, match="forward exploded"):
+        b(mxnp.zeros(3))
+
+
+def test_shape_error_raises_eagerly():
+    # shape mismatches are host-side metadata → immediate error (the
+    # reference also fails these at op-issue time in SetShapeType)
+    a = mxnp.zeros((2, 3))
+    b = mxnp.zeros((4, 5))
+    with pytest.raises(Exception):
+        mxnp.dot(a, b).asnumpy()
+
+
+def test_autograd_backward_outside_record_raises():
+    x = mxnp.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_waitall_after_failure_does_not_deadlock():
+    e = eng.default_engine()
+    v = e.new_variable()
+    e.push(lambda: (_ for _ in ()).throw(RuntimeError("x")),
+           mutable_vars=[v])
+    mx.waitall()  # must not hang or raise unrelated errors
+    with pytest.raises(eng.EngineError):
+        e.wait_for_var(v)
+    # recovery: a new write clears the poison
+    e.push(lambda: None, mutable_vars=[v])
+    e.wait_for_var(v)

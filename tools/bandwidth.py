@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Communication bandwidth harness.
+
+Parity: reference `tools/bandwidth/measure.py` — measures kvstore
+push/pull cost per batch as tensor size and device count vary, used to
+pick kvstore types and tune overlap (SURVEY.md §6 harness table).
+
+Usage:
+  python tools/bandwidth.py --sizes 1e5,1e6,1e7 --kvstore device
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+
+
+def measure(kv, size, iters=10):
+    n = int(size)
+    grad = mxnp.random.uniform(size=(n,))
+    out = mxnp.zeros((n,))
+    kv.init("bw", out)
+    kv.pushpull("bw", grad, out=out)
+    out.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        kv.pushpull("bw", grad, out=out)
+    out.wait_to_read()
+    dt = (time.perf_counter() - t0) / iters
+    gbps = 4.0 * n * 2 / dt / 1e9  # push + pull, fp32
+    return dt * 1e3, gbps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kvstore", default="device")
+    ap.add_argument("--sizes", default="1e5,1e6,1e7")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    kv = mx.kv.create(args.kvstore)
+    print("kvstore=%s workers=%d" % (kv.type, kv.num_workers))
+    print("%-12s %12s %12s" % ("elements", "ms/batch", "GB/s"))
+    for s in args.sizes.split(","):
+        ms, gbps = measure(kv, float(s), args.iters)
+        print("%-12d %12.3f %12.2f" % (int(float(s)), ms, gbps))
+    if hasattr(kv, "stop_servers"):
+        kv.stop_servers()
+
+
+if __name__ == "__main__":
+    main()
