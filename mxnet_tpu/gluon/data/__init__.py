@@ -4,4 +4,5 @@ from .sampler import (  # noqa: F401
     Sampler, SequentialSampler, RandomSampler, BatchSampler, FilterSampler,
     IntervalSampler)
 from .dataloader import DataLoader, default_batchify_fn  # noqa: F401
+from . import batchify  # noqa: F401
 from . import vision  # noqa: F401

@@ -1,0 +1,209 @@
+"""Broad mx.np vs NumPy sweep (reference: test_numpy_op.py's
+hypothesis-style per-op verification across the namespace —
+tests/python/unittest/test_numpy_op.py, numpy interop protocol
+test_numpy_interoperability.py)."""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import np as mxnp
+
+
+RNG = onp.random.RandomState(42)
+
+
+def _pos(shape):
+    return RNG.rand(*shape).astype(onp.float32) + 0.1
+
+
+def _any(shape):
+    return (RNG.rand(*shape).astype(onp.float32) - 0.5) * 4
+
+
+UNARY = [
+    ("exp", _any, {}), ("expm1", _any, {}), ("log", _pos, {}),
+    ("log2", _pos, {}), ("log10", _pos, {}), ("log1p", _pos, {}),
+    ("sqrt", _pos, {}), ("cbrt", _any, {}), ("square", _any, {}),
+    ("abs", _any, {}), ("sign", _any, {}), ("floor", _any, {}),
+    ("ceil", _any, {}), ("trunc", _any, {}), ("rint", _any, {}),
+    ("sin", _any, {}), ("cos", _any, {}), ("tan", _any, {}),
+    ("arcsin", lambda s: _any(s) / 4, {}),
+    ("arccos", lambda s: _any(s) / 4, {}),
+    ("arctan", _any, {}), ("sinh", _any, {}), ("cosh", _any, {}),
+    ("tanh", _any, {}), ("arcsinh", _any, {}),
+    ("arccosh", lambda s: _pos(s) + 1.0, {}),
+    ("arctanh", lambda s: _any(s) / 4, {}),
+    ("degrees", _any, {}), ("radians", _any, {}),
+    ("reciprocal", _pos, {}), ("negative", _any, {}),
+    ("isnan", _any, {}), ("isinf", _any, {}), ("isfinite", _any, {}),
+    ("logical_not", _any, {}),
+]
+
+
+@pytest.mark.parametrize("name,gen,kw", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_matches_numpy(name, gen, kw):
+    for shape in [(7,), (3, 5), (2, 3, 4)]:
+        a = gen(shape)
+        got = getattr(mxnp, name)(mxnp.array(a), **kw).asnumpy()
+        want = getattr(onp, name)(a, **kw)
+        onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+BINARY = ["add", "subtract", "multiply", "true_divide", "maximum",
+          "minimum", "arctan2", "hypot", "copysign", "fmod",
+          "logical_and", "logical_or", "logical_xor",
+          "less", "less_equal", "greater", "greater_equal", "equal",
+          "not_equal"]
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_matches_numpy_with_broadcast(name):
+    for sa, sb in [((4, 5), (4, 5)), ((4, 5), (5,)), ((3, 1, 2), (4, 2))]:
+        a, b = _pos(sa), _pos(sb)
+        got = getattr(mxnp, name)(mxnp.array(a), mxnp.array(b)).asnumpy()
+        want = getattr(onp, name)(a, b)
+        onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+REDUCTIONS = ["sum", "prod", "mean", "std", "var", "min", "max",
+              "argmin", "argmax", "nansum", "nanprod", "nanmin", "nanmax",
+              "count_nonzero"]
+
+
+@pytest.mark.parametrize("name", REDUCTIONS)
+@pytest.mark.parametrize("axis", [None, 0, 1, -1])
+def test_reductions_match_numpy(name, axis):
+    a = _any((4, 5))
+    got = getattr(mxnp, name)(mxnp.array(a), axis=axis)
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = getattr(onp, name)(a, axis=axis)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+SHAPES = [
+    ("reshape", lambda a: a.reshape(5, 4), lambda a: a.reshape(5, 4)),
+    ("ravel", lambda a: mxnp.ravel(a), lambda a: onp.ravel(a)),
+    ("transpose", lambda a: mxnp.transpose(a), lambda a: onp.transpose(a)),
+    ("flipud", lambda a: mxnp.flipud(a), lambda a: onp.flipud(a)),
+    ("fliplr", lambda a: mxnp.fliplr(a), lambda a: onp.fliplr(a)),
+    ("rot90", lambda a: mxnp.rot90(a), lambda a: onp.rot90(a)),
+    ("roll", lambda a: mxnp.roll(a, 2), lambda a: onp.roll(a, 2)),
+    ("tile", lambda a: mxnp.tile(a, (2, 1)), lambda a: onp.tile(a, (2, 1))),
+    ("repeat", lambda a: mxnp.repeat(a, 2, axis=0),
+     lambda a: onp.repeat(a, 2, axis=0)),
+    ("expand_dims", lambda a: mxnp.expand_dims(a, 1),
+     lambda a: onp.expand_dims(a, 1)),
+    ("squeeze", lambda a: mxnp.squeeze(mxnp.expand_dims(a, 0)),
+     lambda a: onp.squeeze(onp.expand_dims(a, 0))),
+    ("swapaxes", lambda a: mxnp.swapaxes(a, 0, 1),
+     lambda a: onp.swapaxes(a, 0, 1)),
+    ("moveaxis", lambda a: mxnp.moveaxis(a, 0, 1),
+     lambda a: onp.moveaxis(a, 0, 1)),
+    ("atleast_2d", lambda a: mxnp.atleast_2d(a),
+     lambda a: onp.atleast_2d(a)),
+    ("tril", lambda a: mxnp.tril(a), lambda a: onp.tril(a)),
+    ("triu", lambda a: mxnp.triu(a), lambda a: onp.triu(a)),
+    ("diff", lambda a: mxnp.diff(a, axis=1), lambda a: onp.diff(a, axis=1)),
+    ("cumsum", lambda a: mxnp.cumsum(a, axis=1),
+     lambda a: onp.cumsum(a, axis=1)),
+    ("cumprod", lambda a: mxnp.cumprod(a, axis=1),
+     lambda a: onp.cumprod(a, axis=1)),
+    ("sort", lambda a: mxnp.sort(a, axis=1), lambda a: onp.sort(a, axis=1)),
+    ("argsort", lambda a: mxnp.argsort(a, axis=1),
+     lambda a: onp.argsort(a, axis=1)),
+    ("pad", lambda a: mxnp.pad(a, ((1, 1), (2, 0))),
+     lambda a: onp.pad(a, ((1, 1), (2, 0)))),
+    ("clip", lambda a: mxnp.clip(a, -0.5, 0.5),
+     lambda a: onp.clip(a, -0.5, 0.5)),
+    ("nan_to_num", lambda a: mxnp.nan_to_num(a),
+     lambda a: onp.nan_to_num(a)),
+    ("trace", lambda a: mxnp.trace(a), lambda a: onp.trace(a)),
+    ("diag", lambda a: mxnp.diag(a), lambda a: onp.diag(a)),
+]
+
+
+@pytest.mark.parametrize("name,mxf,onf", SHAPES, ids=[s[0] for s in SHAPES])
+def test_shape_ops_match_numpy(name, mxf, onf):
+    a = _any((4, 5))
+    got = mxf(mxnp.array(a))
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    onp.testing.assert_allclose(got, onf(a), rtol=2e-5, atol=2e-6)
+
+
+COMBINE = [
+    ("concatenate", lambda xs: mxnp.concatenate(xs, axis=0),
+     lambda xs: onp.concatenate(xs, axis=0)),
+    ("stack", lambda xs: mxnp.stack(xs), lambda xs: onp.stack(xs)),
+    ("vstack", lambda xs: mxnp.vstack(xs), lambda xs: onp.vstack(xs)),
+    ("hstack", lambda xs: mxnp.hstack(xs), lambda xs: onp.hstack(xs)),
+    ("dstack", lambda xs: mxnp.dstack(xs), lambda xs: onp.dstack(xs)),
+    ("column_stack", lambda xs: mxnp.column_stack(xs),
+     lambda xs: onp.column_stack(xs)),
+]
+
+
+@pytest.mark.parametrize("name,mxf,onf", COMBINE, ids=[c[0] for c in COMBINE])
+def test_combine_ops_match_numpy(name, mxf, onf):
+    xs = [_any((3, 4)), _any((3, 4))]
+    got = mxf([mxnp.array(x) for x in xs]).asnumpy()
+    onp.testing.assert_allclose(got, onf(xs), rtol=2e-5)
+
+
+def test_linalg_matches_numpy():
+    a = _any((4, 4))
+    spd = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+    ma = mxnp.array(spd)
+    onp.testing.assert_allclose(mxnp.linalg.det(ma).asnumpy(),
+                                onp.linalg.det(spd), rtol=1e-4)
+    onp.testing.assert_allclose(
+        mxnp.linalg.inv(ma).asnumpy(), onp.linalg.inv(spd), rtol=1e-3,
+        atol=1e-4)
+    L = mxnp.linalg.cholesky(ma).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(mxnp.linalg.norm(ma).asnumpy(),
+                                onp.linalg.norm(spd), rtol=1e-5)
+    w_got = onp.sort(mxnp.linalg.eigvalsh(ma).asnumpy())
+    w_ref = onp.sort(onp.linalg.eigvalsh(spd))
+    onp.testing.assert_allclose(w_got, w_ref, rtol=1e-4)
+    b = _any((4, 2))
+    onp.testing.assert_allclose(
+        mxnp.linalg.solve(ma, mxnp.array(b)).asnumpy(),
+        onp.linalg.solve(spd, b), rtol=1e-3, atol=1e-4)
+
+
+def test_einsum_variants_match_numpy():
+    a, b = _any((3, 4)), _any((4, 5))
+    for expr, ops in [("ij,jk->ik", (a, b)),
+                      ("ij->ji", (a,)),
+                      ("ij->", (a,)),
+                      ("ij,ij->i", (a, a))]:
+        got = mxnp.einsum(expr, *[mxnp.array(x) for x in ops]).asnumpy()
+        onp.testing.assert_allclose(got, onp.einsum(expr, *ops),
+                                    rtol=1e-4, atol=1e-5)
+
+
+def test_batchify_functions():
+    from mxnet_tpu.gluon.data import batchify
+    s = batchify.Stack()([onp.ones((2, 3)), onp.zeros((2, 3))])
+    assert s.shape == (2, 2, 3)
+    p, lens = batchify.Pad(axis=0, pad_val=-1, ret_length=True)(
+        [onp.ones(3), onp.ones(5)])
+    assert p.shape == (2, 5)
+    onp.testing.assert_array_equal(p.asnumpy()[0], [1, 1, 1, -1, -1])
+    onp.testing.assert_array_equal(lens.asnumpy(), [3, 5])
+    g = batchify.Group(batchify.Stack(), batchify.Pad(pad_val=0))(
+        [(onp.ones(2), onp.ones(3)), (onp.zeros(2), onp.ones(4))])
+    assert g[0].shape == (2, 2) and g[1].shape == (2, 4)
+
+
+def test_batchify_in_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader, batchify
+    xs = [onp.ones(i + 1, onp.float32) for i in range(6)]
+    ys = onp.arange(6, dtype=onp.float32)
+    ds = [(x, y) for x, y in zip(xs, ys)]
+    loader = DataLoader(ds, batch_size=3,
+                        batchify_fn=batchify.Group(
+                            batchify.Pad(pad_val=0), batchify.Stack()))
+    batches = list(loader)
+    assert len(batches) == 2
+    x0, y0 = batches[0]
+    assert x0.shape == (3, 3)  # padded to the longest in batch
