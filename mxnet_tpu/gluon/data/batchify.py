@@ -35,7 +35,10 @@ class Pad:
 
     def __call__(self, data):
         arrs = [_as_np(d) for d in data]
-        axis = self._axis % arrs[0].ndim  # negative-axis safe
+        ndim = arrs[0].ndim
+        if not (-ndim <= self._axis < ndim):
+            raise onp.exceptions.AxisError(self._axis, ndim)
+        axis = self._axis % ndim  # negative-axis safe
         max_len = max(a.shape[axis] for a in arrs)
         shape = list(arrs[0].shape)
         shape[axis] = max_len

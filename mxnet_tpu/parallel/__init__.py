@@ -279,3 +279,4 @@ class DataParallelTrainer:
             p._data._set_data(state["params"][k])
 
 from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401,E402
+from .pipeline import PipelineRunner, pipeline_apply  # noqa: F401,E402

@@ -1,0 +1,137 @@
+"""Pipeline parallelism: GPipe-style stage execution over a mesh axis.
+
+Parity-plus (SURVEY.md §2.4: the reference has data parallelism ONLY —
+this axis is where the TPU build goes beyond it, per the §7 design
+stance).  Stages live on a `pp` mesh axis; microbatches stream through
+with `jax.lax.ppermute` passing activations between neighbor stages, the
+standard TPU pipelining recipe (scaling-book: pipelining = shifting
+buffers over ICI while the MXU stays busy).
+
+API:
+  stages = [fn_0, ..., fn_{S-1}]      # per-stage (params, x) -> y
+  runner = PipelineRunner(stages, mesh, axis="pp")
+  y = runner.apply(stage_params, x, n_microbatches=M)
+
+Each fn must map equal input/output shapes across stage boundaries
+(classic GPipe layering).  The whole loop compiles to one XLA program
+under shard_map; collectives ride ICI.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:  # jax>=0.8 top-level, older under experimental
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["PipelineRunner", "pipeline_apply"]
+
+
+class PipelineRunner:
+    def __init__(self, stage_fns, mesh, axis="pp"):
+        self.stage_fns = list(stage_fns)
+        self.mesh = mesh
+        self.axis = axis
+        self.n_stages = mesh.shape[axis]
+        assert len(self.stage_fns) == self.n_stages, \
+            "need one stage fn per device on the %r axis" % axis
+
+    def apply(self, stage_params, x, n_microbatches=None):
+        """Run x (batch-major) through the pipeline.
+
+        stage_params: list (len S) of per-stage param pytrees; x is split
+        into microbatches along axis 0; output matches x's leading shape.
+        """
+        S = self.n_stages
+        M = S if n_microbatches is None else int(n_microbatches)
+        B = x.shape[0]
+        assert M >= 1, "n_microbatches must be >= 1"
+        assert B % M == 0, "batch %d not divisible into %d microbatches" \
+            % (B, M)
+        axis = self.axis
+        fns = self.stage_fns
+
+        # stack per-stage params on a leading axis sharded over pp; stage
+        # fns may differ (lax.switch dispatch) but their param pytrees
+        # must share structure AND leaf shapes so they stack
+        structs = [jax.tree.structure(p) for p in stage_params]
+        if any(s != structs[0] for s in structs[1:]):
+            raise ValueError(
+                "pipeline stages must share one param pytree structure "
+                "(got %s); pad heterogeneous stages to a common structure"
+                % ([str(s) for s in structs]))
+        stacked = jax.tree.map(lambda *ps: jnp.stack(ps), *stage_params)
+        mb = x.reshape(M, B // M, *x.shape[1:])
+
+        def stage_apply(params, h, idx):
+            """Dispatch to this stage's fn (all stages traced via switch —
+            stage code is usually identical layers, branch is cheap)."""
+            return lax.switch(idx, [lambda p, a, f=f: f(p, a)
+                                    for f in fns], params, h)
+
+        def per_stage(params_stk, mb_all):
+            # params_stk: [1, ...] this stage's params; mb_all: all
+            # microbatches replicated
+            sidx = lax.axis_index(axis)
+            params = jax.tree.map(lambda a: a[0], params_stk)
+            nsteps = M + S - 1
+            zero = jnp.zeros_like(mb_all[0])
+
+            def body(carry, t):
+                outputs, recv = carry
+                # stage 0 feeds from the microbatch stream; others from
+                # the neighbor's activation
+                feed = jnp.where(
+                    (sidx == 0),
+                    mb_all[jnp.clip(t, 0, M - 1)], recv)
+                h = stage_apply(params, feed, sidx)
+                # active iff this stage has work at step t
+                active = (t >= sidx) & (t < M + sidx)
+                h = jnp.where(active, h, zero)
+                # pass activations down the ring (stage i → i+1)
+                nxt = lax.ppermute(
+                    h, axis, [(i, (i + 1) % S) for i in range(S)])
+                # last stage emits output for microbatch t - (S-1)
+                out_idx = t - (S - 1)
+                emit = (sidx == S - 1) & (out_idx >= 0)
+                outputs = jnp.where(
+                    emit,
+                    outputs.at[jnp.clip(out_idx, 0, M - 1)].set(h),
+                    outputs)
+                return (outputs, nxt), None
+
+            outputs0 = jnp.zeros((M,) + mb_all.shape[1:], mb_all.dtype)
+            (outputs, _), _ = lax.scan(body, (outputs0, zero),
+                                       jnp.arange(nsteps))
+            # only the last stage holds real outputs (zeros elsewhere):
+            # psum broadcasts them without materializing S copies
+            if S > 1:
+                outputs = lax.psum(outputs, axis)
+            return outputs
+
+        import inspect
+        kw = {}
+        sig_params = inspect.signature(shard_map).parameters
+        if "check_vma" in sig_params:  # jax>=0.8 name
+            kw["check_vma"] = False
+        elif "check_rep" in sig_params:
+            kw["check_rep"] = False
+        out = shard_map(
+            per_stage, mesh=self.mesh,
+            in_specs=(P(axis), P()),  # params sharded by stage
+            out_specs=P(),
+            **kw,
+        )(stacked, mb)
+        return out.reshape(B, *out.shape[2:])
+
+
+def pipeline_apply(stage_fns, stage_params, x, mesh, axis="pp",
+                   n_microbatches=None):
+    """Functional one-shot wrapper around PipelineRunner."""
+    return PipelineRunner(stage_fns, mesh, axis).apply(
+        stage_params, x, n_microbatches)
