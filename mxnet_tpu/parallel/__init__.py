@@ -280,3 +280,4 @@ class DataParallelTrainer:
 
 from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401,E402
 from .pipeline import PipelineRunner, pipeline_apply  # noqa: F401,E402
+from .moe import MoELayer  # noqa: F401,E402

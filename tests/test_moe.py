@@ -1,0 +1,76 @@
+"""Expert-parallel MoE tests on the virtual 8-device mesh."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import Mesh
+from mxnet_tpu.parallel.moe import MoELayer
+
+
+def _mesh(n, axis="ep"):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip("needs %d devices" % n)
+    return Mesh(onp.array(devs[:n]), (axis,))
+
+
+def test_moe_matches_dense_reference():
+    mesh = _mesh(4)
+    moe = MoELayer(num_experts=8, d_model=16, d_hidden=32, mesh=mesh,
+                   capacity_factor=64.0)  # no capacity drops
+    params = moe.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (32, 16))
+    y = moe.apply(params, x)
+    ref = moe.dense_reference(params, x)
+    onp.testing.assert_allclose(onp.asarray(y), onp.asarray(ref),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_drops_zero_tokens():
+    mesh = _mesh(2)
+    moe = MoELayer(num_experts=2, d_model=8, d_hidden=8, mesh=mesh,
+                   capacity_factor=0.25)  # tiny capacity → drops
+    params = moe.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (16, 8))
+    y = onp.asarray(moe.apply(params, x))
+    ref = onp.asarray(moe.dense_reference(params, x))
+    # dropped tokens are exactly zero; surviving ones match the reference
+    dropped = onp.all(y == 0, axis=-1)
+    assert dropped.any()  # capacity actually binds
+    onp.testing.assert_allclose(y[~dropped], ref[~dropped],
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_moe_differentiable():
+    mesh = _mesh(2)
+    moe = MoELayer(num_experts=4, d_model=8, d_hidden=16, mesh=mesh,
+                   capacity_factor=32.0)
+    params = moe.init(jax.random.key(3))
+    x = jax.random.normal(jax.random.key(4), (16, 8))
+
+    def loss(p):
+        return jnp.sum(moe.apply(p, x) ** 2)
+
+    g = jax.grad(loss)(params)
+
+    def ref_loss(p):
+        return jnp.sum(moe.dense_reference(p, x) ** 2)
+
+    g_ref = jax.grad(ref_loss)(params)
+    for k in ("w_in", "w_out"):
+        onp.testing.assert_allclose(onp.asarray(g[k]),
+                                    onp.asarray(g_ref[k]),
+                                    rtol=1e-3, atol=1e-4)
+
+
+def test_moe_jit_compiles_once():
+    mesh = _mesh(2)
+    moe = MoELayer(num_experts=2, d_model=8, d_hidden=8, mesh=mesh)
+    params = moe.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    f = jax.jit(lambda p, xs: moe.apply(p, xs))
+    y1 = f(params, x)
+    y2 = f(params, x)
+    onp.testing.assert_allclose(onp.asarray(y1), onp.asarray(y2))
