@@ -58,6 +58,11 @@ class Trainer:
             self._kvstore = kv_create(self._kvstore_type)
         self._kv_initialized = True
         kv = self._kvstore
+        if self._update_on_kvstore is None and kv is not None:
+            # reference _init_kvstore defaults update_on_kvstore=True for
+            # dist stores (trainer.py:188); mandatory for dist_async, where
+            # the server refuses pushes without an updater
+            self._update_on_kvstore = kv.type.startswith("dist")
         if kv is not None and self._update_on_kvstore:
             # set the optimizer BEFORE seeding params: dist stores disable
             # big-array slicing under a server-side optimizer, and the

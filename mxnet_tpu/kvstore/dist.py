@@ -16,14 +16,23 @@ layers CommDevice under kvstore_dist.  Roles come from the same DMLC_*
 envs and are launched by tools/launch.py (dmlc-tracker local-mode
 analog).
 
-Wire protocol: 8-byte big-endian length + pickled dict.
-  {"op": "init"|"push"|"pull"|"barrier"|"set_optimizer"|"stop", ...}
+Wire protocol (non-executable — no pickle on the data path; the
+reference's ps-lite likewise ships plain tensor buffers):
+  8B header-len | JSON header | 8B frame-count | (8B len | raw bytes)*
+Arrays appear in the header as {"__nd__": i, "dtype", "shape"} references
+into the frame list.  The only pickled payload is the server-side
+optimizer blob (set_optimizer), decoded with a restricted Unpickler that
+admits mxnet_tpu/numpy classes only.
 Sync mode: the server buffers one push per worker per round, then
 aggregates (and applies the optimizer if set); pulls block until the
-puller's round is applied.  Async mode: pushes apply immediately.
+puller's round is applied.  Async mode: pushes apply immediately and
+REQUIRE a server-side optimizer (reference kvstore_dist_server.h:359
+CHECK(sync_mode_) "Updater needs to be set for async mode").
 """
 from __future__ import annotations
 
+import io
+import json
 import os
 import pickle
 import socket
@@ -43,9 +52,41 @@ __all__ = ["KVStoreDist", "KVStoreDistServer", "run_server"]
 _LEN = struct.Struct(">Q")
 
 
+def _encode_msg(obj):
+    """dict (may contain numpy arrays / bytes) → framed wire bytes."""
+    frames = []
+
+    def enc(v):
+        if isinstance(v, onp.ndarray):
+            a = onp.ascontiguousarray(v)
+            frames.append(a.tobytes())
+            return {"__nd__": len(frames) - 1, "dtype": a.dtype.str,
+                    "shape": list(a.shape)}
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            frames.append(bytes(v))
+            return {"__bytes__": len(frames) - 1}
+        if isinstance(v, dict):
+            return {str(k): enc(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [enc(x) for x in v]
+        if isinstance(v, onp.floating):
+            return float(v)
+        if isinstance(v, onp.integer):
+            return int(v)
+        if isinstance(v, onp.bool_):
+            return bool(v)
+        return v
+
+    header = json.dumps(enc(obj)).encode("utf-8")
+    parts = [_LEN.pack(len(header)), header, _LEN.pack(len(frames))]
+    for f in frames:
+        parts.append(_LEN.pack(len(f)))
+        parts.append(f)
+    return b"".join(parts)
+
+
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=4)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    sock.sendall(_encode_msg(obj))
 
 
 def _recv_exact(sock, n):
@@ -59,8 +100,48 @@ def _recv_exact(sock, n):
 
 
 def _recv_msg(sock):
-    (n,) = _LEN.unpack(_recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    (hlen,) = _LEN.unpack(_recv_exact(sock, 8))
+    header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    (nframes,) = _LEN.unpack(_recv_exact(sock, 8))
+    frames = []
+    for _ in range(nframes):
+        (flen,) = _LEN.unpack(_recv_exact(sock, 8))
+        frames.append(_recv_exact(sock, flen))
+
+    def dec(v):
+        if isinstance(v, dict):
+            if "__nd__" in v:
+                return onp.frombuffer(
+                    frames[v["__nd__"]],
+                    dtype=onp.dtype(v["dtype"])).reshape(v["shape"])
+            if "__bytes__" in v:
+                return frames[v["__bytes__"]]
+            return {k: dec(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [dec(x) for x in v]
+        return v
+
+    return dec(header)
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Unpickler for the optimizer blob only: admits mxnet_tpu / numpy /
+    collections globals, rejecting everything else (os, subprocess,
+    builtins.eval, ...) so a hostile peer can't run code via pickle."""
+
+    _ALLOWED_ROOTS = ("mxnet_tpu", "numpy", "collections")
+    _ALLOWED_EXACT = (("types", "SimpleNamespace"),)  # Trainer lr/wd mults
+
+    def find_class(self, module, name):
+        if (module.split(".")[0] in self._ALLOWED_ROOTS
+                or (module, name) in self._ALLOWED_EXACT):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            "disallowed global %s.%s in optimizer blob" % (module, name))
+
+
+def _loads_optimizer(blob):
+    return _RestrictedUnpickler(io.BytesIO(blob)).load()
 
 
 def _env(name, default=None):
@@ -100,7 +181,11 @@ class KVStoreDistServer:
         kvstore_server.py:74)."""
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("0.0.0.0", self.port))
+        # bind to the advertised interface, not 0.0.0.0 — the wire carries
+        # framed tensors, but there's still no reason to listen wide open
+        bind_host = _env("DMLC_PS_BIND_URI",
+                         _env("DMLC_PS_ROOT_URI", "127.0.0.1"))
+        self._sock.bind((bind_host, self.port))
         self._sock.listen(64)
         self._sock.settimeout(0.2)
         if ready_event is not None:
@@ -166,7 +251,7 @@ class KVStoreDistServer:
             return {"ok": True}
         if op == "set_optimizer":
             from ..optimizer import Updater
-            optimizer = pickle.loads(msg["optimizer"])
+            optimizer = _loads_optimizer(msg["optimizer"])
             with self.cond:
                 self.updater = Updater(optimizer)
             return {"ok": True}
@@ -203,14 +288,16 @@ class KVStoreDistServer:
         sync = msg.get("sync", self.sync)
         with self.cond:
             if not sync:
-                # async: apply immediately (reference async mode)
-                if self.updater is not None:
-                    self._apply(key, value)
-                else:
-                    base = self.store.get(key)
-                    self.store[key] = value if base is None else base + value
-                    self.applied_round[key] = \
-                        self.applied_round.get(key, 0) + 1
+                # async: apply immediately.  Without a server-side
+                # optimizer an async push would accumulate raw gradients
+                # into the weights forever — the reference hard-fails here
+                # (kvstore_dist_server.h:359 CHECK(sync_mode_)).
+                if self.updater is None:
+                    raise RuntimeError(
+                        "updater needs to be set for async mode "
+                        "(call kv.set_optimizer / use Trainer with "
+                        "update_on_kvstore=True)")
+                self._apply(key, value)
                 self.cond.notify_all()
                 return {"ok": True}
             # per-rank queues: a worker may push the same key again before

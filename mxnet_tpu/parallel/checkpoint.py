@@ -43,17 +43,28 @@ def save_checkpoint(path, params, step=0):
     tree = _to_tree(params)
     try:
         import orbax.checkpoint as ocp
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(path, "step_%d" % step), tree, force=True)
-        ckptr.wait_until_finished()
+    except ImportError:
+        ocp = None
+    if ocp is not None:
+        # real save errors (disk full, sharded-array failures) propagate —
+        # only orbax's absence falls back to npz.  A partial step dir is
+        # removed so a later load can't prefer it over a good npz.
+        step_dir = os.path.join(path, "step_%d" % step)
+        try:
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(step_dir, tree, force=True)
+            ckptr.wait_until_finished()
+        except Exception:
+            import shutil
+            shutil.rmtree(step_dir, ignore_errors=True)
+            raise
         return path
-    except Exception:
-        # single-host fallback: plain npz
-        os.makedirs(path, exist_ok=True)
-        arrays = {k: onp.asarray(v) for k, v in tree.items()}
-        with open(os.path.join(path, "step_%d.npz" % step), "wb") as f:
-            onp.savez(f, **arrays)
-        return path
+    # single-host fallback: plain npz
+    os.makedirs(path, exist_ok=True)
+    arrays = {k: onp.asarray(v) for k, v in tree.items()}
+    with open(os.path.join(path, "step_%d.npz" % step), "wb") as f:
+        onp.savez(f, **arrays)
+    return path
 
 
 def load_checkpoint(path, params, step=0):

@@ -59,12 +59,14 @@ class MoELayer:
         axis on dim 0 (each device works on T_total/shards tokens)."""
         E, shards, axis = self.E, self.n_shards, self.axis
         e_local = E // shards
-        T = x.shape[0]
-        C = max(1, int(self.capacity_factor * T / E))  # per-expert bucket
 
         def local(router, w_in, w_out, xs):
-            # xs: [T, D] this device's tokens; w_* arrive with a leading
-            # sharded dim of size 1 (this shard's experts)
+            # xs: [T_local, D] this device's tokens; w_* arrive with a
+            # leading sharded dim of size 1 (this shard's experts).
+            # Capacity follows the GShard/Switch recipe from PER-DEVICE
+            # tokens, so the [E, C, D] dispatch buffers stay constant as
+            # the ep axis grows (per-expert total capacity = shards * C).
+            C = max(1, int(self.capacity_factor * xs.shape[0] / E))
             w_in = w_in[0]                            # [e_local, D, H]
             w_out = w_out[0]                          # [e_local, H, D]
             logits = xs @ router                      # [T, E]

@@ -1,0 +1,93 @@
+"""Unit tests for the dist-kvstore wire protocol + server guards (no
+multi-process launch): non-executable framing, restricted optimizer
+unpickling, and the async-mode updater requirement (reference
+kvstore_dist_server.h:359 CHECK)."""
+import pickle
+import socket
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu.kvstore.dist import (
+    KVStoreDistServer, _encode_msg, _loads_optimizer, _recv_msg, _send_msg)
+
+
+def _roundtrip(obj):
+    a, b = socket.socketpair()
+    try:
+        _send_msg(a, obj)
+        return _recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_roundtrip_arrays_and_scalars():
+    msg = {"op": "push", "key": "3", "rank": 1, "sync": True,
+           "value": onp.arange(12, dtype=onp.float32).reshape(3, 4),
+           "meta": {"type": "2bit", "threshold": 0.5, "shape": [3, 4]},
+           "blob": b"\x00\x01raw", "flag": None, "nested": [1, 2.5, "s"]}
+    out = _roundtrip(msg)
+    onp.testing.assert_array_equal(out.pop("value"), msg.pop("value"))
+    assert out.pop("blob") == msg.pop("blob")
+    assert out == msg
+
+
+def test_wire_roundtrip_dtypes():
+    for dt in ("float32", "float64", "int32", "int64", "uint8", "bool"):
+        v = onp.array([[1, 0], [3, 1]], dtype=dt)
+        out = _roundtrip({"value": v})["value"]
+        assert out.dtype == v.dtype
+        onp.testing.assert_array_equal(out, v)
+
+
+def test_wire_is_not_pickle():
+    # the frame must not be a pickle payload: loading it as pickle fails
+    payload = _encode_msg({"op": "pull", "key": "0"})
+    with pytest.raises(Exception):
+        pickle.loads(payload)
+
+
+def test_restricted_unpickler_rejects_hostile_globals():
+    class Evil:
+        def __reduce__(self):
+            import os
+            return (os.system, ("true",))
+
+    blob = pickle.dumps(Evil())
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        _loads_optimizer(blob)
+
+
+def test_restricted_unpickler_loads_real_optimizer():
+    from types import SimpleNamespace
+    from mxnet_tpu import optimizer as opt_mod
+    opt = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9)
+    opt.param_dict = {0: SimpleNamespace(lr_mult=1.0, wd_mult=1.0)}
+    out = _loads_optimizer(pickle.dumps(opt))
+    assert out.learning_rate == pytest.approx(0.1)
+    assert out.param_dict[0].lr_mult == 1.0
+
+
+def test_async_push_without_updater_raises():
+    server = KVStoreDistServer(port=0, num_workers=1, sync=False)
+    server._handle({"op": "init", "key": "0",
+                    "value": onp.zeros(4, onp.float32)})
+    with pytest.raises(RuntimeError, match="[Uu]pdater"):
+        server._handle({"op": "push", "key": "0", "rank": 0,
+                        "value": onp.ones(4, onp.float32), "sync": False})
+
+
+def test_async_push_with_updater_applies():
+    server = KVStoreDistServer(port=0, num_workers=1, sync=False)
+    server._handle({"op": "init", "key": "0",
+                    "value": onp.zeros(4, onp.float32)})
+    from mxnet_tpu import optimizer as opt_mod
+    blob = pickle.dumps(opt_mod.create("sgd", learning_rate=1.0))
+    server._handle({"op": "set_optimizer", "optimizer": blob})
+    server._handle({"op": "push", "key": "0", "rank": 0,
+                    "value": onp.ones(4, onp.float32), "sync": False})
+    r = server._handle({"op": "pull", "key": "0", "round": 1})
+    assert r["ok"]
+    # sgd with lr=1.0, wd=0: w -= 1.0 * grad
+    onp.testing.assert_allclose(r["value"], -onp.ones(4), rtol=1e-6)
