@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import os
 import threading
-from collections import deque
 
 import numpy as onp
 
@@ -38,39 +37,63 @@ __all__ = ["ndarray", "NDArray", "apply_op", "from_numpy", "waitall"]
 # engine shims: NaiveEngine mode + waitall tracking
 # --------------------------------------------------------------------------
 _NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
-_RECENT = deque(maxlen=128)  # recently produced buffers, for waitall()
-_RECENT_LOCK = threading.Lock()
+_PENDING = []  # ALL in-flight buffers, for waitall() completeness
+_PENDING_LOCK = threading.Lock()
+_PENDING_PRUNE_AT = 256  # amortized prune threshold (keeps memory bounded)
+_DEFERRED_ERRORS = []  # async failures observed during pruning
+
+
+def _prune_pending_locked():
+    """Drop buffers whose computation already finished (their references
+    would otherwise pin memory); completed-with-error buffers stash their
+    exception for the next waitall()."""
+    kept = []
+    for buf in _PENDING:
+        try:
+            ready = buf.is_ready()
+        except Exception:
+            ready = True
+        if not ready:
+            kept.append(buf)
+        else:
+            try:
+                jax.block_until_ready(buf)  # no-op when ready; surfaces errors
+            except Exception as e:
+                _DEFERRED_ERRORS.append(e)
+    _PENDING[:] = kept
 
 
 def _track(data):
     if isinstance(data, jax.Array) and not isinstance(data, jax.core.Tracer):
-        try:
-            if _NAIVE:
-                jax.block_until_ready(data)
-            else:
-                with _RECENT_LOCK:
-                    _RECENT.append(data)
-        except Exception:
-            pass
+        if _NAIVE:
+            jax.block_until_ready(data)
+            return
+        with _PENDING_LOCK:
+            _PENDING.append(data)
+            if len(_PENDING) >= _PENDING_PRUNE_AT:
+                _prune_pending_locked()
 
 
 def waitall():
-    """Block until all pending async work completes.
+    """Block until ALL pending async work completes.
 
     Parity: mx.nd.waitall → Engine::WaitForAll
-    (src/engine/threaded_engine.cc:416). PJRT orders work per device, so
-    blocking on recently produced buffers drains the queues; exceptions
-    raised by async computations surface here (reference: engine
-    ExceptionRef rethrow at sync points).
+    (src/engine/threaded_engine.cc:416). Every produced buffer is tracked
+    until observed ready (not a bounded recent-window), so no in-flight
+    computation — or async failure — can slip past a waitall().
     """
-    with _RECENT_LOCK:
-        pending = list(_RECENT)
-        _RECENT.clear()
+    with _PENDING_LOCK:
+        pending = list(_PENDING)
+        _PENDING.clear()
+        errors = list(_DEFERRED_ERRORS)
+        _DEFERRED_ERRORS.clear()
     for buf in pending:
         try:
             jax.block_until_ready(buf)
-        except Exception:
-            raise
+        except Exception as e:
+            errors.append(e)
+    if errors:
+        raise errors[0]
 
 
 # --------------------------------------------------------------------------
