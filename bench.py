@@ -1,49 +1,84 @@
-"""Benchmark: ResNet-50 training throughput (img/s/chip) on the live device.
+"""Benchmark: all five BASELINE.json configs in one run, one JSON line.
 
-Baseline: 298.51 img/s — MXNet 1.2 + cuDNN on V100, batch 32, fp32
-(BASELINE.md "ResNet-50 training, bs=32").  Prints ONE JSON line.
+Configs (BASELINE.json "configs"):
+  1. lenet       — Gluon LeNet, imperative NDArray loop (eager dispatch)
+  2. resnet50    — hybridized ResNet-50 training, fp32 bs=32 (the r1
+                   headline) and bf16 at a chip-filling batch
+  3. bert        — BERT-base bf16 + flash attention, tokens/s/chip
+  4. resnet50_dp — data-parallel ResNet-50 through kvstore=tpu_ici
+                   (imperative Trainer + XLA all-reduce path)
+  5. lstm        — LSTM word LM (example/rnn medium: 2x650, bptt 35),
+                   lax.scan fused kernel, tokens/s/chip
 
-The whole training step (fwd + bwd + SGD-momentum update) compiles to a
-single donated-buffer XLA executable via parallel.DataParallelTrainer —
-the TPU-native equivalent of the reference's CachedOp static executor +
-fused optimizer kernels.
+Baselines (BASELINE.md): ResNet-50 V100 fp32 bs=32 → 298.51 img/s,
+bs=128 → 363.69 img/s; BERT/LSTM use mid V100-fp16-class estimates
+(no published reference table; documented inline).
+
+Prints ONE JSON line: headline = best ResNet-50 number, with every
+config under "all".  BENCH_CONFIGS=csv subsets (e.g. "resnet50,bert").
 """
 from __future__ import annotations
 
 import json
+import os
 import time
+import traceback
 
 import numpy as onp
 
 import jax
 import jax.numpy as jnp
 
-BASELINE_IMGS_PER_SEC = 298.51  # V100 bs=32 fp32 (BASELINE.md)
+BASELINES = {
+    "resnet50_train_imgs_per_sec_per_chip": 298.51,        # V100 bs=32 fp32
+    "resnet50_train_bf16_imgs_per_sec_per_chip": 363.69,   # V100 bs=128 fp32
+    "resnet50_dp_kvstore_ici_imgs_per_sec_per_chip": 298.51,
+    "bert_base_train_tokens_per_sec_per_chip": 15000.0,    # V100 fp16 est.
+    "lstm_lm_train_tokens_per_sec_per_chip": 20000.0,      # V100 cuDNN est.
+    "lenet_imperative_imgs_per_sec": None,                 # no published ref
+}
 
 
-def main():
+def _on_tpu():
+    return jax.default_backend() not in ("cpu",)
+
+
+def _entry(name, value, unit):
+    base = BASELINES.get(name)
+    return {"value": round(value, 2), "unit": unit,
+            "vs_baseline": round(value / base, 3) if base else None}
+
+
+# ---------------------------------------------------------------------------
+# config 2: hybridized ResNet-50 via the fused dp trainer
+# ---------------------------------------------------------------------------
+def bench_resnet50(dtype="float32", batch=None, iters=None, warmup=None):
     import mxnet_tpu as mx
     from mxnet_tpu import np as mxnp
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
     from mxnet_tpu.parallel import DataParallelTrainer, Mesh
 
-    mx.random.seed(0)
-    on_tpu = jax.default_backend() not in ("cpu",)
-    batch = 32 if on_tpu else 8
-    iters = 30 if on_tpu else 3
-    warmup = 5 if on_tpu else 1
+    on_tpu = _on_tpu()
+    if batch is None:
+        batch = (32 if dtype == "float32" else 256) if on_tpu else 8
+    iters = iters if iters is not None else (30 if on_tpu else 3)
+    warmup = warmup if warmup is not None else (5 if on_tpu else 1)
 
+    mx.random.seed(0)
     net = resnet50_v1(classes=1000)
     net.initialize(mx.init.Xavier())
     x = mxnp.random.uniform(size=(batch, 3, 224, 224))
     y = mxnp.random.randint(0, 1000, size=(batch,))
     net(x[:1])  # finalize deferred shapes
+    if dtype != "float32":
+        net.cast(dtype)
+        x = x.astype(dtype)
 
     loss_obj = SoftmaxCrossEntropyLoss()
 
     def loss_fn(out, label):
-        return loss_obj(out, label)
+        return loss_obj(out.astype("float32"), label)
 
     mesh = Mesh(onp.array(jax.devices()[:1]), ("dp",))
     trainer = DataParallelTrainer(net, loss_fn, "sgd",
@@ -64,32 +99,64 @@ def main():
     last_loss = float(loss)  # host fetch inside the timing window
     dt = time.perf_counter() - t0
 
-    # execution proof: the optimizer chain must actually have run
     assert onp.isfinite(last_loss) and last_loss != first_loss, (
         "training step did not execute (loss %r -> %r)"
         % (first_loss, last_loss))
-
-    imgs_per_sec = batch * iters / dt
-    print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_per_chip",
-        "value": round(imgs_per_sec, 2),
-        "unit": "img/s",
-        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
-    }))
+    return batch * iters / dt
 
 
-def main_bert():
-    """BENCH_MODEL=bert: BERT-base bf16 + flash-attention training
-    tokens/s/chip (BASELINE config #3; V100-class fp16 BERT pretraining
-    runs ~10-20k tokens/s)."""
+# ---------------------------------------------------------------------------
+# config 4: data-parallel via kvstore=tpu_ici (imperative Trainer path)
+# ---------------------------------------------------------------------------
+def bench_resnet50_dp_kvstore():
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp, autograd, gluon
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    on_tpu = _on_tpu()
+    batch = 32 if on_tpu else 4
+    iters = 6 if on_tpu else 2
+
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore="tpu_ici")
+    x = mxnp.random.uniform(size=(batch, 3, 224, 224))
+    y = mxnp.random.randint(0, 1000, size=(batch,))
+
+    def step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch)
+        return float(loss.mean())
+
+    first = step()  # compile + warmup
+    step()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        last = step()
+    dt = time.perf_counter() - t0
+    assert onp.isfinite(last) and last != first, (first, last)
+    return batch * iters / dt
+
+
+# ---------------------------------------------------------------------------
+# config 3: BERT-base bf16 + flash attention
+# ---------------------------------------------------------------------------
+def bench_bert():
     import mxnet_tpu as mx
     from mxnet_tpu import np as mxnp
     from mxnet_tpu.models.bert import bert_base
     from mxnet_tpu.parallel import functionalize
 
     mx.random.seed(0)
-    on_tpu = jax.default_backend() not in ("cpu",)
-    B, L = (16, 128) if on_tpu else (2, 64)
+    on_tpu = _on_tpu()
+    B, L = (32, 128) if on_tpu else (2, 64)
     iters = 20 if on_tpu else 2
 
     net = bert_base()
@@ -129,20 +196,176 @@ def main_bert():
         l, pv = step(pv, tok, labels)
     last = float(l)
     dt = time.perf_counter() - t0
-    # execution proof: params actually moved the loss
     assert onp.isfinite(last) and last != first, (first, last)
-    tps = iters * B * L / dt
-    print(json.dumps({
-        "metric": "bert_base_train_tokens_per_sec_per_chip",
-        "value": round(tps, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tps / 15000.0, 3),  # mid V100-fp16 estimate
-    }))
+    return iters * B * L / dt
+
+
+# ---------------------------------------------------------------------------
+# config 5: LSTM word LM (example/rnn medium config)
+# ---------------------------------------------------------------------------
+def bench_lstm_lm():
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu.gluon import nn, rnn, HybridBlock
+    from mxnet_tpu.parallel import functionalize
+
+    on_tpu = _on_tpu()
+    vocab, emsize, nhid, nlayers = 10000, 650, 650, 2
+    B, T = (32, 35) if on_tpu else (4, 8)
+    iters = 20 if on_tpu else 2
+
+    class WordLM(HybridBlock):
+        """example/rnn/word_lm model: embed → stacked LSTM → decoder
+        (reference example/rnn/word_lm/model.py RNNModel)."""
+
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(vocab, emsize)
+            self.lstm = rnn.LSTM(nhid, num_layers=nlayers, layout="NTC",
+                                 input_size=emsize)
+            self.decoder = nn.Dense(vocab, flatten=False,
+                                    in_units=nhid)
+
+        def forward(self, x):
+            return self.decoder(self.lstm(self.embed(x)))
+
+    mx.random.seed(0)
+    net = WordLM()
+    net.initialize(mx.init.Xavier())
+    tokens = mxnp.random.randint(0, vocab, size=(B, T))
+    net(tokens)
+    fn, params = functionalize(net, train=True)
+    pvals = {k: p._data._data for k, p in params.items()}
+    labels = jax.random.randint(jax.random.key(0), (B, T), 0, vocab)
+
+    def loss_fn(pv, tok, lab):
+        out, _aux = fn(pv, tok)
+        lp = jax.nn.log_softmax(out.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(lp, lab[..., None], -1))
+
+    @jax.jit
+    def step(pv, tok, lab):
+        l, g = jax.value_and_grad(loss_fn)(pv, tok, lab)
+        return l, jax.tree.map(
+            lambda p, gg: p - 0.1 * gg.astype(p.dtype), pv, g)
+
+    tok = tokens._data
+    l, pv = step(pvals, tok, labels)
+    jax.block_until_ready(l)
+    first = float(l)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        l, pv = step(pv, tok, labels)
+    last = float(l)
+    dt = time.perf_counter() - t0
+    assert onp.isfinite(last) and last != first, (first, last)
+    return iters * B * T / dt
+
+
+# ---------------------------------------------------------------------------
+# config 1: imperative LeNet (eager NDArray dispatch, no hybridize)
+# ---------------------------------------------------------------------------
+def bench_lenet():
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp, autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    on_tpu = _on_tpu()
+    batch = 64
+    iters = 20 if on_tpu else 3
+
+    mx.random.seed(0)
+    net = nn.Sequential()
+    net.add(nn.Conv2D(6, 5, activation="tanh"), nn.MaxPool2D(2),
+            nn.Conv2D(16, 5, activation="tanh"), nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(120, activation="tanh"),
+            nn.Dense(84, activation="tanh"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    x = mxnp.random.uniform(size=(batch, 1, 28, 28))
+    y = mxnp.random.randint(0, 10, size=(batch,))
+
+    def step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch)
+        return float(loss.mean())
+
+    first = step()
+    step()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        last = step()
+    dt = time.perf_counter() - t0
+    assert onp.isfinite(last) and last != first, (first, last)
+    return batch * iters / dt
+
+
+# ---------------------------------------------------------------------------
+BENCHES = [
+    # (config key, metric name, unit, thunk)
+    ("resnet50", "resnet50_train_imgs_per_sec_per_chip", "img/s",
+     lambda: bench_resnet50("float32")),
+    ("resnet50_bf16", "resnet50_train_bf16_imgs_per_sec_per_chip", "img/s",
+     lambda: bench_resnet50("bfloat16")),
+    ("bert", "bert_base_train_tokens_per_sec_per_chip", "tokens/s",
+     bench_bert),
+    ("lstm", "lstm_lm_train_tokens_per_sec_per_chip", "tokens/s",
+     bench_lstm_lm),
+    ("resnet50_dp", "resnet50_dp_kvstore_ici_imgs_per_sec_per_chip", "img/s",
+     bench_resnet50_dp_kvstore),
+    ("lenet", "lenet_imperative_imgs_per_sec", "img/s", bench_lenet),
+]
+
+
+def main():
+    only = os.environ.get("BENCH_CONFIGS")
+    only = set(s.strip() for s in only.split(",")) if only else None
+    all_results = {}
+    for key, metric, unit, thunk in BENCHES:
+        if only is not None and key not in only:
+            continue
+        last_err = None
+        for attempt in range(2):  # one retry: the axon tunnel can flake
+            try:
+                value = thunk()
+                all_results[metric] = _entry(metric, value, unit)
+                last_err = None
+                break
+            except Exception as e:
+                last_err = {"error": "%s: %s" % (type(e).__name__, e),
+                            "trace": traceback.format_exc()[-1500:]}
+                time.sleep(2)
+        if last_err is not None:
+            all_results[metric] = last_err
+
+    # headline: best ResNet-50 training number (north-star metric)
+    headline = None
+    for metric in ("resnet50_train_bf16_imgs_per_sec_per_chip",
+                   "resnet50_train_imgs_per_sec_per_chip"):
+        r = all_results.get(metric)
+        if r and "value" in r:
+            headline = {"metric": metric, "value": r["value"],
+                        "unit": r["unit"], "vs_baseline": r["vs_baseline"]}
+            break
+    if headline is None and all_results:  # every resnet bench failed
+        metric, r = next(iter(all_results.items()))
+        headline = {"metric": metric, "value": r.get("value", -1),
+                    "unit": "n/a", "vs_baseline": 0}
+    if headline is None:  # nothing ran (bad BENCH_CONFIGS filter)
+        headline = {"metric": "none", "value": -1, "unit": "n/a",
+                    "vs_baseline": 0,
+                    "error": "no configs selected (BENCH_CONFIGS=%r; "
+                             "known: %s)" % (os.environ.get("BENCH_CONFIGS"),
+                                             [b[0] for b in BENCHES])}
+    headline["all"] = all_results
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":
-    import os
-    if os.environ.get("BENCH_MODEL", "resnet50") == "bert":
-        main_bert()
-    else:
-        main()
+    main()

@@ -197,12 +197,21 @@ _default_lock = threading.Lock()
 
 
 def default_engine():
-    """Process-global host engine (parity: Engine::Get())."""
+    """Process-global host engine (parity: Engine::Get()).
+
+    Pool size: MXNET_CPU_WORKER_NTHREADS, else max(4, cores).  Unlike the
+    reference's compute pools, this pool runs IO-bound host ops (sockets,
+    checkpoint writes, batch decode) — more threads than cores is the
+    point, and a 1-core container must still overlap its IO."""
     global _default_engine
     if _default_engine is None:
         with _default_lock:
             if _default_engine is None:
-                _default_engine = Engine()
+                nw = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "0")
+                         or 0)
+                if nw <= 0:
+                    nw = max(4, os.cpu_count() or 1)
+                _default_engine = Engine(num_workers=nw)
     return _default_engine
 
 

@@ -2,10 +2,16 @@
 
 TPU-native notes: the reference's multiprocessing workers + POSIX-shm
 NDArray IPC exist to hide CPU decode/augment latency behind GPU compute.
-Here batches are assembled on host (NumPy, optionally in a thread pool) and
-handed to PJRT with async H2D transfer; `pin_memory` maps to committed host
-buffers.  A prefetch queue of ready batches overlaps input with device
-compute, mirroring iter_prefetcher.h's double buffering.
+Here batches are assembled on host (NumPy) and handed to PJRT with async
+H2D transfer; `pin_memory` maps to committed host buffers.  A prefetch
+queue of ready batches overlaps input with device compute, mirroring
+iter_prefetcher.h's double buffering.
+
+With num_workers > 0, batch assembly runs through the native host
+dependency engine (src/mxtpu/engine.cc worker pool): each batch is pushed
+with its own write var, the consumer waits on the var — the reference's
+threaded iter pipeline (iter_prefetcher.h) expressed as engine read/write
+deps.  Falls back to a dummy-mp thread pool when the native lib is absent.
 """
 from __future__ import annotations
 
@@ -60,19 +66,77 @@ class DataLoader:
                 "exclusive with batch_sampler")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
-        self._pool = (mp_dummy.Pool(self._num_workers)
-                      if self._num_workers > 0 else None)
+        self._pool = None
 
     def _make_batch(self, indices):
         samples = [self._dataset[i] for i in indices]
         return self._batchify_fn(samples)
 
     def __iter__(self):
-        if self._pool is None:
+        if self._num_workers <= 0 or self._prefetch <= 0:
+            # prefetch=0 degrades to synchronous assembly (a 0-deep
+            # pipeline must still produce every batch)
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
-        # thread-pool prefetch pipeline (double-buffering analog)
+        from ...engine import default_engine
+        eng = default_engine()
+        if eng.is_native:
+            yield from self._iter_engine(eng)
+        else:
+            yield from self._iter_pool()
+
+    def _iter_engine(self, eng):
+        """Prefetch via the native dependency engine: one write var per
+        in-flight batch; the pop waits on the var (errors from dataset /
+        batchify code poison the var and re-raise here)."""
+        results = {}
+        pending = deque()  # (batch_id, var)
+        it = iter(self._batch_sampler)
+        bid = 0
+
+        def submit(indices):
+            nonlocal bid
+            bid += 1
+            my_id = bid
+            var = eng.new_variable()
+
+            def work():
+                results[my_id] = self._make_batch(indices)
+
+            eng.push(work, mutable_vars=[var])
+            pending.append((my_id, var))
+
+        try:
+            for _ in range(self._prefetch):
+                idx = next(it, None)
+                if idx is None:
+                    break
+                submit(idx)
+            while pending:
+                my_id, var = pending.popleft()
+                try:
+                    eng.wait_for_var(var)
+                finally:
+                    eng.delete_variable(var)
+                batch = results.pop(my_id)
+                idx = next(it, None)
+                if idx is not None:
+                    submit(idx)
+                yield batch
+        finally:
+            for _my_id, var in pending:
+                try:
+                    eng.wait_for_var(var)
+                except Exception:
+                    pass
+                eng.delete_variable(var)
+            results.clear()
+
+    def _iter_pool(self):
+        """Thread-pool fallback when the native engine is unavailable."""
+        if self._pool is None:
+            self._pool = mp_dummy.Pool(self._num_workers)
         pending = deque()
         it = iter(self._batch_sampler)
         try:

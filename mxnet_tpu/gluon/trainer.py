@@ -115,14 +115,17 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         if self._kvstore is not None and self._update_on_kvstore:
             # push pre-scaled grads; server sums across workers and
-            # updates; pull fresh weights.  Same sum semantics as the
-            # allreduce path (reference: gradients are summed, batch_size
-            # is the per-worker batch).
+            # updates; pull fresh weights.  Two phases: ALL pushes are
+            # scheduled first (dist stores run them async on engine
+            # workers), then pulls drain in the same priority order —
+            # the reference's push-overlapping-backward pipeline
+            # (gluon/trainer.py:395-407).
             scale = self._scale / batch_size
-            for i, p in enumerate(self._params):
-                if p.grad_req == "null" or p._data is None:
-                    continue
+            live = [(i, p) for i, p in enumerate(self._params)
+                    if p.grad_req != "null" and p._data is not None]
+            for i, p in live:
                 self._kvstore.push(str(i), p.grad() * scale, priority=-i)
+            for i, p in live:
                 self._kvstore.pull(str(i), out=p.data(), priority=-i)
             return
         self.allreduce_grads()
@@ -131,11 +134,20 @@ class Trainer:
     def allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, p in enumerate(self._params):
-            if p.grad_req != "null" and p._data is not None:
-                grads = p.list_grad()
-                self._kvstore.pushpull(str(i), grads[0], out=grads[0],
-                                       priority=-i)
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null" and p._data is not None]
+        try:
+            # two-phase: schedule every push, then pull — async (dist)
+            # stores overlap the socket work across keys
+            for i, p in live:
+                self._kvstore.push(str(i), p.list_grad()[0], priority=-i)
+            for i, p in live:
+                g = p.list_grad()[0]
+                self._kvstore.pull(str(i), out=g, priority=-i)
+        except NotImplementedError:
+            for i, p in live:
+                g = p.list_grad()[0]
+                self._kvstore.pushpull(str(i), g, out=g, priority=-i)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -414,6 +414,13 @@ class KVStoreDist(KVStoreBase):
     def __init__(self, name="dist_sync"):
         self._name = name
         self._sync = not name.endswith("async")
+        # host dependency engine: pushes run async on engine workers with a
+        # per-key write var, so grad pushes overlap backward compute and
+        # each other (reference: Trainer priority overlap,
+        # python/mxnet/gluon/trainer.py:395-407 + engine write deps)
+        from ..engine import default_engine
+        self._engine = default_engine()
+        self._key_vars = {}
         # P3-style slicing (reference p3store_dist.h:40 + PSKV big-array
         # splitting, kvstore_dist.h:58): arrays above the threshold are
         # pushed/pulled as independent slices spread round-robin across
@@ -455,6 +462,25 @@ class KVStoreDist(KVStoreBase):
 
     def _conn_for(self, key):
         return self._conns[self._shard_of(key)]
+
+    def _key_var(self, key):
+        """Engine write var serializing async socket work per key."""
+        var = self._key_vars.get(key)
+        if var is None:
+            var = self._engine.new_variable()
+            self._key_vars[key] = var
+        return var
+
+    def _wait_key(self, key):
+        """Drain pending async pushes for key; re-raises their errors."""
+        var = self._key_vars.get(key)
+        if var is not None:
+            self._engine.wait_for_var(var)
+
+    def wait_async(self):
+        """Block until every scheduled push has hit the wire."""
+        for key in list(self._key_vars):
+            self._wait_key(key)
 
     @property
     def type(self):
@@ -510,6 +536,12 @@ class KVStoreDist(KVStoreBase):
         self.barrier()
 
     def push(self, key, value, priority=0):
+        """Schedule the push; socket work runs on an engine worker under
+        the key's write var, overlapping compute and other keys' pushes.
+        The grad buffer is snapshotted at schedule time (device buffers
+        are immutable), so later mutation of the source can't race the
+        wire.  Errors poison the key var and re-raise at the next
+        pull/barrier/wait on that key."""
         if isinstance(key, (list, tuple)):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
@@ -517,33 +549,55 @@ class KVStoreDist(KVStoreBase):
         key = str(key)
         reduced = _reduce(value) if isinstance(value, (list, tuple)) \
             else value
-        arr = reduced.asnumpy()
-        plan = self._slice_plan(key, arr.size)
-        if plan is None:
-            items = [(key, arr, self._conn_for(key))]
+        # snapshot at schedule time: device buffers are immutable, but
+        # numpy/sparse values must be copied NOW or caller mutation races
+        # the engine worker's serialization
+        if isinstance(reduced, ndarray):
+            src = reduced._data
+        elif isinstance(reduced, onp.ndarray):
+            src = reduced.copy()
+        elif hasattr(reduced, "asnumpy"):
+            src = reduced.asnumpy()  # sparse etc. — sync dense snapshot
         else:
-            flat = arr.ravel()
-            items = [(sk, flat[a:b], c) for sk, a, b, c in plan]
-        conn_msgs = []
-        for sk, sv, conn in items:
-            if self._gc is not None:
-                packed, meta = self._gc.compress(sk, sv)
-                msg = {"op": "push", "key": sk, "rank": self._rank,
-                       "value": packed, "meta": meta, "compressed": True,
-                       "sync": self._sync}
-            else:
-                msg = {"op": "push", "key": sk, "rank": self._rank,
-                       "value": sv, "sync": self._sync}
-            conn_msgs.append((conn, msg))
-        replies = _grouped_requests(conn_msgs)
-        for r in replies:
-            if not r["ok"]:
-                raise RuntimeError("dist push failed: %s" % r.get("error"))
-        # only count rounds for pushes the servers actually accepted —
-        # bumping early would make a later pull wait forever on a round
-        # that never applied
-        for sk, _sv, _c in items:
+            src = onp.array(reduced)
+        size = getattr(reduced, "size", None)
+        if size is None:
+            size = int(onp.prod(reduced.shape))
+        plan = self._slice_plan(key, size)
+        # round accounting happens at schedule time: the push WILL land
+        # (or poison the key var, making the round-gated pull raise
+        # instead of hanging)
+        slice_keys = [key] if plan is None else [sk for sk, _, _, _ in plan]
+        for sk in slice_keys:
             self._push_round[sk] = self._push_round.get(sk, 0) + 1
+
+        def work():
+            arr = src.asnumpy() if hasattr(src, "asnumpy") else \
+                onp.asarray(src)
+            if plan is None:
+                items = [(key, arr, self._conn_for(key))]
+            else:
+                flat = arr.ravel()
+                items = [(sk, flat[a:b], c) for sk, a, b, c in plan]
+            conn_msgs = []
+            for sk, sv, conn in items:
+                if self._gc is not None:
+                    packed, meta = self._gc.compress(sk, sv)
+                    msg = {"op": "push", "key": sk, "rank": self._rank,
+                           "value": packed, "meta": meta,
+                           "compressed": True, "sync": self._sync}
+                else:
+                    msg = {"op": "push", "key": sk, "rank": self._rank,
+                           "value": sv, "sync": self._sync}
+                conn_msgs.append((conn, msg))
+            replies = _grouped_requests(conn_msgs)
+            for r in replies:
+                if not r["ok"]:
+                    raise RuntimeError("dist push failed: %s"
+                                       % r.get("error"))
+
+        self._engine.push(work, mutable_vars=[self._key_var(key)],
+                          priority=priority)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -551,6 +605,7 @@ class KVStoreDist(KVStoreBase):
                 self.pull(k, o, priority, ignore_sparse)
             return
         key = str(key)
+        self._wait_key(key)  # pending pushes land first (write→read order)
         outs = out if isinstance(out, (list, tuple)) else [out]
         plan = self._slice_plan(key, outs[0].size)
         if plan is None:
@@ -597,12 +652,16 @@ class KVStoreDist(KVStoreBase):
 
     def barrier(self):
         # the root server coordinates barriers (reference uses the
-        # scheduler; one shard suffices for correctness)
+        # scheduler; one shard suffices for correctness).  Drain this
+        # worker's async pushes first — a barrier that overtook its own
+        # pending pushes would not be a barrier.
+        self.wait_async()
         r = self._conns[0].request({"op": "barrier", "rank": self._rank})
         assert r["ok"], r
 
     def stop_servers(self):
         """Ask every server shard to exit (launcher/worker-0 teardown)."""
+        self.wait_async()
         if self._rank == 0:
             for c in self._conns:
                 try:
@@ -611,5 +670,9 @@ class KVStoreDist(KVStoreBase):
                     pass
 
     def close(self):
+        try:
+            self.wait_async()
+        except Exception:
+            pass  # closing anyway; errors already surfaced at sync points
         for c in self._conns:
             c.close()

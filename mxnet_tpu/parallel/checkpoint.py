@@ -7,10 +7,18 @@ sharded layout: each process writes its shards, metadata records the
 mesh/sharding, and restore re-shards onto the current topology.  Backed
 by orbax (the JAX-ecosystem checkpoint library) when available, with an
 npz fallback for single-host arrays.
+
+Writes are pushed through the host dependency engine (one write var per
+checkpoint path), so persisting a step overlaps the next step's compute —
+the reference's async checkpoint callback pattern expressed as engine
+write deps.  `load_checkpoint` (and `wait_for_saves`) synchronize on the
+path's var, re-raising any async save failure.
 """
 from __future__ import annotations
 
+import atexit
 import os
+import threading
 
 import numpy as onp
 
@@ -18,7 +26,61 @@ import jax
 
 from ..ndarray import ndarray
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "wait_for_saves"]
+
+_save_vars = {}  # abspath -> engine var (write-ordered saves per path)
+_save_lock = threading.Lock()
+
+
+def _path_var(path):
+    from ..engine import default_engine
+    eng = default_engine()
+    with _save_lock:
+        var = _save_vars.get(path)
+        if var is None:
+            var = eng.new_variable()
+            _save_vars[path] = var
+    return eng, var
+
+
+def wait_for_saves(path=None):
+    """Block until pending async checkpoint writes land (all paths, or
+    just `path`); re-raises the first async save failure.  A path with no
+    pending save is a no-op — it must not block on (or inherit failures
+    from) unrelated checkpoints."""
+    from ..engine import default_engine
+    eng = default_engine()
+    with _save_lock:
+        if path is not None:
+            var = _save_vars.get(os.path.abspath(path))
+            items = [(path, var)] if var is not None else []
+        else:
+            items = list(_save_vars.items())
+    for p, var in items:
+        try:
+            eng.wait_for_var(var)
+        except Exception:
+            # deliver each failure exactly once: retire the poisoned var so
+            # a later wait (or the atexit drain) doesn't re-raise it
+            with _save_lock:
+                if _save_vars.get(os.path.abspath(p)) is var:
+                    del _save_vars[os.path.abspath(p)]
+            eng.delete_variable(var)
+            raise
+
+
+def _drain_at_exit():
+    """A process exiting with an unfinished/failed async save must not
+    look like a clean run (silent checkpoint loss)."""
+    try:
+        wait_for_saves()
+    except Exception as e:
+        import sys
+        sys.stderr.write("mxnet_tpu: async checkpoint save FAILED: %s\n" % e)
+        raise
+
+
+atexit.register(_drain_at_exit)
 
 
 def _to_tree(params):
@@ -40,30 +102,39 @@ def save_checkpoint(path, params, step=0):
     keep their sharding — each host persists its addressable shards).
     """
     path = os.path.abspath(path)
-    tree = _to_tree(params)
-    try:
-        import orbax.checkpoint as ocp
-    except ImportError:
-        ocp = None
-    if ocp is not None:
-        # real save errors (disk full, sharded-array failures) propagate —
-        # only orbax's absence falls back to npz.  A partial step dir is
-        # removed so a later load can't prefer it over a good npz.
-        step_dir = os.path.join(path, "step_%d" % step)
+    tree = _to_tree(params)  # snapshot: jax buffers are immutable, so the
+    # async writer can't observe later parameter updates
+    eng, var = _path_var(path)
+
+    def write():
         try:
-            ckptr = ocp.StandardCheckpointer()
-            ckptr.save(step_dir, tree, force=True)
-            ckptr.wait_until_finished()
-        except Exception:
-            import shutil
-            shutil.rmtree(step_dir, ignore_errors=True)
-            raise
-        return path
-    # single-host fallback: plain npz
-    os.makedirs(path, exist_ok=True)
-    arrays = {k: onp.asarray(v) for k, v in tree.items()}
-    with open(os.path.join(path, "step_%d.npz" % step), "wb") as f:
-        onp.savez(f, **arrays)
+            import orbax.checkpoint as ocp
+        except ImportError:
+            ocp = None
+        if ocp is not None:
+            # real save errors (disk full, sharded-array failures)
+            # propagate — only orbax's absence falls back to npz.  A
+            # partial step dir is removed so a later load can't prefer it
+            # over a good npz.
+            step_dir = os.path.join(path, "step_%d" % step)
+            try:
+                ckptr = ocp.StandardCheckpointer()
+                ckptr.save(step_dir, tree, force=True)
+                ckptr.wait_until_finished()
+            except Exception:
+                import shutil
+                shutil.rmtree(step_dir, ignore_errors=True)
+                raise
+            return
+        # single-host fallback: plain npz
+        os.makedirs(path, exist_ok=True)
+        arrays = {k: onp.asarray(v) for k, v in tree.items()}
+        with open(os.path.join(path, "step_%d.npz" % step), "wb") as f:
+            onp.savez(f, **arrays)
+
+    # async: the write runs on an engine worker under the path's write
+    # var; training continues while bytes land
+    eng.push(write, mutable_vars=[var])
     return path
 
 
@@ -71,6 +142,7 @@ def load_checkpoint(path, params, step=0):
     """Restore into params (dict of name → Parameter/ndarray) in place;
     sharded arrays are restored with their target sharding."""
     path = os.path.abspath(path)
+    wait_for_saves(path)  # pending async writes to this path land first
     loaded = None
     ocp_dir = os.path.join(path, "step_%d" % step)
     npz = os.path.join(path, "step_%d.npz" % step)

@@ -1,0 +1,182 @@
+"""Engine wiring: comm/IO runs through the host dependency engine and
+overlaps compute (VERDICT r1 item #3 — the reference's signature
+overlap of grad push with backward, trainer.py:395-407, and the
+threaded iter pipeline, iter_prefetcher.h)."""
+import os
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.engine import EngineError, default_engine
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+
+# ---------------------------------------------------------------------------
+# DataLoader: batch assembly through engine worker pool
+# ---------------------------------------------------------------------------
+class _SlowDataset:
+    """Records the (start, end) wall-time window of each __getitem__."""
+
+    def __init__(self, n, delay):
+        self.n = n
+        self.delay = delay
+        self.windows = []
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        t0 = time.perf_counter()
+        time.sleep(self.delay)
+        with self._lock:
+            self.windows.append((t0, time.perf_counter()))
+        return onp.full((2,), i, dtype=onp.float32)
+
+
+def test_dataloader_engine_prefetch_overlaps():
+    if not default_engine().is_native:
+        pytest.skip("native engine unavailable")
+    ds = _SlowDataset(8, delay=0.15)
+    loader = DataLoader(ds, batch_size=1, num_workers=4, shuffle=False)
+    batches = [b.asnumpy() for b in loader]
+    # ordering: batches arrive in sampler order despite concurrent prep
+    assert [int(b[0][0]) for b in batches] == list(range(8))
+    # overlap: at least one pair of sample windows ran concurrently
+    ws = sorted(ds.windows)
+    overlapping = any(ws[i][1] > ws[i + 1][0] for i in range(len(ws) - 1))
+    assert overlapping, "batch assembly did not overlap: %r" % (ws,)
+
+
+def test_dataloader_engine_error_propagates():
+    if not default_engine().is_native:
+        pytest.skip("native engine unavailable")
+
+    class Bad:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                raise ValueError("bad sample 2")
+            return onp.zeros(2, onp.float32)
+
+    loader = DataLoader(Bad(), batch_size=1, num_workers=2, shuffle=False)
+    with pytest.raises(EngineError, match="bad sample 2"):
+        list(loader)
+
+
+# ---------------------------------------------------------------------------
+# dist kvstore: async push overlaps caller compute; pull orders after push
+# ---------------------------------------------------------------------------
+PORT = 19431
+
+
+class _SlowPushServer:
+    def __init__(self, delay, fail_keys=()):
+        from mxnet_tpu.kvstore.dist import KVStoreDistServer
+
+        class Srv(KVStoreDistServer):
+            def _handle_push(srv, msg):
+                if msg["key"] in fail_keys:
+                    raise RuntimeError("server rejected key %s" % msg["key"])
+                time.sleep(delay)
+                return super()._handle_push(msg)
+
+        self.server = Srv(port=PORT, num_workers=1, sync=True)
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.ready = threading.Event()
+
+    def _run(self):
+        self.server.serve(ready_event=self.ready)
+
+    def __enter__(self):
+        self.thread.start()
+        assert self.ready.wait(10)
+        return self
+
+    def __exit__(self, *exc):
+        with self.server.cond:
+            self.server._stop = True
+            self.server.cond.notify_all()
+        self.thread.join(5)
+
+
+@pytest.fixture
+def _dist_env(monkeypatch):
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(PORT))
+
+
+def test_dist_push_overlaps_caller_and_orders_before_pull(_dist_env):
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    if not default_engine().is_native:
+        pytest.skip("native engine unavailable")
+    delay = 0.4
+    with _SlowPushServer(delay):
+        kv = KVStoreDist("dist_sync")
+        try:
+            kv.init("0", mxnp.zeros(4))
+            t0 = time.perf_counter()
+            kv.push("0", mxnp.ones(4))
+            sched = time.perf_counter() - t0
+            # async: the caller got control back while the server is still
+            # sleeping on the push — this window is where backward compute
+            # overlaps in a real step
+            assert sched < delay / 2, \
+                "push blocked the caller for %.3fs" % sched
+            out = mxnp.zeros(4)
+            kv.pull("0", out=out)  # write→read ordering on the key var
+            onp.testing.assert_allclose(out.asnumpy(), 1.0)
+        finally:
+            kv.close()
+
+
+def test_dist_push_failure_poisons_key_and_raises_at_pull(_dist_env):
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    if not default_engine().is_native:
+        pytest.skip("native engine unavailable")
+    with _SlowPushServer(0.0, fail_keys=("7",)):
+        kv = KVStoreDist("dist_sync")
+        try:
+            kv.init("7", mxnp.zeros(2))
+            kv.push("7", mxnp.ones(2))
+            with pytest.raises(EngineError, match="rejected"):
+                kv.pull("7", out=mxnp.zeros(2))
+        finally:
+            kv.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: async write ordered before load; failures surface at sync
+# ---------------------------------------------------------------------------
+def test_checkpoint_async_save_then_load(tmp_path):
+    from mxnet_tpu.parallel import load_checkpoint, save_checkpoint
+    from mxnet_tpu.parallel.checkpoint import wait_for_saves
+    x = mxnp.arange(16).reshape(4, 4).astype("float32")
+    p = str(tmp_path / "ck")
+    save_checkpoint(p, {"x": x}, step=1)  # returns before bytes land
+    tgt = mxnp.zeros((4, 4))
+    load_checkpoint(p, {"x": tgt}, step=1)  # waits on the path's var
+    onp.testing.assert_allclose(tgt.asnumpy(), x.asnumpy())
+    wait_for_saves()  # idempotent
+
+
+def test_checkpoint_async_save_failure_raises_at_sync(tmp_path):
+    from mxnet_tpu.parallel import save_checkpoint
+    from mxnet_tpu.parallel.checkpoint import wait_for_saves
+    if not default_engine().is_native:
+        pytest.skip("native engine poisoning semantics needed")
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    save_checkpoint(str(blocker), {"x": mxnp.ones(2)}, step=0)
+    with pytest.raises(EngineError):
+        wait_for_saves(str(blocker))
