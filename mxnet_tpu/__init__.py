@@ -62,8 +62,8 @@ from .attribute import AttrScope
 from . import name
 from . import rtc
 from . import sparse
-from . import symbol
-from . import symbol as sym
+from . import symbol  # StableHLO deployment artifact (HybridBlock.export)
+from . import sym_api as sym  # composable graph API (mx.sym.var + ops)
 
 
 from . import test_utils

@@ -532,19 +532,51 @@ class SymbolBlock(HybridBlock):
     def imports(symbol_file, input_names=None, param_file=None, ctx=None,
                 device=None, allow_missing_params=False):
         """Load -symbol.json (+ params npz) into a runnable block
-        (parity: SymbolBlock.imports)."""
-        from ..symbol import Symbol
-        sym = Symbol.load(symbol_file)
+        (parity: SymbolBlock.imports).  Accepts BOTH serialized formats:
+        the StableHLO deployment artifact (HybridBlock.export) and the
+        composable mx.sym DAG json (Symbol.save)."""
+        from ..sym_api import load as sym_load, Symbol as GraphSymbol
+        sym = sym_load(symbol_file)
         params = {}
         if param_file:
             loaded = onp.load(param_file)
             params = {k: jnp.asarray(loaded[k]) for k in loaded.files}
+        if isinstance(sym, GraphSymbol):
+            if input_names is None:
+                input_names = [n for n in sym.list_arguments()
+                               if n not in params]
+            missing = (set(sym.list_arguments())
+                       - set(params) - set(input_names))
+            if missing and not allow_missing_params:
+                raise ValueError("missing parameters: %s" % sorted(missing))
+            blk = SymbolBlock(sym, params)
+            blk._input_names = list(input_names)
+            return blk
         missing = set(sym.param_avals) - set(params)
         if missing and not allow_missing_params:
             raise ValueError("missing parameters: %s" % sorted(missing))
         return SymbolBlock(sym, params)
 
     def forward(self, *args):
+        from ..sym_api import Symbol as GraphSymbol
+        if isinstance(self._symbol, GraphSymbol):
+            names = getattr(self, "_input_names", None) or \
+                [n for n in self._symbol.list_arguments()
+                 if n not in self._param_vals]
+
+            def run(*iv):
+                env = {k: _wrap_value(v)
+                       for k, v in self._param_vals.items()}
+                env.update(dict(zip(names, (_wrap_value(v._data
+                                            if hasattr(v, "_data") else v)
+                                            for v in iv))))
+                out = self._symbol._eval(env)
+                if isinstance(out, (list, tuple)):
+                    return type(out)(o._data if hasattr(o, "_data") else o
+                                     for o in out)
+                return out._data if hasattr(out, "_data") else out
+
+            return apply_op(lambda *iv: run(*iv), *args)
         return apply_op(lambda *iv: self._symbol(self._param_vals, *iv),
                         *args)
 

@@ -1,0 +1,849 @@
+"""Composable symbolic graph API: ``mx.sym``.
+
+Parity: reference `python/mxnet/symbol/symbol.py:57` — ``sym.var`` /
+``Variable``, operator composition, arithmetic on symbols, ``bind`` /
+``simple_bind`` / ``eval`` executors, ``Group``, ``get_internals``,
+``save`` / ``load`` / ``tojson`` — plus the legacy CamelCase op layer
+(``FullyConnected``, ``Convolution``, ...) whose missing parameter inputs
+are auto-created as variables (reference ``symbol.py`` compose semantics).
+
+TPU-native design: a Symbol is a tiny pure-Python DAG over the SAME eager
+op registry as ``mx.np``/``mx.npx`` — there is no separate graph IR to
+maintain.  ``bind()`` traces the DAG once into a jitted XLA executable,
+so the reference's nnvm-graph + GraphExecutor pair collapses into
+"Python DAG + XLA compile".  The DAG serializes to JSON (structure only)
+and ``export_artifact()`` lowers it to the StableHLO deployment artifact
+(`mxnet_tpu/symbol.py`) consumed by ``SymbolBlock.imports``.
+"""
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from . import numpy as np_mod
+from . import numpy_extension as npx_mod
+from .ndarray import ndarray, _wrap_value
+
+__all__ = ["Symbol", "Executor", "var", "Variable", "Group", "load",
+           "fromjson"]
+
+_FORMAT = "mxnet_tpu-symgraph-v1"
+
+
+# ---------------------------------------------------------------------------
+# op resolution: "np:name" / "npx:name" / "legacy:Name"
+# ---------------------------------------------------------------------------
+def _resolve_op(op_id):
+    ns, name = op_id.split(":", 1)
+    if ns == "np":
+        fn = getattr(np_mod, name, None)
+    elif ns == "npx":
+        fn = getattr(npx_mod, name, None)
+    elif ns == "legacy":
+        spec = _LEGACY.get(name)
+        fn = spec["make"] if spec else None
+    else:
+        fn = None
+    if fn is None or not callable(fn):
+        raise ValueError("unknown symbolic op %r" % op_id)
+    return fn
+
+
+class Symbol:
+    """A node in a symbolic DAG (kind: var | const | op | index | group)."""
+
+    _counter = [0]
+
+    def __init__(self, kind, name=None, op=None, inputs=(), attrs=None,
+                 shape=None, dtype=None, aux=False, index=None):
+        self._kind = kind
+        self._op = op
+        self._inputs = list(inputs)
+        self._attrs = dict(attrs or {})
+        self._shape = tuple(shape) if shape is not None else None
+        self._dtype = dtype
+        self._aux = aux
+        self._index = index
+        if name is None and kind == "op":
+            Symbol._counter[0] += 1
+            name = "%s%d" % (op.split(":", 1)[1].lower(), Symbol._counter[0])
+        self.name = name
+
+    # -- traversal ---------------------------------------------------------
+    def _topo(self):
+        """Depth-first post-order over the DAG (deduped)."""
+        seen = set()
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for i in node._inputs:
+                visit(i)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    def _leaves(self, aux=None):
+        out = []
+        for n in self._topo():
+            if n._kind == "var" and (aux is None or n._aux == aux):
+                out.append(n)
+        return out
+
+    # -- reference introspection API --------------------------------------
+    def list_arguments(self):
+        return [n.name for n in self._leaves(aux=False)]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._leaves(aux=True)]
+
+    def list_outputs(self):
+        if self._kind == "group":
+            return [i.name + "_output" for i in self._inputs]
+        return [(self.name or "out") + "_output"]
+
+    @property
+    def num_outputs(self):
+        return len(self._inputs) if self._kind == "group" else 1
+
+    def get_internals(self):
+        """Every op node's output as a Group (reference get_internals)."""
+        nodes = [n for n in self._topo() if n._kind in ("op", "index")]
+        return Group(nodes)
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            for n in self._topo():
+                if n.name == key or (n.name or "") + "_output" == key:
+                    return n
+            raise KeyError(key)
+        if self._kind == "group":
+            return self._inputs[key]
+        return Symbol("index", name="%s_o%d" % (self.name, key),
+                      inputs=[self], index=key)
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name,)
+
+    # -- arithmetic composition (reference symbol arithmetic) --------------
+    def _binop(self, other, opname, swap=False):
+        other = _as_symbol(other)
+        a, b = (other, self) if swap else (self, other)
+        return Symbol("op", op="np:" + opname, inputs=[a, b])
+
+    def __add__(self, o):
+        return self._binop(o, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "subtract")
+
+    def __rsub__(self, o):
+        return self._binop(o, "subtract", swap=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "divide")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "divide", swap=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "power")
+
+    def __matmul__(self, o):
+        return self._binop(o, "dot")
+
+    def __neg__(self):
+        return Symbol("op", op="np:negative", inputs=[self])
+
+    def __abs__(self):
+        return Symbol("op", op="np:abs", inputs=[self])
+
+    def __eq__(self, o):
+        return self._binop(o, "equal")
+
+    def __ne__(self, o):
+        return self._binop(o, "not_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "greater")
+
+    def __ge__(self, o):
+        return self._binop(o, "greater_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, "less")
+
+    def __le__(self, o):
+        return self._binop(o, "less_equal")
+
+    __hash__ = object.__hash__  # __eq__ builds graphs; keep hashable
+
+    # -- shape inference ----------------------------------------------------
+    def infer_shape(self, **kwargs):
+        """Infer every argument/output shape from the given input shapes
+        (reference Symbol.infer_shape).  Legacy ops' implicit parameter
+        variables are inferred from their data input via per-op rules.
+
+        Returns (arg_shapes, out_shapes, aux_shapes) ordered like
+        list_arguments()/list_outputs()/list_auxiliary_states()."""
+        env = {}
+        for n in self._leaves():
+            if n.name in kwargs and kwargs[n.name] is not None:
+                env[n.name] = tuple(kwargs[n.name])
+            elif n._shape is not None:
+                env[n.name] = n._shape
+        shapes = self._shape_pass(env)
+        args = [env.get(n.name) for n in self._leaves(aux=False)]
+        auxs = [env.get(n.name) for n in self._leaves(aux=True)]
+        outs = shapes if isinstance(shapes, list) else [shapes]
+        return args, outs, auxs
+
+    def _shape_pass(self, env):
+        """Walk the DAG computing output shapes; fills env for implicit
+        legacy params.  Uses jax.eval_shape per op node — the op registry
+        itself is the shape function (no duplicate shape rules)."""
+        memo = {}
+
+        def dtype_of(n):
+            return n._dtype or "float32"
+
+        def walk(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            if node._kind == "var":
+                if node.name not in env:
+                    raise ValueError(
+                        "cannot infer shape: variable %r has no shape "
+                        "(pass %s=<shape> to infer_shape)"
+                        % (node.name, node.name))
+                r = jax.ShapeDtypeStruct(env[node.name], dtype_of(node))
+            elif node._kind == "const":
+                r = jax.ShapeDtypeStruct((), "float32")
+            elif node._kind == "index":
+                r = walk(node._inputs[0])
+                if isinstance(r, (list, tuple)):
+                    r = r[node._index]
+            elif node._kind == "group":
+                r = [walk(i) for i in node._inputs]
+            else:  # op
+                if node._op.startswith("legacy:"):
+                    spec = _LEGACY[node._op.split(":", 1)[1]]
+                    dstruct = walk(node._inputs[0])
+                    infer = spec.get("infer")
+                    if infer is not None:
+                        inferred = infer(tuple(dstruct.shape), node._attrs)
+                        # slot order matches node inputs [data, *slots]
+                        for slot_sym, shp in zip(node._inputs[1:], inferred):
+                            if slot_sym._kind == "var" and \
+                                    slot_sym.name not in env and \
+                                    shp is not None:
+                                env[slot_sym.name] = tuple(shp)
+                in_structs = [walk(i) for i in node._inputs]
+                fn = _resolve_op(node._op)
+
+                extra, attrs = _attr_kwargs(node)
+
+                def apply(*vals):
+                    nds = [_wrap_value(v) if isinstance(v, jax.Array)
+                           else v for v in vals]
+                    out = fn(*nds, *extra, **attrs)
+                    return _unwrap_out(out)
+
+                r = jax.eval_shape(apply, *[
+                    s if isinstance(s, jax.ShapeDtypeStruct) else s
+                    for s in in_structs])
+            memo[id(node)] = r
+            return r
+
+        res = walk(self)
+        if isinstance(res, list):
+            return [tuple(r.shape) for r in res]
+        if isinstance(res, (tuple,)) and not isinstance(
+                res, jax.ShapeDtypeStruct):
+            return [tuple(r.shape) for r in res]
+        return tuple(res.shape)
+
+    # -- evaluation ---------------------------------------------------------
+    def _eval(self, env):
+        """Evaluate the DAG given name→ndarray bindings (used under jit
+        tracing by Executor, and eagerly by eval())."""
+        memo = {}
+
+        def walk(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            if node._kind == "var":
+                try:
+                    r = env[node.name]
+                except KeyError:
+                    raise ValueError("unbound variable %r" % node.name)
+            elif node._kind == "const":
+                r = node._attrs["value"]
+            elif node._kind == "index":
+                r = walk(node._inputs[0])
+                if isinstance(r, (list, tuple)):
+                    r = r[node._index]
+            elif node._kind == "group":
+                r = [walk(i) for i in node._inputs]
+            else:
+                fn = _resolve_op(node._op)
+                args = [walk(i) for i in node._inputs]
+                extra, attrs = _attr_kwargs(node)
+                r = fn(*args, *extra, **attrs)
+            memo[id(node)] = r
+            return r
+
+        return walk(self)
+
+    def eval(self, ctx=None, **kwargs):
+        """One-shot evaluate with keyword bindings (reference Symbol.eval);
+        returns a list of ndarrays."""
+        ex = self._bind(ctx, args=kwargs)
+        return ex.forward()
+
+    # reference API names bind/_bind both exist; keep both spellings
+    def _bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+              aux_states=None):
+        return Executor(self, args or {}, args_grad, grad_req,
+                        aux_states or {})
+
+    bind = _bind
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        """Allocate arguments from inferred shapes and bind (reference
+        simple_bind).  Arrays are zero-initialized; set arg_dict values
+        before forward for real runs."""
+        from . import numpy as mxnp
+        arg_shapes, _outs, aux_shapes = self.infer_shape(**shapes)
+        args = {}
+        for n, shp in zip(self._leaves(aux=False), arg_shapes):
+            if shp is None:
+                raise ValueError("shape of %r could not be inferred"
+                                 % n.name)
+            args[n.name] = mxnp.zeros(shp, dtype=n._dtype or "float32")
+        auxs = {}
+        for n, shp in zip(self._leaves(aux=True), aux_shapes):
+            auxs[n.name] = mxnp.zeros(shp, dtype=n._dtype or "float32")
+        return Executor(self, args, None, grad_req, auxs)
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        nodes = self._topo()
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        out = []
+        for n in nodes:
+            d = {"kind": n._kind, "name": n.name,
+                 "inputs": [idx[id(i)] for i in n._inputs]}
+            if n._kind == "op":
+                d["op"] = n._op
+                d["attrs"] = n._attrs
+            elif n._kind == "var":
+                d["shape"] = list(n._shape) if n._shape else None
+                d["dtype"] = n._dtype
+                d["aux"] = n._aux
+            elif n._kind == "const":
+                d["value"] = n._attrs["value"]
+            elif n._kind == "index":
+                d["index"] = n._index
+            out.append(d)
+        return json.dumps({"format": _FORMAT, "nodes": out,
+                           "heads": [idx[id(self)]]})
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- lowering to the deployment artifact -------------------------------
+    def export_artifact(self, arg_arrays, aux_arrays=None):
+        """Lower the DAG to the StableHLO artifact (mxnet_tpu/symbol.py):
+        params = bound arguments except data vars are the positional
+        inputs.  `arg_arrays`: name→ndarray for EVERY argument; names
+        starting with 'data' (or having no param-producing op) that the
+        caller wants positional should be listed first in data_names."""
+        from .symbol import Symbol as ArtifactSymbol, _aval_to_json
+        from jax import export as jexport
+
+        data_names = [n for n in self.list_arguments()
+                      if n not in arg_arrays]
+        param_names = [n for n in self.list_arguments()
+                       if n in arg_arrays]
+        aux_arrays = aux_arrays or {}
+
+        def pure(param_vals, *inputs):
+            env = {}
+            for k, v in param_vals.items():
+                env[k] = _wrap_value(v)
+            for name, v in zip(data_names, inputs):
+                env[name] = _wrap_value(v)
+            out = self._eval(env)
+            return _unwrap_out(out)
+
+        pvals = OrderedDict()
+        for k in param_names:
+            v = arg_arrays[k]
+            pvals[k] = v._data if isinstance(v, ndarray) else jnp.asarray(v)
+        for k, v in aux_arrays.items():
+            pvals[k] = v._data if isinstance(v, ndarray) else jnp.asarray(v)
+        if not data_names:
+            raise ValueError(
+                "export_artifact: every argument was bound; leave the "
+                "data inputs out of arg_arrays")
+        dstructs = []
+        # data shapes must come from somewhere: require declared var shapes
+        for n in self._leaves(aux=False):
+            if n.name in data_names:
+                if n._shape is None:
+                    raise ValueError(
+                        "data variable %r needs a declared shape for "
+                        "export (var(name, shape=...))" % n.name)
+                dstructs.append(jax.ShapeDtypeStruct(
+                    n._shape, n._dtype or "float32"))
+        pstruct = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for k, v in pvals.items()}
+        try:
+            exported = jexport.export(jax.jit(pure),
+                                      platforms=("cpu", "tpu"))(
+                pstruct, *dstructs)
+        except Exception:
+            exported = jexport.export(jax.jit(pure))(pstruct, *dstructs)
+        pavals = OrderedDict((k, _aval_to_json(v)) for k, v in pvals.items())
+        iavals = [_aval_to_json(s) for s in dstructs]
+        art = ArtifactSymbol(exported, pavals, iavals,
+                             meta={"class": "sym", "train": False})
+        return art, pvals
+
+
+def _attr_kwargs(node):
+    """(extra_positional_args, kwargs) for calling the eager op."""
+    attrs = {k: (tuple(v) if isinstance(v, list) else v)
+             for k, v in node._attrs.items()}
+    extra = attrs.pop("_extra_pos", ())
+    extra = tuple(tuple(e) if isinstance(e, list) else e for e in extra)
+    return extra, attrs
+
+
+def _unwrap_out(out):
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap_out(o) for o in out)
+    return out._data if isinstance(out, ndarray) else out
+
+
+def _as_symbol(x):
+    if isinstance(x, Symbol):
+        return x
+    if isinstance(x, (int, float, bool)):
+        return Symbol("const", name="const", attrs={"value": x})
+    raise TypeError("cannot compose symbol with %r" % type(x).__name__)
+
+
+# ---------------------------------------------------------------------------
+# public constructors
+# ---------------------------------------------------------------------------
+def var(name, shape=None, dtype=None, aux=False, **_ignored):
+    """Create a symbolic variable (reference sym.var / sym.Variable)."""
+    return Symbol("var", name=name, shape=shape, dtype=dtype, aux=aux)
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Bundle symbols into one multi-output symbol (reference sym.Group)."""
+    return Symbol("group", name="group", inputs=list(symbols))
+
+
+# ---------------------------------------------------------------------------
+# Executor (reference executor.py Executor: forward/backward/outputs)
+# ---------------------------------------------------------------------------
+class Executor:
+    """Bound symbol: holds argument arrays, compiles forward (and the vjp
+    for backward) into cached XLA executables."""
+
+    def __init__(self, sym, args, args_grad, grad_req, aux_states):
+        self._sym = sym
+        self.arg_dict = OrderedDict()
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        self.aux_dict = OrderedDict(
+            (k, _to_nd(v)) for k, v in (aux_states or {}).items())
+        for k, v in args.items():
+            if k in aux_names and k not in self.aux_dict:
+                self.aux_dict[k] = _to_nd(v)  # aux passed via args is fine
+        for k in arg_names:
+            if k in args:
+                self.arg_dict[k] = _to_nd(args[k])
+        self.grad_req = grad_req if isinstance(grad_req, dict) else \
+            {k: grad_req for k in arg_names}
+        self.grad_dict = OrderedDict()
+        if args_grad:
+            if isinstance(args_grad, (list, tuple)):
+                args_grad = dict(zip(arg_names, args_grad))
+            self.grad_dict.update(
+                (k, _to_nd(v)) for k, v in args_grad.items())
+        self.outputs = []
+        self._fwd_cache = {}
+        self._bwd_cache = {}
+
+    def _env_vals(self):
+        vals = {k: v._data for k, v in self.arg_dict.items()}
+        vals.update({k: v._data for k, v in self.aux_dict.items()})
+        return vals
+
+    def _forward_fn(self, is_train):
+        fn = self._fwd_cache.get(is_train)
+        if fn is None:
+            sym = self._sym
+            from . import autograd
+
+            def run(vals):
+                env = {k: _wrap_value(v) for k, v in vals.items()}
+                with autograd._RecordingStateScope(False, is_train):
+                    out = sym._eval(env)
+                out = _unwrap_out(out)
+                return out if isinstance(out, (list, tuple)) else [out]
+
+            fn = jax.jit(run)
+            self._fwd_cache[is_train] = fn
+        return fn
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            self.arg_dict[k] = _to_nd(v)
+        outs = self._forward_fn(bool(is_train))(self._env_vals())
+        self.outputs = [_wrap_value(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Gradients of outputs (weighted by out_grads, default ones) wrt
+        every argument with grad_req != 'null'; results land in
+        grad_dict / grad_arrays (reference Executor.backward)."""
+        wrt = [k for k in self.arg_dict if self.grad_req.get(k) != "null"]
+        key = tuple(wrt)
+        fn = self._bwd_cache.get(key)
+        if fn is None:
+            sym = self._sym
+            from . import autograd
+
+            def run(diff_vals, const_vals, cots):
+                def f(dv):
+                    env = {k: _wrap_value(v) for k, v in dv.items()}
+                    env.update({k: _wrap_value(v)
+                                for k, v in const_vals.items()})
+                    with autograd._RecordingStateScope(False, True):
+                        out = sym._eval(env)
+                    out = _unwrap_out(out)
+                    return out if isinstance(out, (list, tuple)) else [out]
+
+                outs, vjp = jax.vjp(f, diff_vals)
+                return vjp(list(cots))[0]
+
+            fn = jax.jit(run)
+            self._bwd_cache[key] = fn
+        vals = self._env_vals()
+        diff = {k: vals[k] for k in wrt}
+        const = {k: v for k, v in vals.items() if k not in diff}
+        if not self.outputs:
+            self.forward(is_train=True)
+        if out_grads is None:
+            cots = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cots = [g._data if isinstance(g, ndarray) else jnp.asarray(g)
+                    for g in out_grads]
+        grads = fn(diff, const, tuple(cots))
+        for k, g in grads.items():
+            if k in self.grad_dict:
+                self.grad_dict[k]._set_data(g)
+            else:
+                self.grad_dict[k] = _wrap_value(g)
+        return [self.grad_dict[k] for k in wrt]
+
+    @property
+    def grad_arrays(self):
+        return list(self.grad_dict.values())
+
+    @property
+    def arg_arrays(self):
+        return list(self.arg_dict.values())
+
+
+def _to_nd(v):
+    if isinstance(v, ndarray):
+        return v
+    from .ndarray import array
+    return array(onp.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# deserialization (sniffs DAG json vs StableHLO artifact json)
+# ---------------------------------------------------------------------------
+def fromjson(text):
+    d = json.loads(text)
+    if d.get("format") == _FORMAT:
+        nodes = []
+        for nd in d["nodes"]:
+            kind = nd["kind"]
+            inputs = [nodes[i] for i in nd["inputs"]]
+            if kind == "var":
+                s = Symbol("var", name=nd["name"], shape=nd.get("shape"),
+                           dtype=nd.get("dtype"), aux=nd.get("aux", False))
+            elif kind == "const":
+                s = Symbol("const", name=nd.get("name"),
+                           attrs={"value": nd["value"]})
+            elif kind == "index":
+                s = Symbol("index", name=nd.get("name"), inputs=inputs,
+                           index=nd["index"])
+            elif kind == "group":
+                s = Symbol("group", name=nd.get("name"), inputs=inputs)
+            else:
+                _resolve_op(nd["op"])  # validate early
+                s = Symbol("op", name=nd.get("name"), op=nd["op"],
+                           inputs=inputs, attrs=nd.get("attrs") or {})
+            nodes.append(s)
+        return nodes[d["heads"][0]]
+    # fall through: the StableHLO artifact format
+    from .symbol import Symbol as ArtifactSymbol
+    return ArtifactSymbol.fromjson(text)
+
+
+def load(fname):
+    with open(fname) as f:
+        return fromjson(f.read())
+
+
+# ---------------------------------------------------------------------------
+# legacy CamelCase ops with implicit parameter variables
+# (reference: every op under mx.sym auto-creates missing weight inputs)
+# ---------------------------------------------------------------------------
+def _prod(xs):
+    r = 1
+    for x in xs:
+        r *= int(x)
+    return r
+
+
+def _mk_fc(data, weight, bias=None, **attrs):
+    num_hidden = attrs["num_hidden"]
+    no_bias = attrs.get("no_bias", False)
+    flatten = attrs.get("flatten", True)
+    return npx_mod.fully_connected(data, weight,
+                                   None if no_bias else bias,
+                                   num_hidden=num_hidden, no_bias=no_bias,
+                                   flatten=flatten)
+
+
+def _infer_fc(dshape, attrs):
+    n = attrs["num_hidden"]
+    in_units = _prod(dshape[1:]) if attrs.get("flatten", True) \
+        else dshape[-1]
+    return [(n, in_units), (n,)]
+
+
+def _mk_conv(data, weight, bias=None, **attrs):
+    return npx_mod.convolution(
+        data, weight, None if attrs.get("no_bias") else bias,
+        kernel=tuple(attrs["kernel"]), num_filter=attrs["num_filter"],
+        stride=tuple(attrs.get("stride") or ()) or None,
+        pad=tuple(attrs.get("pad") or ()) or None,
+        dilate=tuple(attrs.get("dilate") or ()) or None,
+        no_bias=attrs.get("no_bias", False))
+
+
+def _infer_conv(dshape, attrs):
+    nf = attrs["num_filter"]
+    c = dshape[1]
+    return [(nf, c) + tuple(attrs["kernel"]), (nf,)]
+
+
+def _mk_bn(data, gamma, beta, moving_mean, moving_var, **attrs):
+    out = npx_mod.batch_norm(
+        data, gamma, beta, moving_mean, moving_var,
+        eps=attrs.get("eps", 1e-3), momentum=attrs.get("momentum", 0.9),
+        fix_gamma=attrs.get("fix_gamma", True),
+        use_global_stats=attrs.get("use_global_stats", False))
+    return out[0] if isinstance(out, (list, tuple)) else out
+
+
+def _infer_bn(dshape, attrs):
+    c = dshape[attrs.get("axis", 1)]
+    return [(c,), (c,), (c,), (c,)]
+
+
+def _mk_embedding(data, weight, **attrs):
+    return npx_mod.embedding(data, weight,
+                             input_dim=attrs["input_dim"],
+                             output_dim=attrs["output_dim"])
+
+
+def _infer_embedding(dshape, attrs):
+    return [(attrs["input_dim"], attrs["output_dim"])]
+
+
+_LEGACY = {
+    "FullyConnected": {
+        "slots": ["weight", "bias"], "aux": [],
+        "make": _mk_fc, "infer": _infer_fc},
+    "Convolution": {
+        "slots": ["weight", "bias"], "aux": [],
+        "make": _mk_conv, "infer": _infer_conv},
+    "BatchNorm": {
+        "slots": ["gamma", "beta"], "aux": ["moving_mean", "moving_var"],
+        "make": _mk_bn, "infer": _infer_bn},
+    "Embedding": {
+        "slots": ["weight"], "aux": [],
+        "make": _mk_embedding, "infer": _infer_embedding},
+    "Activation": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: npx_mod.activation(
+            data, act_type=a.get("act_type", "relu")),
+        "infer": None},
+    "Pooling": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: npx_mod.pooling(
+            data, kernel=tuple(a.get("kernel", (2, 2))),
+            pool_type=a.get("pool_type", "max"),
+            stride=tuple(a.get("stride") or ()) or None,
+            pad=tuple(a.get("pad") or ()) or None,
+            global_pool=a.get("global_pool", False)),
+        "infer": None},
+    "Flatten": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: np_mod.reshape(
+            data, (data.shape[0], -1)),
+        "infer": None},
+    "Reshape": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: np_mod.reshape(data, tuple(a["shape"])),
+        "infer": None},
+    "Concat": {
+        "slots": [], "aux": [], "variadic": True,
+        "make": lambda *inputs, **a: np_mod.concatenate(
+            list(inputs), axis=a.get("dim", 1)),
+        "infer": None},
+    "Dropout": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: npx_mod.dropout(data, p=a.get("p", 0.5)),
+        "infer": None},
+    "SoftmaxOutput": {
+        "slots": [], "aux": [],
+        # forward = softmax over the class axis; under autodiff the
+        # backward IS softmax-minus-label when composed with CE loss
+        # (reference softmax_output.cc fuses the two)
+        "make": lambda data, *rest, **a: npx_mod.softmax(data, axis=-1),
+        "infer": None},
+    "SoftmaxActivation": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: npx_mod.softmax(data, axis=-1),
+        "infer": None},
+    "LeakyReLU": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: npx_mod.leaky_relu(
+            data, act_type=a.get("act_type", "leaky"),
+            slope=a.get("slope", 0.25)),
+        "infer": None},
+}
+
+
+def _legacy_factory(opname, spec):
+    def make_symbol(*pos, name=None, **kwargs):
+        data = kwargs.pop("data", None)
+        inputs = list(pos)
+        if data is not None:
+            inputs.insert(0, data)
+        if not inputs:
+            raise ValueError("%s needs a data input" % opname)
+        Symbol._counter[0] += 1
+        name = name or "%s%d" % (opname.lower(), Symbol._counter[0])
+        if spec.get("variadic"):
+            node_inputs = [_as_symbol(i) for i in inputs]
+        else:
+            node_inputs = [_as_symbol(inputs[0])]
+            extra_pos = list(inputs[1:])  # positional weight/bias/label
+            # wire explicit or implicit parameter variables, in slot order
+            for slot in spec["slots"]:
+                s = kwargs.pop(slot, None)
+                if s is None and extra_pos:
+                    s = extra_pos.pop(0)
+                node_inputs.append(_as_symbol(s) if s is not None
+                                   else var("%s_%s" % (name, slot)))
+            for slot in spec["aux"]:
+                s = kwargs.pop(slot, None)
+                if s is None and extra_pos:
+                    s = extra_pos.pop(0)
+                node_inputs.append(
+                    _as_symbol(s) if s is not None
+                    else var("%s_%s" % (name, slot), aux=True))
+            # remaining positionals (e.g. SoftmaxOutput's label) append
+            # after the slots; the op's make() accepts them via *rest
+            node_inputs.extend(_as_symbol(i) for i in extra_pos)
+            label = kwargs.pop("label", None)
+            if label is not None:
+                node_inputs.append(_as_symbol(label))
+        attrs = {k: (list(v) if isinstance(v, tuple) else v)
+                 for k, v in kwargs.items() if v is not None}
+        return Symbol("op", name=name, op="legacy:" + opname,
+                      inputs=node_inputs, attrs=attrs)
+
+    make_symbol.__name__ = opname
+    make_symbol.__doc__ = ("Symbolic %s (legacy mx.sym op; implicit "
+                           "parameter variables auto-created)" % opname)
+    return make_symbol
+
+
+for _opname, _spec in _LEGACY.items():
+    globals()[_opname] = _legacy_factory(_opname, _spec)
+    __all__.append(_opname)
+
+
+# ---------------------------------------------------------------------------
+# generic op namespace: every mx.np / mx.npx function, symbolically
+# ---------------------------------------------------------------------------
+def _generic_factory(op_id):
+    fn_name = op_id.split(":", 1)[1]
+
+    def make_symbol(*args, name=None, **kwargs):
+        inputs = [_as_symbol(a) for a in args if isinstance(a, Symbol)]
+        rest = [a for a in args if not isinstance(a, Symbol)]
+        # non-symbol positionals (axes, shapes) ride as attrs, appended in
+        # call order after the symbolic inputs
+        attrs = dict(kwargs)
+        if rest:
+            attrs["_extra_pos"] = [list(r) if isinstance(r, tuple) else r
+                                   for r in rest]
+        return Symbol("op", name=name, op=op_id, inputs=inputs, attrs=attrs)
+
+    make_symbol.__name__ = fn_name
+    return make_symbol
+
+
+def __getattr__(name):
+    """Resolve unknown attributes as symbolic wrappers over mx.np / mx.npx
+    (module-level __getattr__, so the whole eager registry is available
+    symbolically without 400 stub defs)."""
+    if not name.startswith("_"):
+        if callable(getattr(np_mod, name, None)):
+            return _generic_factory("np:" + name)
+        if callable(getattr(npx_mod, name, None)):
+            return _generic_factory("npx:" + name)
+    raise AttributeError("module mx.sym has no attribute %r" % name)

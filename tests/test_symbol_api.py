@@ -1,0 +1,174 @@
+"""Composable mx.sym graph API (parity: reference symbol.py:57 —
+var/compose/arithmetic/bind/eval/Group/save/load + legacy ops with
+implicit parameter variables) and its round-trips through SymbolBlock."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import sym_api as sym
+
+
+def test_var_compose_arithmetic_eval():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a + b) * 2 - a / b
+    assert sorted(c.list_arguments()) == ["a", "b"]
+    av = mxnp.array([1.0, 2.0])
+    bv = mxnp.array([4.0, 8.0])
+    (out,) = c.eval(a=av, b=bv)
+    onp.testing.assert_allclose(
+        out.asnumpy(), (onp.array([1, 2.]) + [4, 8.]) * 2 - [.25, .25])
+
+
+def test_generic_np_ops_symbolically():
+    x = sym.var("x")
+    y = sym.exp(sym.sin(x)) + sym.sum(x)
+    (out,) = y.eval(x=mxnp.array([0.1, 0.2]))
+    ref = onp.exp(onp.sin([0.1, 0.2])) + onp.sum([0.1, 0.2])
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+
+def test_legacy_fc_auto_creates_weight_vars():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc1")
+    assert fc.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+    args, outs, _aux = fc.infer_shape(data=(4, 5))
+    assert args == [(4, 5), (3, 5), (3,)]
+    assert outs == [(4, 3)]
+
+
+def test_legacy_mlp_bind_forward_backward():
+    data = sym.var("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=8, name="fc1"),
+                       act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=3, name="fc2")
+    ex = out.simple_bind(data=(4, 6))
+    rng = onp.random.RandomState(0)
+    for k in ex.arg_dict:
+        ex.arg_dict[k] = mxnp.array(
+            rng.uniform(-1, 1, ex.arg_dict[k].shape).astype("float32"))
+    (o,) = ex.forward()
+    assert o.shape == (4, 3)
+    # reference forward in numpy
+    a = ex.arg_dict
+    relu = lambda v: onp.maximum(v, 0)
+    ref = relu(a["data"].asnumpy() @ a["fc1_weight"].asnumpy().T
+               + a["fc1_bias"].asnumpy()) @ a["fc2_weight"].asnumpy().T \
+        + a["fc2_bias"].asnumpy()
+    onp.testing.assert_allclose(o.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+    grads = ex.backward()
+    assert set(ex.grad_dict) == set(ex.arg_dict)
+    # numeric check on fc2_bias: d(sum(out))/d(bias) = batch count
+    onp.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                                onp.full(3, 4.0), rtol=1e-5)
+
+
+def test_convolution_batchnorm_compose_and_shapes():
+    data = sym.var("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="c1")
+    bn = sym.BatchNorm(c, name="bn1")
+    p = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = sym.Flatten(p)
+    args, outs, auxs = f.infer_shape(data=(2, 3, 8, 8))
+    assert outs == [(2, 8 * 4 * 4)]
+    assert f.list_auxiliary_states() == ["bn1_moving_mean",
+                                         "bn1_moving_var"]
+    names = f.list_arguments()
+    assert names[0] == "data" and "c1_weight" in names and \
+        "bn1_gamma" in names
+    ex = f.simple_bind(data=(2, 3, 8, 8))
+    (out,) = ex.forward()
+    assert out.shape == (2, 128)
+
+
+def test_group_and_get_internals():
+    x = sym.var("x")
+    a = sym.sin(x, name="s")
+    b = sym.cos(x, name="c")
+    g = sym.Group([a, b])
+    outs = g.eval(x=mxnp.array([0.5]))
+    onp.testing.assert_allclose(outs[0].asnumpy(), onp.sin([0.5]), rtol=1e-6)
+    onp.testing.assert_allclose(outs[1].asnumpy(), onp.cos([0.5]), rtol=1e-6)
+    internals = (a + b).get_internals()
+    assert any(n.name == "s" for n in internals._inputs)
+    s_node = (a + b)["s"]
+    (sv,) = s_node.eval(x=mxnp.array([0.5]))
+    onp.testing.assert_allclose(sv.asnumpy(), onp.sin([0.5]), rtol=1e-6)
+
+
+def test_json_roundtrip():
+    data = sym.var("data", shape=(2, 4), dtype="float32")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc") + 1.0
+    text = net.tojson()
+    back = sym.fromjson(text)
+    assert back.list_arguments() == net.list_arguments()
+    rng = onp.random.RandomState(1)
+    env = {"data": mxnp.array(rng.randn(2, 4).astype("float32")),
+           "fc_weight": mxnp.array(rng.randn(3, 4).astype("float32")),
+           "fc_bias": mxnp.zeros(3)}
+    (o1,) = net.eval(**env)
+    (o2,) = back.eval(**env)
+    onp.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+
+
+def test_export_artifact_and_symbolblock_imports(tmp_path):
+    from mxnet_tpu.gluon import SymbolBlock
+    data = sym.var("data", shape=(2, 4), dtype="float32")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    rng = onp.random.RandomState(2)
+    w = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3).astype("float32")
+    art, pvals = net.export_artifact(
+        {"fc_weight": mxnp.array(w), "fc_bias": mxnp.array(b)})
+    sym_file = str(tmp_path / "net-symbol.json")
+    art.save(sym_file)
+    param_file = str(tmp_path / "net-0000.params.npz")
+    onp.savez(param_file, **{k: onp.asarray(v) for k, v in pvals.items()})
+    blk = SymbolBlock.imports(sym_file, ["data"], param_file)
+    x = rng.randn(2, 4).astype("float32")
+    out = blk(mxnp.array(x))
+    onp.testing.assert_allclose(out.asnumpy(), x @ w.T + b,
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_symbolblock_imports_dag_json(tmp_path):
+    from mxnet_tpu.gluon import SymbolBlock
+    data = sym.var("data")
+    net = sym.Activation(sym.FullyConnected(data, num_hidden=4, name="fc"),
+                         act_type="tanh")
+    f = str(tmp_path / "dag-symbol.json")
+    net.save(f)
+    rng = onp.random.RandomState(3)
+    w = rng.randn(4, 5).astype("float32")
+    b = rng.randn(4).astype("float32")
+    pf = str(tmp_path / "dag-0000.params.npz")
+    onp.savez(pf, fc_weight=w, fc_bias=b)
+    blk = SymbolBlock.imports(f, ["data"], pf)
+    x = rng.randn(2, 5).astype("float32")
+    out = blk(mxnp.array(x))
+    onp.testing.assert_allclose(out.asnumpy(), onp.tanh(x @ w.T + b),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_mx_namespace_exposes_sym():
+    assert mx.sym.var is sym.var
+    assert callable(mx.sym.FullyConnected)
+
+
+def test_unbound_variable_raises():
+    x = sym.var("x")
+    y = sym.var("y")
+    with pytest.raises(ValueError, match="unbound variable"):
+        (x + y).eval(x=mxnp.ones(2))
+
+
+def test_executor_rebind_kwargs_and_is_train_dropout():
+    x = sym.var("x")
+    d = sym.Dropout(x, p=0.5)
+    ex = d.bind(args={"x": mxnp.ones((100,))})
+    (o_eval,) = ex.forward(is_train=False)
+    onp.testing.assert_allclose(o_eval.asnumpy(), onp.ones(100))
+    (o_train,) = ex.forward(is_train=True)
+    assert (onp.asarray(o_train.asnumpy()) == 0).any()
