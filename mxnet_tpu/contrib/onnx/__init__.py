@@ -1,0 +1,28 @@
+"""ONNX export/import (parity: python/mxnet/contrib/onnx/ —
+`mx2onnx/_op_translations.py:1` per-op export converters and
+`onnx2mx/_import_helper.py` import registry).
+
+TPU-native design: converters translate between the composable mx.sym
+DAG (mxnet_tpu/sym_api.py) and a dict representation that mirrors the
+ONNX protobuf field-for-field ("model dict").  All graph logic —
+traversal, op mapping, attribute translation, round-tripping — runs
+without the `onnx` package; serialization to/from real `.onnx` protobuf
+files engages only when the package is installed (it is absent in this
+environment, so tests exercise the dict layer and skip the file layer).
+"""
+from __future__ import annotations
+
+from .mx2onnx import export_model, export_to_model_dict
+from .onnx2mx import import_model, import_from_model_dict, \
+    get_model_metadata
+
+__all__ = ["export_model", "export_to_model_dict", "import_model",
+           "import_from_model_dict", "get_model_metadata"]
+
+
+def has_onnx():
+    try:
+        import onnx  # noqa: F401
+        return True
+    except ImportError:
+        return False

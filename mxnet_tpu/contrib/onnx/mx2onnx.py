@@ -1,0 +1,402 @@
+"""mx.sym → ONNX export (parity: reference
+`python/mxnet/contrib/onnx/mx2onnx/_op_translations.py:1` — one
+converter per operator, registered by op name).
+
+The export target is a "model dict" that mirrors the ONNX protobuf
+structure field-for-field; `to_proto()` materializes a real ModelProto
+when the `onnx` package is installed.  Opset 13.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+__all__ = ["export_model", "export_to_model_dict", "to_proto",
+           "register_converter"]
+
+OPSET = 13
+
+_DTYPE_TO_ELEM = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6,
+                  "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+                  "bfloat16": 16}
+
+
+def _elem_type(dtype):
+    return _DTYPE_TO_ELEM.get(onp.dtype(dtype).name if dtype != "bfloat16"
+                              else "bfloat16", 1)
+
+
+class _ExportCtx:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = OrderedDict()
+        self._uid = 0
+
+    def fresh(self, base):
+        self._uid += 1
+        return "%s_%d" % (base, self._uid)
+
+    def add_node(self, op_type, inputs, outputs, name=None, **attrs):
+        self.nodes.append({
+            "op_type": op_type,
+            "name": name or self.fresh(op_type.lower()),
+            "input": list(inputs),
+            "output": list(outputs),
+            "attribute": {k: v for k, v in attrs.items() if v is not None},
+        })
+        return outputs[0]
+
+    def add_initializer(self, name, array):
+        self.initializers[name] = onp.asarray(array)
+        return name
+
+
+_CONVERTERS = {}
+
+
+def register_converter(op_id):
+    def deco(fn):
+        _CONVERTERS[op_id] = fn
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# converters: legacy NN ops
+# ---------------------------------------------------------------------------
+@register_converter("legacy:FullyConnected")
+def _fc(ctx, node, ins, out):
+    a = node._attrs
+    x, w = ins[0], ins[1]
+    if a.get("flatten", True):
+        x = ctx.add_node("Flatten", [x], [ctx.fresh(node.name + "_flat")],
+                         axis=1)
+    if a.get("no_bias", False) or len(ins) < 3:
+        bias = ctx.add_initializer(
+            node.name + "_zero_bias",
+            onp.zeros(a["num_hidden"], onp.float32))
+    else:
+        bias = ins[2]
+    return ctx.add_node("Gemm", [x, w, bias], [out], name=node.name,
+                        alpha=1.0, beta=1.0, transB=1)
+
+
+@register_converter("legacy:Convolution")
+def _conv(ctx, node, ins, out):
+    a = node._attrs
+    kernel = tuple(a["kernel"])
+    pad = tuple(a.get("pad") or (0,) * len(kernel))
+    stride = tuple(a.get("stride") or (1,) * len(kernel))
+    dilate = tuple(a.get("dilate") or (1,) * len(kernel))
+    inputs = list(ins[:2]) + ([] if a.get("no_bias") else list(ins[2:3]))
+    return ctx.add_node("Conv", inputs, [out], name=node.name,
+                        kernel_shape=list(kernel),
+                        pads=list(pad) * 2, strides=list(stride),
+                        dilations=list(dilate),
+                        group=int(a.get("num_group", 1)))
+
+
+@register_converter("legacy:BatchNorm")
+def _bn(ctx, node, ins, out):
+    a = node._attrs
+    return ctx.add_node("BatchNormalization", list(ins[:5]), [out],
+                        name=node.name,
+                        epsilon=float(a.get("eps", 1e-3)),
+                        momentum=float(a.get("momentum", 0.9)))
+
+
+@register_converter("legacy:Activation")
+def _act(ctx, node, ins, out):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = node._attrs.get("act_type", "relu")
+    if act not in table:
+        raise ValueError("ONNX export: unsupported act_type %r" % act)
+    return ctx.add_node(table[act], [ins[0]], [out], name=node.name)
+
+
+@register_converter("legacy:LeakyReLU")
+def _leaky(ctx, node, ins, out):
+    return ctx.add_node("LeakyRelu", [ins[0]], [out], name=node.name,
+                        alpha=float(node._attrs.get("slope", 0.25)))
+
+
+@register_converter("legacy:Pooling")
+def _pool(ctx, node, ins, out):
+    a = node._attrs
+    ptype = a.get("pool_type", "max")
+    if a.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        return ctx.add_node(op, [ins[0]], [out], name=node.name)
+    kernel = tuple(a.get("kernel", (2, 2)))
+    stride = tuple(a.get("stride") or kernel)
+    pad = tuple(a.get("pad") or (0,) * len(kernel))
+    op = {"max": "MaxPool", "avg": "AveragePool"}[ptype]
+    kw = {}
+    if ptype == "avg":
+        kw["count_include_pad"] = 1 if a.get("count_include_pad", True) \
+            else 0
+    return ctx.add_node(op, [ins[0]], [out], name=node.name,
+                        kernel_shape=list(kernel), strides=list(stride),
+                        pads=list(pad) * 2, **kw)
+
+
+@register_converter("legacy:Flatten")
+def _flatten(ctx, node, ins, out):
+    return ctx.add_node("Flatten", [ins[0]], [out], name=node.name, axis=1)
+
+
+@register_converter("legacy:Reshape")
+def _reshape(ctx, node, ins, out):
+    shp = ctx.add_initializer(
+        node.name + "_shape",
+        onp.asarray(node._attrs["shape"], onp.int64))
+    return ctx.add_node("Reshape", [ins[0], shp], [out], name=node.name)
+
+
+@register_converter("legacy:Concat")
+def _concat(ctx, node, ins, out):
+    return ctx.add_node("Concat", list(ins), [out], name=node.name,
+                        axis=int(node._attrs.get("dim", 1)))
+
+
+@register_converter("legacy:Dropout")
+def _dropout(ctx, node, ins, out):
+    ratio = ctx.add_initializer(
+        node.name + "_ratio",
+        onp.asarray(node._attrs.get("p", 0.5), onp.float32))
+    return ctx.add_node("Dropout", [ins[0], ratio], [out], name=node.name)
+
+
+@register_converter("legacy:Embedding")
+def _embedding(ctx, node, ins, out):
+    # ONNX Gather(data=weight, indices); mx order is (indices, weight)
+    idx = ctx.add_node("Cast", [ins[0]],
+                       [ctx.fresh(node.name + "_idx")], to=7)
+    return ctx.add_node("Gather", [ins[1], idx], [out], name=node.name,
+                        axis=0)
+
+
+@register_converter("legacy:SoftmaxOutput")
+@register_converter("legacy:SoftmaxActivation")
+def _softmax_out(ctx, node, ins, out):
+    return ctx.add_node("Softmax", [ins[0]], [out], name=node.name,
+                        axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# converters: numpy-namespace ops
+# ---------------------------------------------------------------------------
+_SIMPLE = {
+    "np:add": "Add", "np:subtract": "Sub", "np:multiply": "Mul",
+    "np:divide": "Div", "np:power": "Pow", "np:negative": "Neg",
+    "np:abs": "Abs", "np:exp": "Exp", "np:log": "Log", "np:sqrt": "Sqrt",
+    "np:tanh": "Tanh", "np:sigmoid": "Sigmoid", "np:erf": "Erf",
+    "np:maximum": "Max", "np:minimum": "Min", "np:dot": "MatMul",
+    "np:matmul": "MatMul", "np:sin": "Sin", "np:cos": "Cos",
+    "np:floor": "Floor", "np:ceil": "Ceil", "np:sign": "Sign",
+    "np:relu": "Relu", "npx:relu": "Relu", "npx:sigmoid": "Sigmoid",
+}
+
+
+def _simple_factory(onnx_op):
+    def conv(ctx, node, ins, out):
+        return ctx.add_node(onnx_op, list(ins), [out], name=node.name)
+    return conv
+
+
+for _mx_op, _onnx_op in _SIMPLE.items():
+    _CONVERTERS[_mx_op] = _simple_factory(_onnx_op)
+
+
+@register_converter("npx:softmax")
+def _softmax(ctx, node, ins, out):
+    return ctx.add_node("Softmax", [ins[0]], [out], name=node.name,
+                        axis=int(node._attrs.get("axis", -1)))
+
+
+@register_converter("npx:log_softmax")
+def _log_softmax(ctx, node, ins, out):
+    return ctx.add_node("LogSoftmax", [ins[0]], [out], name=node.name,
+                        axis=int(node._attrs.get("axis", -1)))
+
+
+@register_converter("npx:layer_norm")
+def _layer_norm(ctx, node, ins, out):
+    return ctx.add_node("LayerNormalization", list(ins[:3]), [out],
+                        name=node.name,
+                        axis=int(node._attrs.get("axis", -1)),
+                        epsilon=float(node._attrs.get("eps", 1e-5)))
+
+
+@register_converter("np:transpose")
+def _transpose(ctx, node, ins, out):
+    extra = node._attrs.get("_extra_pos") or []
+    perm = node._attrs.get("axes", extra[0] if extra else None)
+    return ctx.add_node("Transpose", [ins[0]], [out], name=node.name,
+                        perm=list(perm) if perm is not None else None)
+
+
+@register_converter("np:reshape")
+def _np_reshape(ctx, node, ins, out):
+    extra = node._attrs.get("_extra_pos") or []
+    shape = node._attrs.get("newshape", extra[0] if extra else None)
+    shp = ctx.add_initializer(node.name + "_shape",
+                              onp.asarray(shape, onp.int64))
+    return ctx.add_node("Reshape", [ins[0], shp], [out], name=node.name)
+
+
+def _reduce_factory(onnx_op):
+    def conv(ctx, node, ins, out):
+        axes = node._attrs.get("axis")
+        if isinstance(axes, int):
+            axes = [axes]
+        kw = {"keepdims": 1 if node._attrs.get("keepdims") else 0}
+        if axes is not None:
+            ax = ctx.add_initializer(node.name + "_axes",
+                                     onp.asarray(list(axes), onp.int64))
+            return ctx.add_node(onnx_op, [ins[0], ax], [out],
+                                name=node.name, **kw)
+        return ctx.add_node(onnx_op, [ins[0]], [out], name=node.name, **kw)
+    return conv
+
+
+_CONVERTERS["np:sum"] = _reduce_factory("ReduceSum")
+_CONVERTERS["np:mean"] = _reduce_factory("ReduceMean")
+
+
+# ---------------------------------------------------------------------------
+# export driver
+# ---------------------------------------------------------------------------
+def export_to_model_dict(sym, params, input_shapes=None, input_dtypes=None,
+                         graph_name="mxnet_tpu_model"):
+    """Convert an mx.sym DAG + params (name → array) into the ONNX model
+    dict.  `input_shapes`: {data_name: shape} for arguments not covered
+    by params (falls back to shapes declared on the vars)."""
+    from ...sym_api import Symbol
+    if not isinstance(sym, Symbol):
+        raise TypeError("export expects a composable mx.sym Symbol")
+    params = {k: onp.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+              for k, v in (params or {}).items()}
+    input_shapes = dict(input_shapes or {})
+    input_dtypes = dict(input_dtypes or {})
+
+    ctx = _ExportCtx()
+    for k, v in params.items():
+        ctx.add_initializer(k, v)
+
+    heads = sym._inputs if sym._kind == "group" else [sym]
+    names = {}  # id(node) -> onnx tensor name
+    graph_inputs = []
+
+    shape_env = {}
+    for leaf in sym._leaves():
+        nm = leaf.name
+        if nm in params:
+            shape_env[nm] = params[nm].shape
+            continue
+        shp = input_shapes.get(nm) or leaf._shape
+        if shp is None:
+            raise ValueError(
+                "input %r needs a shape (input_shapes= or var(shape=))"
+                % nm)
+        dt = input_dtypes.get(nm) or leaf._dtype or "float32"
+        shape_env[nm] = tuple(shp)
+        graph_inputs.append({"name": nm, "elem_type": _elem_type(dt),
+                             "shape": list(shp)})
+
+    for node in sym._topo():
+        if node._kind == "var":
+            names[id(node)] = node.name
+        elif node._kind == "const":
+            cname = ctx.fresh("const")
+            ctx.add_initializer(
+                cname, onp.asarray(node._attrs["value"], onp.float32))
+            names[id(node)] = cname
+        elif node._kind == "index":
+            # multi-output ops expose per-output names "<name>:i"
+            names[id(node)] = "%s:%d" % (names[id(node._inputs[0])],
+                                         node._index)
+        elif node._kind == "group":
+            continue
+        else:
+            conv = _CONVERTERS.get(node._op)
+            if conv is None:
+                raise NotImplementedError(
+                    "no ONNX converter for op %r (have %d converters)"
+                    % (node._op, len(_CONVERTERS)))
+            ins = [names[id(i)] for i in node._inputs]
+            out_name = node.name or ctx.fresh("out")
+            conv(ctx, node, ins, out_name)
+            names[id(node)] = out_name
+
+    try:
+        _args, out_shapes, _aux = sym.infer_shape(**{
+            k: v for k, v in shape_env.items()})
+    except Exception:
+        out_shapes = [None] * len(heads)
+    graph_outputs = []
+    for h, shp in zip(heads, out_shapes):
+        graph_outputs.append({
+            "name": names[id(h)], "elem_type": 1,
+            "shape": list(shp) if shp else None})
+
+    return {
+        "ir_version": 8,
+        "producer_name": "mxnet_tpu",
+        "opset_import": [{"domain": "", "version": OPSET}],
+        "graph": {
+            "name": graph_name,
+            "node": ctx.nodes,
+            "input": graph_inputs,
+            "output": graph_outputs,
+            "initializer": ctx.initializers,
+        },
+    }
+
+
+def to_proto(model_dict):
+    """Materialize a real onnx.ModelProto (requires the onnx package)."""
+    import onnx
+    from onnx import helper, numpy_helper
+
+    g = model_dict["graph"]
+    nodes = [helper.make_node(n["op_type"], n["input"], n["output"],
+                              name=n["name"], **n["attribute"])
+             for n in g["node"]]
+    inputs = [helper.make_tensor_value_info(
+        i["name"], i["elem_type"],
+        i["shape"]) for i in g["input"]]
+    outputs = [helper.make_tensor_value_info(
+        o["name"], o["elem_type"], o["shape"]) for o in g["output"]]
+    inits = [numpy_helper.from_array(v, name=k)
+             for k, v in g["initializer"].items()]
+    graph = helper.make_graph(nodes, g["name"], inputs, outputs, inits)
+    model = helper.make_model(
+        graph, producer_name=model_dict["producer_name"],
+        opset_imports=[helper.make_opsetid(o["domain"], o["version"])
+                       for o in model_dict["opset_import"]])
+    model.ir_version = model_dict["ir_version"]
+    onnx.checker.check_model(model)
+    return model
+
+
+def export_model(sym, params, input_shapes=None, input_types=None,
+                 onnx_file_path="model.onnx", verbose=False, **kwargs):
+    """Reference-compatible entry (mx2onnx.export_model): writes a .onnx
+    file; requires the `onnx` package for protobuf serialization.  The
+    package-free path is export_to_model_dict()."""
+    model_dict = export_to_model_dict(sym, params, input_shapes,
+                                      input_types)
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "writing .onnx files requires the 'onnx' package; the "
+            "converter itself ran — use export_to_model_dict() for the "
+            "package-free model dict") from e
+    model = to_proto(model_dict)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path

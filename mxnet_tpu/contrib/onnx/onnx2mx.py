@@ -1,0 +1,411 @@
+"""ONNX → mx.sym import (parity: reference
+`python/mxnet/contrib/onnx/onnx2mx/_import_helper.py` registry +
+`_op_translations.py` per-op builders).
+
+Consumes the same protobuf-mirroring "model dict" as mx2onnx; `.onnx`
+files are parsed into that dict when the `onnx` package is installed.
+Returns (sym, arg_params, aux_params) like the reference import_model.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+__all__ = ["import_model", "import_from_model_dict", "get_model_metadata",
+           "register_importer"]
+
+_IMPORTERS = {}
+
+
+def register_importer(op_type):
+    def deco(fn):
+        _IMPORTERS[op_type] = fn
+        return fn
+    return deco
+
+
+class _ImportCtx:
+    """Carries the growing name→Symbol map + initializer arrays."""
+
+    def __init__(self, initializers):
+        self.tensors = {}       # name -> Symbol
+        self.initializers = initializers  # name -> np.ndarray
+        self.used_params = set()
+
+    def sym_of(self, name, aux=False):
+        from ...sym_api import Symbol, var
+        s = self.tensors.get(name)
+        if s is None:
+            if name in self.initializers:
+                arr = self.initializers[name]
+                if arr.ndim == 0:
+                    # scalar initializers (exported consts) fold back to
+                    # const nodes, not parameters
+                    return Symbol("const", name=name,
+                                  attrs={"value": float(arr)})
+                self.used_params.add(name)
+                s = var(name, shape=arr.shape, dtype=str(arr.dtype),
+                        aux=aux)
+            else:
+                raise KeyError("undefined ONNX tensor %r" % name)
+            self.tensors[name] = s
+        return s
+
+    def const_of(self, name):
+        """Initializer consumed as a static attribute (shapes, axes)."""
+        if name not in self.initializers:
+            raise KeyError("expected initializer for %r" % name)
+        self.used_params.add(name)
+        return self.initializers[name]
+
+
+# ---------------------------------------------------------------------------
+# importers
+# ---------------------------------------------------------------------------
+@register_importer("Gemm")
+def _gemm(ctx, node, sym_mod):
+    a = node["attribute"]
+    x = ctx.sym_of(node["input"][0])
+    w_name, b_name = node["input"][1], node["input"][2]
+    if not a.get("transB", 0):
+        raise NotImplementedError("Gemm import requires transB=1 "
+                                  "(weight stored [out, in])")
+    num_hidden = None
+    if w_name in ctx.initializers:
+        num_hidden = int(ctx.initializers[w_name].shape[0])
+    return sym_mod.FullyConnected(
+        x, ctx.sym_of(w_name), ctx.sym_of(b_name),
+        num_hidden=num_hidden, flatten=False, name=node["output"][0])
+
+
+@register_importer("Conv")
+def _conv(ctx, node, sym_mod):
+    a = node["attribute"]
+    ins = node["input"]
+    kernel = tuple(a["kernel_shape"])
+    nd = len(kernel)
+    pads = a.get("pads", [0] * nd * 2)
+    w = ctx.sym_of(ins[1])
+    nf = int(ctx.initializers[ins[1]].shape[0]) \
+        if ins[1] in ctx.initializers else None
+    kw = dict(kernel=kernel, num_filter=nf,
+              stride=tuple(a.get("strides", (1,) * nd)),
+              pad=tuple(pads[:nd]),
+              dilate=tuple(a.get("dilations", (1,) * nd)),
+              num_group=int(a.get("group", 1)),
+              name=node["output"][0])
+    if len(ins) > 2:
+        return sym_mod.Convolution(ctx.sym_of(ins[0]), w,
+                                   ctx.sym_of(ins[2]), **kw)
+    return sym_mod.Convolution(ctx.sym_of(ins[0]), w, no_bias=True, **kw)
+
+
+@register_importer("BatchNormalization")
+def _bn(ctx, node, sym_mod):
+    a = node["attribute"]
+    names = node["input"]
+    ins = [ctx.sym_of(n) for n in names[:3]]
+    # running stats are auxiliary states (reference onnx2mx split)
+    ins += [ctx.sym_of(n, aux=True) for n in names[3:5]]
+    return sym_mod.BatchNorm(
+        ins[0], gamma=ins[1], beta=ins[2], moving_mean=ins[3],
+        moving_var=ins[4], eps=float(a.get("epsilon", 1e-5)),
+        momentum=float(a.get("momentum", 0.9)), fix_gamma=False,
+        use_global_stats=True, name=node["output"][0])
+
+
+@register_importer("MaxPool")
+@register_importer("AveragePool")
+def _pool(ctx, node, sym_mod):
+    a = node["attribute"]
+    kernel = tuple(a["kernel_shape"])
+    nd = len(kernel)
+    pads = a.get("pads", [0] * nd * 2)
+    return sym_mod.Pooling(
+        ctx.sym_of(node["input"][0]), kernel=kernel,
+        pool_type="max" if node["op_type"] == "MaxPool" else "avg",
+        stride=tuple(a.get("strides", kernel)), pad=tuple(pads[:nd]),
+        count_include_pad=bool(a.get("count_include_pad", 1)),
+        name=node["output"][0])
+
+
+@register_importer("GlobalMaxPool")
+@register_importer("GlobalAveragePool")
+def _gpool(ctx, node, sym_mod):
+    pt = "max" if node["op_type"] == "GlobalMaxPool" else "avg"
+    return sym_mod.Pooling(ctx.sym_of(node["input"][0]), pool_type=pt,
+                           global_pool=True, name=node["output"][0])
+
+
+@register_importer("Flatten")
+def _flatten(ctx, node, sym_mod):
+    return sym_mod.Flatten(ctx.sym_of(node["input"][0]),
+                           name=node["output"][0])
+
+
+@register_importer("Reshape")
+def _reshape(ctx, node, sym_mod):
+    shape = [int(s) for s in ctx.const_of(node["input"][1])]
+    return sym_mod.Reshape(ctx.sym_of(node["input"][0]), shape=shape,
+                           name=node["output"][0])
+
+
+@register_importer("Concat")
+def _concat(ctx, node, sym_mod):
+    ins = [ctx.sym_of(n) for n in node["input"]]
+    return sym_mod.Concat(*ins, dim=int(node["attribute"].get("axis", 1)),
+                          name=node["output"][0])
+
+
+@register_importer("Dropout")
+def _dropout(ctx, node, sym_mod):
+    p = 0.5
+    if len(node["input"]) > 1:
+        p = float(ctx.const_of(node["input"][1]))
+    return sym_mod.Dropout(ctx.sym_of(node["input"][0]), p=p,
+                           name=node["output"][0])
+
+
+@register_importer("Gather")
+def _gather(ctx, node, sym_mod):
+    # Gather(weight, indices) → Embedding when weight is a 2-D param
+    w_name = node["input"][0]
+    w = ctx.sym_of(w_name)
+    idx = ctx.sym_of(node["input"][1])
+    if w_name in ctx.initializers and \
+            ctx.initializers[w_name].ndim == 2 and \
+            int(node["attribute"].get("axis", 0)) == 0:
+        in_dim, out_dim = ctx.initializers[w_name].shape
+        return sym_mod.Embedding(idx, w, input_dim=int(in_dim),
+                                 output_dim=int(out_dim),
+                                 name=node["output"][0])
+    return sym_mod.take(w, idx, axis=int(node["attribute"].get("axis", 0)),
+                        name=node["output"][0])
+
+
+@register_importer("Cast")
+def _cast(ctx, node, sym_mod):
+    elem_to_dtype = {1: "float32", 6: "int32", 7: "int64", 9: "bool",
+                     10: "float16", 11: "float64"}
+    return sym_mod.astype(ctx.sym_of(node["input"][0]),
+                          elem_to_dtype.get(node["attribute"]["to"],
+                                            "float32"),
+                          name=node["output"][0])
+
+
+@register_importer("Softmax")
+def _softmax(ctx, node, sym_mod):
+    return sym_mod.softmax(ctx.sym_of(node["input"][0]),
+                           axis=int(node["attribute"].get("axis", -1)),
+                           name=node["output"][0])
+
+
+@register_importer("LogSoftmax")
+def _log_softmax(ctx, node, sym_mod):
+    return sym_mod.log_softmax(ctx.sym_of(node["input"][0]),
+                               axis=int(node["attribute"].get("axis", -1)),
+                               name=node["output"][0])
+
+
+@register_importer("LayerNormalization")
+def _layer_norm(ctx, node, sym_mod):
+    ins = [ctx.sym_of(n) for n in node["input"]]
+    return sym_mod.layer_norm(
+        ins[0], ins[1], ins[2],
+        axis=int(node["attribute"].get("axis", -1)),
+        eps=float(node["attribute"].get("epsilon", 1e-5)),
+        name=node["output"][0])
+
+
+@register_importer("Transpose")
+def _transpose(ctx, node, sym_mod):
+    perm = node["attribute"].get("perm")
+    return sym_mod.transpose(ctx.sym_of(node["input"][0]),
+                             axes=tuple(perm) if perm else None,
+                             name=node["output"][0])
+
+
+def _reduce_factory(np_name):
+    def imp(ctx, node, sym_mod):
+        kw = {"keepdims": bool(node["attribute"].get("keepdims", 1))}
+        if len(node["input"]) > 1:
+            axes = [int(x) for x in ctx.const_of(node["input"][1])]
+            kw["axis"] = tuple(axes) if len(axes) > 1 else axes[0]
+        elif "axes" in node["attribute"]:
+            kw["axis"] = tuple(node["attribute"]["axes"])
+        fn = getattr(sym_mod, np_name)
+        return fn(ctx.sym_of(node["input"][0]), name=node["output"][0],
+                  **kw)
+    return imp
+
+
+_IMPORTERS["ReduceSum"] = _reduce_factory("sum")
+_IMPORTERS["ReduceMean"] = _reduce_factory("mean")
+
+_SIMPLE = {
+    "Add": "add", "Sub": "subtract", "Mul": "multiply", "Div": "divide",
+    "Pow": "power", "Neg": "negative", "Abs": "abs", "Exp": "exp",
+    "Log": "log", "Sqrt": "sqrt", "Tanh": "tanh", "Sigmoid": "sigmoid",
+    "Erf": "erf", "Max": "maximum", "Min": "minimum", "MatMul": "dot",
+    "Sin": "sin", "Cos": "cos", "Floor": "floor", "Ceil": "ceil",
+    "Sign": "sign", "Relu": "relu",
+}
+
+
+def _simple_factory(np_name):
+    def imp(ctx, node, sym_mod):
+        fn = getattr(sym_mod, np_name)
+        ins = [ctx.sym_of(n) for n in node["input"]]
+        return fn(*ins, name=node["output"][0])
+    return imp
+
+
+for _onnx_op, _np_name in _SIMPLE.items():
+    _IMPORTERS[_onnx_op] = _simple_factory(_np_name)
+
+
+@register_importer("LeakyRelu")
+def _leaky(ctx, node, sym_mod):
+    return sym_mod.LeakyReLU(
+        ctx.sym_of(node["input"][0]),
+        slope=float(node["attribute"].get("alpha", 0.01)),
+        name=node["output"][0])
+
+
+@register_importer("Softplus")
+def _softplus(ctx, node, sym_mod):
+    return sym_mod.Activation(ctx.sym_of(node["input"][0]),
+                              act_type="softrelu", name=node["output"][0])
+
+
+@register_importer("Constant")
+def _constant(ctx, node, sym_mod):
+    val = node["attribute"]["value"]
+    ctx.initializers[node["output"][0]] = onp.asarray(val)
+    return None  # handled as an initializer reference
+
+
+# ---------------------------------------------------------------------------
+# import driver
+# ---------------------------------------------------------------------------
+def import_from_model_dict(model_dict):
+    """model dict → (sym, arg_params, aux_params).  BatchNorm running
+    stats land in aux_params (reference onnx2mx split)."""
+    from ... import sym_api as sym_mod
+    g = model_dict["graph"]
+    initializers = OrderedDict(
+        (k, onp.asarray(v)) for k, v in g["initializer"].items())
+    ctx = _ImportCtx(initializers)
+    for inp in g["input"]:
+        if inp["name"] not in initializers:
+            ctx.tensors[inp["name"]] = sym_mod.var(
+                inp["name"], shape=inp.get("shape"),
+                dtype={1: "float32", 6: "int32", 7: "int64"}.get(
+                    inp.get("elem_type", 1), "float32"))
+
+    for node in g["node"]:
+        imp = _IMPORTERS.get(node["op_type"])
+        if imp is None:
+            raise NotImplementedError(
+                "no importer for ONNX op %r (have %d importers)"
+                % (node["op_type"], len(_IMPORTERS)))
+        out_sym = imp(ctx, node, sym_mod)
+        if out_sym is not None:
+            ctx.tensors[node["output"][0]] = out_sym
+
+    heads = [ctx.tensors[o["name"]] for o in g["output"]]
+    sym = heads[0] if len(heads) == 1 else sym_mod.Group(heads)
+
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for name in ctx.used_params:
+        if name not in initializers:
+            continue
+        if name in aux_names:
+            aux_params[name] = initializers[name]
+        elif name in arg_names:
+            arg_params[name] = initializers[name]
+    return sym, arg_params, aux_params
+
+
+def _proto_to_dict(model):
+    """onnx.ModelProto → model dict (requires the onnx package)."""
+    from onnx import numpy_helper
+
+    def vi_to_dict(vi):
+        tt = vi.type.tensor_type
+        shape = [d.dim_value if d.HasField("dim_value") else None
+                 for d in tt.shape.dim] if tt.HasField("shape") else None
+        return {"name": vi.name, "elem_type": tt.elem_type, "shape": shape}
+
+    def attr_val(a):
+        from onnx import AttributeProto
+        t = a.type
+        if t == AttributeProto.INT:
+            return int(a.i)
+        if t == AttributeProto.FLOAT:
+            return float(a.f)
+        if t == AttributeProto.STRING:
+            return a.s.decode()
+        if t == AttributeProto.INTS:
+            return list(a.ints)
+        if t == AttributeProto.FLOATS:
+            return list(a.floats)
+        if t == AttributeProto.TENSOR:
+            return numpy_helper.to_array(a.t)
+        raise NotImplementedError("attribute type %d" % t)
+
+    g = model.graph
+    return {
+        "ir_version": model.ir_version,
+        "producer_name": model.producer_name,
+        "opset_import": [{"domain": o.domain, "version": o.version}
+                         for o in model.opset_import],
+        "graph": {
+            "name": g.name,
+            "node": [{"op_type": n.op_type, "name": n.name,
+                      "input": list(n.input), "output": list(n.output),
+                      "attribute": {a.name: attr_val(a)
+                                    for a in n.attribute}}
+                     for n in g.node],
+            "input": [vi_to_dict(i) for i in g.input],
+            "output": [vi_to_dict(o) for o in g.output],
+            "initializer": OrderedDict(
+                (t.name, numpy_helper.to_array(t)) for t in g.initializer),
+        },
+    }
+
+
+def import_model(model_file):
+    """Reference-compatible entry (onnx2mx.import_model): reads a .onnx
+    file; requires the `onnx` package.  The package-free path is
+    import_from_model_dict()."""
+    try:
+        import onnx
+    except ImportError as e:
+        raise ImportError(
+            "reading .onnx files requires the 'onnx' package; use "
+            "import_from_model_dict() for the package-free model dict"
+        ) from e
+    model = onnx.load(model_file)
+    return import_from_model_dict(_proto_to_dict(model))
+
+
+def get_model_metadata(model_file):
+    """Input/output signature of an ONNX file (reference
+    get_model_metadata)."""
+    try:
+        import onnx
+    except ImportError as e:
+        raise ImportError("requires the 'onnx' package") from e
+    model = onnx.load(model_file)
+    d = _proto_to_dict(model)
+    return {
+        "input_tensor_data": [(i["name"], tuple(i["shape"] or ()))
+                              for i in d["graph"]["input"]
+                              if i["name"] not in d["graph"]["initializer"]],
+        "output_tensor_data": [(o["name"], tuple(o["shape"] or ()))
+                               for o in d["graph"]["output"]],
+    }

@@ -163,6 +163,12 @@ def fix(x, out=None):
     return res
 
 
+def astype(x, dtype, copy=True):
+    """Module-level dtype cast (ndarray.astype as a free function; used
+    by graph importers that need casts as registry-resolvable ops)."""
+    return apply_op(lambda v: v.astype(dtype), x)
+
+
 # einsum: operands after the subscript string
 def einsum(subscripts, *operands, **kwargs):
     kwargs.pop("optimize", None)

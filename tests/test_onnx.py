@@ -1,0 +1,161 @@
+"""ONNX converters (parity: reference contrib/onnx mx2onnx +
+onnx2mx).  The converter logic runs on the protobuf-mirroring model
+dict, so structure + numeric round-trip tests run WITHOUT the onnx
+package; protobuf file tests engage only when it is installed."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import sym_api as sym
+from mxnet_tpu.contrib.onnx import (export_to_model_dict,
+                                    import_from_model_dict)
+
+
+def _mlp():
+    data = sym.var("data", shape=(2, 6), dtype="float32")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=8, name="fc1"),
+                       act_type="relu", name="act1")
+    out = sym.FullyConnected(h, num_hidden=3, name="fc2")
+    rng = onp.random.RandomState(0)
+    params = {
+        "fc1_weight": rng.randn(8, 6).astype("float32"),
+        "fc1_bias": rng.randn(8).astype("float32"),
+        "fc2_weight": rng.randn(3, 8).astype("float32"),
+        "fc2_bias": rng.randn(3).astype("float32"),
+    }
+    return out, params
+
+
+def test_export_model_dict_structure():
+    net, params = _mlp()
+    model = export_to_model_dict(net, params)
+    assert model["opset_import"][0]["version"] >= 13
+    g = model["graph"]
+    assert [i["name"] for i in g["input"]] == ["data"]
+    assert set(params) <= set(g["initializer"])
+    ops = [n["op_type"] for n in g["node"]]
+    # Flatten (fc1) → Gemm → Relu → Flatten (fc2) → Gemm
+    assert ops.count("Gemm") == 2 and "Relu" in ops
+    gemm = [n for n in g["node"] if n["op_type"] == "Gemm"][0]
+    assert gemm["attribute"]["transB"] == 1
+    assert g["output"][0]["shape"] == [2, 3]
+
+
+def test_mlp_roundtrip_numerics():
+    net, params = _mlp()
+    model = export_to_model_dict(net, params)
+    sym2, arg_params, aux_params = import_from_model_dict(model)
+    assert not aux_params
+    x = onp.random.RandomState(1).randn(2, 6).astype("float32")
+    env = {k: mxnp.array(v) for k, v in params.items()}
+    (ref,) = net.eval(data=mxnp.array(x), **env)
+    env2 = {k: mxnp.array(v) for k, v in arg_params.items()}
+    (out,) = sym2.eval(data=mxnp.array(x), **env2)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_convnet_roundtrip_numerics():
+    data = sym.var("data", shape=(2, 3, 8, 8), dtype="float32")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                        stride=(1, 1), name="c1")
+    bn = sym.BatchNorm(c, use_global_stats=True, fix_gamma=False,
+                       name="bn1")
+    act = sym.Activation(bn, act_type="relu", name="a1")
+    p = sym.Pooling(act, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="p1")
+    f = sym.Flatten(p, name="fl1")
+    out = sym.softmax(sym.FullyConnected(f, num_hidden=5, name="fc"),
+                      axis=-1, name="sm")
+
+    rng = onp.random.RandomState(2)
+    params = {
+        "c1_weight": (rng.randn(4, 3, 3, 3) * 0.3).astype("float32"),
+        "c1_bias": rng.randn(4).astype("float32"),
+        "bn1_gamma": rng.uniform(0.5, 1.5, 4).astype("float32"),
+        "bn1_beta": rng.randn(4).astype("float32"),
+        "bn1_moving_mean": rng.randn(4).astype("float32"),
+        "bn1_moving_var": rng.uniform(0.5, 2.0, 4).astype("float32"),
+        "fc_weight": rng.randn(5, 64).astype("float32"),
+        "fc_bias": rng.randn(5).astype("float32"),
+    }
+    model = export_to_model_dict(net := out, params)
+    ops = [n["op_type"] for n in model["graph"]["node"]]
+    for expected in ("Conv", "BatchNormalization", "Relu", "MaxPool",
+                     "Flatten", "Gemm", "Softmax"):
+        assert expected in ops, ops
+
+    sym2, arg_params, aux_params = import_from_model_dict(model)
+    # running stats split into aux (reference onnx2mx behavior)
+    assert set(aux_params) == {"bn1_moving_mean", "bn1_moving_var"}
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    env = {k: mxnp.array(v) for k, v in params.items()}
+    (ref,) = net.eval(data=mxnp.array(x), **env)
+    env2 = {k: mxnp.array(v) for k, v in {**arg_params, **aux_params}.items()}
+    (got,) = sym2.eval(data=mxnp.array(x), **env2)
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_arithmetic_and_reduce_roundtrip():
+    a = sym.var("a", shape=(3, 4), dtype="float32")
+    b = sym.var("b", shape=(3, 4), dtype="float32")
+    out = sym.sum((a + b) * a - 2.0, axis=1, keepdims=False)
+    model = export_to_model_dict(out, {})
+    ops = [n["op_type"] for n in model["graph"]["node"]]
+    assert "Add" in ops and "Mul" in ops and "Sub" in ops and \
+        "ReduceSum" in ops
+    sym2, _ap, _xp = import_from_model_dict(model)
+    rng = onp.random.RandomState(3)
+    av = rng.randn(3, 4).astype("float32")
+    bv = rng.randn(3, 4).astype("float32")
+    (ref,) = out.eval(a=mxnp.array(av), b=mxnp.array(bv))
+    (got,) = sym2.eval(a=mxnp.array(av), b=mxnp.array(bv))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-5)
+
+
+def test_embedding_roundtrip():
+    tok = sym.var("tok", shape=(2, 5), dtype="int32")
+    emb = sym.Embedding(tok, input_dim=11, output_dim=3, name="emb")
+    out = sym.sum(emb, axis=-1)
+    rng = onp.random.RandomState(4)
+    params = {"emb_weight": rng.randn(11, 3).astype("float32")}
+    model = export_to_model_dict(out, params)
+    assert any(n["op_type"] == "Gather" for n in model["graph"]["node"])
+    sym2, ap, _xp = import_from_model_dict(model)
+    toks = rng.randint(0, 11, (2, 5)).astype("int32")
+    (ref,) = out.eval(tok=mxnp.array(toks),
+                      emb_weight=mxnp.array(params["emb_weight"]))
+    env = {k: mxnp.array(v) for k, v in ap.items()}
+    (got,) = sym2.eval(tok=mxnp.array(toks), **env)
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-5)
+
+
+def test_unconvertible_op_raises_cleanly():
+    x = sym.var("x", shape=(4,), dtype="float32")
+    weird = sym.Symbol("op", op="npx:gather_nd", inputs=[x, x])
+    with pytest.raises(NotImplementedError, match="no ONNX converter"):
+        export_to_model_dict(weird, {})
+
+
+def test_onnx_file_roundtrip(tmp_path):
+    onnx = pytest.importorskip("onnx")  # noqa: F841  (absent here; CI w/ onnx runs it)
+    from mxnet_tpu.contrib.onnx import export_model, import_model
+    net, params = _mlp()
+    f = str(tmp_path / "m.onnx")
+    export_model(net, params, onnx_file_path=f)
+    sym2, ap, xp = import_model(f)
+    assert set(ap) == set(params)
+
+
+def test_export_model_without_onnx_package_gates():
+    try:
+        import onnx  # noqa: F401
+        pytest.skip("onnx installed")
+    except ImportError:
+        pass
+    from mxnet_tpu.contrib.onnx import export_model
+    net, params = _mlp()
+    with pytest.raises(ImportError, match="export_to_model_dict"):
+        export_model(net, params, onnx_file_path="/tmp/x.onnx")
