@@ -210,6 +210,14 @@ for _mx_op, _onnx_op in _SIMPLE.items():
     _CONVERTERS[_mx_op] = _simple_factory(_onnx_op)
 
 
+@register_converter("np:astype")
+def _astype(ctx, node, ins, out):
+    extra = node._attrs.get("_extra_pos") or []
+    dtype = node._attrs.get("dtype", extra[0] if extra else "float32")
+    return ctx.add_node("Cast", [ins[0]], [out], name=node.name,
+                        to=_elem_type(dtype))
+
+
 @register_converter("npx:softmax")
 def _softmax(ctx, node, ins, out):
     return ctx.add_node("Softmax", [ins[0]], [out], name=node.name,
@@ -315,9 +323,13 @@ def export_to_model_dict(sym, params, input_shapes=None, input_dtypes=None,
                 cname, onp.asarray(node._attrs["value"], onp.float32))
             names[id(node)] = cname
         elif node._kind == "index":
-            # multi-output ops expose per-output names "<name>:i"
-            names[id(node)] = "%s:%d" % (names[id(node._inputs[0])],
-                                         node._index)
+            # every emitted ONNX node is single-output: index 0 aliases
+            # the base tensor; any other index would dangle
+            if node._index != 0:
+                raise NotImplementedError(
+                    "ONNX export of multi-output op index %d (op %r)"
+                    % (node._index, node._inputs[0]._op))
+            names[id(node)] = names[id(node._inputs[0])]
         elif node._kind == "group":
             continue
         else:

@@ -67,16 +67,20 @@ class _ImportCtx:
 def _gemm(ctx, node, sym_mod):
     a = node["attribute"]
     x = ctx.sym_of(node["input"][0])
-    w_name, b_name = node["input"][1], node["input"][2]
+    w_name = node["input"][1]
     if not a.get("transB", 0):
         raise NotImplementedError("Gemm import requires transB=1 "
                                   "(weight stored [out, in])")
     num_hidden = None
     if w_name in ctx.initializers:
         num_hidden = int(ctx.initializers[w_name].shape[0])
+    if len(node["input"]) > 2:  # C (bias) is optional in ONNX Gemm
+        return sym_mod.FullyConnected(
+            x, ctx.sym_of(w_name), ctx.sym_of(node["input"][2]),
+            num_hidden=num_hidden, flatten=False, name=node["output"][0])
     return sym_mod.FullyConnected(
-        x, ctx.sym_of(w_name), ctx.sym_of(b_name),
-        num_hidden=num_hidden, flatten=False, name=node["output"][0])
+        x, ctx.sym_of(w_name), num_hidden=num_hidden, no_bias=True,
+        flatten=False, name=node["output"][0])
 
 
 @register_importer("Conv")

@@ -28,7 +28,9 @@ __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
            "roi_pooling", "boolean_mask", "index_copy", "index_array",
            "allclose", "gradientmultiplier", "multibox_prior",
            "multibox_target", "multibox_detection", "grid_generator",
-           "bilinear_sampler", "spatial_transformer", "quadratic"]
+           "bilinear_sampler", "spatial_transformer", "quadratic",
+           "fft", "ifft", "count_sketch", "deformable_convolution",
+           "modulated_deformable_convolution"]
 
 
 def _corner(boxes, fmt):
@@ -570,3 +572,162 @@ def spatial_transformer(data, loc, target_shape=None,
     (parity: spatial_transformer.cc)."""
     grid = grid_generator(loc, "affine", target_shape)
     return bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# FFT family (reference src/operator/contrib/fft.cc / ifft.cc)
+# ---------------------------------------------------------------------------
+def fft(data, compute_size=None):
+    """Forward FFT along the last axis; complex output interleaved as
+    [..., 2*d] (re, im, re, im, ...) — the reference's cuFFT layout
+    (fft.cc FFTParam).  Differentiable through jnp.fft."""
+    def f(x):
+        c = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+        out = jnp.stack([c.real, c.imag], axis=-1)
+        return out.reshape(*x.shape[:-1], 2 * x.shape[-1])
+    return apply_op(f, data)
+
+
+def ifft(data, compute_size=None):
+    """Inverse FFT of interleaved complex [..., 2*d] → real [..., d].
+    Unnormalized like cuFFT's CUFFT_INVERSE (reference ifft.cc docs: the
+    caller divides by d)."""
+    def f(x):
+        d = x.shape[-1] // 2
+        pairs = x.reshape(*x.shape[:-1], d, 2)
+        # lax.complex, NOT `re + 1j*im`: the latter lowers to an
+        # UNIMPLEMENTED constant pattern on the TPU backend
+        c = lax.complex(pairs[..., 0], pairs[..., 1])
+        return (jnp.fft.ifft(c, axis=-1).real * d).astype(jnp.float32)
+    return apply_op(f, data)
+
+
+def count_sketch(data, h, s, out_dim, processing_batch_size=None):
+    """Count sketch projection (reference contrib/count_sketch.cc):
+    out[:, h[i]] += s[i] * data[:, i].  h: hash bucket per input dim in
+    [0, out_dim); s: ±1 signs.  One scatter-add — differentiable."""
+    def f(x, hh, ss):
+        hh = hh.reshape(-1).astype(jnp.int32)
+        ss = ss.reshape(-1).astype(x.dtype)
+        out = jnp.zeros((*x.shape[:-1], out_dim), x.dtype)
+        return out.at[..., hh].add(x * ss)
+    return apply_op(f, data, h, s)
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution (reference src/operator/contrib/
+# deformable_convolution.cc + modulated_deformable_convolution.cc)
+# ---------------------------------------------------------------------------
+def _deform_sample(x, ys, xs):
+    """Bilinear-sample x:[C,H,W] at float coords ys/xs:[K,Ho,Wo] with
+    zero padding outside (reference deformable_im2col bilinear)."""
+    H, W = x.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def tap(yi, xi):
+        inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = x[:, yc, xc]                      # [C,K,Ho,Wo]
+        return jnp.where(inb[None], v, 0.0)
+
+    return (tap(y0, x0) * (1 - wy)[None] * (1 - wx)[None]
+            + tap(y0, x0 + 1) * (1 - wy)[None] * wx[None]
+            + tap(y0 + 1, x0) * wy[None] * (1 - wx)[None]
+            + tap(y0 + 1, x0 + 1) * wy[None] * wx[None])
+
+
+def _deformable_conv_impl(x, offset, weight, bias, mask, kernel, stride,
+                          pad, dilate, num_deformable_group):
+    """Shared deformable conv body.  x:[N,C,H,W]; offset:[N,2*G*K,Ho,Wo];
+    mask:[N,G*K,Ho,Wo] or None (modulated variant); weight:[O,C,kh,kw].
+
+    TPU mapping: all K taps bilinear-sample via vectorized gathers into a
+    deformable im2col tensor [N, C*K, Ho, Wo], then ONE big matmul with
+    the flattened weight rides the MXU — the reference's im2col + GEMM
+    split, with XLA fusing the sampling arithmetic."""
+    N, C, H, W = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    K = kh * kw
+    G = num_deformable_group
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    base_y = (jnp.arange(Ho) * sh - ph)[:, None]          # [Ho,1]
+    base_x = (jnp.arange(Wo) * sw - pw)[None, :]          # [1,Wo]
+    ky = (jnp.arange(kh) * dh)[:, None].repeat(kw, 1).reshape(K)
+    kx = (jnp.arange(kw) * dw)[None, :].repeat(kh, 0).reshape(K)
+
+    off = offset.reshape(N, G, K, 2, Ho, Wo)
+
+    def per_image(xi, oi, mi):
+        cols = []
+        cpg = C // G
+        for g in range(G):
+            ys = (base_y[None] + ky[:, None, None]
+                  + oi[g, :, 0])                           # [K,Ho,Wo]
+            xs = (base_x[None] + kx[:, None, None]
+                  + oi[g, :, 1])
+            sampled = _deform_sample(xi[g * cpg:(g + 1) * cpg], ys, xs)
+            if mi is not None:
+                sampled = sampled * mi[g][None]            # [C/G,K,Ho,Wo]
+            cols.append(sampled)
+        return jnp.concatenate(cols, axis=0)               # [C,K,Ho,Wo]
+
+    if mask is None:
+        cols = jax.vmap(lambda xi, oi: per_image(xi, oi, None))(x, off)
+    else:
+        m = mask.reshape(N, G, K, Ho, Wo)
+        cols = jax.vmap(per_image)(x, off, m)
+    wmat = weight.reshape(weight.shape[0], -1)             # [O, C*K]
+    out = jnp.einsum("ock,nckhw->nohw",
+                     wmat.reshape(weight.shape[0], C, K),
+                     cols,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_filter=None, num_deformable_group=1,
+                           no_bias=False, **kw):
+    """Deformable convolution v1 (reference contrib/
+    deformable_convolution.cc:1): sampling grid displaced by learned
+    per-position offsets."""
+    def f(*args):
+        x, off, w = args[:3]
+        b = args[3] if len(args) > 3 else None
+        return _deformable_conv_impl(x, off, w, b, None, tuple(kernel),
+                                     tuple(stride), tuple(pad),
+                                     tuple(dilate), num_deformable_group)
+    args = (data, offset, weight) if (no_bias or bias is None) \
+        else (data, offset, weight, bias)
+    return apply_op(f, *args)
+
+
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                     kernel=(3, 3), stride=(1, 1),
+                                     pad=(0, 0), dilate=(1, 1),
+                                     num_filter=None,
+                                     num_deformable_group=1,
+                                     no_bias=False, **kw):
+    """Deformable convolution v2 (reference contrib/
+    modulated_deformable_convolution.cc): adds a learned [0,1] modulation
+    scalar per sampling tap."""
+    def f(*args):
+        x, off, msk, w = args[:4]
+        b = args[4] if len(args) > 4 else None
+        return _deformable_conv_impl(x, off, w, b, msk, tuple(kernel),
+                                     tuple(stride), tuple(pad),
+                                     tuple(dilate), num_deformable_group)
+    args = (data, offset, mask, weight) if (no_bias or bias is None) \
+        else (data, offset, mask, weight, bias)
+    return apply_op(f, *args)

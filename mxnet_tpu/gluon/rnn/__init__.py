@@ -4,3 +4,5 @@ from .rnn_cell import (  # noqa: F401
     RecurrentCell, RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
     DropoutCell, ZoneoutCell, ResidualCell, BidirectionalCell,
     HybridSequentialRNNCell)
+from .conv_rnn_cell import (  # noqa: F401
+    ConvRNNCell, ConvLSTMCell, ConvGRUCell)

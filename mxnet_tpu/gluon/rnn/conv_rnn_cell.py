@@ -1,0 +1,161 @@
+"""Convolutional recurrent cells (parity: reference
+`python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py` — _ConvRNNCellBase,
+Conv1D/2D/3D RNN/LSTM/GRU cells).
+
+TPU-native: gates are computed by two convolutions (i2h over the input,
+h2h over the hidden state) whose outputs add channel-wise; all gate
+nonlinearities fuse into the convs under XLA, and a cell unrolled with
+RecurrentCell.unroll inside hybridize() compiles to one program.  Only
+the channels-first NC{D}HW layouts are supported (the TPU-friendly
+conv layout used across this framework)."""
+from __future__ import annotations
+
+from ... import numpy as np_mod
+from ... import numpy_extension as npx
+from ..parameter import Parameter
+from .rnn_cell import RecurrentCell
+
+__all__ = ["ConvRNNCell", "ConvLSTMCell", "ConvGRUCell"]
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvRNNCellBase(RecurrentCell):
+    """Shared conv-gate machinery (reference _BaseConvRNNCell)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, conv_dims=2,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden_channels = hidden_channels
+        self._conv_dims = conv_dims
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._i2h_kernel = _tuple(i2h_kernel, conv_dims)
+        self._h2h_kernel = _tuple(h2h_kernel, conv_dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError(
+                    "h2h_kernel dims must be odd (state shape must be "
+                    "preserved); got %r" % (self._h2h_kernel,))
+        self._i2h_pad = _tuple(i2h_pad, conv_dims)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+
+        ng = self._num_gates
+        in_c = self._input_shape[0]
+        from ..nn.basic_layers import _zeros_init
+        self.i2h_weight = Parameter(
+            "i2h_weight",
+            shape=(ng * hidden_channels, in_c) + self._i2h_kernel,
+            init=i2h_weight_initializer)
+        self.h2h_weight = Parameter(
+            "h2h_weight",
+            shape=(ng * hidden_channels, hidden_channels)
+            + self._h2h_kernel,
+            init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias",
+                                  shape=(ng * hidden_channels,),
+                                  init=_zeros_init(i2h_bias_initializer))
+        self.h2h_bias = Parameter("h2h_bias",
+                                  shape=(ng * hidden_channels,),
+                                  init=_zeros_init(h2h_bias_initializer))
+
+    def _state_shape(self):
+        spatial = tuple(
+            (s + 2 * p - k) + 1
+            for s, p, k in zip(self._input_shape[1:], self._i2h_pad,
+                               self._i2h_kernel))
+        return (self._hidden_channels,) + spatial
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size,) + self._state_shape()
+        n = len(shape)
+        layout = "NC" + "DHW"[3 - (n - 2):]
+        infos = [{"shape": shape, "__layout__": layout}]
+        if self._num_states == 2:
+            infos.append({"shape": shape, "__layout__": layout})
+        return infos
+
+    def _conv_gates(self, x, h):
+        ng = self._num_gates
+        gx = npx.convolution(
+            x, self.i2h_weight.data(), self.i2h_bias.data(),
+            kernel=self._i2h_kernel, pad=self._i2h_pad,
+            num_filter=ng * self._hidden_channels)
+        gh = npx.convolution(
+            h, self.h2h_weight.data(), self.h2h_bias.data(),
+            kernel=self._h2h_kernel, pad=self._h2h_pad,
+            num_filter=ng * self._hidden_channels)
+        return gx, gh
+
+
+class ConvRNNCell(_ConvRNNCellBase):
+    """tanh conv-RNN cell (reference Conv2DRNNCell; conv_dims selects
+    1/2/3-D)."""
+
+    _num_gates = 1
+    _num_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), activation="tanh",
+                 conv_dims=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, conv_dims, **kwargs)
+        self._activation = activation
+
+    def forward(self, x, states):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        gx, gh = self._conv_gates(x, h)
+        out = npx.activation(gx + gh, self._activation)
+        return out, [out]
+
+
+class ConvLSTMCell(_ConvRNNCellBase):
+    """Conv-LSTM (Shi et al. 2015; reference Conv2DLSTMCell).  Gate order
+    i, f, g, o matches LSTMCell."""
+
+    _num_gates = 4
+    _num_states = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), conv_dims=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, conv_dims, **kwargs)
+
+    def forward(self, x, states):
+        h, c = states
+        gx, gh = self._conv_gates(x, h)
+        gates = gx + gh
+        H = self._hidden_channels
+        i = npx.sigmoid(gates[:, :H])
+        f = npx.sigmoid(gates[:, H:2 * H])
+        u = np_mod.tanh(gates[:, 2 * H:3 * H])
+        o = npx.sigmoid(gates[:, 3 * H:])
+        next_c = f * c + i * u
+        next_h = o * np_mod.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(_ConvRNNCellBase):
+    """Conv-GRU (reference Conv2DGRUCell).  Gate order r, z, n matches
+    GRUCell."""
+
+    _num_gates = 3
+    _num_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), conv_dims=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, conv_dims, **kwargs)
+
+    def forward(self, x, states):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        gx, gh = self._conv_gates(x, h)
+        H = self._hidden_channels
+        r = npx.sigmoid(gx[:, :H] + gh[:, :H])
+        z = npx.sigmoid(gx[:, H:2 * H] + gh[:, H:2 * H])
+        n = np_mod.tanh(gx[:, 2 * H:] + r * gh[:, 2 * H:])
+        next_h = (1 - z) * n + z * h
+        return next_h, [next_h]

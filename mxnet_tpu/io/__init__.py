@@ -25,7 +25,8 @@ from .. import recordio as _recordio
 from .._native import lib as _native_lib
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ImageRecordIter", "MNISTIter", "ResizeIter", "PrefetchingIter"]
+           "LibSVMIter", "ImageRecordIter", "MNISTIter", "ResizeIter",
+           "PrefetchingIter"]
 
 
 class DataDesc:
@@ -282,6 +283,102 @@ class CSVIter(DataIter):
     @property
     def provide_label(self):
         return self._inner.provide_label
+
+
+class LibSVMIter(DataIter):
+    """Sparse batch iterator over libsvm-format text
+    (parity: src/io/iter_libsvm.cc LibSVMIter): each line is
+    ``label idx:val idx:val ...``; batches come out as CSRNDArray data
+    with dense labels — the sparse input path for linear/factorization
+    models.  Labels may instead come from a separate `label_libsvm` file
+    (multi-label lines of plain floats, same reference option)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._feat_dim = int(data_shape[0] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        values, indices, indptr, labels = [], [], [0], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                start = 0
+                if label_libsvm is None:
+                    labels.append([float(parts[0])])
+                    start = 1
+                for tok in parts[start:]:
+                    idx, val = tok.split(":")
+                    indices.append(int(idx))
+                    values.append(float(val))
+                indptr.append(len(values))
+        if label_libsvm is not None:
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.split():
+                        labels.append([float(t) for t in line.split()])
+        self._values = onp.asarray(values, onp.float32)
+        self._indices = onp.asarray(indices, onp.int32)
+        self._indptr = onp.asarray(indptr, onp.int64)
+        self._labels = onp.asarray(labels, onp.float32).reshape(
+            (-1,) + tuple(label_shape))
+        self._num = len(self._indptr) - 1
+        if self._labels.shape[0] != self._num:
+            raise ValueError(
+                "libsvm label count %d != data rows %d"
+                % (self._labels.shape[0], self._num))
+        self._round = round_batch
+        self._cursor = 0
+
+    def reset(self):
+        self._cursor = 0
+
+    def _csr_rows(self, rows):
+        """Build a batch CSRNDArray from global row ids."""
+        from ..sparse import CSRNDArray
+        counts = self._indptr[rows + 1] - self._indptr[rows]
+        bindptr = onp.zeros(len(rows) + 1, onp.int64)
+        onp.cumsum(counts, out=bindptr[1:])
+        bidx = onp.concatenate(
+            [self._indices[self._indptr[r]:self._indptr[r + 1]]
+             for r in rows]) if len(rows) else onp.zeros(0, onp.int32)
+        bval = onp.concatenate(
+            [self._values[self._indptr[r]:self._indptr[r + 1]]
+             for r in rows]) if len(rows) else onp.zeros(0, onp.float32)
+        return CSRNDArray(bval, bindptr, bidx,
+                          (len(rows), self._feat_dim))
+
+    def next(self):
+        if self._cursor >= self._num:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        pad = 0
+        if end > self._num:
+            if not self._round:
+                raise StopIteration
+            pad = end - self._num
+            rows = onp.concatenate([onp.arange(self._cursor, self._num),
+                                    onp.arange(0, pad)])
+        else:
+            rows = onp.arange(self._cursor, end)
+        self._cursor = end
+        data = self._csr_rows(rows)
+        label = _nd_array(self._labels[rows])
+        return DataBatch([data], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._feat_dim))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label",
+                         (self.batch_size,) + self._labels.shape[1:])]
 
 
 class ImageRecordIter(DataIter):

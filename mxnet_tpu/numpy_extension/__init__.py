@@ -525,3 +525,41 @@ def current_device():
 def num_gpus():
     from ..context import num_gpus as _n
     return _n()
+
+
+# -- AMP cast ops (reference src/operator/tensor/amp_cast.cc) ---------------
+# single source of truth for "is a float dtype", widths for multicast picks
+_FLOAT_WIDTHS = {"float16": 16, "bfloat16": 16, "float32": 32,
+                 "float64": 64}
+
+
+def _is_float_dtype(dtype):
+    return str(dtype) in _FLOAT_WIDTHS
+
+
+def amp_cast(data, dtype="float16", **kw):
+    """Cast ONLY floating inputs to `dtype`; integer/bool tensors pass
+    through untouched (reference amp_cast.cc AMPCastParam semantics — the
+    AMP graph pass inserts these blindly, so they must be no-ops on
+    non-float data)."""
+    from ..ndarray import apply_op
+
+    def f(x):
+        return x.astype(dtype) if _is_float_dtype(x.dtype) else x
+
+    return apply_op(f, data)
+
+
+def amp_multicast(*data, num_outputs=None, cast_narrow=False, **kw):
+    """Cast a group of tensors to a common float width (reference
+    amp_cast.cc AMPMultiCast): widest dtype wins, or the narrowest when
+    cast_narrow=True; non-float tensors pass through."""
+    if num_outputs is not None and num_outputs != len(data):
+        raise ValueError("num_outputs must equal len(data)")
+    floats = [str(d.dtype) for d in data if _is_float_dtype(d.dtype)]
+    if not floats:
+        return list(data)
+    pick = (min if cast_narrow else max)(
+        floats, key=lambda s: _FLOAT_WIDTHS[s])
+    return [amp_cast(d, pick) if _is_float_dtype(d.dtype) else d
+            for d in data]

@@ -203,3 +203,110 @@ def sgld_update(weight, grad, lr, key, wd=0.0, rescale_grad=1.0,
     noise = jax.random.normal(key, weight.shape, jnp.float32) * jnp.sqrt(lr)
     new_w = weight.astype(jnp.float32) - lr / 2 * g + noise
     return new_w.astype(weight.dtype)
+
+
+def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """FTML — Follow the Moving Leader (reference src/operator/optimizer_op.cc
+    FTMLUpdate; states d/v/z as in the paper)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w32 = weight.astype(jnp.float32)
+    g = g + wd * w32
+    t = jnp.asarray(t, jnp.float32)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * w32
+    new_w = -new_z / d_t
+    return new_w.astype(weight.dtype), d_t, new_v, new_z
+
+
+def dcasgd_update(weight, grad, prev_weight, mom, lr, momentum=0.0,
+                  lamda=0.04, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """DCASGD — delay-compensated async SGD (reference optimizer_op.cc
+    DCASGDUpdate): compensates stale gradients with lambda*g^2*(w - w_prev)."""
+    g = _apply_wd(grad, weight, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    w32 = weight.astype(jnp.float32)
+    comp = g + lamda * jnp.square(g) * (w32 - prev_weight)
+    new_mom = momentum * mom - lr * comp
+    new_w = w32 + new_mom
+    return new_w.astype(weight.dtype), w32, new_mom
+
+
+def lans_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-6, wd=0.0, t=1, rescale_grad=1.0,
+                clip_gradient=-1.0, lower_bound=None, upper_bound=None):
+    """LANS (reference src/operator/contrib/multi_lans.cc): LAMB with the
+    gradient pre-normalized per tensor and a two-part (momentum +
+    gradient) Nesterov-style trust-ratio update."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    gnorm = jnp.linalg.norm(g)
+    g = g / jnp.maximum(gnorm, 1e-12)  # per-tensor gradient normalization
+    w32 = weight.astype(jnp.float32)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    t = jnp.asarray(t, jnp.float32)
+    m_hat = new_mean / (1 - beta1 ** t)
+    v_hat = new_var / (1 - beta2 ** t)
+    denom = jnp.sqrt(v_hat) + epsilon
+    r_m = m_hat / denom + wd * w32            # momentum direction
+    r_g = g / denom + wd * w32                # gradient direction
+    wnorm = jnp.linalg.norm(w32)
+
+    def ratio(direction):
+        dnorm = jnp.linalg.norm(direction)
+        r = jnp.where(dnorm > 0, wnorm / jnp.maximum(dnorm, 1e-12), 1.0)
+        r = jnp.where(wnorm > 0, r, 1.0)
+        if lower_bound is not None:
+            r = jnp.maximum(r, lower_bound)
+        if upper_bound is not None:
+            r = jnp.minimum(r, upper_bound)
+        return r
+
+    update = beta1 * ratio(r_m) * r_m + (1 - beta1) * ratio(r_g) * r_g
+    new_w = w32 - lr * update
+    return new_w.astype(weight.dtype), new_mean, new_var
+
+
+def multi_sgd_mom_update(weights, grads, moms, lrs, momentum=0.0, wds=None,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    """Multi-tensor SGD-momentum: the whole parameter group updates in ONE
+    jitted XLA program (reference multi_sgd_mom_update, optimizer_op.cc:313
+    — hand-written kernel there, one fused executable here)."""
+    wds = wds if wds is not None else [0.0] * len(weights)
+    new_ws, new_ms = [], []
+    for w, g, m, lr, wd in zip(weights, grads, moms, lrs, wds):
+        nw, nm = sgd_mom_update(w, g, m, lr, momentum, wd, rescale_grad,
+                                clip_gradient)
+        new_ws.append(nw)
+        new_ms.append(nm)
+    return new_ws, new_ms
+
+
+def multi_lans_update(weights, grads, means, vars_, lrs, beta1=0.9,
+                      beta2=0.999, epsilon=1e-6, wds=None, ts=None,
+                      rescale_grad=1.0, clip_gradient=-1.0,
+                      lower_bound=None, upper_bound=None):
+    """Multi-tensor LANS (reference contrib/multi_lans.cc multi_lans_update):
+    one executable for the whole group; per-tensor norms stay per-tensor."""
+    wds = wds if wds is not None else [0.0] * len(weights)
+    ts = ts if ts is not None else [1] * len(weights)
+    outs = [lans_update(w, g, m, v, lr, beta1, beta2, epsilon, wd, t,
+                        rescale_grad, clip_gradient, lower_bound,
+                        upper_bound)
+            for w, g, m, v, lr, wd, t in
+            zip(weights, grads, means, vars_, lrs, wds, ts)]
+    return ([o[0] for o in outs], [o[1] for o in outs],
+            [o[2] for o in outs])
+
+
+def multi_sum_sq(*arrays):
+    """Sum of squares per tensor in one program (reference
+    multi_sum_sq.cc; feeds LARS-style trust ratios)."""
+    return [jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays]

@@ -23,6 +23,15 @@ from ..ops import optimizer_ops as _ops
 
 _OPT_REGISTRY = {}
 
+# multi-tensor kernels compile ONCE for a parameter-group signature; the
+# whole group then updates in a single XLA program (reference multi_sgd_* /
+# multi_lans kernels, src/operator/optimizer_op.cc:313, contrib/multi_lans.cc)
+_multi_sgd_mom_jit = jax.jit(_ops.multi_sgd_mom_update,
+                             static_argnames=("clip_gradient",))
+_multi_lans_jit = jax.jit(_ops.multi_lans_update,
+                          static_argnames=("clip_gradient", "lower_bound",
+                                          "upper_bound"))
+
 
 def register(klass):
     _OPT_REGISTRY[klass.__name__.lower()] = klass
@@ -212,6 +221,34 @@ class SGD(Optimizer):
                 self.rescale_grad, clip)
             weight._set_data(new_w)
             state._set_data(new_m)
+
+    def update(self, indices, weights, grads, states):
+        """aggregate_num>0: fuse groups of parameters into one XLA
+        program per chunk (reference multi_sgd_mom_update)."""
+        from ..sparse import BaseSparseNDArray
+        usable = (self.aggregate_num and self.momentum
+                  and isinstance(indices, (list, tuple))
+                  and len(indices) > 1
+                  and not any(isinstance(g, BaseSparseNDArray)
+                              for g in grads))
+        if not usable:
+            return super().update(indices, weights, grads, states)
+        n = self.aggregate_num
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        for s in range(0, len(indices), n):
+            idx = indices[s:s + n]
+            ws, gs, sts = weights[s:s + n], grads[s:s + n], states[s:s + n]
+            for i in idx:
+                self._update_count(i)
+            new_ws, new_ms = _multi_sgd_mom_jit(
+                [w._data for w in ws], [g._data for g in gs],
+                [m._data for m in sts],
+                [self._get_lr(i) for i in idx], self.momentum,
+                [self._get_wd(i) for i in idx], self.rescale_grad,
+                clip_gradient=clip)
+            for w, m, nw, nm in zip(ws, sts, new_ws, new_ms):
+                w._set_data(nw)
+                m._set_data(nm)
 
 
 @register
@@ -554,6 +591,163 @@ class LARS(Optimizer):
 
     def step_one(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        new_w, new_m = _ops.lars_update(
+            weight._data, grad._data, state._data, lr, self.eta,
+            self.momentum, wd, self.epsilon, self.rescale_grad, clip)
+        weight._set_data(new_w)
+        state._set_data(new_m)
+
+
+@register
+class LANS(Optimizer):
+    """LANS (reference src/operator/contrib/multi_lans.cc + contrib
+    optimizer): LAMB with per-tensor gradient normalization and a
+    two-part Nesterov trust-ratio update.  aggregate_num>0 fuses the
+    whole parameter group into one XLA program (multi_lans_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 **kwargs):
+        kwargs.setdefault("aggregate_num", 4)
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+
+    def create_state(self, index, weight):
+        return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        mean, var = state
+        new_w, new_m, new_v = _ops.lans_update(
+            weight._data, grad._data, mean._data, var._data, lr,
+            self.beta1, self.beta2, self.epsilon, wd, t,
+            self.rescale_grad, clip, self.lower_bound, self.upper_bound)
+        weight._set_data(new_w)
+        mean._set_data(new_m)
+        var._set_data(new_v)
+
+    def update(self, indices, weights, grads, states):
+        if not (self.aggregate_num and isinstance(indices, (list, tuple))
+                and len(indices) > 1):
+            return super().update(indices, weights, grads, states)
+        n = self.aggregate_num
+        for s in range(0, len(indices), n):
+            idx = indices[s:s + n]
+            ws = weights[s:s + n]
+            gs = grads[s:s + n]
+            sts = states[s:s + n]
+            for i in idx:
+                self._update_count(i)
+            clip = self.clip_gradient if self.clip_gradient else -1.0
+            new_ws, new_ms, new_vs = _multi_lans_jit(
+                [w._data for w in ws], [g._data for g in gs],
+                [st[0]._data for st in sts], [st[1]._data for st in sts],
+                [self._get_lr(i) for i in idx],
+                self.beta1, self.beta2, self.epsilon,
+                [self._get_wd(i) for i in idx],
+                [self._index_update_count[i] for i in idx],
+                self.rescale_grad, clip_gradient=clip,
+                lower_bound=self.lower_bound,
+                upper_bound=self.upper_bound)
+            for w, st, nw, nm, nv in zip(ws, sts, new_ws, new_ms, new_vs):
+                w._set_data(nw)
+                st[0]._set_data(nm)
+                st[1]._set_data(nv)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (reference optimizer_op.cc FTMLUpdate)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_wrap_value(jnp.zeros(weight.shape, jnp.float32)),  # d
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)),  # v
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))  # z
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        d, v, z = state
+        new_w, new_d, new_v, new_z = _ops.ftml_update(
+            weight._data, grad._data, d._data, v._data, z._data, lr, t,
+            self.beta1, self.beta2, self.epsilon, wd, self.rescale_grad,
+            clip)
+        weight._set_data(new_w)
+        d._set_data(new_d)
+        v._set_data(new_v)
+        z._set_data(new_z)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer_op.cc
+    DCASGDUpdate): staleness compensated via lambda*g^2*(w - w_prev)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (_wrap_value(weight._data.astype(jnp.float32)),  # prev w
+                _wrap_value(jnp.zeros(weight.shape, jnp.float32)))  # mom
+
+    def step_one(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        prev_w, mom = state
+        new_w, new_prev, new_mom = _ops.dcasgd_update(
+            weight._data, grad._data, prev_w._data, mom._data, lr,
+            self.momentum, self.lamda, wd, self.rescale_grad, clip)
+        weight._set_data(new_w)
+        prev_w._set_data(new_prev)
+        mom._set_data(new_mom)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-Batch SGD with LARC layer-wise rate adaption + warmup
+    (reference python/mxnet/optimizer/optimizer.py LBSGD)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-9, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+        self.warmup_strategy = warmup_strategy
+        self.warmup_updates = max(1, warmup_epochs * updates_per_epoch)
+        self.batch_scale = batch_scale
+
+    def create_state(self, index, weight):
+        return _wrap_value(jnp.zeros(weight.shape, jnp.float32))
+
+    def _warmup_lr(self, lr):
+        t = min(self.num_update, self.warmup_updates)
+        frac = t / float(self.warmup_updates)
+        if self.warmup_strategy == "linear":
+            return lr * (frac + (1 - frac) / self.batch_scale)
+        if self.warmup_strategy == "power":
+            return lr * (frac ** 2 + (1 - frac ** 2) / self.batch_scale)
+        return lr  # 'lars' and unknown strategies: no warmup scaling
+
+    def step_one(self, index, weight, grad, state):
+        lr = self._warmup_lr(self._get_lr(index))
+        wd = self._get_wd(index)
         clip = self.clip_gradient if self.clip_gradient else -1.0
         new_w, new_m = _ops.lars_update(
             weight._data, grad._data, state._data, lr, self.eta,

@@ -785,6 +785,8 @@ def _legacy_factory(opname, spec):
                 s = kwargs.pop(slot, None)
                 if s is None and extra_pos:
                     s = extra_pos.pop(0)
+                if s is None and slot == "bias" and kwargs.get("no_bias"):
+                    continue  # no implicit bias var under no_bias=True
                 node_inputs.append(_as_symbol(s) if s is not None
                                    else var("%s_%s" % (name, slot)))
             for slot in spec["aux"]:

@@ -1,0 +1,161 @@
+"""ConvRNN/ConvLSTM/ConvGRU cells (reference gluon/contrib/rnn/
+conv_rnn_cell.py) and the LibSVM sparse iterator (reference
+src/io/iter_libsvm.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp, autograd
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.io import LibSVMIter
+
+
+def _np_conv2d_same(x, w, b, pad):
+    """Direct-loop conv for tiny shapes."""
+    N, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    xp = onp.pad(x, ((0, 0), (0, 0), (pad[0],) * 2, (pad[1],) * 2))
+    Ho = H + 2 * pad[0] - kh + 1
+    Wo = W + 2 * pad[1] - kw + 1
+    out = onp.zeros((N, O, Ho, Wo), "float64")
+    for n in range(N):
+        for o in range(O):
+            for i in range(Ho):
+                for j in range(Wo):
+                    out[n, o, i, j] = (
+                        xp[n, :, i:i + kh, j:j + kw] * w[o]).sum() + b[o]
+    return out
+
+
+def _sigmoid(v):
+    return 1 / (1 + onp.exp(-v))
+
+
+def test_conv_lstm_cell_matches_numpy():
+    mx.random.seed(0)
+    cell = rnn.ConvLSTMCell(input_shape=(2, 5, 5), hidden_channels=3,
+                            i2h_kernel=(3, 3), h2h_kernel=(3, 3),
+                            i2h_pad=(1, 1))
+    cell.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(0)
+    x = rng.randn(2, 2, 5, 5).astype("float32")
+    h0 = rng.randn(2, 3, 5, 5).astype("float32")
+    c0 = rng.randn(2, 3, 5, 5).astype("float32")
+    out, (h1, c1) = cell(mxnp.array(x), [mxnp.array(h0), mxnp.array(c0)])
+
+    wi = cell.i2h_weight.data().asnumpy()
+    wh = cell.h2h_weight.data().asnumpy()
+    bi = cell.i2h_bias.data().asnumpy()
+    bh = cell.h2h_bias.data().asnumpy()
+    gates = (_np_conv2d_same(x, wi, bi, (1, 1))
+             + _np_conv2d_same(h0, wh, bh, (1, 1)))
+    i = _sigmoid(gates[:, :3])
+    f = _sigmoid(gates[:, 3:6])
+    u = onp.tanh(gates[:, 6:9])
+    o = _sigmoid(gates[:, 9:])
+    c_ref = f * c0 + i * u
+    h_ref = o * onp.tanh(c_ref)
+    onp.testing.assert_allclose(c1.asnumpy(), c_ref, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(h1.asnumpy(), h_ref, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(out.asnumpy(), h_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_gru_and_rnn_cells_shapes_and_state_info():
+    for Cell, nstates in ((rnn.ConvGRUCell, 1), (rnn.ConvRNNCell, 1)):
+        cell = Cell(input_shape=(2, 6, 6), hidden_channels=4)
+        cell.initialize(mx.init.Xavier())
+        x = mxnp.random.uniform(size=(3, 2, 6, 6))
+        states = cell.begin_state(3)
+        assert len(states) == nstates
+        out, new_states = cell(x, states)
+        assert out.shape == (3, 4, 6, 6)
+        info = cell.state_info(3)
+        assert info[0]["shape"] == (3, 4, 6, 6)
+        assert info[0]["__layout__"] == "NCHW"
+
+
+def test_conv_lstm_unroll_gradients_flow():
+    cell = rnn.ConvLSTMCell(input_shape=(1, 4, 4), hidden_channels=2)
+    cell.initialize(mx.init.Xavier())
+    seq = mxnp.random.uniform(size=(3, 2, 1, 4, 4))  # TNC-HW
+    with autograd.record():
+        outs, _states = cell.unroll(3, seq, layout="TNC")
+        loss = (outs ** 2).sum()
+    loss.backward()
+    g = cell.i2h_weight.grad().asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_conv_cell_even_h2h_kernel_rejected():
+    with pytest.raises(ValueError, match="odd"):
+        rnn.ConvLSTMCell(input_shape=(1, 4, 4), hidden_channels=2,
+                         h2h_kernel=(2, 2))
+
+
+# ---------------------------------------------------------------------------
+# LibSVM iterator
+# ---------------------------------------------------------------------------
+def _write_libsvm(path, rows, labels=None):
+    with open(path, "w") as f:
+        for r, row in enumerate(rows):
+            toks = [] if labels is None else [str(labels[r])]
+            toks += ["%d:%g" % (i, v) for i, v in row]
+            f.write(" ".join(toks) + "\n")
+
+
+def test_libsvm_iter_batches_csr(tmp_path):
+    rows = [[(0, 1.0), (3, 2.5)], [(1, -1.0)], [(2, 4.0), (4, 0.5)],
+            [(0, 3.0)], [(4, -2.0)]]
+    labels = [1, 0, 1, 0, 1]
+    p = str(tmp_path / "train.libsvm")
+    _write_libsvm(p, rows, labels)
+    it = LibSVMIter(data_libsvm=p, data_shape=(5,), batch_size=2)
+    b1 = it.next()
+    d = b1.data[0]
+    assert d.stype == "csr"
+    dense = d.todense().asnumpy()
+    ref = onp.zeros((2, 5), "float32")
+    ref[0, 0], ref[0, 3] = 1.0, 2.5
+    ref[1, 1] = -1.0
+    onp.testing.assert_allclose(dense, ref)
+    onp.testing.assert_allclose(b1.label[0].asnumpy().ravel(), [1, 0])
+    b2 = it.next()
+    assert b2.pad == 0
+    b3 = it.next()  # 5 rows, bs=2 → last batch wraps with pad=1
+    assert b3.pad == 1
+    dense3 = b3.data[0].todense().asnumpy()
+    ref3 = onp.zeros((2, 5), "float32")
+    ref3[0, 4] = -2.0   # row 4
+    ref3[1, 0] = 1.0    # wrapped row 0
+    ref3[1, 3] = 2.5
+    onp.testing.assert_allclose(dense3, ref3)
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    again = it.next().data[0].todense().asnumpy()
+    onp.testing.assert_allclose(again, ref)
+
+
+def test_libsvm_iter_separate_label_file(tmp_path):
+    rows = [[(0, 1.0)], [(1, 2.0)], [(2, 3.0)]]
+    p = str(tmp_path / "d.libsvm")
+    lp = str(tmp_path / "l.libsvm")
+    _write_libsvm(p, rows)
+    with open(lp, "w") as f:
+        f.write("1 0\n0 1\n1 1\n")  # two labels per row
+    it = LibSVMIter(data_libsvm=p, data_shape=(3,), label_libsvm=lp,
+                    label_shape=(2,), batch_size=3)
+    b = it.next()
+    onp.testing.assert_allclose(b.label[0].asnumpy(),
+                                [[1, 0], [0, 1], [1, 1]])
+
+
+def test_libsvm_iter_discard_tail(tmp_path):
+    rows = [[(0, 1.0)], [(1, 2.0)], [(2, 3.0)]]
+    p = str(tmp_path / "d2.libsvm")
+    _write_libsvm(p, rows, [0, 1, 0])
+    it = LibSVMIter(data_libsvm=p, data_shape=(3,), batch_size=2,
+                    round_batch=False)
+    it.next()
+    with pytest.raises(StopIteration):
+        it.next()

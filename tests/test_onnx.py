@@ -159,3 +159,51 @@ def test_export_model_without_onnx_package_gates():
     net, params = _mlp()
     with pytest.raises(ImportError, match="export_to_model_dict"):
         export_model(net, params, onnx_file_path="/tmp/x.onnx")
+
+
+def test_reexport_of_imported_model_is_symmetric():
+    # Embedding export emits Cast+Gather; the imported graph (np:astype)
+    # must itself be exportable (review finding: converter symmetry)
+    tok = sym.var("tok", shape=(2, 3), dtype="int32")
+    out = sym.sum(sym.Embedding(tok, input_dim=7, output_dim=2,
+                                name="emb"), axis=-1)
+    params = {"emb_weight":
+              onp.random.RandomState(5).randn(7, 2).astype("float32")}
+    model = export_to_model_dict(out, params)
+    sym2, ap, _xp = import_from_model_dict(model)
+    model2 = export_to_model_dict(sym2, ap)  # must not raise
+    assert any(n["op_type"] == "Cast" for n in model2["graph"]["node"])
+
+
+def test_import_gemm_without_optional_bias():
+    w = onp.random.RandomState(6).randn(3, 4).astype("float32")
+    model = {
+        "ir_version": 8, "producer_name": "t",
+        "opset_import": [{"domain": "", "version": 13}],
+        "graph": {
+            "name": "g",
+            "node": [{"op_type": "Gemm", "name": "fc",
+                      "input": ["data", "w"], "output": ["fc"],
+                      "attribute": {"transB": 1}}],
+            "input": [{"name": "data", "elem_type": 1, "shape": [2, 4]}],
+            "output": [{"name": "fc", "elem_type": 1, "shape": [2, 3]}],
+            "initializer": {"w": w},
+        },
+    }
+    sym2, ap, _xp = import_from_model_dict(model)
+    x = onp.random.RandomState(7).randn(2, 4).astype("float32")
+    (out,) = sym2.eval(data=mxnp.array(x),
+                       **{k: mxnp.array(v) for k, v in ap.items()})
+    onp.testing.assert_allclose(out.asnumpy(), x @ w.T, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_index0_node_exports_as_base_name():
+    data = sym.var("data", shape=(2, 4), dtype="float32")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    model = export_to_model_dict(fc[0], {
+        "fc_weight": onp.zeros((3, 4), "float32"),
+        "fc_bias": onp.zeros(3, "float32")})
+    out_name = model["graph"]["output"][0]["name"]
+    produced = {o for n in model["graph"]["node"] for o in n["output"]}
+    assert out_name in produced  # no dangling "fc:0" reference
