@@ -1,0 +1,123 @@
+"""Thread-safe inference: N Python threads driving ONE hybridized
+executable concurrently (parity: reference
+src/imperative/cached_op_threadsafe.cc + example/multi_threaded_inference)
+— outputs must match single-threaded results and the signature cache
+must not recompile."""
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.gluon import nn
+
+N_THREADS = 8
+CALLS_PER_THREAD = 10
+
+
+def _make_net():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2), nn.Flatten(),
+            nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return net
+
+
+def test_concurrent_forward_matches_single_thread():
+    net = _make_net()
+    rng = onp.random.RandomState(0)
+    inputs = [rng.randn(2, 3, 8, 8).astype("float32")
+              for _ in range(N_THREADS * CALLS_PER_THREAD)]
+    # first call finalizes deferred shapes eagerly; second compiles
+    net(mxnp.array(inputs[0])).asnumpy()
+    net(mxnp.array(inputs[0])).asnumpy()
+    assert len(net._cached_graphs) == 1
+
+    refs = [net(mxnp.array(x)).asnumpy() for x in inputs]
+
+    results = [None] * len(inputs)
+    errors = []
+    start = threading.Barrier(N_THREADS)
+
+    def worker(tid):
+        try:
+            start.wait()
+            for c in range(CALLS_PER_THREAD):
+                i = tid * CALLS_PER_THREAD + c
+                results[i] = net(mxnp.array(inputs[i])).asnumpy()
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors
+    for i, (got, ref) in enumerate(zip(results, refs)):
+        assert got is not None, "call %d never completed" % i
+        onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6,
+                                    err_msg="call %d diverged" % i)
+    # one signature → one compiled executable, before and after the storm
+    assert len(net._cached_graphs) == 1
+
+
+def test_concurrent_forward_multiple_signatures_no_recompile():
+    net = _make_net()
+    shapes = [(1, 3, 8, 8), (4, 3, 8, 8)]
+    rng = onp.random.RandomState(1)
+    for s in shapes:  # precompile both signatures (first call is eager)
+        net(mxnp.array(rng.randn(*s).astype("float32"))).asnumpy()
+        net(mxnp.array(rng.randn(*s).astype("float32"))).asnumpy()
+    assert len(net._cached_graphs) == 2
+
+    errors = []
+
+    def worker(tid):
+        try:
+            r = onp.random.RandomState(100 + tid)
+            for c in range(CALLS_PER_THREAD):
+                s = shapes[(tid + c) % 2]
+                x = r.randn(*s).astype("float32")
+                out = net(mxnp.array(x)).asnumpy()
+                assert out.shape == (s[0], 4)
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors
+    assert len(net._cached_graphs) == 2  # no signature churn / recompiles
+
+
+def test_batchnorm_aux_state_stable_under_concurrent_inference():
+    """Inference must not mutate BatchNorm running stats, even under
+    concurrency (the reference's thread-safe CachedOp forbids aux
+    writes in inference mode)."""
+    net = _make_net()
+    x = mxnp.random.uniform(size=(2, 3, 8, 8))
+    net(x).asnumpy()
+    net(x).asnumpy()  # compiled path active
+    bn = net[1]
+    before = bn.running_mean.data().asnumpy().copy()
+
+    def worker():
+        for _ in range(CALLS_PER_THREAD):
+            net(x).asnumpy()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    onp.testing.assert_array_equal(bn.running_mean.data().asnumpy(),
+                                   before)
