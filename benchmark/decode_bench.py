@@ -1,0 +1,105 @@
+"""Input-pipeline decode benchmark (VERDICT r1 item #6).
+
+Measures ImageRecordIter throughput (native libjpeg decode on the host
+engine worker pool, GIL released per decode) against the pure-Python
+PIL decode path on the same .rec file.  Prints one JSON line; run with
+`python benchmark/decode_bench.py` and commit the number.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def make_rec(path, n=256, hw=256):
+    from PIL import Image
+    from mxnet_tpu import recordio
+    rng = onp.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(path + ".idx", path, "w")
+    for i in range(n):
+        arr = rng.randint(0, 255, (hw, hw, 3), dtype=onp.uint8)
+        buf = _pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+        header = recordio.IRHeader(0, float(i % 10), i, 0)
+        w.write_idx(i, recordio.pack(header, buf.getvalue()))
+    w.close()
+
+
+def bench_imagerecorditer(path, batch_size=32, resize=224, shape=224):
+    from mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=path, path_imgidx=path + ".idx",
+                         data_shape=(3, shape, shape),
+                         batch_size=batch_size, resize=resize,
+                         rand_crop=True, rand_mirror=True,
+                         mean_r=123.68, mean_g=116.78, mean_b=103.94,
+                         std_r=58.4, std_g=57.12, std_b=57.38)
+    n = 0
+    # warmup epoch
+    for batch in it:
+        n += batch.data[0].shape[0]
+    it.reset()
+    t0 = time.perf_counter()
+    m = 0
+    for batch in it:
+        m += batch.data[0].shape[0]
+    dt = time.perf_counter() - t0
+    return m / dt
+
+
+def bench_python_pil(path, batch_size=32, resize=224, shape=224):
+    """The same pipeline decoded by PIL in a single-threaded loop (what a
+    naive Python DataLoader does per worker)."""
+    from PIL import Image
+    from mxnet_tpu import recordio
+    reader = recordio.MXRecordIO(path, "r")
+    t0 = time.perf_counter()
+    m = 0
+    rng = onp.random.RandomState(0)
+    while True:
+        rec = reader.read()
+        if rec is None:
+            break
+        _h, payload = recordio.unpack(rec)
+        img = onp.asarray(Image.open(_pyio.BytesIO(payload)))
+        ih, iw = img.shape[:2]
+        s = resize / min(ih, iw)
+        img = onp.asarray(Image.fromarray(img).resize(
+            (int(iw * s + 0.5), int(ih * s + 0.5))))
+        ih, iw = img.shape[:2]
+        y = rng.randint(0, ih - shape + 1)
+        x = rng.randint(0, iw - shape + 1)
+        img = img[y:y + shape, x:x + shape].astype(onp.float32)
+        img = (img - [123.68, 116.78, 103.94]) / [58.4, 57.12, 57.38]
+        _ = onp.transpose(img, (2, 0, 1))
+        m += 1
+    dt = time.perf_counter() - t0
+    reader.close()
+    return m / dt
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bench.rec")
+        make_rec(path)
+        native = bench_imagerecorditer(path)
+        python = bench_python_pil(path)
+    print(json.dumps({
+        "metric": "imagerecorditer_decode_imgs_per_sec",
+        "value": round(native, 1),
+        "unit": "img/s",
+        "python_pil_baseline": round(python, 1),
+        "speedup_vs_python": round(native / python, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

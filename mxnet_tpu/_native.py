@@ -102,7 +102,43 @@ def _declare(lib):
     lib.MXTPrefetcherPop.argtypes = [p, ctypes.POINTER(ctypes.c_void_p),
                                      ctypes.POINTER(u64)]
     lib.MXTPrefetcherDestroy.argtypes = [p]
+
+    i32 = ctypes.c_int
+    lib.MXTImdecode.restype = i32
+    lib.MXTImdecode.argtypes = [ctypes.c_char_p, u64, i32, i32,
+                                ctypes.POINTER(i32), ctypes.POINTER(i32),
+                                ctypes.POINTER(i32),
+                                ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXTImresize.restype = i32
+    lib.MXTImresize.argtypes = [ctypes.c_char_p, i32, i32, i32, i32, i32,
+                                ctypes.c_char_p]
+    lib.MXTImFreeBuffer.argtypes = [ctypes.c_void_p]
     return lib
+
+
+def native_imdecode(payload, resize_short=0):
+    """Decode a JPEG via the native decoder (GIL released during the C
+    call).  Returns an HWC uint8 array, or None when the payload isn't a
+    JPEG / the native lib is unavailable / decode failed."""
+    L = lib()
+    if L is None:
+        return None
+    import numpy as onp
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    out = ctypes.c_void_p()
+    rc = L.MXTImdecode(payload, len(payload), 1, int(resize_short),
+                       ctypes.byref(h), ctypes.byref(w), ctypes.byref(c),
+                       ctypes.byref(out))
+    if rc != 1:
+        return None
+    try:
+        buf = ctypes.string_at(out, h.value * w.value * c.value)
+    finally:
+        L.MXTImFreeBuffer(out)
+    arr = onp.frombuffer(buf, dtype=onp.uint8)
+    return arr.reshape(h.value, w.value, c.value)
 
 
 def _try_build():

@@ -388,6 +388,14 @@ def _decode_img(payload, iscolor=-1):
         h, w, c = struct.unpack("<III", payload[8:20])
         arr = onp.frombuffer(payload[20:], dtype=onp.uint8)
         return arr.reshape((h, w, c) if c > 1 else (h, w))
+    if payload[:2] == b"\xff\xd8":  # JPEG: native libjpeg path (no GIL)
+        from ._native import native_imdecode
+        img = native_imdecode(payload)
+        if img is not None:
+            if iscolor == 0 and img.ndim == 3:
+                img = onp.round(
+                    img.astype(onp.float32).mean(-1)).astype(onp.uint8)
+            return img
     try:
         import cv2
         arr = onp.frombuffer(payload, dtype=onp.uint8)

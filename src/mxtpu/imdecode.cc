@@ -1,0 +1,159 @@
+// JPEG decode + resize primitives for the input pipeline.
+//
+// Parity: reference src/io/iter_image_recordio_2.cc:887 decodes JPEG
+// inside an OMP worker pool (opencv imdecode).  Here the decode itself is
+// native (libjpeg, with DCT-domain prescaling like the fast-path image
+// loaders) and releases the GIL for the whole call, so the host engine's
+// worker threads decode genuinely in parallel while XLA runs the step.
+//
+// Exposed C ABI:
+//   MXTImdecode(buf, len, to_rgb, resize_short, &h, &w, &c, &out)
+//     -> 1 ok (malloc'd HWC uint8 in *out), 0 unsupported format, -1 error
+//   MXTImresize(src, h, w, c, nh, nw, dst)  bilinear HWC uint8
+//   MXTImFreeBuffer(p)
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <jpeglib.h>
+
+#include "common.h"
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void on_jpeg_error(j_common_ptr cinfo) {
+  ErrMgr* err = reinterpret_cast<ErrMgr*>(cinfo->err);
+  std::longjmp(err->jump, 1);  // default handler would exit() the process
+}
+
+void bilinear_resize(const unsigned char* src, int h, int w, int c, int nh,
+                     int nw, unsigned char* dst) {
+  const float sy = nh > 1 ? float(h - 1) / float(nh - 1) : 0.f;
+  const float sx = nw > 1 ? float(w - 1) / float(nw - 1) : 0.f;
+  for (int y = 0; y < nh; ++y) {
+    const float fy = y * sy;
+    const int y0 = int(fy);
+    const int y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+    const float wy = fy - y0;
+    for (int x = 0; x < nw; ++x) {
+      const float fx = x * sx;
+      const int x0 = int(fx);
+      const int x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+      const float wx = fx - x0;
+      const unsigned char* p00 = src + (y0 * w + x0) * c;
+      const unsigned char* p01 = src + (y0 * w + x1) * c;
+      const unsigned char* p10 = src + (y1 * w + x0) * c;
+      const unsigned char* p11 = src + (y1 * w + x1) * c;
+      unsigned char* q = dst + (y * nw + x) * c;
+      for (int k = 0; k < c; ++k) {
+        const float v = (1 - wy) * ((1 - wx) * p00[k] + wx * p01[k]) +
+                        wy * ((1 - wx) * p10[k] + wx * p11[k]);
+        q[k] = (unsigned char)(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode a JPEG buffer to HWC uint8 (RGB when to_rgb, else untouched
+// libjpeg order, which is also RGB for JFIF).  resize_short > 0 rescales
+// so the short side lands on that value: the DCT prescaler (M/8 steps)
+// gets close cheaply, bilinear finishes exactly.
+MXTPU_API int MXTImdecode(const char* buf, uint64_t len, int to_rgb,
+                          int resize_short, int* out_h, int* out_w,
+                          int* out_c, unsigned char** out_data) {
+  (void)to_rgb;
+  if (len < 3 || (unsigned char)buf[0] != 0xFF ||
+      (unsigned char)buf[1] != 0xD8) {
+    return 0;  // not a JPEG — caller falls back (PNG etc. stay in Python)
+  }
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_jpeg_error;
+  unsigned char* data = nullptr;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    std::free(data);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, reinterpret_cast<const unsigned char*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+
+  if (resize_short > 0) {
+    // pick the smallest M/8 scale whose short side still >= resize_short
+    const int short_side =
+        cinfo.image_width < cinfo.image_height ? cinfo.image_width
+                                               : cinfo.image_height;
+    int m = 8;
+    while (m > 1 && (short_side * (m - 1)) / 8 >= resize_short) --m;
+    cinfo.scale_num = m;
+    cinfo.scale_denom = 8;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int w = cinfo.output_width;
+  const int h = cinfo.output_height;
+  const int c = cinfo.output_components;
+  data = static_cast<unsigned char*>(std::malloc((size_t)h * w * c));
+  if (data == nullptr) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = data + (size_t)cinfo.output_scanline * w * c;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  if (resize_short > 0) {
+    const int short_side = w < h ? w : h;
+    if (short_side != resize_short) {
+      const float scale = float(resize_short) / float(short_side);
+      const int nh = (int)(h * scale + 0.5f);
+      const int nw = (int)(w * scale + 0.5f);
+      unsigned char* resized =
+          static_cast<unsigned char*>(std::malloc((size_t)nh * nw * c));
+      if (resized == nullptr) {
+        std::free(data);
+        return -1;
+      }
+      bilinear_resize(data, h, w, c, nh, nw, resized);
+      std::free(data);
+      data = resized;
+      *out_h = nh;
+      *out_w = nw;
+      *out_c = c;
+      *out_data = data;
+      return 1;
+    }
+  }
+  *out_h = h;
+  *out_w = w;
+  *out_c = c;
+  *out_data = data;
+  return 1;
+}
+
+MXTPU_API int MXTImresize(const unsigned char* src, int h, int w, int c,
+                          int nh, int nw, unsigned char* dst) {
+  if (h <= 0 || w <= 0 || c <= 0 || nh <= 0 || nw <= 0) return -1;
+  bilinear_resize(src, h, w, c, nh, nw, dst);
+  return 1;
+}
+
+MXTPU_API void MXTImFreeBuffer(unsigned char* p) { std::free(p); }
+
+}  // extern "C"
