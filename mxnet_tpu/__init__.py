@@ -64,6 +64,8 @@ from . import rtc
 from . import sparse
 from . import symbol  # StableHLO deployment artifact (HybridBlock.export)
 from . import sym_api as sym  # composable graph API (mx.sym.var + ops)
+from . import config  # typed MXNET_* knob registry
+config.check_env()  # warn on unknown/inert MXNET_* vars, don't ignore them
 
 
 from . import test_utils

@@ -34,8 +34,8 @@ def waitall():
 
 
 def engine_type():
-    return os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") or \
-        "ThreadedEnginePerDevice"
+    from .config import get as _cfg
+    return _cfg("MXNET_ENGINE_TYPE") or "ThreadedEnginePerDevice"
 
 
 class EngineError(RuntimeError):
@@ -207,8 +207,8 @@ def default_engine():
     if _default_engine is None:
         with _default_lock:
             if _default_engine is None:
-                nw = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "0")
-                         or 0)
+                from .config import get as _cfg
+                nw = int(_cfg("MXNET_CPU_WORKER_NTHREADS") or 0)
                 if nw <= 0:
                     nw = max(4, os.cpu_count() or 1)
                 _default_engine = Engine(num_workers=nw)

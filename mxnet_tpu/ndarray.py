@@ -36,7 +36,9 @@ __all__ = ["ndarray", "NDArray", "apply_op", "from_numpy", "waitall"]
 # --------------------------------------------------------------------------
 # engine shims: NaiveEngine mode + waitall tracking
 # --------------------------------------------------------------------------
-_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+from .config import get as _cfg_get  # typed MXNET_* registry
+
+_NAIVE = _cfg_get("MXNET_ENGINE_TYPE") == "NaiveEngine"
 _PENDING = []  # ALL in-flight buffers, for waitall() completeness
 _PENDING_LOCK = threading.Lock()
 _PENDING_PRUNE_AT = 256  # amortized prune threshold (keeps memory bounded)
