@@ -122,8 +122,12 @@ def bench_resnet50_dp_kvstore():
     net.initialize(mx.init.Xavier())
     net.hybridize()
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    # aggregate_num=len(params): the whole optimizer update fuses into
+    # ONE XLA program (single signature → single compile), cutting the
+    # eager per-param dispatch chain that dominates this imperative path
     trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.05, "momentum": 0.9},
+                            {"learning_rate": 0.05, "momentum": 0.9,
+                             "aggregate_num": 1000},
                             kvstore="tpu_ici")
     x = mxnp.random.uniform(size=(batch, 3, 224, 224))
     y = mxnp.random.randint(0, 1000, size=(batch,))

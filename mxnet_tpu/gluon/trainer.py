@@ -153,31 +153,43 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        # ONE batched optimizer call for the whole parameter set: the
+        # optimizer's multi-tensor path (aggregate_num) fuses groups into
+        # single XLA programs instead of per-param eager dispatch
+        # (reference multi_sgd kernels + MXNET_OPTIMIZER_AGGREGATION_SIZE)
+        idxs, ws, gs, sts = [], [], [], []
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
                 continue
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state_multi_precision(
                     i, p.data())
-            grad = p.grad()
             if (getattr(p, "_grad_stype", "default") == "row_sparse"
                     and getattr(self._optimizer, "lazy_update", False)):
-                # sparse_grad path (Embedding): hand the optimizer a
-                # row_sparse view so only touched rows update (reference
-                # lazy_update kernels, src/operator/optimizer_op.cc).
-                # Only a per-row bool mask crosses to host (input_dim
-                # bytes), not the full gradient; rows gather on-device.
-                import numpy as onp
-                import jax.numpy as jnp
-                from ..sparse import RowSparseNDArray
-                gv = grad._data
-                mask = onp.asarray(jnp.any(
-                    gv != 0, axis=tuple(range(1, gv.ndim))))
-                rows = onp.nonzero(mask)[0].astype("int32")
-                grad = RowSparseNDArray(gv[rows], rows, grad.shape,
-                                        grad.dtype)
-            self._optimizer.update_multi_precision(
-                [i], [p.data()], [grad], [self._states[i]])
+                self._sparse_update_one(i, p)
+                continue
+            idxs.append(i)
+            ws.append(p.data())
+            gs.append(p.grad())
+            sts.append(self._states[i])
+        if idxs:
+            self._optimizer.update_multi_precision(idxs, ws, gs, sts)
+
+    def _sparse_update_one(self, i, p):
+        # sparse_grad path (Embedding): hand the optimizer a row_sparse
+        # view so only touched rows update (reference lazy_update kernels,
+        # src/operator/optimizer_op.cc).  Only a per-row bool mask crosses
+        # to host (input_dim bytes); rows gather on-device.
+        import numpy as onp
+        import jax.numpy as jnp
+        from ..sparse import RowSparseNDArray
+        grad = p.grad()
+        gv = grad._data
+        mask = onp.asarray(jnp.any(gv != 0, axis=tuple(range(1, gv.ndim))))
+        rows = onp.nonzero(mask)[0].astype("int32")
+        grad = RowSparseNDArray(gv[rows], rows, grad.shape, grad.dtype)
+        self._optimizer.update_multi_precision(
+            [i], [p.data()], [grad], [self._states[i]])
 
     def save_states(self, fname):
         """Serialize optimizer states (reference Trainer.save_states)."""

@@ -10,6 +10,7 @@ Trainer calls `update_multi_precision` per parameter, and each distinct
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import numpy as onp
@@ -181,6 +182,14 @@ def _rsp_prologue(grad, rescale, clip):
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
                  **kwargs):
+        # reference SGD reads MXNET_OPTIMIZER_AGGREGATION_SIZE (default 4)
+        # because its multi_sgd is ONE hand-written kernel for any shapes;
+        # here each distinct group signature is an XLA compile, so fusion
+        # is opt-in (env or aggregate_num=) — a many-shaped model would
+        # pay dozens of remote compiles before its first step
+        if "aggregate_num" not in kwargs:
+            kwargs["aggregate_num"] = int(
+                os.environ.get("MXNET_OPTIMIZER_AGGREGATION_SIZE", "0"))
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
@@ -249,6 +258,14 @@ class SGD(Optimizer):
             for w, m, nw, nm in zip(ws, sts, new_ws, new_ms):
                 w._set_data(nw)
                 m._set_data(nm)
+
+    def update_multi_precision(self, indices, weights, grads, states):
+        # without fp16 master-weight tuples this is exactly update();
+        # route there so the multi-tensor fused path engages
+        if not self.multi_precision:
+            return self.update(indices, weights, grads, states)
+        return super().update_multi_precision(indices, weights, grads,
+                                              states)
 
 
 @register

@@ -43,6 +43,7 @@ def test_amp_and_fp32_graphs_are_isolated():
     onp.testing.assert_array_equal(back, ref)  # fp32 graph untouched
 
 
+@pytest.mark.slow
 def test_amp_training_converges():
     net = _net()
     amp_net = amp.convert_hybrid_block(net)

@@ -34,6 +34,7 @@ def test_bert_forward_shapes():
     assert nsp.shape == (2, 2)
 
 
+@pytest.mark.slow
 def test_bert_mlm_trains():
     mx.random.seed(0)
     net = bert_tiny(dropout=0.0)

@@ -55,6 +55,7 @@ def test_checkpoint_missing_raises(tmp_path):
         load_checkpoint(str(tmp_path / "none"), {"x": mxnp.zeros(2)})
 
 
+@pytest.mark.slow
 def test_bandwidth_harness_runs():
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")

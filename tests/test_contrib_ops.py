@@ -148,6 +148,7 @@ def test_multibox_prior():
                                  0.125 + 0.25, 0.125 + 0.25], atol=1e-6)
 
 
+@pytest.mark.slow
 def test_multibox_target_and_detection_pipeline():
     anchors = cops.multibox_prior(mxnp.zeros((1, 3, 4, 4)),
                                   sizes=(0.4,), ratios=(1.0,))

@@ -34,6 +34,7 @@ def _launch(tmp_path, mode, n=2, s=1, timeout=180):
     return results
 
 
+@pytest.mark.slow
 def test_dist_sync_push_pull(tmp_path):
     results = _launch(tmp_path, "kv", n=2, s=1)
     assert all(r["kv_ok"] for r in results)
@@ -41,11 +42,13 @@ def test_dist_sync_push_pull(tmp_path):
     assert all(r["num_workers"] == 2 for r in results)
 
 
+@pytest.mark.slow
 def test_dist_sync_multiple_servers(tmp_path):
     results = _launch(tmp_path, "kv", n=2, s=2)
     assert all(r["kv_ok"] for r in results)
 
 
+@pytest.mark.slow
 def test_dist_trainer_replicas_stay_identical(tmp_path):
     results = _launch(tmp_path, "trainer", n=2, s=1)
     p0, p1 = results[0]["params"], results[1]["params"]
@@ -55,16 +58,19 @@ def test_dist_trainer_replicas_stay_identical(tmp_path):
                                     err_msg="replica divergence in %s" % k)
 
 
+@pytest.mark.slow
 def test_dist_p3_sliced_arrays(tmp_path):
     results = _launch(tmp_path, "p3", n=2, s=2)
     assert all(r["p3_ok"] for r in results)
 
 
+@pytest.mark.slow
 def test_dist_gradient_compression(tmp_path):
     results = _launch(tmp_path, "gc", n=2, s=1)
     assert all(r["gc_ok"] for r in results)
 
 
+@pytest.mark.slow
 def test_dist_update_on_kvstore(tmp_path):
     results = _launch(tmp_path, "server_opt", n=2, s=1)
     digests = [r["params_digest"] for r in results]

@@ -43,6 +43,7 @@ def test_moe_capacity_drops_zero_tokens():
                                 rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_differentiable():
     mesh = _mesh(2)
     moe = MoELayer(num_experts=4, d_model=8, d_hidden=16, mesh=mesh,

@@ -186,6 +186,7 @@ def test_creation_dtypes_and_constants():
     assert np.finfo(np.float32).eps == onp.finfo(onp.float32).eps
 
 
+@pytest.mark.slow
 def test_random_distributions_shapes():
     assert np.random.uniform(0, 1, size=(3, 4)).shape == (3, 4)
     assert np.random.normal(0, 1, size=5).shape == (5,)

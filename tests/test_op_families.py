@@ -179,17 +179,18 @@ def test_modulated_deformable_conv_mask_scales_taps():
 
 
 def test_deformable_conv_gradients():
+    # tiny shapes: finite differences re-run the op per input element
     rng = onp.random.RandomState(8)
-    x = rng.randn(1, 2, 4, 4).astype("float32")
-    w = (rng.randn(2, 2, 3, 3) * 0.3).astype("float32")
+    x = rng.randn(1, 1, 3, 3).astype("float32")
+    w = (rng.randn(1, 1, 2, 2) * 0.3).astype("float32")
     # keep sampling coords well away from integer grid points: bilinear
     # interpolation has gradient kinks there and finite differences
     # straddle them (same caveat as the reference's numeric grad tests)
-    off = (rng.uniform(0.2, 0.45, (1, 18, 4, 4))
-           * rng.choice([-1.0, 1.0], (1, 18, 4, 4))).astype("float32")
+    off = (rng.uniform(0.2, 0.45, (1, 8, 2, 2))
+           * rng.choice([-1.0, 1.0], (1, 8, 2, 2))).astype("float32")
     check_numeric_gradient(
         lambda a, o, ww: cops.deformable_convolution(
-            a, o, ww, kernel=(3, 3), pad=(1, 1)),
+            a, o, ww, kernel=(2, 2)),
         [x, off, w], rtol=5e-2, atol=5e-2)
 
 

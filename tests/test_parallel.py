@@ -87,8 +87,10 @@ def test_tensor_parallel_matches_replicated():
     onp.testing.assert_allclose(results[0], results[1], rtol=1e-4)
 
 
-@pytest.mark.parametrize("causal,window", [(False, None), (True, None),
-                                           (False, 16)])
+@pytest.mark.parametrize("causal,window", [
+    (False, None),
+    pytest.param(True, None, marks=pytest.mark.slow),
+    pytest.param(False, 16, marks=pytest.mark.slow)])
 def test_ring_attention_matches_reference(causal, window):
     """Ring attention over an 8-way sequence shard == single-device
     attention."""

@@ -72,7 +72,8 @@ def _make_net():
     return net
 
 
-@pytest.mark.parametrize("mode", ["naive", "entropy"])
+@pytest.mark.parametrize("mode", [
+    "naive", pytest.param("entropy", marks=pytest.mark.slow)])
 def test_quantize_net(mode):
     rng = onp.random.RandomState(0)
     net = _make_net()

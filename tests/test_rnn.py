@@ -152,6 +152,7 @@ def test_bidirectional_cell():
     assert out.shape == (2, 3, 8)
 
 
+@pytest.mark.slow
 def test_lstm_lm_trains():
     """LSTM language-model slice (BASELINE config #5 shape)."""
     V, E, H, T, B = 20, 8, 16, 6, 4
