@@ -616,6 +616,9 @@ class ImageRecordIter(DataIter):
         results = self._finish_batch(cur)
         imgs = [r[0] for r in results]
         labels = [r[1] for r in results]
+        return self._emit_batch(imgs, labels)
+
+    def _emit_batch(self, imgs, labels):
         pad = 0
         if len(imgs) < self.batch_size:
             if not self.round_batch:
@@ -624,7 +627,14 @@ class ImageRecordIter(DataIter):
             while len(imgs) < self.batch_size:  # pad by repeating from start
                 imgs.append(imgs[len(imgs) % max(1, self.batch_size - pad)])
                 labels.append(labels[len(labels) % max(1, self.batch_size - pad)])
-        data = self._to_device_normalized(onp.stack(imgs))
+        # batch staging buffer from the pooled host arena: steady-state
+        # epochs stop hitting malloc (reference pinned staging buffers,
+        # src/storage/pooled_storage_manager.h)
+        from ..storage import alloc_array
+        batch = alloc_array((len(imgs),) + imgs[0].shape, imgs[0].dtype)
+        for i, im in enumerate(imgs):
+            batch[i] = im
+        data = self._to_device_normalized(batch)
         label = _nd_array(onp.asarray(labels, onp.float32))
         return DataBatch([data], [label], pad, None)
 

@@ -1,0 +1,113 @@
+"""Host storage manager: Python face of the native pooled arena
+(src/mxtpu/storage.cc; parity: reference src/storage/
+pooled_storage_manager.h + storage profiler counters).
+
+Device (HBM) memory is PJRT's job — XLA pools and reuses buffers — so
+this manager serves the host staging path: batch assembly buffers for
+the input pipeline and serialization scratch.  ``alloc_array`` returns a
+numpy array backed by pooled memory; when the array (and every view of
+it) is garbage-collected the block returns to the pool, so steady-state
+input pipelines stop hitting malloc.
+
+API:
+  storage.default_pool()           # process pool (or None w/o native lib)
+  storage.alloc_array(shape, dt)   # pooled-backed numpy array
+  storage.stats()                  # {used, pooled, peak, allocs, hits}
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+import weakref
+
+import numpy as onp
+
+from ._native import lib as _native_lib
+from .config import get as _cfg_get, register as _cfg_register
+
+__all__ = ["HostPool", "default_pool", "alloc_array", "stats"]
+
+_cfg_register("MXNET_HOST_MEM_POOL_TYPE", str, "round", "honored",
+              "host staging pool strategy: naive|round|power2",
+              "storage.default_pool")
+
+_STRATEGIES = {"naive": 0, "unpooled": 0, "round": 1, "power2": 2}
+
+
+class HostPool:
+    """One pooled host arena (free-list reuse, round/power2 bucketing)."""
+
+    def __init__(self, strategy="round", page_size=4096,
+                 max_pool_bytes=1 << 31):
+        lib = _native_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.MXTStorageCreate(
+            _STRATEGIES.get(str(strategy).lower(), 1), page_size,
+            max_pool_bytes)
+
+    def alloc_array(self, shape, dtype="uint8"):
+        """numpy array over a pooled block; the block returns to the pool
+        when the array and all its views are collected."""
+        shape = tuple(int(s) for s in shape)
+        dt = onp.dtype(dtype)
+        nbytes = max(1, int(onp.prod(shape)) * dt.itemsize)
+        ptr = self._lib.MXTStorageAlloc(self._h, nbytes)
+        if not ptr:
+            raise MemoryError("host pool alloc of %d bytes failed" % nbytes)
+        buf = (ctypes.c_char * nbytes).from_address(ptr)
+        # finalizer holds self, so the pool outlives every outstanding block
+        weakref.finalize(buf, self._lib.MXTStorageFree, self._h,
+                         ctypes.c_void_p(ptr))
+        arr = onp.frombuffer(buf, dtype=dt)
+        return arr.reshape(shape) if shape else arr
+
+    def stats(self):
+        out = (ctypes.c_uint64 * 5)()
+        self._lib.MXTStorageStats(self._h, out)
+        return {"used_bytes": out[0], "pooled_bytes": out[1],
+                "peak_bytes": out[2], "alloc_count": out[3],
+                "pool_hits": out[4]}
+
+    def release_all(self):
+        self._lib.MXTStorageReleaseAll(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.MXTStorageDestroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def default_pool():
+    """Process-global host pool, or None when the native lib is absent."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                try:
+                    _default = HostPool(
+                        strategy=_cfg_get("MXNET_HOST_MEM_POOL_TYPE"))
+                except RuntimeError:
+                    return None
+    return _default
+
+
+def alloc_array(shape, dtype="uint8"):
+    """Pooled-backed numpy array; plain numpy when no native pool."""
+    pool = default_pool()
+    if pool is None:
+        return onp.empty(shape, dtype)
+    return pool.alloc_array(shape, dtype)
+
+
+def stats():
+    pool = default_pool()
+    return pool.stats() if pool is not None else None

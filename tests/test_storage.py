@@ -1,0 +1,89 @@
+"""Host storage pool (src/mxtpu/storage.cc wired via mxnet_tpu.storage;
+parity: reference pooled_storage_manager.h free-list reuse + profiler
+counters)."""
+import gc
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import storage
+
+
+def _pool_or_skip():
+    pool = storage.default_pool()
+    if pool is None:
+        pytest.skip("native runtime unavailable")
+    return pool
+
+
+def test_alloc_array_roundtrip_and_reuse():
+    pool = storage.HostPool(strategy="round", page_size=4096)
+    a = pool.alloc_array((16, 16), "float32")
+    a[:] = 1.5
+    onp.testing.assert_allclose(a.sum(), 16 * 16 * 1.5)
+    s0 = pool.stats()
+    assert s0["alloc_count"] >= 1 and s0["used_bytes"] > 0
+    del a
+    gc.collect()
+    s1 = pool.stats()
+    assert s1["used_bytes"] == 0
+    assert s1["pooled_bytes"] > 0  # freed block parked in the free list
+    b = pool.alloc_array((16, 16), "float32")  # same bucket → pool hit
+    s2 = pool.stats()
+    assert s2["pool_hits"] >= s1["pool_hits"] + 1
+    del b
+
+
+def test_views_keep_block_alive():
+    pool = storage.HostPool()
+    a = pool.alloc_array((64,), "uint8")
+    a[:] = onp.arange(64, dtype=onp.uint8)
+    view = a[10:20]
+    del a
+    gc.collect()
+    # the view still reads valid pooled memory
+    onp.testing.assert_array_equal(view, onp.arange(10, 20, dtype=onp.uint8))
+    del view
+    gc.collect()
+    assert pool.stats()["used_bytes"] == 0
+
+
+def test_default_pool_stats_shape():
+    _pool_or_skip()
+    s = storage.stats()
+    assert set(s) == {"used_bytes", "pooled_bytes", "peak_bytes",
+                      "alloc_count", "pool_hits"}
+
+
+def test_power2_bucketing_reuses_across_sizes():
+    pool = storage.HostPool(strategy="power2")
+    a = pool.alloc_array((1000,), "uint8")   # rounds to 1024
+    del a
+    gc.collect()
+    b = pool.alloc_array((900,), "uint8")    # same 1024 bucket → hit
+    assert pool.stats()["pool_hits"] >= 1
+    del b
+
+
+def test_imagerecorditer_uses_pooled_staging(tmp_path):
+    _pool_or_skip()
+    import io as pyio
+    from PIL import Image
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+    rng = onp.random.RandomState(0)
+    path = str(tmp_path / "x.rec")
+    w = recordio.MXIndexedRecordIO(path + ".idx", path, "w")
+    for i in range(8):
+        arr = rng.randint(0, 255, (40, 40, 3), dtype=onp.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                     buf.getvalue()))
+    w.close()
+    before = storage.stats()["alloc_count"]
+    it = ImageRecordIter(path_imgrec=path, path_imgidx=path + ".idx",
+                         data_shape=(3, 32, 32), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 2
+    assert storage.stats()["alloc_count"] > before  # staging came from pool
