@@ -77,3 +77,219 @@ class _Int8Backend(SubgraphBackend):
             calib_data = [args[0]] if args else None
         return quantize_net(block, calib_data=calib_data,
                             calib_mode=calib_mode)
+
+
+# ---------------------------------------------------------------------------
+# Symbol-DAG partitioner (reference SubgraphSelector + BuildSubgraph,
+# src/operator/subgraph/subgraph_property.h:252 + build_subgraph.cc:823)
+# ---------------------------------------------------------------------------
+class SubgraphSelector:
+    """Node-membership policy.  Override select(); select_input/_output
+    control growth across an edge (reference SubgraphSelector API)."""
+
+    def select(self, node):
+        raise NotImplementedError
+
+    def select_input(self, node, input_node):
+        return self.select(input_node)
+
+    def select_output(self, node, output_node):
+        return self.select(output_node)
+
+
+class OpNameSelector(SubgraphSelector):
+    """Membership by op id set, e.g. {'legacy:FullyConnected', 'np:add'}
+    (reference ContainOpNames selector)."""
+
+    def __init__(self, op_names):
+        self.op_names = set(op_names)
+
+    def select(self, node):
+        return node._kind == "op" and node._op in self.op_names
+
+
+class SubgraphProperty:
+    """Pairs a selector with a subgraph-node factory (reference
+    SubgraphProperty).  Override create_subgraph_node to wrap the inner
+    graph differently (e.g. a quantized or precompiled executor)."""
+
+    def create_selector(self):
+        raise NotImplementedError
+
+    def create_subgraph_node(self, inner_sym, inner_inputs, outer_inputs,
+                             index):
+        from .sym_api import Symbol
+        node = Symbol("subgraph", name="subgraph%d" % index,
+                      inputs=list(outer_inputs),
+                      attrs={"inner_inputs": list(inner_inputs)})
+        node._inner = inner_sym
+        return node
+
+
+class OpNameProperty(SubgraphProperty):
+    def __init__(self, op_names):
+        self.op_names = op_names
+
+    def create_selector(self):
+        return OpNameSelector(self.op_names)
+
+
+def _member_reachable_via_outsiders(node, members):
+    """True when some group member is an ancestor of `node` along a path
+    whose FIRST step leaves the group — contracting such a group would
+    make the subgraph node both producer and consumer of an outside node
+    (the cycle BuildSubgraph must avoid)."""
+    for i in node._inputs:
+        if id(i) in members:
+            continue  # direct member edge is fine
+        stack, seen = [i], set()
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            if id(n) in members:
+                return True
+            stack.extend(n._inputs)
+    return False
+
+
+def build_subgraph(sym, prop):
+    """Partition sym's DAG: maximal valid groups of selected nodes become
+    subgraph nodes (reference BuildSubgraph pass).  Groups are grown in
+    topological order; a candidate joins only if merging keeps the
+    contraction acyclic (no member→non-member→member path)."""
+    from .sym_api import Symbol, var
+
+    selector = prop.create_selector()
+    order = sym._topo()
+    selected = {id(n) for n in order if selector.select(n)}
+
+    # greedy grouping in topo order with cycle check
+    group_of = {}  # id(node) -> group idx
+    groups = []    # list of [nodes]
+    for n in order:
+        if id(n) not in selected:
+            continue
+        # candidate groups: groups of selected direct inputs
+        cand = {group_of[id(i)] for i in n._inputs
+                if id(i) in group_of and selector.select_input(n, i)}
+        placed = False
+        for g in sorted(cand):
+            members = {id(m) for m in groups[g]}
+            if _member_reachable_via_outsiders(n, members):
+                continue  # merging would contract across an outside node
+            groups[g].append(n)
+            group_of[id(n)] = g
+            placed = True
+            break
+        if not placed:
+            group_of[id(n)] = len(groups)
+            groups.append([n])
+
+    # build replacement nodes for groups with >= 2 members
+    replacement = {}
+    sub_index = 0
+    for g, members in enumerate(groups):
+        if len(members) < 2:
+            continue
+        member_ids = {id(m) for m in members}
+        # external inputs in first-seen order
+        ext, ext_ids = [], set()
+        for m in members:
+            for i in m._inputs:
+                if id(i) not in member_ids and id(i) not in ext_ids:
+                    ext.append(i)
+                    ext_ids.add(id(i))
+        inner_names = ["in%d" % k for k in range(len(ext))]
+        inner_vars = {id(e): var(nm, shape=e._shape, dtype=e._dtype)
+                      for e, nm in zip(ext, inner_names)}
+
+        # clone the member sub-DAG onto the inner vars
+        clone = {}
+
+        def rebuild(node):
+            if id(node) in clone:
+                return clone[id(node)]
+            if id(node) in inner_vars:
+                return inner_vars[id(node)]
+            if id(node) not in member_ids:
+                # external node referenced deeper than direct input
+                nm = "in%d" % len(ext)
+                ext.append(node)
+                inner_names.append(nm)
+                v = var(nm, shape=node._shape, dtype=node._dtype)
+                inner_vars[id(node)] = v
+                return v
+            new = Symbol(node._kind, name=node.name, op=node._op,
+                         inputs=[rebuild(i) for i in node._inputs],
+                         attrs=dict(node._attrs), index=node._index)
+            if node._kind == "subgraph":
+                new._inner = node._inner
+            clone[id(node)] = new
+            return new
+
+        # outputs: members consumed outside the group (or the graph head)
+        consumed_outside = []
+        head_ids = {id(h) for h in
+                    (sym._inputs if sym._kind == "group" else [sym])}
+        for m in members:
+            used_out = any(
+                id(u) not in member_ids and any(id(i) == id(m)
+                                               for i in u._inputs)
+                for u in order)
+            if used_out or id(m) in head_ids:
+                consumed_outside.append(m)
+        inner_heads = [rebuild(m) for m in consumed_outside]
+        inner_sym = inner_heads[0] if len(inner_heads) == 1 else None
+        if inner_sym is None:
+            from .sym_api import Group
+            inner_sym = Group(inner_heads)
+        node = prop.create_subgraph_node(inner_sym, inner_names, ext,
+                                         sub_index)
+        sub_index += 1
+        if len(inner_heads) == 1:
+            replacement[id(consumed_outside[0])] = node
+        else:
+            for k, m in enumerate(consumed_outside):
+                replacement[id(m)] = node[k]
+
+    if not replacement:
+        return sym
+
+    # rewrite the full graph with members replaced
+    new_nodes = {}
+
+    def rewrite(node):
+        if id(node) in new_nodes:
+            return new_nodes[id(node)]
+        if id(node) in replacement:
+            rep = replacement[id(node)]
+            # the subgraph node's outer inputs must themselves be
+            # rewritten — exactly once (multi-output groups share it)
+            tgt = rep._inputs[0] if rep._kind == "index" else rep
+            if id(tgt) not in new_nodes:
+                new_nodes[id(tgt)] = tgt  # self-map before recursing
+                tgt._inputs = [rewrite(i) for i in tgt._inputs]
+            new_nodes[id(node)] = rep
+            return rep
+        new = Symbol(node._kind, name=node.name, op=node._op,
+                     inputs=[rewrite(i) for i in node._inputs],
+                     attrs=dict(node._attrs), shape=node._shape,
+                     dtype=node._dtype, aux=node._aux, index=node._index)
+        if node._kind == "subgraph":
+            new._inner = node._inner
+        new_nodes[id(node)] = new
+        return new
+
+    return rewrite(sym)
+
+
+def partition_symbol(sym, op_names):
+    """Convenience: group nodes whose op id is in op_names
+    (reference partition_for / optimize_for on symbols)."""
+    return build_subgraph(sym, OpNameProperty(op_names))
+
+
+__all__ += ["SubgraphSelector", "OpNameSelector", "SubgraphProperty",
+            "OpNameProperty", "build_subgraph", "partition_symbol"]

@@ -243,6 +243,27 @@ class Symbol:
                     r = r[node._index]
             elif node._kind == "group":
                 r = [walk(i) for i in node._inputs]
+            elif node._kind == "subgraph":
+                inner_names = node._attrs["inner_inputs"]
+                env2 = {}
+                pending = []  # unshaped outer vars the inner pass may infer
+                for nm, inp in zip(inner_names, node._inputs):
+                    if inp._kind == "var" and inp.name not in env and \
+                            inp._shape is None:
+                        pending.append((nm, inp))
+                    else:
+                        env2[nm] = tuple(walk(inp).shape)
+                inner_shapes = node._inner._shape_pass(env2)
+                # implicit-parameter shapes inferred inside (legacy op
+                # rules) propagate back to the outer arguments
+                for nm, inp in pending:
+                    if nm in env2:
+                        env[inp.name] = env2[nm]
+                if isinstance(inner_shapes, list):
+                    r = [jax.ShapeDtypeStruct(s, "float32")
+                         for s in inner_shapes]
+                else:
+                    r = jax.ShapeDtypeStruct(inner_shapes, "float32")
             else:  # op
                 if node._op.startswith("legacy:"):
                     spec = _LEGACY[node._op.split(":", 1)[1]]
@@ -303,6 +324,10 @@ class Symbol:
                     r = r[node._index]
             elif node._kind == "group":
                 r = [walk(i) for i in node._inputs]
+            elif node._kind == "subgraph":
+                vals = [walk(i) for i in node._inputs]
+                env2 = dict(zip(node._attrs["inner_inputs"], vals))
+                r = node._inner._eval(env2)
             else:
                 fn = _resolve_op(node._op)
                 args = [walk(i) for i in node._inputs]
@@ -363,6 +388,9 @@ class Symbol:
                 d["value"] = n._attrs["value"]
             elif n._kind == "index":
                 d["index"] = n._index
+            elif n._kind == "subgraph":
+                d["inner"] = json.loads(n._inner.tojson())
+                d["inner_inputs"] = list(n._attrs["inner_inputs"])
             out.append(d)
         return json.dumps({"format": _FORMAT, "nodes": out,
                            "heads": [idx[id(self)]]})
@@ -616,6 +644,10 @@ def fromjson(text):
                            index=nd["index"])
             elif kind == "group":
                 s = Symbol("group", name=nd.get("name"), inputs=inputs)
+            elif kind == "subgraph":
+                s = Symbol("subgraph", name=nd.get("name"), inputs=inputs,
+                           attrs={"inner_inputs": nd["inner_inputs"]})
+                s._inner = fromjson(json.dumps(nd["inner"]))
             else:
                 _resolve_op(nd["op"])  # validate early
                 s = Symbol("op", name=nd.get("name"), op=nd["op"],

@@ -1,0 +1,99 @@
+"""Symbol-DAG subgraph partitioner (reference SubgraphSelector +
+BuildSubgraph, subgraph_property.h:252 / build_subgraph.cc:823)."""
+import json
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import sym_api as sym
+from mxnet_tpu.subgraph import (OpNameProperty, build_subgraph,
+                                partition_symbol)
+
+
+def _count(s, kind):
+    return sum(1 for n in s._topo() if n._kind == kind)
+
+
+def test_mlp_chain_partitions_into_one_subgraph():
+    data = sym.var("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=8, name="fc1"),
+                       act_type="relu", name="a1")
+    out = sym.FullyConnected(h, num_hidden=3, name="fc2")
+    part = partition_symbol(out, {"legacy:FullyConnected",
+                                  "legacy:Activation"})
+    assert _count(part, "subgraph") == 1
+    assert _count(part, "op") == 0  # the whole chain got swallowed
+    # numerics unchanged
+    rng = onp.random.RandomState(0)
+    env = {"data": mxnp.array(rng.randn(2, 6).astype("float32")),
+           "fc1_weight": mxnp.array(rng.randn(8, 6).astype("float32")),
+           "fc1_bias": mxnp.zeros(8),
+           "fc2_weight": mxnp.array(rng.randn(3, 8).astype("float32")),
+           "fc2_bias": mxnp.zeros(3)}
+    (ref,) = out.eval(**env)
+    (got,) = part.eval(**env)
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-5)
+    # argument surface is preserved
+    assert sorted(part.list_arguments()) == sorted(out.list_arguments())
+
+
+def test_partition_respects_acyclicity():
+    # b(sel) → c(NOT sel) → d(sel), plus b → d directly: merging {b, d}
+    # would contract a node that c both depends on and feeds → must stay
+    # two groups (singletons here, so no subgraph nodes at all)
+    x = sym.var("x")
+    b = sym.sin(x, name="b")
+    c = sym.exp(b, name="c")            # not selected
+    d = sym.multiply(b, c, name="d")
+    part = partition_symbol(d, {"np:sin", "np:multiply"})
+    assert _count(part, "subgraph") == 0
+    (ref,) = d.eval(x=mxnp.array([0.3, 0.7]))
+    (got,) = part.eval(x=mxnp.array([0.3, 0.7]))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-6)
+
+
+def test_partition_multi_output_group():
+    # group {a, b} where BOTH are consumed outside → subgraph with Group
+    # inner and index outputs
+    x = sym.var("x")
+    a = sym.sin(x, name="a")
+    b = sym.multiply(a, 2.0, name="b")
+    c = sym.exp(a, name="c")   # consumes a from outside the group
+    out = sym.add(b, c)
+    part = partition_symbol(out, {"np:sin", "np:multiply"})
+    assert _count(part, "subgraph") == 1
+    (ref,) = out.eval(x=mxnp.array([0.1, 0.9]))
+    (got,) = part.eval(x=mxnp.array([0.1, 0.9]))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-6)
+
+
+def test_partitioned_symbol_bind_and_grad():
+    data = sym.var("data")
+    out = sym.FullyConnected(sym.Activation(
+        sym.FullyConnected(data, num_hidden=4, name="f1"),
+        act_type="tanh"), num_hidden=2, name="f2")
+    part = build_subgraph(out, OpNameProperty(
+        {"legacy:FullyConnected", "legacy:Activation"}))
+    ex = part.simple_bind(data=(3, 5))
+    rng = onp.random.RandomState(1)
+    for k in ex.arg_dict:
+        ex.arg_dict[k] = mxnp.array(
+            rng.uniform(-1, 1, ex.arg_dict[k].shape).astype("float32"))
+    (o,) = ex.forward()
+    assert o.shape == (3, 2)
+    ex.backward()
+    assert onp.abs(ex.grad_dict["f1_weight"].asnumpy()).sum() > 0
+
+
+def test_partitioned_json_roundtrip():
+    x = sym.var("x", shape=(2, 2), dtype="float32")
+    out = sym.add(sym.sin(x, name="s"), sym.cos(x, name="c"))
+    part = partition_symbol(out, {"np:sin", "np:add"})
+    back = sym.fromjson(part.tojson())
+    v = mxnp.array([[0.1, 0.2], [0.3, 0.4]])
+    (ref,) = part.eval(x=v)
+    (got,) = back.eval(x=v)
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-6)
+    assert _count(back, "subgraph") == _count(part, "subgraph")
