@@ -1,0 +1,50 @@
+/* Minimal embedder using the C predict API (parity: reference
+ * example/image-classification/predict-cpp over c_predict_api.h).
+ * Usage: predict_example <symbol.json> <params.npz> <n_in> <v0> <v1> ...
+ * Prints the flat output values. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* MXTPredCreate(const char*, const char*, const char*);
+extern const char* MXTPredLastError(void*);
+extern int MXTPredSetInput(void*, const char*, const float*,
+                           const int64_t*, int);
+extern int MXTPredForward(void*);
+extern int MXTPredGetOutputShape(void*, int64_t*, int*, int);
+extern int MXTPredGetOutput(void*, float*, int64_t);
+extern void MXTPredFree(void*);
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s sym params n_in v...\n", argv[0]);
+    return 2;
+  }
+  void* h = MXTPredCreate(argv[1], argv[2], "data");
+  if (h == NULL) {
+    fprintf(stderr, "create failed\n");
+    return 1;
+  }
+  int n = atoi(argv[3]);
+  float* in = (float*)malloc(sizeof(float) * n);
+  for (int i = 0; i < n && 4 + i < argc; ++i) in[i] = atof(argv[4 + i]);
+  int64_t shape[2] = {1, n};
+  if (MXTPredSetInput(h, "data", in, shape, 2) != 0 ||
+      MXTPredForward(h) != 0) {
+    fprintf(stderr, "predict failed: %s\n", MXTPredLastError(h));
+    return 1;
+  }
+  int64_t oshape[8];
+  int ndim = 0;
+  if (MXTPredGetOutputShape(h, oshape, &ndim, 8) != 0) return 1;
+  int64_t total = 1;
+  for (int i = 0; i < ndim; ++i) total *= oshape[i];
+  float* out = (float*)malloc(sizeof(float) * total);
+  int got = MXTPredGetOutput(h, out, total);
+  if (got < 0) return 1;
+  for (int i = 0; i < got; ++i) printf("%.6f\n", out[i]);
+  MXTPredFree(h);
+  free(in);
+  free(out);
+  return 0;
+}

@@ -1,0 +1,256 @@
+// C predict API — the embedder deployment surface.
+//
+// Parity: reference include/mxnet/c_predict_api.h (MXPredCreate :78,
+// MXPredSetInput :211, MXPredForward :229, MXPredGetOutputShape :162,
+// MXPredGetOutput :252, MXPredFree :264) — the minimal C ABI a non-Python
+// application links to run exported models.
+//
+// TPU-native design: the compute path IS Python/XLA (the exported
+// -symbol.json artifact replays a StableHLO program through jax), so this
+// library embeds CPython rather than re-implementing an executor: each
+// call marshals through the Python C API into mxnet_tpu.gluon.SymbolBlock.
+// Built as libmxtpu_predict.so (`make predict`), linked with
+// `python3-config --embed` flags.
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+struct Predictor {
+  PyObject* block = nullptr;            // SymbolBlock
+  PyObject* np_mod = nullptr;           // mxnet_tpu.np
+  std::vector<std::string> input_names;
+  std::vector<PyObject*> inputs;        // staged mx arrays per input slot
+  PyObject* output = nullptr;           // last forward's first output
+  std::string last_error;
+};
+
+void set_err(Predictor* p, const char* what) {
+  if (p == nullptr) return;
+  p->last_error = what ? what : "unknown error";
+  if (PyErr_Occurred()) {
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    PyObject* s = v ? PyObject_Str(v) : nullptr;
+    if (s != nullptr) {
+      p->last_error += ": ";
+      p->last_error += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+    Py_XDECREF(t);
+    Py_XDECREF(v);
+    Py_XDECREF(tb);
+  }
+}
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  return Py_IsInitialized();
+}
+
+}  // namespace
+
+MXTPU_API void* MXTPredCreate(const char* symbol_file,
+                              const char* params_file,
+                              const char* input_names_csv) {
+  if (!ensure_python()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Predictor* p = new Predictor();
+  do {
+    PyObject* gluon = PyImport_ImportModule("mxnet_tpu.gluon");
+    if (gluon == nullptr) { set_err(p, "import mxnet_tpu.gluon"); break; }
+    p->np_mod = PyImport_ImportModule("mxnet_tpu.numpy");
+    if (p->np_mod == nullptr) { set_err(p, "import mxnet_tpu.numpy"); break; }
+    PyObject* cls = PyObject_GetAttrString(gluon, "SymbolBlock");
+    Py_DECREF(gluon);
+    if (cls == nullptr) { set_err(p, "SymbolBlock missing"); break; }
+
+    PyObject* names = PyList_New(0);
+    std::string csv = input_names_csv ? input_names_csv : "data";
+    size_t start = 0;
+    while (start <= csv.size()) {
+      size_t comma = csv.find(',', start);
+      std::string nm = csv.substr(
+          start, comma == std::string::npos ? std::string::npos
+                                            : comma - start);
+      if (!nm.empty()) {
+        p->input_names.push_back(nm);
+        PyList_Append(names, PyUnicode_FromString(nm.c_str()));
+      }
+      if (comma == std::string::npos) break;
+      start = comma + 1;
+    }
+    p->inputs.assign(p->input_names.size(), nullptr);
+
+    PyObject* imports = PyObject_GetAttrString(cls, "imports");
+    Py_DECREF(cls);
+    if (imports == nullptr) { set_err(p, "SymbolBlock.imports missing"); break; }
+    PyObject* args = Py_BuildValue(
+        "(sOs)", symbol_file, names, params_file ? params_file : "");
+    Py_DECREF(names);
+    if (params_file == nullptr || params_file[0] == '\0') {
+      Py_DECREF(args);
+      args = Py_BuildValue("(sO)", symbol_file, names);
+    }
+    p->block = PyObject_CallObject(imports, args);
+    Py_DECREF(imports);
+    Py_DECREF(args);
+    if (p->block == nullptr) { set_err(p, "SymbolBlock.imports failed"); break; }
+    PyGILState_Release(gil);
+    return p;
+  } while (false);
+  PyGILState_Release(gil);
+  // leave the Predictor alive so the caller can read the error
+  return p->block == nullptr && p->last_error.empty() ? (delete p, nullptr)
+                                                      : p;
+}
+
+MXTPU_API const char* MXTPredLastError(void* h) {
+  Predictor* p = static_cast<Predictor*>(h);
+  return p ? p->last_error.c_str() : "null predictor";
+}
+
+MXTPU_API int MXTPredSetInput(void* h, const char* name, const float* data,
+                              const int64_t* shape, int ndim) {
+  Predictor* p = static_cast<Predictor*>(h);
+  if (p == nullptr || p->block == nullptr) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    size_t slot = 0;
+    for (; slot < p->input_names.size(); ++slot) {
+      if (p->input_names[slot] == name) break;
+    }
+    if (slot == p->input_names.size()) { set_err(p, "unknown input"); break; }
+    int64_t total = 1;
+    for (int i = 0; i < ndim; ++i) total *= shape[i];
+    PyObject* flat = PyList_New(total);
+    for (int64_t i = 0; i < total; ++i) {
+      PyList_SET_ITEM(flat, i, PyFloat_FromDouble(data[i]));
+    }
+    PyObject* shp = PyTuple_New(ndim);
+    for (int i = 0; i < ndim; ++i) {
+      PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+    }
+    PyObject* arr = PyObject_CallMethod(p->np_mod, "array", "O", flat);
+    Py_DECREF(flat);
+    if (arr == nullptr) { Py_DECREF(shp); set_err(p, "array()"); break; }
+    PyObject* reshaped = PyObject_CallMethod(arr, "reshape", "O", shp);
+    Py_DECREF(arr);
+    Py_DECREF(shp);
+    if (reshaped == nullptr) { set_err(p, "reshape()"); break; }
+    Py_XDECREF(p->inputs[slot]);
+    p->inputs[slot] = reshaped;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+MXTPU_API int MXTPredForward(void* h) {
+  Predictor* p = static_cast<Predictor*>(h);
+  if (p == nullptr || p->block == nullptr) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    PyObject* args = PyTuple_New(p->inputs.size());
+    bool missing = false;
+    for (size_t i = 0; i < p->inputs.size(); ++i) {
+      if (p->inputs[i] == nullptr) { missing = true; break; }
+      Py_INCREF(p->inputs[i]);
+      PyTuple_SET_ITEM(args, i, p->inputs[i]);
+    }
+    if (missing) { Py_DECREF(args); set_err(p, "input not set"); break; }
+    PyObject* out = PyObject_CallObject(p->block, args);
+    Py_DECREF(args);
+    if (out == nullptr) { set_err(p, "forward failed"); break; }
+    if (PyTuple_Check(out) || PyList_Check(out)) {
+      PyObject* first = PySequence_GetItem(out, 0);
+      Py_DECREF(out);
+      out = first;
+    }
+    Py_XDECREF(p->output);
+    p->output = out;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+MXTPU_API int MXTPredGetOutputShape(void* h, int64_t* shape, int* ndim,
+                                    int max_ndim) {
+  Predictor* p = static_cast<Predictor*>(h);
+  if (p == nullptr || p->output == nullptr) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* shp = PyObject_GetAttrString(p->output, "shape");
+  if (shp != nullptr) {
+    Py_ssize_t n = PyTuple_Size(shp);
+    if (n <= max_ndim) {
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        shape[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+      }
+      *ndim = static_cast<int>(n);
+      rc = 0;
+    } else {
+      set_err(p, "ndim exceeds caller buffer");
+    }
+    Py_DECREF(shp);
+  } else {
+    set_err(p, "output has no shape");
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+MXTPU_API int MXTPredGetOutput(void* h, float* out, int64_t capacity) {
+  Predictor* p = static_cast<Predictor*>(h);
+  if (p == nullptr || p->output == nullptr) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    PyObject* np_arr = PyObject_CallMethod(p->output, "asnumpy", nullptr);
+    if (np_arr == nullptr) { set_err(p, "asnumpy failed"); break; }
+    PyObject* ravel = PyObject_CallMethod(np_arr, "ravel", nullptr);
+    Py_DECREF(np_arr);
+    if (ravel == nullptr) { set_err(p, "ravel failed"); break; }
+    PyObject* lst = PyObject_CallMethod(ravel, "tolist", nullptr);
+    Py_DECREF(ravel);
+    if (lst == nullptr) { set_err(p, "tolist failed"); break; }
+    Py_ssize_t n = PyList_Size(lst);
+    if (n > capacity) {
+      Py_DECREF(lst);
+      set_err(p, "output exceeds caller buffer");
+      break;
+    }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      out[i] = static_cast<float>(PyFloat_AsDouble(PyList_GetItem(lst, i)));
+    }
+    Py_DECREF(lst);
+    rc = static_cast<int>(n);
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+MXTPU_API void MXTPredFree(void* h) {
+  Predictor* p = static_cast<Predictor*>(h);
+  if (p == nullptr) return;
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(p->block);
+    Py_XDECREF(p->np_mod);
+    Py_XDECREF(p->output);
+    for (PyObject* o : p->inputs) Py_XDECREF(o);
+    PyGILState_Release(gil);
+  }
+  delete p;
+}

@@ -1,0 +1,61 @@
+"""C predict API: a non-Python embedder drives an exported artifact
+through libmxtpu_predict.so (parity: reference c_predict_api.h +
+example/image-classification/predict-cpp)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import sym_api as sym
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "lib", "libmxtpu_predict.so")
+SRC = os.path.join(REPO, "example", "extensions", "c_predict",
+                   "predict_example.c")
+
+
+@pytest.mark.slow
+def test_c_embedder_runs_exported_artifact(tmp_path):
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "src"),
+                            "predict"], capture_output=True, text=True)
+        if r.returncode != 0 or not os.path.exists(LIB):
+            pytest.skip("cannot build libmxtpu_predict.so: %s" % r.stderr)
+
+    # export a tiny model: out = tanh(x @ W.T + b)
+    data = sym.var("data", shape=(1, 4), dtype="float32")
+    net = sym.Activation(sym.FullyConnected(data, num_hidden=3, name="fc"),
+                         act_type="tanh")
+    rng = onp.random.RandomState(0)
+    w = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3).astype("float32")
+    art, pvals = net.export_artifact(
+        {"fc_weight": mxnp.array(w), "fc_bias": mxnp.array(b)})
+    sym_file = str(tmp_path / "m-symbol.json")
+    art.save(sym_file)
+    params_file = str(tmp_path / "m-0000.params.npz")
+    onp.savez(params_file, **{k: onp.asarray(v) for k, v in pvals.items()})
+
+    exe = str(tmp_path / "predict_example")
+    r = subprocess.run(
+        ["gcc", SRC, "-o", exe, "-L", os.path.dirname(LIB),
+         "-lmxtpu_predict", "-Wl,-rpath," + os.path.dirname(LIB)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    x = [0.5, -1.0, 2.0, 0.25]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")  # embedder must not need a TPU
+    r = subprocess.run([exe, sym_file, params_file, "4"]
+                       + [str(v) for v in x],
+                       capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    got = onp.array([float(line) for line in r.stdout.split()])
+    ref = onp.tanh(onp.array(x, onp.float32) @ w.T + b)
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
