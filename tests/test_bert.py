@@ -70,6 +70,7 @@ def test_bert_hybridize_consistency():
                                 rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_bert_amp_bf16():
     from mxnet_tpu import amp
     mx.random.seed(0)

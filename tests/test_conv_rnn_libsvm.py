@@ -74,6 +74,7 @@ def test_conv_gru_and_rnn_cells_shapes_and_state_info():
         assert info[0]["__layout__"] == "NCHW"
 
 
+@pytest.mark.slow
 def test_conv_lstm_unroll_gradients_flow():
     cell = rnn.ConvLSTMCell(input_shape=(1, 4, 4), hidden_channels=2)
     cell.initialize(mx.init.Xavier())

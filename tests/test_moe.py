@@ -16,6 +16,7 @@ def _mesh(n, axis="ep"):
     return Mesh(onp.array(devs[:n]), (axis,))
 
 
+@pytest.mark.slow
 def test_moe_matches_dense_reference():
     mesh = _mesh(4)
     moe = MoELayer(num_experts=8, d_model=16, d_hidden=32, mesh=mesh,

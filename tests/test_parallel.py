@@ -88,7 +88,7 @@ def test_tensor_parallel_matches_replicated():
 
 
 @pytest.mark.parametrize("causal,window", [
-    (False, None),
+    pytest.param(False, None, marks=pytest.mark.slow),
     pytest.param(True, None, marks=pytest.mark.slow),
     pytest.param(False, 16, marks=pytest.mark.slow)])
 def test_ring_attention_matches_reference(causal, window):

@@ -60,6 +60,7 @@ def test_pipeline_heterogeneous_stages():
                                 rtol=2e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_differentiable():
     """Gradients flow through the pipelined program (training path)."""
     S, B, D = 2, 4, 8
