@@ -36,6 +36,8 @@ BASELINES = {
     "bert_base_train_tokens_per_sec_per_chip": 15000.0,    # V100 fp16 est.
     "lstm_lm_train_tokens_per_sec_per_chip": 20000.0,      # V100 cuDNN est.
     "lenet_imperative_imgs_per_sec": None,                 # no published ref
+    "resnet50_infer_imgs_per_sec_per_chip": 1076.81,       # V100 bs=32 fp32
+    "alexnet_infer_imgs_per_sec_per_chip": 7906.09,        # V100 bs=32 fp32
 }
 
 
@@ -102,6 +104,35 @@ def bench_resnet50(dtype="float32", batch=None, iters=None, warmup=None):
     assert onp.isfinite(last_loss) and last_loss != first_loss, (
         "training step did not execute (loss %r -> %r)"
         % (first_loss, last_loss))
+    return batch * iters / dt
+
+
+# ---------------------------------------------------------------------------
+# inference (BASELINE.md inference tables: V100 bs=32 fp32)
+# ---------------------------------------------------------------------------
+def bench_infer(model_name):
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+
+    on_tpu = _on_tpu()
+    batch = 32 if on_tpu else 4
+    iters = 50 if on_tpu else 3
+
+    mx.random.seed(0)
+    net = getattr(zoo, model_name)(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mxnp.random.uniform(size=(batch, 3, 224, 224))
+    out = net(x)
+    out.asnumpy()  # finalize + compile
+    out = net(x)
+    out.asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    out.asnumpy()  # sync inside the window
+    dt = time.perf_counter() - t0
     return batch * iters / dt
 
 
@@ -324,6 +355,10 @@ BENCHES = [
     ("resnet50_dp", "resnet50_dp_kvstore_ici_imgs_per_sec_per_chip", "img/s",
      bench_resnet50_dp_kvstore),
     ("lenet", "lenet_imperative_imgs_per_sec", "img/s", bench_lenet),
+    ("resnet50_infer", "resnet50_infer_imgs_per_sec_per_chip", "img/s",
+     lambda: bench_infer("resnet50_v1")),
+    ("alexnet_infer", "alexnet_infer_imgs_per_sec_per_chip", "img/s",
+     lambda: bench_infer("alexnet")),
 ]
 
 
