@@ -30,7 +30,9 @@ __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
            "multibox_target", "multibox_detection", "grid_generator",
            "bilinear_sampler", "spatial_transformer", "quadratic",
            "fft", "ifft", "count_sketch", "deformable_convolution",
-           "modulated_deformable_convolution"]
+           "modulated_deformable_convolution",
+           "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample"]
 
 
 def _corner(boxes, fmt):
@@ -731,3 +733,95 @@ def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
     args = (data, offset, mask, weight) if (no_bias or bias is None) \
         else (data, offset, mask, weight, bias)
     return apply_op(f, *args)
+
+
+# ---------------------------------------------------------------------------
+# DGL graph sampling (reference src/operator/contrib/dgl_graph.cc:
+# _contrib_dgl_csr_neighbor_uniform_sample / _non_uniform_sample)
+# ---------------------------------------------------------------------------
+def _dgl_sample(csr, seeds, num_hops, num_neighbor, max_num_vertices,
+                prob=None, seed=0):
+    """Host-side neighbor sampling over a CSR adjacency (graph prep is
+    CPU work in the reference too — the op is registered CPU-only)."""
+    from ..sparse import CSRNDArray
+    indptr = onp.asarray(_unwrap(csr.indptr))
+    indices = onp.asarray(_unwrap(csr.indices))
+    pvals = onp.asarray(_unwrap(prob)) if prob is not None else None
+    rng = onp.random.RandomState(seed)
+
+    seeds = onp.asarray(seeds.asnumpy() if hasattr(seeds, "asnumpy")
+                        else seeds).astype(onp.int64).ravel()
+    seeds = seeds[seeds >= 0]
+    sampled = list(dict.fromkeys(int(s) for s in seeds))
+    edges = set()
+    frontier = list(sampled)
+    for _hop in range(num_hops):
+        nxt = []
+        for v in frontier:
+            nb = indices[indptr[v]:indptr[v + 1]]
+            if nb.size == 0:
+                continue
+            k = min(num_neighbor, nb.size)
+            if pvals is not None:
+                w = pvals[indptr[v]:indptr[v + 1]].astype(onp.float64)
+                nz = int((w > 0).sum())
+                if nz == 0:
+                    continue  # zero probability everywhere: sample nothing
+                k = min(k, nz)  # without-replacement can't exceed support
+                chosen = rng.choice(nb, size=k, replace=False,
+                                    p=w / w.sum())
+            else:
+                chosen = rng.choice(nb, size=k, replace=False)
+            for u in chosen:
+                u = int(u)
+                edges.add((v, u))
+                if u not in sampled:
+                    if len(sampled) >= max_num_vertices:
+                        continue
+                    sampled.append(u)
+                    nxt.append(u)
+        frontier = nxt
+        if not frontier:
+            break
+
+    count = len(sampled)
+    verts = onp.full(max_num_vertices + 1, -1, onp.int64)
+    verts[:count] = sampled
+    verts[-1] = count  # reference contract: last element = #sampled
+    local = {g: i for i, g in enumerate(sampled)}
+    rows = [[] for _ in range(max_num_vertices)]
+    for v, u in edges:
+        if v in local and u in local:
+            rows[local[v]].append(local[u])
+    sub_indptr = onp.zeros(max_num_vertices + 1, onp.int64)
+    sub_indices = []
+    for i, r in enumerate(rows):
+        r.sort()
+        sub_indices.extend(r)
+        sub_indptr[i + 1] = len(sub_indices)
+    sub = CSRNDArray(
+        onp.ones(len(sub_indices), onp.float32),
+        sub_indptr, onp.asarray(sub_indices, onp.int64),
+        (max_num_vertices, max_num_vertices))
+    return nd_array(verts), sub
+
+
+def dgl_csr_neighbor_uniform_sample(csr, seeds, num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100, seed=0):
+    """Uniform neighbor sampling (reference dgl_graph.cc
+    _contrib_dgl_csr_neighbor_uniform_sample).  Returns (vertices,
+    sub_csr): vertices is [max_num_vertices+1] with -1 padding and the
+    sampled count in the last slot; sub_csr is the induced adjacency in
+    local numbering."""
+    return _dgl_sample(csr, seeds, num_hops, num_neighbor,
+                       max_num_vertices, prob=None, seed=seed)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, seeds, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100, seed=0):
+    """Probability-weighted sampling (reference
+    _contrib_dgl_csr_neighbor_non_uniform_sample); `probability` aligns
+    with the CSR's stored edges."""
+    return _dgl_sample(csr, seeds, num_hops, num_neighbor,
+                       max_num_vertices, prob=probability, seed=seed)

@@ -563,3 +563,79 @@ def amp_multicast(*data, num_outputs=None, cast_narrow=False, **kw):
         floats, key=lambda s: _FLOAT_WIDTHS[s])
     return [amp_cast(d, pick) if _is_float_dtype(d.dtype) else d
             for d in data]
+
+
+# -- intgemm ops (reference src/operator/contrib/intgemm/*.cc) --------------
+def intgemm_maxabsolute(data, **kw):
+    """max(|data|) — the scale probe (intgemm_max_absolute.cc)."""
+    from ..ndarray import apply_op
+    import jax.numpy as _jnp
+    return apply_op(lambda x: _jnp.max(_jnp.abs(x)), data)
+
+
+def intgemm_prepare_data(data, maxabs, **kw):
+    """fp32 → int8 rows scaled by 127/maxabs
+    (intgemm_prepare_data.cc)."""
+    from ..ndarray import apply_op
+    import jax.numpy as _jnp
+
+    def f(x, m):
+        scale = 127.0 / _jnp.maximum(m, 1e-12)
+        return _jnp.clip(_jnp.round(x * scale), -127, 127).astype(_jnp.int8)
+
+    return apply_op(f, data, maxabs)
+
+
+def intgemm_prepare_weight(weight, maxabs=None, already_quantized=False,
+                           **kw):
+    """Weight pre-quantization (intgemm_prepare_weight.cc).  The
+    reference also CPU-interleaves for AVX; the MXU needs no interleave,
+    so prepared == quantized."""
+    if already_quantized:
+        return weight
+    if maxabs is None:
+        maxabs = intgemm_maxabsolute(weight)
+    return intgemm_prepare_data(weight, maxabs)
+
+
+def intgemm_take_weight(weight, indices, **kw):
+    """Row-gather of a prepared weight (intgemm_take_weight.cc) — output
+    vocabulary selection for shortlisted softmax."""
+    from ..ndarray import apply_op
+
+    def f(w, idx):
+        return w[idx.astype("int32")]
+
+    return apply_op(f, weight, indices)
+
+
+def intgemm_fully_connected(data, weight, scaling=None, bias=None,
+                            num_hidden=None, no_bias=False,
+                            out_type="float32", **kw):
+    """int8×int8 → int32 matmul with fp32 rescale
+    (intgemm_fully_connected.cc); XLA lowers the int8 dot onto the MXU."""
+    from ..ndarray import apply_op
+    import jax.numpy as _jnp
+
+    def f(*args):
+        x, w = args[0], args[1]
+        rest = list(args[2:])
+        s = rest.pop(0) if scaling is not None else None
+        b = rest.pop(0) if (bias is not None and not no_bias) else None
+        acc = _jnp.matmul(x.astype(_jnp.int32), w.astype(_jnp.int32).T,
+                          preferred_element_type=_jnp.int32)
+        if out_type == "int32":
+            return acc
+        out = acc.astype(_jnp.float32)
+        if s is not None:
+            out = out * s
+        if b is not None:
+            out = out + b
+        return out
+
+    call = [data, weight]
+    if scaling is not None:
+        call.append(scaling)
+    if bias is not None and not no_bias:
+        call.append(bias)
+    return apply_op(f, *call)

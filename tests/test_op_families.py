@@ -315,3 +315,78 @@ def test_multi_sum_sq():
     b = jnp.asarray([[2.0, 2.0]])
     out = [float(v) for v in multi_sum_sq(a, b)]
     assert out == [5.0, 8.0]
+
+
+# ---------------------------------------------------------------------------
+# intgemm ops (reference src/operator/contrib/intgemm/*.cc)
+# ---------------------------------------------------------------------------
+def test_intgemm_prepare_and_fully_connected():
+    rng = onp.random.RandomState(20)
+    x = rng.uniform(-2, 2, (4, 8)).astype("float32")
+    w = rng.uniform(-1, 1, (3, 8)).astype("float32")
+    xm = npx.intgemm_maxabsolute(mxnp.array(x))
+    wm = npx.intgemm_maxabsolute(mxnp.array(w))
+    assert float(xm) == pytest.approx(onp.abs(x).max(), rel=1e-6)
+    qx = npx.intgemm_prepare_data(mxnp.array(x), xm)
+    qw = npx.intgemm_prepare_weight(mxnp.array(w), wm)
+    assert str(qx.dtype) == "int8" and str(qw.dtype) == "int8"
+    scale = (float(xm) / 127.0) * (float(wm) / 127.0)
+    out = npx.intgemm_fully_connected(qx, qw,
+                                      scaling=mxnp.array(scale))
+    ref = x @ w.T
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=0.05, atol=0.05)
+
+
+def test_intgemm_take_weight():
+    rng = onp.random.RandomState(21)
+    w = rng.uniform(-1, 1, (10, 4)).astype("float32")
+    qw = npx.intgemm_prepare_weight(mxnp.array(w))
+    idx = mxnp.array(onp.array([7, 2, 0], "int32"))
+    sub = npx.intgemm_take_weight(qw, idx)
+    onp.testing.assert_array_equal(sub.asnumpy(),
+                                   qw.asnumpy()[[7, 2, 0]])
+
+
+# ---------------------------------------------------------------------------
+# DGL neighbor sampling (reference src/operator/contrib/dgl_graph.cc)
+# ---------------------------------------------------------------------------
+def _ring_csr(n):
+    from mxnet_tpu.sparse import CSRNDArray
+    indptr = onp.arange(0, 2 * n + 1, 2)
+    indices = onp.array([[(i - 1) % n, (i + 1) % n]
+                         for i in range(n)]).ravel()
+    return CSRNDArray(onp.ones(2 * n, "float32"), indptr, indices, (n, n))
+
+
+def test_dgl_uniform_sample_structure():
+    csr = _ring_csr(10)
+    verts, sub = cops.dgl_csr_neighbor_uniform_sample(
+        csr, mxnp.array(onp.array([0, 5], "int64")), num_hops=1,
+        num_neighbor=2, max_num_vertices=8)
+    v = verts.asnumpy()
+    count = int(v[-1])
+    assert 2 <= count <= 8
+    sampled = set(v[:count].tolist())
+    assert {0, 5} <= sampled
+    # every sampled non-seed vertex is a ring neighbor of a seed
+    for u in sampled - {0, 5}:
+        assert u in {1, 9, 4, 6}
+    assert sub.shape == (8, 8)
+    # edges in the sub-csr connect sampled vertices only
+    assert sub.indptr.asnumpy()[-1] == len(sub.indices.asnumpy())
+
+
+def test_dgl_non_uniform_sample_respects_zero_probability():
+    from mxnet_tpu.sparse import CSRNDArray
+    # star: node 0 → {1, 2, 3, 4}; edges to odd neighbors carry p=0
+    indptr = onp.array([0, 4, 4, 4, 4, 4])
+    indices = onp.array([1, 2, 3, 4])
+    csr = CSRNDArray(onp.ones(4, "float32"), indptr, indices, (5, 5))
+    prob = onp.array([0.0, 1.0, 0.0, 1.0], "float32")
+    verts, _sub = cops.dgl_csr_neighbor_non_uniform_sample(
+        csr, mxnp.array(prob), mxnp.array(onp.array([0], "int64")),
+        num_hops=1, num_neighbor=3, max_num_vertices=5)
+    v = verts.asnumpy()
+    count = int(v[-1])
+    sampled = set(v[1:count].tolist())
+    assert sampled and sampled <= {2, 4}  # only even (p>0) neighbors
