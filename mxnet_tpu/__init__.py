@@ -21,6 +21,9 @@ from .ndarray import ndarray, NDArray, waitall
 
 from . import autograd
 from . import engine
+from .engine import waitall  # full drain: device buffers + host engine
+# (shadows the buffer-only ndarray.waitall imported above — mx.waitall
+# must also flush async kvstore pushes / checkpoint writes / IO work)
 from . import util
 from . import runtime
 

@@ -7,7 +7,8 @@ against mx.nd keep running.
 """
 from .numpy import *  # noqa: F401,F403
 from .numpy import random, linalg  # noqa: F401
-from .ndarray import ndarray as NDArray, array, waitall  # noqa: F401
+from .ndarray import ndarray as NDArray, array  # noqa: F401
+from .engine import waitall  # noqa: F401  (buffers + host engine)
 from .numpy_extension import savez  # noqa: F401
 # mx.nd.contrib.{box_nms, roi_align, foreach, while_loop, cond, ...}
 from . import _nd_contrib as contrib  # noqa: F401
