@@ -752,7 +752,9 @@ def _dgl_sample(csr, seeds, num_hops, num_neighbor, max_num_vertices,
     seeds = onp.asarray(seeds.asnumpy() if hasattr(seeds, "asnumpy")
                         else seeds).astype(onp.int64).ravel()
     seeds = seeds[seeds >= 0]
-    sampled = list(dict.fromkeys(int(s) for s in seeds))
+    # the output vertex array holds at most max_num_vertices entries —
+    # excess seeds are truncated (reference validates the same bound)
+    sampled = list(dict.fromkeys(int(s) for s in seeds))[:max_num_vertices]
     edges = set()
     frontier = list(sampled)
     for _hop in range(num_hops):

@@ -27,10 +27,14 @@ from .ndarray import waitall as _waitall  # re-export
 
 
 def waitall():
-    _waitall()
+    """Full drain: host engine FIRST (its work items enqueue device
+    buffers — DataLoader H2D, kvstore pulls), then device buffers.  The
+    reverse order would let device work spawned by in-flight engine ops
+    escape the fence."""
     eng = _default_engine
     if eng is not None:
         eng.wait_for_all()
+    _waitall()
 
 
 def engine_type():

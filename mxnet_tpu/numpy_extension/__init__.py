@@ -18,7 +18,14 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .._rng import next_key
-from ..ndarray import ndarray, apply_op, array, _unwrap, _wrap_value, waitall  # noqa: F401
+from ..ndarray import ndarray, apply_op, array, _unwrap, _wrap_value  # noqa: F401
+
+
+def waitall():
+    """Full sync point (device buffers + host engine) — same semantics
+    as mx.waitall; lazy import avoids an engine↔npx cycle."""
+    from ..engine import waitall as _full
+    _full()
 from ..ops import nn as _nn
 from ..ops import rnn as _rnn
 from ..ops import attention as _att

@@ -57,11 +57,16 @@ class HostPool:
         if not ptr:
             raise MemoryError("host pool alloc of %d bytes failed" % nbytes)
         buf = (ctypes.c_char * nbytes).from_address(ptr)
-        # finalizer holds self, so the pool outlives every outstanding block
-        weakref.finalize(buf, self._lib.MXTStorageFree, self._h,
-                         ctypes.c_void_p(ptr))
+        # the finalizer's args hold a strong ref to SELF, so the pool
+        # object (and its native arena) outlives every outstanding block
+        weakref.finalize(buf, HostPool._return_block, self, ptr)
         arr = onp.frombuffer(buf, dtype=dt)
         return arr.reshape(shape) if shape else arr
+
+    @staticmethod
+    def _return_block(pool, ptr):
+        if getattr(pool, "_h", None):
+            pool._lib.MXTStorageFree(pool._h, ctypes.c_void_p(ptr))
 
     def stats(self):
         out = (ctypes.c_uint64 * 5)()

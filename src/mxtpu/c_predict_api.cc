@@ -92,14 +92,19 @@ MXTPU_API void* MXTPredCreate(const char* symbol_file,
 
     PyObject* imports = PyObject_GetAttrString(cls, "imports");
     Py_DECREF(cls);
-    if (imports == nullptr) { set_err(p, "SymbolBlock.imports missing"); break; }
-    PyObject* args = Py_BuildValue(
-        "(sOs)", symbol_file, names, params_file ? params_file : "");
-    Py_DECREF(names);
-    if (params_file == nullptr || params_file[0] == '\0') {
-      Py_DECREF(args);
-      args = Py_BuildValue("(sO)", symbol_file, names);
+    if (imports == nullptr) {
+      Py_DECREF(names);
+      set_err(p, "SymbolBlock.imports missing");
+      break;
     }
+    // build exactly one args tuple; our `names` ref stays live until
+    // after the call (Py_BuildValue "O" takes its own reference)
+    PyObject* args =
+        (params_file != nullptr && params_file[0] != '\0')
+            ? Py_BuildValue("(sOs)", symbol_file, names, params_file)
+            : Py_BuildValue("(sO)", symbol_file, names);
+    Py_DECREF(names);
+    if (args == nullptr) { Py_DECREF(imports); set_err(p, "args"); break; }
     p->block = PyObject_CallObject(imports, args);
     Py_DECREF(imports);
     Py_DECREF(args);
@@ -132,16 +137,24 @@ MXTPU_API int MXTPredSetInput(void* h, const char* name, const float* data,
     if (slot == p->input_names.size()) { set_err(p, "unknown input"); break; }
     int64_t total = 1;
     for (int i = 0; i < ndim; ++i) total *= shape[i];
-    PyObject* flat = PyList_New(total);
-    for (int64_t i = 0; i < total; ++i) {
-      PyList_SET_ITEM(flat, i, PyFloat_FromDouble(data[i]));
-    }
+    // zero boxed floats: bytes → numpy.frombuffer → mx array
+    PyObject* raw = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(data),
+        static_cast<Py_ssize_t>(total) * 4);
+    if (raw == nullptr) { set_err(p, "bytes"); break; }
+    PyObject* onp = PyImport_ImportModule("numpy");
+    if (onp == nullptr) { Py_DECREF(raw); set_err(p, "import numpy"); break; }
+    PyObject* host = PyObject_CallMethod(onp, "frombuffer", "Os", raw,
+                                         "float32");
+    Py_DECREF(onp);
+    Py_DECREF(raw);
+    if (host == nullptr) { set_err(p, "frombuffer"); break; }
     PyObject* shp = PyTuple_New(ndim);
     for (int i = 0; i < ndim; ++i) {
       PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
     }
-    PyObject* arr = PyObject_CallMethod(p->np_mod, "array", "O", flat);
-    Py_DECREF(flat);
+    PyObject* arr = PyObject_CallMethod(p->np_mod, "array", "O", host);
+    Py_DECREF(host);
     if (arr == nullptr) { Py_DECREF(shp); set_err(p, "array()"); break; }
     PyObject* reshaped = PyObject_CallMethod(arr, "reshape", "O", shp);
     Py_DECREF(arr);
@@ -175,6 +188,10 @@ MXTPU_API int MXTPredForward(void* h) {
     if (PyTuple_Check(out) || PyList_Check(out)) {
       PyObject* first = PySequence_GetItem(out, 0);
       Py_DECREF(out);
+      if (first == nullptr) {  // empty output sequence
+        set_err(p, "model returned no outputs");
+        break;
+      }
       out = first;
     }
     Py_XDECREF(p->output);
@@ -219,22 +236,22 @@ MXTPU_API int MXTPredGetOutput(void* h, float* out, int64_t capacity) {
   do {
     PyObject* np_arr = PyObject_CallMethod(p->output, "asnumpy", nullptr);
     if (np_arr == nullptr) { set_err(p, "asnumpy failed"); break; }
-    PyObject* ravel = PyObject_CallMethod(np_arr, "ravel", nullptr);
+    PyObject* f32 = PyObject_CallMethod(np_arr, "astype", "s", "float32");
     Py_DECREF(np_arr);
-    if (ravel == nullptr) { set_err(p, "ravel failed"); break; }
-    PyObject* lst = PyObject_CallMethod(ravel, "tolist", nullptr);
-    Py_DECREF(ravel);
-    if (lst == nullptr) { set_err(p, "tolist failed"); break; }
-    Py_ssize_t n = PyList_Size(lst);
+    if (f32 == nullptr) { set_err(p, "astype failed"); break; }
+    // zero boxed floats: one contiguous bytes blob, one memcpy
+    PyObject* blob = PyObject_CallMethod(f32, "tobytes", nullptr);
+    Py_DECREF(f32);
+    if (blob == nullptr) { set_err(p, "tobytes failed"); break; }
+    const Py_ssize_t nbytes = PyBytes_Size(blob);
+    const Py_ssize_t n = nbytes / 4;
     if (n > capacity) {
-      Py_DECREF(lst);
+      Py_DECREF(blob);
       set_err(p, "output exceeds caller buffer");
       break;
     }
-    for (Py_ssize_t i = 0; i < n; ++i) {
-      out[i] = static_cast<float>(PyFloat_AsDouble(PyList_GetItem(lst, i)));
-    }
-    Py_DECREF(lst);
+    std::memcpy(out, PyBytes_AsString(blob), nbytes);
+    Py_DECREF(blob);
     rc = static_cast<int>(n);
   } while (false);
   PyGILState_Release(gil);

@@ -390,3 +390,12 @@ def test_dgl_non_uniform_sample_respects_zero_probability():
     count = int(v[-1])
     sampled = set(v[1:count].tolist())
     assert sampled and sampled <= {2, 4}  # only even (p>0) neighbors
+
+
+def test_dgl_sample_caps_excess_seeds():
+    csr = _ring_csr(12)
+    seeds = mxnp.array(onp.arange(10, dtype=onp.int64))
+    verts, sub = cops.dgl_csr_neighbor_uniform_sample(
+        csr, seeds, num_hops=1, num_neighbor=2, max_num_vertices=4)
+    v = verts.asnumpy()
+    assert int(v[-1]) <= 4 and sub.shape == (4, 4)

@@ -87,3 +87,16 @@ def test_imagerecorditer_uses_pooled_staging(tmp_path):
     batches = list(it)
     assert len(batches) == 2
     assert storage.stats()["alloc_count"] > before  # staging came from pool
+
+
+def test_pool_collected_before_blocks_is_safe():
+    """The finalizer's args keep the pool alive: dropping the pool while
+    arrays are outstanding must not free the arena under them."""
+    pool = storage.HostPool()
+    a = pool.alloc_array((128,), "uint8")
+    a[:] = 9
+    del pool
+    gc.collect()
+    assert (a == 9).all()
+    del a
+    gc.collect()
