@@ -1,0 +1,153 @@
+"""Graph-pass registry over the mx.sym DAG.
+
+Parity: the reference's nnvm pass registry (include/nnvm/pass.h
+`nnvm::ApplyPass`, passes like "EliminateCommonExpr", constant folding
+in exec passes) surfaced to users through `mx.sym` graph editing.
+
+TPU-native stance: XLA already runs CSE/DCE/folding inside every
+compiled executable — these passes exist for the GRAPH level the
+compiler never sees (pruning parameters, shrinking exported artifacts,
+pre-simplifying DAGs before partitioning) and as the user seam for
+custom rewrites (reference custom pass API, example/extensions/lib_pass).
+
+API:
+  @graph_pass.register("my-pass")
+  def my_pass(sym): return new_sym
+  out = graph_pass.apply_pass(sym, "fold-constants")
+  out = graph_pass.apply_passes(sym, ["dead-node-elimination", ...])
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["register", "get_pass", "list_passes", "apply_pass",
+           "apply_passes"]
+
+_PASSES = {}
+
+
+def register(name):
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def get_pass(name):
+    if name not in _PASSES:
+        raise ValueError("unknown graph pass %r (have %s)"
+                         % (name, sorted(_PASSES)))
+    return _PASSES[name]
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def apply_pass(sym, name):
+    return get_pass(name)(sym)
+
+
+def apply_passes(sym, names):
+    for n in names:
+        sym = apply_pass(sym, n)
+    return sym
+
+
+# ---------------------------------------------------------------------------
+# rewrite helper: rebuild a DAG bottom-up through a node transformer
+# ---------------------------------------------------------------------------
+def rewrite(sym, fn):
+    """Rebuild the DAG bottom-up; fn(node, new_inputs) returns a
+    replacement Symbol (or None to keep the node with rewired inputs).
+    The seam custom passes build on."""
+    from .sym_api import Symbol
+
+    memo = {}
+
+    def walk(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        new_inputs = [walk(i) for i in node._inputs]
+        replaced = fn(node, new_inputs)
+        if replaced is None:
+            replaced = Symbol(node._kind, name=node.name, op=node._op,
+                              inputs=new_inputs, attrs=dict(node._attrs),
+                              shape=node._shape, dtype=node._dtype,
+                              aux=node._aux, index=node._index)
+            if node._kind == "subgraph":
+                replaced._inner = node._inner
+        memo[id(node)] = replaced
+        return replaced
+
+    return walk(sym)
+
+
+# ---------------------------------------------------------------------------
+# built-in passes
+# ---------------------------------------------------------------------------
+@register("fold-constants")
+def fold_constants(sym):
+    """Evaluate op nodes whose entire ancestry is const → const nodes
+    (reference exec constant folding).  Vars block folding."""
+    from .sym_api import Symbol
+
+    def has_var(node, memo={}):
+        if id(node) in memo:
+            return memo[id(node)]
+        r = node._kind == "var" or any(has_var(i) for i in node._inputs)
+        memo[id(node)] = r
+        return r
+
+    def xform(node, new_inputs):
+        if node._kind != "op" or has_var(node):
+            return None
+        rebuilt = Symbol("op", name=node.name, op=node._op,
+                         inputs=new_inputs, attrs=dict(node._attrs))
+        val = rebuilt._eval({})
+        arr = onp.asarray(val.asnumpy() if hasattr(val, "asnumpy")
+                          else val)
+        if arr.ndim == 0:  # scalars fold to plain const nodes
+            return Symbol("const", name=node.name,
+                          attrs={"value": float(arr)})
+        return None  # keep tensor-valued results as ops (rare; cheap)
+
+    return rewrite(sym, xform)
+
+
+@register("eliminate-common-expr")
+def eliminate_common_expr(sym):
+    """Structural CSE: identical (op, attrs, inputs) nodes collapse to
+    one (reference EliminateCommonExpr pass)."""
+    import json as _json
+    from .sym_api import Symbol  # noqa: F401
+
+    seen = {}
+
+    def key_of(node, new_inputs):
+        return (node._kind, node._op,
+                _json.dumps(node._attrs, sort_keys=True, default=str),
+                tuple(id(i) for i in new_inputs), node._index)
+
+    def xform(node, new_inputs):
+        if node._kind not in ("op", "index"):
+            return None
+        k = key_of(node, new_inputs)
+        if k in seen:
+            return seen[k]
+        # build the node normally, then remember it
+        rebuilt = Symbol(node._kind, name=node.name, op=node._op,
+                         inputs=new_inputs, attrs=dict(node._attrs),
+                         index=node._index)
+        seen[k] = rebuilt
+        return rebuilt
+
+    return rewrite(sym, xform)
+
+
+@register("dead-node-elimination")
+def dead_node_elimination(sym):
+    """Rebuilding from the heads IS dead-node elimination: anything not
+    reachable from the output is dropped (reference PlanMemory dead-node
+    pruning).  Returns a fresh DAG containing only live nodes."""
+    return rewrite(sym, lambda node, new_inputs: None)

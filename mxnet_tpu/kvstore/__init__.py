@@ -291,6 +291,9 @@ def create(name="local"):
         return KVStore(name)
     if name in ("tpu_ici", "nccl"):
         return TpuIci()
+    if name == "horovod":
+        from .horovod import KVStoreHorovod
+        return KVStoreHorovod()
     if name in ("dist_sync", "dist_async", "dist_sync_device", "dist", "p3"):
         import os
         if os.environ.get("DMLC_PS_ROOT_URI"):

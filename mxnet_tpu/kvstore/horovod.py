@@ -1,0 +1,97 @@
+"""Horovod kvstore adapter (parity: reference
+`python/mxnet/kvstore/horovod.py` — KVStoreHorovod delegating
+broadcast/pushpull to hvd.broadcast_/hvd.allreduce_).
+
+The adapter targets the same API: `kv = mx.kv.create('horovod')` works
+wherever the `horovod.mxnet`-equivalent module is importable (exposed
+as `horovod.mxnet_tpu` or injected for tests).  On TPU pods the native
+path is `tpu_ici`/GSPMD — this exists so reference Horovod scripts run
+unchanged where the ecosystem provides hvd.
+"""
+from __future__ import annotations
+
+from . import KVStoreBase
+
+__all__ = ["KVStoreHorovod"]
+
+
+def _load_hvd():
+    import importlib
+    for mod in ("horovod.mxnet_tpu", "horovod.mxnet"):
+        try:
+            return importlib.import_module(mod)
+        except ImportError:
+            continue
+    raise ImportError(
+        "kvstore='horovod' needs the horovod package (horovod.mxnet); "
+        "on TPU use kvstore='tpu_ici' or the SPMD parallel trainer")
+
+
+@KVStoreBase.register
+class KVStoreHorovod(KVStoreBase):
+    """Thin delegation layer: init is a no-op, broadcast roots at rank 0,
+    pushpull is an allreduce (reference horovod.py:34-88)."""
+
+    def __init__(self, hvd=None):
+        self._hvd = hvd if hvd is not None else _load_hvd()
+        self._hvd.init()
+
+    @property
+    def type(self):
+        return "horovod"
+
+    @property
+    def rank(self):
+        return self._hvd.rank()
+
+    @property
+    def num_workers(self):
+        return self._hvd.size()
+
+    def init(self, key, value):
+        pass  # hvd has no server-side store; broadcast seeds instead
+
+    def broadcast(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            outs = out if out is not None else [None] * len(key)
+            for k, v, o in zip(key, value, outs):
+                self.broadcast(k, v, o, priority)
+            return out
+        root = self._hvd.broadcast(value, root_rank=0,
+                                  name=str(key), priority=priority)
+        if out is not None:
+            targets = out if isinstance(out, (list, tuple)) else [out]
+            for o in targets:
+                o._set_data(root._data if hasattr(root, "_data") else root)
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            outs = out if out is not None else [None] * len(key)
+            for k, v, o in zip(key, value, outs):
+                self.pushpull(k, v, o, priority)
+            return
+        from . import _reduce
+        reduced = _reduce(value) if isinstance(value, (list, tuple)) \
+            else value
+        summed = self._hvd.allreduce(reduced, average=False,
+                                     name=str(key), priority=priority)
+        if out is not None:
+            targets = out if isinstance(out, (list, tuple)) else [out]
+            for o in targets:
+                o._set_data(summed._data if hasattr(summed, "_data")
+                            else summed)
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError(
+            "horovod kvstore is allreduce-based: use pushpull "
+            "(reference KVStoreHorovod.push raises the same)")
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError(
+            "horovod kvstore is allreduce-based: use pushpull/broadcast")
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError(
+            "horovod mode updates on workers (DistributedOptimizer), "
+            "not on a server")

@@ -1,0 +1,78 @@
+"""Graph-pass registry over the sym DAG (reference nnvm pass registry +
+custom pass seam, include/nnvm/pass.h / example/extensions/lib_pass)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import sym_api as sym
+from mxnet_tpu import graph_pass
+
+
+def _ops(s):
+    return [n for n in s._topo() if n._kind == "op"]
+
+
+def test_fold_constants():
+    x = sym.var("x")
+    c = sym.add(sym.Symbol("const", attrs={"value": 2.0}),
+                sym.Symbol("const", attrs={"value": 3.0}))  # 2+3
+    out = sym.multiply(x, c)
+    folded = graph_pass.apply_pass(out, "fold-constants")
+    kinds = [n._kind for n in folded._topo()]
+    assert kinds.count("op") == 1  # only the multiply remains
+    (ref,) = out.eval(x=mxnp.array([1.0, 2.0]))
+    (got,) = folded.eval(x=mxnp.array([1.0, 2.0]))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-6)
+
+
+def test_eliminate_common_expr():
+    x = sym.var("x")
+    a = sym.sin(x)
+    b = sym.sin(x)  # structurally identical
+    out = sym.add(a, b)
+    cse = graph_pass.apply_pass(out, "eliminate-common-expr")
+    assert len(_ops(out)) == 3
+    assert len(_ops(cse)) == 2  # one sin + one add
+    v = mxnp.array([0.3, 0.6])
+    onp.testing.assert_allclose(cse.eval(x=v)[0].asnumpy(),
+                                out.eval(x=v)[0].asnumpy(), rtol=1e-6)
+
+
+def test_dead_node_elimination_drops_unreachable():
+    x = sym.var("x")
+    live = sym.sin(x)
+    _dead = sym.exp(live)  # never consumed by the head
+    out = sym.multiply(live, 2.0)
+    pruned = graph_pass.apply_pass(out, "dead-node-elimination")
+    assert all(n._op != "np:exp" for n in _ops(pruned))
+    v = mxnp.array([0.1])
+    onp.testing.assert_allclose(pruned.eval(x=v)[0].asnumpy(),
+                                out.eval(x=v)[0].asnumpy(), rtol=1e-6)
+
+
+def test_custom_pass_registration_and_rewrite_seam():
+    @graph_pass.register("swap-sin-for-cos")
+    def swap(s):
+        def xform(node, new_inputs):
+            if node._kind == "op" and node._op == "np:sin":
+                return sym.Symbol("op", op="np:cos", inputs=new_inputs,
+                                  name=node.name)
+            return None
+        return graph_pass.rewrite(s, xform)
+
+    x = sym.var("x")
+    out = graph_pass.apply_pass(sym.sin(x), "swap-sin-for-cos")
+    (got,) = out.eval(x=mxnp.array([0.5]))
+    onp.testing.assert_allclose(got.asnumpy(), onp.cos([0.5]), rtol=1e-6)
+    assert "swap-sin-for-cos" in graph_pass.list_passes()
+
+
+def test_apply_passes_chain_and_unknown_pass():
+    x = sym.var("x")
+    out = sym.add(sym.sin(x), sym.sin(x))
+    r = graph_pass.apply_passes(out, ["eliminate-common-expr",
+                                      "dead-node-elimination"])
+    assert len(_ops(r)) == 2
+    with pytest.raises(ValueError, match="unknown graph pass"):
+        graph_pass.apply_pass(out, "nope")

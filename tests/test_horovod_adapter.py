@@ -1,0 +1,97 @@
+"""Horovod kvstore adapter (reference python/mxnet/kvstore/horovod.py):
+exercised against a faithful fake hvd module — broadcast roots rank 0,
+pushpull is allreduce, push/pull raise like the reference."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.kvstore import create as kv_create
+from mxnet_tpu.kvstore.horovod import KVStoreHorovod
+
+
+class _FakeHvd:
+    """Single-process hvd standing in for horovod.mxnet: allreduce over
+    one rank is identity; calls are recorded for assertions."""
+
+    def __init__(self, size=1, rank=0):
+        self._size = size
+        self._rank = rank
+        self.calls = []
+
+    def init(self):
+        self.calls.append(("init",))
+
+    def rank(self):
+        return self._rank
+
+    def size(self):
+        return self._size
+
+    def broadcast(self, value, root_rank=0, name=None, priority=0):
+        self.calls.append(("broadcast", name, root_rank))
+        return value
+
+    def allreduce(self, value, average=False, name=None, priority=0):
+        self.calls.append(("allreduce", name, average))
+        return value * self._size  # what a real sum-allreduce produces
+
+
+def test_factory_without_horovod_raises_cleanly():
+    try:
+        import horovod  # noqa: F401
+        pytest.skip("horovod installed")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="tpu_ici"):
+        kv_create("horovod")
+
+
+def test_adapter_delegates_to_hvd():
+    hvd = _FakeHvd(size=2, rank=1)
+    kv = KVStoreHorovod(hvd=hvd)
+    assert kv.type == "horovod"
+    assert kv.rank == 1 and kv.num_workers == 2
+    assert ("init",) in hvd.calls
+
+    v = mxnp.array([1.0, 2.0])
+    out = mxnp.zeros(2)
+    kv.broadcast("3", v, out=out)
+    assert ("broadcast", "3", 0) in hvd.calls
+    onp.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+
+    g = mxnp.array([0.5, 0.5])
+    tgt = mxnp.zeros(2)
+    kv.pushpull("3", g, out=tgt)
+    assert ("allreduce", "3", False) in hvd.calls
+    onp.testing.assert_allclose(tgt.asnumpy(), [1.0, 1.0])  # sum over 2
+
+
+def test_push_pull_raise_like_reference():
+    kv = KVStoreHorovod(hvd=_FakeHvd())
+    with pytest.raises(NotImplementedError, match="allreduce"):
+        kv.push("0", mxnp.ones(2))
+    with pytest.raises(NotImplementedError, match="allreduce"):
+        kv.pull("0", out=mxnp.ones(2))
+    with pytest.raises(NotImplementedError):
+        kv.set_optimizer(object())
+
+
+def test_trainer_runs_on_horovod_adapter():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Xavier())
+    kv = KVStoreHorovod(hvd=_FakeHvd(size=1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv,
+                            update_on_kvstore=False)
+    x = mxnp.random.uniform(size=(4, 3))
+    before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(4)
+    after = net.weight.data().asnumpy()
+    assert not onp.allclose(before, after)
