@@ -1,0 +1,106 @@
+"""Resource manager: temp workspace + parallel RNG streams.
+
+Parity: reference `include/mxnet/resource.h` (ResourceRequest kTempSpace
+:53 / kRandom / kParallelRandom, ResourceManager::Request,
+Resource.get_space) — the per-op scratch and RNG services kernels ask
+the engine for.
+
+TPU-native split: device scratch is XLA's job (temporaries live inside
+each compiled executable), so kTempSpace serves HOST scratch — pooled
+arrays from the native arena that host-side kernels (custom ops, IO
+augmenters) reuse without malloc churn.  kRandom/kParallelRandom hand
+out counter-based threefry keys: every request is an independent stream
+by construction, which is the property the reference's seeded
+per-worker generators approximate.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as onp
+
+__all__ = ["ResourceRequest", "Resource", "ResourceManager", "request"]
+
+
+class ResourceRequest:
+    """Request types (reference resource.h ResourceRequest::Type)."""
+
+    kTempSpace = "temp_space"
+    kRandom = "random"
+    kParallelRandom = "parallel_random"
+
+    def __init__(self, type_):
+        if type_ not in (self.kTempSpace, self.kRandom,
+                         self.kParallelRandom):
+            raise ValueError("unknown resource request %r" % type_)
+        self.type = type_
+
+
+class Resource:
+    """A granted resource (reference resource.h Resource struct)."""
+
+    def __init__(self, req_type, manager):
+        self.req = ResourceRequest(req_type)
+        self._mgr = manager
+
+    # -- kTempSpace --------------------------------------------------------
+    def get_space(self, shape, dtype="float32"):
+        """Host scratch array from the pooled arena (reference
+        Resource.get_space_typed).  Contents are UNINITIALIZED and the
+        buffer may be handed out again after the array is collected —
+        exactly the reference's reuse contract."""
+        if self.req.type != ResourceRequest.kTempSpace:
+            raise TypeError("get_space on a %s resource" % self.req.type)
+        from .storage import alloc_array
+        return alloc_array(shape, dtype)
+
+    # -- kRandom / kParallelRandom ----------------------------------------
+    def get_rng_key(self):
+        """A fresh, independent threefry key (counter-based: every call
+        is its own stream — the guarantee kParallelRandom's per-worker
+        generators exist to provide)."""
+        if self.req.type == ResourceRequest.kTempSpace:
+            raise TypeError("get_rng_key on a temp_space resource")
+        from ._rng import next_key
+        return next_key()
+
+    def uniform(self, shape, low=0.0, high=1.0, dtype="float32"):
+        import jax
+        from .ndarray import _wrap_value
+        return _wrap_value(jax.random.uniform(
+            self.get_rng_key(), tuple(shape), minval=low,
+            maxval=high).astype(dtype))
+
+    def normal(self, shape, loc=0.0, scale=1.0, dtype="float32"):
+        import jax
+        from .ndarray import _wrap_value
+        return _wrap_value((loc + scale * jax.random.normal(
+            self.get_rng_key(), tuple(shape))).astype(dtype))
+
+
+class ResourceManager:
+    """Grants resources (reference ResourceManager::Get()->Request)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._granted = 0
+
+    def request(self, req):
+        if isinstance(req, str):
+            req = ResourceRequest(req)
+        with self._lock:
+            self._granted += 1
+        return Resource(req.type, self)
+
+    @property
+    def granted(self):
+        return self._granted
+
+
+_manager = ResourceManager()
+
+
+def request(req_type):
+    """Module-level convenience (reference
+    ResourceManager::Get()->Request)."""
+    return _manager.request(req_type)

@@ -1,0 +1,45 @@
+"""Resource manager (reference include/mxnet/resource.h: kTempSpace
+host scratch + kRandom/kParallelRandom independent streams)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import resource
+from mxnet_tpu.resource import ResourceRequest
+
+
+def test_temp_space_allocates_and_reuses_pool():
+    res = resource.request(ResourceRequest.kTempSpace)
+    a = res.get_space((64, 64), "float32")
+    assert a.shape == (64, 64) and a.dtype == onp.float32
+    a[:] = 3.0
+    onp.testing.assert_allclose(a.sum(), 64 * 64 * 3.0)
+    with pytest.raises(TypeError):
+        res.get_rng_key()
+
+
+def test_random_streams_are_independent():
+    mx.random.seed(0)
+    res = resource.request("parallel_random")
+    u1 = res.uniform((128,))
+    u2 = res.uniform((128,))
+    assert not onp.allclose(u1.asnumpy(), u2.asnumpy())
+    n = res.normal((4096,), loc=2.0, scale=0.5)
+    v = n.asnumpy()
+    assert abs(v.mean() - 2.0) < 0.05 and abs(v.std() - 0.5) < 0.05
+    with pytest.raises(TypeError):
+        res.get_space((2,))
+
+
+def test_random_resource_seeding_reproducible():
+    res = resource.request(ResourceRequest.kRandom)
+    mx.random.seed(7)
+    a = res.uniform((16,)).asnumpy()
+    mx.random.seed(7)
+    b = res.uniform((16,)).asnumpy()
+    onp.testing.assert_allclose(a, b)
+
+
+def test_unknown_request_rejected():
+    with pytest.raises(ValueError, match="unknown resource"):
+        resource.request("workspace_of_dreams")
