@@ -69,6 +69,8 @@ from . import symbol  # StableHLO deployment artifact (HybridBlock.export)
 from . import sym_api as sym  # composable graph API (mx.sym.var + ops)
 from . import config  # typed MXNET_* knob registry
 from . import graph_pass  # nnvm-pass-registry analog over the sym DAG
+from . import resource  # kTempSpace / kParallelRandom analog
+from . import storage  # pooled host arena API
 config.check_env()  # warn on unknown/inert MXNET_* vars, don't ignore them
 
 

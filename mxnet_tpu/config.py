@@ -110,6 +110,9 @@ register("MXNET_KVSTORE_SYNC", bool, True, "honored",
 register("MXNET_TPU_DISABLE_NATIVE", bool, False, "honored",
          "1 = never load/build libmxtpu_core.so (pure-Python fallbacks)",
          "_native.lib")
+register("MXNET_TPU_CORE_SO", str, "", "honored",
+         "override path to the native core .so (TSAN/ASAN builds)",
+         "tests/tsan_engine_stress.py")
 register("MXNET_SUBGRAPH_BACKEND", str, "", "honored",
          "default backend name for optimize_for block rewriting",
          "subgraph")

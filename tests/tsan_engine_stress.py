@@ -1,0 +1,72 @@
+"""ThreadSanitizer stress driver for the native engine (SURVEY §5.2 —
+the reference's race-detection CI story, CI sanitizer builds).
+
+Build + run:
+    make -C src tsan
+    TSAN_OPTIONS="halt_on_error=1" \
+        LD_PRELOAD=$(gcc -print-file-name=libtsan.so) \
+        MXNET_TPU_CORE_SO=mxnet_tpu/lib/libmxtpu_core_tsan.so \
+        python tests/tsan_engine_stress.py
+
+Exits nonzero if TSAN reports a race.  Not part of the pytest lanes —
+TSAN needs the preload and ~10x runtime; this is the nightly sanitizer
+entry point.
+"""
+import ctypes
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    so = os.environ.get("MXNET_TPU_CORE_SO")
+    if so:
+        # point the loader at the TSAN build before mxnet_tpu loads it
+        import mxnet_tpu._native as native
+        native._LIB_PATH = os.path.abspath(so)
+    from mxnet_tpu.engine import Engine
+
+    eng = Engine(num_workers=8)
+    if not eng.is_native:
+        print("native engine unavailable; nothing to sanitize")
+        return 0
+
+    # storm: many threads pushing chains + independent ops + waits
+    N_THREADS, OPS = 8, 300
+    errors = []
+
+    def worker(tid):
+        try:
+            chain = eng.new_variable()
+            for i in range(OPS):
+                v = eng.new_variable()
+                eng.push(lambda: None, const_vars=[chain],
+                         mutable_vars=[v])
+                eng.push(lambda: None, mutable_vars=[chain])
+                if i % 16 == 0:
+                    eng.wait_for_var(chain)
+                eng.delete_variable(v)
+            eng.wait_for_var(chain)
+            eng.delete_variable(chain)
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.wait_for_all()
+    if errors:
+        print("errors:", errors)
+        return 1
+    print("engine stress clean (%d threads x %d ops)" % (N_THREADS, OPS))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
