@@ -21,7 +21,11 @@ _LIB = None
 _TRIED = False
 _LOCK = threading.Lock()
 
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libmxtpu_core.so")
+# MXNET_TPU_CORE_SO points the loader at an alternate build (TSAN/ASAN);
+# when set, the override is authoritative: no rebuild-on-stale either
+_LIB_OVERRIDE = os.environ.get("MXNET_TPU_CORE_SO") or None
+_LIB_PATH = os.path.abspath(_LIB_OVERRIDE) if _LIB_OVERRIDE else \
+    os.path.join(os.path.dirname(__file__), "lib", "libmxtpu_core.so")
 _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "src")
 
 # callback: int fn(void* ctx, char* err_buf, int err_len, int skipped).
@@ -179,8 +183,8 @@ def lib():
         _TRIED = True
         if os.environ.get("MXNET_TPU_DISABLE_NATIVE", "") == "1":
             return None
-        if _stale():
-            _try_build()
+        if _LIB_OVERRIDE is None and _stale():
+            _try_build()  # never rebuild over an explicit override
         if os.path.exists(_LIB_PATH):
             try:
                 _LIB = _declare(ctypes.CDLL(_LIB_PATH))

@@ -17,33 +17,52 @@ import threading
 
 import jax
 
-_STATE = threading.local()
+# trace stacks are per-thread (a trace is a thread-confined activity);
+# the BASE key + draw counter are process-global so (a) mx.random.seed
+# seeds EVERY thread and (b) two threads can never replay the same
+# stream — each draw folds a unique counter into the base key
+_TRACE = threading.local()
+_LOCK = threading.Lock()
 _DEFAULT_SEED = 0
+_BASE = None
+_COUNTER = 0
 
 
-def _st():
-    if not hasattr(_STATE, "key"):
-        _STATE.key = jax.random.key(_DEFAULT_SEED)
-        _STATE.trace_stack = []
-    return _STATE
+def _trace_stack():
+    if not hasattr(_TRACE, "stack"):
+        _TRACE.stack = []
+    return _TRACE.stack
+
+
+def _base():
+    global _BASE
+    if _BASE is None:
+        _BASE = jax.random.key(_DEFAULT_SEED)
+    return _BASE
 
 
 def seed(seed_state, ctx="all"):
-    """mx.random.seed parity (python/mxnet/random.py)."""
-    st = _st()
-    st.key = jax.random.key(int(seed_state))
+    """mx.random.seed parity (python/mxnet/random.py) — process-wide."""
+    global _BASE, _COUNTER
+    with _LOCK:
+        _BASE = jax.random.key(int(seed_state))
+        _COUNTER = 0
 
 
 def next_key():
     """Return a fresh subkey; inside a trace, derive from the traced key."""
-    st = _st()
-    if st.trace_stack:
-        holder = st.trace_stack[-1]
+    stack = _trace_stack()
+    if stack:
+        holder = stack[-1]
         holder["key"], sub = jax.random.split(holder["key"])
         holder["count"] += 1
         return sub
-    st.key, sub = jax.random.split(st.key)
-    return sub
+    global _COUNTER
+    with _LOCK:
+        _COUNTER += 1
+        n = _COUNTER
+        base = _base()
+    return jax.random.fold_in(base, n)
 
 
 class trace_keys:
@@ -54,13 +73,13 @@ class trace_keys:
         self.holder = {"key": base_key, "count": 0}
 
     def __enter__(self):
-        _st().trace_stack.append(self.holder)
+        _trace_stack().append(self.holder)
         return self.holder
 
     def __exit__(self, *exc):
-        _st().trace_stack.pop()
+        _trace_stack().pop()
         return False
 
 
 def current_key():
-    return _st().key
+    return _base()

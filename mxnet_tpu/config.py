@@ -111,8 +111,8 @@ register("MXNET_TPU_DISABLE_NATIVE", bool, False, "honored",
          "1 = never load/build libmxtpu_core.so (pure-Python fallbacks)",
          "_native.lib")
 register("MXNET_TPU_CORE_SO", str, "", "honored",
-         "override path to the native core .so (TSAN/ASAN builds)",
-         "tests/tsan_engine_stress.py")
+         "override path to the native core .so (TSAN/ASAN builds); "
+         "disables rebuild-on-stale", "_native._LIB_PATH")
 register("MXNET_SUBGRAPH_BACKEND", str, "", "honored",
          "default backend name for optimize_for block rewriting",
          "subgraph")

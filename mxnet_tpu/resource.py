@@ -17,8 +17,6 @@ from __future__ import annotations
 
 import threading
 
-import numpy as onp
-
 __all__ = ["ResourceRequest", "Resource", "ResourceManager", "request"]
 
 

@@ -43,3 +43,40 @@ def test_random_resource_seeding_reproducible():
 def test_unknown_request_rejected():
     with pytest.raises(ValueError, match="unknown resource"):
         resource.request("workspace_of_dreams")
+
+
+def test_random_streams_independent_across_threads():
+    """Worker threads must not replay one stream (the base key + draw
+    counter are process-global; thread-local seeding would make engine
+    workers draw identical 'randomness')."""
+    import threading
+    mx.random.seed(0)
+    res = resource.request("parallel_random")
+    outs = {}
+
+    def draw(tid):
+        outs[tid] = res.uniform((64,)).asnumpy()
+
+    ts = [threading.Thread(target=draw, args=(t,)) for t in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not onp.allclose(outs[0], outs[1])
+    assert not onp.allclose(outs[1], outs[2])
+
+
+def test_seed_applies_to_other_threads():
+    import threading
+    mx.random.seed(42)
+    got = {}
+
+    def draw():
+        got["v"] = resource.request("random").uniform((8,)).asnumpy()
+
+    t = threading.Thread(target=draw)
+    t.start()
+    t.join()
+    mx.random.seed(42)
+    main_v = resource.request("random").uniform((8,)).asnumpy()
+    onp.testing.assert_allclose(got["v"], main_v)

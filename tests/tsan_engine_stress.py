@@ -22,15 +22,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def main():
-    so = os.environ.get("MXNET_TPU_CORE_SO")
-    if so:
-        # point the loader at the TSAN build before mxnet_tpu loads it
-        import mxnet_tpu._native as native
-        native._LIB_PATH = os.path.abspath(so)
+    so = os.environ.get("MXNET_TPU_CORE_SO")  # read by _native directly
     from mxnet_tpu.engine import Engine
 
     eng = Engine(num_workers=8)
     if not eng.is_native:
+        if so:
+            # an explicit sanitizer build that fails to load must FAIL
+            # the lane, not report green with zero native code sanitized
+            print("ERROR: MXNET_TPU_CORE_SO=%s did not load" % so)
+            return 1
         print("native engine unavailable; nothing to sanitize")
         return 0
 
