@@ -9,9 +9,10 @@ import numpy as onp
 from ..ndarray import ndarray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
-           "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "Perplexity",
-           "NegativeLogLikelihood", "PearsonCorrelation", "PCC", "Loss",
-           "create"]
+           "F1", "Fbeta", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "Perplexity", "NegativeLogLikelihood", "PearsonCorrelation",
+           "PCC", "BinaryAccuracy", "MeanCosineSimilarity",
+           "MeanPairwiseDistance", "Loss", "create"]
 
 _REGISTRY = {}
 
@@ -303,7 +304,141 @@ class PearsonCorrelation(EvalMetric):
         return self.name, float(onp.corrcoef(l, p)[0, 1])
 
 
-PCC = PearsonCorrelation
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson/Matthews correlation over a K×K confusion
+    matrix (reference metric.py PCC :1597) — NOT the continuous Pearson
+    correlation (that is PearsonCorrelation above)."""
+
+    def __init__(self, name="pcc", **kwargs):
+        self._conf = onp.zeros((0, 0), onp.float64)
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self._conf = onp.zeros((0, 0), onp.float64)
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def _grow(self, k):
+        if k > self._conf.shape[0]:
+            new = onp.zeros((k, k), onp.float64)
+            old = self._conf.shape[0]
+            new[:old, :old] = self._conf
+            self._conf = new
+
+    def update(self, labels, preds):
+        if isinstance(labels, (ndarray, onp.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label = _np(label).astype(onp.int64).ravel()
+            pred = _np(pred)
+            pred = (pred.argmax(axis=-1) if pred.ndim > 1
+                    else (pred > 0.5)).astype(onp.int64).ravel()
+            k = int(max(label.max(initial=0), pred.max(initial=0))) + 1
+            self._grow(k)
+            onp.add.at(self._conf, (label, pred), 1.0)
+            self.num_inst += label.size
+
+    def get(self):
+        c = self._conf
+        if not c.size or self.num_inst == 0:
+            return self.name, float("nan")
+        s = c.sum()
+        trace = onp.trace(c)
+        t_k = c.sum(axis=1)  # true counts per class
+        p_k = c.sum(axis=0)  # predicted counts per class
+        num = trace * s - (t_k * p_k).sum()
+        den = math.sqrt(max(s * s - (p_k * p_k).sum(), 0.0)) * \
+            math.sqrt(max(s * s - (t_k * t_k).sum(), 0.0))
+        return self.name, (num / den) if den else 0.0
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Thresholded accuracy over scores (reference BinaryAccuracy)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        self.threshold = threshold
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (ndarray, onp.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label = _np(label).ravel()
+            pred = (_np(pred).ravel() > self.threshold)
+            self.sum_metric += float((pred == (label > 0.5)).sum())
+            self.num_inst += label.size
+
+
+@register
+class Fbeta(EvalMetric):
+    """F-beta over binary stats (reference Fbeta): beta weighs recall;
+    beta=1 reduces to F1."""
+
+    def __init__(self, name="fbeta", beta=1.0, **kwargs):
+        self.beta = float(beta)
+        self.stats = _BinaryStats()
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self.stats = _BinaryStats()
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        if isinstance(labels, (ndarray, onp.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            self.stats.update(_np(label), _np(pred))
+
+    def get(self):
+        s, b2 = self.stats, self.beta ** 2
+        prec = s.tp / (s.tp + s.fp) if s.tp + s.fp else 0.0
+        rec = s.tp / (s.tp + s.fn) if s.tp + s.fn else 0.0
+        den = b2 * prec + rec
+        fb = (1 + b2) * prec * rec / den if den else 0.0
+        return self.name, fb
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (reference
+    MeanCosineSimilarity)."""
+
+    def __init__(self, name="cos_sim", eps=1e-12, **kwargs):
+        self.eps = eps
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (ndarray, onp.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            a, b = _np(label), _np(pred)
+            num = (a * b).sum(axis=-1)
+            den = onp.linalg.norm(a, axis=-1) * onp.linalg.norm(b, axis=-1)
+            sim = num / onp.maximum(den, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance along the last axis (reference
+    MeanPairwiseDistance)."""
+
+    def __init__(self, name="mpd", p=2.0, **kwargs):
+        self.p = float(p)
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (ndarray, onp.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            d = onp.abs(_np(pred) - _np(label)) ** self.p
+            dist = d.sum(axis=-1) ** (1.0 / self.p)
+            self.sum_metric += float(dist.sum())
+            self.num_inst += dist.size
 
 
 @register

@@ -130,3 +130,54 @@ def test_dist_slice_plan():
     store._server_opt = False
     store._slice_threshold = 0
     assert store._slice_plan("3", 10**9) is None
+
+
+# -- round-2 metric additions (reference gluon/metric.py) -------------------
+def test_pcc_multiclass_confusion_based():
+    from mxnet_tpu.gluon import metric
+    from mxnet_tpu import np as mxnp
+    import numpy as onp
+    m = metric.PCC()
+    labels = onp.array([0, 1, 2, 0, 1, 2, 0, 0])
+    # perfect predictions → PCC == 1
+    preds = onp.eye(3)[labels]
+    m.update(mxnp.array(labels.astype("float32")), mxnp.array(preds))
+    assert m.get()[1] == pytest.approx(1.0)
+    # uniform wrong predictions pull it down
+    m2 = metric.PCC()
+    m2.update(mxnp.array(labels.astype("float32")),
+              mxnp.array(onp.eye(3)[(labels + 1) % 3]))
+    assert m2.get()[1] < 0
+
+
+def test_binary_accuracy_and_fbeta():
+    from mxnet_tpu.gluon import metric
+    from mxnet_tpu import np as mxnp
+    import numpy as onp
+    label = onp.array([1, 0, 1, 1, 0], "float32")
+    score = onp.array([0.9, 0.2, 0.4, 0.8, 0.6], "float32")
+    ba = metric.BinaryAccuracy(threshold=0.5)
+    ba.update(mxnp.array(label), mxnp.array(score))
+    assert ba.get()[1] == pytest.approx(3 / 5)
+    # beta→0 weighs precision only; beta→inf recall only
+    f_p = metric.Fbeta(beta=1e-6)
+    f_r = metric.Fbeta(beta=1e6)
+    for f in (f_p, f_r):
+        f.update(mxnp.array(label), mxnp.array(score))
+    tp, fp, fn = 2, 1, 1   # preds>0.5: [1,0,0,1,1]
+    assert f_p.get()[1] == pytest.approx(tp / (tp + fp), rel=1e-3)
+    assert f_r.get()[1] == pytest.approx(tp / (tp + fn), rel=1e-3)
+
+
+def test_cosine_and_pairwise_distance_metrics():
+    from mxnet_tpu.gluon import metric
+    from mxnet_tpu import np as mxnp
+    import numpy as onp
+    a = onp.array([[1.0, 0.0], [0.0, 2.0]], "float32")
+    b = onp.array([[2.0, 0.0], [0.0, 1.0]], "float32")
+    cs = metric.MeanCosineSimilarity()
+    cs.update(mxnp.array(a), mxnp.array(b))
+    assert cs.get()[1] == pytest.approx(1.0)
+    mpd = metric.MeanPairwiseDistance(p=2)
+    mpd.update(mxnp.array(a), mxnp.array(b))
+    assert mpd.get()[1] == pytest.approx(1.0)  # each row distance 1
