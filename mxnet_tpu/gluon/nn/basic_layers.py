@@ -14,7 +14,8 @@ from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "Embedding", "Flatten", "InstanceNorm", "LayerNorm", "GroupNorm",
-           "Lambda", "HybridLambda", "Concatenate", "Identity",
+           "Lambda", "HybridLambda", "Concatenate", "HybridConcatenate",
+           "Identity",
            "SyncBatchNorm", "BatchNormReLU"]
 
 
@@ -382,6 +383,11 @@ class Concatenate(HybridSequential):
     def forward(self, x):
         out = [block(x) for block in self._children.values()]
         return np_mod.concatenate(out, axis=self._axis)
+
+
+# reference ships both spellings (basic_layers.py HybridConcatenate :1013);
+# every block here is hybrid-capable, so they are the same class
+HybridConcatenate = Concatenate
 
 
 class Identity(HybridBlock):

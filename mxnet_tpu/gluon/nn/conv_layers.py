@@ -329,3 +329,96 @@ class PixelShuffle3D(_PixelShuffle):
         x = x.reshape((n, c // (f1 * f2 * f3), f1, f2, f3, d, h, w))
         x = x.transpose((0, 1, 5, 2, 6, 3, 7, 4))
         return x.reshape((n, c // (f1 * f2 * f3), d * f1, h * f2, w * f3))
+
+
+class DeformableConvolution(HybridBlock):
+    """2-D deformable convolution v1 (reference conv_layers.py:1246):
+    the sampling offsets are produced by an internal, zero-initialized
+    convolution and fed to contrib.deformable_convolution; both branches
+    live in this one layer like the reference."""
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 modulated=False):
+        super().__init__()
+        assert layout == "NCHW", "deformable conv supports NCHW"
+        assert groups == 1, "groups>1 not supported in the TPU build yet"
+        k = _tup(kernel_size, 2)
+        K = k[0] * k[1]
+        self._modulated = modulated
+        n_offset = num_deformable_group * (3 if modulated else 2) * K
+        self._kernel = k
+        self._stride = _tup(strides, 2)
+        self._pad = _tup(padding, 2)
+        self._dilate = _tup(dilation, 2)
+        self._channels = channels
+        self._ndg = num_deformable_group
+        self._activation = activation
+        self._use_bias = use_bias
+        # offset branch: zero-init conv so training starts at the regular
+        # grid (reference offset_weight_initializer default)
+        from .basic_layers import _zeros_init
+        self.offset_conv = Conv2D(
+            n_offset, kernel_size=k, strides=self._stride,
+            padding=self._pad, dilation=self._dilate,
+            use_bias=offset_use_bias, in_channels=in_channels,
+            weight_initializer=_zeros_init(offset_weight_initializer),
+            bias_initializer=offset_bias_initializer)
+        from .basic_layers import _zeros_init
+        self.weight = Parameter("weight",
+                                shape=(channels, in_channels) + k,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,),
+                              init=_zeros_init(bias_initializer)) \
+            if use_bias else None
+
+    def infer_shape(self, x, *a):
+        in_c = x.shape[1]
+        self.weight.shape_and_init(
+            (self._channels, in_c) + self._kernel)
+
+    def forward(self, x):
+        from ...contrib.ops import (deformable_convolution,
+                                    modulated_deformable_convolution)
+        from ... import numpy_extension as npx_mod
+        if self.weight._data is None:
+            self.infer_shape(x)
+        off_all = self.offset_conv(x)
+        K = self._kernel[0] * self._kernel[1]
+        kw = dict(kernel=self._kernel, stride=self._stride,
+                  pad=self._pad, dilate=self._dilate,
+                  num_filter=self._channels,
+                  num_deformable_group=self._ndg)
+        if self._modulated:
+            n_off = self._ndg * 2 * K
+            offset = off_all[:, :n_off]
+            mask = npx_mod.sigmoid(off_all[:, n_off:])
+            out = modulated_deformable_convolution(
+                x, offset, mask, self.weight.data(),
+                self.bias.data() if self.bias is not None else None, **kw)
+        else:
+            out = deformable_convolution(
+                x, off_all, self.weight.data(),
+                self.bias.data() if self.bias is not None else None, **kw)
+        if self._activation:
+            from ... import numpy_extension as npx2
+            out = npx2.activation(out, self._activation)
+        return out
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """Deformable convolution v2 (reference conv_layers.py
+    ModulatedDeformableConvolution): learned per-tap modulation mask."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs["modulated"] = True
+        super().__init__(*args, **kwargs)
+
+
+__all__ += ["DeformableConvolution", "ModulatedDeformableConvolution"]

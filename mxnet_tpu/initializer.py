@@ -230,6 +230,46 @@ class LSTMBias(Initializer):
         arr._set_data(jnp.asarray(b, arr.dtype))
 
 
+class Load:
+    """Initialize from saved arrays by name, with an optional fallback
+    for params absent from the file (reference initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        import numpy as _onp
+        if isinstance(param, str):
+            loaded = _onp.load(param)
+            param = {k: loaded[k] for k in loaded.files}
+        self.param = {}
+        for name, arr in param.items():
+            # strip reference save prefixes ("arg:", "aux:")
+            key = name.split(":", 1)[1] if name[:4] in ("arg:", "aux:") \
+                else name
+            self.param[key] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        import numpy as _onp
+        key = str(name)
+        if key in self.param:
+            src = _onp.asarray(
+                self.param[key].asnumpy()
+                if hasattr(self.param[key], "asnumpy")
+                else self.param[key])
+            if tuple(src.shape) != tuple(arr.shape):
+                raise ValueError(
+                    "Load: shape mismatch for %r: saved %s vs param %s"
+                    % (key, src.shape, tuple(arr.shape)))
+            arr._set_data(src.astype(str(arr.dtype)))
+            if self.verbose:
+                print("Load: initialized %s from saved arrays" % key)
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise ValueError(
+                "Load: no saved array for %r and no default_init" % key)
+
+
 class Mixed:
     """Mix initializers by regex on param name (reference Mixed)."""
 
@@ -258,6 +298,7 @@ class _InitModule:
     Bilinear = Bilinear
     LSTMBias = LSTMBias
     Mixed = Mixed
+    Load = Load
     InitDesc = InitDesc
 
 

@@ -160,3 +160,40 @@ def test_libsvm_iter_discard_tail(tmp_path):
     it.next()
     with pytest.raises(StopIteration):
         it.next()
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution gluon layers (reference conv_layers.py:1246)
+# ---------------------------------------------------------------------------
+def test_deformable_layer_zero_offsets_match_conv2d():
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    layer = nn.DeformableConvolution(4, kernel_size=(3, 3), padding=(1, 1),
+                                     in_channels=3)
+    layer.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(2, 3, 8, 8))
+    out = layer(x)
+    assert out.shape == (2, 4, 8, 8)
+    # offset conv is zero-initialized → behaves exactly like Conv2D with
+    # the same weights at step 0 (the reference's training start point)
+    from mxnet_tpu import npx
+    ref = npx.convolution(x, layer.weight.data(), layer.bias.data(),
+                          kernel=(3, 3), pad=(1, 1), num_filter=4)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_modulated_deformable_layer_trains():
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    layer = nn.ModulatedDeformableConvolution(2, kernel_size=(3, 3),
+                                              padding=(1, 1), in_channels=1)
+    layer.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(1, 1, 6, 6))
+    with autograd.record():
+        loss = (layer(x) ** 2).sum()
+    loss.backward()
+    g = layer.offset_conv.weight.grad().asnumpy()
+    assert onp.isfinite(g).all()
+    gw = layer.weight.grad().asnumpy()
+    assert onp.abs(gw).sum() > 0
