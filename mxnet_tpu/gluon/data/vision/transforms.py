@@ -188,3 +188,146 @@ class RandomColorJitter:
         for t in self._ts:
             x = t(x)
         return x
+
+
+class RandomCrop:
+    """Random spatial crop with optional padding (reference transforms
+    RandomCrop; pad_value fills when the image is smaller)."""
+
+    def __init__(self, size, pad=None, pad_value=0):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._pad_value = pad_value
+
+    def __call__(self, x):
+        img = _np(x)
+        if self._pad:
+            p = self._pad
+            img = onp.pad(img, ((p, p), (p, p), (0, 0)), mode="constant",
+                          constant_values=self._pad_value)
+        h, w = self._size
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            out = onp.full((max(h, ih), max(w, iw)) + img.shape[2:],
+                           self._pad_value, img.dtype)
+            out[:ih, :iw] = img
+            img, ih, iw = out, out.shape[0], out.shape[1]
+        y = onp.random.randint(0, ih - h + 1)
+        xx = onp.random.randint(0, iw - w + 1)
+        return img[y:y + h, xx:xx + w]
+
+
+class CropResize:
+    """Fixed crop then resize (reference CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        self._x, self._y, self._w, self._h = x, y, width, height
+        self._size = size
+
+    def __call__(self, img):
+        img = _np(img)
+        out = img[self._y:self._y + self._h, self._x:self._x + self._w]
+        if self._size:
+            out = Resize(self._size)(out)
+        return out
+
+
+class RandomGray:
+    """Randomly convert to 3-channel grayscale (reference RandomGray)."""
+
+    def __init__(self, p=0.5):
+        self._p = p
+
+    def __call__(self, x):
+        img = _np(x)
+        if onp.random.rand() < self._p:
+            lum = (img[..., :3] @ onp.array([0.299, 0.587, 0.114],
+                                            img.dtype if img.dtype.kind == "f"
+                                            else onp.float32))
+            img = onp.repeat(lum[..., None], 3, axis=-1).astype(img.dtype)
+        return img
+
+
+class RandomHue:
+    """Random hue rotation in HSV space (reference RandomHue)."""
+
+    def __init__(self, max_delta=0.1):
+        self._d = max_delta
+
+    def __call__(self, x):
+        img = _np(x).astype(onp.float32)
+        delta = onp.random.uniform(-self._d, self._d)
+        # cheap YIQ-rotation approximation of hue shift (the reference's
+        # image_random_hue kernel uses the same trick)
+        u, w = onp.cos(delta * onp.pi), onp.sin(delta * onp.pi)
+        t_yiq = onp.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], onp.float32)
+        t_rgb = onp.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], onp.float32)
+        rot = onp.array([[1, 0, 0], [0, u, -w], [0, w, u]], onp.float32)
+        m = t_rgb @ rot @ t_yiq
+        out = img[..., :3] @ m.T
+        return onp.clip(out, 0, 255).astype(_np(x).dtype)
+
+
+class Rotate:
+    """Rotate by a fixed angle (degrees; reference Rotate with
+    zoom_out=False semantics, nearest sampling)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        self._deg = rotation_degrees
+
+    def __call__(self, x):
+        img = _np(x)
+        theta = onp.deg2rad(self._deg)
+        h, w = img.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = onp.meshgrid(onp.arange(h), onp.arange(w), indexing="ij")
+        ys = cy + (yy - cy) * onp.cos(theta) - (xx - cx) * onp.sin(theta)
+        xs = cx + (yy - cy) * onp.sin(theta) + (xx - cx) * onp.cos(theta)
+        yi = onp.clip(onp.round(ys).astype(int), 0, h - 1)
+        xi = onp.clip(onp.round(xs).astype(int), 0, w - 1)
+        inb = (ys >= 0) & (ys <= h - 1) & (xs >= 0) & (xs <= w - 1)
+        out = img[yi, xi]
+        out[~inb] = 0
+        return out
+
+
+class RandomRotation:
+    """Random rotation from an angle range (reference RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        self._limits = angle_limits
+        self._p = rotate_with_proba
+
+    def __call__(self, x):
+        if onp.random.rand() >= self._p:
+            return _np(x)
+        deg = onp.random.uniform(*self._limits)
+        return Rotate(deg)(x)
+
+
+class RandomApply:
+    """Apply a transform with probability p (reference RandomApply)."""
+
+    def __init__(self, transforms, p=0.5):
+        self._t = transforms
+        self._p = p
+
+    def __call__(self, x):
+        if onp.random.rand() < self._p:
+            return self._t(x)
+        return _np(x)
+
+
+# every transform here is a host-side callable; the reference's Hybrid*
+# variants exist for symbolic tracing, which these already survive
+HybridCompose = Compose
+HybridRandomApply = RandomApply
+
+__all__ += ["RandomCrop", "CropResize", "RandomGray", "RandomHue",
+            "Rotate", "RandomRotation", "RandomApply", "HybridCompose",
+            "HybridRandomApply"]

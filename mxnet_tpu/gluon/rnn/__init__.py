@@ -5,4 +5,9 @@ from .rnn_cell import (  # noqa: F401
     DropoutCell, ZoneoutCell, ResidualCell, BidirectionalCell,
     HybridSequentialRNNCell)
 from .conv_rnn_cell import (  # noqa: F401
-    ConvRNNCell, ConvLSTMCell, ConvGRUCell)
+    ConvRNNCell, ConvLSTMCell, ConvGRUCell,
+    Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+    Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+    Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell)
+from .rnn_cell import (  # noqa: F401
+    LSTMPCell, VariationalDropoutCell, HybridRecurrentCell, ModifierCell)

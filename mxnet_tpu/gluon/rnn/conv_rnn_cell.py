@@ -159,3 +159,42 @@ class ConvGRUCell(_ConvRNNCellBase):
         n = np_mod.tanh(gx[:, 2 * H:] + r * gh[:, 2 * H:])
         next_h = (1 - z) * n + z * h
         return next_h, [next_h]
+
+
+def _dim_variant(base, dims, default_kernel):
+    """Per-dimension class like the reference's Conv1D/2D/3D cells."""
+
+    class _Cell(base):
+        def __init__(self, input_shape, hidden_channels,
+                     i2h_kernel=default_kernel, h2h_kernel=default_kernel,
+                     i2h_pad=None, **kwargs):
+            if i2h_pad is None:
+                i2h_pad = tuple(k // 2 for k in _tuple(i2h_kernel, dims))
+            super().__init__(input_shape, hidden_channels,
+                             i2h_kernel=i2h_kernel, h2h_kernel=h2h_kernel,
+                             i2h_pad=i2h_pad, conv_dims=dims, **kwargs)
+
+    return _Cell
+
+
+Conv1DRNNCell = _dim_variant(ConvRNNCell, 1, (3,))
+Conv2DRNNCell = _dim_variant(ConvRNNCell, 2, (3, 3))
+Conv3DRNNCell = _dim_variant(ConvRNNCell, 3, (3, 3, 3))
+Conv1DLSTMCell = _dim_variant(ConvLSTMCell, 1, (3,))
+Conv2DLSTMCell = _dim_variant(ConvLSTMCell, 2, (3, 3))
+Conv3DLSTMCell = _dim_variant(ConvLSTMCell, 3, (3, 3, 3))
+Conv1DGRUCell = _dim_variant(ConvGRUCell, 1, (3,))
+Conv2DGRUCell = _dim_variant(ConvGRUCell, 2, (3, 3))
+Conv3DGRUCell = _dim_variant(ConvGRUCell, 3, (3, 3, 3))
+for _n, _c in [("Conv%d%sCell" % (d, kind), c)
+               for (d, kind, c) in
+               [(1, "DRNN", Conv1DRNNCell), (2, "DRNN", Conv2DRNNCell),
+                (3, "DRNN", Conv3DRNNCell), (1, "DLSTM", Conv1DLSTMCell),
+                (2, "DLSTM", Conv2DLSTMCell), (3, "DLSTM", Conv3DLSTMCell),
+                (1, "DGRU", Conv1DGRUCell), (2, "DGRU", Conv2DGRUCell),
+                (3, "DGRU", Conv3DGRUCell)]]:
+    _c.__name__ = _n
+
+__all__ += ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+            "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+            "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]

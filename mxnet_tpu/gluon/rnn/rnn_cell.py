@@ -287,3 +287,110 @@ class BidirectionalCell(RecurrentCell):
                                      axis=axis)
         out = np_mod.concatenate([l_out, r_out], axis=-1)
         return out, l_states + r_states
+
+
+class LSTMPCell(_FusedBaseCell):
+    """LSTM with a learned hidden-state projection (reference
+    contrib/rnn LSTMPCell, Sak et al. 2014): the recurrent h is
+    projected to `projection_size` before it feeds h2h and the output."""
+
+    _num_gates = 4
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 projection_initializer=None, **kwargs):
+        super().__init__(hidden_size, input_size, **kwargs)
+        self._projection_size = projection_size
+        # h2h operates on the PROJECTED state
+        ng = self._num_gates
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(ng * hidden_size, projection_size),
+            init=kwargs.get("h2h_weight_initializer"),
+            allow_deferred_init=True)
+        self.projection_weight = Parameter(
+            "projection_weight", shape=(projection_size, hidden_size),
+            init=projection_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def infer_shape(self, x, *a):
+        super().infer_shape(x, *a)
+        ng = self._num_gates
+        self.h2h_weight.shape_and_init(
+            (ng * self._hidden_size, self._projection_size))
+        self.projection_weight.shape_and_init(
+            (self._projection_size, self._hidden_size))
+
+    def forward(self, x, states):
+        h, c = states
+        gates = self._gates_x(x) + npx.fully_connected(
+            h, self.h2h_weight.data(), self.h2h_bias.data(),
+            num_hidden=self._num_gates * self._hidden_size, flatten=False)
+        H = self._hidden_size
+        i = npx.sigmoid(gates[:, :H])
+        f = npx.sigmoid(gates[:, H:2 * H])
+        u = np_mod.tanh(gates[:, 2 * H:3 * H])
+        o = npx.sigmoid(gates[:, 3 * H:])
+        next_c = f * c + i * u
+        hidden = o * np_mod.tanh(next_c)
+        next_h = npx.fully_connected(
+            hidden, self.projection_weight.data(), None, no_bias=True,
+            num_hidden=self._projection_size, flatten=False)
+        return next_h, [next_h, next_c]
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """One dropout mask per SEQUENCE (not per step) on inputs/states/
+    outputs (reference contrib VariationalDropoutCell, Gal & Ghahramani
+    2016)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self.reset()
+
+    def reset(self):
+        self._mask_in = self._mask_st = self._mask_out = None
+        if getattr(self, "base_cell", None) is not None:
+            self.base_cell.reset()
+
+    def _mask(self, cached, x, rate):
+        if rate == 0.0:
+            return None, cached
+        from ... import autograd
+        if not autograd.is_training():
+            return None, cached
+        if cached is None:
+            import jax
+            from ..._rng import next_key
+            from ...ndarray import _wrap_value
+            keep = 1.0 - rate
+            m = jax.random.bernoulli(next_key(), keep, x.shape)
+            cached = _wrap_value(m.astype("float32") / keep)
+        return cached, cached
+
+    def forward(self, x, states):
+        m, self._mask_in = self._mask(self._mask_in, x, self._di)
+        if m is not None:
+            x = x * m
+        if self._ds:
+            h = states[0]
+            m, self._mask_st = self._mask(self._mask_st, h, self._ds)
+            if m is not None:
+                states = [h * m] + list(states[1:])
+        out, new_states = self.base_cell(x, states)
+        m, self._mask_out = self._mask(self._mask_out, out, self._do)
+        if m is not None:
+            out = out * m
+        return out, new_states
+
+
+# public aliases matching the reference class names
+HybridRecurrentCell = RecurrentCell
+ModifierCell = _ModifierCell
+__all__ += ["LSTMPCell", "VariationalDropoutCell", "HybridRecurrentCell",
+            "ModifierCell"]

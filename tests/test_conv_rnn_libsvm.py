@@ -197,3 +197,51 @@ def test_modulated_deformable_layer_trains():
     assert onp.isfinite(g).all()
     gw = layer.weight.grad().asnumpy()
     assert onp.abs(gw).sum() > 0
+
+
+def test_per_dimension_conv_cell_variants():
+    c1 = rnn.Conv1DLSTMCell(input_shape=(2, 8), hidden_channels=3)
+    c1.initialize(mx.init.Xavier())
+    out, states = c1(mxnp.random.uniform(size=(2, 2, 8)),
+                     c1.begin_state(2))
+    assert out.shape == (2, 3, 8) and len(states) == 2
+    g = rnn.Conv2DGRUCell(input_shape=(1, 4, 4), hidden_channels=2)
+    g.initialize(mx.init.Xavier())
+    out, _ = g(mxnp.random.uniform(size=(3, 1, 4, 4)), g.begin_state(3))
+    assert out.shape == (3, 2, 4, 4)
+
+
+def test_lstmp_cell_projects_hidden():
+    mx.random.seed(0)
+    cell = rnn.LSTMPCell(hidden_size=6, projection_size=3, input_size=4)
+    cell.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(2, 4))
+    states = cell.begin_state(2)
+    assert states[0].shape == (2, 3)  # projected h
+    assert states[1].shape == (2, 6)  # full c
+    out, (h1, c1) = cell(x, states)
+    assert out.shape == (2, 3) and c1.shape == (2, 6)
+    with autograd.record():
+        loss = (cell(x, states)[0] ** 2).sum()
+    loss.backward()
+    assert onp.abs(cell.projection_weight.grad().asnumpy()).sum() > 0
+
+
+def test_variational_dropout_mask_constant_across_steps():
+    mx.random.seed(3)
+    base = rnn.LSTMCell(hidden_size=8, input_size=8)
+    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize(mx.init.Xavier())
+    x = mxnp.ones((4, 8))
+    states = cell.begin_state(4)
+    with autograd.record():
+        cell(x, states)
+        m1 = cell._mask_in.asnumpy()
+        cell(x, states)
+        m2 = cell._mask_in.asnumpy()
+    onp.testing.assert_array_equal(m1, m2)  # one mask per sequence
+    cell.reset()
+    with autograd.record():
+        cell(x, states)
+    m3 = cell._mask_in.asnumpy()
+    assert not onp.array_equal(m1, m3)  # new sequence, new mask

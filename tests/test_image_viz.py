@@ -142,3 +142,28 @@ def test_gradient_compression_error_feedback_converges():
         total_d += GradientCompression.decompress(packed, meta)
     # residual is bounded by the threshold
     assert onp.abs(total_g - total_d).max() <= 0.1 + 1e-6
+
+
+def test_round2_transforms():
+    import numpy as onp
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = onp.random.RandomState(0).randint(0, 255, (20, 24, 3),
+                                            dtype=onp.uint8)
+    out = T.RandomCrop(16)(img)
+    assert out.shape == (16, 16, 3)
+    out = T.RandomCrop(16, pad=4)(img)
+    assert out.shape == (16, 16, 3)
+    out = T.CropResize(2, 3, 10, 12, size=(8, 8))(img)
+    assert out.shape[:2] == (8, 8)
+    gray = T.RandomGray(p=1.0)(img)
+    assert gray.shape == img.shape
+    assert onp.allclose(gray[..., 0], gray[..., 1])
+    hue = T.RandomHue(0.2)(img)
+    assert hue.shape == img.shape and hue.dtype == img.dtype
+    rot = T.Rotate(90)(img[:20, :20])
+    assert rot.shape == img[:20, :20].shape
+    same = T.RandomApply(T.RandomGray(p=1.0), p=0.0)(img)
+    onp.testing.assert_array_equal(same, img)
+    assert T.HybridCompose is T.Compose
+    r = T.RandomRotation((-10, 10), rotate_with_proba=0.0)(img)
+    onp.testing.assert_array_equal(r, img)
