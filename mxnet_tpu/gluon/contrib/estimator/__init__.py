@@ -1,3 +1,4 @@
 """gluon.contrib.estimator (parity: python/mxnet/gluon/contrib/estimator)."""
 from .estimator import Estimator  # noqa: F401
 from .event_handler import *  # noqa: F401,F403
+from .batch_processor import BatchProcessor  # noqa: F401

@@ -1,0 +1,29 @@
+"""BatchProcessor — pluggable per-minibatch logic (parity: reference
+`gluon/contrib/estimator/batch_processor.py:27`).
+
+fit_batch runs forward+backward but does NOT step the trainer: the
+weight update belongs to GradientUpdateHandler (priority -2000 BatchEnd),
+so user handlers can observe or transform gradients before the update —
+the reference's separation of concerns.
+"""
+from __future__ import annotations
+
+from .... import autograd
+
+__all__ = ["BatchProcessor"]
+
+
+class BatchProcessor:
+    def fit_batch(self, estimator, batch, batch_axis=0):
+        x, y = batch[0], batch[1]
+        with autograd.record():
+            pred = estimator.net(x)
+            loss = estimator.loss(pred, y)
+        loss.backward()
+        return x, y, pred, loss
+
+    def evaluate_batch(self, estimator, batch, batch_axis=0):
+        x, y = batch[0], batch[1]
+        pred = estimator.net(x)
+        loss = estimator.loss(pred, y)
+        return x, y, pred, loss

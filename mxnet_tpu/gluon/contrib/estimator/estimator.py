@@ -22,7 +22,10 @@ class Estimator:
     """Train/evaluate a Gluon net with pluggable event handlers."""
 
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
-                 trainer=None, context=None, device=None):
+                 trainer=None, context=None, device=None,
+                 batch_processor=None):
+        from .batch_processor import BatchProcessor
+        self.batch_processor = batch_processor or BatchProcessor()
         self.net = net
         if isinstance(loss, gloss.Loss):
             self.loss = loss
@@ -46,9 +49,8 @@ class Estimator:
             m.reset()
         self.val_loss_metric.reset()
         for batch in val_data:
-            x, y = batch[0], batch[1]
-            pred = self.net(x)
-            loss = self.loss(pred, y)
+            _x, y, pred, loss = self.batch_processor.evaluate_batch(
+                self, batch)
             for m in self.val_metrics:
                 m.update(y, pred)
             self.val_loss_metric.update(0, loss)
@@ -57,11 +59,11 @@ class Estimator:
 
     # -- training ---------------------------------------------------------
     def fit_batch(self, batch, batch_axis=0):
-        x, y = batch[0], batch[1]
-        with autograd.record():
-            pred = self.net(x)
-            loss = self.loss(pred, y)
-        loss.backward()
+        """Standalone single-batch train step (fwd+bwd+update).  Inside
+        fit() the update instead runs via GradientUpdateHandler so user
+        handlers can observe gradients first (reference split)."""
+        x, y, pred, loss = self.batch_processor.fit_batch(
+            self, batch, batch_axis)
         self.trainer.step(x.shape[batch_axis])
         return x, y, pred, loss
 
@@ -85,7 +87,8 @@ class Estimator:
                 epoch_batches += 1
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
-                x, y, pred, loss = self.fit_batch(batch, batch_axis)
+                x, y, pred, loss = self.batch_processor.fit_batch(
+                    self, batch, batch_axis)
                 # loss metric updates flow through MetricHandler (single
                 # ownership, matching the reference)
                 for h in batch_end:
@@ -106,7 +109,12 @@ class Estimator:
 
     # -- plumbing ---------------------------------------------------------
     def _prepare_handlers(self, val_data, event_handlers, epochs, batches):
+        from .event_handler import GradientUpdateHandler
         handlers = list(event_handlers or [])
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            # weight updates run as the highest-priority BatchEnd handler
+            # (reference estimator.py default handler set)
+            handlers.append(GradientUpdateHandler())
         if not any(isinstance(h, StoppingHandler) for h in handlers):
             handlers.append(StoppingHandler(max_epoch=epochs,
                                             max_batch=batches))

@@ -13,7 +13,8 @@ import time
 
 import numpy as onp
 
-__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+__all__ = ["EventHandler", "GradientUpdateHandler",
+           "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
            "BatchEnd", "StoppingHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
            "EarlyStoppingHandler"]
@@ -270,3 +271,47 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
                 self.stopped_epoch = self.current_epoch
                 self.stop_training = True
         return self.stop_training
+
+
+class EventHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                   BatchEnd):
+    """Catch-all base implementing every hook as a no-op (reference
+    event_handler.py EventHandler)."""
+
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Applies the weight update at batch end (reference
+    event_handler.py:722, priority -2000 so it runs before metric and
+    logging handlers observe the step's results)."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        batch = kwargs.get("batch")
+        loss = kwargs.get("loss")
+        if batch is not None:
+            batch_size = batch[0].shape[0]
+        elif loss is not None:
+            batch_size = loss.shape[0] if loss.ndim else 1
+        else:
+            batch_size = 1
+        estimator.trainer.step(batch_size)

@@ -12,6 +12,7 @@ from .transformation import (
     SoftmaxTransform, AbsTransform, PowerTransform, ComposeTransform,
     TransformedDistribution)
 from .stochastic_block import StochasticBlock, StochasticSequential
+from . import constraint  # noqa: F401  (support-validation DSL)
 
 __all__ = list(_dist_all) + [
     "kl_divergence", "register_kl", "empirical_kl",

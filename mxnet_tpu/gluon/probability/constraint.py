@@ -1,0 +1,266 @@
+"""Distribution support constraints (parity: reference
+`python/mxnet/gluon/probability/distributions/constraint.py` — the
+validation DSL `Distribution(..., validate_args=True)` checks arguments
+against).
+
+Each constraint's ``check(value)`` returns the value unchanged when every
+element satisfies the support, else raises ValueError — the reference
+contract.  ``is_in(value)`` returns the boolean mask for callers that
+want to inspect instead of raise.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...ndarray import ndarray
+
+__all__ = [
+    "Constraint", "Real", "Boolean", "Interval", "OpenInterval",
+    "HalfOpenInterval", "IntegerInterval", "IntegerOpenInterval",
+    "IntegerHalfOpenInterval", "GreaterThan", "GreaterThanEq", "LessThan",
+    "LessThanEq", "IntegerGreaterThan", "IntegerGreaterThanEq",
+    "IntegerLessThan", "IntegerLessThanEq", "Positive", "NonNegative",
+    "PositiveInteger", "NonNegativeInteger", "UnitInterval", "Simplex",
+    "LowerTriangular", "LowerCholesky", "PositiveDefinite", "Cat",
+    "Stack", "real", "boolean", "positive", "nonnegative",
+    "unit_interval", "simplex", "lower_triangular", "lower_cholesky",
+    "positive_definite",
+]
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, ndarray) else onp.asarray(x)
+
+
+class Constraint:
+    """Base constraint (reference constraint.py Constraint)."""
+
+    def is_in(self, value):
+        raise NotImplementedError
+
+    def check(self, value):
+        ok = self.is_in(value)
+        if not bool(onp.all(ok)):
+            raise ValueError(
+                "Constraint violated: value is not in the support of %s"
+                % type(self).__name__)
+        return value
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class Real(Constraint):
+    def is_in(self, value):
+        return onp.isfinite(_np(value))
+
+
+class Boolean(Constraint):
+    def is_in(self, value):
+        v = _np(value)
+        return (v == 0) | (v == 1)
+
+
+class Interval(Constraint):
+    def __init__(self, lower, upper):
+        self._l, self._u = lower, upper
+
+    def is_in(self, value):
+        v = _np(value)
+        return (v >= self._l) & (v <= self._u)
+
+
+class OpenInterval(Interval):
+    def is_in(self, value):
+        v = _np(value)
+        return (v > self._l) & (v < self._u)
+
+
+class HalfOpenInterval(Interval):
+    def is_in(self, value):
+        v = _np(value)
+        return (v >= self._l) & (v < self._u)
+
+
+class _IntegerMixin:
+    def _integral(self, v):
+        return v == onp.floor(v)
+
+
+class IntegerInterval(Interval, _IntegerMixin):
+    def is_in(self, value):
+        v = _np(value)
+        return super().is_in(value) & self._integral(v)
+
+
+class IntegerOpenInterval(OpenInterval, _IntegerMixin):
+    def is_in(self, value):
+        v = _np(value)
+        return super().is_in(value) & self._integral(v)
+
+
+class IntegerHalfOpenInterval(HalfOpenInterval, _IntegerMixin):
+    def is_in(self, value):
+        v = _np(value)
+        return super().is_in(value) & self._integral(v)
+
+
+class GreaterThan(Constraint):
+    def __init__(self, lower):
+        self._l = lower
+
+    def is_in(self, value):
+        return _np(value) > self._l
+
+
+class GreaterThanEq(GreaterThan):
+    def is_in(self, value):
+        return _np(value) >= self._l
+
+
+class LessThan(Constraint):
+    def __init__(self, upper):
+        self._u = upper
+
+    def is_in(self, value):
+        return _np(value) < self._u
+
+
+class LessThanEq(LessThan):
+    def is_in(self, value):
+        return _np(value) <= self._u
+
+
+class IntegerGreaterThan(GreaterThan, _IntegerMixin):
+    def is_in(self, value):
+        v = _np(value)
+        return super().is_in(value) & self._integral(v)
+
+
+class IntegerGreaterThanEq(GreaterThanEq, _IntegerMixin):
+    def is_in(self, value):
+        v = _np(value)
+        return super().is_in(value) & self._integral(v)
+
+
+class IntegerLessThan(LessThan, _IntegerMixin):
+    def is_in(self, value):
+        v = _np(value)
+        return super().is_in(value) & self._integral(v)
+
+
+class IntegerLessThanEq(LessThanEq, _IntegerMixin):
+    def is_in(self, value):
+        v = _np(value)
+        return super().is_in(value) & self._integral(v)
+
+
+class Positive(GreaterThan):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class NonNegative(GreaterThanEq):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class PositiveInteger(IntegerGreaterThan):
+    def __init__(self):
+        super().__init__(0)
+
+
+class NonNegativeInteger(IntegerGreaterThanEq):
+    def __init__(self):
+        super().__init__(0)
+
+
+class UnitInterval(Interval):
+    def __init__(self):
+        super().__init__(0.0, 1.0)
+
+
+class Simplex(Constraint):
+    """Rows are nonnegative and sum to 1 (reference Simplex)."""
+
+    def is_in(self, value, rtol=1e-5):
+        v = _np(value)
+        nonneg = onp.all(v >= 0, axis=-1)
+        sums = onp.abs(v.sum(axis=-1) - 1.0) < rtol
+        return nonneg & sums
+
+
+class LowerTriangular(Constraint):
+    def is_in(self, value):
+        v = _np(value)
+        return onp.all(onp.triu(v, k=1) == 0, axis=(-2, -1))
+
+
+class LowerCholesky(LowerTriangular):
+    """Lower-triangular with strictly positive diagonal."""
+
+    def is_in(self, value):
+        v = _np(value)
+        diag_pos = onp.all(
+            onp.diagonal(v, axis1=-2, axis2=-1) > 0, axis=-1)
+        return super().is_in(value) & diag_pos
+
+
+class PositiveDefinite(Constraint):
+    def is_in(self, value):
+        v = _np(value)
+        sym = onp.all(onp.abs(v - onp.swapaxes(v, -1, -2)) < 1e-6,
+                      axis=(-2, -1))
+        try:
+            onp.linalg.cholesky(v)
+            chol_ok = True
+        except onp.linalg.LinAlgError:
+            chol_ok = False
+        return sym & chol_ok
+
+
+class Cat(Constraint):
+    """Apply constraints to concatenated slices along an axis
+    (reference Cat)."""
+
+    def __init__(self, constraints, axis=0, lengths=None):
+        self._cs = list(constraints)
+        self._axis = axis
+        self._lengths = lengths or [1] * len(self._cs)
+
+    def is_in(self, value):
+        v = _np(value)
+        checks, start = [], 0
+        for c, ln in zip(self._cs, self._lengths):
+            sl = [slice(None)] * v.ndim
+            sl[self._axis] = slice(start, start + ln)
+            checks.append(onp.all(c.is_in(v[tuple(sl)])))
+            start += ln
+        return onp.array(all(checks))
+
+
+class Stack(Constraint):
+    """Apply constraints to stacked slices along an axis (reference
+    Stack)."""
+
+    def __init__(self, constraints, axis=0):
+        self._cs = list(constraints)
+        self._axis = axis
+
+    def is_in(self, value):
+        v = _np(value)
+        checks = [onp.all(c.is_in(onp.take(v, i, axis=self._axis)))
+                  for i, c in enumerate(self._cs)]
+        return onp.array(all(checks))
+
+
+# canonical singletons (reference module-level instances)
+real = Real()
+boolean = Boolean()
+positive = Positive()
+nonnegative = NonNegative()
+unit_interval = UnitInterval()
+simplex = Simplex()
+lower_triangular = LowerTriangular()
+lower_cholesky = LowerCholesky()
+positive_definite = PositiveDefinite()

@@ -102,3 +102,58 @@ def test_onnx_export_requires_symbol():
     from mxnet_tpu.contrib import onnx as monnx
     with pytest.raises(TypeError, match="mx.sym"):
         monnx.export_model(None, None)
+
+
+def test_batch_processor_and_gradient_update_handler():
+    """fit() routes minibatches through BatchProcessor and steps via
+    GradientUpdateHandler (reference estimator split); a custom
+    processor can replace the per-batch logic."""
+    from mxnet_tpu.gluon.contrib.estimator import (BatchProcessor,
+                                                   Estimator,
+                                                   GradientUpdateHandler)
+
+    calls = {"fit": 0, "eval": 0}
+
+    class Counting(BatchProcessor):
+        def fit_batch(self, estimator, batch, batch_axis=0):
+            calls["fit"] += 1
+            return super().fit_batch(estimator, batch, batch_axis)
+
+        def evaluate_batch(self, estimator, batch, batch_axis=0):
+            calls["eval"] += 1
+            return super().evaluate_batch(estimator, batch, batch_axis)
+
+    ds = _toy_data()
+    loader = DataLoader(ds, batch_size=16)
+    net = _net()
+    est = Estimator(net=net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.1}),
+                    batch_processor=Counting())
+    x0, _y0 = next(iter(loader))
+    net(x0)  # finalize deferred shapes before snapshotting weights
+    before = net[0].weight.data().asnumpy().copy()
+    est.fit(loader, epochs=1)
+    after = net[0].weight.data().asnumpy()
+    assert calls["fit"] == len(loader)
+    assert not onp.allclose(before, after)  # handler stepped the trainer
+    est.evaluate(loader)
+    assert calls["eval"] == len(loader)
+
+
+def test_probability_constraints():
+    import numpy as onp
+    from mxnet_tpu.gluon.probability import constraint as C
+    assert bool(C.positive.is_in(onp.array([1.0, 2.0])).all())
+    with pytest.raises(ValueError):
+        C.positive.check(onp.array([1.0, -1.0]))
+    assert bool(C.simplex.is_in(onp.array([[0.3, 0.7]])).all())
+    assert not bool(C.simplex.is_in(onp.array([[0.5, 0.7]])).all())
+    L = onp.array([[1.0, 0.0], [0.5, 2.0]])
+    assert bool(C.lower_cholesky.is_in(L))
+    assert not bool(C.lower_cholesky.is_in(-L))
+    assert bool(C.positive_definite.is_in(L @ L.T))
+    assert bool(C.IntegerInterval(0, 5).is_in(onp.array([0., 3., 5.])).all())
+    assert not bool(C.IntegerInterval(0, 5).is_in(onp.array([2.5])).all())
+    cat = C.Cat([C.Positive(), C.LessThan(0)], axis=0, lengths=[1, 1])
+    assert bool(cat.is_in(onp.array([[2.0], [-3.0]])))
