@@ -116,6 +116,11 @@ register("MXNET_TPU_CORE_SO", str, "", "honored",
 register("MXNET_SUBGRAPH_BACKEND", str, "", "honored",
          "default backend name for optimize_for block rewriting",
          "subgraph")
+register("MXNET_FLASH_ATTENTION", str, "", "honored",
+         "flash-attention dispatch: ''/'1' = Pallas kernel on any "
+         "accelerator backend, '0'/'off' = always the XLA reference path, "
+         "'interpret' = Pallas interpret mode (CPU test lane)",
+         "ops.attention._pallas_mode")
 register("MXNET_SAFE_ACCUMULATION", bool, True, "honored",
          "accumulate norms/sums in fp32 even for fp16 inputs (always on;"
          " registered for compatibility)", "ops")

@@ -93,7 +93,7 @@ class Estimator:
                 # ownership, matching the reference)
                 for h in batch_end:
                     if h.batch_end(self, batch=batch, pred=pred, label=y,
-                                   loss=loss):
+                                   loss=loss, batch_axis=batch_axis):
                         stop = True
                 if stop:
                     break

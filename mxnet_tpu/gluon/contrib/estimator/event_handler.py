@@ -308,8 +308,9 @@ class GradientUpdateHandler(BatchEnd):
     def batch_end(self, estimator, *args, **kwargs):
         batch = kwargs.get("batch")
         loss = kwargs.get("loss")
+        batch_axis = kwargs.get("batch_axis", 0)
         if batch is not None:
-            batch_size = batch[0].shape[0]
+            batch_size = batch[0].shape[batch_axis]
         elif loss is not None:
             batch_size = loss.shape[0] if loss.ndim else 1
         else:

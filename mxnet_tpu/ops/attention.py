@@ -103,26 +103,86 @@ def attention_reference(q, k, v, mask=None, causal=False, window=None,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None):
-    """Blockwise O(L)-memory attention. Uses the Pallas TPU kernel when
-    running on TPU; falls back to the XLA reference path elsewhere
-    (CPU test meshes)."""
-    if mask is None and _on_tpu():
+# Which path the last flash_attention call took: "pallas" | "pallas-interpret"
+# | "xla".  Tests assert on this to guarantee the kernel is actually used.
+last_path = None
+_fallback_warned = False
+_probe_result = None  # latched: True/False once probed
+
+
+def _probe_pallas():
+    """One-time capability probe: compile + run the kernel on tiny shapes.
+    Latches the result so a non-TPU accelerator (where the Mosaic lowering
+    fails) pays the failed compile exactly once, and the dispatch gate never
+    routes to a doomed kernel inside a user's outer jit (where the
+    try/except around the call could not catch the compile error)."""
+    global _probe_result, _fallback_warned
+    if _probe_result is None:
         try:
             from .pallas.flash_attention import flash_attention_tpu
-            return flash_attention_tpu(q, k, v, causal=causal, window=window,
-                                       scale=scale)
-        except Exception:
-            pass
+            tiny = jnp.zeros((1, 1, 16, 8), jnp.float32)
+            jax.block_until_ready(flash_attention_tpu(tiny, tiny, tiny))
+            _probe_result = True
+        except Exception as e:
+            _probe_result = False
+            if not _fallback_warned:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "flash_attention: Pallas probe failed on backend %r "
+                    "(%s: %s); using the O(L^2) XLA path for this process",
+                    jax.default_backend(), type(e).__name__, e)
+                _fallback_warned = True
+    return _probe_result
+
+
+def _pallas_mode():
+    """'compiled' on any non-CPU PJRT platform that passes the Pallas probe,
+    'interpret' when forced via MXNET_FLASH_ATTENTION=interpret (CPU test
+    lane), None when disabled or on plain CPU.  Never string-compares to
+    'tpu' only: the bench chip has reported platform names like 'axon' for
+    the same hardware."""
+    import os
+    flag = os.environ.get("MXNET_FLASH_ATTENTION", "").lower()
+    if flag in ("0", "off", "false"):
+        return None
+    if flag == "interpret":
+        return "interpret"
+    try:
+        if jax.default_backend() != "cpu" and _probe_pallas():
+            return "compiled"
+    except Exception:
+        pass
+    return None
+
+
+def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None):
+    """Blockwise O(L)-memory attention with a Pallas-kernel custom VJP.
+    Uses the Pallas TPU kernel (fwd + bwd) on any accelerator backend;
+    falls back to the XLA reference path on CPU or for features the kernel
+    does not cover (dense masks, cross-attention with Lq != Lk)."""
+    global last_path, _fallback_warned
+    mode = _pallas_mode()
+    eligible = (mask is None and mode is not None
+                and q.shape[-2] == k.shape[-2])
+    if eligible:
+        try:
+            from .pallas.flash_attention import flash_attention_tpu
+            out = flash_attention_tpu(q, k, v, causal=causal, window=window,
+                                      scale=scale,
+                                      interpret=(mode == "interpret"))
+            last_path = "pallas" if mode == "compiled" else "pallas-interpret"
+            return out
+        except Exception as e:  # pragma: no cover - depends on platform
+            if not _fallback_warned:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "flash_attention: Pallas kernel failed (%s: %s); "
+                    "falling back to the O(L^2) XLA path for this process",
+                    type(e).__name__, e)
+                _fallback_warned = True
+    last_path = "xla"
     return attention_reference(q, k, v, mask=mask, causal=causal,
                                window=window, scale=scale)
-
-
-def _on_tpu():
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
 
 
 # --------------------------------------------------------------------------

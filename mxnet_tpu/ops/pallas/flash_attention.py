@@ -1,15 +1,25 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as a Pallas TPU kernel — forward AND backward.
 
 Replaces the reference's O(L^2)-memory fused attention matmuls
 (`src/operator/contrib/transformer.cc:650` interleaved_matmul_selfatt_qk →
 softmax → valatt chain) and the sliding-window kernels
-(`transformer.cc:847` sldwin_atten_*) with one blockwise kernel:
-per q-block, stream k/v through VMEM, keep a running (max, sum) pair, never
-materialize the (L, L) score matrix in HBM.  Causal and banded
-(sliding-window) masking are flags on the same kernel.
+(`transformer.cc:847` sldwin_atten_*) with a blockwise online-softmax
+kernel: per q-block the kernel streams k/v blocks through VMEM, keeping a
+running (max, sum, acc) carry, and never materializes an (L, L) score
+matrix in HBM.  VMEM footprint per program is
+O(block_q·D + block_k·D + block_q·block_k); HBM is O(L·D) for the tensors
+plus O(L) for the saved log-sum-exp.  Causal and banded (sliding-window)
+masking are flags on the same kernel, and blocks that a mask rules out
+entirely are skipped, so causal attention does ~half the work.
+
+Training is first-class: `flash_attention_tpu` carries a `jax.custom_vjp`
+whose backward is two more Pallas kernels (dq, and dk/dv), using the
+standard recomputation trick — softmax probabilities are rebuilt per block
+from q, k and the saved row-wise log-sum-exp, so no O(L^2) residual is
+stored.
 
 Layout: q, k, v are (B, H, L, D); D should be a multiple of 128 (MXU lane
-width) and block_q a multiple of 8 (f32 sublane) for best tiling.
+width) and blocks multiples of the sublane tile for best tiling.
 """
 from __future__ import annotations
 
@@ -19,63 +29,360 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Large-negative sentinel instead of -inf: masked scores underflow to exactly
+# 0 after the softmax shift (every row of a causal / banded self-attention has
+# at least one unmasked key, so running (max, sum) state self-corrects), which
+# lets the kernels skip all isfinite() guards on the hot path.
+_MASKED = -1e30
+_NEG_INF = float("-inf")
+_LANES = 128  # lane width: (m, l) carries are kept lane-broadcast
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, window,
-                 block_q, seq_len):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
-    k = k_ref[0].astype(jnp.float32)          # (L, D)
-    v = v_ref[0].astype(jnp.float32)          # (L, D)
+def _block_mask(s_shape, qi, ki, block_q, block_k, causal, window):
+    """Boolean mask for one (block_q, block_k) score tile, or None."""
+    if not causal and window is None:
+        return None
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s_shape, 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s_shape, 1)
+    mask = None
+    if causal:
+        mask = k_pos <= q_pos
+    if window is not None:
+        wm = jnp.abs(q_pos - k_pos) <= window
+        mask = wm if mask is None else (mask & wm)
+    return mask
 
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (block_q, L)
 
+def _block_needed(qi, ki, block_q, block_k, causal, window):
+    """Whether any element of score tile (qi, ki) survives the mask."""
+    need = True
+    q_first = qi * block_q
+    q_last = q_first + block_q - 1
+    k_first = ki * block_k
+    k_last = k_first + block_k - 1
+    if causal:
+        need = jnp.logical_and(need, k_first <= q_last)
+    if window is not None:
+        need = jnp.logical_and(need, k_first <= q_last + window)
+        need = jnp.logical_and(need, k_last >= q_first - window)
+    return need
+
+
+def _block_boundary(qi, ki, block_q, block_k, causal, window):
+    """Whether tile (qi, ki) intersects a mask edge (needs per-element
+    masking).  Interior tiles skip the iota/where work entirely."""
+    if not causal and window is None:
+        return False
+    q_first = qi * block_q
+    q_last = q_first + block_q - 1
+    k_first = ki * block_k
+    k_last = k_first + block_k - 1
+    interior = True
+    if causal:
+        interior = jnp.logical_and(interior, k_last <= q_first)
+    if window is not None:
+        interior = jnp.logical_and(interior, q_last - k_first <= window)
+        interior = jnp.logical_and(interior, k_last - q_first <= window)
+    return jnp.logical_not(interior)
+
+
+def _masked_dispatch(qi, ki, block_q, block_k, causal, window, step):
+    """Run `step(use_mask)` for tile (qi, ki): skipped when fully masked,
+    without per-element masking on interior tiles, with it on tiles that
+    intersect a mask edge.  Shared by the forward and both backward
+    kernels."""
+    needed = _block_needed(qi, ki, block_q, block_k, causal, window)
     if causal or window is not None:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = jnp.ones(s.shape, jnp.bool_)
-        if causal:
-            mask = mask & (k_pos <= q_pos)
-        if window is not None:
-            mask = mask & (jnp.abs(q_pos - k_pos) <= window)
-        s = jnp.where(mask, s, -jnp.inf)
+        boundary = _block_boundary(qi, ki, block_q, block_k, causal, window)
+        pl.when(jnp.logical_and(needed, boundary))(lambda: step(True))
+        pl.when(jnp.logical_and(needed, jnp.logical_not(boundary)))(
+            lambda: step(False))
+    else:
+        pl.when(needed)(lambda: step(False))
 
-    m = jnp.max(s, axis=-1, keepdims=True)
-    m = jnp.where(jnp.isfinite(m), m, 0.0)  # fully-masked rows
-    p = jnp.exp(s - m)
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    l = jnp.where(l == 0.0, 1.0, l)
-    o = jnp.dot(p, v, preferred_element_type=jnp.float32) / l
-    o_ref[0] = o.astype(o_ref.dtype)
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, window, block_q, block_k, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _MASKED)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _step(use_mask):
+        # matmuls keep the input dtype (bf16 runs the MXU at full rate);
+        # accumulation and the softmax state are always f32
+        q = q_ref[0]                                   # (bq, D)
+        k = k_ref[0]                                   # (bk, D)
+        v = v_ref[0]                                   # (bk, D)
+        s = jax.lax.dot_general(                       # (bq, bk) = q @ k.T
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if use_mask:
+            mask = _block_mask(s.shape, qi, ki, block_q, block_k, causal,
+                               window)
+            s = jnp.where(mask, s, _MASKED)
+
+        m_prev = jnp.max(m_scr[:], axis=-1, keepdims=True)   # (bq, 1)
+        l_prev = jnp.max(l_scr[:], axis=-1, keepdims=True)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)                        # (bq, bk)
+        l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_next, l_scr.shape)
+
+    _masked_dispatch(qi, ki, block_q, block_k, causal, window, _step)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        m = jnp.max(m_scr[:], axis=-1, keepdims=True)    # (bq, 1)
+        l = jnp.max(l_scr[:], axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+
+
+def _fwd_call(q, k, v, causal, window, scale, block_q, block_k, interpret):
+    BH, L, D = q.shape
+    num_q = L // block_q
+    num_k = L // block_k
+    grid = (BH, num_q, num_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_k=num_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: dq kernel (grid over q blocks, streams k blocks)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, window, block_q, block_k, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _step(use_mask):
+        q = q_ref[0]                                   # (bq, D)
+        k = k_ref[0]                                   # (bk, D)
+        v = v_ref[0]                                   # (bk, D)
+        do = do_ref[0]                                 # (bq, D)
+        lse = lse_ref[0]                               # (bq, 1)
+        delta = delta_ref[0]                           # (bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if use_mask:
+            mask = _block_mask(s.shape, qi, ki, block_q, block_k, causal,
+                               window)
+            s = jnp.where(mask, s, _MASKED)
+        p = jnp.exp(s - lse)                           # masked -> exp(-1e30)=0
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)        # (bq, bk)
+        dq_scr[:] = dq_scr[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32) * scale
+
+    _masked_dispatch(qi, ki, block_q, block_k, causal, window, _step)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward: dk/dv kernel (grid over k blocks, streams q blocks)
+# ---------------------------------------------------------------------------
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, window, block_q, block_k, num_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _step(use_mask):
+        q = q_ref[0]                                   # (bq, D)
+        k = k_ref[0]                                   # (bk, D)
+        v = v_ref[0]                                   # (bk, D)
+        do = do_ref[0]                                 # (bq, D)
+        lse = lse_ref[0]                               # (bq, 1)
+        delta = delta_ref[0]                           # (bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if use_mask:
+            mask = _block_mask(s.shape, qi, ki, block_q, block_k, causal,
+                               window)
+            s = jnp.where(mask, s, _MASKED)
+        p = jnp.exp(s - lse)                           # masked -> exp(-1e30)=0
+        # dv += p.T @ do : contract the q dimension
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)        # (bq, bk)
+        # dk += ds.T @ q, scaled to match s = (q @ k.T) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    _masked_dispatch(qi, ki, block_q, block_k, causal, window, _step)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, do, lse, delta, causal, window, scale,
+              block_q, block_k, interpret):
+    BH, L, D = q.shape
+    num_q = L // block_q
+    num_k = L // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k, num_k=num_k),
+        grid=(BH, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k, num_q=num_q),
+        grid=(BH, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP core on (BH, L, D) tensors
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, window, scale, block_q, block_k, interpret):
+    out, _ = _fwd_call(q, k, v, causal, window, scale, block_q, block_k,
+                       interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, window, scale, block_q, block_k, interpret):
+    out, lse = _fwd_call(q, k, v, causal, window, scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, window, scale, block_q, block_k, interpret,
+               residuals, g):
+    q, k, v, out, lse = residuals
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    dq, dk, dv = _bwd_call(q, k, v, g, lse, delta, causal, window, scale,
+                           block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "window", "scale",
-                                             "block_q", "interpret"))
+                                             "block_q", "block_k",
+                                             "interpret"))
 def flash_attention_tpu(q, k, v, causal=False, window=None, scale=None,
-                        block_q=128, interpret=False):
-    """q,k,v: (B, H, L, D) → (B, H, L, D)."""
+                        block_q=512, block_k=1024, interpret=False):
+    """q,k,v: (B, H, L, D) → (B, H, L, D).  Differentiable (custom VJP with
+    Pallas backward kernels).  `window` is a symmetric band half-width."""
     B, H, L, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     block_q = min(block_q, L)
     while L % block_q:
         block_q //= 2
+    block_k = min(block_k, L)
+    while L % block_k:
+        block_k //= 2
     qr = q.reshape(B * H, L, D)
     kr = k.reshape(B * H, L, D)
     vr = v.reshape(B * H, L, D)
-
-    grid = (B * H, L // block_q)
-    out = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale, causal=causal,
-                          window=window, block_q=block_q, seq_len=L),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
-        interpret=interpret,
-    )(qr, kr, vr)
+    out = _flash(qr, kr, vr, causal, window, scale, block_q, block_k,
+                 interpret)
     return out.reshape(B, H, L, D)

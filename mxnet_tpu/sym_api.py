@@ -779,10 +779,12 @@ _LEGACY = {
         "infer": None},
     "SoftmaxOutput": {
         "slots": [], "aux": [],
-        # forward = softmax over the class axis; under autodiff the
-        # backward IS softmax-minus-label when composed with CE loss
-        # (reference softmax_output.cc fuses the two)
-        "make": lambda data, *rest, **a: npx_mod.softmax(data, axis=-1),
+        # forward = softmax; backward = (softmax - label) * grad_scale wrt
+        # data, independent of the incoming cotangent — the reference's
+        # loss-layer contract (softmax_output.cc backward), so the classic
+        # `ex.backward()` with default ones out_grads trains correctly
+        "make": lambda data, *rest, **a: _softmax_output_make(
+            data, rest, a),
         "infer": None},
     "SoftmaxActivation": {
         "slots": [], "aux": [],
@@ -795,6 +797,69 @@ _LEGACY = {
             slope=a.get("slope", 0.25)),
         "infer": None},
 }
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output_op(data, label, grad_scale, normalization, use_ignore,
+                       ignore_label):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_op_fwd(data, label, grad_scale, normalization,
+                           use_ignore, ignore_label):
+    p = jax.nn.softmax(data, axis=-1)
+    return p, (p, label)
+
+
+def _softmax_output_op_bwd(grad_scale, normalization, use_ignore,
+                           ignore_label, res, g):
+    # reference softmax_output.cc backward: (softmax - onehot(label)) *
+    # grad_scale, rows with label == ignore_label zeroed under use_ignore,
+    # 'valid' normalization divides by the count of non-ignored labels,
+    # 'batch' by the leading dim
+    p, label = res
+    if label.ndim == p.ndim - 1:
+        idx = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(idx, p.shape[-1], dtype=p.dtype)
+        valid = (jnp.ones(idx.shape, jnp.bool_) if not use_ignore
+                 else idx != int(ignore_label))
+    else:
+        onehot = label.astype(p.dtype)
+        valid = jnp.ones(label.shape[:-1], jnp.bool_)
+    d = (p - onehot) * grad_scale
+    if use_ignore:
+        d = d * valid[..., None].astype(p.dtype)
+    if normalization == "valid":
+        n = jnp.maximum(jnp.sum(valid.astype(p.dtype)), 1.0)
+        d = d / n
+    elif normalization == "batch":
+        d = d / p.shape[0]
+    return d, jnp.zeros(label.shape, p.dtype)
+
+
+_softmax_output_op.defvjp(_softmax_output_op_fwd, _softmax_output_op_bwd)
+
+
+def _softmax_output_make(data, rest, attrs):
+    """legacy:SoftmaxOutput eval: plain softmax without a label; the fused
+    custom-VJP op when the label input is wired (ADVICE r2: the previous
+    lowering dropped the label, so backward produced exactly zero grads)."""
+    if not rest:
+        return npx_mod.softmax(data, axis=-1)
+    label = rest[0]
+    d = data._data if isinstance(data, ndarray) else jnp.asarray(data)
+    l = label._data if isinstance(label, ndarray) else jnp.asarray(label)
+    if not jnp.issubdtype(l.dtype, jnp.integer):
+        l = l.astype(jnp.float32) if l.ndim != d.ndim else l.astype(d.dtype)
+    out = _softmax_output_op(
+        d, l, float(attrs.get("grad_scale", 1.0)),
+        attrs.get("normalization", "null"),
+        bool(attrs.get("use_ignore", False)),
+        float(attrs.get("ignore_label", -1)))
+    return _wrap_value(out)
 
 
 def _legacy_factory(opname, spec):
@@ -857,10 +922,23 @@ def _generic_factory(op_id):
     fn_name = op_id.split(":", 1)[1]
 
     def make_symbol(*args, name=None, **kwargs):
-        inputs = [_as_symbol(a) for a in args if isinstance(a, Symbol)]
-        rest = [a for a in args if not isinstance(a, Symbol)]
-        # non-symbol positionals (axes, shapes) ride as attrs, appended in
-        # call order after the symbolic inputs
+        # scalars that precede a later Symbol argument (sym.subtract(2.0, x),
+        # sym.where(cond, 0.0, x)) become const Symbols inline so the call
+        # order is preserved (ADVICE r2: riding them as trailing _extra_pos
+        # silently reordered operands); trailing non-Symbol positionals
+        # (axes, shapes) still ride as attrs after the symbolic inputs
+        last_sym = -1
+        for i, a in enumerate(args):
+            if isinstance(a, Symbol):
+                last_sym = i
+        inputs, rest = [], []
+        for i, a in enumerate(args):
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            elif i < last_sym:
+                inputs.append(_as_symbol(a))  # raises for non-scalars
+            else:
+                rest.append(a)
         attrs = dict(kwargs)
         if rest:
             attrs["_extra_pos"] = [list(r) if isinstance(r, tuple) else r

@@ -15,6 +15,7 @@
 
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -50,9 +51,19 @@ void set_err(Predictor* p, const char* what) {
 }
 
 bool ensure_python() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-  }
+  // call_once: two embedder threads may race their first MXTPred* call
+  static std::once_flag init_once;
+  std::call_once(init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      if (Py_IsInitialized()) {
+        // release the GIL held by the initializing thread so every entry
+        // point (from any embedder thread) can uniformly PyGILState_Ensure
+        // without deadlocking (ADVICE r2)
+        PyEval_SaveThread();
+      }
+    }
+  });
   return Py_IsInitialized();
 }
 
@@ -83,10 +94,23 @@ MXTPU_API void* MXTPredCreate(const char* symbol_file,
                                             : comma - start);
       if (!nm.empty()) {
         p->input_names.push_back(nm);
-        PyList_Append(names, PyUnicode_FromString(nm.c_str()));
+        PyObject* u = PyUnicode_FromString(nm.c_str());
+        if (u == nullptr) {
+          Py_DECREF(names);
+          names = nullptr;
+          set_err(p, "invalid input name (not UTF-8?)");
+          break;
+        }
+        PyList_Append(names, u);  // list holds its own reference
+        Py_DECREF(u);
       }
       if (comma == std::string::npos) break;
       start = comma + 1;
+    }
+    if (names == nullptr) {  // bad input name above; error already set
+      Py_DECREF(cls);
+      PyErr_Clear();
+      break;
     }
     p->inputs.assign(p->input_names.size(), nullptr);
 

@@ -81,7 +81,9 @@ MXTPU_API int MXTImdecode(const char* buf, uint64_t len, int to_rgb,
   ErrMgr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = on_jpeg_error;
-  unsigned char* data = nullptr;
+  // volatile: written between setjmp and longjmp; without it the error
+  // path's free() could see a stale register copy (C++ UB, ADVICE r2)
+  unsigned char* volatile data = nullptr;
   if (setjmp(jerr.jump)) {
     jpeg_destroy_decompress(&cinfo);
     std::free(data);

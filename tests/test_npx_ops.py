@@ -264,6 +264,36 @@ def test_npx_flash_attention_grad():
         assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
 
 
+def test_flash_attention_pallas_vjp_no_fallback(monkeypatch):
+    """Differentiates through the Pallas custom VJP (interpret mode on CPU)
+    and FAILS if the dispatcher silently fell back to the XLA path — the
+    regression that shipped in round 2."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import attention
+    monkeypatch.setenv("MXNET_FLASH_ATTENTION", "interpret")
+    rng = onp.random.RandomState(0)
+    B, H, L, D = 1, 2, 64, 16
+    q, k, v = (jnp.asarray(rng.randn(B, H, L, D).astype("float32"))
+               for _ in range(3))
+
+    def loss_fa(q, k, v):
+        return (attention.flash_attention(q, k, v, causal=True) ** 2).sum()
+
+    attention.last_path = None
+    g1 = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    assert attention.last_path == "pallas-interpret", (
+        f"expected the Pallas kernel path, got {attention.last_path!r}")
+
+    def loss_ref(q, k, v):
+        return (attention.attention_reference(q, k, v, causal=True) ** 2).sum()
+
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-3, atol=1e-3)
+
+
 def test_ctc_loss_simple():
     # single perfect-prediction path
     T, B, V = 4, 1, 3

@@ -172,3 +172,64 @@ def test_executor_rebind_kwargs_and_is_train_dropout():
     onp.testing.assert_allclose(o_eval.asnumpy(), onp.ones(100))
     (o_train,) = ex.forward(is_train=True)
     assert (onp.asarray(o_train.asnumpy()) == 0).any()
+
+
+def test_softmax_output_backward_softmax_minus_label():
+    """ADVICE r2: legacy SoftmaxOutput must emit (softmax - label) wrt data
+    under ex.backward() with default ones out_grads (reference
+    softmax_output.cc), not the zero gradient of d/dx sum(softmax)."""
+    data = sym.var("data")
+    label = sym.var("label")
+    out = sym.SoftmaxOutput(data=data, label=label)
+    rng = onp.random.RandomState(0)
+    x = rng.randn(4, 5).astype("float32")
+    y = onp.array([1, 0, 3, 2])
+    ex = out.bind(args={"data": mxnp.array(x), "label": mxnp.array(y)})
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    p = onp.exp(x) / onp.exp(x).sum(-1, keepdims=True)
+    onp.testing.assert_allclose(g, p - onp.eye(5)[y], rtol=1e-5, atol=1e-6)
+    # grad_scale honored
+    out2 = sym.SoftmaxOutput(data=data, label=label, grad_scale=0.5)
+    ex2 = out2.bind(args={"data": mxnp.array(x), "label": mxnp.array(y)})
+    ex2.forward(is_train=True)
+    ex2.backward()
+    onp.testing.assert_allclose(ex2.grad_dict["data"].asnumpy(),
+                                0.5 * (p - onp.eye(5)[y]),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_generic_factory_scalar_positional_order():
+    """ADVICE r2: scalar positionals that precede Symbol args must keep
+    their call position (sym.subtract(2.0, x) != x - 2)."""
+    x = sym.var("x")
+    v = mxnp.array([1.0, 2.0, 4.0])
+    r = sym.subtract(2.0, x).eval(x=v)[0].asnumpy()
+    onp.testing.assert_allclose(r, 2.0 - v.asnumpy())
+    r = sym.true_divide(1, x).eval(x=v)[0].asnumpy()
+    onp.testing.assert_allclose(r, 1.0 / v.asnumpy())
+    c = sym.var("c")
+    r = sym.where(c, 0.0, x).eval(c=mxnp.array([1, 0, 1]), x=v)[0].asnumpy()
+    onp.testing.assert_allclose(r, onp.where([1, 0, 1], 0.0, v.asnumpy()))
+    # trailing non-symbol positionals still ride as attrs (shape here)
+    assert sym.reshape(x, (3, 1)).eval(x=v)[0].shape == (3, 1)
+
+
+def test_softmax_output_use_ignore_and_valid_normalization():
+    data = sym.var("data")
+    label = sym.var("label")
+    out = sym.SoftmaxOutput(data=data, label=label, use_ignore=True,
+                            ignore_label=-1, normalization="valid")
+    rng = onp.random.RandomState(1)
+    x = rng.randn(4, 5).astype("float32")
+    y = onp.array([1, -1, 3, -1])
+    ex = out.bind(args={"data": mxnp.array(x), "label": mxnp.array(y)})
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    p = onp.exp(x) / onp.exp(x).sum(-1, keepdims=True)
+    # ignored rows get exactly zero grad; valid rows divided by #valid (=2)
+    assert onp.abs(g[1]).max() == 0 and onp.abs(g[3]).max() == 0
+    expect = (p[0] - onp.eye(5)[1]) / 2.0
+    onp.testing.assert_allclose(g[0], expect, rtol=1e-5, atol=1e-6)

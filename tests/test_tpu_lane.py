@@ -1,0 +1,136 @@
+"""On-chip test lane (`python -m pytest -m tpu`).
+
+Runs against the real TPU backend when one is present; every test skips
+with a reason on CPU.  This is the backend-consistency half of the
+reference's test strategy (SURVEY §4: the reference runs the same op suite
+against CPU and GPU backends); here the pairs are (XLA reference path,
+Pallas kernel) and (f32, bf16) on the actual chip.
+
+What round-2's audit proved this lane is for: a Pallas kernel can compile
+in CPU interpret mode yet be unreachable or broken on the real platform.
+These tests fail loudly in that case — `test_flash_dispatch_uses_pallas`
+asserts the dispatcher took the kernel path (no silent fallback), and the
+grad test differentiates through the kernel's custom VJP on-chip.
+"""
+import numpy as onp
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    pytest.skip("no TPU backend present (CPU only); on-chip lane skipped",
+                allow_module_level=True)
+
+
+def _rand(shape, dtype="float32", seed=0):
+    return onp.random.RandomState(seed).randn(*shape).astype(dtype)
+
+
+def test_flash_kernel_numerics_on_chip():
+    from mxnet_tpu.ops.attention import attention_reference
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    B, H, L, D = 2, 4, 512, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s)) for s in range(3))
+    for causal, window in [(False, None), (True, None), (True, 64)]:
+        out = flash_attention_tpu(q, k, v, causal=causal, window=window)
+        ref = attention_reference(q, k, v, causal=causal, window=window)
+        # chip matmuls run at default (bf16-pass) precision: loose atol
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                    rtol=2e-2, atol=2e-2)
+
+
+def test_flash_dispatch_uses_pallas():
+    from mxnet_tpu.ops import attention
+    B, H, L, D = 1, 2, 256, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s)) for s in range(3))
+    attention.last_path = None
+    attention.flash_attention(q, k, v, causal=True)
+    assert attention.last_path == "pallas", (
+        f"dispatcher fell back to {attention.last_path!r} on a TPU backend")
+
+
+def test_flash_grad_through_custom_vjp_on_chip():
+    from mxnet_tpu.ops import attention
+    from mxnet_tpu.ops.attention import attention_reference
+    B, H, L, D = 1, 2, 256, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s)) for s in range(3))
+
+    def loss_fa(q, k, v):
+        return (attention.flash_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=True) ** 2).sum()
+
+    attention.last_path = None
+    g1 = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    assert attention.last_path == "pallas"
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=5e-2, atol=5e-2)
+
+
+def test_flash_long_context_bounded_memory():
+    """L=4096 causal attention runs on-chip — the O(L^2) score matrix
+    (64 heads x 4096^2 f32 = 4 GiB) would not fit VMEM-resident paths."""
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    B, H, L, D = 2, 8, 4096, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s), dtype=jnp.bfloat16)
+               for s in range(3))
+    out = flash_attention_tpu(q, k, v, causal=True)
+    assert out.shape == (B, H, L, D)
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_bf16_parity_dense_on_chip():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(16))
+    net.initialize()
+    net.hybridize()
+    x32 = mx.np.array(_rand((8, 64)))
+    y32 = net(x32).asnumpy()
+    y16 = onp.asarray(
+        jnp.asarray(net(x32.astype("bfloat16")).asnumpy()).astype(jnp.float32))
+    onp.testing.assert_allclose(y16, y32, rtol=5e-2, atol=5e-2)
+
+
+def test_donation_on_chip():
+    """jit with donate_argnums reuses the input buffer for the output on a
+    real device (train-step update pattern: params donated to next params)."""
+    @jax.jit
+    def probe(x):
+        return x + 1.0
+
+    upd = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+    x = jnp.ones((1024, 1024))
+    y = upd(x)
+    assert float(y[0, 0]) == 2.0
+    assert x.is_deleted()
+
+
+def test_hybridized_train_step_on_chip():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.np.array(_rand((32, 28)))
+    y = mx.np.array(onp.arange(32) % 10)
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
