@@ -90,18 +90,21 @@ def _registry(large):
 
 
 def bench_op(make, warmup=3, iters=20, backward=True):
+    from mxnet_tpu import engine
     fn, inputs = make()
     for x in inputs:
         x.attach_grad()
-    # forward timing
-    for _ in range(warmup):
-        out = fn(*inputs)
-    out.wait_to_read()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*inputs)
-    out.wait_to_read()
-    fwd_ms = (time.perf_counter() - t0) / iters * 1e3
+    # forward timing: bulk size 1 = true per-op dispatch (each op is its
+    # own cached executable, dispatched async; one sync per window)
+    with engine.bulk(1):
+        for _ in range(warmup):
+            out = fn(*inputs)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*inputs)
+        out.wait_to_read()
+        fwd_ms = (time.perf_counter() - t0) / iters * 1e3
 
     bwd_ms = None
     if backward:
@@ -110,13 +113,17 @@ def bench_op(make, warmup=3, iters=20, backward=True):
                 o = fn(*inputs)
                 loss = o.sum() if hasattr(o, "sum") else o
             loss.backward()
-            inputs[0].grad.wait_to_read()
         try:
             for _ in range(warmup):
                 run_bwd()
+            inputs[0].grad.wait_to_read()
             t0 = time.perf_counter()
             for _ in range(iters):
                 run_bwd()
+            # one sync per window (same discipline as the fwd loop): the
+            # steady-state cost of an eager fwd+bwd is the async dispatch,
+            # not a host round-trip per op
+            inputs[0].grad.wait_to_read()
             bwd_ms = (time.perf_counter() - t0) / iters * 1e3
         except Exception:
             bwd_ms = None

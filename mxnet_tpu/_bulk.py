@@ -49,8 +49,7 @@ class Unbulkable(Exception):
 class LazyArray:
     """Placeholder for an op output that has not been materialized yet."""
 
-    __slots__ = ("aval", "op", "idx", "value", "error", "holders",
-                 "__weakref__")
+    __slots__ = ("aval", "op", "idx", "value", "error", "__weakref__")
 
     def __init__(self, aval, op, idx):
         self.aval = aval
@@ -58,7 +57,6 @@ class LazyArray:
         self.idx = idx        # output position within the op
         self.value = None     # concrete jax.Array once flushed
         self.error = None     # poison: exception from a failed flush
-        self.holders = []     # weakrefs to wrapping ndarrays (liveness)
 
     @property
     def shape(self):
@@ -75,7 +73,7 @@ class LazyArray:
 
 class BulkOp:
     __slots__ = ("fn", "arg_spec", "kwarg_spec", "cell_spec", "outs",
-                 "out_is_tuple", "key")
+                 "out_is_tuple", "key", "ambients")
 
     def __init__(self, fn, arg_spec, kwarg_spec, cell_spec, outs,
                  out_is_tuple, key):
@@ -97,7 +95,40 @@ class _SegState(threading.local):
 
 _seg = _SegState()
 _cache = {}
+_aval_cache = {}  # (fn_key, arg sig, ambients) -> (out_avals, out_is_tuple)
 _stats = {"flushes": 0, "compiles": 0, "ops_bulked": 0, "eager_fallbacks": 0}
+
+# Ambient thread-local state that op functions read at EXECUTION time (e.g.
+# the AMP scope dtype).  Deferred execution would otherwise observe the
+# state at flush time instead of call time, so record_op snapshots every
+# registered ambient and the flush runner re-enters it around each op.
+# Each entry: name -> (getter, setter); the snapshot must be hashable (it
+# joins the cache key).
+_ambients = {}
+
+
+def register_ambient(name, getter, setter):
+    _ambients[name] = (getter, setter)
+
+
+def _snapshot_ambients():
+    return tuple((name, g()) for name, (g, _) in _ambients.items())
+
+
+class _AmbientScope:
+    def __init__(self, snap):
+        self.snap = snap
+        self.saved = None
+
+    def __enter__(self):
+        self.saved = [(name, _ambients[name][0]()) for name, _ in self.snap]
+        for name, v in self.snap:
+            _ambients[name][1](v)
+
+    def __exit__(self, *exc):
+        for name, v in self.saved:
+            _ambients[name][1](v)
+        return False
 
 
 def enabled():
@@ -125,7 +156,7 @@ _SCALARS = (int, float, bool, str, bytes, complex, type(None), type(Ellipsis))
 
 
 def _const_key(v, depth=0):
-    if depth > 4:
+    if depth > 10:
         raise Unbulkable("constant nesting too deep")
     if isinstance(v, _SCALARS):
         return (type(v).__name__, v)
@@ -138,6 +169,8 @@ def _const_key(v, depth=0):
     if isinstance(v, (tuple, list)):
         return (type(v).__name__,
                 tuple(_const_key(x, depth + 1) for x in v))
+    if isinstance(v, (frozenset, set)):
+        return ("set", tuple(sorted(repr(x) for x in v)))
     if isinstance(v, dict):
         return ("dict", tuple(sorted((k, _const_key(x, depth + 1))
                                      for k, x in v.items())))
@@ -153,13 +186,23 @@ def _fn_key(fn, depth=0):
     """(key, cell_spec) for a callable.  cell_spec is None when the function
     can be called as-is, else a tuple describing how to rebuild its closure
     cells (lifting device-array cells to leaf inputs)."""
-    if depth > 4:
+    if depth > 10:
         raise Unbulkable("function nesting too deep")
+    if getattr(fn, "_mx_no_bulk", False):
+        # per-call state (host callbacks, fresh custom-op instances): every
+        # call would be a cache miss, so run it eagerly instead
+        raise Unbulkable("fn marked no-bulk")
     if isinstance(fn, types.BuiltinFunctionType):
         return ("builtin", fn.__module__, fn.__qualname__), None
     if isinstance(fn, types.MethodType):
         k, _ = _fn_key(fn.__func__, depth + 1)
-        return ("method", k, id(fn.__self__)), None
+        # pin the bound object itself (identity-hashed): id()/repr() would
+        # collide when addresses are reused after GC
+        try:
+            hash(fn.__self__)
+        except TypeError:
+            raise Unbulkable("unhashable bound-method receiver")
+        return ("method", k, fn.__self__), None
     part = getattr(fn, "func", None)
     if part is not None and hasattr(fn, "args"):  # functools.partial
         k, _ = _fn_key(fn.func, depth + 1)
@@ -167,12 +210,15 @@ def _fn_key(fn, depth=0):
                 _const_key(fn.keywords or {}, depth + 1)), None
     code = getattr(fn, "__code__", None)
     if code is None:
-        # arbitrary callable object (e.g. jnp ufunc wrappers): identity is
-        # stable for module-level singletons
-        mod = getattr(fn, "__module__", "") or ""
-        if mod.startswith(("jax", "mxnet_tpu")):
-            return ("obj", mod, getattr(fn, "__name__", repr(fn))), None
-        raise Unbulkable("unkeyable callable %r" % (fn,))
+        # arbitrary callable object (jnp ufunc wrappers, custom-op
+        # instances): key by the object itself — identity-hashed AND kept
+        # alive by the cache key, so the key can never alias a new object
+        # at a recycled address
+        try:
+            hash(fn)
+        except TypeError:
+            raise Unbulkable("unhashable callable %r" % (fn,))
+        return ("obj", fn), None
     if getattr(fn, "__defaults__", None):
         for d in fn.__defaults__:
             if isinstance(d, (jax.Array, onp.ndarray)):
@@ -183,6 +229,18 @@ def _fn_key(fn, depth=0):
     lifted = False
     for c in cells:
         v = c.cell_contents
+        buf = getattr(v, "_buf", None)  # ndarray wrapper in a closure cell
+        if buf is not None and not callable(v):
+            v = buf
+        if isinstance(v, LazyArray):
+            if v.value is not None:
+                v = v.value
+            else:
+                cell_keys.append(("cellleaf", jax.ShapeDtypeStruct(
+                    v.aval.shape, v.aval.dtype)))
+                cell_spec.append(("lazycell", v))
+                lifted = True
+                continue
         if isinstance(v, jax.Array):
             cell_keys.append(("cellleaf", jax.ShapeDtypeStruct(
                 v.shape, v.dtype)))
@@ -194,11 +252,19 @@ def _fn_key(fn, depth=0):
                 av.shape, av.dtype)))
             cell_spec.append(("leaf", av))
             lifted = True
-        elif callable(v) and not isinstance(v, type):
+        elif isinstance(v, types.FunctionType):
+            # recurse: a nested closure may hold array cells of its own
+            # (hybridized blocks close over aux/param arrays) — those lift
+            # through the whole chain
             k, inner_spec = _fn_key(v, depth + 1)
-            if inner_spec is not None and any(
-                    t == "leaf" for t, _ in inner_spec):
-                raise Unbulkable("array cell in nested closure")
+            cell_keys.append(k)
+            if inner_spec is not None:
+                cell_spec.append(("fn", v, inner_spec))
+                lifted = True
+            else:
+                cell_spec.append(("const", v))
+        elif callable(v) and not isinstance(v, type):
+            k, _ = _fn_key(v, depth + 1)
             cell_keys.append(k)
             cell_spec.append(("const", v))
         else:
@@ -214,6 +280,23 @@ def _rebuild_fn(fn, cell_values):
                            fn.__defaults__, cells)
     g.__kwdefaults__ = fn.__kwdefaults__
     return g
+
+
+def _resolve_cell_spec(fn, spec, resolve_entry):
+    """Rebuild `fn` with its cell_spec resolved: array-bearing cells via
+    `resolve_entry(entry)`, ('fn', f, inner) cells recursively, constants
+    as-is."""
+    values = []
+    for entry in spec:
+        tag = entry[0]
+        if tag == "fn":
+            values.append(_resolve_cell_spec(entry[1], entry[2],
+                                             resolve_entry))
+        elif tag == "const":
+            values.append(entry[1])
+        else:  # leaf / lazycell / lazy — plan- or record-level array refs
+            values.append(resolve_entry(entry))
+    return _rebuild_fn(fn, values)
 
 
 # ---------------------------------------------------------------------------
@@ -234,15 +317,6 @@ def _spec_of(v):
     return ("const", v)
 
 
-def _spec_key(spec, op_index_of):
-    tag, v = spec
-    if tag == "lazy":
-        return ("lazy", op_index_of[id(v.op)], v.idx)
-    if tag == "leaf":
-        return ("leaf", v.shape, str(v.dtype))
-    return ("const", _const_key(v))
-
-
 def record_op(fn, args, kwargs):
     """Record `fn(*args, **kwargs)` into the current segment.  Array-valued
     args may be jax.Array, onp.ndarray or LazyArray; everything else is a
@@ -252,7 +326,6 @@ def record_op(fn, args, kwargs):
     kwarg_spec = tuple(sorted(
         (k, _spec_of(v)) for k, v in kwargs.items()))
 
-    # shape inference without executing (and bulkability check)
     def avalize(spec):
         tag, v = spec
         if tag == "const":
@@ -261,45 +334,92 @@ def record_op(fn, args, kwargs):
             return jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
         return jax.ShapeDtypeStruct(v.shape, v.dtype)
 
-    call_fn = fn
-    if cell_spec is not None:
-        # for shape inference, rebuild with the current cell values
-        call_fn = _rebuild_fn(fn, [v for _, v in cell_spec])
-    try:
-        out_avals = jax.eval_shape(
-            lambda *a: call_fn(*a[:len(arg_spec)],
-                               **dict(zip([k for k, _ in kwarg_spec],
-                                          a[len(arg_spec):]))),
-            *[avalize(s) for s in arg_spec],
-            *[avalize(s) for _, s in kwarg_spec])
-    except Unbulkable:
-        raise
-    except Exception as e:
-        raise Unbulkable("eval_shape failed: %s" % e)
+    def spec_sig(spec):
+        tag, v = spec
+        if tag == "const":
+            return ("const", _const_key(v))
+        a = avalize(spec)
+        return ("arr", a.shape, str(a.dtype))
 
-    out_is_tuple = isinstance(out_avals, (tuple, list))
-    avals = list(out_avals) if out_is_tuple else [out_avals]
-    for a in avals:
-        if not isinstance(a, jax.ShapeDtypeStruct) or any(
-                not isinstance(d, int) for d in a.shape):
-            raise Unbulkable("non-array or dynamic-shape output")
+    ambients = _snapshot_ambients()
+    try:
+        amb_key = tuple((n, _const_key(v)) for n, v in ambients)
+    except Unbulkable:
+        amb_key = tuple((n, repr(v)) for n, v in ambients)
+
+    # shape inference without executing (and bulkability check); eval_shape
+    # is pure Python tracing at ~ms per conv-sized op, so it is cached on
+    # the same structural identity the executable cache uses
+    aval_key = (fn_key, tuple(spec_sig(s) for s in arg_spec),
+                tuple((k, spec_sig(s)) for k, s in kwarg_spec), amb_key)
+    cached = _aval_cache.get(aval_key)
+    if cached is not None:
+        avals, out_is_tuple = cached
+    else:
+        call_fn = fn
+        if cell_spec is not None:
+            # for shape inference, rebuild with the current cell values; a
+            # still-pending lazy cell stands in as zeros of its aval (the
+            # inference result is cached on structure, not values)
+            def _record_cell(entry):
+                if entry[0] == "lazycell":
+                    a = entry[1].aval
+                    return jnp.zeros(a.shape, a.dtype)
+                return entry[1]
+            call_fn = _resolve_cell_spec(fn, cell_spec, _record_cell)
+
+        # only array args go through eval_shape (it abstracts EVERY leaf,
+        # so a constant like axis=1 or clip=-1.0 would become a tracer and
+        # break ops that branch on it); constants are closed over
+        arr_arg_idx = [i for i, s in enumerate(arg_spec) if s[0] != "const"]
+        arr_kw_keys = [k for k, s in kwarg_spec if s[0] != "const"]
+
+        def shell(*arrs):
+            it = iter(arrs)
+            full_args = [next(it) if s[0] != "const" else s[1]
+                         for s in arg_spec]
+            full_kw = {k: (next(it) if s[0] != "const" else s[1])
+                       for k, s in kwarg_spec}
+            return call_fn(*full_args, **full_kw)
+
+        try:
+            out_avals = jax.eval_shape(
+                shell,
+                *[avalize(arg_spec[i]) for i in arr_arg_idx],
+                *[avalize(dict(kwarg_spec)[k]) for k in arr_kw_keys])
+        except Unbulkable:
+            raise
+        except Exception as e:
+            raise Unbulkable("eval_shape failed: %s" % e)
+
+        out_is_tuple = isinstance(out_avals, (tuple, list))
+        avals = list(out_avals) if out_is_tuple else [out_avals]
+        for a in avals:
+            if not isinstance(a, jax.ShapeDtypeStruct) or any(
+                    not isinstance(d, int) for d in a.shape):
+                raise Unbulkable("non-array or dynamic-shape output")
+            if a.dtype == jax.dtypes.float0:
+                raise Unbulkable("float0 output (int-input VJP); run eagerly")
+        _aval_cache[aval_key] = (avals, out_is_tuple)
 
     op = BulkOp(fn, arg_spec, kwarg_spec, cell_spec, [], out_is_tuple, None)
+    op.ambients = ambients
     op.outs = [LazyArray(a, op, i) for i, a in enumerate(avals)]
     op.key = (fn_key,
               tuple(("kw", k) for k, _ in kwarg_spec),
-              len(avals), out_is_tuple)
+              len(avals), out_is_tuple, amb_key)
     _seg.ops.append(op)
     _stats["ops_bulked"] += 1
+    outs = list(op.outs)  # before a limit-flush clears op.outs
     if len(_seg.ops) >= _seg.limit:
         flush()
-    return op.outs, out_is_tuple
+    return outs, out_is_tuple
 
 
 def note_holder(lazy, nd):
-    """Register an ndarray as an external holder of `lazy` (liveness for
-    flush outputs)."""
-    lazy.holders.append(weakref.ref(nd))
+    """Kept for call-site compatibility: liveness tracking was removed from
+    the flush plan (GC-timing-dependent keys caused recompiles), so holding
+    is implicit — every output is materialized at flush."""
 
 
 def note_eager_fallback():
@@ -309,15 +429,6 @@ def note_eager_fallback():
 # ---------------------------------------------------------------------------
 # flush: compile + run the pending segment
 # ---------------------------------------------------------------------------
-def _live(lazy):
-    if lazy.value is not None:
-        return False  # already materialized
-    for r in lazy.holders:
-        if r() is not None:
-            return True
-    return False
-
-
 def flush():
     """Materialize every pending op in the current segment with one compiled
     executable (structure-cached)."""
@@ -381,32 +492,65 @@ def _flush_ops(ops):
                 kwplan.append((k, ("leaf", slot_of(v))))
             else:
                 kwplan.append((k, ("const", v)))
+        def plan_cells(spec):
+            plan = []
+            for entry in spec:
+                if entry[0] == "leaf":
+                    plan.append(("leaf", slot_of(entry[1])))
+                elif entry[0] == "lazycell":
+                    lz = entry[1]
+                    if lz.value is not None:
+                        plan.append(("leaf", slot_of(lz.value)))
+                    else:
+                        plan.append(("lazy", op_index_of[id(lz.op)], lz.idx))
+                elif entry[0] == "fn":
+                    plan.append(("fn", entry[1], plan_cells(entry[2])))
+                else:
+                    plan.append(("const", entry[1]))
+            return tuple(plan)
+
         cellplan = None
         if op.cell_spec is not None:
-            cellplan = []
-            for tag, v in op.cell_spec:
-                if tag == "leaf":
-                    cellplan.append(("leaf", slot_of(v)))
-                else:
-                    cellplan.append(("const", v))
-        live_flags = tuple(_live(o) for o in op.outs)
+            cellplan = plan_cells(op.cell_spec)
+        # NOTE: output liveness (is any ndarray still holding this lazy?)
+        # deliberately does NOT join the plan or the key — it depends on GC
+        # timing, and a nondeterministic key would recompile the same
+        # segment over and over.  Every op output is returned; dead ones
+        # are freed as soon as their LazyArray goes out of scope.
         op_plans.append((op.fn, tuple(argplan), tuple(kwplan),
-                         tuple(cellplan) if cellplan is not None else None,
-                         len(op.outs), op.out_is_tuple, live_flags))
+                         cellplan,
+                         len(op.outs), op.out_is_tuple,
+                         op.ambients))
+        def plan_key(p):
+            if p[0] == "leaf":
+                return ("leaf",)
+            if p[0] == "const":
+                return ("const", _const_key(p[1]))  # raw value may be a list
+            return p
         key_parts.append((
             op.key,
-            tuple(p if p[0] != "leaf" else ("leaf",) for p in argplan),
-            tuple((k, p if p[0] != "leaf" else ("leaf",)) for k, p in kwplan),
-            live_flags))
+            tuple(plan_key(p) for p in argplan),
+            tuple((k, plan_key(p)) for k, p in kwplan)))
 
     leaf_avals = tuple((a.shape, str(a.dtype)) for a in leaves)
+
+    def cell_slots(plan):
+        out = []
+        for c in plan:
+            if c[0] == "leaf":
+                out.append(c[1])
+            elif c[0] == "lazy":
+                out.append(("lz", c[1], c[2]))
+            elif c[0] == "fn":
+                out.extend(cell_slots(c[2]))
+        return out
+
     # leaf slots appear positionally inside argplans, so the structural key
     # must record WHICH slot each leaf reference uses
     slot_sig = tuple(
         tuple((p[1] if p[0] == "leaf" else -1) for p in plan[1]) +
         tuple((p[1][1] if p[1][0] == "leaf" else -1) for p in plan[2]) +
-        (tuple((c[1] if c[0] == "leaf" else -1) for c in plan[3])
-         if plan[3] is not None else ())
+        (tuple(cell_slots(plan[3])) if plan[3] is not None else ())
         for plan in op_plans)
     cache_key = (tuple(key_parts), slot_sig, leaf_avals)
 
@@ -418,7 +562,7 @@ def _flush_ops(ops):
             results = []
             out_list = []
             for (fn, argplan, kwplan, cellplan, nout, is_tup,
-                 live_flags) in op_plans:
+                 ambients) in op_plans:
                 def resolve(p):
                     if p[0] == "leaf":
                         return leaf_vals[p[1]]
@@ -428,14 +572,13 @@ def _flush_ops(ops):
                     return p[1]
                 f = fn
                 if cellplan is not None:
-                    f = _rebuild_fn(fn, [resolve(c) for c in cellplan])
-                out = f(*[resolve(p) for p in argplan],
-                        **{k: resolve(p) for k, p in kwplan})
+                    f = _resolve_cell_spec(fn, cellplan, resolve)
+                with _AmbientScope(ambients):
+                    out = f(*[resolve(p) for p in argplan],
+                            **{k: resolve(p) for k, p in kwplan})
                 outs = list(out) if is_tup else [out]
                 results.append(outs)
-                for o, lf in zip(outs, live_flags):
-                    if lf:
-                        out_list.append(o)
+                out_list.extend(outs)
             return out_list
 
         entry = jax.jit(run)
@@ -444,15 +587,18 @@ def _flush_ops(ops):
     out_vals = entry(leaves)
     it = iter(out_vals)
     from .ndarray import _track
-    for op, plan in zip(ops, op_plans):
-        live_flags = plan[6]
-        for o, lf in zip(op.outs, live_flags):
-            if lf:
-                o.value = next(it)
-                _track(o.value)
-            else:
-                o.error = RuntimeError(
-                    "internal: dead lazy array materialized after flush")
+    for op in ops:
+        for o in op.outs:
+            o.value = next(it)
+            o.op = None   # break the ref chain: a live LazyArray must not
+            o.idx = -1    # pin its op's input buffers after materialization
+        op.arg_spec = op.kwarg_spec = op.cell_spec = None
+        op.outs = ()
+    # one tracked buffer per flush suffices for waitall() completeness:
+    # all outputs ride the same executable, so observing the last output
+    # ready implies the whole segment ran (single-program semantics)
+    if out_vals:
+        _track(out_vals[-1])
 
 
 def materialize(lazy):

@@ -120,6 +120,29 @@ class TapeNode:
         self.fn = fn
 
 
+def _make_replay(node_fn, out_shapes, out_dtypes, out_is_tuple, n_in,
+                 in_float):
+    """Build the VJP-replay closure for one tape node: recomputes the
+    forward under jax.vjp and applies the cotangents (float outputs get the
+    provided cts, integer outputs float0 zeros).  Returns only the grads of
+    float-dtype inputs (`in_float` mask): integer-input grads are float0,
+    which cannot ride through a bulked segment — the caller re-slots the
+    outputs by the same static mask."""
+    def replay(*vals):
+        prim = vals[:n_in]
+        cts_in = list(vals[n_in:])
+        cts = []
+        for shape, dt in zip(out_shapes, out_dtypes):
+            if onp.dtype(dt).kind in "fc":
+                cts.append(cts_in.pop(0))
+            else:
+                cts.append(onp.zeros(shape, jax.dtypes.float0))
+        ct = tuple(cts) if out_is_tuple else cts[0]
+        grads = jax.vjp(node_fn, *prim)[1](ct)
+        return tuple(g for g, f in zip(grads, in_float) if f)
+    return replay
+
+
 def _zero_cotangent(shape, dtype):
     dt = onp.dtype(dtype)
     if dt.kind in "fc":
@@ -129,7 +152,7 @@ def _zero_cotangent(shape, dtype):
 
 
 def _is_float0(x):
-    d = getattr(x, "_data", x)
+    d = getattr(x, "_buf", x)  # _buf: metadata peek, never materializes
     return getattr(d, "dtype", None) == jax.dtypes.float0
 
 
@@ -233,37 +256,41 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             if g is None:
                 g = _zero_cotangent(n.out_shapes[i], n.out_dtypes[i])
             full.append(g)
-        if replay_mode and n.fn is not None:
+        # replay is used when recording higher-order grads (create_graph)
+        # AND for bulk-recorded nodes whose VJP was deferred (vjp_fn=None):
+        # the backward computation then records into the bulk segment too,
+        # so one compiled program covers the whole fwd+bwd step
+        if n.fn is not None and (replay_mode or n.vjp_fn is None):
             # recorded replay: grads connect to the tape through n.inputs
             float_cts = []
             for g, dt in zip(full, n.out_dtypes):
                 if onp.dtype(dt).kind in "fc":
                     float_cts.append(g if isinstance(g, ndarray) else _wrap(g))
-            node_fn = n.fn
-            out_shapes, out_dtypes = n.out_shapes, n.out_dtypes
-            out_is_tuple, n_in = n.out_is_tuple, len(n.inputs)
+            # factory, NOT an inline def: execution is deferred to the bulk
+            # flush, so the closure must own its per-node cells (an inline
+            # def would share `backward`'s loop-rebound locals)
+            in_float = tuple(onp.dtype(i.dtype).kind in "fc"
+                             for i in n.inputs)
+            replay = _make_replay(n.fn, n.out_shapes, n.out_dtypes,
+                                  n.out_is_tuple, len(n.inputs), in_float)
 
-            def replay(*vals):
-                prim = vals[:n_in]
-                cts_in = list(vals[n_in:])
-                cts = []
-                for shape, dt in zip(out_shapes, out_dtypes):
-                    if onp.dtype(dt).kind in "fc":
-                        cts.append(cts_in.pop(0))
-                    else:
-                        cts.append(onp.zeros(shape, jax.dtypes.float0))
-                ct = tuple(cts) if out_is_tuple else cts[0]
-                return jax.vjp(node_fn, *prim)[1](ct)
-
-            in_grads = apply_op(replay, *(list(n.inputs) + float_cts))
-            if not isinstance(in_grads, (list, tuple)):
-                in_grads = [in_grads]
+            if replay_mode:
+                flt_grads = apply_op(replay, *(list(n.inputs) + float_cts))
+            else:
+                with pause():
+                    flt_grads = apply_op(replay,
+                                         *(list(n.inputs) + float_cts))
+            if not isinstance(flt_grads, (list, tuple)):
+                flt_grads = [flt_grads]
+            # re-slot by the static mask: int/bool inputs take no gradient
+            flt_iter = iter(flt_grads)
+            in_grads = [next(flt_iter) if f else None for f in in_float]
         else:
             raw = [g._data if isinstance(g, ndarray) else g for g in full]
             ct = tuple(raw) if n.out_is_tuple else raw[0]
             in_grads = n.vjp_fn(ct)
         for inp, g in zip(n.inputs, in_grads):
-            if _is_float0(g):
+            if g is None or _is_float0(g):
                 continue
             if inp._node is not None:
                 islot = cots.get(id(inp._node))
@@ -275,6 +302,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                 _accum_leaf(inp, g)
         if not retain_graph and not replay_mode:
             n.vjp_fn = None  # free residuals eagerly
+            n.fn = None      # deferred-VJP nodes: drop the replay closure too
 
     # ---- write results into .grad per grad_req --------------------------
     from .ndarray import _wrap_value
@@ -303,6 +331,16 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     if not retain_graph:
         for h in heads:
             h._node = None
+
+    # deterministic bulk boundary: the tape walk is complete, so dispatch
+    # the whole fwd+bwd segment as one program NOW.  Without a stable
+    # boundary the op-count limit would cut segments at arbitrary offsets
+    # across steps, minting a new executable signature every few steps.
+    # (The optimizer update deliberately stays a SEPARATE program: merging
+    # it kept fwd residuals + both param copies live in one program and
+    # OOMed HBM on ResNet-50-sized models.)
+    from . import _bulk
+    _bulk.flush()
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

@@ -221,11 +221,20 @@ def default_engine():
 
 @contextlib.contextmanager
 def bulk(size):
-    """Parity: mx.engine.bulk (python/mxnet/engine.py). Under XLA, op
-    coalescing happens at jit/hybridize time; eager ops are individually
-    async — the scope is accepted for API compatibility."""
-    yield
+    """Parity: mx.engine.bulk (python/mxnet/engine.py) — scope-bounded op
+    coalescing.  Eager ops inside the scope join the deferred micro-trace
+    segment (_bulk.py) up to `size` ops per compiled flush; on exit the
+    pending segment is flushed so the scope's work is dispatched."""
+    from . import _bulk
+    prev = _bulk.set_bulk_size(size)
+    try:
+        yield
+    finally:
+        _bulk.set_bulk_size(prev)
+        _bulk.flush()
 
 
 def set_bulk_size(size):
-    return 0
+    """Parity: mx.engine.set_bulk_size — returns the previous limit."""
+    from . import _bulk
+    return _bulk.set_bulk_size(size)

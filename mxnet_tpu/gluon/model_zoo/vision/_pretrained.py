@@ -1,0 +1,13 @@
+"""Shared pretrained-weight loading for the vision zoo factories.
+
+Reference flow (`python/mxnet/gluon/model_zoo/vision/*.py`): every factory
+accepts ``pretrained=True, ctx=..., root=...`` and calls
+``net.load_parameters(get_model_file(name, root), ctx)``.  Here the store
+is the offline hash-checked store (``model_store.publish`` seeds it)."""
+from __future__ import annotations
+
+
+def load_pretrained(net, name, root=None, ctx=None):
+    from ..model_store import get_model_file
+    net.load_parameters(get_model_file(name, root=root), ctx=ctx)
+    return net

@@ -35,7 +35,9 @@ class AlexNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def alexnet(pretrained=False, **kwargs):
+def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    net = AlexNet(**kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable offline")
-    return AlexNet(**kwargs)
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "alexnet", root=root, ctx=ctx)
+    return net

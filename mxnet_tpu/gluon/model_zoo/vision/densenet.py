@@ -86,17 +86,33 @@ def _get(num_layers, **kwargs):
     return DenseNet(init_f, growth, cfg, **kwargs)
 
 
-def densenet121(**kw):
-    return _get(121, **kw)
+def densenet121(pretrained=False, ctx=None, root=None, **kw):
+    net = _get(121, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "densenet121", root=root, ctx=ctx)
+    return net
 
 
-def densenet161(**kw):
-    return _get(161, **kw)
+def densenet161(pretrained=False, ctx=None, root=None, **kw):
+    net = _get(161, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "densenet161", root=root, ctx=ctx)
+    return net
 
 
-def densenet169(**kw):
-    return _get(169, **kw)
+def densenet169(pretrained=False, ctx=None, root=None, **kw):
+    net = _get(169, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "densenet169", root=root, ctx=ctx)
+    return net
 
 
-def densenet201(**kw):
-    return _get(201, **kw)
+def densenet201(pretrained=False, ctx=None, root=None, **kw):
+    net = _get(201, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "densenet201", root=root, ctx=ctx)
+    return net

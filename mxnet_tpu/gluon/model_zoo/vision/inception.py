@@ -145,7 +145,9 @@ class Inception3(HybridBlock):
         return self.output(self.features(x))
 
 
-def inception_v3(pretrained=False, **kwargs):
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    net = Inception3(**kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable offline")
-    return Inception3(**kwargs)
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "inceptionv3", root=root, ctx=ctx)
+    return net

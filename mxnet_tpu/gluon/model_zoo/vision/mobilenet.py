@@ -91,33 +91,65 @@ class MobileNetV2(HybridBlock):
         return self.output(self.features(x))
 
 
-def mobilenet1_0(**kw):
-    return MobileNet(1.0, **kw)
+def mobilenet1_0(pretrained=False, ctx=None, root=None, **kw):
+    net = MobileNet(1.0, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "mobilenet1.0", root=root, ctx=ctx)
+    return net
 
 
-def mobilenet0_75(**kw):
-    return MobileNet(0.75, **kw)
+def mobilenet0_75(pretrained=False, ctx=None, root=None, **kw):
+    net = MobileNet(0.75, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "mobilenet0.75", root=root, ctx=ctx)
+    return net
 
 
-def mobilenet0_5(**kw):
-    return MobileNet(0.5, **kw)
+def mobilenet0_5(pretrained=False, ctx=None, root=None, **kw):
+    net = MobileNet(0.5, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "mobilenet0.5", root=root, ctx=ctx)
+    return net
 
 
-def mobilenet0_25(**kw):
-    return MobileNet(0.25, **kw)
+def mobilenet0_25(pretrained=False, ctx=None, root=None, **kw):
+    net = MobileNet(0.25, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "mobilenet0.25", root=root, ctx=ctx)
+    return net
 
 
-def mobilenet_v2_1_0(**kw):
-    return MobileNetV2(1.0, **kw)
+def mobilenet_v2_1_0(pretrained=False, ctx=None, root=None, **kw):
+    net = MobileNetV2(1.0, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "mobilenetv2_1.0", root=root, ctx=ctx)
+    return net
 
 
-def mobilenet_v2_0_75(**kw):
-    return MobileNetV2(0.75, **kw)
+def mobilenet_v2_0_75(pretrained=False, ctx=None, root=None, **kw):
+    net = MobileNetV2(0.75, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "mobilenetv2_0.75", root=root, ctx=ctx)
+    return net
 
 
-def mobilenet_v2_0_5(**kw):
-    return MobileNetV2(0.5, **kw)
+def mobilenet_v2_0_5(pretrained=False, ctx=None, root=None, **kw):
+    net = MobileNetV2(0.5, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "mobilenetv2_0.5", root=root, ctx=ctx)
+    return net
 
 
-def mobilenet_v2_0_25(**kw):
-    return MobileNetV2(0.25, **kw)
+def mobilenet_v2_0_25(pretrained=False, ctx=None, root=None, **kw):
+    net = MobileNetV2(0.25, **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "mobilenetv2_0.25", root=root, ctx=ctx)
+    return net

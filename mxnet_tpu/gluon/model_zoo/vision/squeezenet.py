@@ -74,9 +74,17 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(**kw):
-    return SqueezeNet("1.0", **kw)
+def squeezenet1_0(pretrained=False, ctx=None, root=None, **kw):
+    net = SqueezeNet("1.0", **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "squeezenet1.0", root=root, ctx=ctx)
+    return net
 
 
-def squeezenet1_1(**kw):
-    return SqueezeNet("1.1", **kw)
+def squeezenet1_1(pretrained=False, ctx=None, root=None, **kw):
+    net = SqueezeNet("1.1", **kw)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        load_pretrained(net, "squeezenet1.1", root=root, ctx=ctx)
+    return net

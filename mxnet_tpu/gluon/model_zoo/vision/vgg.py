@@ -38,11 +38,15 @@ class VGG(HybridBlock):
         return self.output(self.features(x))
 
 
-def get_vgg(num_layers, pretrained=False, **kwargs):
-    if pretrained:
-        raise RuntimeError("pretrained weights unavailable offline")
+def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ._pretrained import load_pretrained
+        batch_norm = kwargs.get("batch_norm", False)
+        name = "vgg%d%s" % (num_layers, "_bn" if batch_norm else "")
+        load_pretrained(net, name, root=root, ctx=ctx)
+    return net
 
 
 def vgg11(**kwargs):

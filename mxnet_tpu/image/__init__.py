@@ -349,3 +349,17 @@ class ImageIter(DataIter):
         return DataBatch([nd_array(onp.stack(imgs))],
                          [nd_array(onp.asarray(labels, onp.float32))],
                          pad, None)
+
+
+# detection pipeline (reference python/mxnet/image/detection.py); imported
+# last to avoid a partial-module cycle (detection borrows the augmenters
+# defined above)
+from . import detection  # noqa: E402
+from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,  # noqa: E402,F401
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateMultiRandCropAugmenter,
+                        CreateDetAugmenter, ImageDetIter)
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+            "ImageDetIter"]

@@ -1,0 +1,477 @@
+"""mx.image detection pipeline: ImageDetIter + detection augmenters.
+
+Parity: reference `python/mxnet/image/detection.py:1` (DetAugmenter class
+tree, CreateDetAugmenter/CreateMultiRandCropAugmenter, ImageDetIter) and
+the det-recordio path `src/io/iter_image_det_recordio.cc:1` (multi-object
+labels packed in recordio headers).  Geometry transforms keep the boxes
+consistent with the pixels: crops clip + filter boxes by coverage, pads
+rescale coordinates, flips mirror x-ranges.
+
+Label wire format (reference convention): a flat vector
+``[A, B, <extra header...>, obj0..., obj1..., ...]`` where ``A`` is the
+header length (>= 2), ``B`` the per-object width (>= 5) and each object is
+``[cls_id, xmin, ymin, xmax, ymax, <extra...>]`` with coordinates
+normalized to [0, 1].  ImageDetIter parses/pads this into a dense
+``(batch, max_objects, B)`` label array, padding rows with cls_id = -1.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random as pyrandom
+
+import numpy as onp
+
+from ..ndarray import ndarray, array as nd_array
+from .. import recordio as _recordio
+from ..io import DataBatch, DataDesc
+from . import (Augmenter, CastAug, ColorNormalizeAug, BrightnessJitterAug,
+               ContrastJitterAug, SaturationJitterAug, ResizeAug,
+               ForceResizeAug, ImageIter, imresize, fixed_crop)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+class DetAugmenter(object):
+    """Detection augmenter base: transforms (image, boxes) jointly
+    (reference detection.py:40)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src, label):
+        """src: HWC image ndarray; label: (N, >=5) numpy array of
+        [cls, xmin, ymin, xmax, ymax, ...] normalized coords."""
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Borrow a plain image Augmenter (color jitter, cast, normalize —
+    anything that does not move pixels around) for detection
+    (reference detection.py:66)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise TypeError("needs an image Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one augmenter from a list to apply, or skip
+    entirely (reference detection.py:91)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror the image and the x-extents of every box
+    (reference detection.py:127)."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = nd_array(src.asnumpy()[:, ::-1].copy())
+            label = label.copy()
+            tmp = 1.0 - label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = tmp
+        return src, label
+
+
+def _box_iou_1d(crop, boxes):
+    """IOU of `crop` (x1,y1,x2,y2) against each box row."""
+    ix1 = onp.maximum(crop[0], boxes[:, 0])
+    iy1 = onp.maximum(crop[1], boxes[:, 1])
+    ix2 = onp.minimum(crop[2], boxes[:, 2])
+    iy2 = onp.minimum(crop[3], boxes[:, 3])
+    iw = onp.maximum(0.0, ix2 - ix1)
+    ih = onp.maximum(0.0, iy2 - iy1)
+    inter = iw * ih
+    area_c = (crop[2] - crop[0]) * (crop[3] - crop[1])
+    area_b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = area_c + area_b - inter
+    return onp.where(union > 0, inter / onp.maximum(union, 1e-12), 0.0)
+
+
+def _coverage(crop, boxes):
+    """Fraction of each box's area inside `crop`."""
+    ix1 = onp.maximum(crop[0], boxes[:, 0])
+    iy1 = onp.maximum(crop[1], boxes[:, 1])
+    ix2 = onp.minimum(crop[2], boxes[:, 2])
+    iy2 = onp.minimum(crop[3], boxes[:, 3])
+    inter = onp.maximum(0.0, ix2 - ix1) * onp.maximum(0.0, iy2 - iy1)
+    area = onp.maximum((boxes[:, 2] - boxes[:, 0]) *
+                       (boxes[:, 3] - boxes[:, 1]), 1e-12)
+    return inter / area
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained by box coverage / aspect ratio; boxes are
+    re-normalized to the crop, clipped, and dropped when their center (or
+    too little area) is left inside (reference detection.py:153)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = area_range[1] > area_range[0]
+
+    def _propose(self):
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            w = min(1.0, (area * ratio) ** 0.5)
+            h = min(1.0, (area / ratio) ** 0.5)
+            x = pyrandom.uniform(0.0, 1.0 - w)
+            y = pyrandom.uniform(0.0, 1.0 - h)
+            yield onp.array([x, y, x + w, y + h])
+
+    def _update_labels(self, label, crop):
+        """Re-express boxes in crop coordinates; None if no box survives."""
+        boxes = label[:, 1:5]
+        cov = _coverage(crop, boxes)
+        cx = (boxes[:, 0] + boxes[:, 2]) / 2
+        cy = (boxes[:, 1] + boxes[:, 3]) / 2
+        center_in = ((cx >= crop[0]) & (cx <= crop[2]) &
+                     (cy >= crop[1]) & (cy <= crop[3]))
+        keep = center_in | (cov >= self.min_eject_coverage)
+        if not keep.any():
+            return None
+        out = label[keep].copy()
+        w = crop[2] - crop[0]
+        h = crop[3] - crop[1]
+        out[:, 1] = onp.clip((out[:, 1] - crop[0]) / w, 0.0, 1.0)
+        out[:, 3] = onp.clip((out[:, 3] - crop[0]) / w, 0.0, 1.0)
+        out[:, 2] = onp.clip((out[:, 2] - crop[1]) / h, 0.0, 1.0)
+        out[:, 4] = onp.clip((out[:, 4] - crop[1]) / h, 0.0, 1.0)
+        return out
+
+    def __call__(self, src, label):
+        if not self.enabled or label.shape[0] == 0:
+            return src, label
+        boxes = label[:, 1:5]
+        for crop in self._propose():
+            iou = _box_iou_1d(crop, boxes)
+            if iou.size and iou.max() < self.min_object_covered:
+                continue
+            new_label = self._update_labels(label, crop)
+            if new_label is None:
+                continue
+            a = src.asnumpy()
+            H, W = a.shape[0], a.shape[1]
+            x0 = int(round(crop[0] * W))
+            y0 = int(round(crop[1] * H))
+            x1 = max(x0 + 1, int(round(crop[2] * W)))
+            y1 = max(y0 + 1, int(round(crop[3] * H)))
+            return nd_array(a[y0:y1, x0:x1].copy()), new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion pad: place the image on a larger canvas and shrink
+    the boxes into it (reference detection.py:324)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+        self.enabled = area_range[1] > 1.0
+
+    def __call__(self, src, label):
+        if not self.enabled:
+            return src, label
+        a = src.asnumpy()
+        H, W = a.shape[0], a.shape[1]
+        for _ in range(self.max_attempts):
+            scale = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            new_w = int(round(W * (scale * ratio) ** 0.5))
+            new_h = int(round(H * (scale / ratio) ** 0.5))
+            if new_w < W or new_h < H:
+                continue
+            x0 = pyrandom.randint(0, new_w - W)
+            y0 = pyrandom.randint(0, new_h - H)
+            canvas = onp.empty((new_h, new_w, a.shape[2]), a.dtype)
+            canvas[:] = onp.asarray(self.pad_val, a.dtype)[:a.shape[2]]
+            canvas[y0:y0 + H, x0:x0 + W] = a
+            out = label.copy()
+            out[:, 1] = (out[:, 1] * W + x0) / new_w
+            out[:, 3] = (out[:, 3] * W + x0) / new_w
+            out[:, 2] = (out[:, 2] * H + y0) / new_h
+            out[:, 4] = (out[:, 4] * H + y0) / new_h
+            return nd_array(canvas), out
+        return src, label
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0):
+    """One DetRandomSelectAug over a set of crop constraints — each scalar
+    argument may be a list, all broadcast to the longest
+    (reference detection.py:418)."""
+    mocs = min_object_covered if isinstance(min_object_covered, (list, tuple)) \
+        else [min_object_covered]
+    arrs = aspect_ratio_range if isinstance(aspect_ratio_range[0],
+                                            (list, tuple)) \
+        else [aspect_ratio_range]
+    ars = area_range if isinstance(area_range[0], (list, tuple)) \
+        else [area_range]
+    mecs = min_eject_coverage if isinstance(min_eject_coverage,
+                                            (list, tuple)) \
+        else [min_eject_coverage]
+    mats = max_attempts if isinstance(max_attempts, (list, tuple)) \
+        else [max_attempts]
+    n = max(len(mocs), len(arrs), len(ars), len(mecs), len(mats))
+
+    def pick(lst, i):
+        return lst[i] if i < len(lst) else lst[-1]
+
+    crops = [DetRandomCropAug(pick(mocs, i), pick(arrs, i), pick(ars, i),
+                              pick(mecs, i), pick(mats, i))
+             for i in range(n)]
+    return DetRandomSelectAug(crops, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Detection augmenter pipeline factory (reference detection.py:483)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(1.0, area_range[1])), min_eject_coverage,
+            max_attempts, skip_prob=1.0 - rand_crop)
+        auglist.append(crop)
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])), max_attempts,
+                              pad_val)
+        auglist.append(DetRandomSelectAug([pad], skip_prob=1.0 - rand_pad))
+    # force the final shape AFTER geometry so boxes stay aligned
+    auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2],
+                                                data_shape[1]),
+                                               inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness:
+        auglist.append(DetBorrowAug(BrightnessJitterAug(brightness)))
+    if contrast:
+        auglist.append(DetBorrowAug(ContrastJitterAug(contrast)))
+    if saturation:
+        auglist.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(
+            mean, std if std is not None else onp.ones(3))))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: multi-object labels ride with the images and
+    flow through the joint (image, boxes) augmenters
+    (reference detection.py:625 + src/io/iter_image_det_recordio.cc)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="label", **kwargs):
+        det_kwargs = {}
+        for k in ("resize", "rand_crop", "rand_pad", "rand_mirror", "mean",
+                  "std", "brightness", "contrast", "saturation",
+                  "min_object_covered", "area_range"):
+            if k in kwargs:
+                det_kwargs[k] = kwargs.pop(k)
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **det_kwargs)
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle,
+                         aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name)
+        self.det_auglist = aug_list
+        self.label_name = label_name
+        # first pass: establish the padded label shape
+        self._label_shape = self._infer_label_shape()
+
+    # -- label parsing ------------------------------------------------------
+    @staticmethod
+    def _parse_label(raw):
+        """Flat header+objects vector -> (N, B) float array
+        (reference ImageDetIter._parse_label)."""
+        raw = onp.asarray(raw, onp.float32).ravel()
+        if raw.size < 7:
+            raise ValueError("label too short for a detection header: %r"
+                             % (raw,))
+        A = int(raw[0])
+        B = int(raw[1])
+        if A < 2 or B < 5:
+            raise ValueError("invalid det header A=%d B=%d" % (A, B))
+        body = raw[A:]
+        n = body.size // B
+        return body[:n * B].reshape(n, B).copy()
+
+    def _infer_label_shape(self):
+        """One pass over the LABELS only — recordio headers unpack without
+        decoding the image payload (src/io/iter_image_det_recordio.cc does
+        the same header-only scan for label width)."""
+        max_objs, width = 0, 5
+        n = len(self._recs) if self._recs is not None else len(self._list)
+        for idx in range(n):
+            if self._recs is not None:
+                self._reader.seek(self._recs[idx])
+                header, _payload = _recordio.unpack(self._reader.read())
+                raw = onp.asarray(header.label, onp.float32)
+            else:
+                raw = onp.asarray(self._list[idx][0], onp.float32)
+            lab = self._parse_label(raw)
+            max_objs = max(max_objs, lab.shape[0])
+            width = max(width, lab.shape[1])
+        if max_objs == 0:
+            raise ValueError("no objects found in the dataset")
+        return (max_objs, width)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self._label_shape)]
+
+    def _read_det_example(self, idx):
+        if self._recs is not None:
+            self._reader.seek(self._recs[idx])
+            header, img = _recordio.unpack_img(self._reader.read())
+            return nd_array(img), onp.asarray(header.label, onp.float32)
+        raw, path = self._list[idx]
+        from . import imread
+        return imread(path), onp.asarray(raw, onp.float32)
+
+    def sync_label_shape(self, it, verbose=False):
+        """Make two iterators (train/val) agree on the padded label shape
+        (reference ImageDetIter.sync_label_shape)."""
+        if not isinstance(it, ImageDetIter):
+            raise TypeError("expected ImageDetIter")
+        shape = (max(self._label_shape[0], it._label_shape[0]),
+                 max(self._label_shape[1], it._label_shape[1]))
+        self._label_shape = shape
+        it._label_shape = shape
+        return it
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+        if label_shape is not None:
+            self._label_shape = tuple(label_shape)
+
+    def next(self):
+        c, h, w = self.data_shape
+        max_objs, width = self._label_shape
+        imgs, labels = [], []
+        n = len(self._recs) if self._recs is not None else len(self._list)
+        while len(imgs) < self.batch_size and self._cursor < n:
+            idx = self._order[self._cursor]
+            self._cursor += 1
+            img, raw = self._read_det_example(idx)
+            label = self._parse_label(raw)
+            for aug in self.det_auglist:
+                img, label = aug(img, label)
+            a = img.asnumpy()
+            if a.shape[:2] != (h, w):
+                img = imresize(nd_array(a), w, h)
+                a = img.asnumpy()
+            a = a.astype(onp.float32)
+            imgs.append(a.transpose(2, 0, 1))
+            padded = onp.full((max_objs, width), -1.0, onp.float32)
+            k = min(label.shape[0], max_objs)
+            padded[:k, :label.shape[1]] = label[:k]
+            labels.append(padded)
+        if not imgs:
+            raise StopIteration
+        pad = self.batch_size - len(imgs)
+        while len(imgs) < self.batch_size:  # pad the tail batch
+            imgs.append(imgs[-1])
+            labels.append(labels[-1])
+        return DataBatch(
+            data=[nd_array(onp.stack(imgs))],
+            label=[nd_array(onp.stack(labels))],
+            pad=pad)
+
+    def draw_next(self, color=None, thickness=2, waitKey=None,
+                  window_name="draw_next"):
+        """Debug visualization generator: yields images with boxes drawn
+        (reference ImageDetIter.draw_next; rectangle fill via numpy, no
+        cv2 dependency needed)."""
+        n = len(self._recs) if self._recs is not None else len(self._list)
+        while self._cursor < n:
+            idx = self._order[self._cursor]
+            self._cursor += 1
+            img, raw = self._read_det_example(idx)
+            label = self._parse_label(raw)
+            for aug in self.det_auglist:
+                img, label = aug(img, label)
+            a = img.asnumpy().astype(onp.uint8).copy()
+            H, W = a.shape[0], a.shape[1]
+            col = onp.asarray(color if color is not None else (0, 255, 0),
+                              onp.uint8)
+            t = thickness
+            for row in label:
+                x0 = int(onp.clip(row[1] * W, 0, W - 1))
+                y0 = int(onp.clip(row[2] * H, 0, H - 1))
+                x1 = int(onp.clip(row[3] * W, 0, W - 1))
+                y1 = int(onp.clip(row[4] * H, 0, H - 1))
+                a[y0:y0 + t, x0:x1] = col
+                a[max(0, y1 - t):y1, x0:x1] = col
+                a[y0:y1, x0:x0 + t] = col
+                a[y0:y1, max(0, x1 - t):x1] = col
+            yield a

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import _bulk
 from .autograd import TapeNode
 from .context import Context, current_context
 
@@ -46,23 +47,33 @@ _DEFERRED_ERRORS = []  # async failures observed during pruning
 
 
 def _prune_pending_locked():
-    """Drop buffers whose computation already finished (their references
-    would otherwise pin memory); completed-with-error buffers stash their
-    exception for the next waitall()."""
-    kept = []
-    for buf in _PENDING:
-        try:
-            ready = buf.is_ready()
-        except Exception:
-            ready = True
-        if not ready:
-            kept.append(buf)
-        else:
+    """Drop the oldest half of the tracked buffers after observing them
+    complete (their references would otherwise pin memory); completed-with-
+    error buffers stash their exception for the next waitall().
+
+    One batched block_until_ready instead of per-buffer is_ready() probes:
+    on a remote-tunneled PJRT backend every per-buffer probe is an RPC
+    (~1ms), which made tracking O(n) RPCs per append past the threshold.
+    The oldest half is steps-old and in practice already done, so the
+    batched block is not a pipeline stall."""
+    half = len(_PENDING) // 2
+    old, rest = _PENDING[:half], _PENDING[half:]
+    if not old:
+        return
+    try:
+        # one batched block over the retired half: on a remote-tunneled
+        # backend this is far cheaper than per-buffer probes, and observing
+        # completion here preserves the waitall() no-error-slips guarantee
+        # for dropped buffers
+        jax.block_until_ready(old)
+    except Exception:
+        # collect EVERY failed buffer's error individually (rare path)
+        for buf in old:
             try:
-                jax.block_until_ready(buf)  # no-op when ready; surfaces errors
+                jax.block_until_ready(buf)
             except Exception as e:
                 _DEFERRED_ERRORS.append(e)
-    _PENDING[:] = kept
+    _PENDING[:] = rest
 
 
 def _track(data):
@@ -84,6 +95,11 @@ def waitall():
     until observed ready (not a bounded recent-window), so no in-flight
     computation — or async failure — can slip past a waitall().
     """
+    try:
+        _bulk.flush()  # pending bulked segment counts as in-flight work
+    except Exception as e:
+        with _PENDING_LOCK:
+            _DEFERRED_ERRORS.append(e)
     with _PENDING_LOCK:
         pending = list(_PENDING)
         _PENDING.clear()
@@ -117,13 +133,15 @@ def _unwrap_deep(x):
 
 def _wrap_value(data, node=None, index=0):
     arr = ndarray.__new__(ndarray)
-    arr._data = data
+    arr._buf = data
     arr._node = node
     arr._out_index = index
     arr._marked = False
     arr._grad = None
     arr._grad_req = "write"
-    if node is None:
+    if isinstance(data, _bulk.LazyArray):
+        _bulk.note_holder(data, arr)
+    elif node is None:
         _track(data)
     return arr
 
@@ -134,14 +152,104 @@ def apply_op(fn, *args, **kwargs):
     `args` may mix ndarray and constants — only ndarray positions are
     differentiable (the rest are closed over, like non-tensor NodeAttrs in
     the reference op registry).
+
+    Dispatch is BULKED by default: the op is recorded into the pending
+    micro-trace segment (_bulk.py) and executes — together with every other
+    pending op — as one compiled XLA program at the next sync point.  Ops
+    the bulker cannot key or shape-infer, and any call made while tracing
+    (hybridize/jit), fall back to immediate eager dispatch.
     """
     nd_idx = [i for i, a in enumerate(args) if isinstance(a, ndarray)]
     nd_args = [args[i] for i in nd_idx]
-    vals = [a._data for a in nd_args]
 
     recording = autograd.is_recording() and any(
         a._node is not None or a._marked for a in nd_args
     )
+
+    if _bulk.enabled() and not any(
+            isinstance(a._buf, jax.core.Tracer) for a in nd_args):
+        # first attempt lifts python-scalar positionals as (weak-typed)
+        # runtime inputs — `x + i` in a loop then reuses ONE executable
+        # instead of compiling per distinct i; ops that need the scalar
+        # statically (axis, shape args) fail shape inference and retry
+        # with scalars as baked constants
+        try:
+            return _apply_op_bulked(fn, args, kwargs, nd_idx, nd_args,
+                                    recording, lift_scalars=True)
+        except _bulk.Unbulkable:
+            pass
+        try:
+            return _apply_op_bulked(fn, args, kwargs, nd_idx, nd_args,
+                                    recording, lift_scalars=False)
+        except _bulk.Unbulkable:
+            _bulk.note_eager_fallback()
+
+    return _apply_op_eager(fn, args, kwargs, nd_idx, nd_args, recording)
+
+
+def _apply_op_bulked(fn, args, kwargs, nd_idx, nd_args, recording,
+                     lift_scalars=False):
+    # lift every array-valued positional (ndarray buffers, raw jax/onp
+    # arrays) into the segment; scalars/tuples stay constants
+    seg_args = []
+    arr_idx = []   # positions traced as segment inputs
+    for i, a in enumerate(args):
+        if isinstance(a, ndarray):
+            seg_args.append(a._buf)
+            arr_idx.append(i)
+        elif isinstance(a, jax.Array) or (
+                isinstance(a, onp.ndarray) and a.dtype != object):
+            seg_args.append(a)
+            arr_idx.append(i)
+        elif lift_scalars and type(a) in (int, float, bool):
+            seg_args.append(jnp.asarray(a))  # stays weak-typed: same
+            arr_idx.append(i)                # promotion as the raw scalar
+        else:
+            seg_args.append(a)
+    outs, multi = _bulk.record_op(fn, tuple(seg_args), kwargs)
+
+    node = None
+    if recording:
+        template = list(args)
+        for i in arr_idx:
+            template[i] = None
+        n_tape = len(arr_idx)
+
+        def closed(*vs):
+            full = list(template)
+            for i, v in zip(arr_idx, vs):
+                full[i] = v
+            return fn(*full, **kwargs)
+
+        # tape inputs: the ndarrays, plus wrappers for raw-array positions
+        # (their grads are computed and dropped — they are not leaves)
+        tape_inputs = []
+        for i in arr_idx:
+            a = args[i]
+            if isinstance(a, ndarray):
+                tape_inputs.append(a)
+            elif isinstance(a, jax.Array):
+                tape_inputs.append(_wrap_value(a))
+            else:
+                tape_inputs.append(_wrap_value(jnp.asarray(a)))
+        node = TapeNode(
+            None,                      # VJP deferred: backward replays fn
+            tape_inputs,
+            len(outs),
+            [o.shape for o in outs],
+            [o.dtype for o in outs],
+            out_is_tuple=multi,
+            fn=closed,
+        )
+        assert n_tape == len(tape_inputs)
+    wrapped = [_wrap_value(o, node, i) for i, o in enumerate(outs)]
+    if multi:
+        return tuple(wrapped)
+    return wrapped[0]
+
+
+def _apply_op_eager(fn, args, kwargs, nd_idx, nd_args, recording):
+    vals = [a._data for a in nd_args]
 
     if recording:
         template = list(args)
@@ -214,27 +322,45 @@ def from_numpy(a, zero_copy=False):
 # the ndarray class
 # --------------------------------------------------------------------------
 class ndarray:
-    """NumPy-compatible imperative array on TPU (mx.np.ndarray parity)."""
+    """NumPy-compatible imperative array on TPU (mx.np.ndarray parity).
 
-    __slots__ = ("_data", "_node", "_out_index", "_marked", "_grad",
+    `_buf` holds either a concrete jax.Array or a `_bulk.LazyArray` — a
+    pending output of the op-bulking micro-trace (the reference engine's
+    bulk execution reborn, see _bulk.py).  Reading `._data` materializes;
+    shape/dtype metadata never forces materialization."""
+
+    __slots__ = ("_buf", "_node", "_out_index", "_marked", "_grad",
                  "_grad_req", "__weakref__")
 
     def __init__(self, data=None, dtype=None, ctx=None):
-        self._data = _to_jax(data if data is not None else (), dtype, ctx)
+        self._buf = _to_jax(data if data is not None else (), dtype, ctx)
         self._node = None
         self._out_index = 0
         self._marked = False
         self._grad = None
         self._grad_req = "write"
 
+    # -- lazy buffer ------------------------------------------------------
+    @property
+    def _data(self):
+        buf = self._buf
+        if type(buf) is _bulk.LazyArray:
+            buf = _bulk.materialize(buf)
+            self._buf = buf
+        return buf
+
+    @_data.setter
+    def _data(self, v):
+        self._buf = v
+
     # -- properties -------------------------------------------------------
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        return tuple(self._buf.shape)
 
     @property
     def dtype(self):
-        return onp.dtype(self._data.dtype)
+        return onp.dtype(self._buf.dtype)
 
     @property
     def size(self):
@@ -242,7 +368,7 @@ class ndarray:
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return len(self._buf.shape)
 
     @property
     def itemsize(self):
@@ -293,7 +419,7 @@ class ndarray:
     # -- sync points ------------------------------------------------------
     def wait_to_read(self):
         try:
-            jax.block_until_ready(self._data)
+            jax.block_until_ready(self._data)  # materializes pending bulk
         except jax.errors.ConcretizationTypeError:
             pass
 
@@ -354,8 +480,11 @@ class ndarray:
                 "in-place mutation of an array produced inside a record() "
                 "scope is not allowed (reference: kWriteInplace hazard)"
             )
-        self._data = data
-        _track(data)
+        self._buf = data
+        if type(data) is _bulk.LazyArray:
+            _bulk.note_holder(data, self)  # liveness for the next flush
+        else:
+            _track(data)
 
     def __setitem__(self, key, value):
         key = _unwrap_deep(key)

@@ -159,6 +159,9 @@ def Custom(*inputs, op_type=None, **kwargs):
         return tuple(gin)
 
     f.defvjp(fwd, bwd)
+    # a fresh operator instance backs every Custom() call: bulking would
+    # cache-miss (and pin the instance) each time, so dispatch eagerly
+    f._mx_no_bulk = True
 
     out = apply_op(f, *inputs)
     if n_out == 1:

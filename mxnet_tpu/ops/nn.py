@@ -35,6 +35,12 @@ def _amp_set(state):
     _AMP.state = state
 
 
+# the AMP scope is read inside op bodies at execution time; deferred bulk
+# execution must re-enter the scope that was live when the op was recorded
+from .._bulk import register_ambient as _register_ambient
+_register_ambient("amp", _amp_state, _amp_set)
+
+
 def _amp_cast2(op, a, b):
     st = _amp_state()
     if st is not None and op in st[1] and \

@@ -27,8 +27,17 @@ _OPT_REGISTRY = {}
 # multi-tensor kernels compile ONCE for a parameter-group signature; the
 # whole group then updates in a single XLA program (reference multi_sgd_* /
 # multi_lans kernels, src/operator/optimizer_op.cc:313, contrib/multi_lans.cc)
-_multi_sgd_mom_jit = jax.jit(_ops.multi_sgd_mom_update,
-                             static_argnames=("clip_gradient",))
+def _multi_sgd_mom_flat(*arrs, lrs, momentum, wds, rescale_grad,
+                        clip_gradient):
+    """Flat-signature multi-tensor SGD-momentum (bulk-dispatchable form of
+    multi_sgd_mom_update: weights+grads+momenta concatenated positionally,
+    outputs new weights then new momenta)."""
+    n = len(lrs)
+    ws, gs, ms = arrs[:n], arrs[n:2 * n], arrs[2 * n:3 * n]
+    new_ws, new_ms = _ops.multi_sgd_mom_update(
+        list(ws), list(gs), list(ms), list(lrs), momentum, list(wds),
+        rescale_grad, clip_gradient=clip_gradient)
+    return tuple(new_ws) + tuple(new_ms)
 _multi_lans_jit = jax.jit(_ops.multi_lans_update,
                           static_argnames=("clip_gradient", "lower_bound",
                                           "upper_bound"))
@@ -221,15 +230,20 @@ class SGD(Optimizer):
                 state._set_data(state._data.at[rows].set(m))
                 weight._set_data(weight._data.at[rows].set(wrows + m))
             return
+        # apply_op (not raw jnp on ._data): the update joins the pending
+        # bulk segment, so a whole step's param updates compile and
+        # dispatch as one XLA program with the backward
+        from ..ndarray import apply_op as _apply_op
         if self.momentum == 0.0:
-            weight._set_data(_ops.sgd_update(
-                weight._data, grad._data, lr, wd, self.rescale_grad, clip))
+            new_w = _apply_op(_ops.sgd_update, weight, grad, lr, wd,
+                              self.rescale_grad, clip)
+            weight._set_data(new_w._buf)
         else:
-            new_w, new_m = _ops.sgd_mom_update(
-                weight._data, grad._data, state._data, lr, self.momentum, wd,
-                self.rescale_grad, clip)
-            weight._set_data(new_w)
-            state._set_data(new_m)
+            new_w, new_m = _apply_op(_ops.sgd_mom_update, weight, grad,
+                                     state, lr, self.momentum, wd,
+                                     self.rescale_grad, clip)
+            weight._set_data(new_w._buf)
+            state._set_data(new_m._buf)
 
     def update(self, indices, weights, grads, states):
         """aggregate_num>0: fuse groups of parameters into one XLA
@@ -244,20 +258,25 @@ class SGD(Optimizer):
             return super().update(indices, weights, grads, states)
         n = self.aggregate_num
         clip = self.clip_gradient if self.clip_gradient else -1.0
+        from ..ndarray import apply_op as _apply_op
         for s in range(0, len(indices), n):
             idx = indices[s:s + n]
             ws, gs, sts = weights[s:s + n], grads[s:s + n], states[s:s + n]
             for i in idx:
                 self._update_count(i)
-            new_ws, new_ms = _multi_sgd_mom_jit(
-                [w._data for w in ws], [g._data for g in gs],
-                [m._data for m in sts],
-                [self._get_lr(i) for i in idx], self.momentum,
-                [self._get_wd(i) for i in idx], self.rescale_grad,
-                clip_gradient=clip)
-            for w, m, nw, nm in zip(ws, sts, new_ws, new_ms):
-                w._set_data(nw)
-                m._set_data(nm)
+            # apply_op (not a direct jit call): the whole-group update joins
+            # the pending bulk segment, so fwd+bwd+update dispatch as ONE
+            # program per step (flushed at the Trainer.step boundary)
+            outs = _apply_op(
+                _multi_sgd_mom_flat, *ws, *gs, *sts,
+                lrs=tuple(self._get_lr(i) for i in idx),
+                momentum=self.momentum,
+                wds=tuple(self._get_wd(i) for i in idx),
+                rescale_grad=self.rescale_grad, clip_gradient=clip)
+            k = len(ws)
+            for j, (w, m) in enumerate(zip(ws, sts)):
+                w._set_data(outs[j]._buf)
+                m._set_data(outs[k + j]._buf)
 
     def update_multi_precision(self, indices, weights, grads, states):
         # without fp16 master-weight tuples this is exactly update();

@@ -1,0 +1,143 @@
+"""Detection pipeline tests: augmenter box-correctness + ImageDetIter
+batching over a det-recordio file (reference tests:
+tests/python/unittest/test_image.py TestImageDetIter)."""
+import os
+import random as pyrandom
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+from mxnet_tpu import recordio
+from mxnet_tpu.image import detection as det
+from mxnet_tpu.ndarray import array as nd_array
+
+
+def _mklabel(boxes, extra_header=()):
+    """[A, B, extra..., obj rows...] wire vector."""
+    A = 2 + len(extra_header)
+    B = len(boxes[0])
+    flat = [A, B] + list(extra_header)
+    for b in boxes:
+        flat.extend(b)
+    return onp.asarray(flat, onp.float32)
+
+
+def _rand_img(h=64, w=80, seed=0):
+    return nd_array(onp.random.RandomState(seed).randint(
+        0, 255, size=(h, w, 3)).astype(onp.uint8))
+
+
+def test_parse_label_header():
+    lab = det.ImageDetIter._parse_label(
+        _mklabel([[0, .1, .2, .5, .6], [3, .3, .1, .9, .8]]))
+    assert lab.shape == (2, 5)
+    onp.testing.assert_allclose(lab[1], [3, .3, .1, .9, .8], atol=1e-6)
+    # extra header values are skipped
+    lab = det.ImageDetIter._parse_label(
+        _mklabel([[1, .1, .2, .3, .4]], extra_header=(7.0,)))
+    assert lab.shape == (1, 5) and lab[0, 0] == 1
+
+
+def test_horizontal_flip_flips_boxes():
+    pyrandom.seed(1)
+    aug = det.DetHorizontalFlipAug(p=1.0)
+    src = _rand_img()
+    lab = onp.asarray([[0, 0.1, 0.2, 0.4, 0.6]], onp.float32)
+    out, lab2 = aug(src, lab)
+    onp.testing.assert_allclose(lab2[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+    # the pixels flipped too
+    onp.testing.assert_array_equal(out.asnumpy(),
+                                   src.asnumpy()[:, ::-1])
+
+
+def test_random_crop_clips_and_renormalizes():
+    pyrandom.seed(3)
+    aug = det.DetRandomCropAug(min_object_covered=0.5,
+                               area_range=(0.5, 0.9), max_attempts=100)
+    src = _rand_img()
+    lab = onp.asarray([[2, 0.3, 0.3, 0.7, 0.7]], onp.float32)
+    for _ in range(5):
+        out, lab2 = aug(src, lab)
+        assert lab2.shape[1] == 5
+        assert (lab2[:, 1:5] >= 0).all() and (lab2[:, 1:5] <= 1).all()
+        assert (lab2[:, 3] >= lab2[:, 1]).all()
+        assert (lab2[:, 4] >= lab2[:, 2]).all()
+
+
+def test_random_pad_shrinks_boxes():
+    pyrandom.seed(5)
+    aug = det.DetRandomPadAug(area_range=(1.5, 2.5))
+    src = _rand_img(h=40, w=40)
+    lab = onp.asarray([[1, 0.0, 0.0, 1.0, 1.0]], onp.float32)
+    out, lab2 = aug(src, lab)
+    a = out.asnumpy()
+    assert a.shape[0] >= 40 and a.shape[1] >= 40
+    # box area shrank by the canvas growth factor
+    area = (lab2[0, 3] - lab2[0, 1]) * (lab2[0, 4] - lab2[0, 2])
+    expect = (40 * 40) / float(a.shape[0] * a.shape[1])
+    onp.testing.assert_allclose(area, expect, rtol=1e-2)
+    # pixels preserved inside the pad
+    y0 = int(round(lab2[0, 2] * a.shape[0]))
+    x0 = int(round(lab2[0, 1] * a.shape[1]))
+    onp.testing.assert_array_equal(
+        a[y0:y0 + 40, x0:x0 + 40], src.asnumpy())
+
+
+def test_create_det_augmenter_runs_all():
+    pyrandom.seed(7)
+    augs = det.CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                  rand_mirror=True, mean=True, std=True,
+                                  brightness=0.1)
+    src = _rand_img()
+    lab = onp.asarray([[0, .2, .2, .8, .8], [1, .4, .1, .6, .5]],
+                      onp.float32)
+    for _ in range(4):
+        out, lab2 = src, lab
+        for a in augs:
+            out, lab2 = a(out, lab2)
+        assert out.shape[:2] == (32, 32)
+        assert (lab2[:, 1:5] >= -1e-6).all() and (lab2[:, 1:5] <= 1 + 1e-6).all()
+
+
+def test_image_det_iter_over_recordio(tmp_path):
+    # build a tiny det .rec: 6 images, 1-3 objects each
+    rec = str(tmp_path / "det.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(6):
+        img_arr = rng.randint(0, 255, size=(48, 56, 3)).astype(onp.uint8)
+        nobj = 1 + i % 3
+        boxes = [[i % 4, .1 + .05 * j, .2, .5 + .05 * j, .7]
+                 for j in range(nobj)]
+        header = recordio.IRHeader(0, _mklabel(boxes), i, 0)
+        w.write(recordio.pack_img(header, img_arr, quality=90))
+    w.close()
+
+    it = det.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                          path_imgrec=rec, shuffle=False)
+    assert it.provide_label[0].shape == (3, 3, 5)  # max 3 objects, width 5
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (3, 3, 5)
+    # first image has exactly 1 object, rest padded with -1
+    assert lab[0, 0, 0] >= 0 and (lab[0, 1:] == -1).all()
+    batch2 = it.next()
+    assert batch2.data[0].shape == (3, 3, 32, 32)
+    with pytest.raises(StopIteration):
+        it.next()
+
+    # sync_label_shape grows both iterators to the common max
+    it2 = det.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                           path_imgrec=rec)
+    it2._label_shape = (5, 6)
+    it.reset()
+    it.sync_label_shape(it2)
+    assert it._label_shape == (5, 6) and it2._label_shape == (5, 6)
+
+
+def test_det_iter_exported_from_mx_image():
+    assert img.ImageDetIter is det.ImageDetIter
+    assert callable(img.CreateDetAugmenter)

@@ -1,0 +1,84 @@
+"""Pretrained-weight store contract (reference model_store.py): versioned
+layout, sha1 integrity, get_model(pretrained=True, root=...) end-to-end
+with golden logits from a committed weight file."""
+import os
+import shutil
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.gluon.model_zoo import model_store
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+DATA = os.path.join(os.path.dirname(__file__), "data",
+                    "squeezenet1.1_tiny.params")
+
+# golden logits for the committed weight file on the fixed probe input
+# (generated once on CPU; exact f32 determinism)
+GOLDEN = [1.7900758393807337e-05, 0.0, 2.586662503745174e-06, 0.0,
+          5.715178303944413e-06, 0.0, 0.0, 7.270905825862428e-06]
+
+
+def _probe():
+    return mxnp.array(onp.linspace(-1, 1, 1 * 3 * 64 * 64,
+                                   dtype="float32").reshape(1, 3, 64, 64))
+
+
+def test_publish_and_resolve(tmp_path):
+    root = str(tmp_path / "models")
+    dst = model_store.publish("squeezenet1.1", DATA, root=root)
+    sha = model_store._sha1_of(DATA)
+    assert dst.endswith("squeezenet1.1-%s.params" % sha[:8])
+    assert os.path.exists(dst)
+    # resolution + integrity pass
+    assert model_store.get_model_file("squeezenet1.1", root=root) == dst
+    assert model_store.short_hash("squeezenet1.1", root=root) == sha[:8]
+
+
+def test_hash_check_detects_corruption(tmp_path):
+    root = str(tmp_path / "models")
+    dst = model_store.publish("squeezenet1.1", DATA, root=root)
+    with open(dst, "r+b") as f:
+        f.seek(100)
+        f.write(b"\x00corrupt\x00")
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        model_store.get_model_file("squeezenet1.1", root=root)
+
+
+def test_missing_model_raises_with_publish_hint(tmp_path):
+    model_store._model_sha1.pop("no_such_model", None)
+    with pytest.raises(ValueError, match="publish"):
+        model_store.get_model_file("no_such_model", root=str(tmp_path))
+
+
+def test_index_survives_fresh_process_state(tmp_path):
+    root = str(tmp_path / "models")
+    model_store.publish("squeezenet1.1", DATA, root=root)
+    # simulate a fresh process: wipe the in-memory table
+    model_store._model_sha1.clear()
+    path = model_store.get_model_file("squeezenet1.1", root=root)
+    assert os.path.exists(path)
+
+
+def test_get_model_pretrained_golden_logits(tmp_path):
+    import jax
+    root = str(tmp_path / "models")
+    model_store.publish("squeezenet1.1", DATA, root=root)
+    net = get_model("squeezenet1.1", classes=8, pretrained=True, root=root)
+    # pin matmul precision: an earlier test in the session may leave a
+    # lower default, and these logits are near-cancelled sums
+    with jax.default_matmul_precision("highest"):
+        out = net(_probe()).asnumpy()
+    # tolerance note: these logits are near-cancelled reductions, so XLA
+    # flag differences (e.g. --xla_allow_excess_precision) shift them by
+    # ~1%; wrong/corrupt weights would be off by orders of magnitude
+    onp.testing.assert_allclose(out[0, :8], GOLDEN, rtol=5e-2, atol=1e-7)
+
+
+def test_purge(tmp_path):
+    root = str(tmp_path / "models")
+    model_store.publish("squeezenet1.1", DATA, root=root)
+    model_store.purge(root)
+    assert not [f for f in os.listdir(root) if f.endswith(".params")]
