@@ -30,7 +30,6 @@ import logging
 import os
 import threading
 import types
-import weakref
 
 import numpy as onp
 
@@ -96,6 +95,7 @@ class _SegState(threading.local):
 _seg = _SegState()
 _cache = {}
 _aval_cache = {}  # (fn_key, arg sig, ambients) -> (out_avals, out_is_tuple)
+_UNBULKABLE = object()  # negative-cache tag: (_UNBULKABLE, reason)
 _stats = {"flushes": 0, "compiles": 0, "ops_bulked": 0, "eager_fallbacks": 0}
 
 # Ambient thread-local state that op functions read at EXECUTION time (e.g.
@@ -354,6 +354,13 @@ def record_op(fn, args, kwargs):
                 tuple((k, spec_sig(s)) for k, s in kwarg_spec), amb_key)
     cached = _aval_cache.get(aval_key)
     if cached is not None:
+        if cached[0] is _UNBULKABLE:
+            # negative cache: a failed shape inference is value-independent
+            # for this structural signature (lifted scalars are abstract),
+            # so re-tracing it per call would pay ~ms of eval_shape on
+            # EVERY op that needs the baked-const retry (e.g. sgd_update's
+            # `clip_gradient > 0` branch, once per parameter per step)
+            raise Unbulkable(cached[1])
         avals, out_is_tuple = cached
     else:
         call_fn = fn
@@ -387,19 +394,31 @@ def record_op(fn, args, kwargs):
                 shell,
                 *[avalize(arg_spec[i]) for i in arr_arg_idx],
                 *[avalize(dict(kwarg_spec)[k]) for k in arr_kw_keys])
-        except Unbulkable:
+        except Unbulkable as e:
+            _aval_cache[aval_key] = (_UNBULKABLE, str(e))
             raise
         except Exception as e:
-            raise Unbulkable("eval_shape failed: %s" % e)
+            msg = "eval_shape failed: %s" % e
+            _aval_cache[aval_key] = (_UNBULKABLE, msg)
+            raise Unbulkable(msg)
 
         out_is_tuple = isinstance(out_avals, (tuple, list))
         avals = list(out_avals) if out_is_tuple else [out_avals]
         for a in avals:
+            # negative-cache these too: they are as structural as an
+            # eval_shape failure, and an uncached raise re-pays the full
+            # trace on every call of the same signature
             if not isinstance(a, jax.ShapeDtypeStruct) or any(
                     not isinstance(d, int) for d in a.shape):
-                raise Unbulkable("non-array or dynamic-shape output")
+                msg = "non-array or dynamic-shape output"
+                _aval_cache[aval_key] = (_UNBULKABLE, msg)
+                raise Unbulkable(msg)
             if a.dtype == jax.dtypes.float0:
-                raise Unbulkable("float0 output (int-input VJP); run eagerly")
+                msg = "float0 output (int-input VJP); run eagerly"
+                _aval_cache[aval_key] = (_UNBULKABLE, msg)
+                raise Unbulkable(msg)
+        if len(_aval_cache) > 16384:  # unbounded-growth safety valve
+            _aval_cache.clear()
         _aval_cache[aval_key] = (avals, out_is_tuple)
 
     op = BulkOp(fn, arg_spec, kwarg_spec, cell_spec, [], out_is_tuple, None)
@@ -414,12 +433,6 @@ def record_op(fn, args, kwargs):
     if len(_seg.ops) >= _seg.limit:
         flush()
     return outs, out_is_tuple
-
-
-def note_holder(lazy, nd):
-    """Kept for call-site compatibility: liveness tracking was removed from
-    the flush plan (GC-timing-dependent keys caused recompiles), so holding
-    is implicit — every output is materialized at flush."""
 
 
 def note_eager_fallback():
@@ -582,6 +595,12 @@ def _flush_ops(ops):
             return out_list
 
         entry = jax.jit(run)
+        if len(_cache) > 2048:
+            # safety valve: cache keys hold callables (incl. bound-method
+            # receivers), so unbounded growth would pin every model a
+            # long-lived process ever created; a rare full clear only costs
+            # recompiles
+            _cache.clear()
         _cache[cache_key] = entry
 
     out_vals = entry(leaves)

@@ -103,12 +103,18 @@ class TapeNode:
     """
 
     __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_shapes", "out_dtypes",
-                 "out_is_tuple", "fn")
+                 "out_is_tuple", "fn", "in_bufs")
 
     def __init__(self, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes,
-                 out_is_tuple=None, fn=None):
+                 out_is_tuple=None, fn=None, in_bufs=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list of ndarray (kept alive while tape lives)
+        # record-time input buffers for deferred-VJP replay: the replay must
+        # recompute the forward from the values the op actually SAW, not
+        # whatever the ndarray wrapper holds at backward time (an in-place
+        # x[:]= mutation between forward and backward would otherwise
+        # silently poison the gradient — reference kWriteInplace semantics)
+        self.in_bufs = in_bufs
         self.n_outputs = n_outputs
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
@@ -275,11 +281,16 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                                   n.out_is_tuple, len(n.inputs), in_float)
 
             if replay_mode:
+                # higher-order: inputs must stay ndarrays so the replay's
+                # grads connect back through the tape
                 flt_grads = apply_op(replay, *(list(n.inputs) + float_cts))
             else:
+                # deferred VJP: replay from the RECORD-TIME buffers, not
+                # the live wrappers (see TapeNode.in_bufs)
+                ins = (list(n.in_bufs) if n.in_bufs is not None
+                       else [i._buf for i in n.inputs])
                 with pause():
-                    flt_grads = apply_op(replay,
-                                         *(list(n.inputs) + float_cts))
+                    flt_grads = apply_op(replay, *(ins + float_cts))
             if not isinstance(flt_grads, (list, tuple)):
                 flt_grads = [flt_grads]
             # re-slot by the static mask: int/bool inputs take no gradient
@@ -314,12 +325,19 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
         if req == "null":
             continue
         if isinstance(g, ndarray):
-            # replay-mode grad: keep the wrapper (it carries the tape node
-            # for higher-order differentiation)
             if req == "add" and arr._grad is not None:
-                arr._grad = _add_grads(arr._grad, g)
-            else:
+                g = _add_grads(arr._grad, g)
+            if arr._grad is None:
                 arr._grad = g
+            else:
+                # x.grad must remain the SAME ndarray attach_grad created
+                # (reference writes grads INTO the attached buffer, so user
+                # aliases stay live); transplant the value and the tape
+                # node (the node carries the replay closure higher-order
+                # differentiation needs)
+                arr._grad._buf = g._buf
+                arr._grad._node = g._node
+                arr._grad._out_index = g._out_index
         elif req == "add" and arr._grad is not None:
             arr._grad._data = arr._grad._data + g
         else:

@@ -219,6 +219,70 @@ class SaturationJitterAug(_JitterAug):
         return nd_array(a * alpha + gray * (1 - alpha))
 
 
+class HueJitterAug(_JitterAug):
+    """Random hue rotation via the YIQ-space approximation the reference
+    uses (image.py HueJitterAug): R' = M(theta) @ R with M built from the
+    classic tyiq/ityiq matrices, so no HSV round-trip is needed."""
+    _tyiq = onp.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], onp.float32)
+    _ityiq = onp.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], onp.float32)
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.jitter, self.jitter)
+        theta = onp.pi * alpha
+        u, w = onp.cos(theta), onp.sin(theta)
+        bt = onp.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], onp.float32)
+        m = self._ityiq @ bt @ self._tyiq
+        a = src.asnumpy().astype(onp.float32)
+        return nd_array(a @ m.T)
+
+
+class RandomGrayAug(Augmenter):
+    """With probability p, collapse to luminance replicated over channels
+    (reference image.py RandomGrayAug)."""
+    _coef = onp.array([0.299, 0.587, 0.114], onp.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            a = src.asnumpy().astype(onp.float32)
+            gray = (a * self._coef).sum(-1, keepdims=True)
+            return nd_array(onp.repeat(gray, a.shape[-1], -1))
+        return src
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise (reference image.py LightingAug):
+    adds eigvec @ (alpha * eigval) with alpha ~ N(0, alphastd)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, onp.float32)
+        self.eigvec = onp.asarray(eigvec, onp.float32)
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(-1)
+        return nd_array(src.asnumpy().astype(onp.float32) + rgb)
+
+
+# ImageNet PCA statistics (the constants every framework's lighting
+# augmentation bakes in, incl. the reference's CreateAugmenter)
+_PCA_EIGVAL = onp.array([55.46, 4.794, 1.148], onp.float32)
+_PCA_EIGVEC = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], onp.float32)
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -242,6 +306,12 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(ContrastJitterAug(contrast))
     if saturation:
         auglist.append(SaturationJitterAug(saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, _PCA_EIGVAL, _PCA_EIGVEC))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = onp.array([123.68, 116.28, 103.53])
     if std is True:
@@ -266,6 +336,8 @@ class ImageIter(DataIter):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
                                            if k in ("resize", "rand_crop",
@@ -291,7 +363,14 @@ class ImageIter(DataIter):
                 with open(path_imglist) as f:
                     for line in f:
                         parts = line.strip().split("\t")
-                        imglist.append((float(parts[1]), parts[-1]))
+                        # list line: idx \t l1 [\t l2 ...] \t path — a
+                        # multi-column label (label_width>1 / detection
+                        # headers) must survive as a vector, not collapse
+                        # to its first float
+                        vals = [float(v) for v in parts[1:-1]]
+                        lbl = vals[0] if len(vals) == 1 else \
+                            onp.asarray(vals, onp.float32)
+                        imglist.append((lbl, parts[-1]))
             self._list = [(lbl, os.path.join(path_root or "", p))
                           for lbl, p in imglist]
         else:
@@ -300,11 +379,12 @@ class ImageIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
 
     @property
     def provide_label(self):
-        return [DataDesc("softmax_label", (self.batch_size,))]
+        return [DataDesc(self.label_name, (self.batch_size,))]
 
     def reset(self):
         n = len(self._recs) if self._recs is not None else len(self._list)

@@ -305,6 +305,16 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         auglist.append(DetBorrowAug(ContrastJitterAug(contrast)))
     if saturation:
         auglist.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    if hue:
+        from . import HueJitterAug
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        from . import LightingAug, _PCA_EIGVAL, _PCA_EIGVEC
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, _PCA_EIGVAL,
+                                                _PCA_EIGVEC)))
+    if rand_gray > 0:
+        from . import RandomGrayAug
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
     if mean is True:
         mean = onp.array([123.68, 116.28, 103.53])
     if std is True:
@@ -324,12 +334,18 @@ class ImageDetIter(ImageIter):
                  path_imglist=None, path_root=None, shuffle=False,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="label", **kwargs):
-        det_kwargs = {}
-        for k in ("resize", "rand_crop", "rand_pad", "rand_mirror", "mean",
-                  "std", "brightness", "contrast", "saturation",
-                  "min_object_covered", "area_range"):
-            if k in kwargs:
-                det_kwargs[k] = kwargs.pop(k)
+        # forward EVERY CreateDetAugmenter tuning knob (silently dropping
+        # e.g. max_attempts or pad_val would run augmentation with defaults
+        # while the caller believes their settings are live)
+        import inspect
+        det_param_names = [
+            p for p in inspect.signature(CreateDetAugmenter).parameters
+            if p != "data_shape"]
+        det_kwargs = {k: kwargs.pop(k) for k in det_param_names
+                      if k in kwargs}
+        if kwargs:
+            raise TypeError("ImageDetIter got unexpected keyword "
+                            "arguments: %s" % sorted(kwargs))
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape, **det_kwargs)
         super().__init__(batch_size=batch_size, data_shape=data_shape,
@@ -381,7 +397,8 @@ class ImageDetIter(ImageIter):
 
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
 
     @property
     def provide_label(self):

@@ -46,25 +46,19 @@ _PENDING_PRUNE_AT = 256  # amortized prune threshold (keeps memory bounded)
 _DEFERRED_ERRORS = []  # async failures observed during pruning
 
 
-def _prune_pending_locked():
-    """Drop the oldest half of the tracked buffers after observing them
-    complete (their references would otherwise pin memory); completed-with-
-    error buffers stash their exception for the next waitall().
+def _drain_retired(old):
+    """Observe a retired batch of buffers complete (their references would
+    otherwise pin memory); completed-with-error buffers stash their
+    exception for the next waitall().
 
     One batched block_until_ready instead of per-buffer is_ready() probes:
     on a remote-tunneled PJRT backend every per-buffer probe is an RPC
     (~1ms), which made tracking O(n) RPCs per append past the threshold.
     The oldest half is steps-old and in practice already done, so the
-    batched block is not a pipeline stall."""
-    half = len(_PENDING) // 2
-    old, rest = _PENDING[:half], _PENDING[half:]
-    if not old:
-        return
+    batched block is not a pipeline stall.  Runs OUTSIDE _PENDING_LOCK:
+    if the buffers are genuinely unfinished, only this thread stalls —
+    other threads keep tracking/waiting."""
     try:
-        # one batched block over the retired half: on a remote-tunneled
-        # backend this is far cheaper than per-buffer probes, and observing
-        # completion here preserves the waitall() no-error-slips guarantee
-        # for dropped buffers
         jax.block_until_ready(old)
     except Exception:
         # collect EVERY failed buffer's error individually (rare path)
@@ -72,8 +66,8 @@ def _prune_pending_locked():
             try:
                 jax.block_until_ready(buf)
             except Exception as e:
-                _DEFERRED_ERRORS.append(e)
-    _PENDING[:] = rest
+                with _PENDING_LOCK:
+                    _DEFERRED_ERRORS.append(e)
 
 
 def _track(data):
@@ -81,10 +75,15 @@ def _track(data):
         if _NAIVE:
             jax.block_until_ready(data)
             return
+        old = None
         with _PENDING_LOCK:
             _PENDING.append(data)
             if len(_PENDING) >= _PENDING_PRUNE_AT:
-                _prune_pending_locked()
+                half = len(_PENDING) // 2
+                old = _PENDING[:half]
+                del _PENDING[:half]
+        if old:
+            _drain_retired(old)
 
 
 def waitall():
@@ -139,11 +138,30 @@ def _wrap_value(data, node=None, index=0):
     arr._marked = False
     arr._grad = None
     arr._grad_req = "write"
-    if isinstance(data, _bulk.LazyArray):
-        _bulk.note_holder(data, arr)
-    elif node is None:
+    if not isinstance(data, _bulk.LazyArray) and node is None:
         _track(data)
     return arr
+
+
+_scalar_lift_cache = {}
+
+
+def _lift_scalar(a):
+    """Device buffer for a lifted python scalar, cached on (type, value).
+
+    jnp.asarray(0.05) is an EAGER dispatch (one device round-trip); an
+    optimizer step passes the same lr/wd/rescale/clip scalars for every
+    parameter every step, which cost ~40 eager transfers per LeNet step
+    through the remote-chip tunnel.  Caching also pins the buffer id, so
+    the bulk flush's leaf-slot dedup sees one stable leaf per scalar."""
+    k = (type(a), a)
+    v = _scalar_lift_cache.get(k)
+    if v is None:
+        if len(_scalar_lift_cache) > 4096:   # unbounded-loop safety valve
+            _scalar_lift_cache.clear()
+        v = jnp.asarray(a)
+        _scalar_lift_cache[k] = v
+    return v
 
 
 def apply_op(fn, *args, **kwargs):
@@ -202,8 +220,8 @@ def _apply_op_bulked(fn, args, kwargs, nd_idx, nd_args, recording,
             seg_args.append(a)
             arr_idx.append(i)
         elif lift_scalars and type(a) in (int, float, bool):
-            seg_args.append(jnp.asarray(a))  # stays weak-typed: same
-            arr_idx.append(i)                # promotion as the raw scalar
+            seg_args.append(_lift_scalar(a))  # stays weak-typed: same
+            arr_idx.append(i)                 # promotion as the raw scalar
         else:
             seg_args.append(a)
     outs, multi = _bulk.record_op(fn, tuple(seg_args), kwargs)
@@ -228,10 +246,13 @@ def _apply_op_bulked(fn, args, kwargs, nd_idx, nd_args, recording,
             a = args[i]
             if isinstance(a, ndarray):
                 tape_inputs.append(a)
-            elif isinstance(a, jax.Array):
-                tape_inputs.append(_wrap_value(a))
-            else:
+            elif isinstance(a, onp.ndarray):
                 tape_inputs.append(_wrap_value(jnp.asarray(a)))
+            else:
+                # seg_args[i] already holds the device buffer (incl. the
+                # cached _lift_scalar buffer for python scalars — a fresh
+                # jnp.asarray here would re-pay an eager transfer per op)
+                tape_inputs.append(_wrap_value(seg_args[i]))
         node = TapeNode(
             None,                      # VJP deferred: backward replays fn
             tape_inputs,
@@ -240,6 +261,7 @@ def _apply_op_bulked(fn, args, kwargs, nd_idx, nd_args, recording,
             [o.dtype for o in outs],
             out_is_tuple=multi,
             fn=closed,
+            in_bufs=tuple(seg_args[i] for i in arr_idx),
         )
         assert n_tape == len(tape_inputs)
     wrapped = [_wrap_value(o, node, i) for i, o in enumerate(outs)]
@@ -481,9 +503,7 @@ class ndarray:
                 "scope is not allowed (reference: kWriteInplace hazard)"
             )
         self._buf = data
-        if type(data) is _bulk.LazyArray:
-            _bulk.note_holder(data, self)  # liveness for the next flush
-        else:
+        if type(data) is not _bulk.LazyArray:
             _track(data)
 
     def __setitem__(self, key, value):

@@ -246,3 +246,33 @@ def test_ctc_loss_padding():
     ll = np.array(onp.array([2, 3], "float32"))
     l_len = loss_fn(logits, labels, None, ll).asnumpy()
     onp.testing.assert_allclose(l_pad, l_len, rtol=1e-4)
+
+
+def test_mutation_between_forward_and_backward_does_not_poison_grad():
+    # deferred-VJP replay must recompute from record-time buffers
+    # (reference kWriteInplace semantics)
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    x[:] = 10.0
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_grad_buffer_identity_preserved_across_backward():
+    # reference writes grads INTO the attach_grad buffer: aliases stay live
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    alias = x.grad
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert x.grad is alias
+    onp.testing.assert_allclose(alias.asnumpy(), [2.0, 4.0, 6.0])
+    # and across a SECOND backward too
+    with autograd.record():
+        y = (3.0 * x).sum()
+    y.backward()
+    assert x.grad is alias
+    onp.testing.assert_allclose(alias.asnumpy(), [3.0, 3.0, 3.0])

@@ -141,3 +141,69 @@ def test_image_det_iter_over_recordio(tmp_path):
 def test_det_iter_exported_from_mx_image():
     assert img.ImageDetIter is det.ImageDetIter
     assert callable(img.CreateDetAugmenter)
+
+
+def test_image_det_iter_over_imglist(tmp_path):
+    # .lst path: idx \t flat-label... \t filename — multi-column labels
+    # must survive ImageIter's list parsing as a full vector
+    from PIL import Image
+    rng = onp.random.RandomState(3)
+    lines = []
+    for i in range(4):
+        arr = rng.randint(0, 255, size=(40, 50, 3)).astype(onp.uint8)
+        name = "im%d.png" % i
+        Image.fromarray(arr).save(str(tmp_path / name))
+        nobj = 1 + i % 2
+        lab = _mklabel([[i, .1, .2, .6, .8]] * nobj)
+        lines.append("\t".join([str(i)] + ["%g" % v for v in lab] + [name]))
+    lst = tmp_path / "det.lst"
+    lst.write_text("\n".join(lines) + "\n")
+
+    it = det.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                          path_imglist=str(lst), path_root=str(tmp_path))
+    assert it.provide_label[0].shape == (2, 2, 5)
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab[0, 0, 0] == 0 and (lab[0, 1] == -1).all()
+
+
+def test_det_iter_rejects_unknown_kwargs():
+    with pytest.raises(TypeError):
+        det.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                         imglist=[(0.0, "x.png")], not_a_knob=1)
+
+
+def test_det_augmenter_forwards_tuning_kwargs(tmp_path):
+    # max_attempts/pad_val/aspect_ratio_range must reach the factory
+    augs = det.CreateDetAugmenter((3, 32, 32), rand_pad=1.0,
+                                  pad_val=(9, 9, 9), max_attempts=3)
+    names = [type(a).__name__ for a in augs]
+    assert "DetRandomSelectAug" in names
+
+
+def test_color_augmenters_run_and_preserve_shape():
+    pyrandom.seed(11)
+    src = _rand_img(32, 32)
+    for aug in (img.HueJitterAug(0.3),
+                img.RandomGrayAug(1.0),
+                img.LightingAug(0.1, img._PCA_EIGVAL, img._PCA_EIGVEC)):
+        out = aug(src)
+        assert out.shape == src.shape
+    # RandomGrayAug(1.0) collapses channels to equal values
+    g = img.RandomGrayAug(1.0)(src).asnumpy()
+    onp.testing.assert_allclose(g[..., 0], g[..., 1], atol=1e-3)
+    # hue jitter preserves rough luminance
+    h = img.HueJitterAug(0.2)(src).asnumpy()
+    coef = onp.array([0.299, 0.587, 0.114])
+    lum0 = (src.asnumpy() * coef).sum(-1).mean()
+    lum1 = (h * coef).sum(-1).mean()
+    assert abs(lum0 - lum1) / lum0 < 0.15
+
+
+def test_create_augmenter_includes_color_augs():
+    augs = img.CreateAugmenter((3, 32, 32), hue=0.1, pca_noise=0.05,
+                               rand_gray=0.2)
+    names = [type(a).__name__ for a in augs]
+    assert "HueJitterAug" in names and "LightingAug" in names \
+        and "RandomGrayAug" in names
