@@ -96,6 +96,15 @@ _seg = _SegState()
 _cache = {}
 _aval_cache = {}  # (fn_key, arg sig, ambients) -> (out_avals, out_is_tuple)
 _UNBULKABLE = object()  # negative-cache tag: (_UNBULKABLE, reason)
+
+
+def _aval_cache_put(key, value):
+    """Single insertion point so the growth cap covers negative entries
+    too (a stream of distinct failing signatures must not grow the dict
+    without bound)."""
+    if len(_aval_cache) > 16384:
+        _aval_cache.clear()
+    _aval_cache[key] = value
 _stats = {"flushes": 0, "compiles": 0, "ops_bulked": 0, "eager_fallbacks": 0}
 
 # Ambient thread-local state that op functions read at EXECUTION time (e.g.
@@ -395,11 +404,11 @@ def record_op(fn, args, kwargs):
                 *[avalize(arg_spec[i]) for i in arr_arg_idx],
                 *[avalize(dict(kwarg_spec)[k]) for k in arr_kw_keys])
         except Unbulkable as e:
-            _aval_cache[aval_key] = (_UNBULKABLE, str(e))
+            _aval_cache_put(aval_key, (_UNBULKABLE, str(e)))
             raise
         except Exception as e:
             msg = "eval_shape failed: %s" % e
-            _aval_cache[aval_key] = (_UNBULKABLE, msg)
+            _aval_cache_put(aval_key, (_UNBULKABLE, msg))
             raise Unbulkable(msg)
 
         out_is_tuple = isinstance(out_avals, (tuple, list))
@@ -411,15 +420,13 @@ def record_op(fn, args, kwargs):
             if not isinstance(a, jax.ShapeDtypeStruct) or any(
                     not isinstance(d, int) for d in a.shape):
                 msg = "non-array or dynamic-shape output"
-                _aval_cache[aval_key] = (_UNBULKABLE, msg)
+                _aval_cache_put(aval_key, (_UNBULKABLE, msg))
                 raise Unbulkable(msg)
             if a.dtype == jax.dtypes.float0:
                 msg = "float0 output (int-input VJP); run eagerly"
-                _aval_cache[aval_key] = (_UNBULKABLE, msg)
+                _aval_cache_put(aval_key, (_UNBULKABLE, msg))
                 raise Unbulkable(msg)
-        if len(_aval_cache) > 16384:  # unbounded-growth safety valve
-            _aval_cache.clear()
-        _aval_cache[aval_key] = (avals, out_is_tuple)
+        _aval_cache_put(aval_key, (avals, out_is_tuple))
 
     op = BulkOp(fn, arg_spec, kwarg_spec, cell_spec, [], out_is_tuple, None)
     op.ambients = ambients

@@ -343,16 +343,24 @@ class ImageDetIter(ImageIter):
             if p != "data_shape"]
         det_kwargs = {k: kwargs.pop(k) for k in det_param_names
                       if k in kwargs}
-        if kwargs:
+        # remaining kwargs must be ones ImageIter itself takes (e.g.
+        # label_width) — anything else is a typo'd augmenter knob that
+        # must NOT be silently dropped
+        parent_params = set(
+            inspect.signature(ImageIter.__init__).parameters) - {
+                "self", "kwargs"}
+        unknown = set(kwargs) - parent_params
+        if unknown:
             raise TypeError("ImageDetIter got unexpected keyword "
-                            "arguments: %s" % sorted(kwargs))
+                            "arguments: %s" % sorted(unknown))
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape, **det_kwargs)
         super().__init__(batch_size=batch_size, data_shape=data_shape,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, shuffle=shuffle,
                          aug_list=[], imglist=imglist,
-                         data_name=data_name, label_name=label_name)
+                         data_name=data_name, label_name=label_name,
+                         **kwargs)
         self.det_auglist = aug_list
         self.label_name = label_name
         # first pass: establish the padded label shape

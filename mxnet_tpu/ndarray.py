@@ -19,6 +19,7 @@ and — when autograd is recording — captures a VJP closure on the tape
 """
 from __future__ import annotations
 
+import math
 import os
 import threading
 
@@ -43,6 +44,7 @@ _NAIVE = _cfg_get("MXNET_ENGINE_TYPE") == "NaiveEngine"
 _PENDING = []  # ALL in-flight buffers, for waitall() completeness
 _PENDING_LOCK = threading.Lock()
 _PENDING_PRUNE_AT = 256  # amortized prune threshold (keeps memory bounded)
+_DRAINING = []  # retired batches being drained outside the lock
 _DEFERRED_ERRORS = []  # async failures observed during pruning
 
 
@@ -57,7 +59,9 @@ def _drain_retired(old):
     The oldest half is steps-old and in practice already done, so the
     batched block is not a pipeline stall.  Runs OUTSIDE _PENDING_LOCK:
     if the buffers are genuinely unfinished, only this thread stalls —
-    other threads keep tracking/waiting."""
+    other threads keep tracking/waiting.  The batch stays visible in
+    _DRAINING while being drained, so a concurrent waitall() still
+    observes (and blocks on) it — no in-flight failure slips past."""
     try:
         jax.block_until_ready(old)
     except Exception:
@@ -68,6 +72,12 @@ def _drain_retired(old):
             except Exception as e:
                 with _PENDING_LOCK:
                     _DEFERRED_ERRORS.append(e)
+    finally:
+        with _PENDING_LOCK:
+            try:
+                _DRAINING.remove(old)
+            except ValueError:
+                pass  # a concurrent waitall() already claimed the batch
 
 
 def _track(data):
@@ -82,6 +92,7 @@ def _track(data):
                 half = len(_PENDING) // 2
                 old = _PENDING[:half]
                 del _PENDING[:half]
+                _DRAINING.append(old)
         if old:
             _drain_retired(old)
 
@@ -102,6 +113,9 @@ def waitall():
     with _PENDING_LOCK:
         pending = list(_PENDING)
         _PENDING.clear()
+        for batch in _DRAINING:  # batches mid-drain in another thread
+            pending.extend(batch)
+        del _DRAINING[:]
         errors = list(_DEFERRED_ERRORS)
         _DEFERRED_ERRORS.clear()
     for buf in pending:
@@ -154,7 +168,9 @@ def _lift_scalar(a):
     parameter every step, which cost ~40 eager transfers per LeNet step
     through the remote-chip tunnel.  Caching also pins the buffer id, so
     the bulk flush's leaf-slot dedup sees one stable leaf per scalar."""
-    k = (type(a), a)
+    # copysign disambiguates -0.0 from 0.0 (== and hash conflate them,
+    # and 1/x, atan2, copysign are sign-of-zero sensitive)
+    k = (type(a), a, math.copysign(1.0, a) if type(a) is float else 1.0)
     v = _scalar_lift_cache.get(k)
     if v is None:
         if len(_scalar_lift_cache) > 4096:   # unbounded-loop safety valve
