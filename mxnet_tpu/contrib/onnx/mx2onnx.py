@@ -272,6 +272,395 @@ def _reduce_factory(onnx_op):
 
 _CONVERTERS["np:sum"] = _reduce_factory("ReduceSum")
 _CONVERTERS["np:mean"] = _reduce_factory("ReduceMean")
+_CONVERTERS["np:prod"] = _reduce_factory("ReduceProd")
+_CONVERTERS["np:max"] = _reduce_factory("ReduceMax")
+_CONVERTERS["np:min"] = _reduce_factory("ReduceMin")
+
+
+# ---------------------------------------------------------------------------
+# converters: shape / indexing / selection ops
+# ---------------------------------------------------------------------------
+def _attr_or_pos(node, key, pos=0, default=None):
+    extra = node._attrs.get("_extra_pos") or []
+    v = node._attrs.get(key)
+    if v is None and len(extra) > pos:
+        v = extra[pos]
+    return default if v is None else v
+
+
+@register_converter("np:clip")
+def _clip(ctx, node, ins, out):
+    lo = _attr_or_pos(node, "a_min", 0)
+    hi = _attr_or_pos(node, "a_max", 1)
+    names = [ins[0]]
+    for tag, v in (("min", lo), ("max", hi)):
+        if v is None:
+            names.append("")
+        else:
+            names.append(ctx.add_initializer(
+                "%s_%s" % (node.name, tag), onp.asarray(v, onp.float32)))
+    while names and names[-1] == "":
+        names.pop()
+    return ctx.add_node("Clip", names, [out], name=node.name)
+
+
+@register_converter("np:square")
+def _square(ctx, node, ins, out):
+    two = ctx.add_initializer(node.name + "_two",
+                              onp.asarray(2.0, onp.float32))
+    return ctx.add_node("Pow", [ins[0], two], [out], name=node.name)
+
+
+@register_converter("np:expand_dims")
+def _expand_dims(ctx, node, ins, out):
+    axis = _attr_or_pos(node, "axis", 0, 0)
+    ax = ctx.add_initializer(node.name + "_axes",
+                             onp.asarray([int(axis)], onp.int64))
+    return ctx.add_node("Unsqueeze", [ins[0], ax], [out], name=node.name)
+
+
+@register_converter("np:squeeze")
+def _squeeze(ctx, node, ins, out):
+    axis = _attr_or_pos(node, "axis", 0)
+    if axis is None:
+        return ctx.add_node("Squeeze", [ins[0]], [out], name=node.name)
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    ax = ctx.add_initializer(node.name + "_axes",
+                             onp.asarray(axes, onp.int64))
+    return ctx.add_node("Squeeze", [ins[0], ax], [out], name=node.name)
+
+
+@register_converter("np:where")
+def _where(ctx, node, ins, out):
+    return ctx.add_node("Where", list(ins[:3]), [out], name=node.name)
+
+
+@register_converter("np:tile")
+def _tile(ctx, node, ins, out):
+    reps = _attr_or_pos(node, "reps", 0)
+    reps = [reps] if isinstance(reps, int) else list(reps)
+    r = ctx.add_initializer(node.name + "_reps",
+                            onp.asarray(reps, onp.int64))
+    return ctx.add_node("Tile", [ins[0], r], [out], name=node.name)
+
+
+@register_converter("np:broadcast_to")
+def _broadcast_to(ctx, node, ins, out):
+    shape = _attr_or_pos(node, "shape", 0)
+    s = ctx.add_initializer(node.name + "_shape",
+                            onp.asarray(list(shape), onp.int64))
+    return ctx.add_node("Expand", [ins[0], s], [out], name=node.name)
+
+
+def _arg_factory(onnx_op):
+    def conv(ctx, node, ins, out):
+        axis = _attr_or_pos(node, "axis", 0)
+        # mx argmax(axis=None) flattens; ONNX has no such mode — emit a
+        # Reshape(-1) then reduce over axis 0
+        data = ins[0]
+        if axis is None:
+            flat_shape = ctx.add_initializer(
+                node.name + "_flat", onp.asarray([-1], onp.int64))
+            data = ctx.add_node("Reshape", [ins[0], flat_shape],
+                                [ctx.fresh(node.name + "_flatten")])
+            axis = 0
+        return ctx.add_node(onnx_op, [data], [out], name=node.name,
+                            axis=int(axis), keepdims=0)
+    return conv
+
+
+_CONVERTERS["np:argmax"] = _arg_factory("ArgMax")
+_CONVERTERS["np:argmin"] = _arg_factory("ArgMin")
+
+
+@register_converter("np:cumsum")
+def _cumsum(ctx, node, ins, out):
+    axis = _attr_or_pos(node, "axis", 0, 0)
+    ax = ctx.add_initializer(node.name + "_axis",
+                             onp.asarray(int(axis), onp.int64))
+    return ctx.add_node("CumSum", [ins[0], ax], [out], name=node.name)
+
+
+@register_converter("np:take")
+def _take(ctx, node, ins, out):
+    axis = _attr_or_pos(node, "axis", 1, 0)
+    return ctx.add_node("Gather", list(ins[:2]), [out], name=node.name,
+                        axis=int(axis) if axis is not None else 0)
+
+
+@register_converter("np:stack")
+def _stack(ctx, node, ins, out):
+    axis = int(node._attrs.get("axis", 0))
+    ax = ctx.add_initializer(node.name + "_axes",
+                             onp.asarray([axis], onp.int64))
+    unsq = [ctx.add_node("Unsqueeze", [i, ax],
+                         [ctx.fresh(node.name + "_u%d" % k)])
+            for k, i in enumerate(ins)]
+    return ctx.add_node("Concat", unsq, [out], name=node.name, axis=axis)
+
+
+@register_converter("np:concatenate")
+def _np_concat(ctx, node, ins, out):
+    return ctx.add_node("Concat", list(ins), [out], name=node.name,
+                        axis=int(node._attrs.get("axis", 0)))
+
+
+@register_converter("np:pad")
+def _np_pad(ctx, node, ins, out):
+    pw = _attr_or_pos(node, "pad_width", 0)
+    mode = node._attrs.get("mode", "constant")
+    # np pad_width [(b,a), ...] -> ONNX [b0,b1,...,a0,a1,...]
+    pw = [tuple(p) if isinstance(p, (tuple, list)) else (p, p) for p in pw]
+    pads = [p[0] for p in pw] + [p[1] for p in pw]
+    p = ctx.add_initializer(node.name + "_pads",
+                            onp.asarray(pads, onp.int64))
+    names = [ins[0], p]
+    cv = node._attrs.get("constant_values", 0.0)
+    if mode == "constant" and cv:
+        names.append(ctx.add_initializer(node.name + "_cval",
+                                         onp.asarray(cv, onp.float32)))
+    return ctx.add_node("Pad", names, [out], name=node.name,
+                        mode={"constant": "constant", "edge": "edge",
+                              "reflect": "reflect"}[mode])
+
+
+@register_converter("np:repeat")
+def _np_repeat(ctx, node, ins, out):
+    # repeat(x, s, axis=k) == Resize by integer scale along k for the
+    # nearest-neighbor upsample idiom; general repeat lowers to
+    # Unsqueeze+Tile+Reshape which needs static rank — use the node shape
+    reps = _attr_or_pos(node, "repeats", 0)
+    axis = node._attrs.get("axis")
+    shp = node._inputs[0]._shape
+    if shp is None or axis is None:
+        raise NotImplementedError(
+            "np:repeat export needs a static input shape and axis")
+    axis = axis % len(shp)
+    ax = ctx.add_initializer(node.name + "_uax",
+                             onp.asarray([axis + 1], onp.int64))
+    u = ctx.add_node("Unsqueeze", [ins[0], ax],
+                     [ctx.fresh(node.name + "_u")])
+    tiles = [1] * (len(shp) + 1)
+    tiles[axis + 1] = int(reps)
+    t = ctx.add_initializer(node.name + "_reps",
+                            onp.asarray(tiles, onp.int64))
+    tl = ctx.add_node("Tile", [u, t], [ctx.fresh(node.name + "_t")])
+    new_shape = list(shp)
+    new_shape[axis] = shp[axis] * int(reps)
+    s = ctx.add_initializer(node.name + "_shape",
+                            onp.asarray(new_shape, onp.int64))
+    return ctx.add_node("Reshape", [tl, s], [out], name=node.name)
+
+
+def _cmp_factory(onnx_op):
+    def conv(ctx, node, ins, out):
+        return ctx.add_node(onnx_op, list(ins[:2]), [out], name=node.name)
+    return conv
+
+
+for _mx, _onnx in (("np:equal", "Equal"), ("np:less", "Less"),
+                   ("np:greater", "Greater"),
+                   ("np:less_equal", "LessOrEqual"),
+                   ("np:greater_equal", "GreaterOrEqual"),
+                   ("np:logical_and", "And"), ("np:logical_or", "Or"),
+                   ("np:logical_xor", "Xor"), ("np:mod", "Mod")):
+    _CONVERTERS[_mx] = _cmp_factory(_onnx)
+
+for _mx, _onnx in (("np:logical_not", "Not"), ("np:isnan", "IsNaN"),
+                   ("np:isinf", "IsInf"), ("np:reciprocal", "Reciprocal"),
+                   ("np:tan", "Tan"), ("np:arctan", "Atan"),
+                   ("np:arcsin", "Asin"), ("np:arccos", "Acos"),
+                   ("np:sinh", "Sinh"), ("np:cosh", "Cosh"),
+                   ("np:round", "Round"), ("npx:leaky_relu", "LeakyRelu")):
+    _CONVERTERS[_mx] = _simple_factory(_onnx)
+
+
+@register_converter("npx:gelu")
+def _gelu(ctx, node, ins, out):
+    # exact-erf GELU decomposition (opset13-portable):
+    # 0.5 * x * (1 + erf(x / sqrt(2)))
+    inv_sqrt2 = ctx.add_initializer(
+        node.name + "_isqrt2", onp.asarray(1.0 / onp.sqrt(2.0), onp.float32))
+    half = ctx.add_initializer(node.name + "_half",
+                               onp.asarray(0.5, onp.float32))
+    one = ctx.add_initializer(node.name + "_one",
+                              onp.asarray(1.0, onp.float32))
+    xs = ctx.add_node("Mul", [ins[0], inv_sqrt2],
+                      [ctx.fresh(node.name + "_xs")])
+    er = ctx.add_node("Erf", [xs], [ctx.fresh(node.name + "_erf")])
+    e1 = ctx.add_node("Add", [er, one], [ctx.fresh(node.name + "_e1")])
+    xh = ctx.add_node("Mul", [ins[0], half],
+                      [ctx.fresh(node.name + "_xh")])
+    return ctx.add_node("Mul", [xh, e1], [out], name=node.name)
+
+
+@register_converter("npx:batch_dot")
+def _batch_dot(ctx, node, ins, out):
+    a, b = ins[0], ins[1]
+    # transpose flags lower to explicit Transpose of the last two dims
+    for flag, which in (("transpose_a", 0), ("transpose_b", 1)):
+        if node._attrs.get(flag):
+            src = ins[which]
+            shp = node._inputs[which]._shape
+            if shp is None:
+                raise NotImplementedError(
+                    "batch_dot transpose export needs static rank")
+            perm = list(range(len(shp)))
+            perm[-1], perm[-2] = perm[-2], perm[-1]
+            t = ctx.add_node("Transpose", [src],
+                             [ctx.fresh(node.name + "_t%d" % which)],
+                             perm=perm)
+            if which == 0:
+                a = t
+            else:
+                b = t
+    return ctx.add_node("MatMul", [a, b], [out], name=node.name)
+
+
+@register_converter("npx:one_hot")
+def _one_hot(ctx, node, ins, out):
+    depth = int(_attr_or_pos(node, "depth", 0))
+    on = float(node._attrs.get("on_value", 1.0))
+    off = float(node._attrs.get("off_value", 0.0))
+    d = ctx.add_initializer(node.name + "_depth",
+                            onp.asarray(depth, onp.int64))
+    vals = ctx.add_initializer(node.name + "_vals",
+                               onp.asarray([off, on], onp.float32))
+    return ctx.add_node("OneHot", [ins[0], d, vals], [out],
+                        name=node.name, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# converters: legacy NN breadth (deconv / norms / pad / RNN)
+# ---------------------------------------------------------------------------
+@register_converter("legacy:Deconvolution")
+def _deconv(ctx, node, ins, out):
+    a = node._attrs
+    kernel = tuple(a["kernel"])
+    pad = tuple(a.get("pad") or (0,) * len(kernel))
+    stride = tuple(a.get("stride") or (1,) * len(kernel))
+    adj = tuple(a.get("adj") or (0,) * len(kernel))
+    inputs = list(ins[:2]) + ([] if a.get("no_bias") else list(ins[2:3]))
+    return ctx.add_node("ConvTranspose", inputs, [out], name=node.name,
+                        kernel_shape=list(kernel), pads=list(pad) * 2,
+                        strides=list(stride), output_padding=list(adj),
+                        group=int(a.get("num_group", 1)))
+
+
+@register_converter("legacy:InstanceNorm")
+def _instance_norm(ctx, node, ins, out):
+    return ctx.add_node("InstanceNormalization", list(ins[:3]), [out],
+                        name=node.name,
+                        epsilon=float(node._attrs.get("eps", 1e-3)))
+
+
+@register_converter("legacy:LayerNorm")
+def _legacy_layer_norm(ctx, node, ins, out):
+    return ctx.add_node("LayerNormalization", list(ins[:3]), [out],
+                        name=node.name,
+                        axis=int(node._attrs.get("axis", -1)),
+                        epsilon=float(node._attrs.get("eps", 1e-5)))
+
+
+@register_converter("legacy:L2Normalization")
+def _l2_norm(ctx, node, ins, out):
+    mode = node._attrs.get("mode", "instance")
+    axis = {"instance": 1, "channel": 1, "spatial": 2}.get(mode, 1)
+    return ctx.add_node("LpNormalization", [ins[0]], [out],
+                        name=node.name, axis=axis, p=2)
+
+
+@register_converter("legacy:Pad")
+def _legacy_pad(ctx, node, ins, out):
+    a = node._attrs
+    pw = list(a["pad_width"])
+    n = len(pw) // 2
+    pads = [pw[2 * i] for i in range(n)] + \
+        [pw[2 * i + 1] for i in range(n)]
+    p = ctx.add_initializer(node.name + "_pads",
+                            onp.asarray(pads, onp.int64))
+    names = [ins[0], p]
+    if a.get("mode", "constant") == "constant" and a.get("constant_value"):
+        names.append(ctx.add_initializer(
+            node.name + "_cval",
+            onp.asarray(a["constant_value"], onp.float32)))
+    return ctx.add_node("Pad", names, [out], name=node.name,
+                        mode={"constant": "constant", "edge": "edge",
+                              "reflect": "reflect"}[a.get("mode",
+                                                          "constant")])
+
+
+@register_converter("legacy:UpSampling")
+def _upsampling(ctx, node, ins, out):
+    s = float(node._attrs.get("scale", 2))
+    scales = ctx.add_initializer(node.name + "_scales",
+                                 onp.asarray([1.0, 1.0, s, s], onp.float32))
+    return ctx.add_node("Resize", [ins[0], "", scales], [out],
+                        name=node.name, mode="nearest",
+                        coordinate_transformation_mode="asymmetric",
+                        nearest_mode="floor")
+
+
+# mx fused-RNN gate order -> ONNX gate order, per mode
+_RNN_GATE_PERM = {"lstm": [0, 3, 1, 2],   # mx [i,f,g,o] -> onnx [i,o,f,c]
+                  "gru": [1, 0, 2],       # mx [r,z,n]   -> onnx [z,r,h]
+                  "rnn_tanh": [0], "rnn_relu": [0]}
+
+
+@register_converter("legacy:RNN")
+def _rnn(ctx, node, ins, out):
+    """Fused RNN -> ONNX LSTM/GRU/RNN.  The mx flat parameter vector
+    (layout: rnn-inl.h — all weights layer-major, then all biases) is
+    sliced into ONNX W/R/B with the gate-order permutation applied.
+    Requires the parameter input to be a graph initializer (weights are
+    constants in an exported model) and num_layers=1 unidirectional —
+    the reference exporter has the same restriction
+    (mx2onnx/_op_translations.py convert_RNN)."""
+    a = node._attrs
+    mode = a.get("mode", "lstm")
+    H = int(a["state_size"])
+    if int(a.get("num_layers", 1)) != 1 or a.get("bidirectional"):
+        raise NotImplementedError(
+            "RNN export supports num_layers=1 unidirectional")
+    pname = ins[1]
+    if pname not in ctx.initializers:
+        raise NotImplementedError(
+            "RNN export needs the parameter vector as a constant")
+    flat = onp.asarray(ctx.initializers[pname], onp.float32)
+    ng = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    in_shape = node._inputs[0]._shape
+    if in_shape is None:
+        raise NotImplementedError("RNN export needs a static input shape")
+    I = int(in_shape[-1])
+    perm = _RNN_GATE_PERM[mode]
+    off = 0
+    w_i2h = flat[off:off + ng * H * I].reshape(ng, H, I); off += ng * H * I
+    w_h2h = flat[off:off + ng * H * H].reshape(ng, H, H); off += ng * H * H
+    b_i2h = flat[off:off + ng * H].reshape(ng, H); off += ng * H
+    b_h2h = flat[off:off + ng * H].reshape(ng, H); off += ng * H
+    W = ctx.add_initializer(node.name + "_W",
+                            w_i2h[perm].reshape(1, ng * H, I))
+    R = ctx.add_initializer(node.name + "_R",
+                            w_h2h[perm].reshape(1, ng * H, H))
+    B = ctx.add_initializer(
+        node.name + "_B",
+        onp.concatenate([b_i2h[perm].reshape(-1),
+                         b_h2h[perm].reshape(-1)]).reshape(1, 2 * ng * H))
+    onnx_op = {"lstm": "LSTM", "gru": "GRU",
+               "rnn_tanh": "RNN", "rnn_relu": "RNN"}[mode]
+    kw = {"hidden_size": H}
+    if mode == "rnn_relu":
+        kw["activations"] = ["Relu"]
+    if mode == "gru":
+        kw["linear_before_reset"] = 1  # mx GRU applies r after the h2h GEMM
+    # ONNX *RNN output: (T, num_dirs, B, H); mx fused RNN: (T, B, H)
+    raw = ctx.add_node(onnx_op, [ins[0], W, R, B],
+                       [ctx.fresh(node.name + "_raw")], name=node.name,
+                       **kw)
+    sq_ax = ctx.add_initializer(node.name + "_sqax",
+                                onp.asarray([1], onp.int64))
+    return ctx.add_node("Squeeze", [raw, sq_ax], [out],
+                        name=node.name + "_sq")
 
 
 # ---------------------------------------------------------------------------

@@ -796,7 +796,73 @@ _LEGACY = {
             data, act_type=a.get("act_type", "leaky"),
             slope=a.get("slope", 0.25)),
         "infer": None},
+    "Deconvolution": {
+        "slots": ["weight", "bias"], "aux": [],
+        "make": lambda data, weight, bias=None, **a: npx_mod.deconvolution(
+            data, weight, None if a.get("no_bias") else bias,
+            kernel=tuple(a["kernel"]), num_filter=a["num_filter"],
+            stride=tuple(a.get("stride") or ()) or None,
+            pad=tuple(a.get("pad") or ()) or None,
+            adj=tuple(a.get("adj") or ()) or None,
+            no_bias=a.get("no_bias", False)),
+        # deconv weight layout: (C_in, num_filter, *kernel)
+        "infer": lambda dshape, a: [(dshape[1], a["num_filter"]) +
+                                    tuple(a["kernel"]), (a["num_filter"],)]},
+    "InstanceNorm": {
+        "slots": ["gamma", "beta"], "aux": [],
+        "make": lambda data, gamma, beta, **a: npx_mod.instance_norm(
+            data, gamma, beta, eps=a.get("eps", 1e-3)),
+        "infer": lambda dshape, a: [(dshape[1],), (dshape[1],)]},
+    "LayerNorm": {
+        "slots": ["gamma", "beta"], "aux": [],
+        "make": lambda data, gamma, beta, **a: npx_mod.layer_norm(
+            data, gamma, beta, axis=a.get("axis", -1),
+            eps=a.get("eps", 1e-5)),
+        "infer": lambda dshape, a: [(dshape[a.get("axis", -1)],)] * 2},
+    "L2Normalization": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: npx_mod.l2_normalization(
+            data, eps=a.get("eps", 1e-10), mode=a.get("mode", "instance")),
+        "infer": None},
+    "Pad": {
+        "slots": [], "aux": [],
+        # pad_width: reference convention — 2 values per axis, NCHW
+        "make": lambda data, **a: np_mod.pad(
+            data,
+            [tuple(a["pad_width"][2 * i:2 * i + 2])
+             for i in range(len(a["pad_width"]) // 2)],
+            mode={"constant": "constant", "edge": "edge",
+                  "reflect": "reflect"}[a.get("mode", "constant")],
+            **({"constant_values": a.get("constant_value", 0.0)}
+               if a.get("mode", "constant") == "constant" else {})),
+        "infer": None},
+    "UpSampling": {
+        "slots": [], "aux": [],
+        "make": lambda data, **a: _mk_upsampling(data, a),
+        "infer": None},
+    "RNN": {
+        # data, parameters, state[, state_cell] ride as explicit inputs
+        # (reference rnn.cc takes them as op inputs, not bound slots)
+        "slots": [], "aux": [], "variadic": True,
+        "make": lambda *ins, **a: npx_mod.rnn(
+            ins[0], ins[1], ins[2],
+            ins[3] if a.get("mode", "lstm") == "lstm" and len(ins) > 3
+            else None,
+            mode=a.get("mode", "lstm"),
+            state_size=a["state_size"], num_layers=a.get("num_layers", 1),
+            bidirectional=a.get("bidirectional", False),
+            p=a.get("p", 0.0),
+            state_outputs=a.get("state_outputs", False)),
+        "infer": None},
 }
+
+
+def _mk_upsampling(data, a):
+    s = int(a.get("scale", 2))
+    # nearest-neighbor upsample: repeat along H and W (reference
+    # upsampling.cc sample_type='nearest')
+    out = np_mod.repeat(data, s, axis=-2)
+    return np_mod.repeat(out, s, axis=-1)
 
 
 import functools as _functools
