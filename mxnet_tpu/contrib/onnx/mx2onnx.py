@@ -383,9 +383,58 @@ def _cumsum(ctx, node, ins, out):
 
 @register_converter("np:take")
 def _take(ctx, node, ins, out):
-    axis = _attr_or_pos(node, "axis", 1, 0)
-    return ctx.add_node("Gather", list(ins[:2]), [out], name=node.name,
-                        axis=int(axis) if axis is not None else 0)
+    # positional layout after the Symbol inputs: [indices,] axis, mode —
+    # indices only ride in _extra_pos when passed as a python constant
+    # (sym.take(x, [0, 2])); otherwise they are the second graph input
+    extra = list(node._attrs.get("_extra_pos") or [])
+    data = ins[0]
+    if len(ins) >= 2:
+        idx = ins[1]
+    elif extra:
+        idx = ctx.add_initializer(node.name + "_indices",
+                                  onp.asarray(extra.pop(0), onp.int64))
+    else:
+        raise NotImplementedError("take: no indices argument")
+    axis = node._attrs.get("axis")
+    if axis is None and extra:
+        axis = extra.pop(0)
+    mode = node._attrs.get("mode")
+    if mode is None and extra:
+        mode = extra.pop(0)
+    mode = mode or "clip"
+    if axis is None:
+        # numpy semantics: axis=None gathers from the flattened array
+        shp = ctx.add_initializer(node.name + "_flatshape",
+                                  onp.asarray([-1], onp.int64))
+        data = ctx.add_node("Reshape", [data, shp],
+                            [ctx.fresh(node.name + "_flat")])
+        axis = 0
+    axis = int(axis)
+    idx = ctx.add_node("Cast", [idx], [ctx.fresh(node.name + "_i64")],
+                       to=_elem_type("int64"))
+    if mode in ("clip", "wrap"):
+        # eager take defaults to mode='clip' (numpy/__init__.py:426) but
+        # ONNX Gather errors on out-of-range — bound the indices explicitly
+        shape = ctx.add_node("Shape", [data],
+                             [ctx.fresh(node.name + "_shape")])
+        axc = ctx.add_initializer(node.name + "_axc",
+                                  onp.asarray(axis, onp.int64))
+        dim = ctx.add_node("Gather", [shape, axc],
+                           [ctx.fresh(node.name + "_dim")], axis=0)
+        if mode == "clip":
+            one = ctx.add_initializer(node.name + "_one",
+                                      onp.asarray(1, onp.int64))
+            hi = ctx.add_node("Sub", [dim, one],
+                              [ctx.fresh(node.name + "_hi")])
+            zero = ctx.add_initializer(node.name + "_zero",
+                                       onp.asarray(0, onp.int64))
+            idx = ctx.add_node("Clip", [idx, zero, hi],
+                               [ctx.fresh(node.name + "_clipped")])
+        else:  # wrap == integer modulo (divisor positive → result >= 0)
+            idx = ctx.add_node("Mod", [idx, dim],
+                               [ctx.fresh(node.name + "_wrapped")], fmod=0)
+    return ctx.add_node("Gather", [data, idx], [out], name=node.name,
+                        axis=axis)
 
 
 @register_converter("np:stack")
@@ -565,9 +614,15 @@ def _legacy_layer_norm(ctx, node, ins, out):
 @register_converter("legacy:L2Normalization")
 def _l2_norm(ctx, node, ins, out):
     mode = node._attrs.get("mode", "instance")
-    axis = {"instance": 1, "channel": 1, "spatial": 2}.get(mode, 1)
+    if mode != "channel":
+        # instance/spatial normalize over multiple axes — single-axis
+        # LpNormalization diverges numerically for rank>2 inputs (the
+        # reference exporter also raises for non-channel modes)
+        raise NotImplementedError(
+            "ONNX export of L2Normalization supports mode='channel' only "
+            "(got mode=%r)" % mode)
     return ctx.add_node("LpNormalization", [ins[0]], [out],
-                        name=node.name, axis=axis, p=2)
+                        name=node.name, axis=1, p=2)
 
 
 @register_converter("legacy:Pad")

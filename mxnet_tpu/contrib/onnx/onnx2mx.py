@@ -41,9 +41,11 @@ class _ImportCtx:
                 arr = self.initializers[name]
                 if arr.ndim == 0:
                     # scalar initializers (exported consts) fold back to
-                    # const nodes, not parameters
+                    # const nodes, not parameters; .item() keeps python
+                    # int for integer scalars (a float would promote
+                    # Gather indices clipped against it to float)
                     return Symbol("const", name=name,
-                                  attrs={"value": float(arr)})
+                                  attrs={"value": arr.item()})
                 self.used_params.add(name)
                 s = var(name, shape=arr.shape, dtype=str(arr.dtype),
                         aux=aux)
@@ -184,8 +186,11 @@ def _gather(ctx, node, sym_mod):
         return sym_mod.Embedding(idx, w, input_dim=int(in_dim),
                                  output_dim=int(out_dim),
                                  name=node["output"][0])
+    # ONNX Gather wraps negative indices (idx + dim); mode='wrap' is the
+    # matching take semantics — 'clip' would clip a negative index (e.g.
+    # the exporter's axis=-1 Shape lookup) to 0
     return sym_mod.take(w, idx, axis=int(node["attribute"].get("axis", 0)),
-                        name=node["output"][0])
+                        mode="wrap", name=node["output"][0])
 
 
 @register_importer("Cast")
@@ -281,6 +286,39 @@ def _leaky(ctx, node, sym_mod):
 def _softplus(ctx, node, sym_mod):
     return sym_mod.Activation(ctx.sym_of(node["input"][0]),
                               act_type="softrelu", name=node["output"][0])
+
+
+@register_importer("Shape")
+def _shape_op(ctx, node, sym_mod):
+    return sym_mod.shape_array(ctx.sym_of(node["input"][0]),
+                               name=node["output"][0])
+
+
+@register_importer("Clip")
+def _clip(ctx, node, sym_mod):
+    # opset 11+: min/max ride as optional inputs (possibly computed
+    # tensors, e.g. the take exporter's dim-1); opset <11: attributes
+    out = ctx.sym_of(node["input"][0])
+    ins = node["input"]
+    a = node["attribute"]
+    lo = ctx.sym_of(ins[1]) if len(ins) > 1 and ins[1] else a.get("min")
+    hi = ctx.sym_of(ins[2]) if len(ins) > 2 and ins[2] else a.get("max")
+    if lo is not None:
+        out = sym_mod.maximum(out, lo)
+    if hi is not None:
+        out = sym_mod.minimum(out, hi)
+    return out
+
+
+@register_importer("Mod")
+def _mod(ctx, node, sym_mod):
+    if node["attribute"].get("fmod", 0):
+        return sym_mod.fmod(ctx.sym_of(node["input"][0]),
+                            ctx.sym_of(node["input"][1]),
+                            name=node["output"][0])
+    return sym_mod.mod(ctx.sym_of(node["input"][0]),
+                       ctx.sym_of(node["input"][1]),
+                       name=node["output"][0])
 
 
 @register_importer("Constant")

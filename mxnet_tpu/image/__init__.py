@@ -244,8 +244,9 @@ class HueJitterAug(_JitterAug):
 
 class RandomGrayAug(Augmenter):
     """With probability p, collapse to luminance replicated over channels
-    (reference image.py RandomGrayAug)."""
-    _coef = onp.array([0.299, 0.587, 0.114], onp.float32)
+    (reference image.py RandomGrayAug — which uses 0.21/0.72/0.07, not the
+    Rec.601 coefficients SaturationJitterAug uses)."""
+    _coef = onp.array([0.21, 0.72, 0.07], onp.float32)
 
     def __init__(self, p):
         super().__init__(p=p)

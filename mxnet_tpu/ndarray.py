@@ -74,10 +74,14 @@ def _drain_retired(old):
                     _DEFERRED_ERRORS.append(e)
     finally:
         with _PENDING_LOCK:
-            try:
-                _DRAINING.remove(old)
-            except ValueError:
-                pass  # a concurrent waitall() already claimed the batch
+            # remove by IDENTITY: list.remove compares with ==, and two
+            # same-length batches of jax arrays elementwise-compare into
+            # an ambiguous-truth array (TypeError) while holding the lock
+            for i, b in enumerate(_DRAINING):
+                if b is old:
+                    del _DRAINING[i]
+                    break
+            # else: a concurrent waitall() already claimed the batch
 
 
 def _track(data):
@@ -288,6 +292,13 @@ def _apply_op_bulked(fn, args, kwargs, nd_idx, nd_args, recording,
 
 def _apply_op_eager(fn, args, kwargs, nd_idx, nd_args, recording):
     vals = [a._data for a in nd_args]
+
+    # raw LazyArray args (deferred-VJP replay passes record-time buffers,
+    # which are lazy for chained ops in one segment) must materialize
+    # before jax.vjp sees them
+    if any(type(a) is _bulk.LazyArray for a in args):
+        args = tuple(_bulk.materialize(a) if type(a) is _bulk.LazyArray
+                     else a for a in args)
 
     if recording:
         template = list(args)
@@ -762,6 +773,8 @@ class ndarray:
 
     def take(self, indices, axis=None, mode="clip"):
         idx = _unwrap(indices)
+        if isinstance(idx, (list, tuple)):
+            idx = onp.asarray(idx)
         return apply_op(lambda x: jnp.take(x, idx, axis=axis, mode=mode), self)
 
     def pick(self, index, axis=-1, keepdims=False, mode="clip"):

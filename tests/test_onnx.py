@@ -132,6 +132,55 @@ def test_embedding_roundtrip():
     onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-5)
 
 
+def test_take_roundtrip_variants():
+    rng = onp.random.RandomState(5)
+    xv = rng.randn(3, 4).astype("float32")
+
+    def roundtrip(out, **feeds):
+        model = export_to_model_dict(out, {})
+        sym2, ap, _xp = import_from_model_dict(model)
+        env = {k: mxnp.array(v) for k, v in feeds.items()}
+        (ref,) = out.eval(**env)
+        env.update({k: mxnp.array(v) for k, v in ap.items()})
+        (got,) = sym2.eval(**env)
+        onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(),
+                                    rtol=1e-5)
+        return ref.asnumpy()
+
+    # constant indices + keyword axis
+    x = sym.var("x", shape=(3, 4), dtype="float32")
+    ref = roundtrip(sym.take(x, [0, 2], axis=1), x=xv)
+    onp.testing.assert_allclose(ref, xv[:, [0, 2]], rtol=1e-5)
+
+    # symbolic indices + POSITIONAL axis (regression: axis was read from
+    # _extra_pos[1] and silently exported as axis=0) + out-of-range
+    # index exercising mode='clip' semantics after export
+    i = sym.var("i", shape=(2,), dtype="int32")
+    iv = onp.array([1, 9], onp.int32)  # 9 clips to 3
+    ref = roundtrip(sym.take(x, i, 1), x=xv, i=iv)
+    onp.testing.assert_allclose(ref, xv[:, [1, 3]], rtol=1e-5)
+
+    # axis=None flattens (numpy semantics)
+    ref = roundtrip(sym.take(x, i), x=xv, i=iv)
+    onp.testing.assert_allclose(ref, xv.ravel()[[1, 9]], rtol=1e-5)
+
+    # mode='wrap'
+    ref = roundtrip(sym.take(x, i, 1, "wrap"), x=xv, i=iv)
+    onp.testing.assert_allclose(ref, xv[:, [1, 1]], rtol=1e-5)
+
+    # negative axis (regression: the clip bound's Shape lookup rode a
+    # negative Gather index, which the importer clipped to dim 0)
+    ref = roundtrip(sym.take(x, i, -1), x=xv, i=iv)
+    onp.testing.assert_allclose(ref, xv[:, [1, 3]], rtol=1e-5)
+
+
+def test_l2norm_export_non_channel_mode_raises():
+    x = sym.var("x", shape=(2, 3, 4), dtype="float32")
+    out = sym.L2Normalization(x, mode="instance")
+    with pytest.raises(NotImplementedError, match="channel"):
+        export_to_model_dict(out, {})
+
+
 def test_unconvertible_op_raises_cleanly():
     x = sym.var("x", shape=(4,), dtype="float32")
     weird = sym.Symbol("op", op="npx:gather_nd", inputs=[x, x])
