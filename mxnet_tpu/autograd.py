@@ -149,10 +149,41 @@ def _make_replay(node_fn, out_shapes, out_dtypes, out_is_tuple, n_in,
     return replay
 
 
+_filled_cache = {}  # (shape, dtype, fill) -> device buffer
+_filled_cache_bytes = 0
+_FILLED_BUDGET = 64 << 20  # HBM pinned by cached constants, not entry count
+
+
+def _filled(shape, dtype, fill):
+    """Cached constant buffer (zero cotangents, ones seeds).
+
+    jnp.zeros is an EAGER dispatch; a hybridized ResNet-50's forward node
+    has ~106 BatchNorm-aux outputs, each needing a zero cotangent every
+    backward — uncached that is ~106 device round-trips per step through
+    the remote-chip tunnel.  jax.Arrays are immutable, so sharing one
+    buffer per (shape, dtype) is safe, and the stable buffer id also
+    dedups into one bulk-segment leaf slot.  The eviction valve is
+    byte-budgeted: counting entries would let a few activation-sized
+    cotangents pin GBs of HBM."""
+    global _filled_cache_bytes
+    dt = onp.dtype(dtype)
+    k = (tuple(shape), dt.str, fill)
+    v = _filled_cache.get(k)
+    if v is None:
+        nbytes = int(onp.prod(shape)) * dt.itemsize if shape else dt.itemsize
+        if _filled_cache_bytes + nbytes > _FILLED_BUDGET:
+            _filled_cache.clear()
+            _filled_cache_bytes = 0
+        v = jnp.full(shape, fill, dt)
+        _filled_cache[k] = v
+        _filled_cache_bytes += nbytes
+    return v
+
+
 def _zero_cotangent(shape, dtype):
     dt = onp.dtype(dtype)
     if dt.kind in "fc":
-        return jnp.zeros(shape, dt)
+        return _filled(shape, dt, 0)
     # integer/bool outputs take float0 cotangents in JAX
     return onp.zeros(shape, jax.dtypes.float0)
 
@@ -229,7 +260,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     any_node = False
     for h, hg in zip(heads, head_grads):
         seed = (
-            jnp.ones(h.shape, h.dtype)
+            _filled(h.shape, h.dtype, 1)
             if hg is None
             else (hg._data if isinstance(hg, ndarray) else jnp.asarray(hg))
         )
