@@ -232,8 +232,10 @@ def bench_bert():
              for k, p in params.items()}
     labels = jax.random.randint(jax.random.key(0), (B, L), 0, 256)
 
-    def loss_fn(pv, tok, lab):
-        out, _aux = fn(pv, tok)
+    def loss_fn(pv, tok, lab, i):
+        # per-step RNG: dropout masks (incl. the flash kernel's in-kernel
+        # mask) must differ across iterations, so the key is a traced input
+        out, _aux = fn(pv, tok, key=jax.random.fold_in(jax.random.key(2), i))
         seq = out[0] if isinstance(out, (tuple, list)) else out
         # fixed random head (shape-matched at trace time) — an all-ones
         # projection would make logits identical across classes
@@ -245,21 +247,31 @@ def bench_bert():
         return -jnp.mean(jnp.take_along_axis(lp, lab[..., None], -1))
 
     @jax.jit
-    def step(pv, tok, lab):
-        l, g = jax.value_and_grad(loss_fn)(pv, tok, lab)
+    def step(pv, tok, lab, i):
+        l, g = jax.value_and_grad(loss_fn)(pv, tok, lab, i)
         return l, jax.tree.map(
             lambda p, gg: p - 0.01 * gg.astype(p.dtype), pv, g)
 
     tok = tokens._data
-    l, pv = step(pvals, tok, labels)
+    it_count = iter(range(10**9))
+    l, pv = step(pvals, tok, labels, next(it_count))
     jax.block_until_ready(l)
     first = float(l)
+
+    # the number is only meaningful if the Pallas kernel actually ran:
+    # bert_base trains with dropout=0.1, so this asserts the in-kernel
+    # dropout path dispatched (on CPU the XLA fallback is expected)
+    if on_tpu:
+        from mxnet_tpu.ops import attention as _att
+        assert _att.last_path == "pallas", (
+            "bench_bert must measure the Pallas flash path, got %r"
+            % (_att.last_path,))
 
     def window():
         nonlocal pv
         t0 = time.perf_counter()
         for _ in range(iters):
-            l, pv = step(pv, tok, labels)
+            l, pv = step(pv, tok, labels, next(it_count))
         last = float(l)
         dt = time.perf_counter() - t0
         assert onp.isfinite(last) and last != first, (first, last)

@@ -43,17 +43,21 @@ class MultiHeadAttention(HybridBlock):
         qkv = self.qkv(x)  # (B, L, 3C)
         qkv = qkv.reshape(B, L, 3, H, D).transpose(2, 0, 3, 1, 4)  # (3,B,H,L,D)
         q, k, v = qkv[0], qkv[1], qkv[2]
-        # flash path fuses softmax so attention-probability dropout can't be
-        # applied inside it; route through the unfused path whenever that
-        # dropout is active so both paths regularize identically
-        att_dropout_active = self._dropout and autograd.is_training()
-        if mask is None and self._use_flash and not att_dropout_active:
-            out = npx.flash_attention(q, k, v)  # (B,H,L,D)
+        # the flash kernel covers attention-probability dropout (in-kernel
+        # hash mask) and padding given as a (B,) valid-length vector; only
+        # DENSE masks fall back to the unfused masked-softmax path
+        valid_len = mask if (mask is not None and mask.ndim == 1) else None
+        if self._use_flash and (mask is None or valid_len is not None):
+            out = npx.flash_attention(q, k, v, dropout=self._dropout,
+                                      kv_length=valid_len)  # (B,H,L,D)
         else:
             att = npx.batch_dot(q.reshape(B * H, L, D),
                                 k.reshape(B * H, L, D),
                                 transpose_b=True) / math.sqrt(D)
             if mask is not None:
+                if valid_len is not None:  # (B,) lengths -> (B,1,1,L) keys
+                    mask = (np.arange(L).reshape(1, 1, 1, L)
+                            < valid_len.reshape(B, 1, 1, 1))
                 att = att.reshape(B, H, L, L)
                 att = npx.masked_softmax(att, mask, axis=-1)
                 att = att.reshape(B * H, L, L)

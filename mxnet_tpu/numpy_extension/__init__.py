@@ -342,18 +342,32 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads, **kw):
                     keys_values, attention)
 
 
-def flash_attention(q, k, v, causal=False, window=None, scale=None, **kw):
+def flash_attention(q, k, v, causal=False, window=None, scale=None,
+                    dropout=0.0, kv_length=None, **kw):
     """TPU-native fused attention: q,k,v (B, H, L, D) → (B, H, L, D).
 
     O(L) memory via the Pallas kernel (ops/pallas/flash_attention.py);
-    this supersedes the reference's interleaved_matmul_* + softmax chain."""
+    this supersedes the reference's interleaved_matmul_* + softmax chain.
+    `dropout` applies attention-probability dropout IN the kernel while
+    training (reference transformer.cc attention dropout semantics);
+    `kv_length` (B,) is a per-sequence valid key count (padding mask)."""
     from ..ops.nn import _amp_cast1
-    def f(a, b, c):
+    from .._rng import next_key
+    rate = float(dropout) if autograd.is_training() else 0.0
+    key = next_key() if rate else None
+
+    def f(a, b, c, *rest):
         a = _amp_cast1("flash_attention", a)
         b = _amp_cast1("flash_attention", b)
         c = _amp_cast1("flash_attention", c)
+        kv = rest[0] if rest else None
         return _att.flash_attention(a, b, c, causal=causal,
-                                    window=window, scale=scale)
+                                    window=window, scale=scale,
+                                    dropout=rate, dropout_key=key,
+                                    kv_length=kv)
+
+    if kv_length is not None:
+        return apply_op(f, q, k, v, kv_length)
     return apply_op(f, q, k, v)
 
 

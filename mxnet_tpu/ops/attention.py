@@ -81,8 +81,11 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
 # reference (XLA, non-Pallas) attention — correctness oracle & CPU path
 # --------------------------------------------------------------------------
 def attention_reference(q, k, v, mask=None, causal=False, window=None,
-                        scale=None):
-    """q,k,v: (B, H, L, D). Returns (B, H, L, D)."""
+                        scale=None, dropout=0.0, dropout_key=None,
+                        kv_length=None):
+    """q,k,v: (B, H, L, D). Returns (B, H, L, D).  `kv_length` is a (B,)
+    valid key count (padding); `dropout` drops normalized attention
+    probabilities using `dropout_key` (a jax PRNG key)."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
@@ -96,10 +99,17 @@ def attention_reference(q, k, v, mask=None, causal=False, window=None,
         ki = jnp.arange(Lk)[None, :]
         wm = jnp.abs(qi - ki) <= window
         logits = jnp.where(wm, logits, -jnp.inf)
+    if kv_length is not None:
+        km = jnp.arange(Lk)[None, None, None, :] < jnp.asarray(
+            kv_length).reshape(-1)[:, None, None, None]
+        logits = jnp.where(km, logits, -jnp.inf)
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
     p = jax.nn.softmax(logits, axis=-1)
     p = jnp.where(jnp.isnan(p), 0.0, p)
+    if dropout and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, p.shape)
+        p = p * keep / (1.0 - dropout)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -155,20 +165,32 @@ def _pallas_mode():
     return None
 
 
-def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None):
+def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None,
+                    dropout=0.0, dropout_key=None, kv_length=None):
     """Blockwise O(L)-memory attention with a Pallas-kernel custom VJP.
     Uses the Pallas TPU kernel (fwd + bwd) on any accelerator backend;
     falls back to the XLA reference path on CPU or for features the kernel
-    does not cover (dense masks, cross-attention with Lq != Lk)."""
+    does not cover (dense masks, cross-attention with Lq != Lk).
+
+    `dropout` (with `dropout_key`, a jax PRNG key) applies attention-
+    probability dropout IN KERNEL (hash-based mask, regenerated by the
+    backward kernels); `kv_length` (B,) is a padding mask as a per-row
+    valid key count.  Both keep the call on the Pallas fast path."""
     global last_path, _fallback_warned
+    if dropout and dropout_key is None:
+        raise ValueError("flash_attention: dropout > 0 requires dropout_key")
     mode = _pallas_mode()
     eligible = (mask is None and mode is not None
                 and q.shape[-2] == k.shape[-2])
     if eligible:
         try:
             from .pallas.flash_attention import flash_attention_tpu
+            seed = None
+            if dropout:
+                seed = jax.random.bits(dropout_key, (1,), jnp.uint32)
             out = flash_attention_tpu(q, k, v, causal=causal, window=window,
-                                      scale=scale,
+                                      scale=scale, dropout=float(dropout),
+                                      seed=seed, kv_length=kv_length,
                                       interpret=(mode == "interpret"))
             last_path = "pallas" if mode == "compiled" else "pallas-interpret"
             return out
@@ -182,7 +204,8 @@ def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None):
                 _fallback_warned = True
     last_path = "xla"
     return attention_reference(q, k, v, mask=mask, causal=causal,
-                               window=window, scale=scale)
+                               window=window, scale=scale, dropout=dropout,
+                               dropout_key=dropout_key, kv_length=kv_length)
 
 
 # --------------------------------------------------------------------------

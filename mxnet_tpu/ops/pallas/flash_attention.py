@@ -40,9 +40,11 @@ _NEG_INF = float("-inf")
 _LANES = 128  # lane width: (m, l) carries are kept lane-broadcast
 
 
-def _block_mask(s_shape, qi, ki, block_q, block_k, causal, window):
-    """Boolean mask for one (block_q, block_k) score tile, or None."""
-    if not causal and window is None:
+def _block_mask(s_shape, qi, ki, block_q, block_k, causal, window,
+                kvlen=None):
+    """Boolean mask for one (block_q, block_k) score tile, or None.
+    `kvlen` is a dynamic per-batch valid key count (padding mask)."""
+    if not causal and window is None and kvlen is None:
         return None
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s_shape, 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s_shape, 1)
@@ -52,10 +54,13 @@ def _block_mask(s_shape, qi, ki, block_q, block_k, causal, window):
     if window is not None:
         wm = jnp.abs(q_pos - k_pos) <= window
         mask = wm if mask is None else (mask & wm)
+    if kvlen is not None:
+        km = k_pos < kvlen
+        mask = km if mask is None else (mask & km)
     return mask
 
 
-def _block_needed(qi, ki, block_q, block_k, causal, window):
+def _block_needed(qi, ki, block_q, block_k, causal, window, kvlen=None):
     """Whether any element of score tile (qi, ki) survives the mask."""
     need = True
     q_first = qi * block_q
@@ -67,13 +72,15 @@ def _block_needed(qi, ki, block_q, block_k, causal, window):
     if window is not None:
         need = jnp.logical_and(need, k_first <= q_last + window)
         need = jnp.logical_and(need, k_last >= q_first - window)
+    if kvlen is not None:
+        need = jnp.logical_and(need, k_first < kvlen)
     return need
 
 
-def _block_boundary(qi, ki, block_q, block_k, causal, window):
+def _block_boundary(qi, ki, block_q, block_k, causal, window, kvlen=None):
     """Whether tile (qi, ki) intersects a mask edge (needs per-element
     masking).  Interior tiles skip the iota/where work entirely."""
-    if not causal and window is None:
+    if not causal and window is None and kvlen is None:
         return False
     q_first = qi * block_q
     q_last = q_first + block_q - 1
@@ -85,17 +92,20 @@ def _block_boundary(qi, ki, block_q, block_k, causal, window):
     if window is not None:
         interior = jnp.logical_and(interior, q_last - k_first <= window)
         interior = jnp.logical_and(interior, k_last - q_first <= window)
+    if kvlen is not None:
+        interior = jnp.logical_and(interior, k_last < kvlen)
     return jnp.logical_not(interior)
 
 
-def _masked_dispatch(qi, ki, block_q, block_k, causal, window, step):
+def _masked_dispatch(qi, ki, block_q, block_k, causal, window, kvlen, step):
     """Run `step(use_mask)` for tile (qi, ki): skipped when fully masked,
     without per-element masking on interior tiles, with it on tiles that
     intersect a mask edge.  Shared by the forward and both backward
     kernels."""
-    needed = _block_needed(qi, ki, block_q, block_k, causal, window)
-    if causal or window is not None:
-        boundary = _block_boundary(qi, ki, block_q, block_k, causal, window)
+    needed = _block_needed(qi, ki, block_q, block_k, causal, window, kvlen)
+    if causal or window is not None or kvlen is not None:
+        boundary = _block_boundary(qi, ki, block_q, block_k, causal, window,
+                                   kvlen)
         pl.when(jnp.logical_and(needed, boundary))(lambda: step(True))
         pl.when(jnp.logical_and(needed, jnp.logical_not(boundary)))(
             lambda: step(False))
@@ -104,12 +114,49 @@ def _masked_dispatch(qi, ki, block_q, block_k, causal, window, step):
 
 
 # ---------------------------------------------------------------------------
+# in-kernel dropout: counter-based hash, no PRNG primitive
+# ---------------------------------------------------------------------------
+def hash_keep_bits(seed, b, gi, gj):
+    """Deterministic pseudo-random uint32 per (seed, batch-head, q-pos,
+    k-pos), built from pure uint32 vector arithmetic (multiply/xor/shift):
+    runs identically on the TPU vector unit, in Pallas interpret mode, and
+    in plain XLA (the oracle in tests) — unlike pltpu.prng_*, which has no
+    CPU lowering.  Position-based counters make the mask independent of
+    the block tiling, so the forward and both backward kernels regenerate
+    the exact same mask from their own grids.  Murmur3's finalizer gives
+    the avalanche; the linear pre-mix only needs to separate coordinates."""
+    u = jnp.uint32
+    h = (gi.astype(u) * u(0x9E3779B1)) ^ (gj.astype(u) * u(0x85EBCA77))
+    h = h ^ (jnp.asarray(seed, u) + jnp.asarray(b, jnp.int32).astype(u)
+             * u(0xC2B2AE3D))
+    h = h ^ (h >> u(16))
+    h = h * u(0x85EBCA6B)
+    h = h ^ (h >> u(13))
+    h = h * u(0xC2B2AE35)
+    h = h ^ (h >> u(16))
+    return h
+
+
+def _keep_scale(seed, b, qi, ki, shape, block_q, block_k, rate):
+    """Float32 dropout multiplier tile: 0 where dropped, 1/(1-rate) kept."""
+    gi = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    gj = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    h = hash_keep_bits(seed, b, gi, gj)
+    thr = jnp.uint32(min(int(round(rate * 4294967296.0)), 4294967295))
+    return (h >= thr).astype(jnp.float32) * (1.0 / (1.0 - rate))
+
+
+# ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, window, block_q, block_k, num_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, kvlen_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale, causal, window, block_q, block_k, num_k, dropout,
+                has_kvlen):
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    kvlen = kvlen_ref[b] if has_kvlen else None
 
     @pl.when(ki == 0)
     def _init():
@@ -128,7 +175,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32) * scale
         if use_mask:
             mask = _block_mask(s.shape, qi, ki, block_q, block_k, causal,
-                               window)
+                               window, kvlen)
             s = jnp.where(mask, s, _MASKED)
 
         m_prev = jnp.max(m_scr[:], axis=-1, keepdims=True)   # (bq, 1)
@@ -137,13 +184,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_next = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_next)
         p = jnp.exp(s - m_next)                        # (bq, bk)
+        # the softmax normalizer accumulates the UNdropped p — dropout
+        # applies to normalized probabilities, and scaling commutes
         l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout:
+            p = p * _keep_scale(seed_ref[0], b, qi, ki, p.shape,
+                                block_q, block_k, dropout)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_next, l_scr.shape)
 
-    _masked_dispatch(qi, ki, block_q, block_k, causal, window, _step)
+    _masked_dispatch(qi, ki, block_q, block_k, causal, window, kvlen, _step)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -154,14 +206,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
 
 
-def _fwd_call(q, k, v, causal, window, scale, block_q, block_k, interpret):
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _fwd_call(q, k, v, seed, kvlen, causal, window, scale, dropout,
+              has_kvlen, block_q, block_k, interpret):
     BH, L, D = q.shape
     num_q = L // block_q
     num_k = L // block_k
     grid = (BH, num_q, num_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k, num_k=num_k)
+        block_q=block_q, block_k=block_k, num_k=num_k, dropout=dropout,
+        has_kvlen=has_kvlen)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -169,6 +227,8 @@ def _fwd_call(q, k, v, causal, window, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            _smem_spec(),
+            _smem_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -186,17 +246,21 @@ def _fwd_call(q, k, v, causal, window, scale, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, seed, kvlen)
     return out, lse
 
 
 # ---------------------------------------------------------------------------
 # backward: dq kernel (grid over q blocks, streams k blocks)
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, window, block_q, block_k, num_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+                   kvlen_ref, dq_ref, dq_scr,
+                   *, scale, causal, window, block_q, block_k, num_k, dropout,
+                   has_kvlen):
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    kvlen = kvlen_ref[b] if has_kvlen else None
 
     @pl.when(ki == 0)
     def _init():
@@ -213,16 +277,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32) * scale
         if use_mask:
             mask = _block_mask(s.shape, qi, ki, block_q, block_k, causal,
-                               window)
+                               window, kvlen)
             s = jnp.where(mask, s, _MASKED)
         p = jnp.exp(s - lse)                           # masked -> exp(-1e30)=0
+        if has_kvlen:
+            # a fully-padded row has lse = -inf; exp(s + inf) would poison
+            p = jnp.where(lse == _NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout:
+            # chain rule through the dropout mask applied to normalized
+            # probabilities (delta = sum(do*o) already equals
+            # sum_k p*dp_dropped — see _flash_bwd docstring)
+            dp = dp * _keep_scale(seed_ref[0], b, qi, ki, dp.shape,
+                                  block_q, block_k, dropout)
         ds = (p * (dp - delta)).astype(k.dtype)        # (bq, bk)
         dq_scr[:] = dq_scr[:] + jnp.dot(
             ds, k, preferred_element_type=jnp.float32) * scale
 
-    _masked_dispatch(qi, ki, block_q, block_k, causal, window, _step)
+    _masked_dispatch(qi, ki, block_q, block_k, causal, window, kvlen, _step)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -233,10 +306,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 # backward: dk/dv kernel (grid over k blocks, streams q blocks)
 # ---------------------------------------------------------------------------
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, window, block_q, block_k, num_q):
+                    seed_ref, kvlen_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, window, block_q, block_k, num_q,
+                    dropout, has_kvlen):
+    b = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
+    kvlen = kvlen_ref[b] if has_kvlen else None
 
     @pl.when(qi == 0)
     def _init():
@@ -254,22 +330,35 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32) * scale
         if use_mask:
             mask = _block_mask(s.shape, qi, ki, block_q, block_k, causal,
-                               window)
+                               window, kvlen)
             s = jnp.where(mask, s, _MASKED)
         p = jnp.exp(s - lse)                           # masked -> exp(-1e30)=0
-        # dv += p.T @ do : contract the q dimension
+        if has_kvlen:
+            p = jnp.where(lse == _NEG_INF, 0.0, p)
+        if dropout:
+            # seeded by GLOBAL positions, so this grid (b, ki, qi) rebuilds
+            # the identical mask the forward's (b, qi, ki) grid drew
+            keep = _keep_scale(seed_ref[0], b, qi, ki, p.shape,
+                               block_q, block_k, dropout)
+            pd = p * keep
+        else:
+            keep = None
+            pd = p
+        # dv += dropped(p).T @ do : contract the q dimension
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if keep is not None:
+            dp = dp * keep
         ds = (p * (dp - delta)).astype(q.dtype)        # (bq, bk)
         # dk += ds.T @ q, scaled to match s = (q @ k.T) * scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    _masked_dispatch(qi, ki, block_q, block_k, causal, window, _step)
+    _masked_dispatch(qi, ki, block_q, block_k, causal, window, kvlen, _step)
 
     @pl.when(qi == num_q - 1)
     def _finalize():
@@ -277,8 +366,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, do, lse, delta, causal, window, scale,
-              block_q, block_k, interpret):
+def _bwd_call(q, k, v, do, lse, delta, seed, kvlen, causal, window, scale,
+              dropout, has_kvlen, block_q, block_k, interpret):
     BH, L, D = q.shape
     num_q = L // block_q
     num_k = L // block_k
@@ -286,7 +375,8 @@ def _bwd_call(q, k, v, do, lse, delta, causal, window, scale,
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, window=window,
-            block_q=block_q, block_k=block_k, num_k=num_k),
+            block_q=block_q, block_k=block_k, num_k=num_k, dropout=dropout,
+            has_kvlen=has_kvlen),
         grid=(BH, num_q, num_k),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -295,6 +385,8 @@ def _bwd_call(q, k, v, do, lse, delta, causal, window, scale,
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            _smem_spec(),
+            _smem_spec(),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
@@ -302,12 +394,13 @@ def _bwd_call(q, k, v, do, lse, delta, causal, window, scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, seed, kvlen)
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
-            block_q=block_q, block_k=block_k, num_q=num_q),
+            block_q=block_q, block_k=block_k, num_q=num_q, dropout=dropout,
+            has_kvlen=has_kvlen),
         grid=(BH, num_k, num_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
@@ -316,6 +409,8 @@ def _bwd_call(q, k, v, do, lse, delta, causal, window, scale,
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            _smem_spec(),
+            _smem_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -332,46 +427,61 @@ def _bwd_call(q, k, v, do, lse, delta, causal, window, scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, seed, kvlen)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # custom-VJP core on (BH, L, D) tensors
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, window, scale, block_q, block_k, interpret):
-    out, _ = _fwd_call(q, k, v, causal, window, scale, block_q, block_k,
-                       interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _flash(q, k, v, seed, kvlen, causal, window, scale, dropout, has_kvlen,
+           block_q, block_k, interpret):
+    out, _ = _fwd_call(q, k, v, seed, kvlen, causal, window, scale, dropout,
+                       has_kvlen, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, window, scale, block_q, block_k, interpret):
-    out, lse = _fwd_call(q, k, v, causal, window, scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, seed, kvlen, causal, window, scale, dropout,
+               has_kvlen, block_q, block_k, interpret):
+    out, lse = _fwd_call(q, k, v, seed, kvlen, causal, window, scale,
+                         dropout, has_kvlen, block_q, block_k, interpret)
+    return out, (q, k, v, seed, kvlen, out, lse)
 
 
-def _flash_bwd(causal, window, scale, block_q, block_k, interpret,
-               residuals, g):
-    q, k, v, out, lse = residuals
+def _flash_bwd(causal, window, scale, dropout, has_kvlen, block_q, block_k,
+               interpret, residuals, g):
+    """With dropout, O = (P ⊙ M/(1-r)) V where P = softmax(S).  The usual
+    delta = Σ_d dO·O still equals Σ_k P·dP (dP = chain through the mask),
+    because Σ_k P_ik dP_ik = Σ_k (P ⊙ M/(1-r))_ik (dO V^T)_ik = dO_i·O_i —
+    so the standard recomputation trick survives dropout unchanged."""
+    q, k, v, seed, kvlen, out, lse = residuals
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
                     keepdims=True)
-    dq, dk, dv = _bwd_call(q, k, v, g, lse, delta, causal, window, scale,
-                           block_q, block_k, interpret)
-    return dq, dk, dv
+    dq, dk, dv = _bwd_call(q, k, v, g, lse, delta, seed, kvlen, causal,
+                           window, scale, dropout, has_kvlen, block_q,
+                           block_k, interpret)
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "window", "scale",
-                                             "block_q", "block_k",
+                                             "dropout", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_tpu(q, k, v, causal=False, window=None, scale=None,
+                        dropout=0.0, seed=None, kv_length=None,
                         block_q=512, block_k=1024, interpret=False):
     """q,k,v: (B, H, L, D) → (B, H, L, D).  Differentiable (custom VJP with
-    Pallas backward kernels).  `window` is a symmetric band half-width."""
+    Pallas backward kernels).  `window` is a symmetric band half-width.
+
+    `dropout` applies in-kernel dropout to the normalized attention
+    probabilities (reference semantics: transformer.cc:650-826 attention
+    dropout), regenerated in the backward kernels from the same hash —
+    `seed` (uint32 scalar/array) picks the mask.  `kv_length` is a (B,)
+    per-sequence valid key count (padding mask as a per-row k-limit)."""
     B, H, L, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     block_q = min(block_q, L)
@@ -383,6 +493,16 @@ def flash_attention_tpu(q, k, v, causal=False, window=None, scale=None,
     qr = q.reshape(B * H, L, D)
     kr = k.reshape(B * H, L, D)
     vr = v.reshape(B * H, L, D)
-    out = _flash(qr, kr, vr, causal, window, scale, block_q, block_k,
-                 interpret)
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.uint32)
+    else:
+        seed = jnp.asarray(seed, jnp.uint32).reshape(-1)[:1]
+    has_kvlen = kv_length is not None
+    if has_kvlen:
+        # one entry per (batch, head) program: bh = b * H + h
+        kvlen = jnp.repeat(jnp.asarray(kv_length, jnp.int32).reshape(B), H)
+    else:
+        kvlen = jnp.zeros((1,), jnp.int32)
+    out = _flash(qr, kr, vr, seed, kvlen, causal, window, scale,
+                 float(dropout), has_kvlen, block_q, block_k, interpret)
     return out.reshape(B, H, L, D)
