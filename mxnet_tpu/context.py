@@ -126,3 +126,21 @@ num_gpus = num_tpus
 
 def device_count():
     return len(jax.devices())
+
+
+def tpu_memory_info(device_id=0):
+    """(free_bytes, total_bytes) for one accelerator device.
+
+    Parity: mx.context.gpu_memory_info (python/mxnet/context.py →
+    MXGetGPUMemoryInformation64).  Backed by the PJRT allocator stats when
+    available, else the live-buffer census (profiler.device_memory_stats);
+    total comes from the chip-spec table / MXNET_TPU_HBM_BYTES."""
+    from . import profiler
+    devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    d = devs[device_id]
+    st = profiler.device_memory_stats(d)
+    total = st.get("bytes_limit") or 0
+    return max(total - st["bytes_in_use"], 0), total
+
+
+gpu_memory_info = tpu_memory_info

@@ -30,6 +30,24 @@ from ..context import Context, current_context
 from ..ndarray import ndarray, _wrap_value, apply_op
 from .parameter import Parameter, DeferredInitializationError
 
+_KEYLESS = {}
+
+
+def _keyless_dummy():
+    """Constant key fed to cached graphs that consume no randomness: the
+    jitted fn still takes the key argument, but a stable unused constant
+    costs nothing, while next_key()'s fold_in is an eager device dispatch
+    (~1ms/call through the remote tunnel)."""
+    k = _KEYLESS.get("k")
+    if k is None:
+        # must be CONCRETE even when first requested under an ambient
+        # trace (nested hybridized block): a traced key cached here would
+        # leak the tracer into later calls
+        with jax.ensure_compile_time_eval():
+            k = jax.random.key(0)
+        _KEYLESS["k"] = k
+    return k
+
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
@@ -409,9 +427,13 @@ class HybridBlock(Block):
 
                 targs = [rebuild(a) for a in args]
                 tkwargs = {k: rebuild(v) for k, v in kwargs.items()}
-                with trace_keys(key):
+                with trace_keys(key) as holder:
                     with autograd._RecordingStateScope(False, outer_training):
                         out = self.forward(*targs, **tkwargs)
+                # how many keys the graph consumed: a keyless graph (all
+                # inference nets) lets every later call skip the eager
+                # next_key() fold_in — a full device round-trip per call
+                tree_template["n_keys"] = holder["count"]
                 flat_out = []
                 _flatten_arrays(out, flat_out)
                 tree_template["out"] = out
@@ -446,7 +468,12 @@ class HybridBlock(Block):
         fn = cache["fn"]
         pvals = [live[n]._data._data for n in pnames]
         ivals = [a._data for a in flat_inputs]
-        key = next_key()
+        # the key argument is only materialized when the traced graph
+        # consumes randomness (n_keys unknown until the first call traces)
+        if cache["template"].get("n_keys", 1):
+            key = next_key()
+        else:
+            key = _keyless_dummy()
 
         diff_params = [live[n]._data for n in pnames]
 

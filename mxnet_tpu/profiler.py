@@ -158,3 +158,103 @@ class Marker:
 
 def scope(name="<unk>:"):
     return Task(name)
+
+
+# ---------------------------------------------------------------------------
+# device-memory (HBM) observability
+#
+# Parity: reference `src/profiler/storage_profiler.h:131` (per-device
+# memory aggregates surfaced through `c_api_profile.cc:197`).  Re-based on
+# PJRT: the plugin's allocator stats when it exposes them, else a
+# client-side census of live jax.Arrays (the axon-tunneled chip returns
+# None from memory_stats(), so the census is the common path there).
+# ---------------------------------------------------------------------------
+_PEAKS = {}  # device -> peak bytes observed by the census
+
+# device_kind prefix -> (HBM bytes, bf16 matmul peak FLOP/s).  Public chip
+# specs; override with MXNET_TPU_HBM_BYTES / MXNET_TPU_PEAK_FLOPS when the
+# platform reports an unknown kind.
+_CHIP_SPECS = (
+    ("TPU v5 lite", 16 << 30, 197e12),   # v5e
+    ("TPU v5e", 16 << 30, 197e12),
+    ("TPU v5p", 95 << 30, 459e12),
+    ("TPU v5", 95 << 30, 459e12),
+    ("TPU v6", 32 << 30, 918e12),        # Trillium
+    ("TPU v4", 32 << 30, 275e12),
+    ("TPU v3", 32 << 30, 123e12),
+    ("TPU v2", 16 << 30, 46e12),
+)
+
+
+def chip_spec(device=None):
+    """{'device_kind', 'hbm_bytes', 'peak_flops_bf16'} for a device (None =
+    default device); unknown kinds yield None fields unless the MXNET_TPU_*
+    env overrides are set."""
+    import jax
+    d = device if device is not None else jax.devices()[0]
+    kind = getattr(d, "device_kind", "") or ""
+    hbm = peak = None
+    for prefix, h, p in _CHIP_SPECS:
+        if kind.startswith(prefix):
+            hbm, peak = h, p
+            break
+    env_hbm = os.environ.get("MXNET_TPU_HBM_BYTES")
+    env_peak = os.environ.get("MXNET_TPU_PEAK_FLOPS")
+    if env_hbm:
+        hbm = int(float(env_hbm))
+    if env_peak:
+        peak = float(env_peak)
+    return {"device_kind": kind, "hbm_bytes": hbm,
+            "peak_flops_bf16": peak}
+
+
+def device_memory_stats(device=None):
+    """Per-device memory usage: bytes_in_use / peak_bytes_in_use /
+    bytes_limit.
+
+    source='pjrt' when the plugin's allocator stats are available
+    (authoritative, includes XLA temp buffers); source='live_arrays' is a
+    client-side census of live jax.Array shards on the device — it misses
+    in-flight executable temps but tracks the working set and its peak."""
+    import jax
+    d = device if device is not None else jax.devices()[0]
+    stats = None
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        stats = None
+    spec = chip_spec(d)
+    if stats:
+        return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+                "bytes_limit": int(stats.get("bytes_limit")
+                                   or spec["hbm_bytes"] or 0) or None,
+                "num_allocs": stats.get("num_allocs"),
+                "source": "pjrt"}
+    total = 0
+    count = 0
+    for a in jax.live_arrays():
+        try:
+            for sh in a.addressable_shards:
+                if sh.device == d:
+                    total += sh.data.nbytes
+                    count += 1
+        except Exception:
+            continue  # deleted/donated arrays mid-iteration
+    peak = max(_PEAKS.get(d, 0), total)
+    _PEAKS[d] = peak
+    return {"bytes_in_use": total, "peak_bytes_in_use": peak,
+            "bytes_limit": spec["hbm_bytes"], "num_live_buffers": count,
+            "source": "live_arrays"}
+
+
+def sample_device_memory(device=None, name="device_memory"):
+    """Record the current device-memory census as a chrome-trace counter
+    sample (reference: the storage profiler's per-device counter series)
+    and return it."""
+    st = device_memory_stats(device)
+    if _STATE["running"]:
+        _emit(name, "counter", "C", time.time(),
+              {"bytes_in_use": st["bytes_in_use"],
+               "peak_bytes_in_use": st["peak_bytes_in_use"]})
+    return st
