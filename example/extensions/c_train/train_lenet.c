@@ -1,0 +1,190 @@
+/* Train LeNet end-to-end from C through libmxtpu_capi.so.
+ *
+ * Parity: the reference's C-API training loop (what a non-Python
+ * embedder writes against include/mxnet/c_api.h): create parameter
+ * NDArrays, run imperative forward ops under autograd recording,
+ * backward, then SGD updates via the optimizer handle.  Prints the loss
+ * per iteration; exits 0 iff the loss decreased.
+ *
+ * Build/run (the test driver tests/test_c_train.py does this):
+ *   gcc train_lenet.c -I include -L mxnet_tpu/lib -lmxtpu_capi \
+ *       -Wl,-rpath,mxnet_tpu/lib -o train_lenet && ./train_lenet
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "mxtpu_c_api.h"
+
+#define CHECK(expr)                                                    \
+  do {                                                                 \
+    if ((expr) != 0) {                                                 \
+      fprintf(stderr, "FAIL %s:%d %s: %s\n", __FILE__, __LINE__, #expr, \
+              MXTGetLastError());                                      \
+      exit(1);                                                         \
+    }                                                                  \
+  } while (0)
+
+static MXTHandle randn(int64_t* shape, int ndim, double scale) {
+  /* host-side gaussian-ish init: sum of 4 uniforms, centered */
+  size_t n = 1;
+  int i;
+  for (i = 0; i < ndim; ++i) n *= (size_t)shape[i];
+  float* buf = (float*)malloc(n * sizeof(float));
+  size_t j;
+  for (j = 0; j < n; ++j) {
+    double s = 0;
+    for (i = 0; i < 4; ++i) s += (double)rand() / RAND_MAX;
+    buf[j] = (float)((s - 2.0) * scale);
+  }
+  MXTHandle h;
+  CHECK(MXTNDArrayFromBytes(shape, ndim, "float32", buf,
+                            n * sizeof(float), &h));
+  free(buf);
+  return h;
+}
+
+/* one imperative op with one output */
+static MXTHandle op1(const char* name, MXTHandle* ins, int nin,
+                     const char* kwargs) {
+  MXTHandle outs[4];
+  int nout = 4;
+  CHECK(MXTImperativeInvoke(name, ins, nin, kwargs, outs, &nout));
+  if (nout < 1) {
+    fprintf(stderr, "op %s returned no outputs\n", name);
+    exit(1);
+  }
+  /* extra outputs (e.g. none expected here) are released */
+  int i;
+  for (i = 1; i < nout; ++i) MXTNDArrayFree(outs[i]);
+  return outs[0];
+}
+
+int main(void) {
+  srand(7);
+  CHECK(MXTRandomSeed(7));
+
+  int ver;
+  CHECK(MXTVersion(&ver));
+  fprintf(stderr, "mxtpu c api version %d\n", ver);
+
+  const int B = 32, CLASSES = 10;
+
+  /* LeNet parameters */
+  int64_t s_c1w[] = {6, 1, 5, 5}, s_c1b[] = {6};
+  int64_t s_c2w[] = {16, 6, 5, 5}, s_c2b[] = {16};
+  int64_t s_f1w[] = {120, 400}, s_f1b[] = {120};
+  int64_t s_f2w[] = {84, 120}, s_f2b[] = {84};
+  int64_t s_f3w[] = {10, 84}, s_f3b[] = {10};
+  MXTHandle params[10];
+  params[0] = randn(s_c1w, 4, 0.2);
+  params[1] = randn(s_c1b, 1, 0.0);
+  params[2] = randn(s_c2w, 4, 0.1);
+  params[3] = randn(s_c2b, 1, 0.0);
+  params[4] = randn(s_f1w, 2, 0.1);
+  params[5] = randn(s_f1b, 1, 0.0);
+  params[6] = randn(s_f2w, 2, 0.1);
+  params[7] = randn(s_f2b, 1, 0.0);
+  params[8] = randn(s_f3w, 2, 0.1);
+  params[9] = randn(s_f3b, 1, 0.0);
+  CHECK(MXTAutogradMarkVariables(10, params));
+
+  /* synthetic batch: images + labels (labels = argmax of a fixed random
+   * projection, so the task is learnable) */
+  int64_t s_x[] = {B, 1, 28, 28};
+  MXTHandle x = randn(s_x, 4, 0.5);
+  float labels[32];
+  int i;
+  for (i = 0; i < B; ++i) labels[i] = (float)(i % CLASSES);
+  int64_t s_y[] = {B};
+  MXTHandle y;
+  CHECK(MXTNDArrayFromBytes(s_y, 1, "float32", labels, sizeof(labels), &y));
+
+  MXTHandle opt;
+  CHECK(MXTOptimizerCreate(
+      "sgd", "{\"learning_rate\": 0.1, \"momentum\": 0.9}", &opt));
+
+  double first = 0, last = 0;
+  int it;
+  for (it = 0; it < 30; ++it) {
+    int prev;
+    CHECK(MXTAutogradSetRecording(1, &prev));
+    CHECK(MXTAutogradSetTraining(1, NULL));
+
+    /* forward: conv-tanh-pool x2 -> dense x3 */
+    MXTHandle c1_in[] = {x, params[0], params[1]};
+    MXTHandle h = op1("convolution", c1_in, 3,
+                      "{\"kernel\": [5, 5], \"num_filter\": 6,"
+                      " \"pad\": [2, 2]}");
+    MXTHandle t = op1("tanh", &h, 1, "");
+    MXTNDArrayFree(h);
+    h = op1("pooling", &t, 1, "{\"kernel\": [2, 2], \"stride\": [2, 2]}");
+    MXTNDArrayFree(t);
+
+    MXTHandle c2_in[] = {h, params[2], params[3]};
+    t = op1("convolution", c2_in, 3,
+            "{\"kernel\": [5, 5], \"num_filter\": 16}");
+    MXTNDArrayFree(h);
+    h = op1("tanh", &t, 1, "");
+    MXTNDArrayFree(t);
+    t = op1("pooling", &h, 1, "{\"kernel\": [2, 2], \"stride\": [2, 2]}");
+    MXTNDArrayFree(h);
+
+    MXTHandle f1_in[] = {t, params[4], params[5]};
+    h = op1("fully_connected", f1_in, 3, "{\"num_hidden\": 120}");
+    MXTNDArrayFree(t);
+    t = op1("tanh", &h, 1, "");
+    MXTNDArrayFree(h);
+    MXTHandle f2_in[] = {t, params[6], params[7]};
+    h = op1("fully_connected", f2_in, 3, "{\"num_hidden\": 84}");
+    MXTNDArrayFree(t);
+    t = op1("tanh", &h, 1, "");
+    MXTNDArrayFree(h);
+    MXTHandle f3_in[] = {t, params[8], params[9]};
+    MXTHandle logits = op1("fully_connected", f3_in, 3,
+                           "{\"num_hidden\": 10}");
+    MXTNDArrayFree(t);
+
+    /* softmax cross-entropy: -mean(pick(log_softmax(logits), y)) */
+    h = op1("log_softmax", &logits, 1, "{\"axis\": -1}");
+    MXTNDArrayFree(logits);
+    MXTHandle pick_in[] = {h, y};
+    t = op1("pick", pick_in, 2, "{\"axis\": -1}");
+    MXTNDArrayFree(h);
+    h = op1("mean", &t, 1, "");
+    MXTNDArrayFree(t);
+    MXTHandle loss = op1("negative", &h, 1, "");
+    MXTNDArrayFree(h);
+
+    CHECK(MXTAutogradSetRecording(0, &prev));
+    CHECK(MXTAutogradBackward(1, &loss, 0));
+
+    /* SGD step on every parameter */
+    for (i = 0; i < 10; ++i) {
+      MXTHandle g;
+      CHECK(MXTNDArrayGetGrad(params[i], &g));
+      CHECK(MXTOptimizerUpdate(opt, i, params[i], g));
+      MXTNDArrayFree(g);
+    }
+
+    float lv;
+    CHECK(MXTNDArraySyncCopyToCPU(loss, &lv, sizeof(lv)));
+    MXTNDArrayFree(loss);
+    if (it == 0) first = lv;
+    last = lv;
+    printf("iter %d loss %.4f\n", it, lv);
+  }
+
+  CHECK(MXTNDArrayWaitAll());
+  MXTOptimizerFree(opt);
+  MXTNDArrayFree(x);
+  MXTNDArrayFree(y);
+  for (i = 0; i < 10; ++i) MXTNDArrayFree(params[i]);
+
+  if (!(last < first * 0.5) || !isfinite(last)) {
+    fprintf(stderr, "loss did not decrease: %.4f -> %.4f\n", first, last);
+    return 1;
+  }
+  fprintf(stderr, "OK: loss %.4f -> %.4f\n", first, last);
+  return 0;
+}

@@ -1,0 +1,87 @@
+/* mxtpu C training API — public header for non-Python embedders.
+ *
+ * Parity: the moral core of the reference include/mxnet/c_api.h (NDArray
+ * lifecycle, imperative invoke, autograd, CachedOp, KVStore, optimizer)
+ * plus a packed-function-style generic entry.  Link libmxtpu_capi.so
+ * (`make -C src capi`); every function returns 0 on success, -1 on error
+ * (message via MXTGetLastError, thread-local).  Handles must be released
+ * with the matching *Free.  The inference-only surface lives in
+ * libmxtpu_predict.so (MXTPred*).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* MXTHandle;
+
+const char* MXTGetLastError(void);
+int MXTVersion(int* out);
+
+/* NDArray lifecycle */
+int MXTNDArrayCreate(const int64_t* shape, int ndim, const char* dtype,
+                     MXTHandle* out);
+int MXTNDArrayFromBytes(const int64_t* shape, int ndim, const char* dtype,
+                        const void* data, size_t nbytes, MXTHandle* out);
+int MXTNDArraySyncCopyToCPU(MXTHandle handle, void* data, size_t nbytes);
+int MXTNDArrayGetShape(MXTHandle handle, int* ndim, int64_t* shape, int cap);
+int MXTNDArrayGetDType(MXTHandle handle, char* buf, int buflen);
+int MXTNDArrayFree(MXTHandle handle);
+int MXTNDArrayWaitAll(void);
+
+/* imperative op invoke: op resolved in mx.npx then mx.np; kwargs as JSON
+ * (lists become tuples python-side).  outs/nout: caller passes capacity,
+ * receives count. */
+int MXTImperativeInvoke(const char* op, MXTHandle* ins, int nin,
+                        const char* kwargs_json, MXTHandle* outs, int* nout);
+int MXTListOps(char** csv_out); /* free with MXTStringFree */
+void MXTStringFree(char* s);
+
+/* autograd */
+int MXTAutogradSetRecording(int flag, int* prev);
+int MXTAutogradSetTraining(int flag, int* prev);
+int MXTAutogradMarkVariables(int n, MXTHandle* handles);
+int MXTAutogradBackward(int n, MXTHandle* heads, int retain_graph);
+int MXTNDArrayGetGrad(MXTHandle handle, MXTHandle* out);
+
+/* optimizer (updater with per-index state, reference updater semantics) */
+int MXTOptimizerCreate(const char* opt_type, const char* kwargs_json,
+                       MXTHandle* out);
+int MXTOptimizerUpdate(MXTHandle opt, int index, MXTHandle weight,
+                       MXTHandle grad);
+int MXTOptimizerFree(MXTHandle opt);
+
+/* CachedOp: bind an mx.sym JSON graph, invoke positionally over
+ * list_arguments() order */
+int MXTCachedOpCreate(const char* symbol_json, MXTHandle* out);
+int MXTCachedOpInvoke(MXTHandle handle, MXTHandle* ins, int nin,
+                      MXTHandle* outs, int* nout);
+int MXTCachedOpFree(MXTHandle handle);
+
+/* kvstore */
+int MXTKVStoreCreate(const char* kind, MXTHandle* out);
+int MXTKVStoreInit(MXTHandle kv, int n, const int* keys, MXTHandle* vals);
+int MXTKVStorePush(MXTHandle kv, int n, const int* keys, MXTHandle* vals,
+                   int priority);
+int MXTKVStorePull(MXTHandle kv, int n, const int* keys, MXTHandle* outs,
+                   int priority);
+int MXTKVStoreFree(MXTHandle kv);
+
+/* misc */
+int MXTRandomSeed(int seed);
+
+/* packed-function analog: call any public mxnet_tpu callable by dotted
+ * path with JSON args; result returned as JSON (arrays cannot cross this
+ * boundary — use the handle-based entries for tensors). */
+int MXTGenericInvoke(const char* path, const char* json_in, char** json_out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

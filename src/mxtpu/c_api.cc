@@ -1,0 +1,403 @@
+// C training API — the full embedder surface (create / train / serve).
+//
+// Parity: the moral core of the reference's 238-entry C API
+// (include/mxnet/c_api.h): NDArray lifecycle (MXNDArrayCreateEx :598,
+// MXNDArraySyncCopyFromCPU :699), imperative invoke
+// (MXImperativeInvokeEx :236), autograd (MXAutogradSetIsRecording :1018,
+// MXAutogradMarkVariables :1045, MXAutogradBackwardEx :1077), CachedOp
+// (MXCreateCachedOp :1119, MXInvokeCachedOp :1161), KVStore
+// (MXKVStoreCreate :1743, MXKVStorePush/Pull :1793), optimizer updates —
+// plus a packed-function-style generic entry (src/runtime/
+// c_runtime_api.cc:56) covering everything else by dotted path + JSON.
+//
+// TPU-native design: the compute path IS Python/XLA, so this library
+// embeds CPython and marshals into mxnet_tpu.capi (one thin Python shim
+// per entry point) rather than re-implementing a runtime.  Handles are
+// PyObject* owned by the embedder until the matching *Free call.  Built
+// as libmxtpu_capi.so (`make -C src capi`), linked with
+// `python3-config --embed` flags.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+// the public header's prototypes must match these definitions — keeping
+// it included turns signature drift into a compile error
+#include "mxtpu_c_api.h"
+#include "py_embed.h"
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+using mxtpu::ensure_python;
+
+thread_local std::string tl_err;
+
+void set_err(const char* what) {
+  tl_err = what ? what : "unknown error";
+  mxtpu::append_py_error(&tl_err);
+}
+
+// call mxnet_tpu.capi.<fn>(*args); steals `args`; returns new ref or null
+PyObject* capi_call(const char* fn, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi");
+  if (mod == nullptr) {
+    Py_XDECREF(args);
+    set_err("import mxnet_tpu.capi");
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    set_err(fn);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) set_err(fn);
+  return r;
+}
+
+PyObject* shape_tuple(const int64_t* shape, int ndim) {
+  PyObject* t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(shape[i]));
+  return t;
+}
+
+PyObject* handle_list(void** handles, int n) {
+  PyObject* l = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject* o = reinterpret_cast<PyObject*>(handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+PyObject* int_list(const int* keys, int n) {
+  PyObject* l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromLong(keys[i]));
+  return l;
+}
+
+// copy a python list of ndarrays into the caller's handle array
+int export_outputs(PyObject* list, void** outs, int* nout) {
+  if (!PyList_Check(list)) {
+    set_err("expected list result");
+    return -1;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(list);
+  if (n > *nout) {
+    set_err("output capacity too small");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(list, i);
+    Py_INCREF(o);
+    outs[i] = o;
+  }
+  *nout = static_cast<int>(n);
+  return 0;
+}
+
+#define ENTER() \
+  if (!ensure_python()) { tl_err = "python init failed"; return -1; } \
+  mxtpu::Gil gil_
+
+}  // namespace
+
+MXTPU_API const char* MXTGetLastError() { return tl_err.c_str(); }
+
+MXTPU_API int MXTVersion(int* out) {
+  if (out) *out = 10400;  // tracks reference 1.4-line API era
+  return 0;
+}
+
+// -- NDArray lifecycle ------------------------------------------------------
+MXTPU_API int MXTNDArrayCreate(const int64_t* shape, int ndim,
+                               const char* dtype, void** out) {
+  ENTER();
+  PyObject* r = capi_call("array_create", Py_BuildValue(
+      "(Ns)", shape_tuple(shape, ndim), dtype ? dtype : "float32"));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTNDArrayFromBytes(const int64_t* shape, int ndim,
+                                  const char* dtype, const void* data,
+                                  size_t nbytes, void** out) {
+  ENTER();
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
+  PyObject* r = capi_call("array_from_bytes", Py_BuildValue(
+      "(NNs)", bytes, shape_tuple(shape, ndim),
+      dtype ? dtype : "float32"));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTNDArraySyncCopyToCPU(void* handle, void* data,
+                                      size_t nbytes) {
+  ENTER();
+  PyObject* r = capi_call("array_to_bytes",
+                          Py_BuildValue("(O)", handle));
+  if (r == nullptr) return -1;
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0 ||
+      static_cast<size_t>(len) != nbytes) {
+    Py_DECREF(r);
+    set_err("byte-size mismatch in SyncCopyToCPU");
+    return -1;
+  }
+  std::memcpy(data, buf, nbytes);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTNDArrayGetShape(void* handle, int* ndim, int64_t* shape,
+                                 int cap) {
+  ENTER();
+  PyObject* r = capi_call("array_shape", Py_BuildValue("(O)", handle));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_GET_SIZE(r);
+  if (n > cap) {
+    Py_DECREF(r);
+    set_err("shape capacity too small");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i)
+    shape[i] = PyLong_AsLongLong(PyList_GET_ITEM(r, i));
+  *ndim = static_cast<int>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTNDArrayGetDType(void* handle, char* buf, int buflen) {
+  ENTER();
+  PyObject* r = capi_call("array_dtype", Py_BuildValue("(O)", handle));
+  if (r == nullptr) return -1;
+  const char* s = PyUnicode_AsUTF8(r);
+  if (s == nullptr) PyErr_Clear();
+  std::snprintf(buf, buflen, "%s", s ? s : "");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTNDArrayFree(void* handle) {
+  ENTER();
+  Py_XDECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXTNDArrayWaitAll() {
+  ENTER();
+  PyObject* r = capi_call("waitall", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- imperative op invoke ---------------------------------------------------
+MXTPU_API int MXTImperativeInvoke(const char* op, void** ins, int nin,
+                                  const char* kwargs_json, void** outs,
+                                  int* nout) {
+  ENTER();
+  PyObject* r = capi_call("invoke", Py_BuildValue(
+      "(sNs)", op, handle_list(ins, nin),
+      kwargs_json ? kwargs_json : ""));
+  if (r == nullptr) return -1;
+  int rc = export_outputs(r, outs, nout);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_API int MXTListOps(char** csv_out) {
+  ENTER();
+  PyObject* r = capi_call("list_ops", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  std::string csv;
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(r); ++i) {
+    const char* nm = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+    if (nm == nullptr) {  // undecodable name: skip, don't crash
+      PyErr_Clear();
+      continue;
+    }
+    if (!csv.empty()) csv += ",";
+    csv += nm;
+  }
+  Py_DECREF(r);
+  *csv_out = strdup(csv.c_str());
+  return 0;
+}
+
+MXTPU_API void MXTStringFree(char* s) { free(s); }
+
+// -- autograd ---------------------------------------------------------------
+MXTPU_API int MXTAutogradSetRecording(int flag, int* prev) {
+  ENTER();
+  PyObject* r = capi_call("set_recording", Py_BuildValue("(i)", flag));
+  if (r == nullptr) return -1;
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTAutogradSetTraining(int flag, int* prev) {
+  ENTER();
+  PyObject* r = capi_call("set_training", Py_BuildValue("(i)", flag));
+  if (r == nullptr) return -1;
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTAutogradMarkVariables(int n, void** handles) {
+  ENTER();
+  PyObject* r = capi_call("mark_variables",
+                          Py_BuildValue("(N)", handle_list(handles, n)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTAutogradBackward(int n, void** heads, int retain_graph) {
+  ENTER();
+  PyObject* r = capi_call("backward", Py_BuildValue(
+      "(NOi)", handle_list(heads, n), Py_None, retain_graph));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTNDArrayGetGrad(void* handle, void** out) {
+  ENTER();
+  PyObject* r = capi_call("get_grad", Py_BuildValue("(O)", handle));
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    Py_DECREF(r);
+    set_err("no gradient attached");
+    return -1;
+  }
+  *out = r;
+  return 0;
+}
+
+// -- optimizer --------------------------------------------------------------
+MXTPU_API int MXTOptimizerCreate(const char* opt_type,
+                                 const char* kwargs_json, void** out) {
+  ENTER();
+  PyObject* r = capi_call("optimizer_create", Py_BuildValue(
+      "(ss)", opt_type, kwargs_json ? kwargs_json : ""));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTOptimizerUpdate(void* opt, int index, void* weight,
+                                 void* grad) {
+  ENTER();
+  PyObject* r = capi_call("optimizer_update", Py_BuildValue(
+      "(OiOO)", opt, index, weight, grad));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTOptimizerFree(void* opt) { return MXTNDArrayFree(opt); }
+
+// -- CachedOp ---------------------------------------------------------------
+MXTPU_API int MXTCachedOpCreate(const char* symbol_json, void** out) {
+  ENTER();
+  PyObject* r = capi_call("cached_op_create",
+                          Py_BuildValue("(s)", symbol_json));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTCachedOpInvoke(void* handle, void** ins, int nin,
+                                void** outs, int* nout) {
+  ENTER();
+  PyObject* r = capi_call("cached_op_invoke", Py_BuildValue(
+      "(ON)", handle, handle_list(ins, nin)));
+  if (r == nullptr) return -1;
+  int rc = export_outputs(r, outs, nout);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_API int MXTCachedOpFree(void* handle) { return MXTNDArrayFree(handle); }
+
+// -- kvstore ----------------------------------------------------------------
+MXTPU_API int MXTKVStoreCreate(const char* kind, void** out) {
+  ENTER();
+  PyObject* r = capi_call("kvstore_create",
+                          Py_BuildValue("(s)", kind ? kind : "local"));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTKVStoreInit(void* kv, int n, const int* keys,
+                             void** vals) {
+  ENTER();
+  PyObject* r = capi_call("kvstore_init", Py_BuildValue(
+      "(ONN)", kv, int_list(keys, n), handle_list(vals, n)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTKVStorePush(void* kv, int n, const int* keys, void** vals,
+                             int priority) {
+  ENTER();
+  PyObject* r = capi_call("kvstore_push", Py_BuildValue(
+      "(ONNi)", kv, int_list(keys, n), handle_list(vals, n), priority));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTKVStorePull(void* kv, int n, const int* keys, void** outs,
+                             int priority) {
+  ENTER();
+  PyObject* r = capi_call("kvstore_pull", Py_BuildValue(
+      "(ONNi)", kv, int_list(keys, n), handle_list(outs, n), priority));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTKVStoreFree(void* kv) { return MXTNDArrayFree(kv); }
+
+// -- misc -------------------------------------------------------------------
+MXTPU_API int MXTRandomSeed(int seed) {
+  ENTER();
+  PyObject* r = capi_call("random_seed", Py_BuildValue("(i)", seed));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- packed-function analog -------------------------------------------------
+MXTPU_API int MXTGenericInvoke(const char* path, const char* json_in,
+                               char** json_out) {
+  ENTER();
+  PyObject* r = capi_call("generic_invoke", Py_BuildValue(
+      "(ss)", path, json_in ? json_in : ""));
+  if (r == nullptr) return -1;
+  const char* s = PyUnicode_AsUTF8(r);
+  if (s == nullptr) PyErr_Clear();
+  *json_out = strdup(s ? s : "");
+  Py_DECREF(r);
+  return 0;
+}

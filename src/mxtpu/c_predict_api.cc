@@ -15,13 +15,16 @@
 
 #include <cstdio>
 #include <cstring>
-#include <mutex>
 #include <string>
 #include <vector>
+
+#include "py_embed.h"
 
 #define MXTPU_API extern "C" __attribute__((visibility("default")))
 
 namespace {
+
+using mxtpu::ensure_python;
 
 struct Predictor {
   PyObject* block = nullptr;            // SymbolBlock
@@ -35,36 +38,7 @@ struct Predictor {
 void set_err(Predictor* p, const char* what) {
   if (p == nullptr) return;
   p->last_error = what ? what : "unknown error";
-  if (PyErr_Occurred()) {
-    PyObject *t, *v, *tb;
-    PyErr_Fetch(&t, &v, &tb);
-    PyObject* s = v ? PyObject_Str(v) : nullptr;
-    if (s != nullptr) {
-      p->last_error += ": ";
-      p->last_error += PyUnicode_AsUTF8(s);
-      Py_DECREF(s);
-    }
-    Py_XDECREF(t);
-    Py_XDECREF(v);
-    Py_XDECREF(tb);
-  }
-}
-
-bool ensure_python() {
-  // call_once: two embedder threads may race their first MXTPred* call
-  static std::once_flag init_once;
-  std::call_once(init_once, [] {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      if (Py_IsInitialized()) {
-        // release the GIL held by the initializing thread so every entry
-        // point (from any embedder thread) can uniformly PyGILState_Ensure
-        // without deadlocking (ADVICE r2)
-        PyEval_SaveThread();
-      }
-    }
-  });
-  return Py_IsInitialized();
+  mxtpu::append_py_error(&p->last_error);
 }
 
 }  // namespace

@@ -1,0 +1,63 @@
+// Shared CPython-embedding plumbing for the C ABI libraries
+// (c_api.cc, c_predict_api.cc): one-time interpreter init that releases
+// the GIL, a scoped GIL guard, and exception-text capture.
+#ifndef MXTPU_PY_EMBED_H_
+#define MXTPU_PY_EMBED_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu {
+
+inline bool ensure_python() {
+  // call_once: two embedder threads may race their first entry call
+  static std::once_flag init_once;
+  std::call_once(init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      if (Py_IsInitialized()) {
+        // release the GIL held by the initializing thread so every entry
+        // point (from any embedder thread) can uniformly PyGILState_Ensure
+        // without deadlocking (ADVICE r2)
+        PyEval_SaveThread();
+      }
+    }
+  });
+  return Py_IsInitialized();
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// append the pending Python exception's text to `dst` (GIL held).
+// PyUnicode_AsUTF8 can itself fail (lone surrogates from surrogateescape
+// paths) — guard the nullptr and clear the secondary exception so it
+// cannot leak into the embedder's next call.
+inline void append_py_error(std::string* dst) {
+  if (!PyErr_Occurred()) return;
+  PyObject *t, *v, *tb;
+  PyErr_Fetch(&t, &v, &tb);
+  PyObject* s = v ? PyObject_Str(v) : nullptr;
+  if (s != nullptr) {
+    const char* es = PyUnicode_AsUTF8(s);
+    if (es == nullptr) {
+      PyErr_Clear();
+      es = "<unprintable exception text>";
+    }
+    *dst += ": ";
+    *dst += es;
+    Py_DECREF(s);
+  }
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_PY_EMBED_H_

@@ -1,0 +1,223 @@
+"""C training API: a non-Python embedder creates arrays, records
+autograd, backprops and runs SGD through libmxtpu_capi.so (parity: the
+moral core of reference include/mxnet/c_api.h + the packed-fn FFI of
+src/runtime/c_runtime_api.cc)."""
+import ctypes
+import json
+import os
+import subprocess
+
+import numpy as onp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "lib", "libmxtpu_capi.so")
+SRC = os.path.join(REPO, "example", "extensions", "c_train",
+                   "train_lenet.c")
+
+
+def _ensure_lib():
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "src"),
+                            "capi"], capture_output=True, text=True)
+        if r.returncode != 0 or not os.path.exists(LIB):
+            pytest.skip("cannot build libmxtpu_capi.so: %s" % r.stderr)
+
+
+@pytest.mark.slow
+def test_c_embedder_trains_lenet(tmp_path):
+    """The acceptance bar from VERDICT r3 #3: a C program TRAINS LeNet
+    end-to-end (conv/pool/dense forward, autograd backward, momentum-SGD
+    updates) and its loss decreases."""
+    _ensure_lib()
+    exe = str(tmp_path / "train_lenet")
+    r = subprocess.run(
+        ["gcc", SRC, "-I", os.path.join(REPO, "include"),
+         "-o", exe, "-L", os.path.dirname(LIB), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.dirname(LIB), "-lm"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    losses = [float(line.split()[-1]) for line in r.stdout.splitlines()
+              if line.startswith("iter")]
+    assert len(losses) == 30 and losses[-1] < losses[0] * 0.5
+
+
+def _load():
+    _ensure_lib()
+    c = ctypes
+    lib = c.CDLL(LIB)
+    lib.MXTGetLastError.restype = c.c_char_p
+    P, vp, i32, i64 = c.POINTER, c.c_void_p, c.c_int, c.c_int64
+    # argtypes are load-bearing: without them ctypes passes handles as
+    # 32-bit ints and the 64-bit pointers truncate (segfault)
+    lib.MXTNDArrayFromBytes.argtypes = [P(i64), i32, c.c_char_p, vp,
+                                        c.c_size_t, P(vp)]
+    lib.MXTNDArraySyncCopyToCPU.argtypes = [vp, vp, c.c_size_t]
+    lib.MXTNDArrayGetShape.argtypes = [vp, P(i32), P(i64), i32]
+    lib.MXTNDArrayFree.argtypes = [vp]
+    lib.MXTImperativeInvoke.argtypes = [c.c_char_p, P(vp), i32,
+                                        c.c_char_p, P(vp), P(i32)]
+    lib.MXTAutogradMarkVariables.argtypes = [i32, P(vp)]
+    lib.MXTAutogradSetRecording.argtypes = [i32, P(i32)]
+    lib.MXTAutogradBackward.argtypes = [i32, P(vp), i32]
+    lib.MXTNDArrayGetGrad.argtypes = [vp, P(vp)]
+    lib.MXTCachedOpCreate.argtypes = [c.c_char_p, P(vp)]
+    lib.MXTCachedOpInvoke.argtypes = [vp, P(vp), i32, P(vp), P(i32)]
+    lib.MXTCachedOpFree.argtypes = [vp]
+    lib.MXTKVStoreCreate.argtypes = [c.c_char_p, P(vp)]
+    lib.MXTKVStoreInit.argtypes = [vp, i32, P(i32), P(vp)]
+    lib.MXTKVStorePush.argtypes = [vp, i32, P(i32), P(vp), i32]
+    lib.MXTKVStorePull.argtypes = [vp, i32, P(i32), P(vp), i32]
+    lib.MXTKVStoreFree.argtypes = [vp]
+    lib.MXTGenericInvoke.argtypes = [c.c_char_p, c.c_char_p,
+                                     P(c.c_char_p)]
+    lib.MXTStringFree.argtypes = [vp]
+    lib.MXTRandomSeed.argtypes = [i32]
+    return lib
+
+
+def _err(lib):
+    return lib.MXTGetLastError().decode()
+
+
+def _from_np(lib, a):
+    a = onp.ascontiguousarray(a)
+    shape = (ctypes.c_int64 * a.ndim)(*a.shape)
+    h = ctypes.c_void_p()
+    rc = lib.MXTNDArrayFromBytes(shape, a.ndim,
+                                 str(a.dtype).encode(),
+                                 a.ctypes.data_as(ctypes.c_void_p),
+                                 a.nbytes, ctypes.byref(h))
+    assert rc == 0, _err(lib)
+    return h
+
+
+def _to_np(lib, h, shape, dtype="float32"):
+    out = onp.empty(shape, dtype)
+    rc = lib.MXTNDArraySyncCopyToCPU(h, out.ctypes.data_as(ctypes.c_void_p),
+                                     out.nbytes)
+    assert rc == 0, _err(lib)
+    return out
+
+
+def test_capi_ndarray_and_invoke_roundtrip():
+    """ctypes drive of the C ABI in-process: create, invoke, copy out."""
+    lib = _load()
+    a = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    b = onp.ones((3, 4), onp.float32) * 2
+    ha, hb = _from_np(lib, a), _from_np(lib, b)
+
+    outs = (ctypes.c_void_p * 4)()
+    nout = ctypes.c_int(4)
+    rc = lib.MXTImperativeInvoke(b"multiply",
+                                 (ctypes.c_void_p * 2)(ha, hb), 2, b"",
+                                 outs, ctypes.byref(nout))
+    assert rc == 0, _err(lib)
+    assert nout.value == 1
+    got = _to_np(lib, outs[0], (3, 4))
+    onp.testing.assert_allclose(got, a * 2)
+
+    ndim = ctypes.c_int()
+    shape = (ctypes.c_int64 * 8)()
+    assert lib.MXTNDArrayGetShape(outs[0], ctypes.byref(ndim), shape, 8) == 0
+    assert list(shape[:ndim.value]) == [3, 4]
+    for h in (ha, hb, outs[0]):
+        lib.MXTNDArrayFree(h)
+
+    # unknown op surfaces a real error, not a crash
+    rc = lib.MXTImperativeInvoke(b"definitely_not_an_op",
+                                 (ctypes.c_void_p * 1)(), 0, b"",
+                                 outs, ctypes.byref(nout))
+    assert rc == -1 and "unknown op" in _err(lib)
+
+
+def test_capi_autograd_grad_matches_numpy():
+    lib = _load()
+    a = onp.array([1.0, 2.0, 3.0], onp.float32)
+    ha = _from_np(lib, a)
+    assert lib.MXTAutogradMarkVariables(1, (ctypes.c_void_p * 1)(ha)) == 0
+    prev = ctypes.c_int()
+    assert lib.MXTAutogradSetRecording(1, ctypes.byref(prev)) == 0
+
+    outs = (ctypes.c_void_p * 1)()
+    nout = ctypes.c_int(1)
+    rc = lib.MXTImperativeInvoke(b"square", (ctypes.c_void_p * 1)(ha), 1,
+                                 b"", outs, ctypes.byref(nout))
+    assert rc == 0, _err(lib)
+    sq = outs[0]
+    nout = ctypes.c_int(1)
+    rc = lib.MXTImperativeInvoke(b"sum", (ctypes.c_void_p * 1)(sq), 1,
+                                 b"", outs, ctypes.byref(nout))
+    assert rc == 0, _err(lib)
+    loss = outs[0]
+    assert lib.MXTAutogradSetRecording(0, ctypes.byref(prev)) == 0
+    assert lib.MXTAutogradBackward(1, (ctypes.c_void_p * 1)(loss), 0) == 0
+
+    g = ctypes.c_void_p()
+    assert lib.MXTNDArrayGetGrad(ha, ctypes.byref(g)) == 0, _err(lib)
+    onp.testing.assert_allclose(_to_np(lib, g, (3,)), 2 * a)
+    for h in (ha, sq, loss, g):
+        lib.MXTNDArrayFree(h)
+
+
+def test_capi_cachedop_kvstore_generic():
+    lib = _load()
+
+    # CachedOp: bind a sym JSON graph, invoke positionally
+    from mxnet_tpu import sym_api as sym
+    x = sym.var("x", shape=(2, 3), dtype="float32")
+    graph = sym.tanh(x * 2.0)
+    hco = ctypes.c_void_p()
+    rc = lib.MXTCachedOpCreate(graph.tojson().encode(), ctypes.byref(hco))
+    assert rc == 0, _err(lib)
+    xv = onp.random.RandomState(0).randn(2, 3).astype("float32")
+    hx = _from_np(lib, xv)
+    outs = (ctypes.c_void_p * 4)()
+    nout = ctypes.c_int(4)
+    rc = lib.MXTCachedOpInvoke(hco, (ctypes.c_void_p * 1)(hx), 1,
+                               outs, ctypes.byref(nout))
+    assert rc == 0, _err(lib)
+    onp.testing.assert_allclose(_to_np(lib, outs[0], (2, 3)),
+                                onp.tanh(xv * 2), rtol=1e-5)
+    lib.MXTCachedOpFree(hco)
+    lib.MXTNDArrayFree(outs[0])
+
+    # kvstore local: init + push two grads + pull the aggregate
+    hkv = ctypes.c_void_p()
+    assert lib.MXTKVStoreCreate(b"local", ctypes.byref(hkv)) == 0
+    v0 = _from_np(lib, onp.zeros(4, onp.float32))
+    keys = (ctypes.c_int * 1)(3)
+    assert lib.MXTKVStoreInit(hkv, 1, keys,
+                              (ctypes.c_void_p * 1)(v0)) == 0, _err(lib)
+    g1 = _from_np(lib, onp.ones(4, onp.float32))
+    g2 = _from_np(lib, onp.ones(4, onp.float32) * 2)
+    assert lib.MXTKVStorePush(hkv, 1, keys,
+                              (ctypes.c_void_p * 1)(g1), 0) == 0
+    assert lib.MXTKVStorePush(hkv, 1, keys,
+                              (ctypes.c_void_p * 1)(g2), 0) == 0
+    dst = _from_np(lib, onp.zeros(4, onp.float32))
+    assert lib.MXTKVStorePull(hkv, 1, keys,
+                              (ctypes.c_void_p * 1)(dst), 0) == 0, _err(lib)
+    pulled = _to_np(lib, dst, (4,))
+    assert pulled.sum() != 0  # aggregated pushes landed
+    for h in (hkv, v0, g1, g2, dst):
+        lib.MXTNDArrayFree(h)
+
+    # packed-fn analog: dotted-path call with JSON args
+    out = ctypes.c_char_p()
+    rc = lib.MXTGenericInvoke(b"runtime.feature_list", b"{}",
+                              ctypes.byref(out))
+    assert rc == 0, _err(lib)
+    payload = json.loads(out.value.decode())
+    assert payload["ok"]
+    lib.MXTStringFree(out)
+
+    # waitall + seed round out the misc surface
+    assert lib.MXTRandomSeed(5) == 0
+    assert lib.MXTNDArrayWaitAll() == 0
