@@ -12,10 +12,22 @@ dependency engine (src/mxtpu/engine.cc worker pool): each batch is pushed
 with its own write var, the consumer waits on the var — the reference's
 threaded iter pipeline (iter_prefetcher.h) expressed as engine read/write
 deps.  Falls back to a dummy-mp thread pool when the native lib is absent.
+
+`worker_mode="process"` selects TRUE multiprocessing workers with
+shared-memory batch IPC (reference dataloader.py:187 worker loop +
+src/storage/cpu_shared_storage_manager.h): arbitrary Python transforms
+(PIL & friends) serialize on the GIL in thread mode — exactly the
+workload the reference's process pool exists for.  Workers are SPAWNED,
+not forked (a forked child inheriting JAX/engine threads and their held
+locks is a deadlock), batches travel as one POSIX shm segment per batch,
+and worker processes force JAX_PLATFORMS=cpu so they can never grab the
+chip.  Thread mode stays the default: the native decode path releases
+the GIL, and spawn startup costs a few seconds per worker.
 """
 from __future__ import annotations
 
 import multiprocessing.dummy as mp_dummy
+import os
 from collections import deque
 
 import numpy as onp
@@ -36,17 +48,105 @@ def default_batchify_fn(data):
     return array(onp.stack(arrs))
 
 
+def _np_batchify_fn(data):
+    """Worker-side default: identical stacking, numpy output (the worker
+    process must not touch device buffers)."""
+    if isinstance(data[0], tuple):
+        return tuple(_np_batchify_fn([d[i] for d in data])
+                     for i in range(len(data[0])))
+    return onp.stack([onp.asarray(d) for d in data])
+
+
+# ---------------------------------------------------------------------------
+# process-pool worker side (module-level: must be picklable for spawn)
+# ---------------------------------------------------------------------------
+_MP_STATE = {}
+
+
+def _mp_init(dataset, batchify_fn):
+    # runs FIRST in the spawned child: pin jax (if any transform imports
+    # it) to CPU before anything can open the real device
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _MP_STATE["dataset"] = dataset
+    _MP_STATE["batchify"] = batchify_fn
+
+
+def _flatten_np(obj, out):
+    """Flatten nested tuples/lists of array-likes to numpy; returns a
+    treedef of ('t'|'l', children) nodes and leaf slot indices — the
+    container KIND is preserved so process mode rebuilds lists as lists,
+    identically to thread mode."""
+    if isinstance(obj, (tuple, list)):
+        kind = "l" if isinstance(obj, list) else "t"
+        return (kind, tuple(_flatten_np(o, out) for o in obj))
+    a = onp.ascontiguousarray(onp.asarray(obj))
+    out.append(a)
+    return len(out) - 1
+
+
+def _rebuild(tree, leaves):
+    if isinstance(tree, tuple):
+        kind, children = tree
+        seq = [_rebuild(t, leaves) for t in children]
+        return seq if kind == "l" else tuple(seq)
+    return leaves[tree]
+
+
+def _mp_make_batch(indices):
+    """Assemble one batch and publish it as ONE shared-memory segment
+    (the cpu_shared_storage_manager analog: data crosses processes by
+    mapping, not by pickling through a pipe)."""
+    from multiprocessing import resource_tracker, shared_memory
+    ds = _MP_STATE["dataset"]
+    bf = _MP_STATE["batchify"]
+    batch = bf([ds[i] for i in indices])
+    leaves = []
+    tree = _flatten_np(batch, leaves)
+    align = 64
+    offsets = []
+    total = 0
+    for a in leaves:
+        total = (total + align - 1) // align * align
+        offsets.append(total)
+        total += a.nbytes
+    shm = shared_memory.SharedMemory(create=True, size=max(total, 1))
+    for a, off in zip(leaves, offsets):
+        dst = onp.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=off)
+        dst[...] = a
+    specs = [{"shape": list(a.shape), "dtype": a.dtype.str, "offset": off}
+             for a, off in zip(leaves, offsets)]
+    name = shm.name
+    # the PARENT owns the segment's lifetime (it unlinks after copy-out);
+    # unregister from this child's resource tracker so its exit-time
+    # cleanup does not double-unlink
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    shm.close()
+    return {"shm": name, "specs": specs, "tree": tree}
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=False, timeout=120,
-                 try_nopython=None):
+                 try_nopython=None, worker_mode=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * max(self._num_workers, 1))
+        # "thread" (default: native-engine/thread prefetch) or "process"
+        # (spawned workers + shm IPC, for GIL-bound Python transforms —
+        # the reference's default worker model)
+        if worker_mode is None:
+            worker_mode = os.environ.get("MXNET_WORKER_MODE", "thread")
+        if worker_mode not in ("thread", "process"):
+            raise ValueError("worker_mode must be 'thread' or 'process'")
+        self._worker_mode = worker_mode
+        self._mp_pool = None
 
         if batch_sampler is None:
             if batch_size is None:
@@ -78,6 +178,9 @@ class DataLoader:
             # pipeline must still produce every batch)
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
+            return
+        if self._worker_mode == "process":
+            yield from self._iter_processes()
             return
         from ...engine import default_engine
         eng = default_engine()
@@ -158,9 +261,75 @@ class DataLoader:
                 except Exception:
                     pass
 
+    def _iter_processes(self):
+        """Spawned-process workers + shared-memory batch IPC (reference
+        multi-worker loop, dataloader.py:187)."""
+        from multiprocessing import get_context, shared_memory
+        if self._mp_pool is None:
+            bf = (self._batchify_fn if self._batchify_fn
+                  is not default_batchify_fn else _np_batchify_fn)
+            ctx = get_context("spawn")
+            self._mp_pool = ctx.Pool(self._num_workers, _mp_init,
+                                     (self._dataset, bf))
+
+        def consume(msg):
+            shm = shared_memory.SharedMemory(name=msg["shm"])
+            try:
+                leaves = []
+                for spec in msg["specs"]:
+                    view = onp.ndarray(tuple(spec["shape"]),
+                                       onp.dtype(spec["dtype"]),
+                                       buffer=shm.buf,
+                                       offset=spec["offset"])
+                    # a REAL copy, not ascontiguousarray (a no-op on the
+                    # contiguous view): the CPU backend may zero-copy
+                    # alias numpy memory, and the segment unmaps below
+                    leaves.append(array(view.copy()))
+            finally:
+                shm.close()
+                try:
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
+            out = _rebuild(msg["tree"], leaves)
+            return out
+
+        pending = deque()
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(self._prefetch):
+                idx = next(it, None)
+                if idx is None:
+                    break
+                pending.append(
+                    self._mp_pool.apply_async(_mp_make_batch, (list(idx),)))
+            while pending:
+                batch = consume(pending.popleft().get())
+                idx = next(it, None)
+                if idx is not None:
+                    pending.append(self._mp_pool.apply_async(
+                        _mp_make_batch, (list(idx),)))
+                yield batch
+        finally:
+            for p in pending:  # orphaned segments would leak /dev/shm
+                try:
+                    msg = p.get(timeout=30)
+                except Exception:
+                    continue
+                # unlink only — materializing device arrays for batches
+                # nobody will read would make an early break expensive
+                try:
+                    shm = shared_memory.SharedMemory(name=msg["shm"])
+                    shm.close()
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
+
     def __len__(self):
         return len(self._batch_sampler)
 
     def __del__(self):
         if self._pool is not None:
             self._pool.terminate()
+        if self._mp_pool is not None:
+            self._mp_pool.terminate()

@@ -294,6 +294,9 @@ def create(name="local"):
     if name == "horovod":
         from .horovod import KVStoreHorovod
         return KVStoreHorovod()
+    if name == "byteps":
+        from .byteps import KVStoreBytePS
+        return KVStoreBytePS()
     if name in ("dist_sync", "dist_async", "dist_sync_device", "dist", "p3"):
         import os
         if os.environ.get("DMLC_PS_ROOT_URI"):

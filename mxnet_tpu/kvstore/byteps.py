@@ -1,0 +1,112 @@
+"""BytePS kvstore adapter (parity: reference
+`python/mxnet/kvstore/byteps.py:29` — KVStoreBase backend delegating to
+`byteps.mxnet`'s declare-tensor + push_pull primitives).
+
+The adapter targets the same API surface: `kv = mx.kv.create('byteps')`
+works wherever a `byteps.mxnet`-equivalent module is importable (exposed
+as `byteps.mxnet_tpu` or injected for tests).  BytePS is a
+server-assisted allreduce: `pushpull` sums in place through the BytePS
+core; `broadcast` is implemented the reference way — non-root ranks
+zero their copy so the summed result equals rank 0's value.  On TPU
+pods the native path is `tpu_ici`/GSPMD; this exists so reference BytePS
+scripts run unchanged where the ecosystem provides bps.
+"""
+from __future__ import annotations
+
+from . import KVStoreBase
+
+__all__ = ["KVStoreBytePS"]
+
+
+def _load_bps():
+    import importlib
+    for mod in ("byteps.mxnet_tpu", "byteps.mxnet"):
+        try:
+            return importlib.import_module(mod)
+        except ImportError:
+            continue
+    raise ImportError(
+        "kvstore='byteps' needs the byteps package (byteps.mxnet); "
+        "on TPU use kvstore='tpu_ici' or the SPMD parallel trainer")
+
+
+@KVStoreBase.register
+class KVStoreBytePS(KVStoreBase):
+    """Reference semantics (byteps.py:46-162): single key per call,
+    value copied unless out aliases it, declare + push_pull(sum),
+    broadcast zeroes non-root ranks first, capabilities all False."""
+
+    def __init__(self, bps=None):
+        self._bps = bps if bps is not None else _load_bps()
+        self._bps.init()
+
+    @property
+    def type(self):
+        return "byteps"
+
+    @property
+    def rank(self):
+        return self._bps.rank()
+
+    @property
+    def num_workers(self):
+        return self._bps.size()
+
+    @staticmethod
+    def is_capable(capability):
+        # byteps servers do not store weights: no server-side optimizer,
+        # compression or sparsity (reference is_capable returns False)
+        return False
+
+    def _single(self, key, value):
+        assert isinstance(key, (str, int)), \
+            "byteps kvstore operates on a single str/int key per call"
+        if isinstance(value, (list, tuple)):
+            assert len(value) == 1, \
+                "byteps accepts one NDArray (or a 1-element list)"
+            value = value[0]
+        return str(key), value
+
+    def _run(self, key, value, out, priority, zero_non_root):
+        key, value = self._single(key, value)
+        if out is None:
+            inplace = True  # reference semantics: result lands in `value`
+        elif isinstance(out, (list, tuple)) and len(out) == 1:
+            inplace = value is out[0]
+        else:
+            inplace = value is out
+        buf = value if inplace else value.copy()
+        if zero_non_root and self.rank != 0:
+            buf *= 0
+        self._bps.byteps_declare_tensor(key)
+        self._bps.byteps_push_pull(buf, version=0, priority=priority,
+                                   name=key, is_average=False)
+        buf.wait_to_read()
+        if out is not None:
+            targets = out if isinstance(out, (list, tuple)) else [out]
+            for o in targets:
+                if o is not buf:
+                    buf.copyto(o)
+        return out
+
+    def broadcast(self, key, value, out=None, priority=0):
+        """Root rank 0's value lands in every rank's `out` (non-root
+        contributions zeroed before the sum — reference byteps.py:88)."""
+        return self._run(key, value, out, priority, zero_non_root=True)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Coalesced push+pull: `value` summed across ranks into `out`
+        (or in place when out is None/aliases value)."""
+        return self._run(key, value, out, priority, zero_non_root=False)
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError(
+            "byteps kvstore is pushpull-based (reference raises the same)")
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError(
+            "byteps kvstore is pushpull-based: use pushpull/broadcast")
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError(
+            "byteps servers do not run optimizers; update on workers")

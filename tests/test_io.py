@@ -250,3 +250,84 @@ def test_im2rec_tool(tmp_path):
     labels = sorted(set(onp.concatenate(
         [b.label[0].asnumpy() for b in batches]).tolist()))
     assert labels == [0.0, 1.0]
+
+
+class _GilBoundDataset:
+    """Pure-python per-sample transform (~ms of bytecode): the workload
+    class the reference's process workers exist for — thread workers
+    serialize on the GIL."""
+
+    def __init__(self, n=64, work=4000):
+        self.n = n
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0.0
+        for k in range(self.work):  # deliberate pure-python loop
+            acc += (i * 31 + k) % 7
+        return (onp.full((8, 8), float(i), "float32"),
+                onp.float32(i + acc * 0))
+
+
+def _list_batchify(samples):
+    # module-level: spawn workers must pickle it
+    return [onp.stack([s[0] for s in samples]),
+            onp.stack([s[1] for s in samples])]
+
+
+def test_dataloader_process_mode_correctness():
+    """worker_mode='process': spawned workers + shm IPC produce the same
+    batches as the in-process path, nested tuple structure preserved."""
+    from mxnet_tpu.gluon.data import DataLoader
+    ds = _GilBoundDataset(n=12, work=10)
+    ref = list(DataLoader(ds, batch_size=4, num_workers=0))
+    got = list(DataLoader(ds, batch_size=4, num_workers=2,
+                          worker_mode="process"))
+    assert len(got) == len(ref) == 3
+    for (rx, ry), (gx, gy) in zip(ref, got):
+        onp.testing.assert_allclose(gx.asnumpy(), rx.asnumpy())
+        onp.testing.assert_allclose(gy.asnumpy(), ry.asnumpy())
+
+    # custom LIST batchify keeps its container type across the shm IPC
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    worker_mode="process", batchify_fn=_list_batchify)
+    b = next(iter(dl))  # early break: prefetched segments must not leak
+    assert isinstance(b, list) and len(b) == 2
+    onp.testing.assert_allclose(b[0].asnumpy(), ref[0][0].asnumpy())
+
+
+@pytest.mark.slow
+def test_dataloader_process_mode_beats_threads_on_python_transform():
+    """VERDICT r3 #6 'done' bar: process mode beats thread mode on a
+    GIL-bound Python-transform dataset.  Requires real parallel cores:
+    on a single-CPU host neither mode can run two transforms at once,
+    so the comparison is physically meaningless there."""
+    import os
+    import time
+    from mxnet_tpu.gluon.data import DataLoader
+
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("single-CPU host: process workers cannot outrun "
+                    "the GIL without a second core")
+
+    ds = _GilBoundDataset(n=96, work=150000)
+    workers = 4
+
+    def run(mode):
+        dl = DataLoader(ds, batch_size=8, num_workers=workers,
+                        worker_mode=mode)
+        list(dl)  # warm the pool (spawn startup must not count)
+        t0 = time.perf_counter()
+        n = sum(1 for _ in dl)
+        dt = time.perf_counter() - t0
+        assert n == 12
+        return dt
+
+    t_proc = run("process")
+    t_thread = run("thread")
+    # GIL-bound python work cannot parallelize on threads; allow slack
+    # for pool scheduling noise
+    assert t_proc < t_thread * 0.9, (t_proc, t_thread)

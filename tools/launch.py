@@ -29,12 +29,69 @@ import subprocess
 import sys
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _reserve_ports(n):
+    """Base port with n CONSECUTIVE bindable ports (server shard i listens
+    on base+i, so probing only the base — the old behavior — left shards
+    1..n-1 to collide with whatever else is on the host; that was the
+    consecutive-test-run flake)."""
+    for _ in range(64):
+        s0 = socket.socket()
+        s0.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s0.bind(("", 0))
+        base = s0.getsockname()[1]
+        socks = [s0]
+        ok = base + n < 65536
+        for i in range(1, n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("", base + i))
+            except OSError:
+                s.close()
+                ok = False
+                break
+            socks.append(s)
+        for s in socks:
+            s.close()
+        if ok:
+            return base
+    raise RuntimeError("no contiguous free port range of %d found" % n)
+
+
+def _kill_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _wait_servers_ready(procs, port, n, deadline_s=60.0):
+    """Block until every server shard accepts a TCP connection (the server
+    treats an immediately-closed probe as a normal client EOF).  Returns
+    False if any server process died first (e.g. lost a bind race)."""
+    import time
+    deadline = time.monotonic() + deadline_s
+    ready = [False] * n
+    while time.monotonic() < deadline and not all(ready):
+        for i in range(n):
+            if ready[i]:
+                continue
+            if procs[i].poll() is not None:
+                return False
+            try:
+                c = socket.create_connection(("127.0.0.1", port + i),
+                                             timeout=0.5)
+                c.close()
+                ready[i] = True
+            except OSError:
+                pass
+        if not all(ready):
+            time.sleep(0.1)
+    return all(ready)
 
 
 def main():
@@ -50,45 +107,54 @@ def main():
     if not args.command:
         ap.error("no command given")
 
-    port = args.port or _free_port()
-    base_env = dict(os.environ)
-    base_env.update({
-        "DMLC_PS_ROOT_URI": "127.0.0.1",
-        "DMLC_PS_ROOT_PORT": str(port),
-        "DMLC_NUM_WORKER": str(args.num_workers),
-        "DMLC_NUM_SERVER": str(args.num_servers),
-        "MXNET_KVSTORE_SYNC": "0" if args.async_mode else "1",
-    })
+    # a lost bind race (another process grabbed a probed port between the
+    # probe and the server's bind) is detectable — the server dies before
+    # accepting — and retryable with a fresh range
+    for attempt in range(3):
+        port = args.port or _reserve_ports(args.num_servers)
+        base_env = dict(os.environ)
+        base_env.update({
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+            "MXNET_KVSTORE_SYNC": "0" if args.async_mode else "1",
+        })
 
-    procs = []
-    try:
-        # servers first (workers block connecting until they're up)
-        for sid in range(args.num_servers):
-            env = dict(base_env)
-            env.update({"DMLC_ROLE": "server", "DMLC_SERVER_ID": str(sid),
-                        "DMLC_SERVER_PORT": str(port + sid)})
-            procs.append(subprocess.Popen(
-                [sys.executable, "-c",
-                 "import mxnet_tpu as mx;"
-                 "mx.kvstore._init_kvstore_server_module()"], env=env))
-        workers = []
-        for wid in range(args.num_workers):
-            env = dict(base_env)
-            env.update({"DMLC_ROLE": "worker", "DMLC_WORKER_ID": str(wid)})
-            workers.append(subprocess.Popen(args.command, env=env))
-        rc = 0
-        for w in workers:
-            rc |= w.wait()
-        return rc
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        procs = []
+        try:
+            # servers first (workers block connecting until they're up)
+            for sid in range(args.num_servers):
+                env = dict(base_env)
+                env.update({"DMLC_ROLE": "server",
+                            "DMLC_SERVER_ID": str(sid),
+                            "DMLC_SERVER_PORT": str(port + sid)})
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c",
+                     "import mxnet_tpu as mx;"
+                     "mx.kvstore._init_kvstore_server_module()"], env=env))
+            if not _wait_servers_ready(procs, port, args.num_servers):
+                if args.port is not None or attempt == 2:
+                    print("launch.py: servers failed to start on ports "
+                          "%d..%d" % (port, port + args.num_servers - 1),
+                          file=sys.stderr)
+                    return 1
+                _kill_all(procs)
+                procs = []
+                continue  # retry on a fresh port range
+            workers = []
+            for wid in range(args.num_workers):
+                env = dict(base_env)
+                env.update({"DMLC_ROLE": "worker",
+                            "DMLC_WORKER_ID": str(wid)})
+                workers.append(subprocess.Popen(args.command, env=env))
+            rc = 0
+            for w in workers:
+                rc |= w.wait()
+            return rc
+        finally:
+            _kill_all(procs)
+    return 1
 
 
 if __name__ == "__main__":
