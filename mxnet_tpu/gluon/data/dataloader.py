@@ -136,6 +136,8 @@ class DataLoader:
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._num_workers = max(0, num_workers)
+        self._pool = None      # assigned before ANY validation raise:
+        self._mp_pool = None   # __del__ reads both unconditionally
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * max(self._num_workers, 1))
         # "thread" (default: native-engine/thread prefetch) or "process"
@@ -146,7 +148,6 @@ class DataLoader:
         if worker_mode not in ("thread", "process"):
             raise ValueError("worker_mode must be 'thread' or 'process'")
         self._worker_mode = worker_mode
-        self._mp_pool = None
 
         if batch_sampler is None:
             if batch_size is None:
@@ -166,7 +167,6 @@ class DataLoader:
                 "exclusive with batch_sampler")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
-        self._pool = None
 
     def _make_batch(self, indices):
         samples = [self._dataset[i] for i in indices]

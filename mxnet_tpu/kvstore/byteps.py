@@ -89,14 +89,28 @@ class KVStoreBytePS(KVStoreBase):
                     buf.copyto(o)
         return out
 
+    def _batched(self, key, value, out, priority, zero_non_root):
+        # gluon.Trainer broadcasts/pushpulls LISTS of keys; the reference
+        # byteps adapter is single-key, so batch by looping (the horovod
+        # adapter does the same)
+        outs = out if out is not None else [None] * len(key)
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        for k, v, o in zip(key, vals, outs):
+            self._run(k, v, o, priority, zero_non_root)
+        return out
+
     def broadcast(self, key, value, out=None, priority=0):
         """Root rank 0's value lands in every rank's `out` (non-root
         contributions zeroed before the sum — reference byteps.py:88)."""
+        if isinstance(key, (list, tuple)):
+            return self._batched(key, value, out, priority, True)
         return self._run(key, value, out, priority, zero_non_root=True)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Coalesced push+pull: `value` summed across ranks into `out`
         (or in place when out is None/aliases value)."""
+        if isinstance(key, (list, tuple)):
+            return self._batched(key, value, out, priority, False)
         return self._run(key, value, out, priority, zero_non_root=False)
 
     def push(self, key, value, priority=0):

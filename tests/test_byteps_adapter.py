@@ -99,8 +99,11 @@ def test_push_pull_raise_like_reference():
         kv.pull("0", out=mxnp.ones(2))
     with pytest.raises(NotImplementedError):
         kv.set_optimizer(object())
-    with pytest.raises(AssertionError):
-        kv.pushpull(["a", "b"], [mxnp.ones(2), mxnp.ones(2)])
+    # LIST keys batch by looping (gluon.Trainer issues them)
+    outs = [mxnp.zeros(2), mxnp.zeros(2)]
+    kv.pushpull(["a", "b"], [mxnp.ones(2), mxnp.ones(2) * 2], out=outs)
+    onp.testing.assert_allclose(outs[0].asnumpy(), [1.0, 1.0])
+    onp.testing.assert_allclose(outs[1].asnumpy(), [2.0, 2.0])
 
 
 def test_trainer_runs_on_byteps_adapter():

@@ -331,3 +331,36 @@ def test_dataloader_process_mode_beats_threads_on_python_transform():
     # GIL-bound python work cannot parallelize on threads; allow slack
     # for pool scheduling noise
     assert t_proc < t_thread * 0.9, (t_proc, t_thread)
+
+
+def test_dataloader_bad_worker_mode_no_del_noise():
+    from mxnet_tpu.gluon.data import DataLoader
+    import gc
+    ds = _GilBoundDataset(n=4, work=1)
+    with pytest.raises(ValueError, match="worker_mode"):
+        DataLoader(ds, batch_size=2, worker_mode="bogus")
+    gc.collect()  # __del__ on the half-built loader must not raise
+
+
+def test_byteps_batched_keys_via_trainer_multiworker():
+    """gluon.Trainer issues LIST keys when num_workers > 1 — the adapter
+    must batch by looping (regression: asserted single key)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.kvstore.byteps import KVStoreBytePS
+    from test_byteps_adapter import _FakeBps
+    mx.random.seed(0)
+    net = nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Xavier())
+    kv = KVStoreBytePS(bps=_FakeBps(size=2, rank=0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv,
+                            update_on_kvstore=False)
+    x = mx.np.random.uniform(size=(4, 3))
+    before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(4)
+    assert not onp.allclose(before, net.weight.data().asnumpy())

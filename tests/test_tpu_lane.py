@@ -134,3 +134,289 @@ def test_hybridized_train_step_on_chip():
         trainer.step(1)
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < losses[0], losses
+
+
+# ---------------------------------------------------------------------------
+# round-4 widening (VERDICT r3 #10): flash dropout/kvlen/window sweep,
+# int8 MXU, bf16 BatchNorm, bulking dispatch counts, optimizer kernels
+# ---------------------------------------------------------------------------
+def test_flash_kv_length_on_chip():
+    from mxnet_tpu.ops.attention import attention_reference
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    B, H, L, D = 2, 4, 512, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s)) for s in range(3))
+    kv = jnp.asarray([200, 512], jnp.int32)
+    out = flash_attention_tpu(q, k, v, kv_length=kv)
+    ref = attention_reference(q, k, v, kv_length=kv)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-2, atol=2e-2)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_flash_kv_length_grads_on_chip():
+    from mxnet_tpu.ops.attention import attention_reference
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    B, H, L, D = 1, 2, 256, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s)) for s in range(3))
+    kv = jnp.asarray([100], jnp.int32)
+    g1 = jax.grad(lambda *a: (flash_attention_tpu(
+        *a, causal=True, kv_length=kv) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: (attention_reference(
+        *a, causal=True, kv_length=kv) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert bool(jnp.isfinite(a).all())
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=5e-2, atol=5e-2)
+
+
+def test_flash_dropout_matches_hash_oracle_on_chip():
+    from mxnet_tpu.ops.pallas.flash_attention import (flash_attention_tpu,
+                                                      hash_keep_bits)
+    B, H, L, D = 2, 2, 256, 64
+    rate = 0.1
+    seed = jnp.asarray([99], jnp.uint32)
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s)) for s in range(3))
+
+    def oracle(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q / onp.sqrt(D), k)
+        p = jax.nn.softmax(s, -1)
+        gi = jnp.broadcast_to(jnp.arange(L)[:, None], (L, L))
+        gj = jnp.broadcast_to(jnp.arange(L)[None, :], (L, L))
+        bits = jax.vmap(lambda b: hash_keep_bits(seed[0], b, gi, gj))(
+            jnp.arange(B * H))
+        thr = jnp.uint32(int(round(rate * 2 ** 32)))
+        keep = (bits >= thr).astype(jnp.float32).reshape(B, H, L, L)
+        return jnp.einsum("bhqk,bhkd->bhqd", p * keep / (1 - rate), v)
+
+    out = flash_attention_tpu(q, k, v, dropout=rate, seed=seed)
+    ref = oracle(q, k, v)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-2, atol=2e-2)
+
+
+def test_flash_dropout_grads_finite_and_seeded_on_chip():
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    B, H, L, D = 1, 2, 256, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s)) for s in range(3))
+    s1 = jnp.asarray([1], jnp.uint32)
+    s2 = jnp.asarray([2], jnp.uint32)
+    g = jax.grad(lambda *a: (flash_attention_tpu(
+        *a, dropout=0.2, seed=s1) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a in g:
+        assert bool(jnp.isfinite(a).all())
+    # determinism: same seed same output; different seed different mask
+    o1 = flash_attention_tpu(q, k, v, dropout=0.2, seed=s1)
+    o1b = flash_attention_tpu(q, k, v, dropout=0.2, seed=s1)
+    o2 = flash_attention_tpu(q, k, v, dropout=0.2, seed=s2)
+    onp.testing.assert_array_equal(onp.asarray(o1), onp.asarray(o1b))
+    assert float(jnp.max(jnp.abs(o1 - o2))) > 1e-3
+
+
+@pytest.mark.parametrize("window", [16, 128])
+def test_flash_window_sweep_on_chip(window):
+    from mxnet_tpu.ops.attention import attention_reference
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    B, H, L, D = 1, 2, 512, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s)) for s in range(3))
+    out = flash_attention_tpu(q, k, v, causal=True, window=window)
+    ref = attention_reference(q, k, v, causal=True, window=window)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-2, atol=2e-2)
+
+
+def test_flash_bf16_on_chip():
+    from mxnet_tpu.ops.attention import attention_reference
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    B, H, L, D = 2, 4, 512, 64
+    q, k, v = (jnp.asarray(_rand((B, H, L, D), seed=s), jnp.bfloat16)
+               for s in range(3))
+    out = flash_attention_tpu(q, k, v, causal=True).astype(jnp.float32)
+    ref = attention_reference(q, k, v, causal=True).astype(jnp.float32)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=8e-2, atol=8e-2)
+
+
+def test_int8_mxu_matmul_numerics_on_chip():
+    """int8 x int8 -> int32 accumulation on the MXU must be EXACT for
+    integer inputs (the quantized-dense core, quantized_fully_connected
+    parity)."""
+    rng = onp.random.RandomState(0)
+    a = rng.randint(-127, 128, (64, 256)).astype(onp.int8)
+    b = rng.randint(-127, 128, (128, 256)).astype(onp.int8)
+    acc = jax.lax.dot_general(jnp.asarray(a), jnp.asarray(b),
+                              (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    ref = a.astype(onp.int64) @ b.astype(onp.int64).T
+    assert acc.dtype == jnp.int32
+    onp.testing.assert_array_equal(onp.asarray(acc), ref.astype(onp.int32))
+
+
+def test_quantized_dense_on_chip():
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import QuantizedDense
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    dense = nn.Dense(32, in_units=64)
+    dense.initialize()
+    x = mx.np.array(_rand((8, 64)) * 0.5)
+    ref = dense(x).asnumpy()
+    q = QuantizedDense(dense, float(x.min().asnumpy()),
+                       float(x.max().asnumpy()))
+    got = q(x).asnumpy()
+    # int8 quantization error bound, not numerical noise
+    assert onp.abs(got - ref).max() < 0.1
+    assert onp.corrcoef(got.ravel(), ref.ravel())[0, 1] > 0.999
+
+
+def test_bf16_batchnorm_on_chip():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    bn32 = nn.BatchNorm(in_channels=16)
+    bn32.initialize()
+    x = mx.np.array(_rand((8, 16, 6, 6)))
+    with autograd.record(train_mode=True):
+        y32 = bn32(x)
+    bn16 = nn.BatchNorm(in_channels=16)
+    bn16.initialize()
+    bn16.cast("bfloat16")
+    with autograd.record(train_mode=True):
+        y16 = bn16(x.astype("bfloat16"))
+    onp.testing.assert_allclose(
+        onp.asarray(jnp.asarray(y16.asnumpy()).astype(jnp.float32)),
+        y32.asnumpy(), rtol=5e-2, atol=5e-2)
+    # running stats updated in both dtypes
+    assert float(onp.abs(bn32.running_var.data().asnumpy() - 1).max()) > 1e-4
+    assert float(onp.abs(bn16.running_var.data().asnumpy()
+                         .astype(onp.float32) - 1).max()) > 1e-4
+
+
+def test_bulking_steady_state_dispatch_counts_on_chip():
+    """The eager-bulking contract on the real chip: after warmup, an
+    imperative train step costs a handful of flushes and ZERO compiles
+    (VERDICT r3 weak #8: the bulking path had no on-chip assertions)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import _bulk, autograd, gluon
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "aggregate_num": 100})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.np.array(_rand((16, 32)))
+    y = mx.np.array(onp.arange(16) % 10)
+
+    def step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(16)
+        return loss
+
+    for _ in range(4):
+        loss = step()
+    float(loss.mean())
+    s0 = _bulk.stats()
+    for _ in range(3):
+        loss = step()
+    float(loss.mean())
+    s1 = _bulk.stats()
+    assert s1["compiles"] - s0["compiles"] == 0, "steady state recompiled"
+    assert s1["eager_fallbacks"] - s0["eager_fallbacks"] == 0
+    assert (s1["flushes"] - s0["flushes"]) <= 12  # a handful per step
+
+
+def test_deferred_vjp_backward_matches_jax_grad_on_chip():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    xv = _rand((8, 8), seed=3)
+    x = mx.np.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        loss = ((x @ x).tanh() ** 2).sum()
+    loss.backward()
+    ref = jax.grad(lambda a: (jnp.tanh(a @ a) ** 2).sum())(jnp.asarray(xv))
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.asarray(ref),
+                                rtol=2e-2, atol=2e-2)
+
+
+def test_fused_multi_sgd_on_chip():
+    from mxnet_tpu.ops.optimizer_ops import multi_sgd_mom_update
+    ws = [jnp.asarray(_rand((64, 64), seed=i)) for i in range(4)]
+    gs = [jnp.asarray(_rand((64, 64), seed=10 + i)) for i in range(4)]
+    ms = [jnp.zeros((64, 64)) for _ in range(4)]
+    out = multi_sgd_mom_update(ws, gs, ms, lrs=[0.1] * 4, momentum=0.9,
+                               wds=[0.0] * 4)
+    new_ws = out[0] if isinstance(out, tuple) else out
+    for w0, g, w1 in zip(ws, gs, new_ws):
+        onp.testing.assert_allclose(onp.asarray(w1),
+                                    onp.asarray(w0) - 0.1 * onp.asarray(g),
+                                    rtol=2e-2, atol=2e-4)
+
+
+def test_adam_update_on_chip():
+    from mxnet_tpu.ops.optimizer_ops import adam_update
+    w = jnp.asarray(_rand((128,), seed=0))
+    g = jnp.asarray(_rand((128,), seed=1))
+    mean = jnp.zeros(128)
+    var = jnp.zeros(128)
+    out = adam_update(w, g, mean, var, lr=1e-3)
+    w1 = out[0] if isinstance(out, (tuple, list)) else out
+    assert bool(jnp.isfinite(w1).all())
+    assert float(jnp.max(jnp.abs(w1 - w))) > 0  # moved
+
+
+def test_lstm_fused_scan_on_chip():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import rnn
+    mx.random.seed(0)
+    lstm = rnn.LSTM(32, num_layers=2, layout="NTC", input_size=16)
+    lstm.initialize()
+    x = mx.np.array(_rand((4, 12, 16)))
+    out = lstm(x)
+    assert out.shape == (4, 12, 32)
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_all_finite_on_chip():
+    from mxnet_tpu import npx
+    import mxnet_tpu as mx
+    good = mx.np.array(_rand((64,)))
+    bad = mx.np.array(onp.array([1.0, onp.inf], onp.float32))
+    assert bool(npx.all_finite(good).asnumpy())
+    assert not bool(npx.all_finite(good, bad).asnumpy())
+
+
+def test_embedding_take_on_chip():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    emb = nn.Embedding(100, 16)
+    emb.initialize()
+    tok = mx.np.array(onp.array([[1, 5, 99], [0, 2, 3]], onp.int32))
+    out = emb(tok)
+    w = emb.weight.data().asnumpy()
+    onp.testing.assert_allclose(out.asnumpy(), w[tok.asnumpy()], rtol=1e-6)
+
+
+def test_large_reduction_f32_accuracy_on_chip():
+    """Big f32 sum must accumulate in f32 (not bf16) on the chip."""
+    x = jnp.full((1 << 20,), 1.0e-3, jnp.float32)
+    s = float(jnp.sum(x))
+    assert abs(s - 1048.576) / 1048.576 < 1e-3, s
+
+
+def test_device_memory_census_on_chip():
+    from mxnet_tpu import profiler
+    st0 = profiler.device_memory_stats()
+    big = jnp.ones((2048, 2048), jnp.float32)  # 16 MB
+    jax.block_until_ready(big)
+    st1 = profiler.device_memory_stats()
+    assert st1["bytes_in_use"] >= st0["bytes_in_use"] + (8 << 20)
+    spec = profiler.chip_spec()
+    assert spec["hbm_bytes"] and spec["peak_flops_bf16"]
+    del big
