@@ -31,6 +31,7 @@ class _ExportCtx:
     def __init__(self):
         self.nodes = []
         self.initializers = OrderedDict()
+        self.multi = {}  # id(sym node) -> list of output names (Split...)
         self._uid = 0
 
     def fresh(self, base):
@@ -448,12 +449,6 @@ def _stack(ctx, node, ins, out):
     return ctx.add_node("Concat", unsq, [out], name=node.name, axis=axis)
 
 
-@register_converter("np:concatenate")
-def _np_concat(ctx, node, ins, out):
-    return ctx.add_node("Concat", list(ins), [out], name=node.name,
-                        axis=int(node._attrs.get("axis", 0)))
-
-
 @register_converter("np:pad")
 def _np_pad(ctx, node, ins, out):
     pw = _attr_or_pos(node, "pad_width", 0)
@@ -550,7 +545,10 @@ def _batch_dot(ctx, node, ins, out):
     for flag, which in (("transpose_a", 0), ("transpose_b", 1)):
         if node._attrs.get(flag):
             src = ins[which]
-            shp = node._inputs[which]._shape
+            try:
+                shp = node._inputs[which].shape  # inferred
+            except Exception:
+                shp = node._inputs[which]._shape
             if shp is None:
                 raise NotImplementedError(
                     "batch_dot transpose export needs static rank")
@@ -767,13 +765,18 @@ def export_to_model_dict(sym, params, input_shapes=None, input_dtypes=None,
                 cname, onp.asarray(node._attrs["value"], onp.float32))
             names[id(node)] = cname
         elif node._kind == "index":
-            # every emitted ONNX node is single-output: index 0 aliases
-            # the base tensor; any other index would dangle
-            if node._index != 0:
+            prod = node._inputs[0]
+            outs_list = ctx.multi.get(id(prod))
+            if outs_list is not None:  # true multi-output op (np:split)
+                names[id(node)] = outs_list[node._index]
+            elif node._index == 0:
+                # single-output: index 0 aliases the base tensor; any
+                # other index would dangle
+                names[id(node)] = names[id(prod)]
+            else:
                 raise NotImplementedError(
                     "ONNX export of multi-output op index %d (op %r)"
-                    % (node._index, node._inputs[0]._op))
-            names[id(node)] = names[id(node._inputs[0])]
+                    % (node._index, prod._op))
         elif node._kind == "group":
             continue
         else:
@@ -784,8 +787,10 @@ def export_to_model_dict(sym, params, input_shapes=None, input_dtypes=None,
                     % (node._op, len(_CONVERTERS)))
             ins = [names[id(i)] for i in node._inputs]
             out_name = node.name or ctx.fresh("out")
-            conv(ctx, node, ins, out_name)
-            names[id(node)] = out_name
+            res = conv(ctx, node, ins, out_name)
+            # multi-output converters (np:split) return a REAL produced
+            # tensor; out_name itself may be produced by no node
+            names[id(node)] = res if isinstance(res, str) else out_name
 
     try:
         _args, out_shapes, _aux = sym.infer_shape(**{
@@ -856,3 +861,251 @@ def export_model(sym, params, input_shapes=None, input_types=None,
     with open(onnx_file_path, "wb") as f:
         f.write(model.SerializeToString())
     return onnx_file_path
+
+
+# ---------------------------------------------------------------------------
+# converters: npx NN ops (emitted by HybridBlock.to_sym traces — the whole
+# gluon model zoo exports through these; attrs mirror the legacy layer)
+# ---------------------------------------------------------------------------
+@register_converter("npx:convolution")
+def _npx_conv(ctx, node, ins, out):
+    a = node._attrs
+    kernel = tuple(a["kernel"])
+    nd = len(kernel)
+    pad = tuple(a.get("pad") or (0,) * nd)
+    stride = tuple(a.get("stride") or (1,) * nd)
+    dilate = tuple(a.get("dilate") or (1,) * nd)
+    inputs = list(ins[:2]) + ([] if a.get("no_bias") else list(ins[2:3]))
+    return ctx.add_node("Conv", inputs, [out], name=node.name,
+                        kernel_shape=list(kernel), pads=list(pad) * 2,
+                        strides=list(stride), dilations=list(dilate),
+                        group=int(a.get("num_group", 1)))
+
+
+@register_converter("npx:fully_connected")
+def _npx_fc(ctx, node, ins, out):
+    a = node._attrs
+    x, w = ins[0], ins[1]
+    if a.get("flatten", True):
+        x = ctx.add_node("Flatten", [x], [ctx.fresh(node.name + "_flat")],
+                         axis=1)
+    if len(ins) < 3 or a.get("no_bias"):
+        if w not in ctx.initializers:
+            raise NotImplementedError(
+                "no-bias fully_connected export needs a constant weight "
+                "(to size the zero bias)")
+        bias = ctx.add_initializer(
+            node.name + "_zero_bias",
+            onp.zeros(int(ctx.initializers[w].shape[0]), onp.float32))
+    else:
+        bias = ins[2]
+    # Gemm needs 2-D x; flatten=False with >2-D input becomes MatMul+Add
+    in_shape = getattr(node._inputs[0], "_shape", None)
+    if not a.get("flatten", True):
+        try:
+            rank = len(node._inputs[0].shape)
+        except Exception:
+            rank = len(in_shape) if in_shape else 2
+        if rank != 2:
+            wt = ctx.add_node("Transpose", [w],
+                              [ctx.fresh(node.name + "_wT")], perm=[1, 0])
+            mm = ctx.add_node("MatMul", [x, wt],
+                              [ctx.fresh(node.name + "_mm")])
+            return ctx.add_node("Add", [mm, bias], [out], name=node.name)
+    return ctx.add_node("Gemm", [x, w, bias], [out], name=node.name,
+                        alpha=1.0, beta=1.0, transB=1)
+
+
+@register_converter("npx:pooling")
+def _npx_pool(ctx, node, ins, out):
+    a = node._attrs
+    ptype = a.get("pool_type", "max")
+    if ptype not in ("max", "avg"):
+        raise NotImplementedError("pooling export supports max/avg")
+    if a.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        return ctx.add_node(op, [ins[0]], [out], name=node.name)
+    kernel = tuple(a.get("kernel", (2, 2)))
+    stride = tuple(a.get("stride") or kernel)
+    pad = tuple(a.get("pad") or (0,) * len(kernel))
+    kw = {}
+    if a.get("pooling_convention", "valid") == "full":
+        kw["ceil_mode"] = 1
+    if ptype == "avg":
+        kw["count_include_pad"] = 1 if a.get("count_include_pad", True) else 0
+    op = {"max": "MaxPool", "avg": "AveragePool"}[ptype]
+    return ctx.add_node(op, [ins[0]], [out], name=node.name,
+                        kernel_shape=list(kernel), strides=list(stride),
+                        pads=list(pad) * 2, **kw)
+
+
+@register_converter("npx:batch_norm")
+def _npx_bn(ctx, node, ins, out):
+    a = node._attrs
+    scale = ins[1]
+    if a.get("fix_gamma", True) and scale in ctx.initializers:
+        # fix_gamma means gamma is pinned to 1 regardless of its value
+        scale = ctx.add_initializer(
+            node.name + "_fixed_gamma",
+            onp.ones_like(onp.asarray(ctx.initializers[scale])))
+    return ctx.add_node("BatchNormalization",
+                        [ins[0], scale, ins[2], ins[3], ins[4]], [out],
+                        name=node.name,
+                        epsilon=float(a.get("eps", 1e-3)),
+                        momentum=float(a.get("momentum", 0.9)))
+
+
+@register_converter("npx:activation")
+def _npx_act(ctx, node, ins, out):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = node._attrs.get("act_type")
+    if act is None:
+        extra = node._attrs.get("_extra_pos") or ["relu"]
+        act = extra[0]
+    if act == "gelu":  # decompose like npx:gelu (Erf form)
+        return _CONVERTERS["npx:gelu"](ctx, node, ins, out)
+    if act not in table:
+        raise NotImplementedError("activation export: act_type %r" % act)
+    return ctx.add_node(table[act], [ins[0]], [out], name=node.name)
+
+
+@register_converter("npx:dropout")
+def _npx_dropout(ctx, node, ins, out):
+    p = node._attrs.get("p", 0.5)
+    ratio = ctx.add_initializer(node.name + "_ratio",
+                                onp.asarray(p, onp.float32))
+    return ctx.add_node("Dropout", [ins[0], ratio], [out], name=node.name)
+
+
+@register_converter("npx:embedding")
+def _npx_embedding(ctx, node, ins, out):
+    idx = ctx.add_node("Cast", [ins[0]], [ctx.fresh(node.name + "_idx")],
+                       to=_elem_type("int64"))
+    return ctx.add_node("Gather", [ins[1], idx], [out], name=node.name,
+                        axis=0)
+
+
+@register_converter("npx:flash_attention")
+def _npx_flash(ctx, node, ins, out):
+    """Decompose fused attention into MatMul/Softmax/MatMul (ONNX has no
+    flash op; the fused kernel is numerically softmax(qk^T/sqrt(d)) v).
+    Inference graphs only: causal/window/dropout masks are rejected."""
+    a = node._attrs
+    # dropout is ignored: exported graphs are inference graphs (same
+    # convention as Dropout nodes, identity at inference)
+    if a.get("causal") or a.get("window") or len(ins) > 3:
+        raise NotImplementedError(
+            "flash_attention export supports the plain (unmasked) "
+            "configuration")
+    q, k, v = ins[0], ins[1], ins[2]
+    try:
+        d = node._inputs[0].shape[-1]
+    except Exception:
+        raise NotImplementedError(
+            "flash_attention export needs a static head dim")
+    scale = ctx.add_initializer(node.name + "_scale",
+                                onp.asarray(1.0 / onp.sqrt(d), onp.float32))
+    qs = ctx.add_node("Mul", [q, scale], [ctx.fresh(node.name + "_qs")])
+    kt = ctx.add_node("Transpose", [k], [ctx.fresh(node.name + "_kt")],
+                      perm=[0, 1, 3, 2])
+    att = ctx.add_node("MatMul", [qs, kt], [ctx.fresh(node.name + "_att")])
+    p = ctx.add_node("Softmax", [att], [ctx.fresh(node.name + "_p")],
+                     axis=-1)
+    return ctx.add_node("MatMul", [p, v], [out], name=node.name)
+
+
+@register_converter("np:concatenate")
+def _np_concatenate(ctx, node, ins, out):
+    axis = node._attrs.get("axis")
+    if axis is None:
+        extra = node._attrs.get("_extra_pos") or [0]
+        axis = extra[0]
+    return ctx.add_node("Concat", list(ins), [out], name=node.name,
+                        axis=int(axis))
+
+
+@register_converter("np:split")
+def _np_split(ctx, node, ins, out):
+    """numpy split -> ONNX Split with N outputs; downstream index nodes
+    alias them via ctx.multi."""
+    a = node._attrs
+    sections = a.get("indices_or_sections")
+    if sections is None:
+        extra = a.get("_extra_pos") or []
+        sections = extra[0] if extra else 2
+    if not isinstance(sections, int):
+        raise NotImplementedError("split export supports int sections")
+    axis = int(a.get("axis", 0))
+    outs = [ctx.fresh("%s_o%d" % (node.name, i)) for i in range(sections)]
+    # no num_outputs attr: it only exists from opset 18; at opset 13 an
+    # attr-less Split divides equally across len(outputs)
+    ctx.add_node("Split", [ins[0]], outs, name=node.name, axis=axis)
+    ctx.multi[id(node)] = outs
+    return outs[0]
+
+
+@register_converter("np:getitem")
+def _np_getitem(ctx, node, ins, out):
+    """Basic indexing (ints / slices / Ellipsis) -> Slice (+ Squeeze for
+    the int axes).  Requires a static input rank."""
+    try:
+        rank = len(node._inputs[0].shape)
+    except Exception:
+        raise NotImplementedError("getitem export needs a static rank")
+    spec = list(node._attrs.get("key") or ())
+    # expand Ellipsis to full slices
+    n_real = sum(1 for k in spec if k != "ellipsis")
+    expanded = []
+    for k in spec:
+        if k == "ellipsis":
+            expanded.extend([("slice", None, None, None)]
+                            * (rank - n_real))
+        elif isinstance(k, (list, tuple)):
+            expanded.append(("slice", k[1], k[2], k[3]))
+        else:
+            expanded.append(int(k))
+    while len(expanded) < rank:
+        expanded.append(("slice", None, None, None))
+    BIG = 1 << 31
+    starts, ends, steps, axes, int_axes = [], [], [], [], []
+    for ax, k in enumerate(expanded):
+        if isinstance(k, tuple):
+            s, e, st = k[1], k[2], k[3]
+            if (s, e, st) == (None, None, None):
+                continue
+            st = 1 if st is None else int(st)
+            starts.append(int(s) if s is not None
+                          else (0 if st > 0 else BIG - 1))
+            ends.append(int(e) if e is not None
+                        else (BIG if st > 0 else -BIG))
+            steps.append(st)
+            axes.append(ax)
+        else:
+            starts.append(int(k))
+            ends.append(int(k) + 1 if k != -1 else BIG)
+            steps.append(1)
+            axes.append(ax)
+            int_axes.append(ax)
+    cur = ins[0]
+    if axes:
+        s_i = ctx.add_initializer(node.name + "_starts",
+                                  onp.asarray(starts, onp.int64))
+        e_i = ctx.add_initializer(node.name + "_ends",
+                                  onp.asarray(ends, onp.int64))
+        a_i = ctx.add_initializer(node.name + "_axes",
+                                  onp.asarray(axes, onp.int64))
+        t_i = ctx.add_initializer(node.name + "_steps",
+                                  onp.asarray(steps, onp.int64))
+        nxt = (out if not int_axes
+               else ctx.fresh(node.name + "_sliced"))
+        cur = ctx.add_node("Slice", [cur, s_i, e_i, a_i, t_i], [nxt],
+                           name=None if int_axes else node.name)
+    if int_axes:
+        sq = ctx.add_initializer(node.name + "_sqaxes",
+                                 onp.asarray(int_axes, onp.int64))
+        cur = ctx.add_node("Squeeze", [cur, sq], [out], name=node.name)
+    elif not axes:
+        # key selected nothing (all full slices): Identity
+        cur = ctx.add_node("Identity", [ins[0]], [out], name=node.name)
+    return cur

@@ -256,7 +256,8 @@ _SIMPLE = {
     "Add": "add", "Sub": "subtract", "Mul": "multiply", "Div": "divide",
     "Pow": "power", "Neg": "negative", "Abs": "abs", "Exp": "exp",
     "Log": "log", "Sqrt": "sqrt", "Tanh": "tanh", "Sigmoid": "sigmoid",
-    "Erf": "erf", "Max": "maximum", "Min": "minimum", "MatMul": "dot",
+    "Erf": "erf", "Max": "maximum", "Min": "minimum",
+    "MatMul": "matmul",  # numpy matmul semantics (batched >2-D)
     "Sin": "sin", "Cos": "cos", "Floor": "floor", "Ceil": "ceil",
     "Sign": "sign", "Relu": "relu",
 }
@@ -451,3 +452,75 @@ def get_model_metadata(model_file):
         "output_tensor_data": [(o["name"], tuple(o["shape"] or ()))
                                for o in d["graph"]["output"]],
     }
+
+
+@register_importer("Identity")
+def _identity(ctx, node, sym_mod):
+    # alias, not *1.0 — a multiply would promote integer tensors to float
+    return ctx.sym_of(node["input"][0])
+
+
+@register_importer("Squeeze")
+def _squeeze(ctx, node, sym_mod):
+    ins = node["input"]
+    if len(ins) > 1:  # opset 13: axes ride as an initializer input
+        axes = tuple(int(x) for x in ctx.const_of(ins[1]))
+    else:
+        axes = tuple(node["attribute"].get("axes", ()))
+    ax = axes if len(axes) != 1 else axes[0]
+    return sym_mod.squeeze(ctx.sym_of(ins[0]),
+                           axis=ax if axes else None,
+                           name=node["output"][0])
+
+
+@register_importer("Unsqueeze")
+def _unsqueeze(ctx, node, sym_mod):
+    ins = node["input"]
+    if len(ins) > 1:
+        axes = [int(x) for x in ctx.const_of(ins[1])]
+    else:
+        axes = list(node["attribute"].get("axes", ()))
+    out = ctx.sym_of(ins[0])
+    for ax in sorted(axes):
+        out = sym_mod.expand_dims(out, axis=int(ax))
+    return out
+
+
+@register_importer("Split")
+def _split_imp(ctx, node, sym_mod):
+    a = node["attribute"]
+    n = len(node["output"])
+    if len(node["input"]) > 1:  # explicit split sizes
+        sizes = [int(x) for x in ctx.const_of(node["input"][1])]
+        if len(set(sizes)) != 1:
+            raise NotImplementedError("uneven Split import")
+        n = len(sizes)
+    s = sym_mod.split(ctx.sym_of(node["input"][0]), n,
+                      axis=int(a.get("axis", 0)))
+    for i, out_name in enumerate(node["output"]):
+        ctx.tensors[out_name] = s[i]
+    return None  # outputs registered above (multi-output op)
+
+
+@register_importer("Slice")
+def _slice_imp(ctx, node, sym_mod):
+    """ONNX Slice -> the basic-indexing op (np:getitem)."""
+    ins = node["input"]
+    starts = [int(x) for x in ctx.const_of(ins[1])]
+    ends = [int(x) for x in ctx.const_of(ins[2])]
+    axes = ([int(x) for x in ctx.const_of(ins[3])] if len(ins) > 3
+            else list(range(len(starts))))
+    steps = ([int(x) for x in ctx.const_of(ins[4])] if len(ins) > 4
+             else [1] * len(starts))
+    BIG = 1 << 30  # sentinel bounds mean "to the end"
+    key = {}
+    for s, e, ax, st in zip(starts, ends, axes, steps):
+        s = None if (st > 0 and s == 0) else s
+        e = None if abs(e) >= BIG else e
+        st = None if st == 1 else st
+        key[ax] = ["slice", s, e, st]
+    rank = max(key) + 1
+    spec = [key.get(ax, ["slice", None, None, None]) for ax in range(rank)]
+    from ...sym_api import Symbol
+    return Symbol("op", op="np:getitem", inputs=[ctx.sym_of(ins[0])],
+                  attrs={"key": spec}, name=node["output"][0])

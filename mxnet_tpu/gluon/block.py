@@ -542,6 +542,58 @@ class HybridBlock(Block):
         self.save_parameters(params_file)
         return path + "-symbol.json", params_file
 
+    def to_sym(self, input_shapes=None, input_dtypes=None):
+        """Symbolically trace this block into a composable mx.sym DAG +
+        params dict — the (sym, params) pair the ONNX exporter and the
+        reference's Gluon→Symbol conversion consume.
+
+        The forward runs ONCE with mx.sym Variables in place of inputs
+        and parameters (same rebinding trick as _build_cache); every
+        np/npx call dispatches symbolically on them, so a block written
+        against the eager array API traces unchanged.  Runs in predict
+        mode: dropout is identity, BatchNorm uses running stats (what an
+        exported inference graph means).  Returns (sym, params) with
+        params: name -> ndarray (BatchNorm running stats marked aux)."""
+        from .. import sym_api
+
+        if input_shapes is None:
+            if not getattr(self, "_last_input_avals", None):
+                raise ValueError(
+                    "to_sym needs input_shapes= or a prior forward call")
+            input_shapes = [tuple(a["shape"])
+                            for a in self._last_input_avals]
+            input_dtypes = [a["dtype"] for a in self._last_input_avals]
+        if input_shapes and not isinstance(input_shapes[0], (tuple, list)):
+            input_shapes = [tuple(input_shapes)]
+        if input_dtypes is None:
+            input_dtypes = ["float32"] * len(input_shapes)
+
+        params = OrderedDict(
+            (name, p) for name, p in self.collect_params().items()
+            if p._data is not None)
+        saved = [(p, p._data) for p in params.values()]
+        try:
+            pvals = {}
+            for name, p in params.items():
+                v = p._data
+                aux = p.grad_req == "null"  # running stats etc.
+                p._data = sym_api.var(name, shape=tuple(v.shape),
+                                      dtype=str(v.dtype), aux=aux)
+                pvals[name] = v
+            data_vars = [
+                sym_api.var("data" if len(input_shapes) == 1
+                            else "data%d" % i,
+                            shape=tuple(s), dtype=str(d))
+                for i, (s, d) in enumerate(zip(input_shapes, input_dtypes))]
+            with autograd._RecordingStateScope(False, False):
+                out = self.forward(*data_vars)
+            if isinstance(out, (list, tuple)):
+                out = sym_api.Group([o for o in out])
+            return out, pvals
+        finally:
+            for p, old in saved:
+                p._data = old
+
 
 class SymbolBlock(HybridBlock):
     """Run an imported serialized graph (reference block.py:1716).

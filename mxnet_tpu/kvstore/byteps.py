@@ -95,6 +95,10 @@ class KVStoreBytePS(KVStoreBase):
         # adapter does the same)
         outs = out if out is not None else [None] * len(key)
         vals = value if isinstance(value, (list, tuple)) else [value]
+        if not (len(key) == len(vals) == len(outs)):
+            raise ValueError(
+                "byteps batched call needs matching key/value/out "
+                "lengths, got %d/%d/%d" % (len(key), len(vals), len(outs)))
         for k, v, o in zip(key, vals, outs):
             self._run(k, v, o, priority, zero_non_root)
         return out

@@ -42,7 +42,13 @@ class MultiHeadAttention(HybridBlock):
         H, D = self._num_heads, self._head_dim
         qkv = self.qkv(x)  # (B, L, 3C)
         qkv = qkv.reshape(B, L, 3, H, D).transpose(2, 0, 3, 1, 4)  # (3,B,H,L,D)
-        q, k, v = qkv[0], qkv[1], qkv[2]
+        # split, not int-indexing: under symbolic tracing qkv[0] would be
+        # output-selection (reference Symbol semantics), while np.split's
+        # list works identically in eager and traced form
+        parts = np.split(qkv, 3, axis=0)
+        q = parts[0].squeeze(0)
+        k = parts[1].squeeze(0)
+        v = parts[2].squeeze(0)
         # the flash kernel covers attention-probability dropout (in-kernel
         # hash mask) and padding given as a (B,) valid-length vector; only
         # DENSE masks fall back to the unfused masked-softmax path

@@ -647,3 +647,50 @@ def polydiv(u, v):
 def roots(p):
     """Polynomial roots (host LAPACK path like the reference fallback)."""
     return array(onp.roots(onp.asarray(_unwrap(asarray(p)))))
+
+
+# symbolic dispatch on Symbol args — see numpy_extension (same contract,
+# op ids "np:<name>")
+from ..numpy_extension import _wrap_symbolic  # noqa: E402
+
+_wrap_symbolic(globals(), [n for n in list(globals())
+                           if not n.startswith("_")])
+
+
+# -- symbolic-indexing support (np:getitem) ---------------------------------
+def _encode_index(key):
+    """JSON-safe encoding of a basic-indexing key (ints / slices /
+    Ellipsis) for the symbolic np:getitem op."""
+    if not isinstance(key, tuple):
+        key = (key,)
+    spec = []
+    for k in key:
+        if isinstance(k, slice):
+            spec.append(["slice", k.start, k.stop, k.step])
+        elif k is Ellipsis:
+            spec.append("ellipsis")
+        elif isinstance(k, (int, onp.integer)):
+            spec.append(int(k))
+        else:
+            raise TypeError(
+                "symbolic indexing supports ints/slices/Ellipsis, got %r"
+                % (k,))
+    return spec
+
+
+def _decode_index(spec):
+    key = []
+    for k in spec:
+        if isinstance(k, (list, tuple)) and len(k) == 4 and k[0] == "slice":
+            key.append(slice(k[1], k[2], k[3]))
+        elif k == "ellipsis":
+            key.append(Ellipsis)
+        else:
+            key.append(int(k))
+    return tuple(key)
+
+
+def getitem(a, key):
+    """Eager replay of a symbolic basic-indexing node (sym[1:3, 0])."""
+    a = a if isinstance(a, ndarray) else array(a)
+    return a[_decode_index(key)]

@@ -660,3 +660,44 @@ def intgemm_fully_connected(data, weight, scaling=None, bias=None,
     if bias is not None and not no_bias:
         call.append(bias)
     return apply_op(f, *call)
+
+
+# ---------------------------------------------------------------------------
+# symbolic dispatch: calling any npx function on mx.sym Symbols builds the
+# corresponding sym node (op id "npx:<name>") instead of executing — so a
+# HybridBlock.forward written against the eager API traces into a
+# composable Symbol DAG (block.to_sym / ONNX export).  Duck-typed marker
+# check (_is_mx_symbol) to avoid a circular sym_api import.
+# ---------------------------------------------------------------------------
+def _wrap_symbolic(mod, names):
+    import functools as _ft
+
+    def _has_sym(a):
+        if getattr(a, "_is_mx_symbol", False):
+            return True
+        if isinstance(a, (list, tuple)):  # concatenate/stack sequences
+            return any(getattr(x, "_is_mx_symbol", False) for x in a)
+        return False
+
+    def make(name, fn):
+        @_ft.wraps(fn)
+        def wrapper(*args, **kwargs):
+            for a in args:
+                if _has_sym(a):
+                    from .. import sym_api
+                    return getattr(sym_api, name)(*args, **kwargs)
+            return fn(*args, **kwargs)
+        wrapper._mx_symbolic_dispatch = True
+        return wrapper
+
+    g = mod if isinstance(mod, dict) else vars(mod)
+    for n in names:
+        f = g.get(n)
+        if (callable(f) and not isinstance(f, type)
+                and not getattr(f, "_mx_symbolic_dispatch", False)
+                and getattr(f, "__module__", "").startswith("mxnet_tpu")):
+            g[n] = make(n, f)
+
+
+_wrap_symbolic(globals(), [n for n in list(globals())
+                           if not n.startswith("_")])

@@ -58,6 +58,8 @@ class Symbol:
     """A node in a symbolic DAG (kind: var | const | op | index | group)."""
 
     _counter = [0]
+    _is_mx_symbol = True  # duck-type marker: the eager np/npx wrappers
+    # dispatch to the symbolic factory on it without importing this module
 
     def __init__(self, kind, name=None, op=None, inputs=(), attrs=None,
                  shape=None, dtype=None, aux=False, index=None):
@@ -127,6 +129,13 @@ class Symbol:
             raise KeyError(key)
         if self._kind == "group":
             return self._inputs[key]
+        if isinstance(key, (slice, tuple)) or key is Ellipsis:
+            # ARRAY basic indexing (sym[:, 0], sym[1:3]): a real op node.
+            # A bare int stays output-selection (reference Symbol
+            # semantics: fc[0] is fc) — eager-idiom int indexing should
+            # use np.split / explicit tuples when written for tracing.
+            return Symbol("op", op="np:getitem", inputs=[self],
+                          attrs={"key": np_mod._encode_index(key)})
         return Symbol("index", name="%s_o%d" % (self.name, key),
                       inputs=[self], index=key)
 
@@ -195,6 +204,60 @@ class Symbol:
         return self._binop(o, "less_equal")
 
     __hash__ = object.__hash__  # __eq__ builds graphs; keep hashable
+
+    @property
+    def shape(self):
+        """Inferred output shape (trace-time shape queries: Flatten's
+        x.reshape((x.shape[0], -1)), attention's B,L,C unpacking).  Needs
+        every reachable leaf to declare a shape."""
+        if self._kind == "var" and self._shape is not None:
+            return self._shape
+        cached = getattr(self, "_shape_cache", None)
+        if cached is not None:
+            return tuple(cached)
+        env = {}
+        for n in self._leaves():
+            if n._shape is None:
+                raise AttributeError(
+                    "shape of %r needs every input var to declare one "
+                    "(leaf %r has none)" % (self.name, n.name))
+            env[n.name] = n._shape
+        shp = self._shape_pass(env)
+        if isinstance(shp, list):
+            raise AttributeError("multi-output symbol has no single shape")
+        object.__setattr__(self, "_shape_cache", tuple(shp))
+        return tuple(shp)
+
+    def __getattr__(self, name):
+        """ndarray-method parity: x.reshape(...)/x.transpose(...)/... on a
+        Symbol resolve through the generic op factory (np:<name> /
+        npx:<name>) with self as the first input — HybridBlock forwards
+        written against the eager array API then trace symbolically
+        unchanged."""
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in ("shape", "dtype", "ndim", "size", "asnumpy", "item",
+                    "data", "T", "grad"):
+            # 'shape' matters most: the shape PROPERTY raising
+            # AttributeError falls through to __getattr__, which would
+            # otherwise return np.shape as a phantom bound method
+            # metadata names must keep raising: hasattr(sym, 'asnumpy')
+            # style feature probes would otherwise see phantom methods
+            raise AttributeError(name)
+        if callable(getattr(np_mod, name, None)) or callable(
+                getattr(npx_mod, name, None)):
+            fn = __getattr__(name)  # module-level generic factory
+
+            def method(*args, **kwargs):
+                # ndarray methods take varargs shapes/axes
+                # (x.reshape(B, L, C), x.transpose(2, 0, 1)); the np
+                # FUNCTIONS take one tuple — repack
+                if name in ("reshape", "transpose") and len(args) > 1 \
+                        and all(isinstance(a, int) for a in args):
+                    args = (tuple(args),)
+                return fn(self, *args, **kwargs)
+            return method
+        raise AttributeError("Symbol has no attribute %r" % name)
 
     # -- shape inference ----------------------------------------------------
     def infer_shape(self, **kwargs):
@@ -285,7 +348,10 @@ class Symbol:
                 def apply(*vals):
                     nds = [_wrap_value(v) if isinstance(v, jax.Array)
                            else v for v in vals]
-                    out = fn(*nds, *extra, **attrs)
+                    if node._attrs.get("_pack_inputs"):
+                        out = fn(nds, *extra, **attrs)
+                    else:
+                        out = fn(*nds, *extra, **attrs)
                     return _unwrap_out(out)
 
                 r = jax.eval_shape(apply, *[
@@ -332,7 +398,12 @@ class Symbol:
                 fn = _resolve_op(node._op)
                 args = [walk(i) for i in node._inputs]
                 extra, attrs = _attr_kwargs(node)
-                r = fn(*args, *extra, **attrs)
+                if node._attrs.get("_pack_inputs"):
+                    # list-input ops (concatenate/stack): the eager fn
+                    # takes ONE sequence argument
+                    r = fn(args, *extra, **attrs)
+                else:
+                    r = fn(*args, *extra, **attrs)
             memo[id(node)] = r
             return r
 
@@ -463,6 +534,7 @@ def _attr_kwargs(node):
     """(extra_positional_args, kwargs) for calling the eager op."""
     attrs = {k: (tuple(v) if isinstance(v, list) else v)
              for k, v in node._attrs.items()}
+    attrs.pop("_pack_inputs", None)  # eval-dispatch flag, not an op kwarg
     extra = attrs.pop("_extra_pos", ())
     extra = tuple(tuple(e) if isinstance(e, list) else e for e in extra)
     return extra, attrs
@@ -699,6 +771,7 @@ def _mk_conv(data, weight, bias=None, **attrs):
         stride=tuple(attrs.get("stride") or ()) or None,
         pad=tuple(attrs.get("pad") or ()) or None,
         dilate=tuple(attrs.get("dilate") or ()) or None,
+        num_group=int(attrs.get("num_group", 1)),  # depthwise/grouped
         no_bias=attrs.get("no_bias", False))
 
 
@@ -1013,6 +1086,28 @@ def _generic_factory(op_id):
 
     make_symbol.__name__ = fn_name
     return make_symbol
+
+
+def _packed_factory(op_id):
+    """Symbolic builder for ops whose eager form takes ONE sequence of
+    arrays (np.concatenate/stack/...): the symbols become the node's
+    inputs and _pack_inputs tells evaluation to re-pack them."""
+    def make(seq, *extra, name=None, **kwargs):
+        inputs = [_as_symbol(s) for s in seq]
+        attrs = dict(kwargs)
+        attrs["_pack_inputs"] = True
+        if extra:
+            attrs["_extra_pos"] = [list(e) if isinstance(e, tuple) else e
+                                   for e in extra]
+        return Symbol("op", name=name, op=op_id, inputs=inputs, attrs=attrs)
+    make.__name__ = op_id.split(":", 1)[1]
+    return make
+
+
+concatenate = _packed_factory("np:concatenate")
+stack = _packed_factory("np:stack")
+vstack = _packed_factory("np:vstack")
+hstack = _packed_factory("np:hstack")
 
 
 def __getattr__(name):

@@ -256,3 +256,79 @@ def test_index0_node_exports_as_base_name():
     out_name = model["graph"]["output"][0]["name"]
     produced = {o for n in model["graph"]["node"] for o in n["output"]}
     assert out_name in produced  # no dangling "fc:0" reference
+
+
+# ---------------------------------------------------------------------------
+# model-zoo closure (VERDICT r3 #4): every family exports via
+# HybridBlock.to_sym and reimports with matching numerics
+# ---------------------------------------------------------------------------
+def _roundtrip_net(net, x, rtol=2e-3, atol=2e-3, input_dtypes=None):
+    ref = net(x)
+    ref_list = [r.asnumpy() for r in (ref if isinstance(ref, tuple)
+                                      else (ref,))]
+    net_sym, params = net.to_sym(
+        input_shapes=[tuple(x.shape)], input_dtypes=input_dtypes)
+    model = export_to_model_dict(net_sym, params)
+    sym2, ap, xp = import_from_model_dict(model)
+    env = {k: mxnp.array(v) for k, v in {**ap, **xp}.items()}
+    outs = sym2.eval(data=x, **env)
+    for got, want in zip(outs, ref_list):
+        onp.testing.assert_allclose(got.asnumpy(), want, rtol=rtol,
+                                    atol=atol)
+    return model
+
+
+_ZOO_FAST = ["resnet18_v1", "squeezenet1_0", "mobilenet_v2_0_25"]
+_ZOO_SLOW = ["alexnet", "vgg11", "vgg11_bn", "resnet18_v2", "densenet121",
+             "inception_v3", "mobilenet0_25"]
+
+
+def _run_zoo_roundtrip(family):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    mx.random.seed(0)
+    net = getattr(zoo, family)(classes=10)
+    net.initialize(mx.init.Xavier())
+    shape = (1, 3, 299, 299) if "inception" in family else (1, 3, 224, 224)
+    _roundtrip_net(net, mxnp.random.uniform(size=shape))
+
+
+@pytest.mark.parametrize("family", _ZOO_FAST)
+def test_zoo_family_onnx_roundtrip(family):
+    _run_zoo_roundtrip(family)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", _ZOO_SLOW)
+def test_zoo_family_onnx_roundtrip_slow(family):
+    _run_zoo_roundtrip(family)
+
+
+def test_bert_tiny_onnx_roundtrip():
+    """bert_tiny exports through the flash-attention decomposition
+    (MatMul/Softmax/MatMul), embedding Gather, LayerNormalization, Split
+    and Slice — and reimports with matching numerics for both heads."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.bert import bert_tiny
+    mx.random.seed(0)
+    net = bert_tiny()
+    net.initialize(mx.init.Xavier())
+    tok = mxnp.array(onp.random.RandomState(0).randint(
+        0, 1000, (2, 16)).astype("int32"))
+    model = _roundtrip_net(net, tok, rtol=5e-3, atol=5e-3,
+                           input_dtypes=["int32"])
+    ops = {n["op_type"] for n in model["graph"]["node"]}
+    assert {"MatMul", "Softmax", "Gather", "LayerNormalization",
+            "Split", "Slice"} <= ops
+
+
+def test_symbol_getitem_slicing_roundtrip():
+    x = sym.var("x", shape=(4, 6), dtype="float32")
+    out = x[1:3, 0] * 2.0
+    xv = onp.random.RandomState(0).randn(4, 6).astype("float32")
+    (ref,) = out.eval(x=mxnp.array(xv))
+    onp.testing.assert_allclose(ref.asnumpy(), xv[1:3, 0] * 2, rtol=1e-6)
+    model = export_to_model_dict(out, {})
+    sym2, _ap, _xp = import_from_model_dict(model)
+    (got,) = sym2.eval(x=mxnp.array(xv))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-6)
