@@ -1,0 +1,85 @@
+"""Horovod-style data-parallel training (parity target: reference
+example/distributed_training-horovod/train_mnist.py).
+
+The script follows the exact hvd workflow — rank/size, parameter
+broadcast from rank 0, allreduce-averaged gradients — through the
+kvstore='horovod' adapter when a horovod package is present, and falls
+back to the framework's native path (kvstore='tpu_ici', XLA collectives
+over the mesh) otherwise, so the same script runs everywhere.
+
+Run: python example/distributed_training-horovod/train_mnist_hvd.py [--smoke]
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as np
+from mxnet_tpu.gluon import nn
+
+
+def make_kvstore():
+    try:
+        kv = mx.kv.create("horovod")
+        print("using horovod kvstore (rank %d/%d)"
+              % (kv.rank, kv.num_workers))
+    except ImportError:
+        kv = mx.kv.create("tpu_ici")
+        print("horovod not installed; native tpu_ici collectives "
+              "(rank %d/%d)" % (kv.rank, kv.num_workers))
+    return kv
+
+
+def build_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(10, 5, activation="tanh"), nn.MaxPool2D(2),
+            nn.Conv2D(20, 5, activation="tanh"), nn.MaxPool2D(2),
+            nn.Flatten(), nn.Dense(50, activation="tanh"), nn.Dense(10))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    kv = make_kvstore()
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    # hvd-style: scale lr by world size, average grads across workers
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr * kv.num_workers},
+                            kvstore=kv, update_on_kvstore=False)
+
+    ds = gluon.data.vision.MNIST(train=True)
+    tf = gluon.data.vision.transforms.ToTensor()
+    loader = gluon.data.DataLoader(ds.transform_first(tf),
+                                   batch_size=args.batch, shuffle=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    steps = 20 if args.smoke else None
+    for epoch in range(1 if args.smoke else args.epochs):
+        metric = gluon.metric.Accuracy()
+        for i, (x, y) in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch)
+            metric.update([y], [out])
+        print("epoch %d  rank %d  accuracy %.3f"
+              % (epoch, kv.rank, metric.get()[1]))
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Actor-critic policy gradient (parity target: reference
+example/gluon/actor_critic) — TPU-native: the policy/value net
+hybridizes; episodes run imperatively (the env is host-side Python).
+
+A dependency-free CartPole implementation replaces gym so the example
+runs offline.
+
+Run: python example/gluon/actor_critic.py [--episodes N] [--smoke]
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as np
+from mxnet_tpu.gluon import nn
+
+
+class CartPole:
+    """Classic cart-pole dynamics (Barto et al.), numpy only."""
+
+    def __init__(self, seed=0):
+        self.rng = onp.random.RandomState(seed)
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4)
+        self.t = 0
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        f = 10.0 if action == 1 else -10.0
+        costh, sinth = onp.cos(th), onp.sin(th)
+        temp = (f + 0.05 * thd ** 2 * sinth) / 1.1
+        thacc = (9.8 * sinth - costh * temp) / \
+            (0.5 * (4.0 / 3.0 - 0.1 * costh ** 2 / 1.1))
+        xacc = temp - 0.05 * thacc * costh / 1.1
+        tau = 0.02
+        self.s = onp.array([x + tau * xd, xd + tau * xacc,
+                            th + tau * thd, thd + tau * thacc])
+        self.t += 1
+        done = bool(abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.2095
+                    or self.t >= 200)
+        return self.s.copy(), 1.0, done
+
+
+class PolicyValue(gluon.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.body = nn.Dense(128, activation="relu", in_units=4)
+        self.action = nn.Dense(2, in_units=128)
+        self.value = nn.Dense(1, in_units=128)
+
+    def forward(self, x):
+        h = self.body(x)
+        return self.action(h), self.value(h)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=150)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.episodes = 3
+
+    mx.random.seed(0)
+    env = CartPole(seed=0)
+    net = PolicyValue()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    rng = onp.random.RandomState(1)
+
+    running = 10.0
+    for ep in range(args.episodes):
+        s = env.reset()
+        states, actions, rewards = [], [], []
+        done = False
+        while not done:
+            logits, _ = net(np.array(s[None].astype("float32")))
+            p = onp.exp(logits.asnumpy()[0])
+            p = p / p.sum()
+            a = int(rng.choice(2, p=p))
+            states.append(s)
+            actions.append(a)
+            s, r, done = env.step(a)
+            rewards.append(r)
+
+        # discounted returns, normalized
+        R, returns = 0.0, []
+        for r in reversed(rewards):
+            R = r + args.gamma * R
+            returns.append(R)
+        returns = onp.array(returns[::-1], "float32")
+        returns = (returns - returns.mean()) / (returns.std() + 1e-6)
+
+        S = np.array(onp.stack(states).astype("float32"))
+        A = np.array(onp.array(actions, "int32"))
+        G = np.array(returns)
+        with autograd.record():
+            logits, values = net(S)
+            logp = mx.npx.log_softmax(logits, axis=-1)
+            chosen = mx.npx.pick(logp, A, axis=-1)
+            adv = (G - values.reshape((-1,))).detach()
+            policy_loss = -(chosen * adv).sum()
+            value_loss = ((values.reshape((-1,)) - G) ** 2).sum()
+            loss = policy_loss + 0.5 * value_loss
+        loss.backward()
+        trainer.step(len(rewards))
+
+        running = 0.95 * running + 0.05 * len(rewards)
+        if ep % 10 == 0 or ep == args.episodes - 1:
+            print("episode %d  length %d  running %.1f"
+                  % (ep, len(rewards), running))
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""DCGAN (parity target: reference example/gluon/dc_gan) — TPU-native:
+both networks hybridize into single XLA programs; one fused
+generator+discriminator update per step.
+
+Synthetic 32x32 image data keeps the example offline; swap `real_batch`
+for an ImageRecordIter / DataLoader stream for real training.
+
+Run: python example/gluon/dc_gan.py [--iters N] [--smoke]
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as np
+from mxnet_tpu.gluon import nn
+
+
+def build_generator(ngf=32, nz=64):
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2DTranspose(ngf * 4, 4, 1, 0, use_bias=False),  # 1 -> 4
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(ngf * 2, 4, 2, 1, use_bias=False),  # 4 -> 8
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False),      # 8 -> 16
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(1, 4, 2, 1, use_bias=False),        # 16 -> 32
+        nn.Activation("tanh"))
+    return net
+
+
+def build_discriminator(ndf=32):
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2D(ndf, 4, 2, 1, use_bias=False),
+        nn.LeakyReLU(0.2),
+        nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),
+        nn.Conv2D(ndf * 4, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),
+        nn.Conv2D(1, 4, 1, 0, use_bias=False))
+    return net
+
+
+def real_batch(rng, batch):
+    """Synthetic 'real' distribution: soft blobs (stands in for MNIST)."""
+    yy, xx = onp.mgrid[0:32, 0:32] / 32.0          # (32, 32) each
+    cx = rng.uniform(0.25, 0.75, (batch, 1, 1))
+    cy = rng.uniform(0.25, 0.75, (batch, 1, 1))
+    img = onp.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / 0.02)) * 2 - 1
+    return np.array(img[:, None].astype("float32"))  # (B, 1, 32, 32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--nz", type=int, default=64)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.iters, args.batch = 4, 8
+
+    mx.random.seed(0)
+    rng = onp.random.RandomState(0)
+    netG, netD = build_generator(nz=args.nz), build_discriminator()
+    netG.initialize(mx.init.Normal(0.02))
+    netD.initialize(mx.init.Normal(0.02))
+    netG.hybridize()
+    netD.hybridize()
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    trainerG = gluon.Trainer(netG.collect_params(), "adam",
+                             {"learning_rate": 2e-4, "beta1": 0.5})
+    trainerD = gluon.Trainer(netD.collect_params(), "adam",
+                             {"learning_rate": 2e-4, "beta1": 0.5})
+
+    ones = np.ones((args.batch,))
+    zeros = np.zeros((args.batch,))
+    for it in range(args.iters):
+        real = real_batch(rng, args.batch)
+        noise = np.random.normal(0, 1, size=(args.batch, args.nz, 1, 1))
+        # D step
+        with autograd.record():
+            fake = netG(noise)
+            errD = (loss_fn(netD(real).reshape((-1,)), ones)
+                    + loss_fn(netD(fake.detach()).reshape((-1,)), zeros))
+            errD = errD.mean()
+        errD.backward()
+        trainerD.step(1)
+        # G step
+        with autograd.record():
+            errG = loss_fn(netD(netG(noise)).reshape((-1,)), ones).mean()
+        errG.backward()
+        trainerG.step(1)
+        if it % max(1, args.iters // 10) == 0 or it == args.iters - 1:
+            print("iter %d  D=%.4f  G=%.4f"
+                  % (it, float(errD.asnumpy()), float(errG.asnumpy())))
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

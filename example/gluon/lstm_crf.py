@@ -1,0 +1,136 @@
+"""BiLSTM-CRF sequence tagger (parity target: reference
+example/gluon/lstm_crf) — TPU-native: the CRF forward algorithm and
+Viterbi decode are vectorized over the tag dimension (logsumexp /
+max-reduction per step instead of the reference's per-tag python loops).
+
+Tiny in-file corpus; the point is the model, not the data.
+
+Run: python example/gluon/lstm_crf.py [--epochs N] [--smoke]
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as np
+from mxnet_tpu.gluon import nn, rnn
+
+START, STOP = "<s>", "</s>"
+
+
+class BiLSTMCRF(gluon.Block):
+    def __init__(self, vocab_size, tag2idx, embed=32, hidden=32):
+        super().__init__()
+        self.tag2idx = tag2idx
+        self.n_tags = len(tag2idx)
+        self.embedding = nn.Embedding(vocab_size, embed)
+        self.lstm = rnn.LSTM(hidden // 2, bidirectional=True,
+                             layout="NTC", input_size=embed)
+        self.hidden2tag = nn.Dense(self.n_tags, flatten=False,
+                                   in_units=hidden)
+        self.transitions = gluon.Parameter("transitions",
+                                           shape=(self.n_tags, self.n_tags))
+
+    def _emissions(self, sent):
+        h = self.lstm(self.embedding(sent.reshape((1, -1))))
+        return self.hidden2tag(h)[0]  # (T, n_tags)
+
+    def _forward_alg(self, emis):
+        """log Z via the forward algorithm, vectorized over tags."""
+        T = self.transitions.data()
+        alpha = np.full((self.n_tags,), -10000.0)
+        alpha[self.tag2idx[START]] = 0.0
+        for t in range(emis.shape[0]):
+            # broadcast: alpha[j] + T[i, j] + emis[t, i]
+            scores = alpha.reshape((1, -1)) + T + \
+                emis[t].reshape((-1, 1))
+            m = scores.max(axis=1, keepdims=True)
+            alpha = (m.reshape((-1,))
+                     + np.log(np.exp(scores - m).sum(axis=1)))
+        final = alpha + T[self.tag2idx[STOP]]
+        m = final.max()
+        return m + np.log(np.exp(final - m).sum())
+
+    def _score(self, emis, tags):
+        T = self.transitions.data()
+        idx = [self.tag2idx[START]] + tags
+        s = np.array(0.0)
+        for t in range(emis.shape[0]):
+            s = s + T[idx[t + 1], idx[t]] + emis[t, idx[t + 1]]
+        return s + T[self.tag2idx[STOP], idx[-1]]
+
+    def neg_log_likelihood(self, sent, tags):
+        emis = self._emissions(sent)
+        return self._forward_alg(emis) - self._score(emis, tags)
+
+    def viterbi(self, sent):
+        emis = self._emissions(sent).asnumpy()
+        T = self.transitions.data().asnumpy()
+        alpha = onp.full(self.n_tags, -10000.0)
+        alpha[self.tag2idx[START]] = 0.0
+        back = []
+        for t in range(emis.shape[0]):
+            scores = alpha[None, :] + T          # (to, from)
+            best = scores.argmax(1)
+            alpha = scores.max(1) + emis[t]
+            back.append(best)
+        final = alpha + T[self.tag2idx[STOP]]
+        path = [int(final.argmax())]
+        for bptr in reversed(back):
+            path.append(int(bptr[path[-1]]))
+        path.reverse()
+        return path[1:], float(final.max())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=40)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.epochs = 3
+
+    data = [
+        ("the wall street journal reported today that apple corporation "
+         "made money".split(), "B I I I O O O B I O O".split()),
+        ("georgia tech is a university in georgia".split(),
+         "B I O O O O B".split()),
+    ]
+    vocab = {w: i for i, w in enumerate(
+        sorted({w for s, _ in data for w in s}))}
+    tag2idx = {"B": 0, "I": 1, "O": 2, START: 3, STOP: 4}
+
+    mx.random.seed(0)
+    model = BiLSTMCRF(len(vocab), tag2idx)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "wd": 1e-4})
+
+    def encode(sent):
+        return np.array(onp.array([vocab[w] for w in sent], "int32"))
+
+    first = last = None
+    for ep in range(args.epochs):
+        total = 0.0
+        for sent, tags in data:
+            with autograd.record():
+                nll = model.neg_log_likelihood(
+                    encode(sent), [tag2idx[t] for t in tags])
+            nll.backward()
+            trainer.step(1)
+            total += float(nll.asnumpy())
+        if first is None:
+            first = total
+        last = total
+        if ep % 10 == 0 or ep == args.epochs - 1:
+            print("epoch %d  nll %.3f" % (ep, total))
+
+    path, score = model.viterbi(encode(data[0][0]))
+    inv = {v: k for k, v in tag2idx.items()}
+    print("viterbi:", [inv[p] for p in path], "score %.2f" % score)
+    assert last < first, "training did not reduce NLL"
+
+
+if __name__ == "__main__":
+    main()

@@ -107,14 +107,16 @@ def _bn(ctx, node, ins, out):
                         momentum=float(a.get("momentum", 0.9)))
 
 
+_ACT_TABLE = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+              "softrelu": "Softplus", "softsign": "Softsign"}
+
+
 @register_converter("legacy:Activation")
 def _act(ctx, node, ins, out):
-    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
-             "softrelu": "Softplus", "softsign": "Softsign"}
     act = node._attrs.get("act_type", "relu")
-    if act not in table:
+    if act not in _ACT_TABLE:
         raise ValueError("ONNX export: unsupported act_type %r" % act)
-    return ctx.add_node(table[act], [ins[0]], [out], name=node.name)
+    return ctx.add_node(_ACT_TABLE[act], [ins[0]], [out], name=node.name)
 
 
 @register_converter("legacy:LeakyReLU")
@@ -440,7 +442,7 @@ def _take(ctx, node, ins, out):
 
 @register_converter("np:stack")
 def _stack(ctx, node, ins, out):
-    axis = int(node._attrs.get("axis", 0))
+    axis = int(_attr_or_pos(node, "axis", 0, 0))
     ax = ctx.add_initializer(node.name + "_axes",
                              onp.asarray([axis], onp.int64))
     unsq = [ctx.add_node("Unsqueeze", [i, ax],
@@ -867,19 +869,8 @@ def export_model(sym, params, input_shapes=None, input_types=None,
 # converters: npx NN ops (emitted by HybridBlock.to_sym traces — the whole
 # gluon model zoo exports through these; attrs mirror the legacy layer)
 # ---------------------------------------------------------------------------
-@register_converter("npx:convolution")
-def _npx_conv(ctx, node, ins, out):
-    a = node._attrs
-    kernel = tuple(a["kernel"])
-    nd = len(kernel)
-    pad = tuple(a.get("pad") or (0,) * nd)
-    stride = tuple(a.get("stride") or (1,) * nd)
-    dilate = tuple(a.get("dilate") or (1,) * nd)
-    inputs = list(ins[:2]) + ([] if a.get("no_bias") else list(ins[2:3]))
-    return ctx.add_node("Conv", inputs, [out], name=node.name,
-                        kernel_shape=list(kernel), pads=list(pad) * 2,
-                        strides=list(stride), dilations=list(dilate),
-                        group=int(a.get("num_group", 1)))
+# attrs are name-identical to legacy:Convolution — same converter
+_CONVERTERS["npx:convolution"] = _conv
 
 
 @register_converter("npx:fully_connected")
@@ -905,7 +896,11 @@ def _npx_fc(ctx, node, ins, out):
         try:
             rank = len(node._inputs[0].shape)
         except Exception:
-            rank = len(in_shape) if in_shape else 2
+            if in_shape is None:
+                raise NotImplementedError(
+                    "flatten=False fully_connected export needs a static "
+                    "input rank (declare var shapes)")
+            rank = len(in_shape)
         if rank != 2:
             wt = ctx.add_node("Transpose", [w],
                               [ctx.fresh(node.name + "_wT")], perm=[1, 0])
@@ -957,17 +952,12 @@ def _npx_bn(ctx, node, ins, out):
 
 @register_converter("npx:activation")
 def _npx_act(ctx, node, ins, out):
-    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
-             "softrelu": "Softplus", "softsign": "Softsign"}
-    act = node._attrs.get("act_type")
-    if act is None:
-        extra = node._attrs.get("_extra_pos") or ["relu"]
-        act = extra[0]
+    act = _attr_or_pos(node, "act_type", 0, "relu")
     if act == "gelu":  # decompose like npx:gelu (Erf form)
         return _CONVERTERS["npx:gelu"](ctx, node, ins, out)
-    if act not in table:
+    if act not in _ACT_TABLE:
         raise NotImplementedError("activation export: act_type %r" % act)
-    return ctx.add_node(table[act], [ins[0]], [out], name=node.name)
+    return ctx.add_node(_ACT_TABLE[act], [ins[0]], [out], name=node.name)
 
 
 @register_converter("npx:dropout")
@@ -1017,12 +1007,8 @@ def _npx_flash(ctx, node, ins, out):
 
 @register_converter("np:concatenate")
 def _np_concatenate(ctx, node, ins, out):
-    axis = node._attrs.get("axis")
-    if axis is None:
-        extra = node._attrs.get("_extra_pos") or [0]
-        axis = extra[0]
     return ctx.add_node("Concat", list(ins), [out], name=node.name,
-                        axis=int(axis))
+                        axis=int(_attr_or_pos(node, "axis", 0, 0)))
 
 
 @register_converter("np:split")
@@ -1030,10 +1016,7 @@ def _np_split(ctx, node, ins, out):
     """numpy split -> ONNX Split with N outputs; downstream index nodes
     alias them via ctx.multi."""
     a = node._attrs
-    sections = a.get("indices_or_sections")
-    if sections is None:
-        extra = a.get("_extra_pos") or []
-        sections = extra[0] if extra else 2
+    sections = _attr_or_pos(node, "indices_or_sections", 0, 2)
     if not isinstance(sections, int):
         raise NotImplementedError("split export supports int sections")
     axis = int(a.get("axis", 0))

@@ -513,6 +513,10 @@ def _slice_imp(ctx, node, sym_mod):
     steps = ([int(x) for x in ctx.const_of(ins[4])] if len(ins) > 4
              else [1] * len(starts))
     BIG = 1 << 30  # sentinel bounds mean "to the end"
+    if any(ax < 0 for ax in axes):
+        # the input rank is unknown here, so negative axes cannot be
+        # normalized — reject instead of silently mis-slicing
+        raise NotImplementedError("Slice import with negative axes")
     key = {}
     for s, e, ax, st in zip(starts, ends, axes, steps):
         s = None if (st > 0 and s == 0) else s

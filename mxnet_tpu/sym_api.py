@@ -216,15 +216,22 @@ class Symbol:
         if cached is not None:
             return tuple(cached)
         env = {}
+
+        def _fail(msg):
+            # stash the diagnostic: the attribute protocol falls through
+            # to __getattr__, which re-raises it (a bare AttributeError
+            # from here would surface as just 'shape')
+            object.__setattr__(self, "_shape_error", msg)
+            raise AttributeError(msg)
+
         for n in self._leaves():
             if n._shape is None:
-                raise AttributeError(
-                    "shape of %r needs every input var to declare one "
-                    "(leaf %r has none)" % (self.name, n.name))
+                _fail("shape of %r needs every input var to declare one "
+                      "(leaf %r has none)" % (self.name, n.name))
             env[n.name] = n._shape
         shp = self._shape_pass(env)
         if isinstance(shp, list):
-            raise AttributeError("multi-output symbol has no single shape")
+            _fail("multi-output symbol has no single shape")
         object.__setattr__(self, "_shape_cache", tuple(shp))
         return tuple(shp)
 

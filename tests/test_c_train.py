@@ -221,3 +221,34 @@ def test_capi_cachedop_kvstore_generic():
     # waitall + seed round out the misc surface
     assert lib.MXTRandomSeed(5) == 0
     assert lib.MXTNDArrayWaitAll() == 0
+
+
+@pytest.mark.slow
+def test_c_multi_threaded_inference(tmp_path):
+    """Reference example/multi_threaded_inference parity: N pthreads
+    share one CachedOp through the C ABI and every result matches the
+    single-threaded reference."""
+    _ensure_lib()
+    src = os.path.join(REPO, "example", "extensions",
+                       "multi_threaded_inference", "mti.c")
+    exe = str(tmp_path / "mti")
+    r = subprocess.run(
+        ["gcc", src, "-I", os.path.join(REPO, "include"),
+         "-o", exe, "-L", os.path.dirname(LIB), "-lmxtpu_capi",
+         "-lpthread", "-Wl,-rpath," + os.path.dirname(LIB), "-lm"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    from mxnet_tpu import sym_api as sym
+    x = sym.var("x", shape=(1, 16), dtype="float32")
+    graph = sym.tanh(x * 3.0) + 0.5
+    gfile = str(tmp_path / "graph.json")
+    with open(gfile, "w") as f:
+        f.write(graph.tojson())
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([exe, gfile], capture_output=True, text=True,
+                       env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "matched the reference" in r.stdout

@@ -1,0 +1,37 @@
+"""Acceptance-example inventory (SURVEY §2.9 / VERDICT r3 missing #8):
+every example script runs end-to-end in --smoke mode.  Each is a real
+training/eval loop on synthetic data — the smoke flag only shrinks
+iteration counts."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    "example/gluon/dc_gan.py",
+    "example/gluon/actor_critic.py",
+    "example/gluon/house_prices.py",
+    "example/gluon/lstm_crf.py",
+    "example/gluon/embedding_learning.py",
+    "example/gluon/word_language_model.py",
+    "example/distributed_training-horovod/train_mnist_hvd.py",
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script", EXAMPLES,
+                         ids=[os.path.basename(s) for s in EXAMPLES])
+def test_example_smoke(script):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([sys.executable, os.path.join(REPO, script),
+                        "--smoke"],
+                       capture_output=True, text=True, env=env,
+                       timeout=600, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "done" in r.stdout or "rmse" in r.stdout \
+        or "viterbi" in r.stdout or "accuracy" in r.stdout
