@@ -55,6 +55,10 @@ FLOPS_PER_ITEM = {
     "resnet50_train_bf16_imgs_per_sec_per_chip": 3 * 8.2e9,
     "resnet50_dp_kvstore_ici_imgs_per_sec_per_chip": 3 * 8.2e9,
     "bert_base_train_tokens_per_sec_per_chip": 6 * 110e6,
+    # long-context row adds the attention term (12*L*d*layers per token,
+    # fwd+bwd), which 6ND omits and which dominates as L grows
+    "bert_base_L2048_train_tokens_per_sec_per_chip":
+        6 * 110e6 + 12 * 2048 * 768 * 12,
     "lstm_lm_train_tokens_per_sec_per_chip": 6 * 13.3e6,
     "resnet50_infer_imgs_per_sec_per_chip": 8.2e9,
     "alexnet_infer_imgs_per_sec_per_chip": 1.43e9,
@@ -318,7 +322,8 @@ def bench_resnet50_dp_kvstore():
 # ---------------------------------------------------------------------------
 # config 3: BERT-base bf16 + flash attention
 # ---------------------------------------------------------------------------
-def bench_bert():
+def bench_bert(tpu_shape=(32, 128), cpu_shape=(2, 64), iters_tpu=20,
+               max_length=512):
     import mxnet_tpu as mx
     from mxnet_tpu import np as mxnp
     from mxnet_tpu.models.bert import bert_base
@@ -326,10 +331,10 @@ def bench_bert():
 
     mx.random.seed(0)
     on_tpu = _on_tpu()
-    B, L = (32, 128) if on_tpu else (2, 64)
-    iters = 20 if on_tpu else 2
+    B, L = tpu_shape if on_tpu else cpu_shape
+    iters = iters_tpu if on_tpu else 2
 
-    net = bert_base()
+    net = bert_base(max_length=max_length)
     net.initialize(mx.init.Xavier())
     tokens = mxnp.random.randint(0, 30000, size=(B, L))
     net(tokens)
@@ -385,6 +390,17 @@ def bench_bert():
         return iters * B * L / dt
 
     return _best_window(window)
+
+
+def bench_bert_long():
+    """Long-context BERT training step (L=2048): the configuration where
+    the Pallas flash kernel's O(L) memory matters — the unfused path's
+    (B,H,L,L) probabilities would be 12 heads x 2048^2 x 4B = 200MB per
+    layer per batch element.  No V100 baseline exists for this row; it
+    documents long-context throughput on its own terms.  Same harness as
+    bench_bert, reshaped."""
+    return bench_bert(tpu_shape=(4, 2048), cpu_shape=(1, 256),
+                      iters_tpu=10, max_length=2048)
 
 
 # ---------------------------------------------------------------------------
@@ -516,6 +532,8 @@ BENCHES = [
      lambda: bench_resnet50("bfloat16")),
     ("bert", "bert_base_train_tokens_per_sec_per_chip", "tokens/s",
      bench_bert),
+    ("bert_long", "bert_base_L2048_train_tokens_per_sec_per_chip",
+     "tokens/s", bench_bert_long),
     ("lstm", "lstm_lm_train_tokens_per_sec_per_chip", "tokens/s",
      bench_lstm_lm),
     ("resnet50_dp", "resnet50_dp_kvstore_ici_imgs_per_sec_per_chip", "img/s",
