@@ -368,6 +368,21 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     collector = CalibrationCollector(mode=calib_mode)
     collector.attach(OrderedDict((p, c) for p, (_, _, c)
                                  in targets.items()))
+    # calibration must observe CONCRETE activations: a hybridized net
+    # would run the hooks inside a jit trace where .asnumpy() on the
+    # traced batch raises.  Force eager with the framework's own
+    # monitored-call mechanism (_op_hooks_active, the counter
+    # register_op_hook uses): unlike a hybridize(False)/(True) dance it
+    # mutates no block's _active state, so nested blocks keep whatever
+    # hybridization the user set, and warm compiled caches survive.
+    def _walk(b):
+        yield b
+        for c in b._children.values():
+            yield from _walk(c)
+
+    blocks = list(_walk(network))
+    for b in blocks:
+        b._op_hooks_active = getattr(b, "_op_hooks_active", 0) + 1
     try:
         for batch in calib_data:
             if isinstance(batch, (tuple, list)):
@@ -375,6 +390,9 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             network(batch)
     finally:
         collector.detach()  # never leave stats hooks on the user's net
+        for b in blocks:
+            b._op_hooks_active = max(
+                getattr(b, "_op_hooks_active", 1) - 1, 0)
     thresholds = collector.thresholds()
 
     # 2) swap in quantized blocks

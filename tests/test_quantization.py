@@ -148,3 +148,67 @@ def test_kl_threshold_reasonable():
     hist, edges = onp.histogram(onp.abs(a), bins=2048, range=(0, 80.0))
     t = q._optimal_threshold_kl(hist, edges)
     assert t < 20.0  # clipped well below the outlier
+
+
+def test_quantize_net_on_hybridized_network():
+    """Calibration must work on an already-hybridized net (regression:
+    the stats hooks ran inside the jit trace and .asnumpy() on the
+    traced batch raised TracerArrayConversionError); the net comes back
+    hybridized afterwards."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=3),
+            nn.Activation("relu"), nn.Flatten(),
+            nn.Dense(16, in_units=8 * 8 * 8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.np.random.uniform(size=(2, 3, 8, 8))
+    ref = net(x).asnumpy()
+
+    qnet = quantize_net(net, calib_data=[x], calib_mode="naive")
+    out = qnet(x).asnumpy()
+    assert onp.isfinite(out).all()
+    # int8 quantization error bound, high correlation with fp32
+    assert onp.corrcoef(out.ravel(), ref.ravel())[0, 1] > 0.99
+    # the net is hybridized again after the eager calibration pass
+    assert getattr(qnet, "_active", False)
+    # and the eager-forcing counter is fully released
+    assert not getattr(qnet, "_op_hooks_active", 0)
+
+
+def test_quantize_net_preserves_nested_hybrid_state():
+    """Regression: the calibration pass must not clobber per-block
+    hybridization — a plain Block wrapper holding a hybridized child
+    keeps the child hybridized, and a deliberately-eager child stays
+    eager."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    class Wrapper(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.body = nn.HybridSequential()
+            self.body.add(nn.Dense(8, in_units=4))
+            self.head = nn.HybridSequential()
+            self.head.add(nn.Dense(2, in_units=8))
+
+        def forward(self, x):
+            return self.head(self.body(x))
+
+    mx.random.seed(0)
+    net = Wrapper()
+    net.initialize(mx.init.Xavier())
+    net.body.hybridize()        # hybridized child
+    # net.head deliberately left eager
+    x = mx.np.random.uniform(size=(2, 4))
+    net(x)
+    quantize_net(net, calib_data=[x], calib_mode="naive")
+    assert getattr(net.body, "_active", False) is True
+    assert not getattr(net.head, "_active", False)
+    assert not getattr(net.body, "_op_hooks_active", 0)
