@@ -451,6 +451,14 @@ def _stack(ctx, node, ins, out):
     return ctx.add_node("Concat", unsq, [out], name=node.name, axis=axis)
 
 
+@register_converter("np:onnx_expand")
+def _onnx_expand(ctx, node, ins, out):
+    shape = _attr_or_pos(node, "shape", 0)
+    shp = ctx.add_initializer(node.name + "_shape",
+                              onp.asarray(shape, onp.int64))
+    return ctx.add_node("Expand", [ins[0], shp], [out], name=node.name)
+
+
 @register_converter("np:pad")
 def _np_pad(ctx, node, ins, out):
     pw = _attr_or_pos(node, "pad_width", 0)

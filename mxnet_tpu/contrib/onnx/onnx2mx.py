@@ -528,3 +528,162 @@ def _slice_imp(ctx, node, sym_mod):
     from ...sym_api import Symbol
     return Symbol("op", op="np:getitem", inputs=[ctx.sym_of(ins[0])],
                   attrs={"key": spec}, name=node["output"][0])
+
+
+# ---------------------------------------------------------------------------
+# breadth importers (round 4): elementwise/comparison/reduction/shape ops
+# emitted by common exporters — each lowers to the matching np/npx op
+# ---------------------------------------------------------------------------
+_SIMPLE2 = {
+    "Not": "logical_not", "And": "logical_and", "Or": "logical_or",
+    "Xor": "logical_xor", "Equal": "equal", "Greater": "greater",
+    "GreaterOrEqual": "greater_equal", "Less": "less",
+    "LessOrEqual": "less_equal", "Where": "where", "Reciprocal":
+    "reciprocal", "Round": "round", "IsNaN": "isnan", "IsInf": "isinf",
+    "Tan": "tan", "Sinh": "sinh", "Cosh": "cosh", "Asin": "arcsin",
+    "Acos": "arccos", "Atan": "arctan",
+}
+for _onnx_op, _np_name in _SIMPLE2.items():
+    _IMPORTERS[_onnx_op] = _simple_factory(_np_name)
+
+_IMPORTERS["ReduceMax"] = _reduce_factory("max")
+_IMPORTERS["ReduceMin"] = _reduce_factory("min")
+_IMPORTERS["ReduceProd"] = _reduce_factory("prod")
+
+
+@register_importer("Softsign")
+def _softsign(ctx, node, sym_mod):
+    return sym_mod.Activation(ctx.sym_of(node["input"][0]),
+                              act_type="softsign", name=node["output"][0])
+
+
+@register_importer("ArgMax")
+@register_importer("ArgMin")
+def _argminmax(ctx, node, sym_mod):
+    a = node["attribute"]
+    fn = (sym_mod.argmax if node["op_type"] == "ArgMax"
+          else sym_mod.argmin)
+    out = fn(ctx.sym_of(node["input"][0]), axis=int(a.get("axis", 0)))
+    if a.get("keepdims", 1):
+        out = sym_mod.expand_dims(out, axis=int(a.get("axis", 0)))
+    return out
+
+
+@register_importer("Elu")
+def _elu(ctx, node, sym_mod):
+    return sym_mod.LeakyReLU(ctx.sym_of(node["input"][0]), act_type="elu",
+                             slope=float(node["attribute"].get("alpha", 1.0)),
+                             name=node["output"][0])
+
+
+@register_importer("Selu")
+def _selu(ctx, node, sym_mod):
+    return sym_mod.LeakyReLU(ctx.sym_of(node["input"][0]),
+                             act_type="selu", name=node["output"][0])
+
+
+@register_importer("PRelu")
+def _prelu(ctx, node, sym_mod):
+    # npx.leaky_relu takes gamma POSITIONALLY so it becomes a graph input
+    # (the legacy LeakyReLU make is single-input and would drop it)
+    return sym_mod.leaky_relu(ctx.sym_of(node["input"][0]),
+                              ctx.sym_of(node["input"][1]),
+                              "prelu", name=node["output"][0])
+
+
+@register_importer("Tile")
+def _tile(ctx, node, sym_mod):
+    reps = tuple(int(x) for x in ctx.const_of(node["input"][1]))
+    return sym_mod.tile(ctx.sym_of(node["input"][0]), reps,
+                        name=node["output"][0])
+
+
+@register_importer("Expand")
+def _expand(ctx, node, sym_mod):
+    # ONNX Expand broadcasts BIDIRECTIONALLY (out dim = max(in, shape));
+    # np.broadcast_to is one-directional, onnx_expand implements the max
+    shape = tuple(int(x) for x in ctx.const_of(node["input"][1]))
+    return sym_mod.onnx_expand(ctx.sym_of(node["input"][0]), shape,
+                               name=node["output"][0])
+
+
+@register_importer("Range")
+def _range(ctx, node, sym_mod):
+    start = ctx.const_of(node["input"][0]).item()
+    limit = ctx.const_of(node["input"][1]).item()
+    delta = ctx.const_of(node["input"][2]).item()
+    return sym_mod.arange(start, limit, delta)
+
+
+@register_importer("CumSum")
+def _cumsum_imp(ctx, node, sym_mod):
+    a = node["attribute"]
+    if int(a.get("exclusive", 0)) or int(a.get("reverse", 0)):
+        raise NotImplementedError(
+            "CumSum import: exclusive/reverse variants unsupported")
+    axis = int(ctx.const_of(node["input"][1]))
+    return sym_mod.cumsum(ctx.sym_of(node["input"][0]), axis=axis,
+                          name=node["output"][0])
+
+
+@register_importer("InstanceNormalization")
+def _instnorm_imp(ctx, node, sym_mod):
+    ins = [ctx.sym_of(n) for n in node["input"][:3]]
+    return sym_mod.InstanceNorm(
+        ins[0], ins[1], ins[2],
+        eps=float(node["attribute"].get("epsilon", 1e-5)),
+        name=node["output"][0])
+
+
+@register_importer("LpNormalization")
+def _lpnorm_imp(ctx, node, sym_mod):
+    a = node["attribute"]
+    if int(a.get("p", 2)) != 2 or int(a.get("axis", 1)) != 1:
+        raise NotImplementedError("LpNormalization import: p=2/axis=1 only")
+    return sym_mod.L2Normalization(ctx.sym_of(node["input"][0]),
+                                   mode="channel", name=node["output"][0])
+
+
+@register_importer("Pad")
+def _pad_imp(ctx, node, sym_mod):
+    ins = node["input"]
+    a = node["attribute"]
+    pads = [int(x) for x in (ctx.const_of(ins[1]) if len(ins) > 1
+                             else a.get("pads", []))]
+    n = len(pads) // 2
+    pad_width = []
+    for i in range(n):
+        pad_width += [pads[i], pads[n + i]]
+    mode = a.get("mode", "constant")
+    kw = {"mode": mode, "pad_width": tuple(pad_width)}
+    if mode == "constant" and len(ins) > 2 and ins[2]:
+        kw["constant_value"] = float(ctx.const_of(ins[2]))
+    return sym_mod.Pad(ctx.sym_of(ins[0]), name=node["output"][0], **kw)
+
+
+@register_importer("Resize")
+def _resize_imp(ctx, node, sym_mod):
+    ins = node["input"]
+    if node["attribute"].get("mode", "nearest") != "nearest":
+        raise NotImplementedError("Resize import: nearest mode only")
+    scales = [float(x) for x in ctx.const_of(ins[2])]
+    if scales[:2] != [1.0, 1.0] or scales[2] != scales[3]             or scales[2] != round(scales[2]):
+        raise NotImplementedError(
+            "Resize import: uniform INTEGER spatial scale only")
+    return sym_mod.UpSampling(ctx.sym_of(ins[0]), scale=int(scales[2]),
+                              sample_type="nearest",
+                              name=node["output"][0])
+
+
+@register_importer("TopK")
+def _topk_imp(ctx, node, sym_mod):
+    a = node["attribute"]
+    k = int(ctx.const_of(node["input"][1]))
+    vals = sym_mod.topk(ctx.sym_of(node["input"][0]),
+                        axis=int(a.get("axis", -1)), k=k, ret_typ="both",
+                        is_ascend=not int(a.get("largest", 1)),
+                        dtype="int64")  # ONNX indices are int64
+    for i, out_name in enumerate(node["output"]):
+        ctx.tensors[out_name] = vals[i]
+    return None
+

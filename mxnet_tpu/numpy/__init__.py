@@ -694,3 +694,13 @@ def getitem(a, key):
     """Eager replay of a symbolic basic-indexing node (sym[1:3, 0])."""
     a = a if isinstance(a, ndarray) else array(a)
     return a[_decode_index(key)]
+
+
+def onnx_expand(a, shape):
+    """Bidirectional broadcast (ONNX Expand semantics: each output dim is
+    max(input dim, requested dim)); np.broadcast_to is one-directional."""
+    a = a if isinstance(a, ndarray) else array(a)
+    shape = tuple(int(s) for s in shape)
+    return apply_op(
+        lambda x: jnp.broadcast_to(
+            x, onp.broadcast_shapes(x.shape, shape)), a)

@@ -332,3 +332,85 @@ def test_symbol_getitem_slicing_roundtrip():
     sym2, _ap, _xp = import_from_model_dict(model)
     (got,) = sym2.eval(x=mxnp.array(xv))
     onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-6)
+
+
+def test_breadth_ops_roundtrip():
+    """Round-4 importer breadth: comparison/logical/reduction/shape ops
+    export and reimport with matching numerics."""
+    rng = onp.random.RandomState(7)
+    av = rng.randn(3, 4).astype("float32")
+    bv = rng.randn(3, 4).astype("float32")
+
+    a = sym.var("a", shape=(3, 4), dtype="float32")
+    b = sym.var("b", shape=(3, 4), dtype="float32")
+
+    cases = [
+        sym.where(a > b, a, b),
+        sym.logical_and(a > 0.0, b > 0.0),
+        sym.logical_not(a > 0.0),
+        sym.maximum(a, b) + sym.minimum(a, b),
+        sym.max(a, axis=1, keepdims=True) * 1.0,
+        sym.min(a, axis=1) + sym.prod(sym.sigmoid(a), axis=1),
+        sym.round(a) + sym.reciprocal(b * b + 1.0),
+        sym.tan(a * 0.1) + sym.sinh(a * 0.1) + sym.cosh(b * 0.1),
+        sym.arcsin(sym.clip(a, -0.9, 0.9)) + sym.arctan(b),
+        sym.cumsum(a, axis=1),
+        sym.tile(a, (2, 1)),
+        sym.negative(a) + sym.exp(b * 0.1),
+    ]
+    for i, out in enumerate(cases):
+        model = export_to_model_dict(out, {})
+        sym2, ap, _xp = import_from_model_dict(model)
+        env = {"a": mxnp.array(av), "b": mxnp.array(bv)}
+        (ref,) = out.eval(**env)
+        env.update({k: mxnp.array(v) for k, v in ap.items()})
+        (got,) = sym2.eval(**env)
+        onp.testing.assert_allclose(got.asnumpy().astype("float32"),
+                                    ref.asnumpy().astype("float32"),
+                                    rtol=1e-4, atol=1e-5,
+                                    err_msg="case %d" % i)
+
+
+def test_breadth_legacy_ops_roundtrip():
+    x = sym.var("x", shape=(2, 4, 6, 6), dtype="float32")
+    rng = onp.random.RandomState(8)
+    xv = rng.randn(2, 4, 6, 6).astype("float32")
+
+    # InstanceNorm
+    g = sym.var("g", shape=(4,), dtype="float32")
+    bta = sym.var("bt", shape=(4,), dtype="float32")
+    out = sym.InstanceNorm(x, g, bta, eps=1e-5)
+    params = {"g": onp.ones(4, "float32"), "bt": onp.zeros(4, "float32")}
+    model = export_to_model_dict(out, params)
+    sym2, ap, _xp = import_from_model_dict(model)
+    (ref,) = out.eval(x=mxnp.array(xv),
+                      **{k: mxnp.array(v) for k, v in params.items()})
+    (got,) = sym2.eval(x=mxnp.array(xv),
+                       **{k: mxnp.array(v) for k, v in ap.items()})
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                                atol=1e-5)
+
+    # L2Normalization channel mode
+    out = sym.L2Normalization(x, mode="channel")
+    model = export_to_model_dict(out, {})
+    sym2, _ap, _xp = import_from_model_dict(model)
+    (ref,) = out.eval(x=mxnp.array(xv))
+    (got,) = sym2.eval(x=mxnp.array(xv))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-4)
+
+    # Pad (constant)
+    out = sym.Pad(x, mode="constant",
+                  pad_width=(0, 0, 0, 0, 1, 1, 2, 2))
+    model = export_to_model_dict(out, {})
+    sym2, _ap, _xp = import_from_model_dict(model)
+    (ref,) = out.eval(x=mxnp.array(xv))
+    (got,) = sym2.eval(x=mxnp.array(xv))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-5)
+
+    # UpSampling nearest
+    out = sym.UpSampling(x, scale=2, sample_type="nearest")
+    model = export_to_model_dict(out, {})
+    sym2, _ap, _xp = import_from_model_dict(model)
+    (ref,) = out.eval(x=mxnp.array(xv))
+    (got,) = sym2.eval(x=mxnp.array(xv))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-5)
