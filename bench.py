@@ -438,7 +438,12 @@ def bench_lstm_lm():
     tokens = mxnp.random.randint(0, vocab, size=(B, T))
     net(tokens)
     fn, params = functionalize(net, train=True)
-    pvals = {k: p._data._data for k, p in params.items()}
+    # bf16 training (same methodology as bench_bert: the V100 baseline
+    # estimate is fp16-class cuDNN; bf16 is the TPU-idiomatic equivalent
+    # and needs no loss scaler)
+    pvals = {k: (p._data._data.astype(jnp.bfloat16)
+                 if p._data._data.dtype == jnp.float32 else p._data._data)
+             for k, p in params.items()}
     labels = jax.random.randint(jax.random.key(0), (B, T), 0, vocab)
 
     def loss_fn(pv, tok, lab):
@@ -546,32 +551,76 @@ BENCHES = [
 ]
 
 
+def _run_config(key, metric, unit, thunk):
+    """Run ONE config in this process; print its result as one JSON line.
+
+    Invoked in a child process by main() — each config gets a fresh
+    backend/HBM heap, so earlier configs' parameters and compiled
+    executables can never exhaust the chip for later ones (the r4
+    failure mode: 9 configs in one process → RESOURCE_EXHAUSTED on the
+    last four, every full run)."""
+    try:
+        value = thunk()
+        extra = None
+        if isinstance(value, tuple):
+            value, extra = value
+        entry = _entry(metric, value, unit)
+        if extra:
+            entry.update(extra)
+    except Exception as e:
+        entry = {"error": "%s: %s" % (type(e).__name__, e),
+                 "trace": traceback.format_exc()[-1500:]}
+    print("BENCH_RESULT " + json.dumps({metric: entry}), flush=True)
+    return 0 if "error" not in entry else 1
+
+
+def _run_config_subprocess(key, timeout=1200):
+    """Spawn `python bench.py --one <key>` and parse its result line."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["BENCH_CONFIGS"] = key  # belt+braces: child also filters
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--one", key],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in reversed((proc.stdout or "").splitlines()):
+        if line.startswith("BENCH_RESULT "):
+            return json.loads(line[len("BENCH_RESULT "):])
+    return {"error": "subprocess produced no result (rc=%d)"
+                     % proc.returncode,
+            "trace": (proc.stderr or "")[-1500:]}
+
+
 def main():
+    import sys
+
+    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+        sel = sys.argv[2]
+        for key, metric, unit, thunk in BENCHES:
+            if key == sel:
+                raise SystemExit(_run_config(key, metric, unit, thunk))
+        raise SystemExit("unknown config %r (known: %s)"
+                         % (sel, [b[0] for b in BENCHES]))
+
     only = os.environ.get("BENCH_CONFIGS")
     only = set(s.strip() for s in only.split(",")) if only else None
     all_results = {}
     for key, metric, unit, thunk in BENCHES:
         if only is not None and key not in only:
             continue
-        last_err = None
+        result = None
         for attempt in range(2):  # one retry: the axon tunnel can flake
             try:
-                value = thunk()
-                extra = None
-                if isinstance(value, tuple):
-                    value, extra = value
-                entry = _entry(metric, value, unit)
-                if extra:
-                    entry.update(extra)
-                all_results[metric] = entry
-                last_err = None
+                res = _run_config_subprocess(key)
+            except Exception as e:  # timeout / spawn failure
+                res = {"error": "%s: %s" % (type(e).__name__, e)}
+            result = res.get(metric, res)
+            if "error" not in result:
                 break
-            except Exception as e:
-                last_err = {"error": "%s: %s" % (type(e).__name__, e),
-                            "trace": traceback.format_exc()[-1500:]}
-                time.sleep(2)
-        if last_err is not None:
-            all_results[metric] = last_err
+            time.sleep(2)
+        all_results[metric] = result
 
     # headline: best ResNet-50 training number (north-star metric)
     headline = None

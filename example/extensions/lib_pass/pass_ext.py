@@ -1,0 +1,33 @@
+"""Example graph-pass extension (parity: reference
+example/extensions/lib_pass — a custom pass loaded from an external
+library via REGISTER_PASS, include/mxnet/lib_api.h:806,:936).
+
+Load with mx.library.load(".../pass_ext.py") — registers:
+
+  * "drop-dropout":   removes npx:dropout nodes (inference cleanup)
+  * "tanh-to-relu":   swaps np:tanh activations for npx:relu
+"""
+
+
+def register_passes(mx):
+    gp = mx.graph_pass
+
+    @gp.register("drop-dropout")
+    def drop_dropout(sym):
+        def fn(node, new_inputs):
+            if node._kind == "op" and node._op in ("npx:dropout",
+                                                   "legacy:Dropout"):
+                return new_inputs[0]
+            return None
+        return gp.rewrite(sym, fn)
+
+    @gp.register("tanh-to-relu")
+    def tanh_to_relu(sym):
+        from mxnet_tpu.sym_api import Symbol
+
+        def fn(node, new_inputs):
+            if node._kind == "op" and node._op == "np:tanh":
+                return Symbol("op", name=node.name, op="npx:relu",
+                              inputs=new_inputs, attrs=dict(node._attrs))
+            return None
+        return gp.rewrite(sym, fn)

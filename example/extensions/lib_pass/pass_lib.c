@@ -1,0 +1,55 @@
+/* Example NATIVE graph-pass extension (parity: reference
+ * example/extensions/lib_pass/pass_lib.cc — a CustomPass compiled into
+ * an external .so and loaded at runtime, lib_api.h:806).
+ *
+ * ABI (see mxnet_tpu/library.py): a pass receives the graph's JSON
+ * serialization and returns a malloc'd transformed JSON string.
+ *
+ * "relu-to-tanh-native" rewrites op ids "npx:relu" -> "np:tanh" by
+ * substring substitution over the serialized op fields — the same toy
+ * transform the reference example performs with its JsonParser.
+ *
+ * Build: gcc -shared -fPIC -o libpass_ext.so pass_lib.c
+ */
+#include <stdlib.h>
+#include <string.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+static const char* PASS_NAMES[] = {"relu-to-tanh-native"};
+
+int mxtpu_ext_num_passes(void) { return 1; }
+
+const char* mxtpu_ext_pass_name(int i) { return PASS_NAMES[i]; }
+
+char* mxtpu_ext_pass_apply(int i, const char* graph_json) {
+  (void)i;
+  const char* from = "\"npx:relu\"";
+  const char* to = "\"np:tanh\"";
+  size_t flen = strlen(from), tlen = strlen(to);
+  size_t n = strlen(graph_json);
+  /* worst case: every byte starts a match (tlen <= flen here anyway) */
+  char* out = (char*)malloc(n * (tlen > flen ? tlen : flen) / flen + tlen + 1);
+  if (!out) return NULL;
+  const char* src = graph_json;
+  char* dst = out;
+  while (*src) {
+    if (strncmp(src, from, flen) == 0) {
+      memcpy(dst, to, tlen);
+      dst += tlen;
+      src += flen;
+    } else {
+      *dst++ = *src++;
+    }
+  }
+  *dst = '\0';
+  return out;
+}
+
+void mxtpu_ext_free(char* p) { free(p); }
+
+#ifdef __cplusplus
+}
+#endif

@@ -1,0 +1,23 @@
+"""Example partitioner extension (parity: reference
+example/extensions/lib_subgraph — a CustomPartitioner loaded from an
+external library via REGISTER_PARTITIONER, include/mxnet/lib_api.h:837,
+:940).
+
+Load with mx.library.load(".../subgraph_ext.py") — registers subgraph
+property "DENSE_FUSE": groups FullyConnected/Dense + elementwise
+activations into subgraph nodes (the conv/FC+eltwise fusion pattern the
+reference's ONEDNN subgraph backend targets).
+"""
+
+
+def register_partitioners(mx):
+    sg = mx.subgraph
+
+    FUSABLE = {"legacy:FullyConnected", "npx:fully_connected",
+               "npx:relu", "np:tanh", "npx:activation",
+               "legacy:Activation", "npx:sigmoid"}
+
+    @sg.register_property("DENSE_FUSE")
+    class DenseFuseProperty(sg.SubgraphProperty):
+        def create_selector(self):
+            return sg.OpNameSelector(FUSABLE)

@@ -13,6 +13,15 @@ from __future__ import annotations
 
 __version__ = "2.0.0"  # capability-parity version (reference libinfo.py:150)
 
+import os as _os
+
+if _os.environ.get("MXNET_INT64_TENSOR_SIZE", "0").lower() in (
+        "1", "true", "yes", "on"):
+    # reference USE_INT64_TENSOR_SIZE build flag as a runtime switch:
+    # must flip before any array exists (x64 changes canonical dtypes)
+    import jax as _jax
+    _jax.config.update("jax_enable_x64", True)
+
 from . import context
 from .context import Context, Device, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 

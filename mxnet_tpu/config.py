@@ -124,6 +124,11 @@ register("MXNET_FLASH_ATTENTION", str, "", "honored",
 register("MXNET_SAFE_ACCUMULATION", bool, True, "honored",
          "accumulate norms/sums in fp32 even for fp16 inputs (always on;"
          " registered for compatibility)", "ops")
+register("MXNET_INT64_TENSOR_SIZE", bool, False, "honored",
+         "enable true int64 tensors/indices (reference USE_INT64_TENSOR_SIZE"
+         " build flag; here it flips jax_enable_x64 at import). Off: int64"
+         " inputs whose VALUES fit int32 narrow safely; out-of-range values"
+         " raise instead of silently truncating", "ndarray._to_jax")
 
 # ---------------------------------------------------------------------------
 # substrate knobs (the reference tuned these by hand; XLA/PJRT owns them)

@@ -666,6 +666,10 @@ def _resize_imp(ctx, node, sym_mod):
     ins = node["input"]
     if node["attribute"].get("mode", "nearest") != "nearest":
         raise NotImplementedError("Resize import: nearest mode only")
+    # exporters using the 'sizes' input pass scales as the empty string
+    if len(ins) <= 2 or not ins[2]:
+        raise NotImplementedError("Resize import: sizes input unsupported "
+                                  "(only a populated scales tensor)")
     scales = [float(x) for x in ctx.const_of(ins[2])]
     if scales[:2] != [1.0, 1.0] or scales[2] != scales[3]             or scales[2] != round(scales[2]):
         raise NotImplementedError(

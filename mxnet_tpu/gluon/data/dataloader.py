@@ -63,11 +63,17 @@ def _np_batchify_fn(data):
 _MP_STATE = {}
 
 
-def _mp_init(dataset, batchify_fn):
+def _mp_init(dataset_bytes, batchify_fn):
     # runs FIRST in the spawned child: pin jax (if any transform imports
-    # it) to CPU before anything can open the real device
+    # it) to CPU before anything can open the real device.  The dataset
+    # arrives as PICKLED BYTES and is deserialized HERE, after the env
+    # pin — if it were a live Pool initarg, spawn would unpickle it
+    # before this initializer runs (and again in any worker the pool
+    # RESPAWNS after a crash), letting a dataset whose unpickle touches
+    # jax grab the real chip
+    import pickle
     os.environ["JAX_PLATFORMS"] = "cpu"
-    _MP_STATE["dataset"] = dataset
+    _MP_STATE["dataset"] = pickle.loads(dataset_bytes)
     _MP_STATE["batchify"] = batchify_fn
 
 
@@ -268,9 +274,15 @@ class DataLoader:
         if self._mp_pool is None:
             bf = (self._batchify_fn if self._batchify_fn
                   is not default_batchify_fn else _np_batchify_fn)
+            import pickle
             ctx = get_context("spawn")
-            self._mp_pool = ctx.Pool(self._num_workers, _mp_init,
-                                     (self._dataset, bf))
+            # dataset ships as pickled bytes so its deserialization runs
+            # inside _mp_init AFTER the child pins JAX_PLATFORMS=cpu —
+            # this also covers workers the pool respawns after a crash
+            # (no parent-env window to race)
+            self._mp_pool = ctx.Pool(
+                self._num_workers, _mp_init,
+                (pickle.dumps(self._dataset), bf))
 
         def consume(msg):
             shm = shared_memory.SharedMemory(name=msg["shm"])

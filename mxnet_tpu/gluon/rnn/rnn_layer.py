@@ -92,11 +92,19 @@ class _RNNLayer(HybridBlock):
     def begin_state(self, batch_size=0, func=None, **kwargs):
         states = []
         n = self._num_layers * self._dir
+        # follow the PARAMETERS' live dtype, not the constructor dtype:
+        # after net.cast('bfloat16') a float32 h0 would silently promote
+        # every recurrent matmul in the scan back to fp32
+        dtype = self._dtype
+        first = getattr(self, "i2h_weight_l0", None)
+        if first is not None and first.dtype is not None:
+            # Parameter.cast updates .dtype even before materialization
+            dtype = first.dtype
         shapes = [(n, batch_size, self._hidden_size)]
         if self._mode == "lstm":
             shapes.append((n, batch_size, self._hidden_size))
         for s in shapes:
-            states.append(np_mod.zeros(s, dtype=self._dtype))
+            states.append(np_mod.zeros(s, dtype=dtype))
         return states
 
     def forward(self, x, states=None):

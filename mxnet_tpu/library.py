@@ -57,41 +57,109 @@ def load(path, verbose=True):
 
 
 def _load_python(path):
+    """Python extensions may define any of (reference lib_api.h
+    REGISTER_OP :932 / REGISTER_PASS :936 / REGISTER_PARTITIONER :940):
+
+        register_ops(mx)           — custom operators
+        register_passes(mx)        — graph passes (mx.graph_pass registry)
+        register_partitioners(mx)  — subgraph properties (mx.subgraph)
+    """
     import importlib.util
     spec = importlib.util.spec_from_file_location(
         "mxtpu_ext_%s" % os.path.basename(path)[:-3], path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    if not hasattr(mod, "register_ops"):
-        raise ValueError("python extension must define register_ops(mx)")
+    hooks = [h for h in ("register_ops", "register_passes",
+                         "register_partitioners") if hasattr(mod, h)]
+    if not hooks:
+        raise ValueError(
+            "python extension must define register_ops(mx), "
+            "register_passes(mx), or register_partitioners(mx)")
     import mxnet_tpu as mx
-    before = set(_operator.get_all_registered_operators())
-    mod.register_ops(mx)
-    after = set(_operator.get_all_registered_operators())
-    return sorted(after - before)
+    from . import graph_pass, subgraph
+    before_ops = set(_operator.get_all_registered_operators())
+    before_passes = set(graph_pass.list_passes())
+    before_props = set(subgraph.list_properties())
+    for h in hooks:
+        getattr(mod, h)(mx)
+    names = sorted(set(_operator.get_all_registered_operators())
+                   - before_ops)
+    names += ["pass:%s" % p for p in
+              sorted(set(graph_pass.list_passes()) - before_passes)]
+    names += ["partitioner:%s" % p for p in
+              sorted(set(subgraph.list_properties()) - before_props)]
+    return names
 
 
 def _load_native(path):
-    lib = ctypes.CDLL(path)
-    lib.mxtpu_ext_num_ops.restype = ctypes.c_int
-    lib.mxtpu_ext_op_name.restype = ctypes.c_char_p
-    lib.mxtpu_ext_op_name.argtypes = [ctypes.c_int]
-    lib.mxtpu_ext_op_compute.argtypes = [
-        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
-        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
-    has_grad = hasattr(lib, "mxtpu_ext_op_grad")
-    if has_grad:
-        lib.mxtpu_ext_op_grad.argtypes = [
-            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    """Native extensions export any of the op ABI (docstring above), the
+    pass ABI (reference CustomPass, lib_api.h:806 — a pass transforms
+    the serialized graph JSON):
 
+        int          mxtpu_ext_num_passes(void);
+        const char*  mxtpu_ext_pass_name(int i);
+        char*        mxtpu_ext_pass_apply(int i, const char* graph_json);
+        void         mxtpu_ext_free(char* p);     // optional
+
+    Registered passes appear in mx.graph_pass and run sym → sym via the
+    graph's JSON serialization (sym_api.tojson/fromjson)."""
+    lib = ctypes.CDLL(path)
     names = []
-    for i in range(lib.mxtpu_ext_num_ops()):
-        name = lib.mxtpu_ext_op_name(i).decode()
-        names.append(name)
-        _register_native_op(lib, i, name, has_grad)
+    if hasattr(lib, "mxtpu_ext_num_ops"):
+        lib.mxtpu_ext_num_ops.restype = ctypes.c_int
+        lib.mxtpu_ext_op_name.restype = ctypes.c_char_p
+        lib.mxtpu_ext_op_name.argtypes = [ctypes.c_int]
+        lib.mxtpu_ext_op_compute.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        has_grad = hasattr(lib, "mxtpu_ext_op_grad")
+        if has_grad:
+            lib.mxtpu_ext_op_grad.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        for i in range(lib.mxtpu_ext_num_ops()):
+            name = lib.mxtpu_ext_op_name(i).decode()
+            names.append(name)
+            _register_native_op(lib, i, name, has_grad)
+    if hasattr(lib, "mxtpu_ext_num_passes"):
+        lib.mxtpu_ext_num_passes.restype = ctypes.c_int
+        lib.mxtpu_ext_pass_name.restype = ctypes.c_char_p
+        lib.mxtpu_ext_pass_name.argtypes = [ctypes.c_int]
+        lib.mxtpu_ext_pass_apply.restype = ctypes.c_void_p  # own the free
+        lib.mxtpu_ext_pass_apply.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        if hasattr(lib, "mxtpu_ext_free"):
+            lib.mxtpu_ext_free.argtypes = [ctypes.c_void_p]
+        for i in range(lib.mxtpu_ext_num_passes()):
+            pname = lib.mxtpu_ext_pass_name(i).decode()
+            names.append("pass:%s" % pname)
+            _register_native_pass(lib, i, pname)
+    if not names:
+        raise ValueError(
+            "native extension %s exports neither the op ABI "
+            "(mxtpu_ext_num_ops) nor the pass ABI (mxtpu_ext_num_passes)"
+            % path)
     return names
+
+
+def _register_native_pass(lib, pass_index, name):
+    from . import graph_pass
+    from . import sym_api
+
+    def run(sym):
+        raw = lib.mxtpu_ext_pass_apply(pass_index,
+                                       sym.tojson().encode("utf-8"))
+        if not raw:
+            raise RuntimeError("extension pass %s returned NULL" % name)
+        try:
+            out = ctypes.cast(raw, ctypes.c_char_p).value.decode("utf-8")
+        finally:
+            if hasattr(lib, "mxtpu_ext_free"):
+                lib.mxtpu_ext_free(ctypes.c_void_p(raw))
+        return sym_api.fromjson(out)
+
+    run.__name__ = name
+    graph_pass.register(name)(run)
 
 
 def _register_native_op(lib, op_index, name, has_grad):

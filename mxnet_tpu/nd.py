@@ -15,10 +15,13 @@ from . import _nd_contrib as contrib  # noqa: F401
 from .operator import Custom  # noqa: F401  (mx.nd.Custom)
 
 
-def save(fname, data):
-    """Save a list or dict of arrays to one file (parity: mx.nd.save,
-    reference NDArray binary container src/ndarray/ndarray.cc:1720;
-    here an npz container with a list/dict marker)."""
+def save(fname, data, format="npz"):
+    """Save a list or dict of arrays to one file (parity: mx.nd.save).
+
+    format='npz' (default — what reference 2.0 writes,
+    src/c_api/c_api.cc:1913 MXNDArraySave → npz); format='legacy' writes
+    the MXNet binary NDArray container (src/ndarray/ndarray.cc:1962)
+    loadable by actual MXNet 1.x/2.0."""
     import numpy as _onp
     if isinstance(data, NDArray):
         data = [data]
@@ -28,17 +31,38 @@ def save(fname, data):
         arrays = {k: v.asnumpy() for k, v in data.items()}
     else:
         raise TypeError("save expects NDArray, list, or dict")
+    if format == "legacy":
+        from .legacy_serialization import save_legacy
+        keys = list(arrays)
+        names = [] if isinstance(data, (list, tuple)) else keys
+        with open(fname, "wb") as f:
+            f.write(save_legacy([arrays[k] for k in keys], names))
+        return
     _onp.savez(fname, **arrays)
 
 
 def load(fname):
-    """Load arrays saved by mx.nd.save → list or dict (parity: mx.nd.load)."""
+    """Load arrays saved by mx.nd.save → list or dict (parity:
+    mx.nd.load).  Sniffs the container: npz/npy (reference 2.0 format)
+    or the MXNet binary NDArray container (1.x artifacts,
+    src/ndarray/ndarray.cc:1720 NDARRAY_V1/V2/V3)."""
+    import os as _os
     import numpy as _onp
-    try:
-        data = _onp.load(fname, allow_pickle=False)
-    except FileNotFoundError:
-        data = _onp.load(fname + ".npz", allow_pickle=False)
     import builtins
+    if not _os.path.exists(fname) and _os.path.exists(fname + ".npz"):
+        fname = fname + ".npz"
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    from .legacy_serialization import is_legacy_file
+    if is_legacy_file(head):
+        from .legacy_serialization import load_legacy
+        with open(fname, "rb") as f:
+            arrays, names = load_legacy(f.read())
+        wrapped = [None if a is None else array(a) for a in arrays]
+        if names:
+            return {n: a for n, a in zip(names, wrapped)}
+        return wrapped
+    data = _onp.load(fname, allow_pickle=False)
     keys = list(data.files)
     if keys and builtins.all(k.startswith("__mx_list_") for k in keys):
         keys.sort(key=lambda k: int(k.rsplit("_", 1)[1]))

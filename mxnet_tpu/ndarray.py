@@ -22,6 +22,7 @@ from __future__ import annotations
 import math
 import os
 import threading
+import time
 
 import numpy as onp
 
@@ -39,6 +40,7 @@ __all__ = ["ndarray", "NDArray", "apply_op", "from_numpy", "waitall"]
 # engine shims: NaiveEngine mode + waitall tracking
 # --------------------------------------------------------------------------
 from .config import get as _cfg_get  # typed MXNET_* registry
+from .profiler import _AGG as _profiler_agg  # per-op aggregate stats flag
 
 _NAIVE = _cfg_get("MXNET_ENGINE_TYPE") == "NaiveEngine"
 _PENDING = []  # ALL in-flight buffers, for waitall() completeness
@@ -296,6 +298,20 @@ def apply_op(fn, *args, **kwargs):
     the bulker cannot key or shape-infer, and any call made while tracing
     (hybridize/jit), fall back to immediate eager dispatch.
     """
+    if _profiler_agg["enabled"]:
+        # per-op aggregate stats (reference AggregateStats,
+        # src/profiler/aggregate_stats.cc): time the host dispatch
+        t0 = time.perf_counter()
+        try:
+            return _apply_op_dispatch(fn, args, kwargs)
+        finally:
+            from . import profiler
+            profiler.record_op_stat(getattr(fn, "__name__", "op"),
+                                    time.perf_counter() - t0)
+    return _apply_op_dispatch(fn, args, kwargs)
+
+
+def _apply_op_dispatch(fn, args, kwargs):
     nd_idx = [i for i, a in enumerate(args) if isinstance(a, ndarray)]
     nd_args = [args[i] for i in nd_idx]
 
@@ -436,12 +452,44 @@ def _apply_op_eager(fn, args, kwargs, nd_idx, nd_args, recording):
     return wrapped[0]
 
 
+def _guard_int64_narrowing(obj, dtype):
+    """With x64 disabled, jnp.asarray silently narrows int64->int32 —
+    an embedding/take index over 2^31 rows would CORRUPT, not fail
+    (reference builds guard this with USE_INT64_TENSOR_SIZE,
+    /root/reference/tests/nightly/test_large_array.py).  Policy: loud or
+    correct, never silent — in-range values narrow safely; out-of-range
+    values raise with a pointer to MXNET_INT64_TENSOR_SIZE=1."""
+    if jax.config.jax_enable_x64:
+        return  # true int64 mode: no narrowing happens
+    try:
+        src = onp.asarray(obj)
+    except Exception:
+        return
+    if src.dtype not in (onp.int64, onp.uint64) or src.size == 0:
+        return
+    if dtype is not None and onp.dtype(dtype).itemsize <= 4:
+        return  # explicit narrow request: user asked for it
+    lo, hi = int(src.min()), int(src.max())
+    # narrowing targets: int64->int32 (signed bound), uint64->uint32
+    bound_lo, bound_hi = ((0, 2**32) if src.dtype == onp.uint64
+                          else (-2**31, 2**31))
+    if lo < bound_lo or hi >= bound_hi:
+        raise OverflowError(
+            "%s value %d does not fit %s and would be silently "
+            "truncated; set MXNET_INT64_TENSOR_SIZE=1 to enable true "
+            "int64 tensors"
+            % (src.dtype.name, hi if hi >= bound_hi else lo,
+               "uint32" if src.dtype == onp.uint64 else "int32"))
+
+
 def _to_jax(obj, dtype=None, ctx=None):
     if isinstance(obj, ndarray):
         data = obj._data
         if dtype is not None:
             data = data.astype(dtype)
     else:
+        if not isinstance(obj, (int, float, bool, jax.Array)):
+            _guard_int64_narrowing(obj, dtype)
         data = jnp.asarray(obj, dtype=dtype)
     if ctx is not None and isinstance(data, jax.Array):
         dev = ctx.jax_device if isinstance(ctx, Context) else ctx
@@ -586,6 +634,17 @@ class ndarray:
     def __array__(self, dtype=None):
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
+
+    # NumPy interop protocol (reference numpy_dispatch_protocol.py:37 +
+    # numpy/fallback.py:25): numpy.mean(mx_array) etc. dispatch to mx ops
+    # instead of coercing through __array__; see numpy_dispatch.py
+    def __array_function__(self, func, types, args, kwargs):
+        from .numpy_dispatch import array_function
+        return array_function(self, func, types, args, kwargs)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        from .numpy_dispatch import array_ufunc
+        return array_ufunc(self, ufunc, method, *inputs, **kwargs)
 
     def __dlpack__(self, **kw):
         return self._data.__dlpack__(**kw)

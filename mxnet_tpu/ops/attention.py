@@ -177,6 +177,11 @@ def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None,
     backward kernels); `kv_length` (B,) is a padding mask as a per-row
     valid key count.  Both keep the call on the Pallas fast path."""
     global last_path, _fallback_warned
+    if not 0.0 <= dropout < 1.0:
+        # matches the eager Dropout op's validation; rate >= 1 would put
+        # a 1/(1-rate) = inf scale through the kernel (NaN outputs)
+        raise ValueError("flash_attention: dropout must be in [0, 1), got %r"
+                         % (dropout,))
     if dropout and dropout_key is None:
         raise ValueError("flash_attention: dropout > 0 requires dropout_key")
     mode = _pallas_mode()

@@ -71,10 +71,14 @@ def unpack_params(params, mode, input_size, state_size, num_layers=1,
 
 
 def _cell_step(mode, state_size):
+    """Step fns take the PRE-TRANSPOSED recurrent weight (H, G): the
+    transpose is hoisted out of the scan so the per-step program is one
+    (B,H)x(H,G) matmul + fused elementwise (the cuDNN-RNN fusion,
+    reference rnn-inl.h, re-based on the MXU)."""
     if mode == "lstm":
-        def step(carry, gates_x, w_h2h, b_h2h):
+        def step(carry, gates_x, w_h2h_t, b_h2h):
             h, c = carry
-            g = gates_x + jnp.matmul(h, w_h2h.T) + b_h2h
+            g = gates_x + jnp.matmul(h, w_h2h_t) + b_h2h
             i, f, u, o = jnp.split(g, 4, axis=-1)
             i = jax.nn.sigmoid(i)
             f = jax.nn.sigmoid(f)
@@ -84,9 +88,9 @@ def _cell_step(mode, state_size):
             h2 = o * jnp.tanh(c2)
             return (h2, c2), h2
     elif mode == "gru":
-        def step(carry, gates_x, w_h2h, b_h2h):
+        def step(carry, gates_x, w_h2h_t, b_h2h):
             (h,) = carry
-            gh = jnp.matmul(h, w_h2h.T) + b_h2h
+            gh = jnp.matmul(h, w_h2h_t) + b_h2h
             xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
             hr, hz, hn = jnp.split(gh, 3, axis=-1)
             r = jax.nn.sigmoid(xr + hr)
@@ -97,11 +101,16 @@ def _cell_step(mode, state_size):
     else:
         act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
 
-        def step(carry, gates_x, w_h2h, b_h2h):
+        def step(carry, gates_x, w_h2h_t, b_h2h):
             (h,) = carry
-            h2 = act(gates_x + jnp.matmul(h, w_h2h.T) + b_h2h)
+            h2 = act(gates_x + jnp.matmul(h, w_h2h_t) + b_h2h)
             return (h2,), h2
     return step
+
+
+# scan unroll factor: amortizes per-step loop overhead and lets XLA
+# software-pipeline consecutive cells' matmul + elementwise phases
+_SCAN_UNROLL = 5
 
 
 def _single_layer(x, h0, c0, p, mode, reverse=False):
@@ -109,12 +118,14 @@ def _single_layer(x, h0, c0, p, mode, reverse=False):
     gates_x = jnp.einsum("tbi,gi->tbg", x, p["w_i2h"]) + p["b_i2h"]
     step = _cell_step(mode, p["w_h2h"].shape[1])
     carry = (h0, c0) if mode == "lstm" else (h0,)
+    w_h2h_t = p["w_h2h"].T  # hoisted: one transpose per call, not per step
 
     def scan_fn(carry, gx):
-        new_carry, out = step(carry, gx, p["w_h2h"], p["b_h2h"])
+        new_carry, out = step(carry, gx, w_h2h_t, p["b_h2h"])
         return new_carry, out
 
-    carry, outs = lax.scan(scan_fn, carry, gates_x, reverse=reverse)
+    carry, outs = lax.scan(scan_fn, carry, gates_x, reverse=reverse,
+                           unroll=_SCAN_UNROLL)
     hT = carry[0]
     cT = carry[1] if mode == "lstm" else None
     return outs, hT, cT

@@ -20,6 +20,90 @@ _STATE = {
     "device_dir": None,
 }
 
+# Per-op aggregate statistics (reference src/profiler/aggregate_stats.cc +
+# MXAggregateProfileStatsPrint, src/c_api/c_api_profile.cc:284).  Enabled
+# by set_config(aggregate_stats=True); ndarray.apply_op feeds it.
+_AGG = {
+    "enabled": False,
+    "ops": {},      # name -> [count, total_s, min_s, max_s]
+    "memory": {},   # counter name -> [samples, last, peak]
+    "lock": threading.Lock(),
+}
+
+
+def record_op_stat(name, dur_s):
+    """Accumulate one op dispatch into the aggregate table (hot path:
+    callers check _AGG['enabled'] first)."""
+    with _AGG["lock"]:
+        st = _AGG["ops"].get(name)
+        if st is None:
+            _AGG["ops"][name] = [1, dur_s, dur_s, dur_s]
+        else:
+            st[0] += 1
+            st[1] += dur_s
+            if dur_s < st[2]:
+                st[2] = dur_s
+            if dur_s > st[3]:
+                st[3] = dur_s
+
+
+def record_memory_stat(name, value):
+    with _AGG["lock"]:
+        st = _AGG["memory"].get(name)
+        if st is None:
+            _AGG["memory"][name] = [1, value, value]
+        else:
+            st[0] += 1
+            st[1] = value
+            if value > st[2]:
+                st[2] = value
+
+
+def aggregate_stats():
+    """Snapshot: {'ops': {name: {count,total_ms,min_ms,max_ms,avg_ms}},
+    'memory': {name: {samples,last_bytes,peak_bytes}}}."""
+    with _AGG["lock"]:
+        ops = {n: {"count": c, "total_ms": t * 1e3, "min_ms": lo * 1e3,
+                   "max_ms": hi * 1e3, "avg_ms": t / c * 1e3}
+               for n, (c, t, lo, hi) in _AGG["ops"].items()}
+        mem = {n: {"samples": s, "last_bytes": last, "peak_bytes": peak}
+               for n, (s, last, peak) in _AGG["memory"].items()}
+    return {"ops": ops, "memory": mem}
+
+
+def reset_stats():
+    with _AGG["lock"]:
+        _AGG["ops"].clear()
+        _AGG["memory"].clear()
+
+
+def get_summary(sort_by="total", ascending=False):
+    """Printable per-op-name summary table (the
+    MXAggregateProfileStatsPrint analog)."""
+    key = {"total": "total_ms", "count": "count", "avg": "avg_ms",
+           "min": "min_ms", "max": "max_ms"}.get(sort_by, "total_ms")
+    snap = aggregate_stats()
+    lines = ["Profile Statistics:",
+             "  Operator summary (host dispatch)",
+             "  %-28s %10s %12s %12s %12s %12s" % (
+                 "Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+                 "Avg(ms)")]
+    rows = sorted(snap["ops"].items(), key=lambda kv: kv[1][key],
+                  reverse=not ascending)
+    for name, st in rows:
+        lines.append("  %-28s %10d %12.4f %12.4f %12.4f %12.4f" % (
+            name[:28], st["count"], st["total_ms"], st["min_ms"],
+            st["max_ms"], st["avg_ms"]))
+    if snap["memory"]:
+        lines.append("  Memory counters")
+        lines.append("  %-28s %10s %14s %14s" % (
+            "Name", "Samples", "Last(bytes)", "Peak(bytes)"))
+        for name, st in sorted(snap["memory"].items()):
+            lines.append("  %-28s %10d %14d %14d" % (
+                name[:28], st["samples"], st["last_bytes"],
+                st["peak_bytes"]))
+    return "\n".join(lines)
+
 
 def set_config(**kwargs):
     """profiler.set_config(filename=..., profile_all=..., ...)"""
@@ -36,7 +120,7 @@ def set_state(state="stop", profile_process="worker"):
 def start(profile_process="worker"):
     _STATE["running"] = True
     _STATE["start_ts"] = time.time()
-    aggregate = _STATE["config"].get("aggregate_stats", False)
+    _AGG["enabled"] = bool(_STATE["config"].get("aggregate_stats", False))
     dev_dir = _STATE["config"].get("xplane_dir")
     if dev_dir:
         import jax
@@ -46,6 +130,7 @@ def start(profile_process="worker"):
 
 def stop(profile_process="worker"):
     _STATE["running"] = False
+    _AGG["enabled"] = False  # stats stay readable until reset_stats()
     if _STATE["device_dir"]:
         import jax
         jax.profiler.stop_trace()
@@ -70,7 +155,15 @@ def dump(finished=True, profile_process="worker"):
     return fname
 
 
-def dumps(reset=False):
+def dumps(reset=False, format="json"):
+    """format='json' → chrome-trace events; format='table' → the per-op
+    aggregate summary (reference profiler.dumps(format='table') →
+    MXAggregateProfileStatsPrint)."""
+    if format == "table":
+        s = get_summary()
+        if reset:
+            reset_stats()
+        return s
     with _STATE["lock"]:
         s = json.dumps({"traceEvents": _STATE["events"]})
         if reset:
@@ -257,4 +350,6 @@ def sample_device_memory(device=None, name="device_memory"):
         _emit(name, "counter", "C", time.time(),
               {"bytes_in_use": st["bytes_in_use"],
                "peak_bytes_in_use": st["peak_bytes_in_use"]})
+    if _AGG["enabled"]:
+        record_memory_stat(name, st["bytes_in_use"])
     return st

@@ -291,5 +291,41 @@ def partition_symbol(sym, op_names):
     return build_subgraph(sym, OpNameProperty(op_names))
 
 
+# ---------------------------------------------------------------------------
+# named SubgraphProperty registry — the extension-partitioner seam
+# (reference REGISTER_PARTITIONER, include/mxnet/lib_api.h:837,:940;
+# external libraries register properties via mx.library.load)
+# ---------------------------------------------------------------------------
+_PROPERTIES = {}
+
+
+def register_property(name):
+    """Register a SubgraphProperty factory under a backend name."""
+    def decorator(factory):
+        _PROPERTIES[str(name).upper()] = factory
+        return factory
+    return decorator
+
+
+def get_property(name, **kwargs):
+    key = str(name).upper()
+    if key not in _PROPERTIES:
+        raise ValueError("unknown subgraph property %r (have %s)"
+                         % (name, sorted(_PROPERTIES)))
+    return _PROPERTIES[key](**kwargs)
+
+
+def list_properties():
+    return sorted(_PROPERTIES)
+
+
+def partition_for(sym, prop_name, **kwargs):
+    """Partition a symbol with a registered property (reference
+    Symbol.optimize_for(backend) routed through BuildSubgraph)."""
+    return build_subgraph(sym, get_property(prop_name, **kwargs))
+
+
 __all__ += ["SubgraphSelector", "OpNameSelector", "SubgraphProperty",
-            "OpNameProperty", "build_subgraph", "partition_symbol"]
+            "OpNameProperty", "build_subgraph", "partition_symbol",
+            "register_property", "get_property", "list_properties",
+            "partition_for"]

@@ -250,6 +250,10 @@ class Symbol:
             # otherwise return np.shape as a phantom bound method
             # metadata names must keep raising: hasattr(sym, 'asnumpy')
             # style feature probes would otherwise see phantom methods
+            if name == "shape":
+                err = self.__dict__.get("_shape_error")
+                if err:
+                    raise AttributeError(err)
             raise AttributeError(name)
         if callable(getattr(np_mod, name, None)) or callable(
                 getattr(npx_mod, name, None)):

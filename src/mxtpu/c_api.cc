@@ -186,8 +186,13 @@ MXTPU_API int MXTNDArrayGetDType(void* handle, char* buf, int buflen) {
   PyObject* r = capi_call("array_dtype", Py_BuildValue("(O)", handle));
   if (r == nullptr) return -1;
   const char* s = PyUnicode_AsUTF8(r);
-  if (s == nullptr) PyErr_Clear();
-  std::snprintf(buf, buflen, "%s", s ? s : "");
+  if (s == nullptr) {
+    PyErr_Clear();
+    Py_DECREF(r);
+    set_err("undecodable dtype string");
+    return -1;
+  }
+  std::snprintf(buf, buflen, "%s", s);
   Py_DECREF(r);
   return 0;
 }
@@ -236,6 +241,10 @@ MXTPU_API int MXTListOps(char** csv_out) {
   }
   Py_DECREF(r);
   *csv_out = strdup(csv.c_str());
+  if (*csv_out == nullptr) {
+    set_err("out of memory");
+    return -1;
+  }
   return 0;
 }
 
@@ -399,5 +408,9 @@ MXTPU_API int MXTGenericInvoke(const char* path, const char* json_in,
   if (s == nullptr) PyErr_Clear();
   *json_out = strdup(s ? s : "");
   Py_DECREF(r);
+  if (*json_out == nullptr) {
+    set_err("out of memory");
+    return -1;
+  }
   return 0;
 }

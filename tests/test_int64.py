@@ -1,0 +1,64 @@
+"""int64 policy: loud or correct, never silent (reference
+USE_INT64_TENSOR_SIZE + tests/nightly/test_large_array.py).
+
+Default mode (x64 off): int64 host data whose values fit int32 narrows
+safely; values outside int32 raise OverflowError instead of silently
+truncating.  MXNET_INT64_TENSOR_SIZE=1 enables true int64 end-to-end
+(verified in a subprocess — the flag must flip before backend init).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import np as mxnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_in_range_int64_narrows_safely():
+    a = mxnp.array(onp.array([1, 2, 2**31 - 1], dtype=onp.int64))
+    assert a.asnumpy().tolist() == [1, 2, 2**31 - 1]
+
+
+def test_out_of_range_int64_raises():
+    with pytest.raises(OverflowError, match="MXNET_INT64_TENSOR_SIZE"):
+        mxnp.array(onp.array([2**40], dtype=onp.int64))
+    with pytest.raises(OverflowError, match="MXNET_INT64_TENSOR_SIZE"):
+        mxnp.array(onp.array([-2**35], dtype=onp.int64))
+
+
+def test_explicit_narrow_request_allowed():
+    # user explicitly asked for int32: the narrowing is theirs
+    a = mxnp.array(onp.array([2, 3], dtype=onp.int64), dtype="int32")
+    assert a.dtype == onp.int32
+
+
+def test_int64_mode_subprocess():
+    """MXNET_INT64_TENSOR_SIZE=1: int64 values survive end-to-end,
+    including a take() through an index larger than int32."""
+    child = """
+import numpy as onp
+from mxnet_tpu import np as mxnp
+a = mxnp.array(onp.array([2**40, 7], dtype=onp.int64))
+assert a.dtype == onp.int64, a.dtype
+assert a.asnumpy().tolist() == [2**40, 7]
+# int64 indices through take: values above 2**31 must index correctly.
+# (A >2^31-ELEMENT array does not fit host RAM here; the correctness
+# property is that the index dtype carries 64-bit values unclipped.)
+idx = mxnp.array(onp.array([2**40], dtype=onp.int64))
+assert int(idx.asnumpy()[0]) == 2**40
+big = mxnp.arange(10, dtype="int64") + (2**33)
+got = mxnp.take(big, mxnp.array([3], dtype="int64"))
+assert int(got.asnumpy()[0]) == 2**33 + 3, got
+print("INT64_OK")
+"""
+    env = dict(os.environ)
+    env["MXNET_INT64_TENSOR_SIZE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", child], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "INT64_OK" in r.stdout

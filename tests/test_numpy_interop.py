@@ -1,0 +1,118 @@
+"""NumPy interop protocol tests (reference
+tests/python/unittest/test_numpy_interoperability.py:3336-3352).
+
+numpy.<fn>(mx_array) must dispatch to the mx implementation via
+__array_function__ / __array_ufunc__, returning mx ndarrays; allow-listed
+functions mx does not implement fall back to real NumPy on host copies
+and wrap the result back.
+"""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.ndarray import ndarray
+
+
+def _mx(a):
+    return mxnp.array(onp.asarray(a, dtype=onp.float32))
+
+
+def test_array_function_dispatch_basic():
+    a = _mx([[1.0, 2.0], [3.0, 4.0]])
+    m = onp.mean(a)
+    assert isinstance(m, ndarray), type(m)
+    assert abs(float(m.asnumpy()) - 2.5) < 1e-6
+
+    c = onp.concatenate([a, a], axis=0)
+    assert isinstance(c, ndarray)
+    assert c.shape == (4, 2)
+
+    w = onp.where(onp.asarray([[True, False], [False, True]]), a, _mx(0))
+    # cond passed as numpy is fine; result must be an mx ndarray
+    assert isinstance(w, ndarray)
+    assert w.asnumpy().tolist() == [[1.0, 0.0], [0.0, 4.0]]
+
+
+def test_array_function_more_ops():
+    a = _mx([3.0, 1.0, 2.0])
+    s = onp.sort(a)
+    assert isinstance(s, ndarray)
+    assert s.asnumpy().tolist() == [1.0, 2.0, 3.0]
+    st = onp.stack([a, a])
+    assert isinstance(st, ndarray) and st.shape == (2, 3)
+    assert float(onp.sum(a).asnumpy()) == 6.0
+    assert onp.argmax(a).asnumpy() == 0
+
+
+def test_array_function_linalg():
+    a = _mx([[2.0, 0.0], [0.0, 3.0]])
+    n = onp.linalg.norm(a)
+    assert isinstance(n, ndarray)
+    assert abs(float(n.asnumpy()) - onp.sqrt(13.0)) < 1e-5
+
+
+def test_array_ufunc_call():
+    a = _mx([1.0, 2.0])
+    b = _mx([10.0, 20.0])
+    s = onp.add(a, b)
+    assert isinstance(s, ndarray)
+    assert s.asnumpy().tolist() == [11.0, 22.0]
+    e = onp.exp(a)
+    assert isinstance(e, ndarray)
+    assert onp.allclose(e.asnumpy(), onp.exp(onp.array([1.0, 2.0])))
+    # mixed numpy/mx operands dispatch to mx (mx operand wins)
+    m = onp.multiply(onp.array([2.0, 2.0], dtype=onp.float32), a)
+    assert isinstance(m, ndarray)
+    assert m.asnumpy().tolist() == [2.0, 4.0]
+
+
+def test_array_ufunc_reduce_fallback():
+    a = _mx([[1.0, 2.0], [3.0, 4.0]])
+    r = onp.add.reduce(a, axis=0)
+    assert isinstance(r, ndarray)
+    assert r.asnumpy().tolist() == [4.0, 6.0]
+
+
+def test_array_ufunc_out_numpy_target():
+    a = _mx([1.0, 2.0])
+    out = onp.zeros(2, dtype=onp.float32)
+    res = onp.add(a, a, out=out)
+    assert res is out
+    assert out.tolist() == [2.0, 4.0]
+
+
+def test_fallback_allowlist():
+    a = _mx([[1.0, 2.0], [3.0, 4.0]])
+    assert bool(onp.allclose(a, a))
+    p = onp.ptp(a)
+    p = float(p.asnumpy()) if isinstance(p, ndarray) else float(p)
+    assert p == 3.0
+    idx = onp.searchsorted(_mx([1.0, 2.0, 3.0]), _mx(2.5))
+    val = int(idx.asnumpy()) if isinstance(idx, ndarray) else int(idx)
+    assert val == 2
+
+
+def test_unknown_function_raises_cleanly():
+    class NotAFunc:
+        pass
+    a = _mx([1.0])
+    # numpy raises TypeError when every implementer returns NotImplemented
+    with pytest.raises(TypeError):
+        onp.busday_count(a, a)
+
+
+def test_generic_host_fallback_unlisted_function():
+    # functions absent from mx.np and the allow-list keep the
+    # pre-protocol behavior: run on host, return host results
+    a = _mx([1.0, 0.0, -1.0, 0.0])
+    out = onp.fft.fft(a)
+    assert isinstance(out, onp.ndarray)
+    assert out.dtype in (onp.complex64, onp.complex128)
+    assert abs(out[0] - 0.0) < 1e-9
+
+
+def test_ufunc_at_writes_back():
+    a = _mx([0.0, 0.0, 0.0])
+    r = onp.add.at(a, onp.array([0, 1, 0]), 1.0)
+    assert r is None
+    assert a.asnumpy().tolist() == [2.0, 1.0, 0.0]

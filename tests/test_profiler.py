@@ -1,0 +1,76 @@
+"""Profiler tests: chrome-trace events + per-op aggregate stats
+(reference src/profiler/aggregate_stats.cc, MXAggregateProfileStatsPrint
+src/c_api/c_api_profile.cc:284; python/mxnet/profiler.py dumps(format)).
+"""
+import json
+
+import numpy as onp
+
+from mxnet_tpu import np as mxnp, profiler
+
+
+def _setup():
+    profiler.reset_stats()
+    profiler.set_config(aggregate_stats=True)
+    profiler.start()
+
+
+def test_aggregate_counts_known_sequence():
+    _setup()
+    a = mxnp.array(onp.ones((4, 4), dtype=onp.float32))
+    b = mxnp.array(onp.full((4, 4), 2.0, dtype=onp.float32))
+    for _ in range(5):
+        c = mxnp.add(a, b)
+    for _ in range(3):
+        d = mxnp.multiply(a, b)
+    c.asnumpy(), d.asnumpy()
+    profiler.stop()
+
+    stats = profiler.aggregate_stats()["ops"]
+    add_rows = {n: s for n, s in stats.items() if "add" in n}
+    mul_rows = {n: s for n, s in stats.items() if "mul" in n}
+    assert sum(s["count"] for s in add_rows.values()) >= 5, stats
+    assert sum(s["count"] for s in mul_rows.values()) >= 3, stats
+    one = next(iter(add_rows.values()))
+    assert one["total_ms"] > 0
+    assert one["min_ms"] <= one["avg_ms"] <= one["max_ms"]
+
+
+def test_aggregate_table_printable():
+    _setup()
+    a = mxnp.array(onp.ones((2, 2), dtype=onp.float32))
+    (a + a).asnumpy()
+    profiler.sample_device_memory()
+    profiler.stop()
+
+    table = profiler.dumps(format="table")
+    assert "Operator summary" in table
+    assert "Calls" in table and "Avg(ms)" in table
+    assert "Memory counters" in table
+    assert "device_memory" in table
+    # reset clears
+    profiler.dumps(format="table", reset=True)
+    assert profiler.aggregate_stats()["ops"] == {}
+
+
+def test_stats_off_by_default():
+    profiler.reset_stats()
+    profiler.set_config(aggregate_stats=False)
+    profiler.start()
+    a = mxnp.array(onp.ones((2, 2), dtype=onp.float32))
+    (a + a).asnumpy()
+    profiler.stop()
+    assert profiler.aggregate_stats()["ops"] == {}
+
+
+def test_chrome_trace_still_works(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "p.json"))
+    profiler.start()
+    with profiler.Task("unit_task"):
+        pass
+    profiler.stop()
+    fname = profiler.dump()
+    with open(fname) as f:
+        data = json.load(f)
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "unit_task" in names
