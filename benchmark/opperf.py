@@ -37,6 +37,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as onp
 
+import jax
+
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
 from mxnet_tpu import np as mxnp
@@ -523,7 +525,12 @@ def bench_op(fn, args_thunk, needs_grad, warmup=3, iters=10, windows=3):
     return fwd_ms, bwd_ms
 
 
-def run(names=None, iters=10, probe_only=False, verbose=True):
+def run(names=None, iters=10, probe_only=False, verbose=True,
+        platform=None):
+    if platform:
+        # must precede first backend use (the axon sitecustomize ignores
+        # JAX_PLATFORMS, so the config API is the only reliable switch)
+        jax.config.update("jax_platforms", platform)
     mx.random.seed(0)
     ops = enumerate_ops()
     if names:
@@ -558,11 +565,14 @@ def main():
     ap.add_argument("--json", default=None)
     ap.add_argument("--probe-only", action="store_true",
                     help="report op coverage without timing")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) before first use")
     args = ap.parse_args()
 
     names = args.ops.split(",") if args.ops else None
     rows, skipped = run(names, iters=args.iters,
-                        probe_only=args.probe_only)
+                        probe_only=args.probe_only,
+                        platform=args.platform)
     print("covered %d ops, skipped %d" % (len(rows), len(skipped)))
     if skipped:
         print("skipped:", ", ".join(sorted(skipped)))

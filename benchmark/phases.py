@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""Per-phase breakdown of the ResNet-50 bf16 and LSTM training steps.
+
+Decomposes the bench's flagship training step into measurable phases —
+forward, forward+backward, optimizer-only, full step — each timed as its
+own jitted program with fused windows (one dispatch + one scalar fetch
+per window; the tunnel charges ~6 ms/dispatch, ~110 ms/fetch).  Emits
+benchmark/PHASES.json including compiled FLOP counts (XLA cost
+analysis), achieved FLOP/s, and MFU per phase.
+
+Usage: python benchmark/phases.py [--json benchmark/PHASES.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+
+def _scalarize(out):
+    """Reduce any output pytree to one scalar so the sync is a real
+    value FETCH — through the axon tunnel block_until_ready is not a
+    true sync; only fetching data is."""
+    leaves = jax.tree.leaves(out)
+    small = min(leaves, key=lambda l: getattr(l, "size", 1))
+    return jnp.sum(small.astype(jnp.float32)) if hasattr(small, "astype") \
+        else small
+
+
+def _wtime(fn, *args, iters=1, windows=3):
+    """Best-of-windows wall time per call; syncs by FETCHING a scalar
+    derived from the result (see _scalarize)."""
+    float(jax.device_get(_scalarize(fn(*args))))
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        float(jax.device_get(_scalarize(out)))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def _cost(jfn, *args):
+    try:
+        an = jfn.lower(*args).compile().cost_analysis()
+        if isinstance(an, list):
+            an = an[0]
+        return {"flops": an.get("flops"),
+                "bytes": an.get("bytes accessed")}
+    except Exception:
+        return {"flops": None, "bytes": None}
+
+
+def _peak():
+    try:
+        from mxnet_tpu.profiler import chip_spec
+        return chip_spec().get("peak_flops_bf16")
+    except Exception:
+        return None
+
+
+def resnet_phases(batch=256, dtype="bfloat16", layout="NCHW"):
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.parallel import DataParallelTrainer, Mesh
+
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000, layout=layout)
+    net.initialize(mx.init.Xavier())
+    shape = ((batch, 3, 224, 224) if layout == "NCHW"
+             else (batch, 224, 224, 3))
+    x = mxnp.random.uniform(size=shape)
+    y = mxnp.random.randint(0, 1000, size=(batch,))
+    net(x[:1])
+    if dtype != "float32":
+        net.cast(dtype)
+        x = x.astype(dtype)
+    loss_obj = SoftmaxCrossEntropyLoss()
+
+    def loss_fn(out, label):
+        return loss_obj(out.astype("float32"), label)
+
+    mesh = Mesh(onp.array(jax.devices()[:1]), ("dp",))
+    trainer = DataParallelTrainer(net, loss_fn, "sgd",
+                                  {"learning_rate": 0.05, "momentum": 0.9},
+                                  mesh=mesh)
+    state = trainer.init_state()
+    step = trainer.build_step(donate=False)  # keep state reusable
+    key = jax.random.key(0)
+    xv, yv = x._data, y._data
+
+    # --- full step
+    full_t = _wtime(lambda: step(state, xv, yv, key, 0.05), iters=8)
+    full_cost = _cost(step, state, xv, yv, key, 0.05)
+
+    # --- fwd+bwd only (no optimizer): value_and_grad of the same loss
+    from mxnet_tpu.parallel import functionalize
+    fn, params = functionalize(net, train=True)
+    pvals = {k: p._data._data for k, p in params.items()}
+    import mxnet_tpu.autograd as ag
+    from mxnet_tpu.ndarray import _wrap_value
+
+    grad_names = [k for k, p in params.items() if p.grad_req != "null"]
+
+    def loss_of(diff, kkey):
+        fullp = dict(pvals)
+        fullp.update(diff)
+        out, aux = fn(fullp, xv, key=kkey)
+        with ag._RecordingStateScope(False, True):
+            l = loss_fn(_wrap_value(out), _wrap_value(yv))
+        return jnp.mean(l._data)
+
+    diff = {k: pvals[k] for k in grad_names}
+    vg = jax.jit(lambda d, kk: jax.value_and_grad(loss_of)(d, kk))
+    fwd_bwd_t = _wtime(lambda: vg(diff, key), iters=8)
+    fwd_bwd_cost = _cost(vg, diff, key)
+
+    # --- fwd only
+    fw = jax.jit(lambda d, kk: loss_of(d, kk))
+    fwd_t = _wtime(lambda: fw(diff, key), iters=8)
+    fwd_cost = _cost(fw, diff, key)
+
+    # --- optimizer only: sgd-momentum over all trainable tensors
+    grads = {k: jnp.ones_like(v) * 1e-4 for k, v in diff.items()}
+    slots = {k: jnp.zeros(v.shape, jnp.float32) for k, v in diff.items()}
+
+    def opt(params_d, grads_d, slots_d):
+        new_p, new_s = {}, {}
+        for k in params_d:
+            g = grads_d[k].astype(jnp.float32)
+            m = 0.9 * slots_d[k] - 0.05 * g
+            new_s[k] = m
+            new_p[k] = (params_d[k].astype(jnp.float32)
+                        + m).astype(params_d[k].dtype)
+        return new_p, new_s
+
+    jopt = jax.jit(opt)
+    opt_t = _wtime(lambda: jopt(diff, grads, slots), iters=8)
+    opt_cost = _cost(jopt, diff, grads, slots)
+
+    peak = _peak()
+
+    def mfu(model_flops, t):
+        return round(model_flops / t / peak, 4) if (peak and t) else None
+
+    model_flops = 3 * 8.2e9 * batch  # fwd+bwd+update convention
+
+    # roofline adjudication: is the step compute- or bandwidth-bound?
+    SPEC_BW = 819e9  # v5e HBM bandwidth (bytes/s)
+    roofline = None
+    if fwd_bwd_cost.get("bytes") and peak:
+        by = fwd_bwd_cost["bytes"]
+        fl = fwd_bwd_cost["flops"]
+        intensity = fl / by
+        balance = peak / SPEC_BW
+        roofline = {
+            "achieved_bw_GBps": round(by / fwd_bwd_t / 1e9, 1),
+            "spec_bw_GBps": round(SPEC_BW / 1e9, 1),
+            "pct_of_spec_bw": round(by / fwd_bwd_t / SPEC_BW, 3),
+            "arith_intensity_F_per_B": round(intensity, 1),
+            "chip_balance_F_per_B": round(balance, 1),
+            "bound": ("bandwidth" if intensity < balance else "compute"),
+        }
+
+    return {
+        "config": {"model": "resnet50_v1", "batch": batch, "dtype": dtype,
+                   "layout": layout},
+        "roofline": roofline,
+        "phases": {
+            "full_step": {"ms": round(full_t * 1e3, 2), **full_cost,
+                          "mfu_model": mfu(model_flops, full_t)},
+            "fwd_bwd": {"ms": round(fwd_bwd_t * 1e3, 2), **fwd_bwd_cost,
+                        "mfu_model": mfu(model_flops, fwd_bwd_t)},
+            "fwd": {"ms": round(fwd_t * 1e3, 2), **fwd_cost,
+                    "mfu_model": mfu(8.2e9 * batch, fwd_t)},
+            "optimizer": {"ms": round(opt_t * 1e3, 2), **opt_cost},
+            "derived_bwd_ms": round((fwd_bwd_t - fwd_t) * 1e3, 2),
+            "derived_opt_overhead_ms": round((full_t - fwd_bwd_t) * 1e3, 2),
+        },
+        "peak_flops_bf16": peak,
+        "imgs_per_sec_full": round(batch / full_t, 1),
+    }
+
+
+def lstm_phases(B=32, T=35):
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu.gluon import nn, rnn, HybridBlock
+    from mxnet_tpu.parallel import functionalize
+
+    vocab, emsize, nhid, nlayers = 10000, 650, 650, 2
+
+    class WordLM(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(vocab, emsize)
+            self.lstm = rnn.LSTM(nhid, num_layers=nlayers, layout="NTC",
+                                 input_size=emsize)
+            self.decoder = nn.Dense(vocab, flatten=False, in_units=nhid)
+
+        def forward(self, x):
+            return self.decoder(self.lstm(self.embed(x)))
+
+    mx.random.seed(0)
+    net = WordLM()
+    net.initialize(mx.init.Xavier())
+    tokens = mxnp.random.randint(0, vocab, size=(B, T))
+    net(tokens)
+    fn, params = functionalize(net, train=True)
+    pvals = {k: (p._data._data.astype(jnp.bfloat16)
+                 if p._data._data.dtype == jnp.float32 else p._data._data)
+             for k, p in params.items()}
+    labels = jax.random.randint(jax.random.key(0), (B, T), 0, vocab)
+    tok = tokens._data
+
+    def loss_of(pv):
+        out, _aux = fn(pv, tok)
+        lp = jax.nn.log_softmax(out.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(lp, labels[..., None], -1))
+
+    # per-step programs are ~ms-scale: chain K steps INSIDE one program
+    # (lax.fori_loop) so the tunnel's ~6ms dispatch charge amortizes and
+    # the number is pure device time
+    K = 16
+
+    def chained(pv):
+        def body(_, carry):
+            l, g = jax.value_and_grad(loss_of)(carry)
+            return jax.tree.map(
+                lambda p, gg: p - 0.01 * gg.astype(p.dtype), carry, g)
+        out = jax.lax.fori_loop(0, K, body, pv)
+        return loss_of(out)
+
+    cj = jax.jit(chained)
+    fb_t = _wtime(lambda: cj(pvals), iters=1) / K
+    fb_cost = _cost(jax.jit(lambda pv: jax.value_and_grad(loss_of)(pv)),
+                    pvals)
+
+    def chained_fwd(pv):
+        def body(_, acc):
+            return acc + loss_of(pv)
+        return jax.lax.fori_loop(0, K, body, jnp.zeros((), jnp.float32))
+
+    fwd_t = _wtime(lambda: jax.jit(chained_fwd)(pvals), iters=1) / K
+
+    # decoder matmul alone (the FLOPs-dominant piece), K-chained
+    dw = pvals["decoder.weight"]
+    emb = jax.random.normal(jax.random.key(1), (B * T, nhid),
+                            jnp.bfloat16)
+
+    def chained_dec(e, w):
+        def body(_, acc):
+            return acc + jnp.sum((e @ w.T).astype(jnp.float32))
+        return jax.lax.fori_loop(0, K, body, jnp.zeros((), jnp.float32))
+
+    dec_t = _wtime(lambda: jax.jit(chained_dec)(emb, dw), iters=1) / K
+
+    peak = _peak()
+    model_flops = 6 * 13.3e6 * B * T
+    return {
+        "config": {"model": "lstm_lm_2x650", "B": B, "T": T,
+                   "dtype": "bfloat16"},
+        "phases": {
+            "fwd": {"ms": round(fwd_t * 1e3, 3)},
+            "fwd_bwd": {"ms": round(fb_t * 1e3, 3), **fb_cost,
+                        "mfu_model": (round(model_flops / fb_t / peak, 4)
+                                      if peak else None)},
+            "decoder_matmul": {"ms": round(dec_t * 1e3, 3)},
+        },
+        "tokens_per_sec_fwd_bwd": round(B * T / fb_t, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "PHASES.json"))
+    ap.add_argument("--only", default=None,
+                    choices=[None, "resnet", "resnet_nhwc", "lstm"])
+    args = ap.parse_args()
+    out = {}
+    if args.only in (None, "resnet"):
+        out["resnet50_bf16"] = resnet_phases()
+        print(json.dumps(out["resnet50_bf16"], indent=1), flush=True)
+    if args.only in (None, "resnet_nhwc"):
+        out["resnet50_bf16_nhwc"] = resnet_phases(layout="NHWC")
+        print(json.dumps(out["resnet50_bf16_nhwc"], indent=1), flush=True)
+    if args.only in (None, "lstm"):
+        out["lstm_lm"] = lstm_phases()
+        print(json.dumps(out["lstm_lm"], indent=1), flush=True)
+    with open(args.json, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", args.json)
+
+
+if __name__ == "__main__":
+    main()

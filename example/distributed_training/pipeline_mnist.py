@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Pipeline-parallel MNIST training (beyond-reference capability:
+SURVEY.md §2.4 notes the reference has data parallelism only; this
+example trains a real model through GPipe-style pipeline parallelism
+over a `pp` mesh axis).
+
+Model (praxis pattern): replicated prologue (Flatten + input Dense),
+S identical pipelined Dense stages — one per device on the `pp` axis —
+and a replicated epilogue (classifier head).  Forward microbatches
+stream between stages over ppermute; backward is the AD transpose;
+fwd+bwd+update compile into one XLA executable.
+
+Run on a virtual 8-device CPU mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python example/distributed_training/pipeline_mnist.py --steps 30
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--stages", type=int, default=4)
+    ap.add_argument("--microbatches", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (virtual mesh)")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import Mesh
+    from mxnet_tpu.parallel.pipeline import PipelineTrainer
+
+    S = args.stages
+    devices = jax.devices()
+    assert len(devices) >= S, (
+        "need %d devices for %d stages (set XLA_FLAGS="
+        "--xla_force_host_platform_device_count=8)" % (S, S))
+    mesh = Mesh(onp.array(devices[:S]), ("pp",))
+
+    mx.random.seed(0)
+    H = args.hidden
+
+    prologue = nn.HybridSequential()
+    prologue.add(nn.Flatten(), nn.Dense(H, activation="relu",
+                                        in_units=28 * 28))
+    stages = []
+    for _ in range(S):
+        st = nn.HybridSequential()
+        st.add(nn.Dense(H, activation="relu", in_units=H))
+        stages.append(st)
+    epilogue = nn.Dense(10, in_units=H)
+
+    x0 = mxnp.random.uniform(size=(args.batch, 1, 28, 28))
+    for blk in [prologue] + stages + [epilogue]:
+        blk.initialize(mx.init.Xavier())
+    h = prologue(x0)
+    for st in stages:
+        h = st(h)
+    epilogue(h)  # finalize deferred shapes end-to-end
+
+    loss_obj = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = PipelineTrainer(
+        prologue, stages, epilogue,
+        lambda out, label: loss_obj(out, label),
+        "sgd", {"learning_rate": 0.05, "momentum": 0.9}, mesh,
+        n_microbatches=args.microbatches)
+    state = trainer.init_state()
+    trainer.build_step(donate=False)
+
+    ds = gluon.data.vision.MNIST(train=True)
+    tf = gluon.data.vision.transforms.ToTensor()
+    loader = gluon.data.DataLoader(ds.transform_first(tf),
+                                   batch_size=args.batch, shuffle=True)
+
+    losses = []
+    t0 = time.perf_counter()
+    n = 0
+    for i, (x, y) in enumerate(loader):
+        if i >= args.steps:
+            break
+        state, loss = trainer.step(state, x, y)
+        losses.append(float(jax.device_get(loss)))
+        n += args.batch
+    dt = time.perf_counter() - t0
+    print("pipeline(%d stages, %d microbatches): loss %.3f -> %.3f, "
+          "%.0f img/s" % (S, args.microbatches, losses[0], losses[-1],
+                          n / dt))
+    assert losses[-1] < losses[0], "loss did not decrease"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,458 @@
+"""Graph-level INT8 post-training quantization.
+
+Parity: the reference's ``QuantizeGraph`` pass + calibration-table flow
+(`/root/reference/src/operator/quantization/quantize_graph_pass.cc:286`,
+`SetCalibTableToQuantizedGraph` :602) — whole-graph rewriting where int8
+regions CHAIN across conv/fc/activation/pooling/elemwise-add/concat/
+reshape without fp32 round-trips between them, not just per-layer
+Dense/Conv swaps.  The reference quantizes exactly this op family
+(`src/operator/quantization/quantized_{conv,fully_connected,pooling,
+activation,elemwise_add,concat,flatten}.cc`).
+
+TPU-native design: the Gluon net is traced to the sym DAG
+(``HybridBlock.to_sym``), BatchNorms following convolutions are FOLDED
+into the conv weights (inference-time transform, what the reference's
+ONEDNN subgraph fusion does before quantization), every node output is
+calibrated, and execution runs through a domain-tracking interpreter:
+tensors between int8-eligible ops stay ``(int8 data, scale)`` — the
+int32 matmul accumulate → rescale → int8 requantize all happens
+in-register inside one fused XLA program.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from ..gluon.block import HybridBlock
+from ..ndarray import apply_op, _wrap_value, ndarray
+
+_INT8_MAX = 127.0
+
+
+def _sym_mod():
+    from .. import sym_api
+    return sym_api
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm folding (conv → bn becomes conv' with scaled weights + bias)
+# ---------------------------------------------------------------------------
+def fold_batchnorm(sym, params):
+    """Return (folded_sym, folded_params).  A ``npx:batch_norm`` whose
+    data input is a ``npx:convolution`` consumed only by that bn is
+    replaced by a convolution with per-channel-scaled weights and a
+    fused bias (standard inference-time BN folding)."""
+    sym_api = _sym_mod()
+    Symbol = sym_api.Symbol
+    params = dict(params)
+
+    uses = {}
+    for n in sym._topo():
+        for i in n._inputs:
+            uses[id(i)] = uses.get(id(i), 0) + 1
+
+    def pval(node):
+        if node._kind == "var" and node.name in params:
+            v = params[node.name]
+            return v.asnumpy() if isinstance(v, ndarray) else onp.asarray(v)
+        return None
+
+    counter = [0]
+
+    def fn(node, new_inputs):
+        if node._kind != "op" or node._op != "npx:batch_norm":
+            return None
+        conv_new = new_inputs[0]
+        if conv_new._kind != "op" or conv_new._op != "npx:convolution":
+            return None
+        if uses.get(id(node._inputs[0]), 0) != 1:
+            return None
+        gamma = pval(node._inputs[1])
+        beta = pval(node._inputs[2])
+        mean = pval(node._inputs[3])
+        var = pval(node._inputs[4])
+        w_node = conv_new._inputs[1]
+        w = pval(w_node)
+        if any(v is None for v in (gamma, beta, mean, var, w)):
+            return None
+        if node._attrs.get("fix_gamma"):
+            gamma = onp.ones_like(gamma)
+        eps = float(node._attrs.get("eps", 1e-5))
+        scale = gamma / onp.sqrt(var + eps)
+        w2 = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
+        conv_attrs = {k: v for k, v in conv_new._attrs.items()
+                      if not k.startswith("_")}  # drop trace-call residue
+        had_bias = not conv_attrs.get("no_bias", False) \
+            and len(conv_new._inputs) > 2
+        b = pval(conv_new._inputs[2]) if had_bias else 0.0
+        b2 = (b - mean) * scale + beta
+
+        counter[0] += 1
+        wname = "%s_bnfold%d_weight" % (conv_new.name or "conv", counter[0])
+        bname = "%s_bnfold%d_bias" % (conv_new.name or "conv", counter[0])
+        from .. import np as mxnp
+        params[wname] = mxnp.array(w2.astype(w.dtype))
+        params[bname] = mxnp.array(onp.asarray(b2, dtype=w.dtype))
+        wvar = Symbol("var", name=wname)
+        bvar = Symbol("var", name=bname)
+        conv_attrs["no_bias"] = False
+        return Symbol("op", name=(node.name or "") + "_bnfold",
+                      op="npx:convolution",
+                      inputs=[conv_new._inputs[0], wvar, bvar],
+                      attrs=conv_attrs)
+
+    from .. import graph_pass
+    return graph_pass.rewrite(sym, fn), params
+
+
+# ---------------------------------------------------------------------------
+# calibration: per-node output ranges over the (folded) graph
+# ---------------------------------------------------------------------------
+def calibrate_graph(sym, params, calib_data, calib_mode="naive"):
+    """Evaluate every op node on the calibration batches; return
+    {id(node): (min, max)} (entropy mode narrows via KL thresholds,
+    reference calibrate.cc)."""
+    sym_api = _sym_mod()
+    nodes = [n for n in sym._topo() if n._kind == "op"]
+    group = sym_api.Group(nodes)
+    stats = {id(n): [onp.inf, -onp.inf] for n in nodes}
+    hists = {id(n): None for n in nodes} if calib_mode == "entropy" else None
+    data_stat = [onp.inf, -onp.inf]
+
+    from .. import np as mxnp
+    env = {k: (v if isinstance(v, ndarray) else mxnp.array(v))
+           for k, v in params.items()}
+    for batch in calib_data:
+        if isinstance(batch, (tuple, list)):
+            batch = batch[0]
+        if not isinstance(batch, ndarray):
+            batch = mxnp.array(batch)
+        b = batch.asnumpy()
+        data_stat[0] = min(data_stat[0], float(b.min()))
+        data_stat[1] = max(data_stat[1], float(b.max()))
+        outs = group.eval(data=batch, **env)
+        for n, o in zip(nodes, outs):
+            a = o.asnumpy()
+            st = stats[id(n)]
+            st[0] = min(st[0], float(a.min()))
+            st[1] = max(st[1], float(a.max()))
+            if hists is not None:
+                h, _ = onp.histogram(onp.abs(a), bins=2048,
+                                     range=(0, max(abs(st[0]),
+                                                   abs(st[1]), 1e-8)))
+                hists[id(n)] = h if hists[id(n)] is None \
+                    else hists[id(n)] + h
+
+    if calib_mode == "entropy":
+        from .quantization import _optimal_threshold_kl
+        for n in nodes:
+            st = stats[id(n)]
+            amax = max(abs(st[0]), abs(st[1]), 1e-8)
+            h = hists[id(n)]
+            if h is not None and h.sum() > 0:
+                edges = onp.linspace(0, amax, 2049)
+                t = _optimal_threshold_kl(h, edges)
+                st[0], st[1] = -t, t
+    return {k: tuple(v) for k, v in stats.items()}, tuple(data_stat)
+
+
+def _scale_of(rng_pair):
+    amax = max(abs(rng_pair[0]), abs(rng_pair[1]), 1e-8)
+    return amax / _INT8_MAX
+
+
+# ---------------------------------------------------------------------------
+# the int8 interpreter block
+# ---------------------------------------------------------------------------
+_Q_OPS = {"npx:convolution", "npx:fully_connected", "npx:activation",
+          "npx:relu", "npx:pooling", "np:add", "np:concatenate",
+          "np:reshape", "legacy:Flatten", "npx:reshape"}
+
+
+class QuantizedGraphBlock(HybridBlock):
+    """Inference block executing a calibrated sym DAG with chained int8
+    domains.  ``quantized_ops``/``domains`` report what actually runs
+    int8 (tests and the bench assert on them)."""
+
+    def __init__(self, sym, params, thresholds, data_range,
+                 exclude_names=()):
+        super().__init__()
+        self._sym = sym
+        self._thresholds = thresholds
+        self._data_scale = _scale_of(data_range)
+        self._exclude = set(exclude_names)
+        self._params_np = {}
+        for k, v in params.items():
+            a = v.asnumpy() if isinstance(v, ndarray) else onp.asarray(v)
+            self._params_np[k] = a
+        # pre-quantize conv/fc weights (per-out-channel symmetric)
+        self._qweights = {}
+        from .quantization import _quantize_weight
+        from .. import np as mxnp
+        for n in sym._topo():
+            if n._kind != "op" or n._op not in ("npx:convolution",
+                                                "npx:fully_connected"):
+                continue
+            if (n.name or "") in self._exclude:
+                continue
+            w_node = n._inputs[1]
+            if w_node._kind != "var" or w_node.name not in self._params_np:
+                continue
+            w = self._params_np[w_node.name]
+            q, s = _quantize_weight(mxnp.array(w))
+            self._qweights[id(n)] = (jnp.asarray(q), jnp.asarray(s))
+        self.domains = {}       # node name -> 'q8' | 'f32' (last run)
+        self.quantized_ops = 0  # count of ops that ran in int8
+
+    # -- domain helpers ----------------------------------------------------
+    @staticmethod
+    def _to_f(entry):
+        if entry[0] == "q":
+            return entry[1].astype(jnp.float32) * entry[2]
+        return entry[1]
+
+    @staticmethod
+    def _to_q(entry, scale):
+        if entry[0] == "q":
+            v = entry[1].astype(jnp.float32) * (entry[2] / scale)
+        else:
+            v = entry[1] / scale
+        return jnp.clip(jnp.round(v), -127, 127).astype(jnp.int8)
+
+    def _forward_impl(self, xv):
+        sym_api = _sym_mod()
+        memo = {}
+        domains = {}
+        qcount = [0]
+        pvals = {k: jnp.asarray(v) for k, v in self._params_np.items()}
+
+        def out_scale(node):
+            th = self._thresholds.get(id(node))
+            return _scale_of(th) if th is not None else None
+
+        def walk(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            r = self._exec(node, walk, xv, pvals, out_scale, domains,
+                           qcount)
+            memo[id(node)] = r
+            return r
+
+        out = walk(self._sym)
+        self.domains = domains
+        self.quantized_ops = qcount[0]
+        return self._to_f(out)
+
+    def _exec(self, node, walk, xv, pvals, out_scale, domains, qcount):
+        if node._kind == "var":
+            if node.name == "data":
+                return ("f", xv)
+            return ("f", pvals[node.name])
+        if node._kind == "const":
+            return ("f", node._attrs["value"])
+        if node._kind == "index":
+            r = walk(node._inputs[0])
+            return r[node._index] if isinstance(r, list) else r
+        if node._kind == "group":
+            return [walk(i) for i in node._inputs]
+
+        op = node._op
+        attrs = {k: v for k, v in node._attrs.items()
+                 if not k.startswith("_")}
+        name = node.name or op
+        eligible = (op in _Q_OPS and name not in self._exclude)
+        oscale = out_scale(node)
+
+        if eligible and op in ("npx:convolution", "npx:fully_connected") \
+                and id(node) in self._qweights and oscale is not None:
+            x_entry = walk(node._inputs[0])
+            in_scale = (x_entry[2] if x_entry[0] == "q"
+                        else self._scale_for_entry(node._inputs[0]))
+            qx = self._to_q(x_entry, in_scale)
+            qw, ws = self._qweights[id(node)]
+            bias = None
+            if not attrs.get("no_bias", False) and len(node._inputs) > 2:
+                bias = self._to_f(walk(node._inputs[2]))
+            if op == "npx:convolution":
+                acc = lax.conv_general_dilated(
+                    qx, qw, window_strides=tuple(attrs["stride"]),
+                    padding=[(p, p) for p in attrs["pad"]],
+                    rhs_dilation=tuple(attrs.get("dilate", (1, 1))),
+                    feature_group_count=attrs.get("num_group", 1),
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                    preferred_element_type=jnp.int32)
+                y = acc.astype(jnp.float32) * (in_scale
+                                               * ws.reshape(1, -1, 1, 1))
+                if bias is not None:
+                    y = y + bias.reshape(1, -1, 1, 1)
+            else:
+                if attrs.get("flatten", True) and qx.ndim > 2:
+                    qx = qx.reshape(qx.shape[0], -1)
+                acc = lax.dot_general(
+                    qx, qw, (((qx.ndim - 1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                y = acc.astype(jnp.float32) * (in_scale * ws)
+                if bias is not None:
+                    y = y + bias
+            q = jnp.clip(jnp.round(y / oscale), -127, 127).astype(jnp.int8)
+            domains[name] = "q8"
+            qcount[0] += 1
+            return ("q", q, oscale)
+
+        if eligible and op in ("npx:activation", "npx:relu"):
+            act = attrs.get("act_type", "relu")
+            x_entry = walk(node._inputs[0])
+            if act == "relu" and x_entry[0] == "q":
+                domains[name] = "q8"
+                qcount[0] += 1
+                return ("q", jnp.maximum(x_entry[1], 0), x_entry[2])
+
+        if eligible and op == "npx:pooling":
+            x_entry = walk(node._inputs[0])
+            if x_entry[0] == "q" and attrs.get("layout", "NCHW") == "NCHW":
+                q, s = x_entry[1], x_entry[2]
+                ptype = attrs.get("pool_type", "max")
+                if attrs.get("global_pool"):
+                    if ptype == "max":
+                        out = q.max(axis=(2, 3), keepdims=True)
+                    else:
+                        m = q.astype(jnp.float32).mean(axis=(2, 3),
+                                                       keepdims=True)
+                        out = jnp.clip(jnp.round(m), -127,
+                                       127).astype(jnp.int8)
+                    domains[name] = "q8"
+                    qcount[0] += 1
+                    return ("q", out, s)
+                k = tuple(attrs["kernel"])
+                st = tuple(attrs.get("stride", k))
+                pad = tuple(attrs.get("pad", (0,) * len(k)))
+                if ptype == "max":
+                    out = lax.reduce_window(
+                        q, jnp.int8(-128), lax.max,
+                        (1, 1) + k, (1, 1) + st,
+                        [(0, 0), (0, 0)] + [(p, p) for p in pad])
+                    domains[name] = "q8"
+                    qcount[0] += 1
+                    return ("q", out, s)
+                if ptype == "avg":
+                    acc = lax.reduce_window(
+                        q.astype(jnp.int32), jnp.int32(0), lax.add,
+                        (1, 1) + k, (1, 1) + st,
+                        [(0, 0), (0, 0)] + [(p, p) for p in pad])
+                    m = acc.astype(jnp.float32) / float(onp.prod(k))
+                    out = jnp.clip(jnp.round(m), -127,
+                                   127).astype(jnp.int8)
+                    domains[name] = "q8"
+                    qcount[0] += 1
+                    return ("q", out, s)
+
+        if eligible and op == "np:add" and oscale is not None:
+            a = walk(node._inputs[0])
+            b = walk(node._inputs[1])
+            if a[0] == "q" and b[0] == "q":
+                y = (a[1].astype(jnp.float32) * a[2]
+                     + b[1].astype(jnp.float32) * b[2])
+                q = jnp.clip(jnp.round(y / oscale), -127,
+                             127).astype(jnp.int8)
+                domains[name] = "q8"
+                qcount[0] += 1
+                return ("q", q, oscale)
+
+        if eligible and op == "np:concatenate" and oscale is not None:
+            ins = node._inputs
+            entries = [walk(i) for i in ins]
+            if all(e[0] == "q" for e in entries):
+                axis = attrs.get("axis", 0)
+                qs = [self._to_q(e, oscale) for e in entries]
+                q = jnp.concatenate(qs, axis=axis)
+                domains[name] = "q8"
+                qcount[0] += 1
+                return ("q", q, oscale)
+
+        if eligible and op in ("np:reshape", "npx:reshape",
+                               "legacy:Flatten"):
+            x_entry = walk(node._inputs[0])
+            if x_entry[0] == "q":
+                q = x_entry[1]
+                out = None
+                if op == "legacy:Flatten":
+                    out = q.reshape(q.shape[0], -1)
+                else:
+                    # shape may ride as an attr or a positional extra
+                    extra, kw = _sym_mod()._attr_kwargs(node)
+                    shp = kw.get("newshape") or kw.get("shape") or \
+                        (extra[0] if extra else None)
+                    if shp is not None:
+                        out = q.reshape(tuple(int(s) for s in
+                                              (shp if hasattr(shp, "__iter__")
+                                               else (shp,))))
+                if out is not None:
+                    domains[name] = "q8"
+                    qcount[0] += 1
+                    return ("q", out, x_entry[2])
+
+        # fp32 fallback: dequantize inputs, run the eager op
+        sym_api = _sym_mod()
+        fn = sym_api._resolve_op(op)
+        args = []
+        for i in node._inputs:
+            e = walk(i)
+            if isinstance(e, list):
+                args.append([_wrap_value(self._to_f(x)) for x in e])
+            else:
+                args.append(_wrap_value(self._to_f(e)))
+        extra, kw = sym_api._attr_kwargs(node)
+        if node._attrs.get("_pack_inputs"):
+            r = fn(args, *extra, **kw)
+        else:
+            r = fn(*args, *extra, **kw)
+        if isinstance(r, (list, tuple)):
+            r = r[0]
+        domains[name] = "f32"
+        return ("f", r._data if isinstance(r, ndarray) else r)
+
+    def _scale_for_entry(self, input_node):
+        th = self._thresholds.get(id(input_node))
+        if th is not None:
+            return _scale_of(th)
+        return self._data_scale
+
+    def forward(self, x):
+        def f(xv):
+            with autograd._RecordingStateScope(False, False):
+                return self._forward_impl(xv)
+        return apply_op(f, x)
+
+    def __repr__(self):
+        return ("QuantizedGraphBlock(%d int8 ops last run)"
+                % self.quantized_ops)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def quantize_net_graph(network, calib_data, calib_mode="naive",
+                       exclude_layers=(), exclude_layers_match=(),
+                       fold_bn=True, logger=None):
+    """Whole-graph INT8 PTQ: trace → fold BN → calibrate → int8
+    interpreter block.  Returns a QuantizedGraphBlock (the reference
+    returns a rebuilt SymbolBlock the same way)."""
+    sym, params = network.to_sym()
+    if fold_bn:
+        sym, params = fold_batchnorm(sym, params)
+    thresholds, data_range = calibrate_graph(sym, params, calib_data,
+                                             calib_mode)
+    exclude = set(exclude_layers)
+    if exclude_layers_match:
+        for n in sym._topo():
+            nm = n.name or ""
+            if any(m in nm for m in exclude_layers_match):
+                exclude.add(nm)
+    return QuantizedGraphBlock(sym, params, thresholds, data_range,
+                               exclude_names=exclude)

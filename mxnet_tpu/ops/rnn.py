@@ -110,7 +110,9 @@ def _cell_step(mode, state_size):
 
 # scan unroll factor: amortizes per-step loop overhead and lets XLA
 # software-pipeline consecutive cells' matmul + elementwise phases
-_SCAN_UNROLL = 5
+# (MXNET_RNN_SCAN_UNROLL overrides; 5 won the 1/5/7/35 sweep on v5e)
+import os as _os
+_SCAN_UNROLL = int(_os.environ.get("MXNET_RNN_SCAN_UNROLL", "5"))
 
 
 def _single_layer(x, h0, c0, p, mode, reverse=False):
@@ -131,6 +133,95 @@ def _single_layer(x, h0, c0, p, mode, reverse=False):
     return outs, hT, cT
 
 
+def _stacked_wavefront(x, layers, h0, c0, mode, state_size):
+    """Layer-diagonal (wavefront) schedule for a unidirectional stacked
+    RNN: iteration k advances layer l at time k-l, so ALL layers' cell
+    matmuls batch into ONE (2L-1, B, H) x (2L-1, H, G) batched matmul
+    per iteration and the serial chain is T+L-1 iterations instead of
+    T*L — the cuDNN persistent-RNN schedule, re-based on the MXU.
+    Numerically identical to the layer-by-layer scan."""
+    T, B = x.shape[0], x.shape[1]
+    L = len(layers)
+    H = state_size
+    ng = _gates(mode)
+    step = _cell_step(mode, H)
+
+    # precompute layer-0 input projections for all T (biases folded)
+    p0 = layers[0][0]
+    gates_x0 = jnp.einsum("tbi,gi->tbg", x, p0["w_i2h"]) + p0["b_i2h"]
+
+    w_h2h = jnp.stack([p[0]["w_h2h"].T for p in layers])        # (L,H,G)
+    b_h2h = jnp.stack([p[0]["b_h2h"] for p in layers])          # (L,G)
+    if L > 1:
+        w_i2h_rest = jnp.stack([p[0]["w_i2h"].T for p in layers[1:]])
+        b_i2h_rest = jnp.stack([p[0]["b_i2h"] for p in layers[1:]])
+
+    lidx = jnp.arange(L)
+    is_lstm = mode == "lstm"
+
+    def body(carry, k):
+        h, c, pend = carry            # h,c: (L,B,H); pend: (L-1,B,H) or None
+        # one batched matmul: recurrent for all L + input-proj for l>=1
+        if L > 1:
+            A = jnp.concatenate([h, pend], axis=0)          # (2L-1,B,H)
+            W = jnp.concatenate([w_h2h, w_i2h_rest], axis=0)
+            prod = jnp.matmul(A, W)                          # (2L-1,B,G)
+            hh = prod[:L] + b_h2h[:, None, :]
+            i2h_rest = prod[L:] + b_i2h_rest[:, None, :]
+        else:
+            hh = jnp.matmul(h, w_h2h) + b_h2h[:, None, :]
+            i2h_rest = None
+        gx0 = gates_x0[jnp.clip(k, 0, T - 1)]                # (B,G)
+        if L > 1:
+            gx = jnp.concatenate([gx0[None], i2h_rest], axis=0)
+        else:
+            gx = gx0[None]
+        g = gx + hh                                          # (L,B,G)
+
+        if is_lstm:
+            i, f, u, o = jnp.split(g, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            u = jnp.tanh(u)
+            o = jax.nn.sigmoid(o)
+            c2 = f * c + i * u
+            h2 = o * jnp.tanh(c2)
+        elif mode == "gru":
+            # gru gates mix differently: xr/xz/xn from gx, hr/hz/hn from hh
+            xr, xz, xn = jnp.split(gx, 3, axis=-1)
+            hr, hz, hn = jnp.split(hh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1 - z) * n + z * h
+            c2 = c
+        else:
+            act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+            h2 = act(g)
+            c2 = c
+
+        active = ((k >= lidx) & (k < T + lidx))[:, None, None]  # (L,1,1)
+        h_new = jnp.where(active, h2, h)
+        c_new = jnp.where(active, c2, c) if is_lstm else c
+        pend_new = h_new[:-1] if L > 1 else pend
+        return (h_new, c_new, pend_new), h_new[-1]
+
+    # run the whole cell in the compute dtype (x ⊗ weights promotion):
+    # a float32 h0 against bf16 weights would silently promote every
+    # recurrent matmul back to fp32
+    cdt = gates_x0.dtype
+    h0 = h0.astype(cdt)
+    pend0 = jnp.zeros((L - 1, B, H), cdt) if L > 1 else \
+        jnp.zeros((0, B, H), cdt)
+    c_init = (c0.astype(cdt) if c0 is not None
+              else jnp.zeros_like(h0))
+    (hT, cT, _), outs = lax.scan(
+        body, (h0, c_init, pend0), jnp.arange(T + L - 1),
+        unroll=min(_SCAN_UNROLL, T + L - 1))
+    out_seq = outs[L - 1:]                                   # (T,B,H)
+    return out_seq, hT, (cT if is_lstm else None)
+
+
 def rnn_forward(x, params, h0, c0, mode, state_size, num_layers=1,
                 bidirectional=False, dropout_rate=0.0, dropout_key=None):
     """Full stacked RNN. x: (T, B, I); h0/c0: (L*D, B, H).
@@ -140,6 +231,17 @@ def rnn_forward(x, params, h0, c0, mode, state_size, num_layers=1,
     d = 2 if bidirectional else 1
     layers = unpack_params(params, mode, x.shape[-1], state_size, num_layers,
                            bidirectional)
+
+    # fused wavefront path: unidirectional stacks without inter-layer
+    # dropout.  (Layer-0's input projection is precomputed for all T, so
+    # any input width works; layers 1..L-1 have in_size == state_size by
+    # construction when d == 1.)
+    no_drop = (dropout_rate == 0.0 or dropout_key is None
+               or num_layers == 1)
+    if d == 1 and no_drop:
+        return _stacked_wavefront(
+            x, layers, h0, c0 if mode == "lstm" else None, mode,
+            state_size)
     hTs, cTs = [], []
     inp = x
     for li, dirs in enumerate(layers):

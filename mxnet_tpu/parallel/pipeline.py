@@ -32,6 +32,18 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 __all__ = ["PipelineRunner", "pipeline_apply"]
 
 
+def _shard_map_compat_kwargs():
+    """shard_map's replication-check kwarg was renamed across jax
+    versions (check_rep → check_vma); resolve once for every caller."""
+    import inspect as _inspect
+    sigp = _inspect.signature(shard_map).parameters
+    if "check_vma" in sigp:
+        return {"check_vma": False}
+    if "check_rep" in sigp:
+        return {"check_rep": False}
+    return {}
+
+
 class PipelineRunner:
     def __init__(self, stage_fns, mesh, axis="pp"):
         self.stage_fns = list(stage_fns)
@@ -114,13 +126,7 @@ class PipelineRunner:
                 outputs = lax.psum(outputs, axis)
             return outputs
 
-        import inspect
-        kw = {}
-        sig_params = inspect.signature(shard_map).parameters
-        if "check_vma" in sig_params:  # jax>=0.8 name
-            kw["check_vma"] = False
-        elif "check_rep" in sig_params:
-            kw["check_rep"] = False
+        kw = _shard_map_compat_kwargs()
         out = shard_map(
             per_stage, mesh=self.mesh,
             in_specs=(P(axis), P()),  # params sharded by stage
@@ -135,3 +141,226 @@ def pipeline_apply(stage_fns, stage_params, x, mesh, axis="pp",
     """Functional one-shot wrapper around PipelineRunner."""
     return PipelineRunner(stage_fns, mesh, axis).apply(
         stage_params, x, n_microbatches)
+
+
+# ---------------------------------------------------------------------------
+# Trainer-grade pipeline training (VERDICT r4 #10: a real model trains
+# through pp, not just a toy forward)
+# ---------------------------------------------------------------------------
+class PipelineTrainer:
+    """GPipe training over a ``pp`` mesh axis with the praxis pattern:
+    a replicated prologue (input stem), S homogeneous pipelined body
+    stages (one per device on the axis), and a replicated epilogue
+    (head + loss).  Forward microbatches stream through ``ppermute``;
+    the backward pipeline is the AD transpose of the same program
+    (reverse ppermute), so fwd+bwd+update compile into ONE XLA
+    executable — mirroring DataParallelTrainer's contract.
+
+    Stages must be structurally identical Gluon blocks (the standard
+    pipelined-transformer shape: repeated layers); the prologue/epilogue
+    absorb the heterogeneous edges.
+
+    API (mirrors DataParallelTrainer):
+      t = PipelineTrainer(prologue, stages, epilogue, loss_fn,
+                          "sgd", {"learning_rate": .1}, mesh)
+      state = t.init_state(); t.build_step()
+      state, loss = t.step(state, x, y, lr)
+    """
+
+    def __init__(self, prologue, stages, epilogue, loss_fn, optimizer,
+                 hp, mesh, axis="pp", n_microbatches=None):
+        from . import functionalize  # late: parallel/__init__ imports us
+
+        self.mesh = mesh
+        self.axis = axis
+        self.loss_fn = loss_fn
+        self._hp = dict(hp or {})
+        self._opt = optimizer
+        if optimizer == "sgd" and self._hp.get("momentum"):
+            self._opt = "sgd_mom"
+        S = mesh.shape[axis]
+        assert len(stages) == S, \
+            "need one stage block per device on %r (%d != %d)" % (
+                axis, len(stages), S)
+        self.n_stages = S
+        self.n_microbatches = n_microbatches or S
+
+        self._pro_fn, self._pro_params = functionalize(prologue,
+                                                       train=True) \
+            if prologue is not None else (None, {})
+        self._epi_fn, self._epi_params = functionalize(epilogue,
+                                                       train=True) \
+            if epilogue is not None else (None, {})
+        self._stage_fns = []
+        self._stage_params = []
+        for st in stages:
+            f, p = functionalize(st, train=True)
+            self._stage_fns.append(f)
+            self._stage_params.append(p)
+        structs = [sorted(p.keys()) for p in self._stage_params]
+        if any(s != structs[0] for s in structs[1:]):
+            raise ValueError("pipeline stages must be structurally "
+                             "identical blocks")
+        self._step = None
+
+    def _vals(self, params):
+        return {k: p._data._data for k, p in params.items()}
+
+    def init_state(self):
+        stacked = {}
+        keys = sorted(self._stage_params[0].keys())
+        sh = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+        stage_vals = [self._vals(p) for p in self._stage_params]
+        for k in keys:
+            leaves = [v[k] for v in stage_vals]
+            stacked[k] = jax.device_put(jnp.stack(leaves), sh)
+        pro = {k: jax.device_put(v, repl)
+               for k, v in self._vals(self._pro_params).items()}
+        epi = {k: jax.device_put(v, repl)
+               for k, v in self._vals(self._epi_params).items()}
+        params = {"stages": stacked, "pro": pro, "epi": epi}
+        slots = (jax.tree.map(
+            lambda v: jnp.zeros(v.shape, jnp.float32), params)
+            if self._opt == "sgd_mom" else {})
+        return {"params": params, "slots": slots}
+
+    def _forward(self, params, x, key=None, want_aux=False):
+        """Full forward: prologue → pipelined stages → epilogue.
+
+        Runs every part in TRAINING mode (batch stats, dropout given a
+        key).  With want_aux=True also returns the aux updates — BN
+        running stats etc. — for the prologue/epilogue and per-stage
+        params (stage aux from each stage's LAST active microbatch, the
+        standard GPipe convention)."""
+        axis, S, M = self.axis, self.n_stages, self.n_microbatches
+        stage_fn = self._stage_fns[0]  # homogeneous
+
+        keys = (list(jax.random.split(key, 3)) if key is not None
+                else [None, None, None])
+        h = x
+        pro_aux = {}
+        if self._pro_fn is not None:
+            h, pro_aux = self._pro_fn(params["pro"], h, key=keys[0])
+        B = h.shape[0]
+        if B % M != 0:
+            raise ValueError("batch %d not divisible into %d microbatches"
+                             % (B, M))
+        mb = h.reshape(M, B // M, *h.shape[1:])
+        stage_key = keys[1]
+
+        def per_stage(params_stk, mb_all):
+            sidx = lax.axis_index(axis)
+            sparams = jax.tree.map(lambda a: a[0], params_stk)
+            nsteps = M + S - 1
+            zero = jnp.zeros_like(mb_all[0])
+
+            def body(carry, t):
+                outputs, recv, aux_carry = carry
+                feed = jnp.where(sidx == 0,
+                                 mb_all[jnp.clip(t, 0, M - 1)], recv)
+                skey = (jax.random.fold_in(stage_key, t)
+                        if stage_key is not None else None)
+                hh, st_aux = stage_fn(sparams, feed, key=skey)
+                active = (t >= sidx) & (t < M + sidx)
+                hh = jnp.where(active, hh, zero)
+                # aux (running stats): keep the last ACTIVE microbatch's
+                # update per stage; inactive steps must not clobber
+                new_aux = dict(aux_carry)
+                for k, v in st_aux.items():
+                    new_aux[k] = jnp.where(active, v, aux_carry[k])
+                nxt = lax.ppermute(
+                    hh, axis, [(i, (i + 1) % S) for i in range(S)])
+                out_idx = t - (S - 1)
+                emit = (sidx == S - 1) & (out_idx >= 0)
+                outputs = jnp.where(
+                    emit, outputs.at[jnp.clip(out_idx, 0, M - 1)].set(hh),
+                    outputs)
+                return (outputs, nxt, new_aux), None
+
+            outputs0 = jnp.zeros((M,) + mb_all.shape[1:], mb_all.dtype)
+            (outputs, _, aux_final), _ = lax.scan(
+                body, (outputs0, zero, dict(sparams)), jnp.arange(nsteps))
+            if S > 1:
+                outputs = lax.psum(outputs, axis)
+            # re-add the stage axis so out_specs=P(axis) reassembles the
+            # (S, ...) stacked layout of params["stages"]
+            aux_final = jax.tree.map(lambda a: a[None], aux_final)
+            return outputs, aux_final
+
+        kw = _shard_map_compat_kwargs()
+        out, stage_aux = shard_map(
+            per_stage, mesh=self.mesh,
+            in_specs=(P(axis), P()), out_specs=(P(), P(axis)), **kw)(
+            params["stages"], mb)
+        out = out.reshape(B, *out.shape[2:])
+        epi_aux = {}
+        if self._epi_fn is not None:
+            out, epi_aux = self._epi_fn(params["epi"], out, key=keys[2])
+        if want_aux:
+            return out, {"pro": pro_aux, "stages": stage_aux,
+                         "epi": epi_aux}
+        return out
+
+    def build_step(self, donate=True):
+        hp = self._hp
+        kind = self._opt
+        loss_fn = self.loss_fn
+
+        def step(state, x, y, lr, key):
+            from mxnet_tpu import autograd as ag
+            from mxnet_tpu.ndarray import _wrap_value, ndarray as ndcls
+
+            def loss_of(params):
+                out, aux = self._forward(params, x, key=key,
+                                         want_aux=True)
+                with ag._RecordingStateScope(False, True):
+                    l = loss_fn(_wrap_value(out), _wrap_value(y))
+                l = jnp.mean(l._data if isinstance(l, ndcls) else l)
+                return l, aux
+
+            (loss_val, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(state["params"])
+            lr_ = lr
+            if kind == "sgd_mom":
+                mom = hp.get("momentum", 0.9)
+                new_slots = jax.tree.map(
+                    lambda s, g: mom * s - lr_ * g.astype(jnp.float32),
+                    state["slots"], grads)
+                new_params = jax.tree.map(
+                    lambda p, m: (p.astype(jnp.float32) + m).astype(p.dtype),
+                    state["params"], new_slots)
+            else:
+                new_params = jax.tree.map(
+                    lambda p, g: (p.astype(jnp.float32)
+                                  - lr_ * g.astype(jnp.float32)
+                                  ).astype(p.dtype),
+                    state["params"], grads)
+                new_slots = state["slots"]
+            # aux updates (BN running stats, non-trainable) overwrite the
+            # gradient-stepped values — their grads are zero in training
+            # mode, so this is the only real update they get
+            for group, upd in aux.items():
+                for k, v in upd.items():
+                    new_params[group][k] = v.astype(
+                        new_params[group][k].dtype)
+            return {"params": new_params, "slots": new_slots}, loss_val
+
+        self._step = jax.jit(step,
+                             donate_argnums=(0,) if donate else ())
+        return self._step
+
+    def step(self, state, x, y, lr=None, key=None):
+        from mxnet_tpu.ndarray import ndarray as ndcls
+        if self._step is None:
+            self.build_step()
+        x = x._data if isinstance(x, ndcls) else x
+        y = y._data if isinstance(y, ndcls) else y
+        if lr is None:
+            lr = self._hp.get("learning_rate", 0.01)
+        if key is None:
+            key = jax.random.key(0)
+        return self._step(state, x, y, lr, key)
+
+
+__all__ += ["PipelineTrainer"]

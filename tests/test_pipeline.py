@@ -94,3 +94,138 @@ def test_pipeline_microbatch_validation():
     w = [jnp.zeros((4, 4))] * 2
     with pytest.raises(AssertionError, match="not divisible"):
         runner.apply(w, jnp.zeros((5, 4)), n_microbatches=2)
+
+
+# ---------------------------------------------------------------------------
+# PipelineTrainer: Trainer-grade GPipe training (VERDICT r4 #10)
+# ---------------------------------------------------------------------------
+def test_pipeline_trainer_trains_real_model():
+    import time
+    import jax
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import Mesh
+    from mxnet_tpu.parallel.pipeline import PipelineTrainer
+
+    S, H, B = 4, 64, 64
+    mesh = Mesh(onp.array(jax.devices()[:S]), ("pp",))
+    mx.random.seed(0)
+    prologue = nn.HybridSequential()
+    prologue.add(nn.Flatten(), nn.Dense(H, activation="relu",
+                                        in_units=28 * 28))
+    stages = []
+    for _ in range(S):
+        st = nn.HybridSequential()
+        st.add(nn.Dense(H, activation="relu", in_units=H))
+        stages.append(st)
+    epilogue = nn.Dense(10, in_units=H)
+    x = mxnp.random.uniform(size=(B, 1, 28, 28))
+    y = mxnp.random.randint(0, 10, size=(B,))
+    for blk in [prologue] + stages + [epilogue]:
+        blk.initialize(mx.init.Xavier())
+    h = prologue(x)
+    for st in stages:
+        h = st(h)
+    seq_ref = epilogue(h)
+
+    loss_obj = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = PipelineTrainer(prologue, stages, epilogue,
+                              lambda o, l: loss_obj(o, l),
+                              "sgd", {"learning_rate": 0.03,
+                                      "momentum": 0.9},
+                              mesh, n_microbatches=8)
+    state = trainer.init_state()
+    trainer.build_step(donate=False)
+
+    # pipelined forward == sequential execution of the same blocks
+    fwd = trainer._forward(state["params"], x._data)
+    onp.testing.assert_allclose(onp.asarray(fwd), seq_ref.asnumpy(),
+                                rtol=2e-4, atol=2e-5)
+
+    # training decreases loss on a fixed batch
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(25):
+        state, loss = trainer.step(state, x, y)
+        losses.append(float(jax.device_get(loss)))
+    dt = time.perf_counter() - t0
+    assert losses[-1] < losses[0] * 0.9, losses[:3] + losses[-3:]
+    # throughput floor: compiled pipelined steps, not per-step recompiles
+    # (a loose anti-recompile gate — the box is 1 CPU core and CI may
+    # share it with other lanes)
+    assert 25 * B / dt > 40, "pipeline step too slow: %.1f img/s" % (
+        25 * B / dt)
+
+
+def test_pipeline_trainer_rejects_heterogeneous_stages():
+    import jax
+    import numpy as onp
+    import pytest
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import Mesh
+    from mxnet_tpu.parallel.pipeline import PipelineTrainer
+
+    S = 2
+    mesh = Mesh(onp.array(jax.devices()[:S]), ("pp",))
+    st1 = nn.HybridSequential(); st1.add(nn.Dense(8, in_units=8))
+    st2 = nn.HybridSequential()
+    st2.add(nn.Dense(8, in_units=8), nn.Dense(8, in_units=8))
+    for b in (st1, st2):
+        b.initialize()
+    loss_obj = gluon.loss.SoftmaxCrossEntropyLoss()
+    with pytest.raises(ValueError, match="structurally identical"):
+        PipelineTrainer(None, [st1, st2], None,
+                        lambda o, l: loss_obj(o, l),
+                        "sgd", {}, mesh)
+
+
+def test_pipeline_trainer_batchnorm_stats_update():
+    """Stages containing BatchNorm train in TRAINING mode: running stats
+    move, and the aux updates land back in the state."""
+    import jax
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import Mesh
+    from mxnet_tpu.parallel.pipeline import PipelineTrainer
+
+    S, H, B = 2, 16, 32
+    mesh = Mesh(onp.array(jax.devices()[:S]), ("pp",))
+    mx.random.seed(0)
+    stages = []
+    for _ in range(S):
+        st = nn.HybridSequential()
+        st.add(nn.Dense(H, in_units=H), nn.BatchNorm(axis=1),
+               nn.Activation("relu"))
+        stages.append(st)
+    epilogue = nn.Dense(4, in_units=H)
+    x = mxnp.random.uniform(size=(B, H)) * 3.0 + 1.0
+    y = mxnp.random.randint(0, 4, size=(B,))
+    for blk in stages + [epilogue]:
+        blk.initialize(mx.init.Xavier())
+    h = x
+    for st in stages:
+        h = st(h)
+    epilogue(h)
+
+    loss_obj = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = PipelineTrainer(None, stages, epilogue,
+                         lambda o, l: loss_obj(o, l),
+                         "sgd", {"learning_rate": 0.05}, mesh,
+                         n_microbatches=4)
+    state = tr.init_state()
+    tr.build_step(donate=False)
+    rm_keys = [k for k in state["params"]["stages"] if "running_mean" in k]
+    assert rm_keys, "BN running stats missing from pipeline state"
+    rm_before = onp.asarray(state["params"]["stages"][rm_keys[0]])
+    for i in range(3):
+        state, loss = tr.step(state, x, y, key=jax.random.key(i))
+    rm_after = onp.asarray(state["params"]["stages"][rm_keys[0]])
+    assert not onp.allclose(rm_before, rm_after), \
+        "BatchNorm running stats did not update through the pipeline"
+    assert onp.isfinite(float(jax.device_get(loss)))

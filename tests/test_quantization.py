@@ -212,3 +212,115 @@ def test_quantize_net_preserves_nested_hybrid_state():
     assert getattr(net.body, "_active", False) is True
     assert not getattr(net.head, "_active", False)
     assert not getattr(net.body, "_op_hooks_active", 0)
+
+
+# ---------------------------------------------------------------------------
+# Graph-level INT8 (reference QuantizeGraph pass, VERDICT r4 #5):
+# int8 chains across conv/act/pool/add/flatten without fp32 round-trips
+# ---------------------------------------------------------------------------
+def test_quantize_net_graph_resnet_spine_int8():
+    from collections import Counter
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    from mxnet_tpu.contrib.quantization_graph import quantize_net_graph
+
+    mx.random.seed(0)
+    net = resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(8, 3, 32, 32))
+    ref = net(x).asnumpy()
+
+    qnet = quantize_net_graph(net, calib_data=[x])
+    out = qnet(x).asnumpy()
+
+    # the ENTIRE spine runs int8: BN folded away, conv/relu/pool/add/fc
+    # all in q8 domain, no fp32 op between them
+    doms = Counter(qnet.domains.values())
+    assert doms.get("f32", 0) == 0, qnet.domains
+    assert qnet.quantized_ops >= 40, qnet.quantized_ops
+    kinds = set()
+    for n in qnet._sym._topo():
+        if n._kind == "op":
+            kinds.add(n._op)
+    assert "npx:batch_norm" not in kinds, "BN not folded"
+    # conv + pooling + elemwise add + fully_connected all present & int8
+    assert {"npx:convolution", "npx:pooling", "np:add",
+            "npx:fully_connected"} <= kinds
+
+    # accuracy: top-1 agreement with the fp32 net
+    agree = (out.argmax(1) == ref.argmax(1)).mean()
+    assert agree >= 0.75, agree
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-8)
+    assert rel < 0.25, rel
+
+
+def test_quantize_graph_concat_chain_int8():
+    """Concat of two int8 conv branches stays int8 (reference
+    quantized_concat.cc)."""
+    from mxnet_tpu.contrib.quantization_graph import quantize_net_graph
+    from mxnet_tpu.gluon import HybridBlock
+
+    from mxnet_tpu import npx
+
+    class TwoBranch(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Conv2D(8, 3, padding=1, in_channels=3)
+            self.b = nn.Conv2D(8, 3, padding=1, in_channels=3)
+            self.head = nn.Dense(5)
+
+        def forward(self, x):
+            ya = npx.relu(self.a(x))
+            yb = npx.relu(self.b(x))
+            y = mxnp.concatenate([ya, yb], axis=1)
+            return self.head(npx.pooling(y, kernel=(2, 2), stride=(2, 2),
+                                         pool_type="max"))
+
+    mx.random.seed(0)
+    net = TwoBranch()
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(4, 3, 8, 8))
+    ref = net(x).asnumpy()
+    qnet = quantize_net_graph(net, calib_data=[x])
+    out = qnet(x).asnumpy()
+    concat_nodes = [n for n, d in qnet.domains.items()
+                    if "concat" in n.lower()]
+    dom_by_op = {}
+    for n in qnet._sym._topo():
+        if n._kind == "op":
+            dom_by_op[n._op] = qnet.domains.get(n.name or n._op)
+    assert dom_by_op.get("np:concatenate") == "q8", qnet.domains
+    assert dom_by_op.get("npx:pooling") == "q8", qnet.domains
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-8)
+    assert rel < 0.25, rel
+
+
+def test_quantize_graph_entropy_mode():
+    from mxnet_tpu.contrib.quantization_graph import quantize_net_graph
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, in_channels=3, activation="relu"),
+            nn.MaxPool2D(2), nn.Flatten(), nn.Dense(6))
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(8, 3, 8, 8))
+    ref = net(x).asnumpy()
+    qnet = quantize_net_graph(net, calib_data=[x], calib_mode="entropy")
+    out = qnet(x).asnumpy()
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-8)
+    assert rel < 0.3, rel
+
+
+def test_quantize_graph_exclude_layers():
+    from mxnet_tpu.contrib.quantization_graph import quantize_net_graph
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, in_channels=3, activation="relu"),
+            nn.Flatten(), nn.Dense(6))
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(4, 3, 8, 8))
+    net(x)
+    qnet = quantize_net_graph(net, calib_data=[x],
+                              exclude_layers_match=["fully_connected"])
+    qnet(x)
+    fc = [n.name for n in qnet._sym._topo()
+          if n._kind == "op" and n._op == "npx:fully_connected"]
+    assert qnet.domains[fc[0]] == "f32"
