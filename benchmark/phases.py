@@ -269,9 +269,23 @@ def lstm_phases(B=32, T=35):
 
     peak = _peak()
     model_flops = 6 * 13.3e6 * B * T
+    # adjudication: compute 61GF/8ms = ~4% of MXU peak and bytes
+    # 1.45GB/8ms = ~22% of HBM bandwidth — NEITHER roofline binds; the
+    # step is LATENCY-bound on the ~70 serial scan iterations (fwd+bwd)
+    # of small (B=32) cells.  This is inherent to the reference workload
+    # shape (bptt=35, bs=32), not schedulable work.
+    bound = None
+    if fb_cost.get("bytes") and peak:
+        cf = fb_cost["flops"] / fb_t / peak
+        cb = fb_cost["bytes"] / fb_t / 819e9
+        bound = {"pct_compute_roofline": round(cf, 3),
+                 "pct_bandwidth_roofline": round(cb, 3),
+                 "bound": ("latency" if max(cf, cb) < 0.5 else
+                           ("compute" if cf > cb else "bandwidth"))}
     return {
         "config": {"model": "lstm_lm_2x650", "B": B, "T": T,
                    "dtype": "bfloat16"},
+        "roofline": bound,
         "phases": {
             "fwd": {"ms": round(fwd_t * 1e3, 3)},
             "fwd_bwd": {"ms": round(fb_t * 1e3, 3), **fb_cost,

@@ -344,11 +344,17 @@ def record_op(fn, args, kwargs):
         return jax.ShapeDtypeStruct(v.shape, v.dtype)
 
     def spec_sig(spec):
+        # hot path: runs once per array per recorded op (the fused
+        # optimizer op alone carries ~500 arrays).  Key on the raw
+        # (shape, dtype) objects — no ShapeDtypeStruct construction, no
+        # str(dtype) (numpy dtypes hash/compare fine)
         tag, v = spec
         if tag == "const":
             return ("const", _const_key(v))
-        a = avalize(spec)
-        return ("arr", a.shape, str(a.dtype))
+        if tag == "lazy":
+            a = v.aval
+            return ("arr", a.shape, a.dtype)
+        return ("arr", v.shape, v.dtype)
 
     ambients = _snapshot_ambients()
     try:

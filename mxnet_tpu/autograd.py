@@ -381,15 +381,19 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
         for h in heads:
             h._node = None
 
-    # deterministic bulk boundary: the tape walk is complete, so dispatch
-    # the whole fwd+bwd segment as one program NOW.  Without a stable
-    # boundary the op-count limit would cut segments at arbitrary offsets
-    # across steps, minting a new executable signature every few steps.
-    # (The optimizer update deliberately stays a SEPARATE program: merging
-    # it kept fwd residuals + both param copies live in one program and
-    # OOMed HBM on ResNet-50-sized models.)
-    from . import _bulk
-    _bulk.flush()
+    # bulk boundary policy: by default the backward segment stays OPEN so
+    # the optimizer update that typically follows records into the SAME
+    # program — one dispatch for bwd+update instead of two (each dispatch
+    # costs ~6 ms through the bench tunnel; trainer.step flushes at its
+    # end, and any host fetch flushes too, so correctness never depends
+    # on this boundary).  MXNET_EXEC_BULK_FUSE_BACKWARD_UPDATE=0 restores
+    # the eager flush — use it if the merged program's live set (fwd
+    # residuals + both param copies) presses HBM on very large models.
+    import os as _os
+    if _os.environ.get("MXNET_EXEC_BULK_FUSE_BACKWARD_UPDATE",
+                       "1") == "0":
+        from . import _bulk
+        _bulk.flush()
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

@@ -124,6 +124,17 @@ register("MXNET_FLASH_ATTENTION", str, "", "honored",
 register("MXNET_SAFE_ACCUMULATION", bool, True, "honored",
          "accumulate norms/sums in fp32 even for fp16 inputs (always on;"
          " registered for compatibility)", "ops")
+register("MXNET_EXEC_BULK_FUSE_BACKWARD_UPDATE", bool, True, "honored",
+         "keep the backward bulk segment open so the optimizer update "
+         "joins the same compiled program (one dispatch for bwd+update)."
+         " Set 0 to restore a flush at backward() — use if the merged "
+         "program's live set presses HBM on very large models",
+         "autograd.backward")
+register("MXNET_RNN_SCAN_UNROLL", int, 5, "honored",
+         "RNN time-scan unroll factor", "ops.rnn")
+register("MXNET_RNN_WAVEFRONT", bool, True, "honored",
+         "layer-diagonal fused schedule for stacked unidirectional RNNs",
+         "ops.rnn")
 register("MXNET_INT64_TENSOR_SIZE", bool, False, "honored",
          "enable true int64 tensors/indices (reference USE_INT64_TENSOR_SIZE"
          " build flag; here it flips jax_enable_x64 at import). Off: int64"

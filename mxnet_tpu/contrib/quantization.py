@@ -107,6 +107,29 @@ class CalibrationCollector:
         self.stats = OrderedDict()
         self._handles = []
 
+    def observe(self, name, a):
+        """Accumulate one concrete activation for `name` (min/max, and in
+        entropy mode a bin-aligned |x| histogram — widening the range
+        REBINS the existing histogram so multi-batch sums stay aligned)."""
+        st = self.stats[name]
+        st.min = min(st.min, float(a.min()))
+        st.max = max(st.max, float(a.max()))
+        if self.mode == "entropy":
+            amax = float(onp.abs(a).max())
+            if st.hist is None:
+                st.edges = onp.linspace(0, max(amax, 1e-8),
+                                        self.NUM_BINS + 1)
+                st.hist = onp.zeros(self.NUM_BINS)
+            elif amax > st.edges[-1]:
+                # rebin the old histogram onto wider edges
+                new_edges = onp.linspace(0, amax, self.NUM_BINS + 1)
+                centers = (st.edges[:-1] + st.edges[1:]) / 2
+                new_hist, _ = onp.histogram(centers, bins=new_edges,
+                                            weights=st.hist)
+                st.edges, st.hist = new_edges, new_hist
+            h, _ = onp.histogram(onp.abs(a), bins=st.edges)
+            st.hist += h
+
     def attach(self, layers):
         for name, layer in layers.items():
             self.stats[name] = _LayerStats()
@@ -114,24 +137,7 @@ class CalibrationCollector:
             def hook(block, inputs, _name=name):
                 x = inputs[0]
                 a = x.asnumpy() if isinstance(x, ndarray) else onp.asarray(x)
-                st = self.stats[_name]
-                st.min = min(st.min, float(a.min()))
-                st.max = max(st.max, float(a.max()))
-                if self.mode == "entropy":
-                    amax = float(onp.abs(a).max())
-                    if st.hist is None:
-                        st.edges = onp.linspace(0, max(amax, 1e-8),
-                                                self.NUM_BINS + 1)
-                        st.hist = onp.zeros(self.NUM_BINS)
-                    elif amax > st.edges[-1]:
-                        # rebin the old histogram onto wider edges
-                        new_edges = onp.linspace(0, amax, self.NUM_BINS + 1)
-                        centers = (st.edges[:-1] + st.edges[1:]) / 2
-                        new_hist, _ = onp.histogram(centers, bins=new_edges,
-                                                    weights=st.hist)
-                        st.edges, st.hist = new_edges, new_hist
-                    h, _ = onp.histogram(onp.abs(a), bins=st.edges)
-                    st.hist += h
+                self.observe(_name, a)
 
             self._handles.append(layer.register_forward_pre_hook(hook))
 

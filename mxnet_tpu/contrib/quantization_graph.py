@@ -118,11 +118,18 @@ def calibrate_graph(sym, params, calib_data, calib_mode="naive"):
     """Evaluate every op node on the calibration batches; return
     {id(node): (min, max)} (entropy mode narrows via KL thresholds,
     reference calibrate.cc)."""
+    from .quantization import _LayerStats, CalibrationCollector
+
     sym_api = _sym_mod()
     nodes = [n for n in sym._topo() if n._kind == "op"]
     group = sym_api.Group(nodes)
-    stats = {id(n): [onp.inf, -onp.inf] for n in nodes}
-    hists = {id(n): None for n in nodes} if calib_mode == "entropy" else None
+    # per-node stats via the SAME accumulator the layer-mode collector
+    # uses — its rebin-on-wider-range logic keeps multi-batch entropy
+    # histograms bin-aligned (summing per-batch histograms with growing
+    # ranges would misalign bins and corrupt the KL threshold)
+    collector = CalibrationCollector(mode=calib_mode)
+    for n in nodes:
+        collector.stats[id(n)] = _LayerStats()
     data_stat = [onp.inf, -onp.inf]
 
     from .. import np as mxnp
@@ -138,28 +145,9 @@ def calibrate_graph(sym, params, calib_data, calib_mode="naive"):
         data_stat[1] = max(data_stat[1], float(b.max()))
         outs = group.eval(data=batch, **env)
         for n, o in zip(nodes, outs):
-            a = o.asnumpy()
-            st = stats[id(n)]
-            st[0] = min(st[0], float(a.min()))
-            st[1] = max(st[1], float(a.max()))
-            if hists is not None:
-                h, _ = onp.histogram(onp.abs(a), bins=2048,
-                                     range=(0, max(abs(st[0]),
-                                                   abs(st[1]), 1e-8)))
-                hists[id(n)] = h if hists[id(n)] is None \
-                    else hists[id(n)] + h
+            collector.observe(id(n), o.asnumpy())
 
-    if calib_mode == "entropy":
-        from .quantization import _optimal_threshold_kl
-        for n in nodes:
-            st = stats[id(n)]
-            amax = max(abs(st[0]), abs(st[1]), 1e-8)
-            h = hists[id(n)]
-            if h is not None and h.sum() > 0:
-                edges = onp.linspace(0, amax, 2049)
-                t = _optimal_threshold_kl(h, edges)
-                st[0], st[1] = -t, t
-    return {k: tuple(v) for k, v in stats.items()}, tuple(data_stat)
+    return collector.thresholds(), tuple(data_stat)
 
 
 def _scale_of(rng_pair):
@@ -265,10 +253,28 @@ class QuantizedGraphBlock(HybridBlock):
         op = node._op
         attrs = {k: v for k, v in node._attrs.items()
                  if not k.startswith("_")}
+        # positionally-passed op args (act_type, concat axis, ...) ride in
+        # _extra_pos, not named attrs — fold them in per known signature
+        # (the f32 fallback resolves them via _attr_kwargs already)
+        extra = tuple(node._attrs.get("_extra_pos", ()) or ())
+        if extra:
+            if op == "npx:activation" and "act_type" not in attrs \
+                    and extra[0] is not None:
+                attrs["act_type"] = extra[0]
+            elif op == "np:concatenate" and "axis" not in attrs \
+                    and extra[0] is not None:
+                attrs["axis"] = extra[0]
+            elif op in ("np:reshape", "npx:reshape") \
+                    and "newshape" not in attrs and "shape" not in attrs \
+                    and extra[0] is not None:
+                attrs["newshape"] = extra[0]
         name = node.name or op
         eligible = (op in _Q_OPS and name not in self._exclude)
         oscale = out_scale(node)
 
+        if (eligible and op == "npx:convolution"
+                and attrs.get("layout", "NCHW") != "NCHW"):
+            eligible = False  # int8 conv kernel is NCHW-only (like pool)
         if eligible and op in ("npx:convolution", "npx:fully_connected") \
                 and id(node) in self._qweights and oscale is not None:
             x_entry = walk(node._inputs[0])

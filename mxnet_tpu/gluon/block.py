@@ -487,7 +487,11 @@ class HybridBlock(Block):
         flat_out = list(results[:n_out])
         aux_vals = results[n_out:]
         for name, v in zip(template["aux_names"], aux_vals):
-            live[name]._data._set_data(v.detach()._data)
+            # write back through the RAW buffer: the `_data` property
+            # materializes LazyArrays, which flushed the freshly-recorded
+            # forward out of the bulk segment — paying one extra program
+            # dispatch per hybridized call (BatchNorm nets: every call)
+            live[name]._data._set_data(v._buf)
 
         # rebuild output structure
         idx = [0]

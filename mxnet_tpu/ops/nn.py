@@ -618,11 +618,14 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None, blank=0):
             prev2 = jnp.concatenate([jnp.full((2,), ninf), alpha[:-2]])
             skip_ok = (jnp.arange(S) % 2 == 1) & (ext != jnp.concatenate(
                 [jnp.full((2,), -1), ext[:-2]]))
-            m = jnp.maximum(alpha, prev1)
-            m = jnp.where(skip_ok, jnp.maximum(m, prev2), m)
+            # mask BEFORE the log-sum-exp: where(skip_ok, exp(prev2-m), 0)
+            # with prev2 > m in the untaken branch makes the untaken exp
+            # inf, and its VJP inf*0 = NaN poisons every gradient
+            prev2 = jnp.where(skip_ok, prev2, ninf)
+            m = jnp.maximum(jnp.maximum(alpha, prev1), prev2)
             comb = jnp.log(
                 jnp.exp(alpha - m) + jnp.exp(prev1 - m)
-                + jnp.where(skip_ok, jnp.exp(prev2 - m), 0.0)) + m
+                + jnp.exp(prev2 - m)) + m
             new = comb + lp[ext]
             return new, new
 

@@ -235,10 +235,12 @@ def rnn_forward(x, params, h0, c0, mode, state_size, num_layers=1,
     # fused wavefront path: unidirectional stacks without inter-layer
     # dropout.  (Layer-0's input projection is precomputed for all T, so
     # any input width works; layers 1..L-1 have in_size == state_size by
-    # construction when d == 1.)
+    # construction when d == 1.)  MXNET_RNN_WAVEFRONT=0 forces the
+    # layer-by-layer scan (A/B lever).
     no_drop = (dropout_rate == 0.0 or dropout_key is None
                or num_layers == 1)
-    if d == 1 and no_drop:
+    if d == 1 and no_drop and \
+            _os.environ.get("MXNET_RNN_WAVEFRONT", "1") != "0":
         return _stacked_wavefront(
             x, layers, h0, c0 if mode == "lstm" else None, mode,
             state_size)

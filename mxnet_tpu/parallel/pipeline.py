@@ -255,6 +255,23 @@ class PipelineTrainer:
             nsteps = M + S - 1
             zero = jnp.zeros_like(mb_all[0])
 
+            # learn which params the stage actually MUTATES (BN running
+            # stats) with one abstract trace — the aux carry must hold
+            # ONLY those: seeding it with all of sparams would make the
+            # write-back in step() overwrite freshly gradient-stepped
+            # weights with their forward-time values
+            try:
+                aux_shapes = jax.eval_shape(
+                    lambda p, h: stage_fn(p, h, key=None)[1],
+                    sparams, mb_all[0])
+            except Exception:  # dropout stages demand a key at trace
+                aux_shapes = jax.eval_shape(
+                    lambda p, h: stage_fn(p, h,
+                                          key=jax.random.key(0))[1],
+                    sparams, mb_all[0])
+            aux_keys = sorted(aux_shapes.keys())
+            aux0 = {k: sparams[k] for k in aux_keys}
+
             def body(carry, t):
                 outputs, recv, aux_carry = carry
                 feed = jnp.where(sidx == 0,
@@ -266,9 +283,8 @@ class PipelineTrainer:
                 hh = jnp.where(active, hh, zero)
                 # aux (running stats): keep the last ACTIVE microbatch's
                 # update per stage; inactive steps must not clobber
-                new_aux = dict(aux_carry)
-                for k, v in st_aux.items():
-                    new_aux[k] = jnp.where(active, v, aux_carry[k])
+                new_aux = {k: jnp.where(active, st_aux[k], aux_carry[k])
+                           for k in aux_keys}
                 nxt = lax.ppermute(
                     hh, axis, [(i, (i + 1) % S) for i in range(S)])
                 out_idx = t - (S - 1)
@@ -280,7 +296,7 @@ class PipelineTrainer:
 
             outputs0 = jnp.zeros((M,) + mb_all.shape[1:], mb_all.dtype)
             (outputs, _, aux_final), _ = lax.scan(
-                body, (outputs0, zero, dict(sparams)), jnp.arange(nsteps))
+                body, (outputs0, zero, aux0), jnp.arange(nsteps))
             if S > 1:
                 outputs = lax.psum(outputs, axis)
             # re-add the stage axis so out_specs=P(axis) reassembles the
@@ -359,7 +375,10 @@ class PipelineTrainer:
         if lr is None:
             lr = self._hp.get("learning_rate", 0.01)
         if key is None:
-            key = jax.random.key(0)
+            # advance an internal counter: a FIXED default key would
+            # replay identical dropout masks on every training step
+            self._auto_step = getattr(self, "_auto_step", 0) + 1
+            key = jax.random.fold_in(jax.random.key(0), self._auto_step)
         return self._step(state, x, y, lr, key)
 
 

@@ -18,6 +18,8 @@ EXAMPLES = [
     "example/gluon/embedding_learning.py",
     "example/gluon/word_language_model.py",
     "example/distributed_training-horovod/train_mnist_hvd.py",
+    "example/gluon/lipnet.py",
+    "example/gluon/audio_classification.py",
 ]
 
 
@@ -35,3 +37,18 @@ def test_example_smoke(script):
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
     assert "done" in r.stdout or "rmse" in r.stdout \
         or "viterbi" in r.stdout or "accuracy" in r.stdout
+
+
+@pytest.mark.slow
+def test_pipeline_example_smoke():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "example/distributed_training/pipeline_mnist.py"),
+         "--cpu", "--steps", "8"],
+        capture_output=True, text=True, env=env, timeout=600, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "pipeline(" in r.stdout

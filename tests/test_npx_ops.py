@@ -408,3 +408,21 @@ def test_ctc_loss_simple():
     label = np.array([[1, 2]], dtype="float32")
     loss = npx.ctc_loss(np.array(logits), label).asnumpy()
     assert loss[0] < 1.0  # high-probability path → small loss
+
+
+def test_ctc_loss_gradient_finite():
+    """Regression: the alpha-recursion's where-masked logsumexp used to
+    produce inf in the untaken skip branch, whose VJP (inf * 0 = NaN)
+    poisoned every gradient — CTC training NaN'd on step one."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import ctc_loss
+
+    rng = onp.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(12, 4, 11).astype("float32"))
+    label = jnp.asarray(rng.randint(1, 11, size=(4, 4)).astype("float32"))
+    val = ctc_loss(logits, label)
+    assert bool(jnp.isfinite(val).all())
+    g = jax.grad(lambda d: ctc_loss(d, label).sum())(logits)
+    assert bool(jnp.isfinite(g).all()), "CTC gradient has NaN/inf"
+    assert float(jnp.abs(g).max()) > 0

@@ -221,11 +221,19 @@ def test_pipeline_trainer_batchnorm_stats_update():
     state = tr.init_state()
     tr.build_step(donate=False)
     rm_keys = [k for k in state["params"]["stages"] if "running_mean" in k]
+    w_keys = [k for k in state["params"]["stages"]
+              if k.endswith("weight") and "running" not in k]
     assert rm_keys, "BN running stats missing from pipeline state"
     rm_before = onp.asarray(state["params"]["stages"][rm_keys[0]])
+    w_before = onp.asarray(state["params"]["stages"][w_keys[0]])
     for i in range(3):
         state, loss = tr.step(state, x, y, key=jax.random.key(i))
     rm_after = onp.asarray(state["params"]["stages"][rm_keys[0]])
+    w_after = onp.asarray(state["params"]["stages"][w_keys[0]])
     assert not onp.allclose(rm_before, rm_after), \
         "BatchNorm running stats did not update through the pipeline"
+    # regression: the aux write-back must NOT clobber the gradient step —
+    # pipelined stage WEIGHTS must train, not just prologue/epilogue
+    assert not onp.allclose(w_before, w_after), \
+        "pipelined stage weights did not train (aux write-back clobber)"
     assert onp.isfinite(float(jax.device_get(loss)))
