@@ -38,6 +38,9 @@ BASELINES = {
     "lenet_imperative_imgs_per_sec": None,                 # no published ref
     "resnet50_infer_imgs_per_sec_per_chip": 1076.81,       # V100 bs=32 fp32
     "alexnet_infer_imgs_per_sec_per_chip": 7906.09,        # V100 bs=32 fp32
+    # int8 vs the V100 fp16 inference row (closest published precision-
+    # reduced baseline, perf.md:208)
+    "resnet50_int8_infer_imgs_per_sec_per_chip": 2085.51,
 }
 
 
@@ -167,6 +170,86 @@ def bench_resnet50(dtype="float32", batch=None, iters=None, warmup=None,
     return _best_window(window)
 
 
+def _foreach_throughput(block, batch, iters, in_shape):
+    """Throughput mode shared by the inference benches: drive the block
+    through ONE npx.foreach scan program per window (one dispatch + one
+    scalar fetch for the whole window).  Two DISTINCT data windows so
+    XLA cannot CSE them into a single pass."""
+    from mxnet_tpu import np as mxnp, npx
+    from mxnet_tpu.gluon import HybridBlock
+
+    class WindowInfer(HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, xs, s0):
+            def body(xb, s):
+                return self.inner(xb), s
+            outs, _ = npx.foreach(body, xs, s0)
+            # reduce on device: the window's sync then fetches one scalar
+            return outs.mean()
+
+    wrapped = WindowInfer(block)
+    wrapped.hybridize()
+    xs_list = [mxnp.random.uniform(size=(iters, batch) + tuple(in_shape))
+               for _ in range(2)]
+    s0 = mxnp.zeros((1,))
+    for xsb in xs_list:
+        float(wrapped(xsb, s0).mean())  # compile
+
+    def window():
+        t0 = time.perf_counter()
+        v = 0.0
+        for xsb in xs_list:
+            v = wrapped(xsb, s0)
+        v = float(v.mean())
+        dt = time.perf_counter() - t0
+        assert onp.isfinite(v)
+        return batch * iters * len(xs_list) / dt
+
+    return _best_window(window)
+
+
+def bench_int8_infer():
+    """INT8 ResNet-50 inference through the whole-graph quantizer
+    (contrib/quantization_graph.py: BN folding + chained int8 domains).
+    Reports throughput (foreach-scan window, like bench_infer) plus the
+    top-1 agreement vs the fp32 net on the same batch — the accuracy
+    column the reference's quantization example reports.
+
+    No MFU field: the int8 path runs at the MXU's int8 peak (~2x bf16),
+    so normalizing by the bf16 peak would mislead (even exceed 1.0)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.contrib.quantization_graph import quantize_net_graph
+
+    on_tpu = _on_tpu()
+    batch = 32 if on_tpu else 4
+    iters = 30 if on_tpu else 2
+
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000)  # NCHW: int8 conv kernel layout
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(batch, 3, 224, 224))
+    ref = net(x)
+
+    qnet = quantize_net_graph(net, calib_data=[x])
+    out = qnet(x)
+    agree = float((out.asnumpy().argmax(1)
+                   == ref.asnumpy().argmax(1)).mean())
+    n_q = int(qnet.quantized_ops)
+    assert n_q >= 100, "int8 spine did not form (%d quantized ops)" % n_q
+
+    thr = _foreach_throughput(qnet, batch, iters, (3, 224, 224))
+    return thr, {"top1_agreement_vs_fp32": round(agree, 3),
+                 "quantized_ops": n_q,
+                 "notes": "whole-graph int8 (BN folded; conv/relu/pool/"
+                          "add/fc chained int8); agreement on one "
+                          "random-init batch"}
+
+
 # ---------------------------------------------------------------------------
 # inference (BASELINE.md inference tables: V100 bs=32 fp32)
 # ---------------------------------------------------------------------------
@@ -186,9 +269,7 @@ def bench_infer(model_name):
       chip-representative number; a locally-attached TPU would put the
       latency mode in the same range."""
     import mxnet_tpu as mx
-    from mxnet_tpu import np as mxnp, npx
-    from mxnet_tpu.gluon import HybridBlock
-
+    from mxnet_tpu import np as mxnp
     from mxnet_tpu.gluon.model_zoo import vision as zoo
 
     on_tpu = _on_tpu()
@@ -214,42 +295,7 @@ def bench_infer(model_name):
 
     latency = _best_window(latency_window)
 
-    class WindowInfer(HybridBlock):
-        """One scan program over a window of batches (npx.foreach)."""
-
-        def __init__(self, inner):
-            super().__init__()
-            self.inner = inner
-
-        def forward(self, xs, s0):
-            def body(xb, s):
-                return self.inner(xb), s
-            outs, _ = npx.foreach(body, xs, s0)
-            # reduce on device: the window's sync then fetches one scalar
-            return outs.mean()
-
-    wrapped = WindowInfer(net)
-    wrapped.hybridize()
-    # two DISTINCT data windows: both scans land in one bulked program per
-    # window (one dispatch + one fetch for 2*iters batches) and XLA cannot
-    # CSE them into a single pass
-    xs_list = [mxnp.random.uniform(size=(iters, batch, 3, 224, 224))
-               for _ in range(2)]
-    s0 = mxnp.zeros((1,))
-    for xsb in xs_list:
-        float(wrapped(xsb, s0).mean())  # compile
-
-    def throughput_window():
-        t0 = time.perf_counter()
-        v = 0.0
-        for xsb in xs_list:
-            v = wrapped(xsb, s0)
-        v = float(v.mean())
-        dt = time.perf_counter() - t0
-        assert onp.isfinite(v)
-        return batch * iters * len(xs_list) / dt
-
-    throughput = _best_window(throughput_window)
+    throughput = _foreach_throughput(net, batch, iters, (3, 224, 224))
     # per-mode ratios are emitted alongside the headline so the
     # methodology mix is explicit: the V100 baseline was an
     # engine-pipelined loop on LOCAL hardware; through the bench tunnel
@@ -555,6 +601,8 @@ BENCHES = [
      lambda: bench_infer("resnet50_v1")),
     ("alexnet_infer", "alexnet_infer_imgs_per_sec_per_chip", "img/s",
      lambda: bench_infer("alexnet")),
+    ("resnet50_int8_infer", "resnet50_int8_infer_imgs_per_sec_per_chip",
+     "img/s", bench_int8_infer),
 ]
 
 
