@@ -69,3 +69,162 @@ def load(fname):
         return [array(data[k]) for k in keys]
     return {k: array(data[k]) for k in keys}
 from . import sparse  # noqa: F401  (mx.nd.sparse.*)
+
+
+# ---------------------------------------------------------------------------
+# legacy CamelCase eager ops (reference mx.nd op surface: explicit-weight
+# signatures, python/mxnet/ndarray/register.py-generated wrappers).  Each
+# maps onto the npx/np implementation the Gluon layers use — the same
+# kernels, the 1.x calling convention.
+# ---------------------------------------------------------------------------
+from . import numpy_extension as _npx  # noqa: E402
+from . import numpy as _np  # noqa: E402
+
+
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True, **kw):
+    return _npx.fully_connected(data, weight, bias, num_hidden=num_hidden,
+                                no_bias=no_bias or bias is None,
+                                flatten=flatten, **kw)
+
+
+def Convolution(data, weight, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=None, num_group=1,
+                no_bias=False, layout=None, **kw):
+    return _npx.convolution(data, weight, bias, kernel=kernel,
+                            stride=stride, dilate=dilate, pad=pad,
+                            num_filter=num_filter, num_group=num_group,
+                            no_bias=no_bias or bias is None, layout=layout,
+                            **kw)
+
+
+def Activation(data, act_type="relu", **kw):
+    return _npx.activation(data, act_type=act_type, **kw)
+
+
+def Pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
+            global_pool=False, **kw):
+    return _npx.pooling(data, kernel=kernel, pool_type=pool_type,
+                        stride=stride, pad=pad, global_pool=global_pool,
+                        **kw)
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              axis=1, **kw):
+    return _npx.batch_norm(data, gamma, beta, moving_mean, moving_var,
+                           eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                           use_global_stats=use_global_stats, axis=axis,
+                           **kw)
+
+
+def Embedding(data, weight, input_dim=None, output_dim=None, **kw):
+    return _npx.embedding(data, weight, input_dim=input_dim,
+                          output_dim=output_dim, **kw)
+
+
+def Flatten(data, **kw):
+    return _np.reshape(data, (data.shape[0], -1))
+
+
+def _legacy_reshape_shape(in_shape, spec, reverse=False):
+    """Resolve the 1.x Reshape special codes (reference
+    src/operator/tensor/matrix_op-inl.h InferReshapeShape):
+    0 copy input dim; -1 infer; -2 copy ALL remaining input dims;
+    -3 merge two consecutive input dims; -4 split a dim into the next
+    two spec values (one may be -1)."""
+    ishape = list(in_shape[::-1]) if reverse else list(in_shape)
+    spec = list(spec[::-1]) if reverse else list(spec)
+    out = []
+    i = 0   # position in ishape
+    j = 0   # position in spec
+    infer_at = None
+    while j < len(spec):
+        v = spec[j]
+        if v == 0:
+            out.append(ishape[i]); i += 1
+        elif v == -1:
+            infer_at = len(out); out.append(1)
+        elif v == -2:
+            out.extend(ishape[i:]); i = len(ishape)
+        elif v == -3:
+            out.append(ishape[i] * ishape[i + 1]); i += 2
+        elif v == -4:
+            a, b = spec[j + 1], spec[j + 2]
+            d = ishape[i]; i += 1
+            if a == -1:
+                a = d // b
+            if b == -1:
+                b = d // a
+            out.extend([a, b]); j += 2
+        else:
+            out.append(int(v)); i += 1
+        j += 1
+    if infer_at is not None:
+        known = 1
+        for k, v in enumerate(out):
+            if k != infer_at:
+                known *= v
+        total = 1
+        for v in in_shape:
+            total *= v
+        # NB: bare max() here would resolve to the star-imported np.max
+        import builtins as _bi
+        out[infer_at] = total // _bi.max(known, 1)
+    return tuple(out[::-1]) if reverse else tuple(out)
+
+
+def Reshape(data, shape=None, reverse=False, **kw):
+    if shape is None:
+        raise ValueError("Reshape requires shape=")
+    return _np.reshape(data,
+                       _legacy_reshape_shape(data.shape, shape, reverse))
+
+
+def Concat(*data, dim=1, **kw):
+    return _np.concatenate(list(data), axis=dim)
+
+
+def Dropout(data, p=0.5, **kw):
+    return _npx.dropout(data, p=p, **kw)
+
+
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25, **kw):
+    if act_type == "prelu":
+        return _npx.leaky_relu(data, gamma, act_type=act_type, **kw)
+    return _npx.leaky_relu(data, act_type=act_type, slope=slope, **kw)
+
+
+def SoftmaxActivation(data, mode="instance", **kw):
+    return _npx.softmax(data, axis=-1 if mode == "instance" else 1)
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0, **kw):
+    return _npx.sequence_mask(data, sequence_length,
+                              use_sequence_length=use_sequence_length,
+                              value=value, axis=axis, **kw)
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0, **kw):
+    return _npx.sequence_last(data, sequence_length,
+                              use_sequence_length=use_sequence_length,
+                              axis=axis, **kw)
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0, **kw):
+    return _npx.sequence_reverse(data, sequence_length,
+                                 use_sequence_length=use_sequence_length,
+                                 axis=axis, **kw)
+
+
+def SliceChannel(data, num_outputs=None, axis=1, squeeze_axis=False, **kw):
+    outs = _np.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [o.squeeze(axis=axis) for o in outs]
+    return outs
+
+
+split = SliceChannel  # legacy alias

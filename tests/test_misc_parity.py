@@ -181,3 +181,57 @@ def test_cosine_and_pairwise_distance_metrics():
     mpd = metric.MeanPairwiseDistance(p=2)
     mpd.update(mxnp.array(a), mxnp.array(b))
     assert mpd.get()[1] == pytest.approx(1.0)  # each row distance 1
+
+
+def test_nd_legacy_camelcase_ops():
+    """Legacy mx.nd CamelCase op surface (reference 1.x calling
+    convention: explicit weights)."""
+    import numpy as onp
+    from mxnet_tpu import nd
+
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.randn(2, 8).astype("float32"))
+    w = nd.array(rng.randn(4, 8).astype("float32"))
+    b = nd.zeros(4)
+    y = nd.FullyConnected(x, w, b, num_hidden=4)
+    onp.testing.assert_allclose(
+        y.asnumpy(), x.asnumpy() @ w.asnumpy().T + b.asnumpy(), rtol=1e-5)
+
+    img = nd.array(rng.randn(1, 3, 8, 8).astype("float32"))
+    k = nd.array(rng.randn(5, 3, 3, 3).astype("float32"))
+    c = nd.Convolution(img, k, kernel=(3, 3), num_filter=5, pad=(1, 1),
+                       no_bias=True)
+    assert c.shape == (1, 5, 8, 8)
+    assert nd.Activation(x, "tanh").shape == x.shape
+    assert nd.Pooling(img, kernel=(2, 2), stride=(2, 2)).shape == (1, 3, 4, 4)
+    assert nd.Flatten(img).shape == (1, 3 * 8 * 8)
+    assert nd.Concat(x, x, dim=1).shape == (2, 16)
+    outs = nd.SliceChannel(x, num_outputs=2, axis=1)
+    assert len(outs) == 2 and outs[0].shape == (2, 4)
+    # legacy split IS SliceChannel (axis=1 default), unlike np.split
+    outs2 = nd.split(x, num_outputs=2)
+    assert outs2[0].shape == (2, 4)
+    g, be = nd.ones(3), nd.zeros(3)
+    mm, mv = nd.zeros(3), nd.ones(3)
+    img3 = nd.array(rng.randn(2, 3, 4, 4).astype("float32"))
+    bn = nd.BatchNorm(img3, g, be, mm, mv, use_global_stats=True)
+    assert bn.shape == img3.shape
+
+
+def test_nd_legacy_reshape_codes():
+    """1.x Reshape special codes (reference matrix_op-inl.h
+    InferReshapeShape): 0 copy, -1 infer, -2 tail, -3 merge, -4 split."""
+    import numpy as onp
+    from mxnet_tpu import nd
+
+    x = nd.array(onp.arange(24, dtype="float32").reshape(2, 3, 4))
+    assert nd.Reshape(x, shape=(0, -1)).shape == (2, 12)
+    assert nd.Reshape(x, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.Reshape(x, shape=(-3, 0)).shape == (6, 4)
+    assert nd.Reshape(x, shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert nd.Reshape(x, shape=(0, 0, -1)).shape == (2, 3, 4)
+
+    g = nd.array(onp.full((3,), 0.1, dtype="float32"))
+    xx = nd.array(onp.array([[-1.0, 2.0, -3.0]], dtype="float32"))
+    out = nd.LeakyReLU(xx, g, act_type="prelu").asnumpy()
+    onp.testing.assert_allclose(out, [[-0.1, 2.0, -0.3]], rtol=1e-5)
