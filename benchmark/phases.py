@@ -69,6 +69,31 @@ def _peak():
         return None
 
 
+
+def _bf16_params(params):
+    """Cast float32 param values to bf16 (bench methodology for the
+    transformer/LSTM rows)."""
+    return {k: (p._data._data.astype(jnp.bfloat16)
+                if p._data._data.dtype == jnp.float32 else p._data._data)
+            for k, p in params.items()}
+
+
+SPEC_BW = 819e9  # v5e HBM bandwidth (bytes/s)
+
+
+def _roofline_bound(cost, t, peak):
+    """Adjudicate compute-/bandwidth-/latency-bound from XLA cost
+    analysis + measured time (shared by the per-model phase fns)."""
+    if not cost.get("bytes") or not peak or not t:
+        return None
+    cf = cost["flops"] / t / peak
+    cb = cost["bytes"] / t / SPEC_BW
+    return {"pct_compute_roofline": round(cf, 3),
+            "pct_bandwidth_roofline": round(cb, 3),
+            "bound": ("latency" if max(cf, cb) < 0.5 else
+                      ("compute" if cf > cb else "bandwidth"))}
+
+
 def resnet_phases(batch=256, dtype="bfloat16", layout="NCHW"):
     import mxnet_tpu as mx
     from mxnet_tpu import np as mxnp
@@ -158,7 +183,8 @@ def resnet_phases(batch=256, dtype="bfloat16", layout="NCHW"):
     model_flops = 3 * 8.2e9 * batch  # fwd+bwd+update convention
 
     # roofline adjudication: is the step compute- or bandwidth-bound?
-    SPEC_BW = 819e9  # v5e HBM bandwidth (bytes/s)
+    # (richer fields than _roofline_bound: achieved bandwidth matters
+    # for the resnet story)
     roofline = None
     if fwd_bwd_cost.get("bytes") and peak:
         by = fwd_bwd_cost["bytes"]
@@ -194,6 +220,61 @@ def resnet_phases(batch=256, dtype="bfloat16", layout="NCHW"):
     }
 
 
+def bert_phases(B=32, L=128):
+    """BERT-base bf16 fwd+bwd roofline adjudication (same harness as the
+    bench's config 3, flash attention on)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu.models.bert import bert_base
+    from mxnet_tpu.parallel import functionalize
+
+    mx.random.seed(0)
+    net = bert_base(max_length=max(L, 128))
+    net.initialize(mx.init.Xavier())
+    tokens = mxnp.random.randint(0, 30000, size=(B, L))
+    net(tokens)
+    fn, params = functionalize(net, train=True)
+    pvals = _bf16_params(params)
+    labels = jax.random.randint(jax.random.key(0), (B, L), 0, 30000)
+    tok = tokens._data
+
+    def loss_of(pv, i):
+        out, _aux = fn(pv, tok, key=jax.random.fold_in(jax.random.key(2), i))
+        # out = (mlm_logits (B, L, vocab), nsp_logits): train on the MLM
+        # head the model already carries — no synthetic head, so the
+        # compiled FLOPs match the 6ND model-FLOPs convention
+        mlm = out[0] if isinstance(out, (tuple, list)) else out
+        lp = jax.nn.log_softmax(mlm.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(lp, labels[..., None], -1))
+
+    K = 8
+
+    def chained(pv):
+        def body(i, carry):
+            l, g = jax.value_and_grad(loss_of)(carry, i)
+            return jax.tree.map(
+                lambda p, gg: p - 0.01 * gg.astype(p.dtype), carry, g)
+        out = jax.lax.fori_loop(0, K, body, pv)
+        return loss_of(out, K)
+
+    cj = jax.jit(chained)
+    fb_t = _wtime(lambda: cj(pvals), iters=1) / K
+    fb_cost = _cost(jax.jit(lambda pv: jax.value_and_grad(loss_of)(pv, 0)),
+                    pvals)
+    peak = _peak()
+    model_flops = (6 * 110e6 + (12 * L * 768 * 12 if L > 512 else 0)) * B * L
+    bound = _roofline_bound(fb_cost, fb_t, peak)
+    return {
+        "config": {"model": "bert_base", "B": B, "L": L,
+                   "dtype": "bfloat16"},
+        "roofline": bound,
+        "phases": {"fwd_bwd": {"ms": round(fb_t * 1e3, 2), **fb_cost,
+                               "mfu_model": (round(model_flops / fb_t / peak,
+                                                   4) if peak else None)}},
+        "tokens_per_sec_fwd_bwd": round(B * L / fb_t, 1),
+    }
+
+
 def lstm_phases(B=32, T=35):
     import mxnet_tpu as mx
     from mxnet_tpu import np as mxnp
@@ -219,9 +300,7 @@ def lstm_phases(B=32, T=35):
     tokens = mxnp.random.randint(0, vocab, size=(B, T))
     net(tokens)
     fn, params = functionalize(net, train=True)
-    pvals = {k: (p._data._data.astype(jnp.bfloat16)
-                 if p._data._data.dtype == jnp.float32 else p._data._data)
-             for k, p in params.items()}
+    pvals = _bf16_params(params)
     labels = jax.random.randint(jax.random.key(0), (B, T), 0, vocab)
     tok = tokens._data
 
@@ -274,14 +353,7 @@ def lstm_phases(B=32, T=35):
     # step is LATENCY-bound on the ~70 serial scan iterations (fwd+bwd)
     # of small (B=32) cells.  This is inherent to the reference workload
     # shape (bptt=35, bs=32), not schedulable work.
-    bound = None
-    if fb_cost.get("bytes") and peak:
-        cf = fb_cost["flops"] / fb_t / peak
-        cb = fb_cost["bytes"] / fb_t / 819e9
-        bound = {"pct_compute_roofline": round(cf, 3),
-                 "pct_bandwidth_roofline": round(cb, 3),
-                 "bound": ("latency" if max(cf, cb) < 0.5 else
-                           ("compute" if cf > cb else "bandwidth"))}
+    bound = _roofline_bound(fb_cost, fb_t, peak)
     return {
         "config": {"model": "lstm_lm_2x650", "B": B, "T": T,
                    "dtype": "bfloat16"},
@@ -302,7 +374,8 @@ def main():
     ap.add_argument("--json", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "PHASES.json"))
     ap.add_argument("--only", default=None,
-                    choices=[None, "resnet", "resnet_nhwc", "lstm"])
+                    choices=[None, "resnet", "resnet_nhwc", "lstm",
+                             "bert"])
     args = ap.parse_args()
     out = {}
     if args.only in (None, "resnet"):
@@ -314,6 +387,9 @@ def main():
     if args.only in (None, "lstm"):
         out["lstm_lm"] = lstm_phases()
         print(json.dumps(out["lstm_lm"], indent=1), flush=True)
+    if args.only in (None, "bert"):
+        out["bert_base"] = bert_phases()
+        print(json.dumps(out["bert_base"], indent=1), flush=True)
     with open(args.json, "w") as f:
         json.dump(out, f, indent=1)
     print("wrote", args.json)
