@@ -364,3 +364,30 @@ def test_byteps_batched_keys_via_trainer_multiworker():
     loss.backward()
     trainer.step(4)
     assert not onp.allclose(before, net.weight.data().asnumpy())
+
+
+def test_rec2idx_tool(tmp_path):
+    """tools/rec2idx.py builds an index enabling random access
+    (reference tools/rec2idx.py IndexCreator)."""
+    from mxnet_tpu.recordio import MXRecordIO, MXIndexedRecordIO
+
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = MXRecordIO(rec, "w")
+    payloads = [b"rec-%d" % i * (i + 1) for i in range(7)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable,
+                        os.path.join(repo, "tools", "rec2idx.py"),
+                        rec, idx], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "7 records" in r.stdout
+
+    ir = MXIndexedRecordIO(idx, rec, "r")
+    assert ir.read_idx(5) == payloads[5]
+    assert ir.read_idx(0) == payloads[0]
+    ir.close()

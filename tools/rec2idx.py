@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Create an .idx index for an existing .rec file (parity:
+tools/rec2idx.py — IndexCreator over MXRecordIO: walk the record
+stream, emit `key\\tbyte_offset` per record so MXIndexedRecordIO can
+random-access it).
+
+Usage:  python tools/rec2idx.py data.rec data.idx [--key-type int]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.recordio import MXRecordIO
+
+
+def create_index(rec_path, idx_path):
+    """Walk the .rec stream and write key→offset lines (reference
+    IndexCreator.create_index).  Keys are the sequential record number
+    as text — the dtype only matters when READING the index
+    (MXIndexedRecordIO's key_type), not when writing it."""
+    reader = MXRecordIO(rec_path, "r")
+    counter = 0
+    with open(idx_path, "w") as f:
+        while True:
+            pos = reader.tell()
+            item = reader.read()
+            if item is None:
+                break
+            f.write("%d\t%d\n" % (counter, pos))
+            counter += 1
+    reader.close()
+    return counter
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Create an index file for a RecordIO .rec")
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", help="path of the .idx to write")
+    args = ap.parse_args()
+    n = create_index(args.record, args.index)
+    print("wrote %s: %d records" % (args.index, n))
+
+
+if __name__ == "__main__":
+    main()
