@@ -4,7 +4,7 @@ tools/rec2idx.py — IndexCreator over MXRecordIO: walk the record
 stream, emit `key\\tbyte_offset` per record so MXIndexedRecordIO can
 random-access it).
 
-Usage:  python tools/rec2idx.py data.rec data.idx [--key-type int]
+Usage:  python tools/rec2idx.py data.rec data.idx
 """
 from __future__ import annotations
 
