@@ -41,6 +41,10 @@ BASELINES = {
     # int8 vs the V100 fp16 inference row (closest published precision-
     # reduced baseline, perf.md:208)
     "resnet50_int8_infer_imgs_per_sec_per_chip": 2085.51,
+    # serving compares against the same V100 bs=32 fp32 inference loop:
+    # the serving stack's job is to reach the offline number under
+    # concurrent single-item clients
+    "resnet50_serving_imgs_per_sec_per_chip": 1076.81,
 }
 
 
@@ -65,6 +69,7 @@ FLOPS_PER_ITEM = {
     "lstm_lm_train_tokens_per_sec_per_chip": 6 * 13.3e6,
     "resnet50_infer_imgs_per_sec_per_chip": 8.2e9,
     "alexnet_infer_imgs_per_sec_per_chip": 1.43e9,
+    "resnet50_serving_imgs_per_sec_per_chip": 8.2e9,
 }
 
 
@@ -314,6 +319,93 @@ def bench_infer(model_name):
                  "AlexNet bs=32 fwd vs 0.23ms chip roofline); throughput "
                  "mode = one foreach scan program per window, "
                  "chip-representative",
+    }
+
+
+# ---------------------------------------------------------------------------
+# serving: ResNet-50 through mxnet_tpu.serving (registry + dynamic batcher)
+# ---------------------------------------------------------------------------
+def bench_serving():
+    """Steady-state serving throughput + tail latency: concurrent
+    closed-loop clients submit SINGLE images to the dynamic batcher,
+    which coalesces them into bucket-padded batches (one pre-compiled
+    XLA program per bucket).  Reports img/s plus the latency percentiles
+    and batch-occupancy the offline `resnet50_infer` loop can't see.
+
+    In-process submission (no HTTP): the wire JSON codec would measure
+    the frontend, not the serving stack — HTTP semantics are identical
+    by construction (the frontend is a thin shim over the same batcher,
+    tests/test_serving.py covers the round trip)."""
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    on_tpu = _on_tpu()
+    clients = 16 if on_tpu else 4
+    per_client = 50 if on_tpu else 3
+    max_batch = 32 if on_tpu else 4
+    item_shape = (3, 224, 224)
+
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net(mxnp.zeros((1,) + item_shape))  # finalize deferred shapes
+
+    registry = serving.ModelRegistry()
+    # warmup=True pre-compiles every batch bucket at load time
+    registry.load("resnet50", net, item_shape=item_shape,
+                  max_batch_size=max_batch,
+                  buckets=(max_batch // 4, max_batch // 2, max_batch))
+    batcher = serving.DynamicBatcher(
+        registry, flush_ms=(5.0 if on_tpu else 50.0),
+        max_queue_depth=4 * clients * max_batch)
+
+    rng = onp.random.RandomState(0)
+    items = [rng.rand(*item_shape).astype("float32")
+             for _ in range(clients)]
+
+    def window():
+        errors = []
+        barrier = threading.Barrier(clients)
+
+        def client(cid):
+            try:
+                barrier.wait()
+                for _ in range(per_client):
+                    out = batcher.submit(
+                        "resnet50", items[cid]).result(timeout=600)
+                    assert out.shape == (1000,)
+            except Exception as e:  # pragma: no cover
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(1200)
+        dt = time.perf_counter() - t0
+        assert not errors, errors[:3]
+        return clients * per_client / dt
+
+    thr = _best_window(window, n=2)
+    snap = batcher.metrics.snapshot()["models"]["resnet50"]
+    batcher.stop()
+    return thr, {
+        "clients": clients,
+        "batch_occupancy": snap["batch_occupancy"],
+        "latency_p50_ms": snap["total"].get("p50_ms"),
+        "latency_p95_ms": snap["total"].get("p95_ms"),
+        "latency_p99_ms": snap["total"].get("p99_ms"),
+        "queue_wait_p95_ms": snap["queue_wait"].get("p95_ms"),
+        "device_p50_ms": snap["device"].get("p50_ms"),
+        "notes": "closed-loop concurrent clients, single-image submits "
+                 "coalesced by the dynamic batcher into bucket-padded "
+                 "XLA programs; latency = submit-to-response",
     }
 
 
@@ -603,6 +695,8 @@ BENCHES = [
      lambda: bench_infer("alexnet")),
     ("resnet50_int8_infer", "resnet50_int8_infer_imgs_per_sec_per_chip",
      "img/s", bench_int8_infer),
+    ("resnet50_serving", "resnet50_serving_imgs_per_sec_per_chip", "img/s",
+     bench_serving),
 ]
 
 

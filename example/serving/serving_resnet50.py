@@ -1,0 +1,117 @@
+"""Serve ResNet-50 with mxnet_tpu.serving: model registry + dynamic
+batcher + HTTP frontend, driven by concurrent HTTP clients.
+
+What this demonstrates (the serving half of tests/test_serving.py, as a
+runnable deployment shape):
+
+1. load a hybridized model into the ``ModelRegistry`` — every batch
+   bucket pre-compiles at load time, so no client pays a compile;
+2. start the ``ModelServer`` HTTP frontend on an ephemeral port;
+3. hammer it with concurrent ``ServingClient`` threads submitting small
+   batches — the dynamic batcher coalesces them into bucket-padded XLA
+   programs;
+4. scrape the stats snapshot: batch occupancy + p50/p95/p99 queue-wait
+   and end-to-end latency.
+
+Run::
+
+    python example/serving/serving_resnet50.py            # full: 224x224
+    python example/serving/serving_resnet50.py --smoke    # CI: 64x64
+"""
+import argparse
+import threading
+import time
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import serving
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="small inputs / few requests (CI lane)")
+    ap.add_argument("--clients", type=int, default=None)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per client")
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--flush-ms", type=float, default=10.0)
+    args = ap.parse_args()
+
+    side = 64 if args.smoke else 224
+    clients = args.clients or (2 if args.smoke else 8)
+    requests = args.requests or (3 if args.smoke else 20)
+    max_batch = args.max_batch or (4 if args.smoke else 16)
+    item_shape = (3, side, side)
+
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net(mxnp.zeros((1,) + item_shape))  # finalize deferred shapes
+
+    registry = serving.ModelRegistry()
+    t0 = time.perf_counter()
+    served = registry.load("resnet50", net, item_shape=item_shape,
+                           max_batch_size=max_batch)
+    print("loaded resnet50 v%d, %d buckets %s pre-compiled in %.1fs"
+          % (served.version, len(served.buckets), served.buckets,
+             time.perf_counter() - t0))
+
+    with serving.ModelServer(registry, flush_ms=args.flush_ms,
+                             max_queue_depth=8 * clients) as srv:
+        host, port = srv.address
+        print("serving on http://%s:%d  (try GET /v1/models, /v1/stats)"
+              % (host, port))
+
+        errors = []
+        barrier = threading.Barrier(clients)
+
+        def client_loop(cid):
+            rng = onp.random.RandomState(cid)
+            cli = serving.ServingClient(host, port, timeout=600)
+            try:
+                barrier.wait()
+                for _ in range(requests):
+                    x = rng.rand(1, *item_shape).astype("float32")
+                    preds = cli.predict("resnet50", x)
+                    assert preds.shape == (1, 1000)
+            except Exception as e:  # pragma: no cover
+                errors.append(repr(e))
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=client_loop, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            raise SystemExit("client errors: %s" % errors[:3])
+
+        n = clients * requests
+        print("%d requests from %d concurrent clients in %.2fs "
+              "(%.1f img/s end-to-end over HTTP)" % (n, clients, dt, n / dt))
+
+        stats = serving.ServingClient(host, port).stats()
+        m = stats["models"]["resnet50"]
+        print("batch occupancy: %s  (batches: %d for %d items)"
+              % (m["batch_occupancy"], m["counters"]["batches_total"],
+                 m["counters"]["items_total"]))
+        print("queue wait  p50/p95/p99 ms: %s / %s / %s"
+              % (m["queue_wait"].get("p50_ms"), m["queue_wait"].get("p95_ms"),
+                 m["queue_wait"].get("p99_ms")))
+        print("end-to-end  p50/p95/p99 ms: %s / %s / %s"
+              % (m["total"].get("p50_ms"), m["total"].get("p95_ms"),
+                 m["total"].get("p99_ms")))
+        # graceful drain happens in ModelServer.stop() on context exit
+    print("serving done")
+
+
+if __name__ == "__main__":
+    main()

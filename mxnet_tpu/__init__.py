@@ -80,6 +80,7 @@ from . import config  # typed MXNET_* knob registry
 from . import graph_pass  # nnvm-pass-registry analog over the sym DAG
 from . import resource  # kTempSpace / kParallelRandom analog
 from . import storage  # pooled host arena API
+from . import serving  # dynamic-batching inference service
 config.check_env()  # warn on unknown/inert MXNET_* vars, don't ignore them
 
 

@@ -35,6 +35,16 @@ from ..ndarray import apply_op, _wrap_value, ndarray
 _INT8_MAX = 127.0
 
 
+def _conv_tup(attrs, key, default, ndim=2):
+    """Conv spatial attr with the op's default (stride=1, pad=0,
+    dilate=1 — see ops/nn.py:convolution): missing or None falls back,
+    scalars broadcast to the 2D spatial tuple."""
+    v = attrs.get(key)
+    if v is None:
+        v = default
+    return (v,) * ndim if isinstance(v, int) else tuple(v)
+
+
 def _sym_mod():
     from .. import sym_api
     return sym_api
@@ -286,10 +296,14 @@ class QuantizedGraphBlock(HybridBlock):
             if not attrs.get("no_bias", False) and len(node._inputs) > 2:
                 bias = self._to_f(walk(node._inputs[2]))
             if op == "npx:convolution":
+                # traced convs may omit stride/pad/dilate entirely (a
+                # direct npx.convolution call records only the kwargs it
+                # was given): apply the op defaults, same as ops/nn.py
                 acc = lax.conv_general_dilated(
-                    qx, qw, window_strides=tuple(attrs["stride"]),
-                    padding=[(p, p) for p in attrs["pad"]],
-                    rhs_dilation=tuple(attrs.get("dilate", (1, 1))),
+                    qx, qw, window_strides=_conv_tup(attrs, "stride", 1),
+                    padding=[(p, p)
+                             for p in _conv_tup(attrs, "pad", 0)],
+                    rhs_dilation=_conv_tup(attrs, "dilate", 1),
                     feature_group_count=attrs.get("num_group", 1),
                     dimension_numbers=("NCHW", "OIHW", "NCHW"),
                     preferred_element_type=jnp.int32)

@@ -144,7 +144,10 @@ def _legacy_reshape_shape(in_shape, spec, reverse=False):
         if v == 0:
             out.append(ishape[i]); i += 1
         elif v == -1:
-            infer_at = len(out); out.append(1)
+            # -1 still consumes one input dim (reference
+            # matrix_op-inl.h:114 does src_idx++): a later 0 must copy
+            # the NEXT input dim, e.g. (-1, 0) on (2,3) -> (2,3)
+            infer_at = len(out); out.append(1); i += 1
         elif v == -2:
             out.extend(ishape[i:]); i = len(ishape)
         elif v == -3:

@@ -18,7 +18,34 @@ path instead of silently coercing through ``__array__``.
 """
 from __future__ import annotations
 
+import re
+import warnings
+
 import numpy as onp
+
+# Call-binding TypeError shapes (CPython's "cannot bind these arguments"
+# messages).  Only these divert a ufunc call to the host fallback: an mx
+# implementation exists but doesn't accept this calling convention (e.g.
+# numpy-protocol kwargs like casting=/order= that XLA ops don't take).
+# Any other TypeError is a genuine user argument error and must surface
+# instead of silently moving the work to host NumPy.
+_SIG_MISMATCH = re.compile(
+    r"unexpected keyword argument|positional argument|"
+    r"got multiple values for|missing \d+ required")
+
+_FALLBACK_WARNED = set()
+
+
+def _warn_ufunc_fallback(name, reason):
+    """One-time (per ufunc name, per process) host-fallback warning."""
+    if name in _FALLBACK_WARNED:
+        return
+    _FALLBACK_WARNED.add(name)
+    warnings.warn(
+        "numpy.%s on mxnet_tpu arrays fell back to host NumPy (mx.np.%s "
+        "rejected the call signature: %s); the computation ran on host "
+        "copies, not on device" % (name, name, reason),
+        RuntimeWarning, stacklevel=4)
 
 # Functions mx.np does not implement but real NumPy may run on host copies
 # (reference numpy/fallback.py:25 allow-list, minus entries whose semantics
@@ -124,8 +151,14 @@ def array_ufunc(self, ufunc, method, *inputs, **kwargs):
         if callable(target):
             try:
                 res = target(*inputs, **kwargs)
-            except TypeError:
-                res = None  # signature mismatch: fall back below
+            except TypeError as e:
+                # host fallback ONLY for signature mismatch (mx op exists
+                # but doesn't take this calling convention); genuine user
+                # argument errors re-raise instead of running on host
+                if not _SIG_MISMATCH.search(str(e)):
+                    raise
+                _warn_ufunc_fallback(name, e)
+                res = None  # fall back below
         else:
             res = None
         if res is None:

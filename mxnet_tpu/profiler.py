@@ -47,6 +47,14 @@ def record_op_stat(name, dur_s):
                 st[3] = dur_s
 
 
+def record_counter(name, **values):
+    """Public counter hook for subsystems (serving queue depth / batch
+    occupancy, cache hit rates, ...): emits one chrome-trace counter
+    sample when a trace is recording, else is a no-op."""
+    if _STATE["running"]:
+        _emit(name, "counter", "C", time.time(), dict(values))
+
+
 def record_memory_stat(name, value):
     with _AGG["lock"]:
         st = _AGG["memory"].get(name)

@@ -1,0 +1,47 @@
+"""mxnet_tpu.serving — dynamic-batching inference service.
+
+The inference half of the north star: turns hybridized ``HybridBlock``s
+and exported symbol checkpoints into a served endpoint with request
+batching, admission control, and latency telemetry.
+
+Layers (each usable on its own):
+
+- ``ModelRegistry`` (``registry.py``) — load/version/hot-swap models;
+  per-batch-bucket XLA precompile at load time.
+- ``DynamicBatcher`` (``batcher.py``) — per-model queues, size-or-timeout
+  flush, shape-bucketed coalescing, futures fan-out, load shedding,
+  deadlines, graceful drain, poisoned-request isolation.
+- ``ServingMetrics`` (``metrics.py``) — per-model counters + p50/p95/p99
+  histograms (queue wait vs device time, batch occupancy), exported
+  through ``mxnet_tpu.profiler`` and as a scrapeable snapshot.
+- ``ModelServer`` / ``ServingClient`` (``server.py`` / ``client.py``) —
+  thin HTTP frontend + stdlib client.
+
+Quick start::
+
+    import mxnet_tpu as mx
+    reg = mx.serving.ModelRegistry()
+    reg.load("resnet", net, item_shape=(3, 224, 224), max_batch_size=32)
+    with mx.serving.ModelServer(reg, flush_ms=5) as srv:
+        cli = mx.serving.ServingClient(*srv.address)
+        preds = cli.predict("resnet", batch_np)
+        print(cli.stats())
+"""
+from __future__ import annotations
+
+from .errors import (BadRequestError, DeadlineExceededError,
+                     ModelNotFoundError, QueueFullError, ServerClosedError,
+                     ServingError)
+from .metrics import LatencyHistogram, ModelMetrics, ServingMetrics
+from .registry import ModelRegistry, ServedModel, default_buckets
+from .batcher import DynamicBatcher
+from .server import ModelServer
+from .client import ServingClient
+
+__all__ = [
+    "ServingError", "BadRequestError", "ModelNotFoundError",
+    "QueueFullError", "ServerClosedError", "DeadlineExceededError",
+    "ServingMetrics", "ModelMetrics", "LatencyHistogram",
+    "ModelRegistry", "ServedModel", "default_buckets",
+    "DynamicBatcher", "ModelServer", "ServingClient",
+]

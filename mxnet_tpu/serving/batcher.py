@@ -1,0 +1,252 @@
+"""Dynamic batcher: per-model request queues with coalescing dispatch.
+
+Request lifecycle:
+
+- ``submit()`` validates the item against the served model's signature
+  and enqueues it.  Admission control is synchronous: a full queue sheds
+  the request with ``QueueFullError`` (fast-fail 503) instead of letting
+  latency grow without bound; a draining batcher rejects with
+  ``ServerClosedError``.
+- One worker thread per model coalesces requests that share a shape
+  bucket key ``(pinned_version, item_shape, dtype)``, flushing a batch
+  when it reaches the model's max batch size OR when the oldest request
+  has waited ``flush_ms`` — the classic size-or-timeout policy
+  (Clipper / TF-Serving style) that trades a bounded latency floor for
+  hardware-limited throughput.
+- The batch is padded to the model's enclosing batch bucket (one
+  pre-compiled XLA program per bucket, see ``registry.py``) and results
+  are fanned back out to per-request futures.
+
+Failure isolation reuses the engine's exception-transport semantics
+(``mxnet_tpu/engine.py``: an async op's exception poisons its own output
+vars and rethrows at the sync point, never killing the worker): a batch
+that raises is re-executed per request so ONLY the poisoned request's
+future carries the exception; every other request in the batch still
+gets its result, and the worker thread keeps serving.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as onp
+
+from .errors import DeadlineExceededError, QueueFullError, ServerClosedError
+from .metrics import ServingMetrics
+
+__all__ = ["DynamicBatcher"]
+
+
+class _Request:
+    __slots__ = ("item", "future", "t_enqueue", "deadline", "version")
+
+    def __init__(self, item, version, deadline):
+        self.item = item
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.deadline = deadline  # absolute perf_counter time or None
+        self.version = version    # pinned version or None (= latest)
+
+    def expired(self, now):
+        return self.deadline is not None and now > self.deadline
+
+
+class DynamicBatcher:
+    """Coalesce concurrent single-item requests into bucketed batches.
+
+    Knobs:
+      flush_ms        — max time the oldest queued request waits for the
+                        batch to fill before a partial batch dispatches.
+      max_queue_depth — per-model bound on queued requests; admission
+                        beyond it sheds with ``QueueFullError``.
+      max_batch_size  — per-model cap (defaults to the served model's
+                        largest bucket; the smaller of the two wins).
+    """
+
+    def __init__(self, registry, *, flush_ms=5.0, max_queue_depth=256,
+                 max_batch_size=None, metrics=None):
+        self.registry = registry
+        self.flush_s = float(flush_ms) / 1e3
+        self.max_queue_depth = int(max_queue_depth)
+        self._max_batch_override = max_batch_size
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues = {}   # model -> {key: deque[_Request]}
+        self._depth = {}    # model -> queued request count
+        self._workers = {}  # model -> Thread
+        self._stopping = False
+
+    # -- admission --------------------------------------------------------
+    def submit(self, model, item, *, version=None, deadline_ms=None):
+        """Enqueue one item; returns a ``concurrent.futures.Future`` that
+        resolves to the model output for this item (the exception
+        transport: a failed/shed/expired request rethrows at
+        ``future.result()``)."""
+        served = self.registry.get(model, version)  # ModelNotFound early
+        arr = served.check_item(item)               # BadRequest early
+        self.metrics.count(model, "requests_total")
+        deadline = (time.perf_counter() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(arr, version, deadline)
+        key = (version, tuple(arr.shape), str(arr.dtype))
+        with self._cond:
+            if self._stopping:
+                self.metrics.count(model, "shed_total")
+                raise ServerClosedError(
+                    "batcher is draining; not accepting new requests")
+            depth = self._depth.get(model, 0)
+            if depth >= self.max_queue_depth:
+                self.metrics.count(model, "shed_total")
+                raise QueueFullError(
+                    "model %r queue full (%d queued >= max_queue_depth=%d)"
+                    % (model, depth, self.max_queue_depth))
+            self._queues.setdefault(model, {}).setdefault(
+                key, collections.deque()).append(req)
+            self._depth[model] = depth + 1
+            if model not in self._workers:
+                t = threading.Thread(target=self._worker, args=(model,),
+                                     name="mxtpu-serving-%s" % model,
+                                     daemon=True)
+                self._workers[model] = t
+                t.start()
+            self._cond.notify_all()
+        self.metrics.observe_queue_depth(model, depth + 1)
+        return req.future
+
+    def queue_depth(self, model):
+        with self._lock:
+            return self._depth.get(model, 0)
+
+    # -- worker -----------------------------------------------------------
+    def _max_batch(self, served):
+        if self._max_batch_override is not None:
+            return min(int(self._max_batch_override), served.max_batch_size)
+        return served.max_batch_size
+
+    def _worker(self, model):
+        while True:
+            batch = self._collect(model)
+            if batch is None:
+                return  # stopped and drained
+            if batch:
+                self._execute(model, batch)
+
+    def _collect(self, model):
+        """Block until a batch is ready for ``model``; pop and return it.
+        Returns None when the batcher is stopping and the queue is empty,
+        [] when a wait loop ended with nothing dispatchable (retry)."""
+        with self._cond:
+            while True:
+                queues = self._queues.get(model) or {}
+                if queues:
+                    break
+                if self._stopping:
+                    return None
+                self._cond.wait()
+            # serve the shape key whose head request is oldest (FIFO
+            # across buckets at the granularity of batches)
+            key = min(queues, key=lambda k: queues[k][0].t_enqueue)
+            q = queues[key]
+            try:
+                served = self.registry.get(model, key[0])
+            except Exception as e:
+                # model unloaded with requests still queued: poison them
+                for r in q:
+                    r.future.set_exception(e)
+                self._depth[model] -= len(q)
+                del queues[key]
+                return []
+            target = self._max_batch(served)
+            # size-or-timeout flush: wait for the batch to fill until the
+            # oldest request has aged flush_s
+            while (len(q) < target and not self._stopping):
+                remaining = q[0].t_enqueue + self.flush_s - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            n = min(len(q), target)
+            batch = [q.popleft() for _ in range(n)]
+            if not q:
+                del queues[key]
+            self._depth[model] -= n
+            self._cond.notify_all()
+        return batch
+
+    def _execute(self, model, batch):
+        now = time.perf_counter()
+        live = []
+        for r in batch:
+            if r.expired(now):
+                self.metrics.count(model, "deadline_expired_total")
+                r.future.set_exception(DeadlineExceededError(
+                    "request expired after %.1f ms in queue (deadline)"
+                    % ((now - r.t_enqueue) * 1e3)))
+            elif r.future.set_running_or_notify_cancel():
+                live.append(r)
+        if not live:
+            return
+        try:
+            served = self.registry.get(model, live[0].version)
+        except Exception as e:
+            for r in live:
+                r.future.set_exception(e)
+            return
+        t_dispatch = time.perf_counter()
+        stacked = onp.stack([r.item for r in live], axis=0)
+        try:
+            out, bucket, device_s = served.run_batch(stacked)
+            self.metrics.observe_batch(model, len(live), bucket, device_s)
+            done = time.perf_counter()
+            for i, r in enumerate(live):
+                self.metrics.observe_request(
+                    model, t_dispatch - r.t_enqueue, done - r.t_enqueue)
+                r.future.set_result(out[i])
+        except Exception:
+            # poisoned-request isolation: one bad input must not take the
+            # batch (or the worker) down — re-run each request alone so
+            # the exception poisons only its own future (engine.py's
+            # poison-and-rethrow-at-sync contract)
+            for r in live:
+                try:
+                    out, bucket, device_s = served.run_batch(
+                        r.item[None, ...])
+                    self.metrics.observe_batch(model, 1, bucket, device_s)
+                    done = time.perf_counter()
+                    self.metrics.observe_request(
+                        model, t_dispatch - r.t_enqueue, done - r.t_enqueue)
+                    r.future.set_result(out[0])
+                except Exception as e:
+                    self.metrics.count(model, "errors_total")
+                    r.future.set_exception(e)
+
+    # -- shutdown ---------------------------------------------------------
+    def drain(self, timeout=30.0):
+        """Stop admissions, serve everything queued, join the workers."""
+        return self.stop(drain=True, timeout=timeout)
+
+    def stop(self, drain=True, timeout=30.0):
+        """Graceful (drain=True: queued requests complete) or immediate
+        (drain=False: queued requests fail with ServerClosedError) stop.
+        Returns True when every worker exited within the timeout."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                for model, queues in self._queues.items():
+                    for q in queues.values():
+                        for r in q:
+                            self._depth[model] -= 1
+                            r.future.set_exception(ServerClosedError(
+                                "batcher stopped before this request ran"))
+                        q.clear()
+                self._queues.clear()
+            self._cond.notify_all()
+            workers = list(self._workers.values())
+        deadline = time.monotonic() + timeout
+        ok = True
+        for t in workers:
+            t.join(max(0.0, deadline - time.monotonic()))
+            ok = ok and not t.is_alive()
+        return ok

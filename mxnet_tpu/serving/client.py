@@ -1,0 +1,107 @@
+"""Python client for the serving HTTP frontend.
+
+Stdlib-only (http.client): one persistent connection per client object,
+JSON request/response, server error codes rehydrated into the same
+exception classes the in-process API raises (``QueueFullError`` on shed,
+``DeadlineExceededError`` on expiry, ...), so calling code is identical
+whether it talks to the batcher directly or over the wire.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+
+import numpy as onp
+
+from .errors import ServingError, error_for_code
+
+__all__ = ["ServingClient"]
+
+
+class ServingClient:
+    def __init__(self, host="127.0.0.1", port=8080, timeout=30.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = float(timeout)
+        self._conn = None
+
+    # -- plumbing ---------------------------------------------------------
+    def _connection(self):
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    def _request(self, method, path, body=None):
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        try:
+            conn = self._connection()
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except (ConnectionError, http.client.HTTPException, OSError):
+            # one reconnect: the server may have closed an idle keep-alive
+            self.close()
+            conn = self._connection()
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        try:
+            doc = json.loads(data.decode() or "{}")
+        except ValueError:
+            doc = {"error": data.decode(errors="replace"), "code": "internal"}
+        if resp.status >= 400:
+            raise error_for_code(doc.get("code", "internal"),
+                                 doc.get("error", "HTTP %d" % resp.status))
+        return doc
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- API --------------------------------------------------------------
+    def predict(self, model, data, version=None, deadline_ms=None):
+        """Run inference on a BATCH: ``data`` is a list of instances or
+        an array whose leading axis is the batch (each instance must have
+        the model's item shape — wrap a single item in a length-1 list).
+        Returns a numpy array with the batch axis first."""
+        if isinstance(data, (list, tuple)):
+            instances = [onp.asarray(d).tolist() for d in data]
+        else:
+            arr = onp.asarray(data)
+            if arr.ndim == 0:
+                raise ServingError("scalar input has no batch axis")
+            instances = [row.tolist() for row in arr]
+        path = ("/v1/models/%s:predict" % model if version is None
+                else "/v1/models/%s/versions/%d:predict" % (model, version))
+        body = {"instances": instances}
+        if deadline_ms is not None:
+            body["deadline_ms"] = float(deadline_ms)
+        doc = self._request("POST", path, body)
+        return onp.asarray(doc["predictions"])
+
+    def models(self):
+        return self._request("GET", "/v1/models")["models"]
+
+    def model(self, name):
+        return self._request("GET", "/v1/models/%s" % name)
+
+    def stats(self):
+        """The scrapeable metrics snapshot (counters, batch occupancy,
+        p50/p95/p99 queue-wait & service latencies)."""
+        return self._request("GET", "/v1/stats")
+
+    def metrics_text(self):
+        """Prometheus exposition text."""
+        return self._request("GET", "/metrics")["text"]

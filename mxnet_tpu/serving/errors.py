@@ -1,0 +1,63 @@
+"""Serving-layer error taxonomy.
+
+Every error carries an ``http_status`` (the frontend maps it 1:1 onto the
+response code) and a stable ``code`` string (the client maps it back to
+the same exception class on the other side of the wire).
+
+Transport semantics mirror the engine's exception contract
+(``mxnet_tpu/engine.py``: a failed async op poisons its output var and
+rethrows at the sync point): a failed request poisons ONLY its own
+future and rethrows at ``future.result()`` — the batcher worker survives
+and keeps serving.
+"""
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class for all mxnet_tpu.serving errors."""
+    http_status = 500
+    code = "internal"
+
+
+class BadRequestError(ServingError):
+    """Malformed request payload (shape/dtype/JSON)."""
+    http_status = 400
+    code = "bad_request"
+
+
+class ModelNotFoundError(ServingError):
+    """Unknown model name or version in the registry."""
+    http_status = 404
+    code = "model_not_found"
+
+
+class QueueFullError(ServingError):
+    """Load shed: the model's request queue is at max depth.  Raised
+    synchronously at submit() — fast-fail 503, never unbounded latency."""
+    http_status = 503
+    code = "queue_full"
+
+
+class ServerClosedError(ServingError):
+    """The batcher/server is draining or stopped; no new admissions."""
+    http_status = 503
+    code = "server_closed"
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired before it could be served."""
+    http_status = 504
+    code = "deadline_exceeded"
+
+
+#: code string -> exception class (client-side rehydration)
+CODE_TO_ERROR = {
+    cls.code: cls
+    for cls in (ServingError, BadRequestError, ModelNotFoundError,
+                QueueFullError, ServerClosedError, DeadlineExceededError)
+}
+
+
+def error_for_code(code, message):
+    """Rebuild the server-side exception class from its wire code."""
+    return CODE_TO_ERROR.get(code, ServingError)(message)

@@ -1,0 +1,153 @@
+"""Serving observability: per-model counters + latency histograms.
+
+Three surfaces over one set of measurements:
+- ``ServingMetrics.snapshot()`` — a JSON-able dict (the scrapeable stats
+  endpoint): counters, p50/p95/p99 for queue-wait / device / end-to-end
+  latency, and the batch-occupancy ratio (items served / bucket slots
+  dispatched — how full the padded XLA programs actually run).
+- ``mxnet_tpu.profiler`` aggregate table: each dispatched batch feeds
+  ``record_op_stat("serving::<model>", device_s)`` when
+  ``set_config(aggregate_stats=True)`` is active, so serving shows up in
+  ``profiler.dumps(format='table')`` next to operator dispatches.
+- chrome-trace counters: queue depth and batch occupancy ride
+  ``profiler.record_counter`` while a trace is recording.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import profiler
+
+#: ring-buffer size per histogram — recent-window percentiles, O(1) memory
+_RESERVOIR = 2048
+
+PERCENTILES = (50, 95, 99)
+
+
+class LatencyHistogram:
+    """Bounded reservoir of the most recent ``_RESERVOIR`` samples.
+
+    Serving percentiles are a moving window by design: a p99 over the
+    process lifetime would bury a fresh latency regression under hours of
+    old samples.  Not thread-safe on its own — the owning
+    ``ServingMetrics`` lock serializes access."""
+
+    __slots__ = ("count", "total", "_ring", "_idx")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self._ring = []
+        self._idx = 0
+
+    def observe(self, value_s):
+        self.count += 1
+        self.total += value_s
+        if len(self._ring) < _RESERVOIR:
+            self._ring.append(value_s)
+        else:
+            self._ring[self._idx] = value_s
+            self._idx = (self._idx + 1) % _RESERVOIR
+
+    def snapshot(self):
+        """{count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms} (ms floats)."""
+        if not self._ring:
+            return {"count": 0}
+        srt = sorted(self._ring)
+        out = {"count": self.count,
+               "mean_ms": round(self.total / self.count * 1e3, 3),
+               "max_ms": round(srt[-1] * 1e3, 3)}
+        n = len(srt)
+        for p in PERCENTILES:
+            # nearest-rank percentile over the recent window
+            k = min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))
+            out["p%d_ms" % p] = round(srt[k] * 1e3, 3)
+        return out
+
+
+class ModelMetrics:
+    """One model's counters + histograms (guarded by the parent lock)."""
+
+    COUNTERS = ("requests_total", "responses_total", "shed_total",
+                "deadline_expired_total", "errors_total", "batches_total",
+                "items_total", "bucket_slots_total")
+
+    def __init__(self):
+        self.counters = dict.fromkeys(self.COUNTERS, 0)
+        self.queue_wait = LatencyHistogram()   # submit -> dispatch
+        self.device = LatencyHistogram()       # model execution per batch
+        self.total = LatencyHistogram()        # submit -> response
+        self.batch_size = LatencyHistogram()   # items per dispatched batch
+
+    def snapshot(self):
+        items = self.counters["items_total"]
+        slots = self.counters["bucket_slots_total"]
+        return {
+            "counters": dict(self.counters),
+            "batch_occupancy": round(items / slots, 4) if slots else None,
+            "queue_wait": self.queue_wait.snapshot(),
+            "device": self.device.snapshot(),
+            "total": self.total.snapshot(),
+            "batch_size": self.batch_size.snapshot(),
+        }
+
+
+class ServingMetrics:
+    """Thread-safe per-model metrics registry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}
+
+    def _model(self, name):
+        m = self._models.get(name)
+        if m is None:
+            m = self._models.setdefault(name, ModelMetrics())
+        return m
+
+    def count(self, name, counter, n=1):
+        with self._lock:
+            self._model(name).counters[counter] += n
+
+    def observe_queue_depth(self, name, depth):
+        # chrome-trace counter only — depth is an instantaneous gauge,
+        # the snapshot reports it live from the batcher instead
+        profiler.record_counter("serving::%s::queue_depth" % name,
+                                depth=depth)
+
+    def observe_batch(self, name, batch, bucket, device_s):
+        """One dispatched batch: ``batch`` real items padded up to
+        ``bucket`` slots, executed in ``device_s`` seconds."""
+        with self._lock:
+            m = self._model(name)
+            m.counters["batches_total"] += 1
+            m.counters["items_total"] += batch
+            m.counters["bucket_slots_total"] += bucket
+            m.device.observe(device_s)
+            m.batch_size.observe(float(batch))
+        # profiler hooks outside the lock: the aggregate table is the
+        # MXAggregateProfileStatsPrint analog, the counter the trace view
+        if profiler._AGG["enabled"]:
+            profiler.record_op_stat("serving::%s" % name, device_s)
+        profiler.record_counter("serving::%s::batch" % name,
+                                batch=batch, bucket=bucket)
+
+    def observe_request(self, name, queue_wait_s, total_s):
+        with self._lock:
+            m = self._model(name)
+            m.counters["responses_total"] += 1
+            m.queue_wait.observe(queue_wait_s)
+            m.total.observe(total_s)
+
+    def snapshot(self):
+        """Scrapeable stats: {model: {counters, batch_occupancy,
+        queue_wait/device/total/batch_size histograms}}."""
+        with self._lock:
+            return {"time": time.time(),
+                    "models": {n: m.snapshot()
+                               for n, m in self._models.items()}}
+
+    def reset(self):
+        with self._lock:
+            self._models.clear()

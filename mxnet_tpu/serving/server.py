@@ -1,0 +1,212 @@
+"""Thin HTTP frontend over the registry + dynamic batcher.
+
+Endpoints (TF-Serving-flavoured REST, JSON bodies):
+
+- ``GET  /v1/models``                          — registry listing
+- ``GET  /v1/models/<name>``                   — one model's description
+- ``POST /v1/models/<name>:predict``           — latest version
+- ``POST /v1/models/<name>/versions/<v>:predict``
+      body: ``{"instances": [<item>, ...], "deadline_ms": <opt float>}``
+      reply: ``{"predictions": [...], "model": ..., "version": ...}``
+- ``GET  /v1/stats``                           — metrics snapshot (JSON)
+- ``GET  /metrics``                            — same counters/percentiles
+      in Prometheus text exposition format (scrape target)
+
+Error mapping is 1:1 with the serving error taxonomy (``errors.py``):
+400 bad payload, 404 unknown model, 503 shed/draining, 504 deadline —
+the body carries ``{"error", "code"}`` so the Python client rehydrates
+the exact exception class.
+
+The HTTP layer is intentionally thin: every concurrency decision
+(coalescing, shedding, deadlines) lives in the batcher, so in-process
+callers (``bench.py``) and HTTP callers get identical semantics.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as onp
+
+from .batcher import DynamicBatcher
+from .errors import (BadRequestError, DeadlineExceededError,
+                     ModelNotFoundError, ServingError)
+from .registry import ModelRegistry
+
+__all__ = ["ModelServer"]
+
+_PREDICT_RE = re.compile(
+    r"^/v1/models/(?P<name>[^/:]+)(?:/versions/(?P<version>\d+))?:predict$")
+_MODEL_RE = re.compile(r"^/v1/models/(?P<name>[^/:]+)$")
+
+
+class ModelServer:
+    """Own a registry + batcher and expose them over HTTP.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port`` after
+    ``start()``).  ``stop(drain=True)`` is the graceful path: stop
+    admissions, let queued requests finish, then shut the listener down.
+    """
+
+    def __init__(self, registry=None, *, host="127.0.0.1", port=0,
+                 batcher=None, request_timeout_s=30.0, **batcher_kwargs):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.batcher = batcher if batcher is not None else DynamicBatcher(
+            self.registry, **batcher_kwargs)
+        self.metrics = self.batcher.metrics
+        self.request_timeout_s = float(request_timeout_s)
+        self._host = host
+        self._port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    @property
+    def address(self):
+        return (self._host, self.port)
+
+    def start(self):
+        if self._httpd is not None:
+            return self.address
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet: metrics are the log
+                pass
+
+            def _reply(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_error(self, exc):
+                status = getattr(exc, "http_status", 500)
+                code = getattr(exc, "code", "internal")
+                self._reply(status, {"error": str(exc), "code": code})
+
+            def do_GET(self):
+                try:
+                    self._reply(*server._handle_get(self.path))
+                except Exception as e:  # pragma: no cover - defensive
+                    self._reply_error(e)
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n) if n else b""
+                    self._reply(*server._handle_post(self.path, raw))
+                except ServingError as e:
+                    self._reply_error(e)
+                except Exception as e:
+                    self._reply_error(ServingError(
+                        "%s: %s" % (type(e).__name__, e)))
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="mxtpu-serving-http",
+                                        daemon=True)
+        self._thread.start()
+        return self.address
+
+    def stop(self, drain=True, timeout=30.0):
+        """Graceful shutdown: quiesce the batcher first (admissions fail
+        503 while queued work completes), then stop the listener."""
+        self.batcher.stop(drain=drain, timeout=timeout)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request handling (transport-independent) -------------------------
+    def _handle_get(self, path):
+        if path == "/v1/models":
+            return 200, {"models": self.registry.models()}
+        if path in ("/v1/stats", "/stats"):
+            return 200, self.metrics.snapshot()
+        if path == "/metrics":
+            return 200, {"text": self._prometheus_text()}
+        m = _MODEL_RE.match(path)
+        if m:
+            name = m.group("name")
+            if name not in self.registry:
+                raise ModelNotFoundError("no model %r" % (name,))
+            return 200, self.registry.models()[name]
+        raise ModelNotFoundError("no route %r" % (path,))
+
+    def _handle_post(self, path, raw_body):
+        m = _PREDICT_RE.match(path)
+        if not m:
+            raise ModelNotFoundError("no route %r" % (path,))
+        name = m.group("name")
+        version = int(m.group("version")) if m.group("version") else None
+        try:
+            body = json.loads(raw_body.decode() or "{}")
+        except ValueError as e:
+            raise BadRequestError("invalid JSON body: %s" % (e,))
+        instances = body.get("instances")
+        if instances is None and "data" in body:
+            instances = [body["data"]]
+        if not isinstance(instances, list) or not instances:
+            raise BadRequestError(
+                'body must carry "instances": [<item>, ...]')
+        deadline_ms = body.get("deadline_ms")
+        futures = [self.batcher.submit(name, inst, version=version,
+                                       deadline_ms=deadline_ms)
+                   for inst in instances]
+        timeout = (float(deadline_ms) / 1e3 + 1.0 if deadline_ms is not None
+                   else self.request_timeout_s)
+        preds = []
+        for f in futures:
+            try:
+                preds.append(onp.asarray(f.result(timeout=timeout)).tolist())
+            except FutureTimeoutError:
+                raise DeadlineExceededError(
+                    "no response within %.1fs" % timeout)
+        served = self.registry.get(name, version)
+        return 200, {"predictions": preds, "model": name,
+                     "version": served.version}
+
+    def _prometheus_text(self):
+        """Counters + percentiles in Prometheus exposition format."""
+        snap = self.metrics.snapshot()
+        lines = []
+        for model, stats in sorted(snap["models"].items()):
+            labels = 'model="%s"' % model
+            for cname, v in sorted(stats["counters"].items()):
+                lines.append("mxtpu_serving_%s{%s} %d" % (cname, labels, v))
+            occ = stats.get("batch_occupancy")
+            if occ is not None:
+                lines.append("mxtpu_serving_batch_occupancy{%s} %g"
+                             % (labels, occ))
+            for hist in ("queue_wait", "device", "total"):
+                h = stats.get(hist) or {}
+                for k, v in sorted(h.items()):
+                    if k == "count":
+                        continue
+                    lines.append("mxtpu_serving_%s_%s{%s} %g"
+                                 % (hist, k, labels, v))
+        return "\n".join(lines) + "\n"

@@ -20,6 +20,7 @@ EXAMPLES = [
     "example/distributed_training-horovod/train_mnist_hvd.py",
     "example/gluon/lipnet.py",
     "example/gluon/audio_classification.py",
+    "example/serving/serving_resnet50.py",
 ]
 
 

@@ -230,6 +230,13 @@ def test_nd_legacy_reshape_codes():
     assert nd.Reshape(x, shape=(-3, 0)).shape == (6, 4)
     assert nd.Reshape(x, shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
     assert nd.Reshape(x, shape=(0, 0, -1)).shape == (2, 3, 4)
+    # -1 consumes one input dim (reference matrix_op-inl.h:114 src_idx++),
+    # so a trailing 0 copies the NEXT dim: (-1, 0) on (2,3) -> (2,3)
+    x23 = nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    assert nd.Reshape(x23, shape=(-1, 0)).shape == (2, 3)
+    onp.testing.assert_array_equal(
+        nd.Reshape(x23, shape=(-1, 0)).asnumpy(), x23.asnumpy())
+    assert nd.Reshape(x, shape=(-1, 0, 0)).shape == (2, 3, 4)
 
     g = nd.array(onp.full((3,), 0.1, dtype="float32"))
     xx = nd.array(onp.array([[-1.0, 2.0, -3.0]], dtype="float32"))

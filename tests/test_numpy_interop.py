@@ -116,3 +116,53 @@ def test_ufunc_at_writes_back():
     r = onp.add.at(a, onp.array([0, 1, 0]), 1.0)
     assert r is None
     assert a.asnumpy().tolist() == [2.0, 1.0, 0.0]
+
+
+def test_ufunc_signature_mismatch_falls_back_with_warning():
+    """A ufunc kwarg the mx op doesn't take (casting=) diverts to the
+    host fallback — correct result, one-time RuntimeWarning."""
+    from mxnet_tpu import numpy_dispatch
+    a, b = _mx([1.0, 2.0]), _mx([3.0, 4.0])
+    numpy_dispatch._FALLBACK_WARNED.discard("add")
+    with pytest.warns(RuntimeWarning, match="fell back to host"):
+        r = onp.add(a, b, casting="same_kind")
+    onp.testing.assert_allclose(onp.asarray(r), [4.0, 6.0])
+    # one-time: the second identical call must not warn again
+    with warnings_none():
+        r2 = onp.add(a, b, casting="same_kind")
+    onp.testing.assert_allclose(onp.asarray(r2), [4.0, 6.0])
+
+
+class warnings_none:
+    """Context asserting no RuntimeWarning is emitted inside."""
+
+    def __enter__(self):
+        import warnings as _w
+        self._cm = _w.catch_warnings(record=True)
+        self.records = self._cm.__enter__()
+        import warnings as _w2
+        _w2.simplefilter("always")
+        return self.records
+
+    def __exit__(self, *exc):
+        self._cm.__exit__(*exc)
+        if exc[0] is None:
+            bad = [w for w in self.records
+                   if issubclass(w.category, RuntimeWarning)
+                   and "fell back to host" in str(w.message)]
+            assert not bad, bad
+        return False
+
+
+def test_ufunc_genuine_type_error_surfaces(monkeypatch):
+    """A TypeError raised INSIDE the mx op (not a call-binding mismatch)
+    must propagate — not silently retry on host NumPy."""
+    from mxnet_tpu import numpy as mx_np
+
+    def broken_hypot(*args, **kwargs):
+        raise TypeError("operand dtypes are incompatible deep in the op")
+
+    monkeypatch.setattr(mx_np, "hypot", broken_hypot)
+    a, b = _mx([3.0]), _mx([4.0])
+    with pytest.raises(TypeError, match="deep in the op"):
+        onp.hypot(a, b)

@@ -294,6 +294,44 @@ def test_quantize_graph_concat_chain_int8():
     assert rel < 0.25, rel
 
 
+def test_quantize_graph_conv_default_stride_pad():
+    """Regression: a traced conv that omitted stride/pad/dilate (a direct
+    npx.convolution call records only the kwargs it was given) must
+    quantize with the op defaults (stride=(1,1), pad=(0,0), dilate=(1,1))
+    instead of KeyError'ing on attrs['stride']."""
+    from mxnet_tpu.contrib.quantization_graph import quantize_net_graph
+    from mxnet_tpu.gluon import HybridBlock, Parameter
+    from mxnet_tpu import npx
+
+    class BareConv(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.weight = Parameter("weight", shape=(4, 3, 3, 3))
+            self.head = nn.Dense(5)
+
+        def forward(self, x):
+            y = npx.convolution(x, self.weight.data(), None, kernel=(3, 3),
+                                num_filter=4, no_bias=True)
+            return self.head(npx.relu(y))
+
+    mx.random.seed(0)
+    net = BareConv()
+    net.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(4, 3, 8, 8))
+    ref = net(x).asnumpy()
+    qnet = quantize_net_graph(net, calib_data=[x])
+    out = qnet(x).asnumpy()
+    # the conv actually ran int8 (with the default stride/pad), and the
+    # result still tracks fp32
+    conv_doms = [qnet.domains.get(n.name or n._op)
+                 for n in qnet._sym._topo()
+                 if n._kind == "op" and n._op == "npx:convolution"]
+    assert "q8" in conv_doms, qnet.domains
+    assert out.shape == ref.shape
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-8)
+    assert rel < 0.25, rel
+
+
 def test_quantize_graph_entropy_mode():
     from mxnet_tpu.contrib.quantization_graph import quantize_net_graph
     mx.random.seed(0)

@@ -1,0 +1,324 @@
+"""mxnet_tpu.serving fast-lane tests (CPU-only, synthetic models).
+
+Covers the subsystem's contracts: batch-coalescing correctness (batched
+result == per-request result), per-batch-bucket precompile (no serving
+recompiles), deadline expiry, load-shed rejection on a full queue,
+graceful drain, poisoned-request isolation, multi-model registry
+isolation, versioned hot swap, and the HTTP frontend + client round
+trip with the scrapeable stats snapshot.
+"""
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import serving
+from mxnet_tpu.gluon import nn
+
+pytestmark = pytest.mark.serving
+
+IN_UNITS = 16
+
+
+def _dense_net(units=8):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(units, in_units=IN_UNITS), nn.Activation("relu"),
+            nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    net(mxnp.zeros((1, IN_UNITS)))  # finalize deferred shapes
+    return net
+
+
+def _items(n, seed=0):
+    rng = onp.random.RandomState(seed)
+    return [rng.randn(IN_UNITS).astype("float32") for _ in range(n)]
+
+
+def test_batch_coalescing_matches_unbatched():
+    """Concurrent clients through the dynamic batcher get results
+    identical to unbatched inference, and requests actually coalesce."""
+    net = _dense_net()
+    reg = serving.ModelRegistry()
+    reg.load("m", net, item_shape=(IN_UNITS,), max_batch_size=8)
+    batcher = serving.DynamicBatcher(reg, flush_ms=25, max_queue_depth=256)
+
+    items = _items(32)
+    refs = [net(mxnp.array(it[None])).asnumpy()[0] for it in items]
+
+    results = [None] * len(items)
+    errors = []
+    start = threading.Barrier(4)
+
+    def client(tid):
+        try:
+            start.wait()
+            futs = [(i, batcher.submit("m", items[i]))
+                    for i in range(tid * 8, tid * 8 + 8)]
+            for i, f in futs:
+                results[i] = f.result(timeout=30)
+        except Exception as e:  # pragma: no cover
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    for got, ref in zip(results, refs):
+        onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    snap = batcher.metrics.snapshot()["models"]["m"]
+    assert snap["counters"]["requests_total"] == 32
+    assert snap["counters"]["responses_total"] == 32
+    # coalescing happened: far fewer dispatches than requests
+    assert snap["counters"]["batches_total"] < 32
+    # the acceptance-criteria stats surface: occupancy + p50/p95/p99
+    assert snap["batch_occupancy"] is not None
+    for hist in ("queue_wait", "device", "total"):
+        for key in ("p50_ms", "p95_ms", "p99_ms"):
+            assert key in snap[hist], (hist, snap[hist])
+    batcher.stop()
+
+
+def test_registry_bucket_precompile_no_serving_recompile():
+    """Warmup compiles one cached graph per batch bucket; serving traffic
+    (any batch size <= max) never adds a signature."""
+    net = _dense_net()
+    reg = serving.ModelRegistry()
+    served = reg.load("m", net, item_shape=(IN_UNITS,), max_batch_size=8)
+    assert served.buckets == (1, 2, 4, 8)
+    # one extra signature from the (1, IN_UNITS) finalization call
+    n_after_warmup = len(net._cached_graphs)
+
+    batcher = serving.DynamicBatcher(reg, flush_ms=5)
+    futs = [batcher.submit("m", it) for it in _items(13)]
+    for f in futs:
+        f.result(timeout=30)
+    assert len(net._cached_graphs) == n_after_warmup  # zero recompiles
+    batcher.stop()
+
+
+def test_deadline_expiry():
+    gate = threading.Event()
+
+    def blocked_fn(batch):
+        gate.wait(10)
+        return batch * 2.0
+
+    reg = serving.ModelRegistry()
+    reg.load("slow", blocked_fn, item_shape=(4,), max_batch_size=1,
+             warmup=False)
+    batcher = serving.DynamicBatcher(reg, flush_ms=1)
+    item = onp.ones(4, dtype="float32")
+    f1 = batcher.submit("slow", item)  # occupies the worker at the gate
+    # wait until the worker picked f1 up, then queue one with a deadline
+    for _ in range(200):
+        if batcher.queue_depth("slow") == 0:
+            break
+        threading.Event().wait(0.005)
+    f2 = batcher.submit("slow", item, deadline_ms=10)
+    threading.Event().wait(0.05)  # let the deadline lapse while queued
+    gate.set()
+    onp.testing.assert_allclose(f1.result(timeout=30), item * 2.0)
+    with pytest.raises(serving.DeadlineExceededError):
+        f2.result(timeout=30)
+    snap = batcher.metrics.snapshot()["models"]["slow"]
+    assert snap["counters"]["deadline_expired_total"] == 1
+    batcher.stop()
+
+
+def test_load_shed_rejection_under_full_queue():
+    gate = threading.Event()
+
+    def blocked_fn(batch):
+        gate.wait(10)
+        return batch + 1.0
+
+    reg = serving.ModelRegistry()
+    reg.load("slow", blocked_fn, item_shape=(4,), max_batch_size=1,
+             warmup=False)
+    batcher = serving.DynamicBatcher(reg, flush_ms=1, max_queue_depth=2)
+    item = onp.zeros(4, dtype="float32")
+    f1 = batcher.submit("slow", item)
+    for _ in range(200):  # worker holds f1 -> queue back to empty
+        if batcher.queue_depth("slow") == 0:
+            break
+        threading.Event().wait(0.005)
+    f2 = batcher.submit("slow", item)
+    f3 = batcher.submit("slow", item)
+    # queue is at max_queue_depth: fast-fail 503, not unbounded latency
+    with pytest.raises(serving.QueueFullError) as exc:
+        batcher.submit("slow", item)
+    assert exc.value.http_status == 503
+    gate.set()
+    for f in (f1, f2, f3):
+        onp.testing.assert_allclose(f.result(timeout=30), item + 1.0)
+    assert batcher.metrics.snapshot()["models"]["slow"][
+        "counters"]["shed_total"] == 1
+    batcher.stop()
+
+
+def test_graceful_drain():
+    net = _dense_net()
+    reg = serving.ModelRegistry()
+    reg.load("m", net, item_shape=(IN_UNITS,), max_batch_size=4)
+    batcher = serving.DynamicBatcher(reg, flush_ms=50)
+    items = _items(10)
+    futs = [batcher.submit("m", it) for it in items]
+    assert batcher.stop(drain=True, timeout=30)  # all workers exited
+    refs = [net(mxnp.array(it[None])).asnumpy()[0] for it in items]
+    for f, ref in zip(futs, refs):  # queued work completed, not dropped
+        onp.testing.assert_allclose(f.result(timeout=1), ref,
+                                    rtol=1e-5, atol=1e-6)
+    with pytest.raises(serving.ServerClosedError):
+        batcher.submit("m", items[0])
+
+
+def test_stop_without_drain_fails_queued_requests():
+    gate = threading.Event()
+
+    def blocked_fn(batch):
+        gate.wait(10)
+        return batch
+
+    reg = serving.ModelRegistry()
+    reg.load("slow", blocked_fn, item_shape=(2,), max_batch_size=1,
+             warmup=False)
+    batcher = serving.DynamicBatcher(reg, flush_ms=1)
+    item = onp.zeros(2, dtype="float32")
+    batcher.submit("slow", item)
+    for _ in range(200):
+        if batcher.queue_depth("slow") == 0:
+            break
+        threading.Event().wait(0.005)
+    f2 = batcher.submit("slow", item)
+    gate.set()
+    batcher.stop(drain=False, timeout=30)
+    with pytest.raises(serving.ServerClosedError):
+        f2.result(timeout=5)
+
+
+def test_poisoned_request_isolation():
+    """One bad input fails ONLY its own future (engine-style exception
+    transport); batchmates still get results and the worker survives."""
+    def fussy_fn(batch):
+        if onp.isnan(batch).any():
+            raise ValueError("poisoned input")
+        return batch * 3.0
+
+    reg = serving.ModelRegistry()
+    reg.load("fussy", fussy_fn, item_shape=(4,), max_batch_size=8,
+             warmup=False)
+    batcher = serving.DynamicBatcher(reg, flush_ms=40)
+    good = [onp.full(4, i, dtype="float32") for i in range(3)]
+    poison = onp.array([1.0, onp.nan, 1.0, 1.0], dtype="float32")
+    futs = [batcher.submit("fussy", g) for g in good]
+    fbad = batcher.submit("fussy", poison)
+    for f, g in zip(futs, good):
+        onp.testing.assert_allclose(f.result(timeout=30), g * 3.0)
+    with pytest.raises(ValueError, match="poisoned"):
+        fbad.result(timeout=30)
+    # worker survived the poison: later requests still serve
+    f_after = batcher.submit("fussy", good[0])
+    onp.testing.assert_allclose(f_after.result(timeout=30), good[0] * 3.0)
+    assert batcher.metrics.snapshot()["models"]["fussy"][
+        "counters"]["errors_total"] == 1
+    batcher.stop()
+
+
+def test_multi_model_registry_isolation():
+    reg = serving.ModelRegistry()
+    reg.load("plus", lambda b: b + 10.0, item_shape=(3,), max_batch_size=4,
+             warmup=False)
+    reg.load("times", lambda b: b * 10.0, item_shape=(3,), max_batch_size=4,
+             warmup=False)
+    batcher = serving.DynamicBatcher(reg, flush_ms=10)
+    item = onp.arange(3, dtype="float32")
+    fp = [batcher.submit("plus", item) for _ in range(5)]
+    ft = [batcher.submit("times", item) for _ in range(5)]
+    for f in fp:
+        onp.testing.assert_allclose(f.result(timeout=30), item + 10.0)
+    for f in ft:
+        onp.testing.assert_allclose(f.result(timeout=30), item * 10.0)
+    snap = batcher.metrics.snapshot()["models"]
+    assert snap["plus"]["counters"]["responses_total"] == 5
+    assert snap["times"]["counters"]["responses_total"] == 5
+    reg.unload("plus")
+    with pytest.raises(serving.ModelNotFoundError):
+        batcher.submit("plus", item)
+    # the surviving model is unaffected by the unload
+    onp.testing.assert_allclose(
+        batcher.submit("times", item).result(timeout=30), item * 10.0)
+    batcher.stop()
+
+
+def test_versioned_hot_swap():
+    reg = serving.ModelRegistry()
+    reg.load("m", lambda b: b + 1.0, item_shape=(2,), warmup=False)
+    reg.load("m", lambda b: b + 2.0, item_shape=(2,), warmup=False)
+    assert reg.latest_version("m") == 2
+    batcher = serving.DynamicBatcher(reg, flush_ms=1)
+    item = onp.zeros(2, dtype="float32")
+    # default routes to the latest version; pinning still hits v1
+    onp.testing.assert_allclose(
+        batcher.submit("m", item).result(timeout=30), item + 2.0)
+    onp.testing.assert_allclose(
+        batcher.submit("m", item, version=1).result(timeout=30), item + 1.0)
+    with pytest.raises(serving.ModelNotFoundError):
+        reg.get("m", 7)
+    reg.unload("m", 2)  # latest falls back to the remaining version
+    assert reg.latest_version("m") == 1
+    onp.testing.assert_allclose(
+        batcher.submit("m", item).result(timeout=30), item + 1.0)
+    batcher.stop()
+
+
+def test_serve_exported_checkpoint(tmp_path):
+    """The registry serves exported artifact pairs (HybridBlock.export ->
+    SymbolBlock.imports), not just live blocks.  A StableHLO artifact has
+    ONE fixed input signature, so the served model pins a single batch
+    bucket matching the exported batch size — the batcher's padding makes
+    every request run through that one compiled program."""
+    net = _dense_net()
+    ref_in = onp.stack(_items(3))
+    refs = net(mxnp.array(ref_in)).asnumpy()
+    net(mxnp.zeros((4, IN_UNITS)))  # export signature = the bucket shape
+    sym_file, params_file = net.export(str(tmp_path / "dense"))
+
+    reg = serving.ModelRegistry()
+    reg.load_checkpoint("ckpt", sym_file, param_file=params_file,
+                        item_shape=(IN_UNITS,), buckets=(4,))
+    batcher = serving.DynamicBatcher(reg, flush_ms=20)
+    futs = [batcher.submit("ckpt", x) for x in ref_in]
+    for f, ref in zip(futs, refs):
+        onp.testing.assert_allclose(f.result(timeout=30), ref,
+                                    rtol=1e-4, atol=1e-5)
+    batcher.stop()
+
+
+def test_http_server_end_to_end():
+    net = _dense_net()
+    reg = serving.ModelRegistry()
+    reg.load("dense", net, item_shape=(IN_UNITS,), max_batch_size=8)
+    items = onp.stack(_items(6))
+    refs = net(mxnp.array(items)).asnumpy()
+    with serving.ModelServer(reg, flush_ms=5) as srv:
+        cli = serving.ServingClient(*srv.address, timeout=30)
+        preds = cli.predict("dense", items)
+        onp.testing.assert_allclose(preds, refs, rtol=1e-4, atol=1e-5)
+        # registry listing + stats snapshot over the wire
+        assert "dense" in cli.models()
+        stats = cli.stats()["models"]["dense"]
+        assert stats["batch_occupancy"] is not None
+        assert "p99_ms" in stats["queue_wait"]
+        assert "mxtpu_serving_requests_total" in cli.metrics_text()
+        with pytest.raises(serving.ModelNotFoundError):
+            cli.predict("nope", items)
+        with pytest.raises(serving.BadRequestError):
+            cli.predict("dense", onp.zeros((2, 3), dtype="float32"))
+        cli.close()
