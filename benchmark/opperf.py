@@ -403,6 +403,10 @@ OVERRIDES = {
     "npx:flash_attention": (
         lambda: ((_u((4, 8, 128, 64)), _u((4, 8, 128, 64)),
                   _u((4, 8, 128, 64))), {}), True),
+    "npx:bias_gelu": (
+        lambda: ((_u((128, N)), _u((N,))), {}), True),
+    "npx:bias_dropout_residual": (
+        lambda: ((_u((128, N)), _u((N,)), _u((128, N))), {"p": 0.1}), True),
     "npx:interleaved_matmul_selfatt_qk": (
         lambda: ((_u((128, 8, 3 * 64)),), {"heads": 8}), False),
     "npx:interleaved_matmul_selfatt_valatt": (

@@ -12,6 +12,10 @@ TARGET_DTYPE_OPS = [
     "einsum", "interleaved_matmul_selfatt_qk",
     "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
     "interleaved_matmul_encdec_valatt", "flash_attention", "rnn",
+    # fused matmul epilogues ride in the matmul's dtype so the chain stays
+    # one low-precision kernel (reference: the transformer.cc fused ops
+    # run in the fp16 fast path)
+    "bias_gelu", "bias_dropout_residual",
 ]
 
 # ops that run in either precision (FP16_FP32_FUNCS analog :40)

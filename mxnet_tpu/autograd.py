@@ -24,6 +24,14 @@ import jax.numpy as jnp
 _STATE = threading.local()
 
 
+def _float_kind(dt):
+    """True for dtypes that carry gradients.  numpy's `kind` alone misses
+    the ml_dtypes extension floats (bfloat16/float8 report kind 'V'), so
+    bf16 tape nodes would be fed float0 cotangents and crash the vjp."""
+    dt = onp.dtype(dt)
+    return dt.kind in "fc" or jnp.issubdtype(dt, jnp.inexact)
+
+
 def _st():
     if not hasattr(_STATE, "recording"):
         _STATE.recording = False
@@ -139,7 +147,7 @@ def _make_replay(node_fn, out_shapes, out_dtypes, out_is_tuple, n_in,
         cts_in = list(vals[n_in:])
         cts = []
         for shape, dt in zip(out_shapes, out_dtypes):
-            if onp.dtype(dt).kind in "fc":
+            if _float_kind(dt):
                 cts.append(cts_in.pop(0))
             else:
                 cts.append(onp.zeros(shape, jax.dtypes.float0))
@@ -182,7 +190,7 @@ def _filled(shape, dtype, fill):
 
 def _zero_cotangent(shape, dtype):
     dt = onp.dtype(dtype)
-    if dt.kind in "fc":
+    if _float_kind(dt):
         return _filled(shape, dt, 0)
     # integer/bool outputs take float0 cotangents in JAX
     return onp.zeros(shape, jax.dtypes.float0)
@@ -301,12 +309,12 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             # recorded replay: grads connect to the tape through n.inputs
             float_cts = []
             for g, dt in zip(full, n.out_dtypes):
-                if onp.dtype(dt).kind in "fc":
+                if _float_kind(dt):
                     float_cts.append(g if isinstance(g, ndarray) else _wrap(g))
             # factory, NOT an inline def: execution is deferred to the bulk
             # flush, so the closure must own its per-node cells (an inline
             # def would share `backward`'s loop-rebound locals)
-            in_float = tuple(onp.dtype(i.dtype).kind in "fc"
+            in_float = tuple(_float_kind(i.dtype)
                              for i in n.inputs)
             replay = _make_replay(n.fn, n.out_shapes, n.out_dtypes,
                                   n.out_is_tuple, len(n.inputs), in_float)

@@ -121,6 +121,26 @@ register("MXNET_FLASH_ATTENTION", str, "", "honored",
          "accelerator backend, '0'/'off' = always the XLA reference path, "
          "'interpret' = Pallas interpret mode (CPU test lane)",
          "ops.attention._pallas_mode")
+register("MXNET_FUSE_EPILOGUE", bool, True, "honored",
+         "fuse matmul epilogues (bias+gelu, bias+dropout+residual) in "
+         "gluon Dense/FFN, the BERT encoder, and the fuse-epilogue graph "
+         "pass.  Set 0 to force the unfused op chains",
+         "ops.pallas.epilogue.fuse_epilogue_enabled")
+register("MXNET_EPILOGUE_KERNEL", str, "", "honored",
+         "fused-epilogue kernel dispatch: ''/'1' = Pallas kernel on any "
+         "accelerator backend, '0' = always the XLA-fused jnp chain, "
+         "'interpret' = Pallas interpret mode (CPU test lane)",
+         "ops.pallas.epilogue._mode")
+register("MXNET_FLASH_BLOCK_Q", int, 0, "honored",
+         "flash-attention q block size override (0 = autotable/autotune)",
+         "ops.pallas.flash_attention.pick_block_sizes")
+register("MXNET_FLASH_BLOCK_K", int, 0, "honored",
+         "flash-attention k block size override (0 = autotable/autotune)",
+         "ops.pallas.flash_attention.pick_block_sizes")
+register("MXNET_FLASH_AUTOTUNE", bool, False, "honored",
+         "1 = pick flash-attention block sizes by a one-time on-device "
+         "sweep per (L, D, dtype, causal), cached for the process; "
+         "0 = use the static table", "ops.pallas.flash_attention")
 register("MXNET_SAFE_ACCUMULATION", bool, True, "honored",
          "accumulate norms/sums in fp32 even for fp16 inputs (always on;"
          " registered for compatibility)", "ops")

@@ -548,6 +548,28 @@ def _gelu(ctx, node, ins, out):
     return ctx.add_node("Mul", [xh, e1], [out], name=node.name)
 
 
+@register_converter("npx:bias_gelu")
+def _bias_gelu(ctx, node, ins, out):
+    # fused epilogue (ops/pallas/epilogue.py) decomposes to the SAME
+    # subgraph the unfused add→gelu chain exports: Add + Erf-form GELU
+    u = ctx.add_node("Add", [ins[0], ins[1]],
+                     [ctx.fresh(node.name + "_u")])
+    return _CONVERTERS["npx:gelu"](ctx, node, [u], out)
+
+
+@register_converter("npx:bias_dropout_residual")
+def _bias_dropout_residual(ctx, node, ins, out):
+    # Add + Dropout (identity at inference) + residual Add
+    u = ctx.add_node("Add", [ins[0], ins[1]],
+                     [ctx.fresh(node.name + "_u")])
+    ratio = ctx.add_initializer(
+        node.name + "_ratio",
+        onp.asarray(node._attrs.get("p", 0.0), onp.float32))
+    d = ctx.add_node("Dropout", [u, ratio],
+                     [ctx.fresh(node.name + "_d")])
+    return ctx.add_node("Add", [d, ins[2]], [out], name=node.name)
+
+
 @register_converter("npx:batch_dot")
 def _batch_dot(ctx, node, ins, out):
     a, b = ins[0], ins[1]

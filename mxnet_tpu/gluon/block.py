@@ -385,10 +385,14 @@ class HybridBlock(Block):
     def _signature(self, flat_inputs):
         training = autograd.is_training()
         from ..ops import nn as _ops_nn
+        from ..ops.pallas.epilogue import fuse_epilogue_enabled
         amp = _ops_nn._amp_state()  # amp scope traces its own graph
         amp_key = (str(amp[0]), amp[1]) if amp is not None else None
+        # the epilogue-fusion gate changes the traced graph (Dense/BERT
+        # fused fast paths): flipping MXNET_FUSE_EPILOGUE must retrace,
+        # not reuse a stale cache
         return (tuple((a.shape, str(a.dtype)) for a in flat_inputs),
-                training, amp_key)
+                training, amp_key, fuse_epilogue_enabled())
 
     def _build_cache(self, args, kwargs, flat_inputs):
         """Trace forward into a jitted pure function.

@@ -126,6 +126,17 @@ class Dense(HybridBlock):
     def forward(self, x):
         if self.weight._data is None:
             self.infer_shape(x)
+        # fused epilogue fast path: matmul stays bias-free and the
+        # bias+gelu lands in ONE fwd (and one bwd) kernel instead of the
+        # add→gelu chain re-reading the activations from HBM
+        # (MXNET_FUSE_EPILOGUE=0 restores the unfused chain)
+        if self._activation == "gelu" and self.bias is not None:
+            from ...ops.pallas.epilogue import fuse_epilogue_enabled
+            if fuse_epilogue_enabled():
+                out = npx.fully_connected(
+                    x, self.weight.data(), None, num_hidden=self._units,
+                    no_bias=True, flatten=self._flatten)
+                return npx.bias_gelu(out, self.bias.data())
         out = npx.fully_connected(
             x, self.weight.data(), self.bias.data() if self.bias is not None else None,
             num_hidden=self._units, no_bias=self.bias is None,

@@ -151,3 +151,116 @@ def dead_node_elimination(sym):
     reachable from the output is dropped (reference PlanMemory dead-node
     pruning).  Returns a fresh DAG containing only live nodes."""
     return rewrite(sym, lambda node, new_inputs: None)
+
+
+@register("fuse-epilogue")
+def fuse_epilogue(sym):
+    """Rewrite unfused transformer epilogue chains to the fused ops
+    (ops/pallas/epilogue.py), the graph-level twin of the eager fast
+    paths in gluon Dense / models.bert:
+
+      matmul → add(bias) → gelu            ⇒  npx:bias_gelu
+      add(bias) → dropout → add(residual)  ⇒  npx:bias_dropout_residual
+
+    Both ``npx:fully_connected`` (bias as third input) and explicit
+    ``np:add`` spell the bias add.  A chain is only fused when every
+    interior node has exactly ONE consumer and is not a graph head —
+    rewiring a shared dropout node would otherwise split one mask draw
+    into two independent draws.  Applied automatically by Executor when
+    MXNET_FUSE_EPILOGUE is on (default); exact-erf gelu only, so the
+    rewrite is value-preserving (gelu_tanh chains are left alone).
+    """
+    from .sym_api import Symbol
+
+    # consumer counts over the ORIGINAL graph (+ the head, counted once
+    # more so a head node is never treated as an interior node)
+    consumers = {}
+    topo = sym._topo()
+    for n in topo:
+        for i in n._inputs:
+            consumers[id(i)] = consumers.get(id(i), 0) + 1
+    consumers[id(sym)] = consumers.get(id(sym), 0) + 1
+
+    def _single_use(node):
+        return consumers.get(id(node), 0) == 1
+
+    def _pos_attr(node, name, default=None):
+        """Read an op kwarg that may ride positionally: the symbolic
+        factories stash trailing non-Symbol positionals in _extra_pos
+        (npx.activation(x, 'gelu') / npx.dropout(x, 0.5))."""
+        if name in node._attrs:
+            return node._attrs[name]
+        extra = node._attrs.get("_extra_pos") or ()
+        return extra[0] if extra else default
+
+    def _is_gelu(node):
+        if node._kind != "op":
+            return False
+        if node._op == "npx:activation":
+            return _pos_attr(node, "act_type") == "gelu"
+        if node._op == "npx:gelu":
+            return not _pos_attr(node, "approximate", False)
+        return False
+
+    def _split_bias(new_node):
+        """If the REWRITTEN node computes X + bias, return (X, bias)
+        Symbols, else None.  Matching on the rewritten form means a chain
+        whose inner node was already fused by another pattern can never
+        be mis-split."""
+        if new_node._kind != "op":
+            return None
+        if new_node._op == "npx:fully_connected":
+            if len(new_node._inputs) == 3 and \
+                    not new_node._attrs.get("no_bias"):
+                attrs = dict(new_node._attrs)
+                attrs["no_bias"] = True
+                attrs.pop("bias", None)
+                fc = Symbol("op", op="npx:fully_connected",
+                            inputs=new_node._inputs[:2], attrs=attrs,
+                            name=new_node.name)
+                return fc, new_node._inputs[2]
+        if new_node._op == "np:add" and len(new_node._inputs) == 2:
+            a, b = new_node._inputs
+            if a._kind != "const" and b._kind != "const":
+                return a, b
+        return None
+
+    def xform(node, new_inputs):
+        # pattern A: gelu(X + b) -> bias_gelu(X, b)
+        if _is_gelu(node) and len(new_inputs) == 1 \
+                and _single_use(node._inputs[0]):
+            split = _split_bias(new_inputs[0])
+            if split is not None:
+                pre, bias = split
+                return Symbol("op", op="npx:bias_gelu",
+                              inputs=[pre, bias], name=node.name)
+        # pattern B: R + dropout(X + b) -> bias_dropout_residual(X, b, R)
+        if node._kind == "op" and node._op == "np:add" \
+                and len(new_inputs) == 2:
+            for di, ri in ((0, 1), (1, 0)):
+                drop_new = new_inputs[di]
+                if not (drop_new._kind == "op"
+                        and drop_new._op == "npx:dropout"
+                        and len(drop_new._inputs) == 1
+                        and _single_use(node._inputs[di])):
+                    continue
+                # consumer counts live on ORIGINAL ids; the original
+                # dropout's input is the original inner node
+                if not _single_use(node._inputs[di]._inputs[0]):
+                    continue
+                split = _split_bias(drop_new._inputs[0])
+                if split is None:
+                    continue
+                pre, bias = split
+                attrs = {k: v for k, v in drop_new._attrs.items()
+                         if k in ("p", "mode")}
+                if "p" not in attrs:
+                    p = _pos_attr(drop_new, "p")
+                    if p is not None:
+                        attrs["p"] = p
+                return Symbol("op", op="npx:bias_dropout_residual",
+                              inputs=[pre, bias, new_inputs[ri]],
+                              attrs=attrs, name=node.name)
+        return None
+
+    return rewrite(sym, xform)

@@ -18,6 +18,16 @@ from .. import numpy_extension as npx
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..gluon.parameter import Parameter
+from ..ops.pallas.epilogue import fuse_epilogue_enabled
+
+
+def _dense_nobias(dense, x):
+    """Apply a Dense layer's matmul WITHOUT its bias — the bias is folded
+    into the following fused epilogue (bias_gelu / bias_dropout_residual),
+    mirroring the reference's transformer.cc fused fast path where the
+    projection GEMM is bias-free and the epilogue kernel owns the add."""
+    return npx.fully_connected(x, dense.weight.data(), None,
+                               no_bias=True, flatten=False)
 
 __all__ = ["BERTEncoder", "BERTModel", "bert_base", "bert_large", "bert_tiny"]
 
@@ -73,6 +83,10 @@ class MultiHeadAttention(HybridBlock):
                 att = npx.dropout(att, p=self._dropout)
             out = npx.batch_dot(att, v.reshape(B * H, L, D)).reshape(B, H, L, D)
         out = out.transpose(0, 2, 1, 3).reshape(B, L, C)
+        if fuse_epilogue_enabled():
+            # bias-free projection: TransformerLayer folds proj.bias into
+            # the fused bias+dropout+residual epilogue
+            return _dense_nobias(self.proj, out)
         return self.proj(out)
 
 
@@ -85,6 +99,15 @@ class PositionwiseFFN(HybridBlock):
         self._dropout = dropout
 
     def forward(self, x):
+        if self._activation == "gelu" and fuse_epilogue_enabled():
+            # fused bias+gelu after a bias-free GEMM; ffn2 also runs
+            # bias-free — its bias joins TransformerLayer's fused
+            # bias+dropout+residual epilogue
+            h = npx.bias_gelu(_dense_nobias(self.ffn1, x),
+                              self.ffn1.bias.data())
+            if self._dropout:
+                h = npx.dropout(h, p=self._dropout)
+            return _dense_nobias(self.ffn2, h)
         h = npx.activation(self.ffn1(x), self._activation)
         if self._dropout:
             h = npx.dropout(h, p=self._dropout)
@@ -105,6 +128,16 @@ class TransformerLayer(HybridBlock):
         self._dropout = dropout
 
     def forward(self, x, mask=None):
+        if fuse_epilogue_enabled():
+            # attention/ffn return PRE-bias projections; each residual
+            # join is one fused bias+dropout+residual kernel instead of
+            # the add→dropout→add chain (three HBM round-trips)
+            h = self.attention(x, mask)
+            x = self.ln1(npx.bias_dropout_residual(
+                h, self.attention.proj.bias.data(), x, p=self._dropout))
+            h = self.ffn(x)
+            return self.ln2(npx.bias_dropout_residual(
+                h, self.ffn.ffn2.bias.data(), x, p=self._dropout))
         h = self.attention(x, mask)
         if self._dropout:
             h = npx.dropout(h, p=self._dropout)
@@ -173,7 +206,11 @@ class BERTModel(HybridBlock):
         seq = self.encoder(x, mask)  # (B, L, C)
         pooled = self.pooler(seq[:, 0])  # CLS
         # MLM logits over full sequence
-        h = npx.activation(self.mlm_dense(seq), "gelu")
+        if fuse_epilogue_enabled():
+            h = npx.bias_gelu(_dense_nobias(self.mlm_dense, seq),
+                              self.mlm_dense.bias.data())
+        else:
+            h = npx.activation(self.mlm_dense(seq), "gelu")
         h = self.mlm_ln(h)
         if self._tie:
             # jnp.matmul broadcasts the leading batch dim of 1 — no (B,V,C)

@@ -230,4 +230,27 @@ def SliceChannel(data, num_outputs=None, axis=1, squeeze_axis=False, **kw):
     return outs
 
 
-split = SliceChannel  # legacy alias
+def split(data, num_outputs=None, axis=1, squeeze_axis=False, **kw):
+    """Legacy ``mx.nd.split`` == SliceChannel: `num_outputs` equal parts
+    along ``axis`` (default 1!).
+
+    This name shadows the np-style ``split`` star-exported from mx.np —
+    whose signature is ``np.split(a, indices_or_sections, axis=0)``.  A
+    NumPy-style call (index-list second argument, or the
+    ``sections``/``indices_or_sections`` keyword) used to be silently
+    interpreted as a SliceChannel along axis 1; detect it and point the
+    caller at ``mx.np.split`` instead."""
+    np_style = ("sections" in kw or "indices_or_sections" in kw
+                or isinstance(num_outputs, (list, tuple))
+                or isinstance(num_outputs, NDArray)
+                or (hasattr(num_outputs, "ndim")
+                    and getattr(num_outputs, "ndim", 0) > 0))
+    if np_style:
+        raise TypeError(
+            "mx.nd.split is the legacy SliceChannel op (num_outputs equal "
+            "parts along axis=%d, axis default 1); it does not accept "
+            "NumPy-style split points. For np.split semantics "
+            "(indices_or_sections, axis default 0) call mx.np.split "
+            "explicitly." % axis)
+    return SliceChannel(data, num_outputs=num_outputs, axis=axis,
+                        squeeze_axis=squeeze_axis, **kw)

@@ -43,6 +43,7 @@ __all__ = [
     "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
     "interleaved_matmul_encdec_valatt", "flash_attention", "save", "load",
     "savez", "set_np", "reset_np", "waitall", "all_finite",
+    "bias_gelu", "bias_dropout_residual",
 ]
 
 
@@ -214,6 +215,34 @@ def l2_normalization(data, eps=1e-10, mode="instance", **kw):
 
 def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0, **kw):
     return apply_op(lambda x: _nn.lrn(x, nsize, alpha, beta, knorm), data)
+
+
+# -- fused epilogues (ops/pallas/epilogue.py; reference transformer.cc's
+# hand-fused bias+GELU / bias+dropout+residual matmul epilogues) ------------
+def bias_gelu(data, bias, **kw):
+    """gelu(data + bias), fused fwd+bwd (exact erf GELU — identical to
+    npx.activation(..., 'gelu') over npx.fully_connected's bias add)."""
+    def f(x, b):
+        x, b = _nn._amp_cast2("bias_gelu", x, b)
+        return _nn.bias_gelu(x, b)
+
+    return apply_op(f, data, bias)
+
+
+def bias_dropout_residual(data, bias, residual, p=0.0, mode="training", **kw):
+    """residual + dropout(data + bias), fused fwd+bwd.  Dropout follows
+    npx.dropout semantics: active only while training (or mode='always'),
+    scaled by 1/(1-p); the in-kernel hash mask is regenerated by the
+    backward, so no mask residual is stored."""
+    rate = float(p) if (autograd.is_training() or mode == "always") else 0.0
+    key = next_key() if rate else None
+
+    def f(x, b, r):
+        x, b = _nn._amp_cast2("bias_dropout_residual", x, b)
+        r = _nn._amp_cast1("bias_dropout_residual", r)
+        return _nn.bias_dropout_residual(x, b, r, rate=rate, key=key)
+
+    return apply_op(f, data, bias, residual)
 
 
 # -- dropout ----------------------------------------------------------------

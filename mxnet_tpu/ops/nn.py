@@ -84,6 +84,22 @@ def activation(x, act_type):
     raise ValueError("unknown act_type %r" % (act_type,))
 
 
+def bias_gelu(x, bias):
+    """Fused bias + exact-erf GELU epilogue (fwd+bwd one kernel each; see
+    ops/pallas/epilogue.py).  Replaces the reference's hand-fused FFN
+    epilogue in transformer.cc."""
+    from .pallas import epilogue as _epi
+    return _epi.bias_gelu(x, bias)
+
+
+def bias_dropout_residual(x, bias, residual, rate=0.0, key=None):
+    """Fused bias + dropout + residual-add epilogue.  `rate` must already
+    reflect train/predict mode (0.0 disables the mask); the hash-based
+    mask is regenerated in backward, so no mask tensor is stored."""
+    from .pallas import epilogue as _epi
+    return _epi.bias_dropout_residual(x, bias, residual, rate=rate, key=key)
+
+
 def leaky_relu(x, slope=0.25):
     return jnp.where(x >= 0, x, slope * x)
 

@@ -25,11 +25,17 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# renamed across jax versions (TPUCompilerParams in 0.4/0.5, CompilerParams
+# from 0.6); resolve once so every pallas_call below works on either
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
 
 # Large-negative sentinel instead of -inf: masked scores underflow to exactly
 # 0 after the softmax shift (every row of a causal / banded self-attention has
@@ -243,7 +249,7 @@ def _fwd_call(q, k, v, seed, kvlen, causal, window, scale, dropout,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, seed, kvlen)
@@ -391,7 +397,7 @@ def _bwd_call(q, k, v, do, lse, delta, seed, kvlen, causal, window, scale,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta, seed, kvlen)
@@ -424,7 +430,7 @@ def _bwd_call(q, k, v, do, lse, delta, seed, kvlen, causal, window, scale,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta, seed, kvlen)
@@ -468,20 +474,103 @@ def _flash_bwd(causal, window, scale, dropout, has_kvlen, block_q, block_k,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ---------------------------------------------------------------------------
+# block-size selection: env override > per-process autotune sweep > table
+# ---------------------------------------------------------------------------
+# one entry per (seq-len bucket, dtype width): tuned at the BERT shapes the
+# bench drives (L=128 and L=2048, D∈{64,128}).  Small L wants one block per
+# grid row (no online-softmax rescale traffic); long L wants the biggest
+# k-block VMEM tolerates so each q-block streams fewer carry updates, and
+# bf16 halves the score-tile footprint so block_q can double.
+_AUTOTUNE_CACHE = {}  # (L, D, dtype, causal, banded) -> (block_q, block_k)
+
+
+def _table_blocks(L, D, dtype):
+    narrow = jnp.dtype(dtype).itemsize <= 2
+    if L <= 256:
+        return (L, L)
+    if L <= 1024:
+        return (256, 512)
+    return (512, 1024) if narrow else (256, 1024)
+
+
+def _env_block(name):
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        return 0
+
+
+def _sweep_candidates(L):
+    out = []
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512, 1024):
+            if bq <= L and bk <= L and L % bq == 0 and L % bk == 0:
+                out.append((bq, bk))
+    return out or [(min(L, 128), min(L, 128))]
+
+
+def _autotune_sweep(L, D, dtype, causal, window):
+    """One-time on-device sweep: time the forward kernel per candidate on
+    synthetic (8, L, D) tensors, best wall-clock wins (min-of-2 after a
+    compile warmup — interference can only slow a sample down)."""
+    import time
+    BH = 8
+    q = jnp.zeros((BH, L, D), dtype)
+    seed = jnp.zeros((1,), jnp.uint32)
+    kvlen = jnp.zeros((1,), jnp.int32)
+    best, best_t = None, float("inf")
+    for bq, bk in _sweep_candidates(L):
+        try:
+            run = jax.jit(functools.partial(
+                _fwd_call, causal=causal, window=window,
+                scale=1.0 / math.sqrt(D), dropout=0.0, has_kvlen=False,
+                block_q=bq, block_k=bk, interpret=False))
+            jax.block_until_ready(run(q, q, q, seed, kvlen))  # compile
+            t = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                jax.block_until_ready(run(q, q, q, seed, kvlen))
+                t = min(t, time.perf_counter() - t0)
+        except Exception:  # candidate doesn't fit/compile on this chip
+            continue
+        if t < best_t:
+            best, best_t = (bq, bk), t
+    return best or _table_blocks(L, D, dtype)
+
+
+def pick_block_sizes(L, D, dtype, causal=False, window=None,
+                     interpret=False):
+    """(block_q, block_k) for a flash call: MXNET_FLASH_BLOCK_Q/K env
+    overrides win outright; with MXNET_FLASH_AUTOTUNE=1 on a compiled
+    (non-interpret, non-CPU) backend a one-time on-device sweep picks per
+    (L, D, dtype, mask-kind) and caches for the process; otherwise the
+    static table."""
+    eq, ek = _env_block("MXNET_FLASH_BLOCK_Q"), _env_block(
+        "MXNET_FLASH_BLOCK_K")
+    if eq and ek:
+        return eq, ek
+    key = (L, D, str(jnp.dtype(dtype)), bool(causal), window is not None)
+    got = _AUTOTUNE_CACHE.get(key)
+    if got is None:
+        autotune = os.environ.get("MXNET_FLASH_AUTOTUNE", "") not in (
+            "", "0", "false", "False", "off")
+        if autotune and not interpret and jax.default_backend() != "cpu":
+            got = _autotune_sweep(L, D, jnp.dtype(dtype), causal, window)
+        else:
+            got = _table_blocks(L, D, dtype)
+        _AUTOTUNE_CACHE[key] = got
+    bq, bk = got
+    return (eq or bq), (ek or bk)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "window", "scale",
                                              "dropout", "block_q", "block_k",
                                              "interpret"))
-def flash_attention_tpu(q, k, v, causal=False, window=None, scale=None,
-                        dropout=0.0, seed=None, kv_length=None,
-                        block_q=512, block_k=1024, interpret=False):
-    """q,k,v: (B, H, L, D) → (B, H, L, D).  Differentiable (custom VJP with
-    Pallas backward kernels).  `window` is a symmetric band half-width.
-
-    `dropout` applies in-kernel dropout to the normalized attention
-    probabilities (reference semantics: transformer.cc:650-826 attention
-    dropout), regenerated in the backward kernels from the same hash —
-    `seed` (uint32 scalar/array) picks the mask.  `kv_length` is a (B,)
-    per-sequence valid key count (padding mask as a per-row k-limit)."""
+def _flash_attention_blocks(q, k, v, causal=False, window=None, scale=None,
+                            dropout=0.0, seed=None, kv_length=None,
+                            block_q=512, block_k=1024, interpret=False):
     B, H, L, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     block_q = min(block_q, L)
@@ -506,3 +595,34 @@ def flash_attention_tpu(q, k, v, causal=False, window=None, scale=None,
     out = _flash(qr, kr, vr, seed, kvlen, causal, window, scale,
                  float(dropout), has_kvlen, block_q, block_k, interpret)
     return out.reshape(B, H, L, D)
+
+
+def flash_attention_tpu(q, k, v, causal=False, window=None, scale=None,
+                        dropout=0.0, seed=None, kv_length=None,
+                        block_q=None, block_k=None, interpret=False):
+    """q,k,v: (B, H, L, D) → (B, H, L, D).  Differentiable (custom VJP with
+    Pallas backward kernels).  `window` is a symmetric band half-width.
+
+    `dropout` applies in-kernel dropout to the normalized attention
+    probabilities (reference semantics: transformer.cc:650-826 attention
+    dropout), regenerated in the backward kernels from the same hash —
+    `seed` (uint32 scalar/array) picks the mask.  `kv_length` is a (B,)
+    per-sequence valid key count (padding mask as a per-row k-limit).
+
+    ``block_q``/``block_k`` default to ``pick_block_sizes`` — the env
+    overrides (MXNET_FLASH_BLOCK_Q/K), the per-process autotune cache
+    (MXNET_FLASH_AUTOTUNE=1), or the static table, in that order.  The
+    jitted core (`_flash_attention_blocks`) still clamps/halves them to
+    divide L, so any override is safe."""
+    L, D = q.shape[-2], q.shape[-1]
+    if block_q is None or block_k is None:
+        tq, tk = pick_block_sizes(L, D, q.dtype, causal=causal,
+                                  window=window, interpret=interpret)
+        block_q = block_q or tq
+        block_k = block_k or tk
+    return _flash_attention_blocks(q, k, v, causal=causal, window=window,
+                                   scale=scale, dropout=dropout, seed=seed,
+                                   kv_length=kv_length,
+                                   block_q=int(block_q),
+                                   block_k=int(block_k),
+                                   interpret=interpret)

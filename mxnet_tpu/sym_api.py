@@ -589,6 +589,13 @@ class Executor:
     for backward) into cached XLA executables."""
 
     def __init__(self, sym, args, args_grad, grad_req, aux_states):
+        # graph-level epilogue fusion (env-gated, on by default): rewrite
+        # unfused matmul→add→gelu / add→dropout→add chains to the fused
+        # ops before the DAG is compiled (graph_pass.fuse_epilogue)
+        from .ops.pallas.epilogue import fuse_epilogue_enabled
+        if fuse_epilogue_enabled():
+            from . import graph_pass
+            sym = graph_pass.apply_pass(sym, "fuse-epilogue")
         self._sym = sym
         self.arg_dict = OrderedDict()
         arg_names = sym.list_arguments()

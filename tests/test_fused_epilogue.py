@@ -1,0 +1,330 @@
+"""Fused epilogue kernels (ops/pallas/epilogue.py) + the fuse-epilogue
+graph pass + flash-attention block autotuning.
+
+Parity discipline: the fused ops must match the UNFUSED op composition —
+outputs and gradients — in fp32 and bf16, on both the XLA fallback chain
+and the Pallas kernels (interpret mode on the CPU lane)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import np as mxnp
+from mxnet_tpu import npx
+from mxnet_tpu import graph_pass
+from mxnet_tpu import sym_api as sym
+
+
+def _grads_fused_vs_unfused(dtype):
+    """(fused, unfused) (out, dx, db[, dr]) pairs at one dtype."""
+    mx.random.seed(0)
+    x = mxnp.random.uniform(low=-2, high=2, size=(8, 33)).astype(dtype)
+    b = mxnp.random.uniform(low=-1, high=1, size=(33,)).astype(dtype)
+
+    def run(fn):
+        xx, bb = x.copy(), b.copy()
+        xx.attach_grad()
+        bb.attach_grad()
+        with autograd.record():
+            out = fn(xx, bb)
+            loss = (out * out).sum()
+        loss.backward()
+        return (out.asnumpy().astype("float32"),
+                xx.grad.asnumpy().astype("float32"),
+                bb.grad.asnumpy().astype("float32"))
+
+    fused = run(lambda xx, bb: npx.bias_gelu(xx, bb))
+    unfused = run(lambda xx, bb: npx.activation(xx + bb, "gelu"))
+    return fused, unfused
+
+
+@pytest.mark.parametrize("dtype,tol", [("float32", 1e-5),
+                                       ("bfloat16", 5e-2)])
+def test_bias_gelu_parity_out_and_grads(dtype, tol):
+    fused, unfused = _grads_fused_vs_unfused(dtype)
+    for f, u, name in zip(fused, unfused, ("out", "dx", "db")):
+        onp.testing.assert_allclose(f, u, rtol=tol, atol=tol,
+                                    err_msg="bias_gelu %s (%s)"
+                                            % (name, dtype))
+
+
+@pytest.mark.parametrize("dtype,tol", [("float32", 1e-5),
+                                       ("bfloat16", 5e-2)])
+def test_bias_dropout_residual_parity_p0(dtype, tol):
+    """With the mask inactive (p=0) the fused op must equal the unfused
+    add→add chain exactly — outputs and all three gradients."""
+    mx.random.seed(0)
+    x = mxnp.random.uniform(size=(6, 17)).astype(dtype)
+    b = mxnp.random.uniform(size=(17,)).astype(dtype)
+    r = mxnp.random.uniform(size=(6, 17)).astype(dtype)
+
+    def run(fn):
+        xx, bb, rr = x.copy(), b.copy(), r.copy()
+        for a in (xx, bb, rr):
+            a.attach_grad()
+        with autograd.record():
+            loss = (fn(xx, bb, rr) ** 2).sum()
+        loss.backward()
+        return [a.asnumpy().astype("float32")
+                for a in (xx.grad, bb.grad, rr.grad)]
+
+    fused = run(lambda xx, bb, rr:
+                npx.bias_dropout_residual(xx, bb, rr, p=0.0))
+    unfused = run(lambda xx, bb, rr: rr + (xx + bb))
+    for f, u, name in zip(fused, unfused, ("dx", "db", "dr")):
+        onp.testing.assert_allclose(f, u, rtol=tol, atol=tol,
+                                    err_msg="bdr %s (%s)" % (name, dtype))
+
+
+def test_bias_dropout_residual_training_mask_consistency():
+    """Training mode: the hash mask must (a) scale kept elements by
+    1/(1-p) and zero dropped ones, (b) be REGENERATED identically in the
+    backward (dx = g * mask, dr = g, db = sum dx) — no stored mask."""
+    mx.random.seed(3)
+    x = mxnp.random.uniform(low=0.5, high=1.5, size=(16, 32))
+    b = mxnp.random.uniform(low=0.5, high=1.5, size=(32,))
+    r = mxnp.random.uniform(size=(16, 32))
+    x.attach_grad()
+    b.attach_grad()
+    r.attach_grad()
+    with autograd.record(train_mode=True):
+        out = npx.bias_dropout_residual(x, b, r, p=0.5)
+        loss = out.sum()
+    loss.backward()
+    mask = (out - r).asnumpy() / (x + b).asnumpy()
+    vals = onp.unique(onp.round(mask, 4))
+    assert set(vals) <= {0.0, 2.0}, vals  # 1/(1-p) = 2 or dropped
+    keep_frac = (mask > 0).mean()
+    assert 0.3 < keep_frac < 0.7, keep_frac
+    # backward regenerated the same mask
+    onp.testing.assert_allclose(x.grad.asnumpy(), mask, atol=1e-5)
+    onp.testing.assert_allclose(r.grad.asnumpy(),
+                                onp.ones_like(mask), atol=1e-6)
+    onp.testing.assert_allclose(b.grad.asnumpy(), mask.sum(0), rtol=1e-5)
+
+
+def test_bias_dropout_residual_predict_mode_is_identity_chain():
+    x = mxnp.random.uniform(size=(4, 8))
+    b = mxnp.random.uniform(size=(8,))
+    r = mxnp.random.uniform(size=(4, 8))
+    out = npx.bias_dropout_residual(x, b, r, p=0.9)  # not training
+    onp.testing.assert_allclose(out.asnumpy(),
+                                (r + x + b).asnumpy(), rtol=1e-6)
+
+
+def test_epilogue_pallas_interpret_matches_xla(monkeypatch):
+    """The Pallas kernels (interpret mode on CPU) and the XLA fallback
+    chain share the hash mask and numerics: outputs and grads agree."""
+    from mxnet_tpu.ops.pallas import epilogue as epi
+    mx.random.seed(1)
+    x = mxnp.random.uniform(low=-2, high=2, size=(8, 64))
+    b = mxnp.random.uniform(size=(64,))
+
+    def run():
+        xx, bb = x.copy(), b.copy()
+        xx.attach_grad()
+        bb.attach_grad()
+        with autograd.record():
+            loss = (npx.bias_gelu(xx, bb) ** 2).sum()
+        loss.backward()
+        return xx.grad.asnumpy(), bb.grad.asnumpy()
+
+    ref = run()
+    assert epi.last_path == "xla"
+    monkeypatch.setenv("MXNET_EPILOGUE_KERNEL", "interpret")
+    got = run()
+    assert epi.last_path == "pallas-interpret"
+    for a, c in zip(ref, got):
+        onp.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# graph pass
+# ---------------------------------------------------------------------------
+def _ops(s):
+    return [n._op for n in s._topo() if n._kind == "op"]
+
+
+def test_fuse_epilogue_pass_gelu_chains():
+    x = sym.var("x", shape=(4, 8))
+    w = sym.var("w", shape=(8, 8))
+    b = sym.var("b", shape=(8,))
+    fc = sym.fully_connected(x, w, b, num_hidden=8)
+    fused = graph_pass.apply_pass(
+        sym.activation(fc, act_type="gelu"), "fuse-epilogue")
+    assert "npx:bias_gelu" in _ops(fused)
+    assert "npx:activation" not in _ops(fused)
+    # explicit add form
+    fused2 = graph_pass.apply_pass(
+        sym.activation(sym.add(x, b), act_type="gelu"), "fuse-epilogue")
+    assert _ops(fused2) == ["npx:bias_gelu"]
+    # gelu_tanh is NOT value-equal to the fused exact-erf op: left alone
+    kept = graph_pass.apply_pass(
+        sym.activation(fc, act_type="gelu_tanh"), "fuse-epilogue")
+    assert "npx:bias_gelu" not in _ops(kept)
+
+
+def test_fuse_epilogue_pass_dropout_residual_chain_and_values(monkeypatch):
+    x = sym.var("x", shape=(4, 8))
+    w = sym.var("w", shape=(8, 8))
+    b = sym.var("b", shape=(8,))
+    r = sym.var("r", shape=(4, 8))
+    fc = sym.fully_connected(x, w, b, num_hidden=8)
+    chain = sym.add(sym.dropout(fc, p=0.25), r)
+    fused = graph_pass.apply_pass(chain, "fuse-epilogue")
+    assert "npx:bias_dropout_residual" in _ops(fused)
+    assert "npx:dropout" not in _ops(fused)
+    vals = dict(x=mxnp.random.uniform(size=(4, 8)),
+                w=mxnp.random.uniform(size=(8, 8)),
+                b=mxnp.random.uniform(size=(8,)),
+                r=mxnp.random.uniform(size=(4, 8)))
+    # predict-mode eval: dropout is identity in both forms
+    monkeypatch.setenv("MXNET_FUSE_EPILOGUE", "0")
+    ref = chain.eval(**vals)[0].asnumpy()
+    monkeypatch.setenv("MXNET_FUSE_EPILOGUE", "1")
+    got = fused.eval(**vals)[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_fuse_epilogue_pass_keeps_shared_dropout():
+    """A dropout consumed twice draws ONE mask; fusing one consumer would
+    split it into two draws — the pass must leave it alone."""
+    x = sym.var("x", shape=(4, 8))
+    b = sym.var("b", shape=(8,))
+    r = sym.var("r", shape=(4, 8))
+    d = sym.dropout(sym.add(x, b), p=0.5)
+    g = sym.add(sym.add(d, r), d)
+    fused = graph_pass.apply_pass(g, "fuse-epilogue")
+    assert "npx:dropout" in _ops(fused)
+    assert "npx:bias_dropout_residual" not in _ops(fused)
+
+
+def test_fuse_epilogue_pass_on_2layer_encoder(monkeypatch):
+    """The rewrite preserves results on a symbolically-traced 2-layer
+    encoder: trace UNFUSED, apply the pass, eval both (predict mode)."""
+    from mxnet_tpu.models.bert import BERTEncoder
+    monkeypatch.setenv("MXNET_FUSE_EPILOGUE", "0")
+    mx.random.seed(0)
+    enc = BERTEncoder(num_layers=2, units=32, hidden_size=64, num_heads=2,
+                      dropout=0.1, max_length=16)
+    enc.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(2, 16, 32))
+    enc(x)
+    s, params = enc.to_sym(input_shapes=[(2, 16, 32)])
+    assert "npx:bias_gelu" not in _ops(s)
+    fused = graph_pass.apply_pass(s, "fuse-epilogue")
+    fops = _ops(fused)
+    assert fops.count("npx:bias_gelu") == 2, fops  # one FFN per layer
+    # attention-proj and FFN-out residual joins, per layer
+    assert fops.count("npx:bias_dropout_residual") == 4, fops
+    # only the FFN-internal dropout (not an epilogue) survives, per layer
+    assert fops.count("npx:dropout") == 2, fops
+
+    env = dict(params)
+    env["data"] = x
+    ref = s.eval(**env)[0].asnumpy()
+    monkeypatch.setenv("MXNET_FUSE_EPILOGUE", "1")
+    got = fused.eval(**env)[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_hybridized_encoder_fused_vs_unfused(monkeypatch):
+    """The eager/hybridized fused fast path (gluon wiring) matches the
+    unfused chain on a 2-layer encoder — the MXNET_FUSE_EPILOGUE toggle
+    retraces (signature includes the gate)."""
+    from mxnet_tpu.models.bert import BERTEncoder
+    from mxnet_tpu.ops.pallas import epilogue as epi
+    mx.random.seed(0)
+    enc = BERTEncoder(num_layers=2, units=32, hidden_size=64, num_heads=2,
+                      dropout=0.0, max_length=16)
+    enc.initialize(mx.init.Xavier())
+    x = mxnp.random.uniform(size=(2, 16, 32))
+    enc(x)
+    enc.hybridize()
+    # flush the warmup's deferred bulk segment BEFORE snapshotting the
+    # op counters: it replays the ops recorded while fusion was on
+    npx.waitall()
+    monkeypatch.setenv("MXNET_FUSE_EPILOGUE", "0")
+    c0 = dict(epi.trace_counts)
+    ref = enc(x).asnumpy()
+    assert dict(epi.trace_counts) == c0  # unfused trace used no fused op
+    monkeypatch.setenv("MXNET_FUSE_EPILOGUE", "1")
+    got = enc(x).asnumpy()
+    assert epi.trace_counts["bias_gelu"] > c0["bias_gelu"]
+    assert epi.trace_counts["bias_dropout_residual"] \
+        > c0["bias_dropout_residual"]
+    onp.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# flash-attention block autotuning
+# ---------------------------------------------------------------------------
+def test_flash_block_table_and_env_override(monkeypatch):
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+    assert fa.pick_block_sizes(128, 64, jnp.float32) == (128, 128)
+    assert fa.pick_block_sizes(512, 64, jnp.bfloat16) == (256, 512)
+    assert fa.pick_block_sizes(2048, 64, jnp.bfloat16) == (512, 1024)
+    assert fa.pick_block_sizes(2048, 128, jnp.float32) == (256, 1024)
+    # env overrides win outright
+    monkeypatch.setenv("MXNET_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("MXNET_FLASH_BLOCK_K", "128")
+    assert fa.pick_block_sizes(2048, 64, jnp.bfloat16) == (64, 128)
+    # malformed override falls back to the table
+    monkeypatch.setenv("MXNET_FLASH_BLOCK_Q", "nope")
+    monkeypatch.setenv("MXNET_FLASH_BLOCK_K", "")
+    assert fa.pick_block_sizes(2048, 64, jnp.bfloat16) == (512, 1024)
+
+
+def test_flash_block_autotune_cache_is_per_process():
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+    fa._AUTOTUNE_CACHE.clear()
+    got = fa.pick_block_sizes(256, 64, jnp.float32)
+    key = (256, 64, "float32", False, False)
+    assert fa._AUTOTUNE_CACHE[key] == got
+    # cache hit returns the stored pick even if the table would differ
+    fa._AUTOTUNE_CACHE[key] = (32, 32)
+    assert fa.pick_block_sizes(256, 64, jnp.float32) == (32, 32)
+    fa._AUTOTUNE_CACHE.clear()
+
+
+def test_flash_attention_auto_blocks_parity():
+    """flash_attention_tpu with table-picked blocks (interpret mode)
+    matches the XLA reference."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention_tpu
+    from mxnet_tpu.ops.attention import attention_reference
+    q = jax.random.normal(jax.random.key(0), (1, 2, 64, 16))
+    k = jax.random.normal(jax.random.key(1), (1, 2, 64, 16))
+    v = jax.random.normal(jax.random.key(2), (1, 2, 64, 16))
+    ref = attention_reference(q, k, v, causal=True)
+    got = flash_attention_tpu(q, k, v, causal=True, interpret=True)
+    assert float(jnp.abs(ref - got).max()) < 2e-5
+
+
+# ---------------------------------------------------------------------------
+# mx.nd.split shadowing (satellite)
+# ---------------------------------------------------------------------------
+def test_nd_split_legacy_slicechannel_still_works():
+    from mxnet_tpu import nd
+    x = mxnp.arange(24.0).reshape(2, 4, 3)
+    outs = nd.split(x, 2)  # legacy: 2 parts along axis=1
+    assert len(outs) == 2 and outs[0].shape == (2, 2, 3)
+    onp.testing.assert_allclose(
+        outs[1].asnumpy(), x.asnumpy()[:, 2:], rtol=0)
+
+
+def test_nd_split_np_style_raises_clear_typeerror():
+    from mxnet_tpu import nd
+    x = mxnp.arange(12.0).reshape(4, 3)
+    with pytest.raises(TypeError, match="np.split"):
+        nd.split(x, [1, 3])  # np-style index list
+    with pytest.raises(TypeError, match="np.split"):
+        nd.split(x, sections=2)
+    with pytest.raises(TypeError, match="np.split"):
+        nd.split(x, indices_or_sections=2)
+    # mx.np.split keeps np semantics untouched
+    parts = mxnp.split(x, [1, 3], axis=0)
+    assert [p.shape[0] for p in parts] == [1, 2, 1]
