@@ -54,6 +54,7 @@ from . import kvstore as kv
 from . import kvstore
 from . import parallel
 from . import profiler
+from . import faults  # deterministic fault injection (resilience tests)
 from . import amp
 
 from .util import is_np_array, is_np_shape, set_np, reset_np

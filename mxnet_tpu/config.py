@@ -141,6 +141,37 @@ register("MXNET_FLASH_AUTOTUNE", bool, False, "honored",
          "1 = pick flash-attention block sizes by a one-time on-device "
          "sweep per (L, D, dtype, causal), cached for the process; "
          "0 = use the static table", "ops.pallas.flash_attention")
+register("MXNET_KV_TIMEOUT", float, 300.0, "honored",
+         "dist kvstore socket timeout in seconds (send/recv/connect on a "
+         "server shard stream); also the reconnect deadline after a "
+         "transport failure", "kvstore.dist._ServerConn")
+register("MXNET_KV_RETRIES", int, 4, "honored",
+         "dist kvstore: bounded retries per request after a transport "
+         "failure (reconnect + resend; the server dedups replayed "
+         "mutations by (key, rank, seq))", "kvstore.dist._ServerConn")
+register("MXNET_KV_BACKOFF_MS", float, 50.0, "honored",
+         "dist kvstore: base retry backoff in ms, doubled per attempt "
+         "with jitter", "kvstore.dist._ServerConn")
+register("MXNET_KV_STALL_SEC", float, 600.0, "honored",
+         "dist server watchdog: a sync-round pull or barrier waiting "
+         "longer than this raises a diagnostic naming the stalled ranks "
+         "instead of hanging forever (0 disables)",
+         "kvstore.dist.KVStoreDistServer")
+register("MXNET_FAULT_SPEC", str, "", "honored",
+         "deterministic fault injection spec: site:kind[@p=F|n=I] joined "
+         "by ';' (sites: kvstore.send, kvstore.recv, server.apply, "
+         "checkpoint.write)", "faults")
+register("MXNET_FAULT_SEED", int, 0, "honored",
+         "seed for probability-based fault-injection rules (deterministic "
+         "trip sequences per (seed, site, kind))", "faults.FaultRule")
+register("MXNET_CKPT_BACKEND", str, "", "honored",
+         "checkpoint backend: '' = orbax when importable else npz; "
+         "'npz' forces the crash-safe npz path; 'orbax' requires orbax",
+         "parallel.checkpoint")
+register("MXNET_CKPT_KEEP", int, 0, "honored",
+         "default checkpoint retention: keep only the newest N steps "
+         "after each save (0 = keep all; save_checkpoint(keep=...) wins)",
+         "parallel.checkpoint.save_checkpoint")
 register("MXNET_SAFE_ACCUMULATION", bool, True, "honored",
          "accumulate norms/sums in fp32 even for fp16 inputs (always on;"
          " registered for compatibility)", "ops")

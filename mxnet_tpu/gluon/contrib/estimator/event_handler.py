@@ -172,11 +172,19 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
 
 class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     """Save parameters periodically; keep the best by a monitored metric
-    (reference CheckpointHandler)."""
+    (reference CheckpointHandler).
+
+    Crash safety: every file lands via tmp + os.replace, so a process
+    killed mid-save can never leave a torn .params file.  With
+    ``resume=True`` each save also records a ``<prefix>-resume.json``
+    state (epoch/batch counters, best metric, trainer optimizer states)
+    and ``train_begin`` restores all of it, so a killed run continues
+    where it stopped (pass the epochs still remaining to ``fit``; the
+    checkpoint tags keep counting from the restored epoch)."""
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
                  mode="auto", epoch_period=1, batch_period=None,
-                 save_best=False, max_checkpoints=5):
+                 save_best=False, max_checkpoints=5, resume=False):
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.monitor = monitor
@@ -184,6 +192,7 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.batch_period = batch_period
         self.save_best = save_best
         self.max_checkpoints = max_checkpoints
+        self.resume = resume
         self.current_epoch = 0
         self.current_batch = 0
         self.saved = []
@@ -193,11 +202,68 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.mode = mode
         self.best = -onp.inf if self.mode == "max" else onp.inf
 
+    def _atomic_save_params(self, estimator, path):
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        estimator.net.save_parameters(tmp)
+        os.replace(tmp, path)
+
+    def _resume_state_path(self):
+        return os.path.join(self.model_dir,
+                            "%s-resume.json" % self.model_prefix)
+
+    def _save_resume_state(self, estimator, params_path):
+        import json
+        states_path = None
+        trainer = getattr(estimator, "trainer", None)
+        if trainer is not None and hasattr(trainer, "save_states"):
+            states_path = os.path.join(
+                self.model_dir, "%s-trainer.states" % self.model_prefix)
+            tmp = "%s.tmp.%d" % (states_path, os.getpid())
+            trainer.save_states(tmp)
+            os.replace(tmp, states_path)
+        state = {"epoch": self.current_epoch, "batch": self.current_batch,
+                 "best": float(self.best),
+                 "params": os.path.basename(params_path),
+                 "states": (os.path.basename(states_path)
+                            if states_path else None)}
+        sp = self._resume_state_path()
+        tmp = "%s.tmp.%d" % (sp, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, sp)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        if not self.resume:
+            return
+        import json
+        sp = self._resume_state_path()
+        if not os.path.isfile(sp):
+            return
+        with open(sp) as f:
+            state = json.load(f)
+        params_path = os.path.join(self.model_dir, state["params"])
+        estimator.net.load_parameters(params_path)
+        trainer = getattr(estimator, "trainer", None)
+        if state.get("states") and trainer is not None and \
+                hasattr(trainer, "load_states"):
+            trainer.load_states(os.path.join(self.model_dir,
+                                             state["states"]))
+        self.current_epoch = int(state.get("epoch", 0))
+        self.current_batch = int(state.get("batch", 0))
+        self.best = float(state.get("best", self.best))
+        logging.getLogger("mxnet_tpu.estimator").info(
+            "CheckpointHandler: resumed from %s (epoch %d, batch %d)",
+            params_path, self.current_epoch, self.current_batch)
+
     def _save(self, estimator, tag):
         os.makedirs(self.model_dir, exist_ok=True)
         path = os.path.join(self.model_dir,
                             "%s-%s.params" % (self.model_prefix, tag))
-        estimator.net.save_parameters(path)
+        self._atomic_save_params(estimator, path)
+        if self.resume:
+            self._save_resume_state(estimator, path)
         self.saved.append(path)
         while len(self.saved) > self.max_checkpoints:
             old = self.saved.pop(0)
@@ -225,7 +291,7 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             if better:
                 self.best = val
                 os.makedirs(self.model_dir, exist_ok=True)
-                estimator.net.save_parameters(os.path.join(
+                self._atomic_save_params(estimator, os.path.join(
                     self.model_dir, "%s-best.params" % self.model_prefix))
 
 

@@ -28,13 +28,25 @@ aggregates (and applies the optimizer if set); pulls block until the
 puller's round is applied.  Async mode: pushes apply immediately and
 REQUIRE a server-side optimizer (reference kvstore_dist_server.h:359
 CHECK(sync_mode_) "Updater needs to be set for async mode").
+
+Resilience (OSDI'14 parameter-server semantics; see README "Fault
+tolerance"): every worker request carries (rank, seq); transport
+failures retry with exponential backoff + transparent reconnect
+(MXNET_KV_RETRIES / MXNET_KV_BACKOFF_MS / MXNET_KV_TIMEOUT); the server
+dedups replayed pushes by (key, rank, seq) and replayed barriers by
+(rank, seq) so a resend after a lost ack never double-applies; sync
+waits carry a stall watchdog (MXNET_KV_STALL_SEC) that raises a
+diagnostic naming the stalled ranks.  Injection sites kvstore.send /
+kvstore.recv / server.apply hook `mxnet_tpu.faults`.
 """
 from __future__ import annotations
 
 import io
+import itertools
 import json
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
@@ -44,6 +56,8 @@ import numpy as onp
 
 import jax.numpy as jnp
 
+from .. import config as _config
+from .. import faults
 from ..ndarray import ndarray, array as nd_array
 from . import KVStoreBase, _reduce
 
@@ -149,13 +163,24 @@ def _env(name, default=None):
     return v if v is not None else default
 
 
+class _ConnDrop(Exception):
+    """Raised inside a server handler to kill the connection without
+    replying (fault injection: server.apply@drop — the ack-lost replay
+    case a retrying worker must survive via seq dedup)."""
+
+
+# one request-id stream per worker process (see KVStoreDist.__init__)
+_GLOBAL_SEQ = itertools.count(1)
+
+
 # ---------------------------------------------------------------------------
 # server
 # ---------------------------------------------------------------------------
 class KVStoreDistServer:
     """One parameter-server shard (reference kvstore_dist_server.h:155)."""
 
-    def __init__(self, port=None, num_workers=None, sync=None):
+    def __init__(self, port=None, num_workers=None, sync=None,
+                 stall_sec=None):
         self.num_workers = int(num_workers
                                if num_workers is not None
                                else _env("DMLC_NUM_WORKER", "1"))
@@ -165,13 +190,20 @@ class KVStoreDistServer:
         self.port = int(port if port is not None
                         else _env("DMLC_SERVER_PORT",
                                   _env("DMLC_PS_ROOT_PORT", "9090")))
+        self.stall_sec = float(stall_sec if stall_sec is not None
+                               else _config.get("MXNET_KV_STALL_SEC"))
         self.store = {}          # key -> onp.ndarray
         self.updater = None
-        self.buf = {}            # key -> {rank: grad}
+        self.buf = {}            # key -> {rank: [grads]}
         self.applied_round = {}  # key -> completed rounds
         self.cond = threading.Condition()
         self.barrier_count = 0
         self.barrier_gen = 0
+        self._barrier_ranks = set()   # ranks waiting in the current gen
+        self._barrier_entered = {}    # rank -> (seq, gen) replay dedup
+        self._push_seen = {}          # (key, rank) -> last applied seq
+        self._dup_pushes = 0          # replayed pushes dedup'd (not
+        # re-applied) — OSDI'14 replay safety observable for tests
         self._stop = False
         self._sock = None
         self._threads = []
@@ -198,6 +230,10 @@ class KVStoreDistServer:
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
+            # prune finished conn threads: reconnecting workers would
+            # otherwise grow this list by one dead Thread per reconnect
+            # for the life of the server
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
         self._sock.close()
 
@@ -210,6 +246,8 @@ class KVStoreDistServer:
                     return
                 try:
                     reply = self._handle(msg)
+                except _ConnDrop:
+                    return  # injected ack loss: close without replying
                 except Exception as e:  # report, don't kill the conn —
                     # a swallowed server error would hang every sync
                     # puller waiting on applied_round forever
@@ -218,7 +256,11 @@ class KVStoreDistServer:
                              "error": "%s\n%s" % (e,
                                                   traceback.format_exc())}
                 if reply is not None:
-                    _send_msg(conn, reply)
+                    try:
+                        _send_msg(conn, reply)
+                    except OSError:
+                        return  # worker vanished mid-reply; its retry
+                        # (or the stall watchdog) takes it from here
                 if msg.get("op") == "stop":
                     return
         finally:
@@ -238,17 +280,7 @@ class KVStoreDistServer:
         if op == "pull":
             return self._handle_pull(msg)
         if op == "barrier":
-            with self.cond:
-                gen = self.barrier_gen
-                self.barrier_count += 1
-                if self.barrier_count == self.num_workers:
-                    self.barrier_count = 0
-                    self.barrier_gen += 1
-                    self.cond.notify_all()
-                else:
-                    while self.barrier_gen == gen and not self._stop:
-                        self.cond.wait(0.2)
-            return {"ok": True}
+            return self._handle_barrier(msg)
         if op == "set_optimizer":
             from ..optimizer import Updater
             optimizer = _loads_optimizer(msg["optimizer"])
@@ -261,6 +293,44 @@ class KVStoreDistServer:
                 self.cond.notify_all()
             return {"ok": True}
         return {"ok": False, "error": "unknown op %r" % op}
+
+    def _handle_barrier(self, msg):
+        """Barrier with replay dedup: a worker whose ack was lost resends
+        the same (rank, seq); counting it twice would release a later
+        barrier early.  A replayed entry just re-waits on the generation
+        it originally joined."""
+        rank = msg.get("rank", -1)
+        seq = msg.get("seq")
+        with self.cond:
+            prev = self._barrier_entered.get(rank)
+            if seq is not None and prev is not None and prev[0] == seq:
+                gen = prev[1]  # replay: already counted; wait it out
+            else:
+                gen = self.barrier_gen
+                self._barrier_entered[rank] = (seq, gen)
+                self._barrier_ranks.add(rank)
+                self.barrier_count += 1
+                if self.barrier_count == self.num_workers:
+                    self.barrier_count = 0
+                    self._barrier_ranks.clear()
+                    self.barrier_gen += 1
+                    self.cond.notify_all()
+                    return {"ok": True}
+            deadline = (time.monotonic() + self.stall_sec
+                        if self.stall_sec > 0 else None)
+            while self.barrier_gen == gen and not self._stop:
+                self.cond.wait(0.2)
+                if deadline is not None and time.monotonic() > deadline \
+                        and self.barrier_gen == gen:
+                    missing = sorted(set(range(self.num_workers))
+                                     - self._barrier_ranks)
+                    return {"ok": False, "stall": True,
+                            "error": "barrier stalled for %.0fs waiting "
+                                     "for rank(s) %s (arrived: %s of %d)"
+                                     % (self.stall_sec, missing,
+                                        sorted(self._barrier_ranks),
+                                        self.num_workers)}
+        return {"ok": True}
 
     def _apply(self, key, agg):
         """Aggregate applied: run server-side optimizer or store the sum
@@ -276,6 +346,7 @@ class KVStoreDistServer:
 
     def _handle_push(self, msg):
         key, rank = msg["key"], msg["rank"]
+        seq = msg.get("seq")
         if msg.get("compressed"):
             from .gradient_compression import GradientCompression
             value = GradientCompression.decompress(
@@ -287,6 +358,17 @@ class KVStoreDistServer:
         # launcher env is only the default for old-style pushes
         sync = msg.get("sync", self.sync)
         with self.cond:
+            if seq is not None:
+                # replay dedup: per (key, rank) the worker's engine
+                # serializes pushes, so seqs arrive monotonically; a
+                # replay (retry after a lost ack) carries seq <= last and
+                # must be acked WITHOUT re-applying — a double-applied
+                # gradient silently corrupts training
+                last = self._push_seen.get((key, rank), -1)
+                if seq <= last:
+                    self._dup_pushes += 1
+                    return {"ok": True, "dup": True}
+                self._push_seen[(key, rank)] = seq
             if not sync:
                 # async: apply immediately.  Without a server-side
                 # optimizer an async push would accumulate raw gradients
@@ -299,6 +381,8 @@ class KVStoreDistServer:
                         "update_on_kvstore=True)")
                 self._apply(key, value)
                 self.cond.notify_all()
+                if faults.check("server.apply") == "drop":
+                    raise _ConnDrop()
                 return {"ok": True}
             # per-rank queues: a worker may push the same key again before
             # the round completes; overwriting would lose a gradient and
@@ -315,27 +399,83 @@ class KVStoreDistServer:
                         del q[r]
                 self._apply(key, agg)
                 self.cond.notify_all()
+        # injected AFTER the push is recorded (and dedup-registered):
+        # 'drop' loses the ack, forcing the worker down the retry+dedup
+        # path; exception kinds surface as error replies
+        if faults.check("server.apply") == "drop":
+            raise _ConnDrop()
         return {"ok": True}
 
     def _handle_pull(self, msg):
         key = msg["key"]
         want_round = msg.get("round", 0)
         with self.cond:
+            deadline = (time.monotonic() + self.stall_sec
+                        if self.stall_sec > 0 else None)
             while (self.sync
                    and self.applied_round.get(key, 0) < want_round
                    and not self._stop):
                 self.cond.wait(0.2)
+                if deadline is not None and time.monotonic() > deadline \
+                        and self.applied_round.get(key, 0) < want_round:
+                    # name the culprits instead of hanging forever: ranks
+                    # with a queued gradient for this key are alive; the
+                    # rest never pushed this round
+                    pushed = sorted(r for r, v in
+                                    self.buf.get(key, {}).items() if v)
+                    missing = sorted(set(range(self.num_workers))
+                                     - set(self.buf.get(key, {})))
+                    return {"ok": False, "stall": True,
+                            "error": "sync pull of key %r stalled for "
+                                     "%.0fs at round %d/%d: rank(s) %s "
+                                     "have not pushed (pending pushes "
+                                     "from: %s)"
+                                     % (key, self.stall_sec,
+                                        self.applied_round.get(key, 0),
+                                        want_round, missing, pushed)}
             if key not in self.store:
                 return {"ok": False, "error": "unknown key %r" % key}
             return {"ok": True, "value": self.store[key]}
 
 
+def _run_conn_group(conn, entries, replies):
+    """Send one shard's messages and collect its replies, with bounded
+    retry: a transport failure (reset, timeout, injected fault) marks the
+    conn broken, reconnects, and resends the SAME messages — safe because
+    every mutation carries (rank, seq) and the server dedups replays.
+    Closing the broken socket also discards any half-read reply stream,
+    so a later caller can never misattribute stale replies."""
+    last = None
+    for attempt in range(conn.retries + 1):
+        try:
+            conn.ensure_connected()
+            for _pos, m in entries:
+                faults.check("kvstore.send")
+                _send_msg(conn.sock, m)
+            for pos, _m in entries:
+                faults.check("kvstore.recv")
+                replies[pos] = _recv_msg(conn.sock)
+            return
+        except OSError as e:  # ConnectionError/timeout are OSError subs
+            last = e
+            conn.mark_broken()
+            if attempt >= conn.retries:
+                raise ConnectionError(
+                    "kvstore shard %s:%d failed after %d attempt(s): %s"
+                    % (conn.host, conn.port, attempt + 1, last)) from e
+            from .. import profiler
+            profiler.record_event_stat("kvstore.retry")
+            conn.backoff(attempt)
+
+
 def _grouped_requests(conn_msgs):
-    """Run (conn, msg) pairs pipelined: ALL sends go out (to every server
-    stream) before any reply is awaited, so slices progress on all shards
-    in parallel instead of one blocking round trip each.  Per-conn locks
-    are held across send+recv (acquired in a fixed order) so concurrent
-    callers can't interleave on a stream."""
+    """Run (conn, msg) pairs pipelined: ALL first-attempt sends go out (to
+    every server stream) before any reply is awaited, so slices progress
+    on all shards in parallel instead of one blocking round trip each.
+    Per-conn locks are held across send+recv (acquired in a fixed order)
+    so concurrent callers can't interleave on a stream.  A shard whose
+    stream fails falls back to a per-shard retry loop — only the failed
+    shard's messages are resent."""
     by_conn = {}
     for pos, (conn, msg) in enumerate(conn_msgs):
         by_conn.setdefault(id(conn), (conn, []))[1].append((pos, msg))
@@ -346,12 +486,27 @@ def _grouped_requests(conn_msgs):
         for _cid, (conn, entries) in groups:
             conn.lock.acquire()
             acquired.append(conn.lock)
-        for _cid, (conn, entries) in groups:  # phase 1: send everywhere
-            for _pos, m in entries:
-                _send_msg(conn.sock, m)
-        for _cid, (conn, entries) in groups:  # phase 2: collect replies
-            for pos, _m in entries:
-                replies[pos] = _recv_msg(conn.sock)
+        sent_ok = {}
+        for cid, (conn, entries) in groups:  # phase 1: send everywhere
+            try:
+                conn.ensure_connected()
+                for _pos, m in entries:
+                    faults.check("kvstore.send")
+                    _send_msg(conn.sock, m)
+                sent_ok[cid] = True
+            except OSError:
+                conn.mark_broken()
+                sent_ok[cid] = False  # retried in phase 2
+        for cid, (conn, entries) in groups:  # phase 2: collect replies
+            if sent_ok[cid]:
+                try:
+                    for pos, _m in entries:
+                        faults.check("kvstore.recv")
+                        replies[pos] = _recv_msg(conn.sock)
+                    continue
+                except OSError:
+                    conn.mark_broken()
+            _run_conn_group(conn, entries, replies)
     finally:
         for lock in acquired:  # only locks actually taken
             lock.release()
@@ -369,35 +524,82 @@ def run_server():
 # worker
 # ---------------------------------------------------------------------------
 class _ServerConn:
-    """One persistent, locked connection to a server shard."""
+    """One persistent, locked, self-healing connection to a server shard.
+
+    Transport failures mark the conn broken; the next use reconnects
+    transparently.  Knobs: MXNET_KV_TIMEOUT (socket timeout + reconnect
+    deadline, replaces the old hardcoded 300 s), MXNET_KV_RETRIES,
+    MXNET_KV_BACKOFF_MS (exponential backoff base, with jitter)."""
 
     def __init__(self, host, port, timeout=60.0):
         self.lock = threading.Lock()
-        deadline = time.time() + timeout
+        self.host = host
+        self.port = int(port)
+        self.sock = None
+        self.sock_timeout = float(_config.get("MXNET_KV_TIMEOUT"))
+        self.retries = max(0, int(_config.get("MXNET_KV_RETRIES")))
+        self.backoff_ms = max(1.0, float(_config.get("MXNET_KV_BACKOFF_MS")))
+        # jitter decorrelates retry storms across workers; it never
+        # affects training numerics, so a non-deterministic seed is fine
+        self._jitter = random.Random(os.getpid() ^ id(self))
+        self._connect(timeout)
+
+    def _connect(self, wait):
+        """(Re)connect, retrying brief refusals until `wait` elapses (a
+        restarting server shard is a normal event, not an error)."""
+        deadline = time.monotonic() + wait
         last = None
-        while time.time() < deadline:
+        while True:
             try:
-                self.sock = socket.create_connection((host, port),
-                                                     timeout=300)
-                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
-                                     1)
+                s = socket.create_connection(
+                    (self.host, self.port),
+                    timeout=min(self.sock_timeout, 5.0))
+                s.settimeout(self.sock_timeout)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.sock = s
                 return
             except OSError as e:
                 last = e
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        "cannot reach server %s:%d (%s)"
+                        % (self.host, self.port, last)) from e
                 time.sleep(0.1)
-        raise ConnectionError("cannot reach server %s:%d (%s)"
-                              % (host, port, last))
+
+    def ensure_connected(self):
+        if self.sock is None:
+            self._connect(self.sock_timeout)
+
+    def mark_broken(self):
+        """Close and forget the socket: discards any unread reply bytes
+        (stream desync protection) and forces a reconnect on next use."""
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def backoff(self, attempt):
+        time.sleep(self.backoff_ms / 1e3 * (2 ** attempt)
+                   * (0.5 + self._jitter.random()))
 
     def request(self, msg):
+        """One request/reply round trip with bounded retry + transparent
+        reconnect (see _run_conn_group for the failure contract)."""
+        replies = [None]
         with self.lock:
-            _send_msg(self.sock, msg)
-            return _recv_msg(self.sock)
+            _run_conn_group(self, [(0, msg)], replies)
+        return replies[0]
 
     def send_only(self, msg):
         with self.lock:
+            self.ensure_connected()
             _send_msg(self.sock, msg)
 
     def close(self):
+        if self.sock is None:
+            return
         try:
             self.sock.close()
         except OSError:
@@ -438,6 +640,16 @@ class KVStoreDist(KVStoreBase):
                        for s in range(self._num_servers)]
         self._push_round = {}  # key -> rounds this worker pushed
         self._gc = None  # optional GradientCompression
+        # every request carries (rank, seq): the server dedups replayed
+        # mutations so a retried push/barrier can never double-apply.
+        # The counter is PROCESS-global (not per-store): the server keys
+        # replay state by rank alone, and one process may hold several
+        # stores (e.g. dist_sync + p3) whose per-store counters would
+        # collide — two distinct barriers carrying the same (rank, seq)
+        # read as a replay and deadlock the round.  itertools.count is
+        # atomic in CPython; engine key vars keep per-key push order, so
+        # per-(key, rank) seqs stay monotonic.
+        self._seq = _GLOBAL_SEQ
 
     _server_opt = False
 
@@ -524,13 +736,15 @@ class KVStoreDist(KVStoreBase):
                 plan = self._slice_plan(k, v.size)
                 if plan is None:
                     r = self._conn_for(k).request(
-                        {"op": "init", "key": k, "value": v})
+                        {"op": "init", "key": k, "value": v,
+                         "rank": self._rank, "seq": next(self._seq)})
                     assert r["ok"], r
                 else:
                     flat = v.ravel()
                     for r in _grouped_requests(
                             [(c, {"op": "init", "key": sk,
-                                  "value": flat[a:b]})
+                                  "value": flat[a:b], "rank": self._rank,
+                                  "seq": next(self._seq)})
                              for sk, a, b, c in plan]):
                         assert r["ok"], r
         self.barrier()
@@ -589,6 +803,10 @@ class KVStoreDist(KVStoreBase):
                 else:
                     msg = {"op": "push", "key": sk, "rank": self._rank,
                            "value": sv, "sync": self._sync}
+                # seq assigned here (engine worker, per-key serialized):
+                # a RETRY of this message reuses the same seq, so the
+                # server can tell "resent after lost ack" from "new push"
+                msg["seq"] = next(self._seq)
                 conn_msgs.append((conn, msg))
             replies = _grouped_requests(conn_msgs)
             for r in replies:
@@ -611,18 +829,24 @@ class KVStoreDist(KVStoreBase):
         if plan is None:
             r = self._conn_for(key).request(
                 {"op": "pull", "key": key,
-                 "round": self._push_round.get(key, 0)})
+                 "round": self._push_round.get(key, 0),
+                 "rank": self._rank, "seq": next(self._seq)})
             if not r["ok"]:
+                if r.get("stall"):
+                    raise TimeoutError(r["error"])
                 raise KeyError(r.get("error", "pull failed"))
             value = r["value"]
         else:
             replies = _grouped_requests(
                 [(c, {"op": "pull", "key": sk,
-                      "round": self._push_round.get(sk, 0)})
+                      "round": self._push_round.get(sk, 0),
+                      "rank": self._rank, "seq": next(self._seq)})
                  for sk, _a, _b, c in plan])
             parts = []
             for r in replies:
                 if not r["ok"]:
+                    if r.get("stall"):
+                        raise TimeoutError(r["error"])
                     raise KeyError(r.get("error", "pull failed"))
                 parts.append(onp.asarray(r["value"]).ravel())
             value = onp.concatenate(parts).reshape(outs[0].shape)
@@ -646,7 +870,9 @@ class KVStoreDist(KVStoreBase):
         if self._rank == 0:
             blob = pickle.dumps(optimizer)
             for c in self._conns:
-                r = c.request({"op": "set_optimizer", "optimizer": blob})
+                r = c.request({"op": "set_optimizer", "optimizer": blob,
+                               "rank": self._rank,
+                               "seq": next(self._seq)})
                 assert r["ok"], r
         self.barrier()
 
@@ -656,8 +882,12 @@ class KVStoreDist(KVStoreBase):
         # worker's async pushes first — a barrier that overtook its own
         # pending pushes would not be a barrier.
         self.wait_async()
-        r = self._conns[0].request({"op": "barrier", "rank": self._rank})
-        assert r["ok"], r
+        r = self._conns[0].request({"op": "barrier", "rank": self._rank,
+                                    "seq": next(self._seq)})
+        if not r.get("ok"):
+            if r.get("stall"):
+                raise TimeoutError(r["error"])
+            raise RuntimeError("barrier failed: %s" % r.get("error"))
 
     def stop_servers(self):
         """Ask every server shard to exit (launcher/worker-0 teardown)."""
@@ -665,7 +895,8 @@ class KVStoreDist(KVStoreBase):
         if self._rank == 0:
             for c in self._conns:
                 try:
-                    c.request({"op": "stop"})
+                    c.request({"op": "stop", "rank": self._rank,
+                               "seq": next(self._seq)})
                 except ConnectionError:
                     pass
 

@@ -278,6 +278,8 @@ class DataParallelTrainer:
         for k, p in self._params.items():
             p._data._set_data(state["params"][k])
 
-from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401,E402
+from .checkpoint import (  # noqa: F401,E402
+    save_checkpoint, load_checkpoint, wait_for_saves, list_steps,
+    latest_step, verify_checkpoint, resume_training)
 from .pipeline import PipelineRunner, pipeline_apply  # noqa: F401,E402
 from .moe import MoELayer  # noqa: F401,E402

@@ -1,4 +1,4 @@
-"""Sharded checkpointing for pod-scale parameters.
+"""Sharded, crash-safe checkpointing for pod-scale parameters.
 
 Parity-plus: the reference's checkpoint story is parameter files
 (block.save_parameters → cnpy .npz, SURVEY.md §5.4); at pod scale one
@@ -6,30 +6,62 @@ host can't materialize the full parameter set, so the TPU build adds a
 sharded layout: each process writes its shards, metadata records the
 mesh/sharding, and restore re-shards onto the current topology.  Backed
 by orbax (the JAX-ecosystem checkpoint library) when available, with an
-npz fallback for single-host arrays.
+npz fallback for single-host arrays (force with MXNET_CKPT_BACKEND=npz).
+
+Crash safety (CheckFreq, FAST'21: checkpoints must be frequent, cheap,
+and *consistent* under kill -9):
+- the npz payload is written tmp → flush → fsync → os.replace, then the
+  directory is fsynced — a crash leaves either the old file or the new
+  one, never a torn one;
+- every step gets a ``step_N.manifest.json`` (written last, atomically)
+  with per-array crc32 checksums; a step without a matching manifest or
+  with mismatched checksums is *invalid*;
+- ``load_checkpoint`` verifies and, if the requested step is corrupt or
+  missing, falls back to the newest valid step (warning), so a process
+  killed mid-save always resumes from the last good checkpoint;
+- ``save_checkpoint(keep=N)`` prunes old steps after a successful write;
+- ``save_checkpoint(trainer=..., extra=...)`` snapshots optimizer state
+  and user metadata (step/epoch) into the same step;
+  ``resume_training`` restores all of it so a killed run continues.
 
 Writes are pushed through the host dependency engine (one write var per
 checkpoint path), so persisting a step overlaps the next step's compute —
 the reference's async checkpoint callback pattern expressed as engine
 write deps.  `load_checkpoint` (and `wait_for_saves`) synchronize on the
-path's var, re-raising any async save failure.
+path's var, re-raising any async save failure.  The writer carries the
+``checkpoint.write`` fault-injection site (kinds: ``torn`` tears the npz
+payload, ``error``/``crash`` fail the write) for deterministic
+crash-consistency tests.
 """
 from __future__ import annotations
 
 import atexit
+import io
+import json
 import os
+import re
 import threading
+import warnings
+import zlib
 
 import numpy as onp
 
 import jax
 
+from .. import config as _config
+from .. import faults
 from ..ndarray import ndarray
 
-__all__ = ["save_checkpoint", "load_checkpoint", "wait_for_saves"]
+__all__ = ["save_checkpoint", "load_checkpoint", "wait_for_saves",
+           "list_steps", "latest_step", "verify_checkpoint",
+           "resume_training"]
 
 _save_vars = {}  # abspath -> engine var (write-ordered saves per path)
 _save_lock = threading.Lock()
+
+_MANIFEST_RE = re.compile(r"^step_(\d+)\.manifest\.json$")
+_NPZ_RE = re.compile(r"^step_(\d+)\.npz$")
+_DIR_RE = re.compile(r"^step_(\d+)$")
 
 
 def _path_var(path):
@@ -95,27 +127,117 @@ def _to_tree(params):
     return tree
 
 
-def save_checkpoint(path, params, step=0):
+# ---------------------------------------------------------------------------
+# crash-safe filesystem primitives
+# ---------------------------------------------------------------------------
+def _fsync_dir(dirpath):
+    """Make a rename durable: fsync the containing directory (no-op where
+    directories can't be opened, e.g. some network filesystems)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(final_path, data):
+    """tmp → flush → fsync → os.replace: a crash at ANY point leaves
+    either no file or the complete file at final_path, never a torn one
+    (the pre-existing npz fallback wrote in place and could)."""
+    tmp = "%s.tmp.%d" % (final_path, os.getpid())
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final_path)
+
+
+def _crc(arr):
+    return zlib.crc32(onp.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _backend():
+    b = (_config.get("MXNET_CKPT_BACKEND") or "").lower()
+    if b in ("npz", "orbax"):
+        return b
+    try:
+        import orbax.checkpoint  # noqa: F401
+        return "orbax"
+    except ImportError:
+        return "npz"
+
+
+def _manifest_path(path, step):
+    return os.path.join(path, "step_%d.manifest.json" % step)
+
+
+def _read_manifest(path, step):
+    try:
+        with open(_manifest_path(path, step)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _trainer_states_blob(trainer):
+    """Snapshot optimizer state NOW (the async writer must not observe
+    later updates) — the same serialization as Trainer.save_states."""
+    from ..optimizer import Updater
+    u = Updater(trainer._optimizer)
+    u.states = trainer._states
+    return u.get_states(dump_optimizer=True)
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+def save_checkpoint(path, params, step=0, trainer=None, extra=None,
+                    keep=None):
     """Write a (possibly sharded) checkpoint.
 
     params: dict of name → Parameter/ndarray/jax.Array (sharded arrays
     keep their sharding — each host persists its addressable shards).
+    trainer: optional gluon Trainer whose optimizer state is snapshotted
+    alongside the arrays (restored by resume_training).
+    extra: JSON-able metadata (epoch, seen samples, ...) stored in the
+    step's manifest.
+    keep: retain only the newest `keep` steps after a successful write
+    (default: MXNET_CKPT_KEEP; 0/None = keep everything).
     """
     path = os.path.abspath(path)
+    step = int(step)
     tree = _to_tree(params)  # snapshot: jax buffers are immutable, so the
     # async writer can't observe later parameter updates
+    states_blob = _trainer_states_blob(trainer) if trainer is not None \
+        else None
+    extra = dict(extra) if extra else {}
+    if keep is None:
+        keep = int(_config.get("MXNET_CKPT_KEEP")) or 0
     eng, var = _path_var(path)
 
     def write():
-        try:
+        os.makedirs(path, exist_ok=True)
+        backend = _backend()
+        # deterministic crash testing: 'torn' tears the npz payload,
+        # exception kinds abort the write (the engine var is poisoned and
+        # the failure surfaces at wait_for_saves/load_checkpoint)
+        kind = faults.check("checkpoint.write")
+        manifest = {"format": 1, "step": step, "backend": backend,
+                    "extra": extra}
+        if backend == "orbax":
+            if kind == "torn":
+                raise RuntimeError("injected torn fault at "
+                                   "checkpoint.write needs the npz "
+                                   "backend (MXNET_CKPT_BACKEND=npz)")
             import orbax.checkpoint as ocp
-        except ImportError:
-            ocp = None
-        if ocp is not None:
             # real save errors (disk full, sharded-array failures)
-            # propagate — only orbax's absence falls back to npz.  A
-            # partial step dir is removed so a later load can't prefer it
-            # over a good npz.
+            # propagate.  A partial step dir is removed so a later load
+            # can't prefer it over a good older checkpoint.
             step_dir = os.path.join(path, "step_%d" % step)
             try:
                 ckptr = ocp.StandardCheckpointer()
@@ -125,12 +247,43 @@ def save_checkpoint(path, params, step=0):
                 import shutil
                 shutil.rmtree(step_dir, ignore_errors=True)
                 raise
-            return
-        # single-host fallback: plain npz
-        os.makedirs(path, exist_ok=True)
-        arrays = {k: onp.asarray(v) for k, v in tree.items()}
-        with open(os.path.join(path, "step_%d.npz" % step), "wb") as f:
-            onp.savez(f, **arrays)
+            manifest["data"] = "step_%d" % step
+            manifest["arrays"] = {
+                k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                for k, v in tree.items()}
+        else:
+            arrays = {k: onp.asarray(v) for k, v in tree.items()}
+            buf = io.BytesIO()
+            onp.savez(buf, **arrays)
+            data = buf.getvalue()
+            final = os.path.join(path, "step_%d.npz" % step)
+            if kind == "torn":
+                # simulate the legacy non-atomic writer dying mid-write:
+                # half the payload lands at the final path.  The manifest
+                # below carries the TRUE checksums, so verification must
+                # reject this step and fall back.
+                with open(final, "wb") as f:
+                    f.write(data[:max(1, len(data) // 2)])
+            else:
+                _atomic_write(final, data)
+            manifest["data"] = "step_%d.npz" % step
+            manifest["arrays"] = {
+                k: {"shape": list(v.shape), "dtype": v.dtype.str,
+                    "crc32": _crc(v)}
+                for k, v in arrays.items()}
+        if states_blob is not None:
+            states_name = "step_%d.states" % step
+            _atomic_write(os.path.join(path, states_name), states_blob)
+            manifest["states"] = states_name
+            manifest["states_crc32"] = zlib.crc32(states_blob) & 0xFFFFFFFF
+        # manifest LAST: its presence marks the step complete (a crash
+        # before this point leaves no manifest → step invalid → the
+        # previous checkpoint stays the newest valid one)
+        _atomic_write(_manifest_path(path, step),
+                      json.dumps(manifest, indent=1).encode())
+        _fsync_dir(path)
+        if keep:
+            _prune(path, keep)
 
     # async: the write runs on an engine worker under the path's write
     # var; training continues while bytes land
@@ -138,11 +291,155 @@ def save_checkpoint(path, params, step=0):
     return path
 
 
+def _prune(path, keep):
+    """Drop everything but the newest `keep` steps (manifest first, so a
+    crash mid-prune can't leave a manifest pointing at deleted data)."""
+    steps = sorted(list_steps(path))
+    for s in steps[:-keep] if keep < len(steps) else []:
+        try:
+            os.remove(_manifest_path(path, s))
+        except OSError:
+            pass
+        for name in ("step_%d.npz" % s, "step_%d.states" % s):
+            try:
+                os.remove(os.path.join(path, name))
+            except OSError:
+                pass
+        step_dir = os.path.join(path, "step_%d" % s)
+        if os.path.isdir(step_dir):
+            import shutil
+            shutil.rmtree(step_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# discovery + verification
+# ---------------------------------------------------------------------------
+def list_steps(path):
+    """All step numbers present (manifests, plus legacy npz/orbax steps
+    written before manifests existed)."""
+    path = os.path.abspath(path)
+    steps = set()
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    for n in names:
+        for pat in (_MANIFEST_RE, _NPZ_RE, _DIR_RE):
+            m = pat.match(n)
+            if m:
+                steps.add(int(m.group(1)))
+    return sorted(steps)
+
+
+def verify_checkpoint(path, step):
+    """(ok, problems): checks the step's manifest, data-file presence,
+    and per-array crc32 checksums (npz backend).  Legacy steps without a
+    manifest are verified by loadability alone."""
+    path = os.path.abspath(path)
+    problems = []
+    man = _read_manifest(path, step)
+    npz = os.path.join(path, "step_%d.npz" % step)
+    ocp_dir = os.path.join(path, "step_%d" % step)
+    if man is None:
+        if os.path.exists(_manifest_path(path, step)):
+            return False, ["unreadable manifest"]
+        # legacy (pre-manifest) checkpoint: best-effort loadability check
+        if os.path.isdir(ocp_dir):
+            return True, []
+        if os.path.isfile(npz):
+            try:
+                with onp.load(npz) as data:
+                    data.files  # forces the zip directory read
+                return True, []
+            except Exception as e:
+                return False, ["legacy npz unreadable: %s" % e]
+        return False, ["no data for step %d" % step]
+    data_name = man.get("data")
+    data_path = os.path.join(path, data_name) if data_name else None
+    if data_path is None or not os.path.exists(data_path):
+        return False, ["data file %r missing" % data_name]
+    if man.get("backend") == "npz":
+        try:
+            with onp.load(data_path) as data:
+                for k, meta in (man.get("arrays") or {}).items():
+                    if k not in data.files:
+                        problems.append("array %r missing" % k)
+                        continue
+                    arr = data[k]
+                    if "crc32" in meta and _crc(arr) != meta["crc32"]:
+                        problems.append("array %r checksum mismatch" % k)
+        except Exception as e:
+            problems.append("npz unreadable: %s" % e)
+    states = man.get("states")
+    if states:
+        sp = os.path.join(path, states)
+        try:
+            with open(sp, "rb") as f:
+                blob = f.read()
+            if man.get("states_crc32") is not None and \
+                    zlib.crc32(blob) & 0xFFFFFFFF != man["states_crc32"]:
+                problems.append("optimizer states checksum mismatch")
+        except OSError as e:
+            problems.append("states file unreadable: %s" % e)
+    return not problems, problems
+
+
+def _resolve_step(path, step):
+    """Pick the step to load: the requested one if valid, else the newest
+    valid one (with a warning).  step=None/'latest'/-1 → newest valid."""
+    explicit = step is not None and step != "latest" and int(step) >= 0
+    steps = list_steps(path)
+    order = []
+    if explicit:
+        step = int(step)
+        order = [step] + [s for s in sorted(steps, reverse=True)
+                          if s != step]
+    else:
+        order = sorted(steps, reverse=True)
+    for s in order:
+        ok, problems = verify_checkpoint(path, s)
+        if ok:
+            if explicit and s != step:
+                warnings.warn(
+                    "checkpoint step %d at %s is %s; falling back to "
+                    "newest valid step %d"
+                    % (step, path,
+                       "missing" if step not in steps else "corrupt "
+                       "(%s)" % "; ".join(
+                           verify_checkpoint(path, step)[1]), s))
+                from .. import profiler
+                profiler.record_event_stat("checkpoint.fallback")
+            return s
+        if explicit and s == step:
+            from .. import profiler
+            profiler.record_event_stat("checkpoint.invalid")
+    if explicit:
+        raise FileNotFoundError("no checkpoint at %s (step %d)"
+                                % (path, step))
+    raise FileNotFoundError("no valid checkpoint at %s" % path)
+
+
+def latest_step(path):
+    """Newest step that passes verification, or None."""
+    for s in sorted(list_steps(path), reverse=True):
+        if verify_checkpoint(path, s)[0]:
+            return s
+    return None
+
+
+# ---------------------------------------------------------------------------
+# load / resume
+# ---------------------------------------------------------------------------
 def load_checkpoint(path, params, step=0):
     """Restore into params (dict of name → Parameter/ndarray) in place;
-    sharded arrays are restored with their target sharding."""
+    sharded arrays are restored with their target sharding.
+
+    step: an int (that step, falling back to the newest valid one with a
+    warning if it is corrupt or missing), or None/'latest' for the
+    newest valid step."""
     path = os.path.abspath(path)
     wait_for_saves(path)  # pending async writes to this path land first
+    step = _resolve_step(path, step)
     loaded = None
     ocp_dir = os.path.join(path, "step_%d" % step)
     npz = os.path.join(path, "step_%d.npz" % step)
@@ -179,3 +476,27 @@ def load_checkpoint(path, params, step=0):
         elif isinstance(v, ndarray):
             v._set_data(new)
     return params
+
+
+def resume_training(path, params, trainer=None, step=None):
+    """Continue a killed run from the newest valid checkpoint (or a given
+    step): restores params in place, restores the trainer's optimizer
+    state when the step has one, and returns ``{"step": int, "extra":
+    dict}`` so the caller (e.g. the estimator's CheckpointHandler) can
+    fast-forward epoch/batch counters."""
+    path = os.path.abspath(path)
+    wait_for_saves(path)
+    s = _resolve_step(path, step)
+    load_checkpoint(path, params, step=s)
+    man = _read_manifest(path, s) or {}
+    if trainer is not None and man.get("states"):
+        with open(os.path.join(path, man["states"]), "rb") as f:
+            blob = f.read()
+        from ..optimizer import Updater
+        u = Updater(trainer._optimizer)
+        u.set_states(blob)
+        trainer._states = u.states
+        trainer._optimizer = u.optimizer
+        trainer._optimizer.param_dict = {
+            i: p for i, p in enumerate(trainer._params)}
+    return {"step": s, "extra": man.get("extra") or {}}

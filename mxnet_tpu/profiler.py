@@ -27,6 +27,7 @@ _AGG = {
     "enabled": False,
     "ops": {},      # name -> [count, total_s, min_s, max_s]
     "memory": {},   # counter name -> [samples, last, peak]
+    "events": {},   # name -> count (always on: fault trips, kv retries)
     "lock": threading.Lock(),
 }
 
@@ -55,6 +56,15 @@ def record_counter(name, **values):
         _emit(name, "counter", "C", time.time(), dict(values))
 
 
+def record_event_stat(name, n=1):
+    """Count a discrete event (fault-injection trip, kvstore retry,
+    checkpoint fallback).  Unlike op stats these are not gated on
+    aggregate_stats=True — they are rare and operators need them after
+    the fact; read back via aggregate_stats()['events']."""
+    with _AGG["lock"]:
+        _AGG["events"][name] = _AGG["events"].get(name, 0) + n
+
+
 def record_memory_stat(name, value):
     with _AGG["lock"]:
         st = _AGG["memory"].get(name)
@@ -76,13 +86,15 @@ def aggregate_stats():
                for n, (c, t, lo, hi) in _AGG["ops"].items()}
         mem = {n: {"samples": s, "last_bytes": last, "peak_bytes": peak}
                for n, (s, last, peak) in _AGG["memory"].items()}
-    return {"ops": ops, "memory": mem}
+        events = dict(_AGG["events"])
+    return {"ops": ops, "memory": mem, "events": events}
 
 
 def reset_stats():
     with _AGG["lock"]:
         _AGG["ops"].clear()
         _AGG["memory"].clear()
+        _AGG["events"].clear()
 
 
 def get_summary(sort_by="total", ascending=False):
@@ -110,6 +122,11 @@ def get_summary(sort_by="total", ascending=False):
             lines.append("  %-28s %10d %14d %14d" % (
                 name[:28], st["samples"], st["last_bytes"],
                 st["peak_bytes"]))
+    if snap["events"]:
+        lines.append("  Event counters")
+        lines.append("  %-28s %10s" % ("Name", "Count"))
+        for name, count in sorted(snap["events"].items()):
+            lines.append("  %-28s %10d" % (name[:28], count))
     return "\n".join(lines)
 
 

@@ -68,6 +68,8 @@ def main():
         result["params_digest"] = sum(
             float(onp.abs(onp.asarray(v)).sum()) for v in params.values())
         result["params"] = params
+        # observable fault-injection activity (MXNET_FAULT_SPEC runs)
+        result["fault_trips"] = mx.faults.stats()["tripped"]
 
     elif mode == "p3":
         # big-array slicing: value larger than the slice threshold moves
@@ -101,6 +103,31 @@ def main():
         if expect is not None:
             onp.testing.assert_allclose(out.asnumpy(), expect, atol=1e-6)
         result["gc_ok"] = True
+
+    elif mode == "die":
+        # fault-tolerance: rank 1 vanishes mid-round (preemption); rank
+        # 0's sync pull must fail FAST with a diagnostic naming the dead
+        # rank (stall watchdog, MXNET_KV_STALL_SEC) instead of hanging.
+        kv.init("5", mxnp.zeros((2, 2)))
+        if rank == 1:
+            result["die_ok"] = True
+            with open(os.path.join(out_dir, "worker%d.json" % rank),
+                      "w") as f:
+                json.dump(result, f)
+            return  # exit without pushing — the simulated preemption
+        kv.push("5", mxnp.ones((2, 2)))
+        out = mxnp.zeros((2, 2))
+        try:
+            kv.pull("5", out=out)
+            result["stall_ok"] = False
+            result["stall_error"] = "pull returned despite dead rank"
+        except TimeoutError as e:
+            result["stall_ok"] = "rank(s) [1]" in str(e)
+            result["stall_error"] = str(e)
+        with open(os.path.join(out_dir, "worker%d.json" % rank), "w") as f:
+            json.dump(result, f)
+        kv.stop_servers()
+        return
 
     elif mode == "server_opt":
         # update_on_kvstore: optimizer runs server-side

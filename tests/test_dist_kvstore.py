@@ -14,11 +14,13 @@ WORKER = os.path.join(REPO, "tests", "dist_worker.py")
 LAUNCH = os.path.join(REPO, "tools", "launch.py")
 
 
-def _launch(tmp_path, mode, n=2, s=1, timeout=180):
+def _launch(tmp_path, mode, n=2, s=1, timeout=180, extra_env=None):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("XLA_FLAGS", None)  # workers don't need the 8-device mesh
+    env.pop("MXNET_FAULT_SPEC", None)  # only injected explicitly
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     r = subprocess.run(
         [sys.executable, LAUNCH, "-n", str(n), "-s", str(s),
          sys.executable, WORKER, str(tmp_path), mode],
@@ -75,3 +77,105 @@ def test_dist_update_on_kvstore(tmp_path):
     results = _launch(tmp_path, "server_opt", n=2, s=1)
     digests = [r["params_digest"] for r in results]
     assert digests[0] == pytest.approx(digests[1], rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fault tolerance (multi-process; the fast deterministic matrix is in
+# test_faults.py)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.faults
+def test_dist_sync_faulty_transport_bit_identical(tmp_path):
+    """Acceptance: a 2-worker dist_sync run with seeded transport faults
+    (connection resets on send AND recv) finishes with final weights
+    bit-identical to the fault-free run — bounded retry + reconnect +
+    server-side (key, rank, seq) dedup never drop or double-apply a
+    gradient."""
+    clean_dir = tmp_path / "clean"
+    fault_dir = tmp_path / "faulty"
+    clean_dir.mkdir()
+    fault_dir.mkdir()
+    clean = _launch(clean_dir, "trainer", n=2, s=1)
+    faulty = _launch(
+        fault_dir, "trainer", n=2, s=1,
+        extra_env={"MXNET_FAULT_SPEC":
+                   "kvstore.send:reset@p=0.05;kvstore.recv:reset@p=0.03",
+                   "MXNET_KV_BACKOFF_MS": "5"})
+    assert any(sum(r.get("fault_trips", {}).values()) > 0
+               for r in faulty), "fault spec injected nothing"
+    for rank in range(2):
+        pc, pf = clean[rank]["params"], faulty[rank]["params"]
+        assert pc.keys() == pf.keys()
+        for k in pc:
+            onp.testing.assert_array_equal(
+                onp.asarray(pc[k]), onp.asarray(pf[k]),
+                err_msg="faulty run diverged in %s (rank %d)" % (k, rank))
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_dist_kill_worker_stall_diagnostic(tmp_path):
+    """A worker vanishing mid-round (preemption) must surface as a FAST
+    TimeoutError naming the dead rank — not an infinite hang."""
+    results = _launch(tmp_path, "die", n=2, s=1, timeout=120,
+                      extra_env={"MXNET_KV_STALL_SEC": "3"})
+    assert results[1]["die_ok"]
+    assert results[0]["stall_ok"], results[0].get("stall_error")
+    assert "stalled" in results[0]["stall_error"]
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_kill9_mid_save_leaves_loadable_checkpoint(tmp_path):
+    """kill -9 a process mid-checkpoint-loop: the newest VALID step must
+    always load, and its contents must be internally consistent (every
+    array carries its step's value — no torn mix of two steps)."""
+    import signal
+    import subprocess
+    import time
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    script = (
+        "import os, sys\n"
+        "os.environ['MXNET_CKPT_BACKEND'] = 'npz'\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from mxnet_tpu import np as mxnp\n"
+        "from mxnet_tpu.parallel import save_checkpoint, wait_for_saves\n"
+        "d = sys.argv[1]\n"
+        "for s in range(10000):\n"
+        "    save_checkpoint(d, {'a': mxnp.ones(2048) * s,\n"
+        "                        'b': mxnp.ones(2048) * s}, step=s)\n"
+        "    wait_for_saves(d)\n"
+    )
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.Popen([sys.executable, "-c", script, ckpt_dir],
+                         env=env, cwd=REPO,
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.isdir(ckpt_dir) and any(
+                f.endswith(".manifest.json")
+                for f in os.listdir(ckpt_dir)):
+            break
+        time.sleep(0.05)
+    time.sleep(0.4)  # let a save be in flight
+    p.send_signal(signal.SIGKILL)
+    p.wait(timeout=30)
+
+    env2 = dict(os.environ)
+    env2["MXNET_CKPT_BACKEND"] = "npz"
+    os.environ["MXNET_CKPT_BACKEND"] = "npz"
+    try:
+        from mxnet_tpu import np as mxnp
+        from mxnet_tpu.parallel import latest_step, load_checkpoint
+        s = latest_step(ckpt_dir)
+        assert s is not None, "no valid checkpoint survived kill -9"
+        a, b = mxnp.zeros(2048), mxnp.zeros(2048)
+        load_checkpoint(ckpt_dir, {"a": a, "b": b}, step="latest")
+        onp.testing.assert_array_equal(a.asnumpy(), onp.full(2048, s))
+        onp.testing.assert_array_equal(b.asnumpy(), onp.full(2048, s))
+    finally:
+        os.environ.pop("MXNET_CKPT_BACKEND", None)

@@ -72,6 +72,43 @@ def test_checkpoint_handler(tmp_path):
     net2.load_parameters(os.path.join(str(tmp_path), "toy-epoch3.params"))
 
 
+@pytest.mark.faults
+def test_checkpoint_handler_resume(tmp_path):
+    """resume=True: a new run picks up weights, optimizer state, and the
+    epoch counter from the last (atomically written) checkpoint, so a
+    killed training job continues instead of restarting."""
+    ds = _toy_data(n=32)
+    loader = DataLoader(ds, batch_size=16)
+    net = _net()
+    est = Estimator(net=net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.05,
+                                           "momentum": 0.9}))
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                             epoch_period=1, resume=True)
+    est.fit(train_data=loader, epochs=2, event_handlers=[ckpt])
+    assert os.path.isfile(os.path.join(str(tmp_path), "toy-resume.json"))
+    ref = {k: p.data().asnumpy().copy()
+           for k, p in net.collect_params().items()}
+
+    # "restart after a kill": fresh net/trainer/handler, same model_dir
+    net2 = _net()
+    est2 = Estimator(net=net2, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                     trainer=gluon.Trainer(net2.collect_params(), "sgd",
+                                           {"learning_rate": 0.05,
+                                            "momentum": 0.9}))
+    ckpt2 = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                              epoch_period=1, resume=True)
+    ckpt2.train_begin(est2)
+    assert ckpt2.current_epoch == 2  # counters restored
+    for k, p in net2.collect_params().items():
+        onp.testing.assert_array_equal(p.data().asnumpy(), ref[k])
+    # continuing trains onward and tags keep counting from the restart
+    est2.fit(train_data=loader, epochs=1, event_handlers=[ckpt2])
+    assert os.path.isfile(os.path.join(str(tmp_path),
+                                       "toy-epoch3.params"))
+
+
 def test_early_stopping_handler():
     class FakeMetric:
         """Metric that stops improving after 2 epochs."""

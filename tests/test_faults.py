@@ -1,0 +1,555 @@
+"""Fault-matrix suite: deterministic fault injection (mxnet_tpu.faults)
+exercised at every site — kvstore transport retry/reconnect, server-side
+replay dedup, stall watchdogs, and crash-safe checkpoints.  Everything
+here is in-process and deterministic (tier-1); the multi-process kill
+tests live in test_dist_kvstore.py marked `slow`."""
+import os
+import socket
+import threading
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, np as mxnp, profiler
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry / spec grammar
+# ---------------------------------------------------------------------------
+def test_spec_grammar():
+    rules = faults.parse_spec(
+        "kvstore.send:reset@p=0.05;checkpoint.write:torn@n=3;"
+        "server.apply:drop@n=2,max=1,seed=9")
+    assert [r.site for r in rules] == ["kvstore.send", "checkpoint.write",
+                                       "server.apply"]
+    assert rules[0].p == 0.05 and rules[0].n == 0
+    assert rules[1].n == 3
+    assert rules[2].n == 2 and rules[2].max_trips == 1
+    assert faults.parse_spec("") == []
+    assert faults.parse_spec("  ;  ") == []
+
+
+@pytest.mark.parametrize("bad", ["site-only", "a:unknownkind",
+                                 "a:reset@q=1", "a:reset@p=x"])
+def test_spec_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_every_nth_trips_deterministically():
+    rule = faults.FaultRule("x", "error", n=3)
+    got = [rule.should_trip() for _ in range(9)]
+    assert got == [False, False, True] * 3
+    assert rule.trips == 3 and rule.calls == 9
+
+
+def test_probability_is_seeded_and_reproducible():
+    a = faults.FaultRule("x", "error", p=0.3, seed=5)
+    b = faults.FaultRule("x", "error", p=0.3, seed=5)
+    c = faults.FaultRule("x", "error", p=0.3, seed=6)
+    seq_a = [a.should_trip() for _ in range(50)]
+    seq_b = [b.should_trip() for _ in range(50)]
+    seq_c = [c.should_trip() for _ in range(50)]
+    assert seq_a == seq_b
+    assert seq_a != seq_c  # decorrelated by seed
+    assert any(seq_a) and not all(seq_a)
+
+
+def test_max_trips_caps_injection():
+    with faults.inject("site.capped", "error", n=1, max_trips=2):
+        trips = 0
+        for _ in range(5):
+            try:
+                faults.check("site.capped")
+            except RuntimeError:
+                trips += 1
+        assert trips == 2
+
+
+def test_check_raises_mapped_exceptions():
+    with faults.inject("s.reset", "reset"):
+        with pytest.raises(ConnectionResetError):
+            faults.check("s.reset")
+    with faults.inject("s.timeout", "timeout"):
+        with pytest.raises(socket.timeout):
+            faults.check("s.timeout")
+    with faults.inject("s.err", "error"):
+        with pytest.raises(RuntimeError):
+            faults.check("s.err")
+    # soft kinds are returned, not raised
+    with faults.inject("s.soft", "torn"):
+        assert faults.check("s.soft") == "torn"
+    with faults.inject("s.drop", "drop"):
+        assert faults.check("s.drop") == "drop"
+    # untouched site never trips
+    assert faults.check("s.other") is None
+
+
+def test_env_spec_and_reset(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "env.site:reset@n=1")
+    faults.reset()  # spec re-read on next check
+    with pytest.raises(ConnectionResetError):
+        faults.check("env.site")
+    monkeypatch.delenv("MXNET_FAULT_SPEC")
+    faults.reset()
+    assert faults.check("env.site") is None
+
+
+def test_trip_counters_exported_via_profiler():
+    profiler.reset_stats()
+    with faults.inject("prof.site", "error", n=1):
+        for _ in range(3):
+            with pytest.raises(RuntimeError):
+                faults.check("prof.site")
+    assert faults.stats()["tripped"]["prof.site"] == 3
+    assert profiler.aggregate_stats()["events"]["fault.prof.site"] == 3
+    assert "fault.prof.site" in profiler.get_summary()
+    profiler.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# kvstore transport (in-process server + worker, real sockets)
+# ---------------------------------------------------------------------------
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_server(port, num_workers=1, sync=True, stall_sec=0):
+    from mxnet_tpu.kvstore.dist import KVStoreDistServer
+    srv = KVStoreDistServer(port=port, num_workers=num_workers, sync=sync,
+                            stall_sec=stall_sec)
+    ready = threading.Event()
+    t = threading.Thread(target=srv.serve, args=(ready,), daemon=True)
+    t.start()
+    assert ready.wait(10)
+    return srv, t
+
+
+def _stop_server(srv, t):
+    with srv.cond:
+        srv._stop = True
+        srv.cond.notify_all()
+    t.join(5)
+
+
+@pytest.fixture
+def kv_cluster(monkeypatch):
+    """One in-process server shard + a KVStoreDist worker over real
+    localhost sockets, with fast retry/backoff knobs."""
+    port = _free_port()
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "15")
+    monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "5")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    srv, t = _start_server(port, num_workers=1)
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist("dist_sync")
+    yield srv, kv, port
+    kv.stop_servers()
+    kv.close()
+    _stop_server(srv, t)
+
+
+def test_transport_retries_through_send_faults(kv_cluster):
+    srv, kv, _port = kv_cluster
+    kv.init("k", mxnp.ones((2, 3)))
+    out = mxnp.zeros((2, 3))
+    with faults.inject("kvstore.send", "reset", n=2):
+        kv.push("k", mxnp.ones((2, 3)) * 4)
+        kv.pull("k", out=out)
+    onp.testing.assert_array_equal(out.asnumpy(), onp.full((2, 3), 4.0))
+    assert faults.stats()["tripped"]["kvstore.send"] >= 1
+
+
+def test_transport_retries_through_recv_faults(kv_cluster):
+    srv, kv, _port = kv_cluster
+    kv.init("k", mxnp.zeros(4))
+    out = mxnp.zeros(4)
+    # recv fault: the request was processed server-side, the reply is
+    # lost — the resent push MUST be dedup'd (never double-applied)
+    with faults.inject("kvstore.recv", "reset", n=3, max_trips=2):
+        kv.push("k", mxnp.ones(4))
+        kv.pull("k", out=out)
+        kv.push("k", mxnp.ones(4))
+        kv.pull("k", out=out)
+    onp.testing.assert_array_equal(out.asnumpy(), onp.ones(4))
+
+
+def test_server_dedups_replayed_push_after_dropped_ack(kv_cluster):
+    srv, kv, _port = kv_cluster
+    kv.init("k", mxnp.zeros((2, 2)))
+    out = mxnp.zeros((2, 2))
+    # the ack of an APPLIED push is dropped: worker retries, server must
+    # ack from the dedup table without re-applying the gradient
+    with faults.inject("server.apply", "drop", n=1, max_trips=1):
+        kv.push("k", mxnp.ones((2, 2)) * 3)
+        kv.pull("k", out=out)
+    onp.testing.assert_array_equal(out.asnumpy(), onp.full((2, 2), 3.0))
+    assert srv._dup_pushes >= 1
+
+
+def test_transport_reconnects_after_broken_socket(kv_cluster):
+    srv, kv, _port = kv_cluster
+    kv.init("k", mxnp.ones(3))
+    # sever the worker's socket behind its back (NAT reset analog)
+    kv._conns[0].sock.shutdown(socket.SHUT_RDWR)
+    kv._conns[0].sock.close()
+    out = mxnp.zeros(3)
+    kv.pull("k", out=out)  # transparent reconnect
+    onp.testing.assert_array_equal(out.asnumpy(), onp.ones(3))
+
+
+def test_restart_server_midrun(monkeypatch):
+    """A server shard dying and coming back (state carried over, the
+    preemption-recovery pattern) is transparent to the worker."""
+    from mxnet_tpu.kvstore.dist import KVStoreDist, KVStoreDistServer
+    port = _free_port()
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "15")
+    monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "5")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    srv, t = _start_server(port, num_workers=1)
+    kv = KVStoreDist("dist_sync")
+    kv.init("k", mxnp.ones(3))
+    _stop_server(srv, t)  # shard dies; its port is released on join
+    srv2 = KVStoreDistServer(port=port, num_workers=1, sync=True,
+                             stall_sec=0)
+    srv2.store = srv.store  # recovered state (replication analog)
+    srv2.applied_round = srv.applied_round
+    srv2._push_seen = srv._push_seen
+    ready = threading.Event()
+    t2 = threading.Thread(target=srv2.serve, args=(ready,), daemon=True)
+    t2.start()
+    assert ready.wait(10)
+    try:
+        kv.push("k", mxnp.ones(3) * 6)
+        out = mxnp.zeros(3)
+        kv.pull("k", out=out)
+        onp.testing.assert_array_equal(out.asnumpy(), onp.full(3, 6.0))
+    finally:
+        kv.stop_servers()
+        kv.close()
+        _stop_server(srv2, t2)
+
+
+def test_retries_exhausted_raises_connection_error(monkeypatch):
+    port = _free_port()
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "15")
+    monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "2")
+    monkeypatch.setenv("MXNET_KV_RETRIES", "1")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    srv, t = _start_server(port, num_workers=1)
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist("dist_sync")
+    kv.init("k", mxnp.ones(2))
+    _stop_server(srv, t)
+    # shrink the reconnect deadline so the failure is quick
+    for c in kv._conns:
+        c.sock_timeout = 0.5
+        c.mark_broken()
+    with pytest.raises(ConnectionError):
+        kv._conns[0].request({"op": "pull", "key": "k", "round": 0,
+                              "rank": 0, "seq": 999})
+    kv.close()
+
+
+def test_barrier_stall_watchdog_names_missing_ranks(monkeypatch):
+    port = _free_port()
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "15")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")  # rank 1 never shows up
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    srv, t = _start_server(port, num_workers=2, stall_sec=0.6)
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist("dist_sync")
+    try:
+        with pytest.raises(TimeoutError, match=r"rank\(s\) \[1\]"):
+            kv.barrier()
+    finally:
+        kv.close()
+        _stop_server(srv, t)
+
+
+def test_pull_stall_watchdog_names_missing_ranks(monkeypatch):
+    port = _free_port()
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "15")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    srv, t = _start_server(port, num_workers=2, stall_sec=0.6)
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist("dist_sync")
+    try:
+        # init on the server directly (rank-0 init would barrier → stall)
+        with srv.cond:
+            srv.store["k"] = onp.zeros(2, onp.float32)
+            srv.applied_round["k"] = 0
+        kv.push("k", mxnp.ones(2))  # buffered: rank 1 never pushes
+        with pytest.raises(TimeoutError) as ei:
+            out = mxnp.zeros(2)
+            kv.pull("k", out=out)
+        assert "rank(s) [1]" in str(ei.value)
+        assert "stalled" in str(ei.value)
+    finally:
+        kv.close()
+        _stop_server(srv, t)
+
+
+def test_server_prunes_finished_conn_threads(kv_cluster):
+    srv, kv, port = kv_cluster
+    kv.init("k", mxnp.ones(2))
+    for _ in range(20):  # churn: connect + immediately disconnect
+        c = socket.create_connection(("127.0.0.1", port), timeout=5)
+        c.close()
+    # one more accept prunes the dead threads from the list
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    import time
+    time.sleep(0.5)
+    c2 = socket.create_connection(("127.0.0.1", port), timeout=5)
+    time.sleep(0.3)
+    assert len(srv._threads) < 10, len(srv._threads)
+    c.close()
+    c2.close()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpoints
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def npz_ckpt(monkeypatch):
+    monkeypatch.setenv("MXNET_CKPT_BACKEND", "npz")
+    yield
+
+
+def test_checkpoint_atomic_npz_layout(tmp_path, npz_ckpt):
+    from mxnet_tpu.parallel import (save_checkpoint, load_checkpoint,
+                                    wait_for_saves, verify_checkpoint)
+    d = str(tmp_path)
+    save_checkpoint(d, {"x": mxnp.arange(6).reshape(2, 3)}, step=0)
+    wait_for_saves(d)
+    assert sorted(os.listdir(d)) == ["step_0.manifest.json", "step_0.npz"]
+    assert verify_checkpoint(d, 0) == (True, [])
+    tgt = mxnp.zeros((2, 3))
+    load_checkpoint(d, {"x": tgt}, step=0)
+    onp.testing.assert_array_equal(tgt.asnumpy(),
+                                   onp.arange(6).reshape(2, 3))
+
+
+def test_checkpoint_torn_write_falls_back_to_last_good(tmp_path, npz_ckpt):
+    from mxnet_tpu.parallel import (save_checkpoint, load_checkpoint,
+                                    wait_for_saves, latest_step,
+                                    verify_checkpoint)
+    d = str(tmp_path)
+    save_checkpoint(d, {"x": mxnp.ones(4) * 9}, step=0)
+    wait_for_saves(d)
+    with faults.inject("checkpoint.write", "torn", n=1):
+        save_checkpoint(d, {"x": mxnp.ones(4) * 7}, step=1)
+        wait_for_saves(d)
+    ok, problems = verify_checkpoint(d, 1)
+    assert not ok and problems
+    assert latest_step(d) == 0
+    tgt = mxnp.zeros(4)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        load_checkpoint(d, {"x": tgt}, step=1)  # corrupt → falls back
+    assert any("falling back" in str(x.message) for x in w)
+    onp.testing.assert_array_equal(tgt.asnumpy(), onp.full(4, 9.0))
+
+
+def test_checkpoint_crash_fault_surfaces_and_keeps_last_good(
+        tmp_path, npz_ckpt):
+    from mxnet_tpu.parallel import (save_checkpoint, load_checkpoint,
+                                    wait_for_saves, latest_step)
+    d = str(tmp_path)
+    save_checkpoint(d, {"x": mxnp.ones(2)}, step=0)
+    wait_for_saves(d)
+    with faults.inject("checkpoint.write", "crash", n=1):
+        save_checkpoint(d, {"x": mxnp.ones(2) * 5}, step=1)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            wait_for_saves(d)
+    assert latest_step(d) == 0
+    tgt = mxnp.zeros(2)
+    load_checkpoint(d, {"x": tgt}, step="latest")
+    onp.testing.assert_array_equal(tgt.asnumpy(), onp.ones(2))
+
+
+def test_checkpoint_corrupt_bytes_detected(tmp_path, npz_ckpt):
+    from mxnet_tpu.parallel import (save_checkpoint, wait_for_saves,
+                                    verify_checkpoint)
+    d = str(tmp_path)
+    save_checkpoint(d, {"x": mxnp.arange(100)}, step=2)
+    wait_for_saves(d)
+    npz = os.path.join(d, "step_2.npz")
+    blob = bytearray(open(npz, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # single flipped byte mid-payload
+    with open(npz, "wb") as f:
+        f.write(blob)
+    ok, problems = verify_checkpoint(d, 2)
+    assert not ok, problems
+
+
+def test_checkpoint_retention_keeps_newest(tmp_path, npz_ckpt):
+    from mxnet_tpu.parallel import (save_checkpoint, wait_for_saves,
+                                    list_steps)
+    d = str(tmp_path)
+    for s in range(1, 5):
+        save_checkpoint(d, {"x": mxnp.ones(2) * s}, step=s, keep=2)
+    wait_for_saves(d)
+    assert list_steps(d) == [3, 4]
+
+
+def test_checkpoint_legacy_npz_without_manifest_loads(tmp_path, npz_ckpt):
+    from mxnet_tpu.parallel import load_checkpoint, latest_step
+    d = str(tmp_path)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "step_7.npz"), "wb") as f:
+        onp.savez(f, x=onp.full(3, 2.5, onp.float32))
+    assert latest_step(d) == 7
+    tgt = mxnp.zeros(3)
+    load_checkpoint(d, {"x": tgt}, step=7)
+    onp.testing.assert_array_equal(tgt.asnumpy(), onp.full(3, 2.5))
+
+
+def test_checkpoint_missing_still_raises(tmp_path, npz_ckpt):
+    from mxnet_tpu.parallel import load_checkpoint
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path / "none"), {"x": mxnp.zeros(2)})
+
+
+def test_resume_matches_uninterrupted_run(tmp_path, npz_ckpt):
+    """The acceptance bar: train 3 steps, checkpoint (params + optimizer
+    momentum), restore into a FRESH net/trainer, train 3 more — the
+    result is bit-identical to 6 uninterrupted steps."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import (save_checkpoint, wait_for_saves,
+                                    resume_training)
+
+    def make(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4, activation="relu"),
+                nn.Dense(2, in_units=8))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        return net, tr
+
+    def step(net, tr, rng):
+        x = mxnp.array(rng.rand(8, 4).astype(onp.float32))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(8)
+
+    net1, tr1 = make(7)
+    rng = onp.random.RandomState(0)
+    for _ in range(6):
+        step(net1, tr1, rng)
+    ref = {k: p.data().asnumpy() for k, p in
+           net1.collect_params().items()}
+
+    net2, tr2 = make(7)
+    rng = onp.random.RandomState(0)
+    for _ in range(3):
+        step(net2, tr2, rng)
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, net2.collect_params(), step=3, trainer=tr2,
+                    extra={"epoch": 1})
+    wait_for_saves(d)
+
+    net3, tr3 = make(99)  # different init — must be fully overwritten
+    info = resume_training(d, net3.collect_params(), trainer=tr3)
+    assert info == {"step": 3, "extra": {"epoch": 1}}
+    for _ in range(3):
+        step(net3, tr3, rng)
+    for k, p in net3.collect_params().items():
+        onp.testing.assert_array_equal(p.data().asnumpy(), ref[k])
+
+
+def test_dist_sync_training_under_faults_bit_identical(monkeypatch):
+    """In-process acceptance check: a dist_sync training loop with send
+    AND recv faults injected (seeded p-based) converges to weights
+    bit-identical to the fault-free loop — retry + dedup never drop or
+    double-apply a gradient."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+
+    def run(spec):
+        faults.reset()
+        port = _free_port()
+        monkeypatch.setenv("MXNET_KV_TIMEOUT", "15")
+        monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "2")
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        if spec:
+            monkeypatch.setenv("MXNET_FAULT_SPEC", spec)
+        else:
+            monkeypatch.delenv("MXNET_FAULT_SPEC", raising=False)
+        srv, t = _start_server(port, num_workers=1)
+        kv = KVStoreDist("dist_sync")
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=6, activation="relu"), nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=kv)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        rng = onp.random.RandomState(1234)
+        for _ in range(5):
+            x = mxnp.array(rng.rand(8, 6).astype(onp.float32))
+            y = mxnp.array(rng.randint(0, 2, 8).astype(onp.float32))
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+        params = {k: p.data().asnumpy()
+                  for k, p in net.collect_params().items()}
+        tripped = dict(faults.stats()["tripped"])
+        kv.stop_servers()
+        kv.close()
+        _stop_server(srv, t)
+        faults.reset()
+        return params, tripped
+
+    clean, _ = run(None)
+    faulty, tripped = run("kvstore.send:reset@p=0.10;"
+                          "kvstore.recv:reset@p=0.05")
+    assert sum(tripped.values()) > 0, "spec injected nothing"
+    assert clean.keys() == faulty.keys()
+    for k in clean:
+        onp.testing.assert_array_equal(clean[k], faulty[k],
+                                       err_msg="divergence in %s" % k)
