@@ -445,6 +445,15 @@ def bench_serving():
 # config 4: data-parallel via kvstore=tpu_ici (imperative Trainer path)
 # ---------------------------------------------------------------------------
 def bench_resnet50_dp_kvstore():
+    """Data-parallel ResNet-50 through kvstore=tpu_ici, bucketed vs
+    per-key gradient communication (kvstore/bucketing.py).  The bucketed
+    number is the headline; the row ASSERTS — via Trainer.comm_stats() —
+    that the bucketed run issued at most ceil(total_grad_bytes /
+    bucket_size) + num_dtypes fused collectives per step and zero per-key
+    pushpulls, so a silent fallback to the ~160-collective per-key path
+    can never masquerade as a result."""
+    import math
+
     import mxnet_tpu as mx
     from mxnet_tpu import np as mxnp, autograd, gluon
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
@@ -453,47 +462,88 @@ def bench_resnet50_dp_kvstore():
     batch = 32 if on_tpu else 4
     iters = 20 if on_tpu else 2
 
-    mx.random.seed(0)
-    net = resnet50_v1(classes=1000)
-    net.initialize(mx.init.Xavier())
-    net.hybridize()
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    # aggregate_num=len(params): the whole optimizer update fuses into
-    # ONE XLA program (single signature → single compile), cutting the
-    # eager per-param dispatch chain that dominates this imperative path
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.05, "momentum": 0.9,
-                             "aggregate_num": 1000},
-                            kvstore="tpu_ici")
-    x = mxnp.random.uniform(size=(batch, 3, 224, 224))
-    y = mxnp.random.randint(0, 1000, size=(batch,))
+    def one(bucketing):
+        mx.random.seed(0)
+        net = resnet50_v1(classes=1000)
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        # aggregate_num=len(params): the whole optimizer update fuses into
+        # ONE XLA program (single signature → single compile), cutting the
+        # eager per-param dispatch chain that dominates this imperative
+        # path
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9,
+                                 "aggregate_num": 1000},
+                                kvstore="tpu_ici", bucketing=bucketing)
+        x = mxnp.random.uniform(size=(batch, 3, 224, 224))
+        y = mxnp.random.randint(0, 1000, size=(batch,))
 
-    def step():
-        with autograd.record():
-            loss = loss_fn(net(x), y)
-        loss.backward()
-        trainer.step(batch)
-        return loss  # async: the host fetch happens once per window
+        def step():
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(batch)
+            return loss  # async: the host fetch happens once per window
 
-    # warmup must cover EVERY bulk-segment variant the window will
-    # execute (first-touch step, post-fetch step, steady step, and the
-    # window-ending fetch): a single ~30 s remote compile landing inside
-    # the timed window would swamp the measurement
-    first = float(step().mean())  # compile + warmup (hard sync)
-    for _ in range(3):
-        loss = step()
-    warm = float(loss.mean())  # window-ending fetch variant
-
-    def window():
-        t0 = time.perf_counter()
-        for _ in range(iters):
+        # warmup must cover EVERY bulk-segment variant the window will
+        # execute (first-touch step, post-fetch step, steady step, and the
+        # window-ending fetch): a single ~30 s remote compile landing
+        # inside the timed window would swamp the measurement
+        first = float(step().mean())  # compile + warmup (hard sync)
+        for _ in range(3):
             loss = step()
-        last = float(loss.mean())  # single host fetch inside the window
-        dt = time.perf_counter() - t0
-        assert onp.isfinite(last) and last != first, (first, last, warm)
-        return batch * iters / dt
+        warm = float(loss.mean())  # window-ending fetch variant
 
-    return _best_window(window)
+        steps_run = [4]  # warmup steps so far
+
+        def window():
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = step()
+            steps_run[0] += iters
+            last = float(loss.mean())  # single host fetch in the window
+            dt = time.perf_counter() - t0
+            assert onp.isfinite(last) and last != first, (first, last, warm)
+            return batch * iters / dt
+
+        thr = _best_window(window)
+        comm = trainer.comm_stats()
+        if bucketing:
+            # the fused-collective-count assertion (acceptance): every
+            # step must have issued <= ceil(total_grad_bytes/bucket_bytes)
+            # + num_dtypes bucket collectives and NO per-key pushpulls
+            params = [p for p in net.collect_params().values()
+                      if p.grad_req != "null"]
+            total_bytes = sum(
+                int(onp.prod(p.shape)) * onp.dtype(p.dtype).itemsize
+                for p in params)
+            ndtypes = len({onp.dtype(p.dtype) for p in params})
+            bound = math.ceil(total_bytes / comm["bucket_bytes"]) + ndtypes
+            assert comm["bucketing"], "bucketing silently disabled"
+            assert comm["perkey_collectives"] == 0, (
+                "bucketed run fell back to %d per-key collectives"
+                % comm["perkey_collectives"])
+            assert comm["launches"] <= bound * steps_run[0], (
+                "bucketed run issued %d collectives over %d steps, bound "
+                "%d/step" % (comm["launches"], steps_run[0], bound))
+            comm["collective_bound_asserted"] = bound
+        return thr, comm
+
+    unbucketed_thr, _ = one(bucketing=False)
+    bucketed_thr, comm = one(bucketing=True)
+    return bucketed_thr, {
+        "imgs_per_sec_unbucketed": round(unbucketed_thr, 2),
+        "bucketed_speedup": round(bucketed_thr / unbucketed_thr, 3),
+        "comm_buckets_per_step": comm.get("launches_per_step"),
+        "comm_bucket_bytes": comm.get("bucket_bytes"),
+        "comm_collective_bound": comm.get("collective_bound_asserted"),
+        "comm_overlapped_launches": comm.get("overlapped_launches"),
+        "notes": "bucketed backward-overlapped gradient comm "
+                 "(MXNET_KV_BUCKET_KB fused buckets, grad-ready hook "
+                 "launches during backward); collective count asserted "
+                 "<= ceil(total_grad_bytes/bucket)+num_dtypes per step",
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -421,6 +421,27 @@ OVERRIDES = {
 }
 
 
+def calibrate(repeats=5, inner=8):
+    """Median ms of a fixed PURE-NUMPY workload (matmul + elementwise) —
+    a machine/load probe, deliberately untouched by any framework code
+    path.  The perf gate (tests/test_opperf_gate.py) divides its op
+    ratios by (calibrate() now / the committed value in
+    OPPERF_CALIB.json), so a loaded CI box — where every wall-clock
+    measurement inflates together — no longer reads as a framework
+    regression, while a real eager-path regression (framework-only, the
+    5-20x class) still fails the normalized bars."""
+    a = onp.random.RandomState(0).rand(256, 256).astype("float32")
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            b = a @ a
+            b = onp.exp(b * 1e-3) + a
+            b.sum()
+        samples.append((time.perf_counter() - t0) / inner * 1e3)
+    return statistics.median(samples)
+
+
 def enumerate_ops():
     """(qualified_name, callable) across the live op namespaces."""
     from mxnet_tpu.contrib import ops as cops
@@ -475,10 +496,15 @@ def _sync(out):
         out.wait_to_read()
 
 
-def bench_op(fn, args_thunk, needs_grad, warmup=3, iters=10, windows=3):
+def bench_op(fn, args_thunk, needs_grad, warmup=3, iters=10, windows=3,
+             agg="median"):
     """Median across windows of (window_time / iters); one sync per
-    window (eager steady state is async dispatch, not host RTT)."""
+    window (eager steady state is async dispatch, not host RTT).
+    ``agg='min'`` takes the best window instead — interference (GC
+    pauses, a competing lane's burst) only ever ADDS time, so min-of-N
+    approaches the true dispatch cost; the perf gate's retry uses it."""
     from mxnet_tpu import engine
+    pick = min if agg == "min" else statistics.median
     args, kwargs = args_thunk()
     nd_args = []
     for a in args:  # include arrays nested in list args (concat family)
@@ -498,7 +524,7 @@ def bench_op(fn, args_thunk, needs_grad, warmup=3, iters=10, windows=3):
                 out = fn(*args, **kwargs)
             _sync(out)
             fwd_samples.append((time.perf_counter() - t0) / iters * 1e3)
-    fwd_ms = statistics.median(fwd_samples)
+    fwd_ms = pick(fwd_samples)
 
     bwd_ms = None
     if needs_grad and nd_args:
@@ -523,14 +549,14 @@ def bench_op(fn, args_thunk, needs_grad, warmup=3, iters=10, windows=3):
                     run_bwd()
                 nd_args[0].grad.wait_to_read()
                 bwd_samples.append((time.perf_counter() - t0) / iters * 1e3)
-            bwd_ms = statistics.median(bwd_samples)
+            bwd_ms = pick(bwd_samples)
         except Exception:
             bwd_ms = None
     return fwd_ms, bwd_ms
 
 
 def run(names=None, iters=10, probe_only=False, verbose=True,
-        platform=None):
+        platform=None, windows=3, agg="median"):
     if platform:
         # must precede first backend use (the axon sitecustomize ignores
         # JAX_PLATFORMS, so the config API is the only reliable switch)
@@ -550,7 +576,8 @@ def run(names=None, iters=10, probe_only=False, verbose=True,
             rows.append({"op": qual})
             continue
         try:
-            fwd, bwd = bench_op(fn, spec[0], spec[1], iters=iters)
+            fwd, bwd = bench_op(fn, spec[0], spec[1], iters=iters,
+                                windows=windows, agg=agg)
         except Exception as e:
             skipped.append("%s (%s)" % (qual, type(e).__name__))
             continue

@@ -453,6 +453,31 @@ def note_eager_fallback():
 
 
 # ---------------------------------------------------------------------------
+# flush listeners: segment-boundary observability
+#
+# A listener is called (with the number of ops the segment held) after each
+# successful flush.  Consumers: kvstore/bucketing.py counts the segment
+# boundaries a bucketed step produces (the bucket launches ARE the intended
+# boundaries on dist stores — a per-param fallback would show up as many
+# more), and tests assert the single-program property of the in-process
+# bucket path.  Listeners must be cheap and must not record ops.
+# ---------------------------------------------------------------------------
+_flush_listeners = []
+
+
+def add_flush_listener(fn):
+    _flush_listeners.append(fn)
+    return fn
+
+
+def remove_flush_listener(fn):
+    try:
+        _flush_listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+# ---------------------------------------------------------------------------
 # flush: compile + run the pending segment
 # ---------------------------------------------------------------------------
 def flush():
@@ -631,6 +656,8 @@ def _flush_ops(ops):
     # ready implies the whole segment ran (single-program semantics)
     if out_vals:
         _track(out_vals[-1])
+    for fn in list(_flush_listeners):
+        fn(len(ops))
 
 
 def materialize(lazy):

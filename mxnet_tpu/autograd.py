@@ -61,6 +61,61 @@ def set_training(train_mode):
     return prev
 
 
+# ---------------------------------------------------------------------------
+# grad-ready completion hooks
+#
+# The bucketed-communication layer (kvstore/bucketing.py) needs to know the
+# moment a leaf's gradient is FINAL — its last tape contribution accumulated
+# — while the rest of the backward walk is still running, so a gradient
+# bucket can launch its fused pushpull overlapping the remaining backward
+# (the reference engine's priority-ordered push pipeline,
+# python/mxnet/gluon/trainer.py:395-407; PyTorch DDP's autograd hooks).
+# backward() counts, per marked leaf, how many reachable tape nodes still
+# reference it; when the count drains to zero the leaf's grad is written
+# immediately (instead of at the end of the walk) and its hooks fire.
+# ---------------------------------------------------------------------------
+_GRAD_READY_HOOKS = {}  # id(arr) -> (weakref(arr), [callbacks])
+
+
+def register_grad_ready_hook(arr, fn):
+    """Call ``fn(arr)`` each time a backward pass finalizes ``arr``'s
+    gradient (written to ``arr.grad`` per its grad_req).  Fires at most
+    once per backward per leaf, as early as the tape walk allows.  Returns
+    a handle for :func:`remove_grad_ready_hook`.  Exceptions raised by a
+    hook propagate out of ``backward()``."""
+    import weakref
+    key = id(arr)
+    entry = _GRAD_READY_HOOKS.get(key)
+    if entry is None or entry[0]() is not arr:
+        # weakref cleanup: a dead leaf must not pin its slot (and a
+        # recycled id() must not inherit a stale hook list)
+        ref = weakref.ref(
+            arr, lambda _r, k=key: _GRAD_READY_HOOKS.pop(k, None))
+        entry = (ref, [])
+        _GRAD_READY_HOOKS[key] = entry
+    entry[1].append(fn)
+    return (key, fn)
+
+
+def remove_grad_ready_hook(handle):
+    key, fn = handle
+    entry = _GRAD_READY_HOOKS.get(key)
+    if entry is not None:
+        try:
+            entry[1].remove(fn)
+        except ValueError:
+            pass
+        if not entry[1]:
+            _GRAD_READY_HOOKS.pop(key, None)
+
+
+def _fire_grad_ready(arr):
+    entry = _GRAD_READY_HOOKS.get(id(arr))
+    if entry is not None and entry[0]() is arr:
+        for fn in list(entry[1]):
+            fn(arr)
+
+
 class _RecordingStateScope:
     """Scope manager flipping (recording, training) like the reference's
     `_RecordingStateScope` (python/mxnet/autograd.py:33)."""
@@ -292,9 +347,71 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
 
     replay_mode = create_graph and is_recording()
 
+    # ---- per-leaf completion tracking (grad-ready hooks) ----------------
+    # remaining reachable-node references per marked leaf: when a leaf's
+    # count drains to zero mid-walk, its gradient is final — write it and
+    # fire hooks NOW so bucketed comm can launch overlapping the rest of
+    # the backward.  Only paid when hooks are registered.
+    hooks_live = bool(_GRAD_READY_HOOKS)
+    finalized = set()
+    pending_refs = {}
+    if hooks_live:
+        for n in nodes:
+            for inp in n.inputs:
+                if inp._node is None and inp._marked:
+                    pending_refs[id(inp)] = pending_refs.get(id(inp), 0) + 1
+
+    def _write_leaf_grad(arr, g):
+        """Write one finalized leaf gradient per its grad_req (the logic
+        previously inline in the tail loop).  Returns True if written."""
+        req = arr._grad_req
+        if req == "null":
+            return False
+        if isinstance(g, ndarray):
+            if req == "add" and arr._grad is not None:
+                g = _add_grads(arr._grad, g)
+            if arr._grad is None:
+                arr._grad = g
+            else:
+                # x.grad must remain the SAME ndarray attach_grad created
+                # (reference writes grads INTO the attached buffer, so user
+                # aliases stay live); transplant the value and the tape
+                # node (the node carries the replay closure higher-order
+                # differentiation needs)
+                arr._grad._buf = g._buf
+                arr._grad._node = g._node
+                arr._grad._out_index = g._out_index
+        elif req == "add" and arr._grad is not None:
+            arr._grad._data = arr._grad._data + g
+        else:
+            if arr._grad is None:
+                arr._grad = _wrap(g)
+            else:
+                arr._grad._data = g
+        return True
+
+    def _finalize_leaf(arr):
+        if id(arr) in finalized:
+            return
+        g = leaf_grads.get(id(arr))
+        if g is None:
+            return  # leaf never received a gradient this backward
+        finalized.add(id(arr))
+        if _write_leaf_grad(arr, g):
+            _fire_grad_ready(arr)
+
     for n in reversed(nodes):
         slot = cots[id(n)]
         if all(g is None for g in slot):
+            if hooks_live:
+                # a dead node still releases its references: its inputs'
+                # grads cannot change any more through this node
+                for inp in n.inputs:
+                    if inp._node is None and inp._marked:
+                        c = pending_refs.get(id(inp), 1) - 1
+                        pending_refs[id(inp)] = c
+                        if c <= 0:
+                            _finalize_leaf(inp)
             continue
         full = []
         for i, g in enumerate(slot):
@@ -353,37 +470,29 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
         if not retain_graph and not replay_mode:
             n.vjp_fn = None  # free residuals eagerly
             n.fn = None      # deferred-VJP nodes: drop the replay closure too
+        if hooks_live:
+            # this node's contributions (if any) are accumulated above, so
+            # releasing its references AFTER the accumulation is what makes
+            # a zero count mean "final"
+            for inp in n.inputs:
+                if inp._node is None and inp._marked:
+                    c = pending_refs.get(id(inp), 1) - 1
+                    pending_refs[id(inp)] = c
+                    if c <= 0:
+                        _finalize_leaf(inp)
 
     # ---- write results into .grad per grad_req --------------------------
-    from .ndarray import _wrap_value
+    # (leaves already finalized mid-walk by the hook machinery are skipped;
+    # head-seeded leaves with no tape references land here)
     for key, g in list(leaf_grads.items()):
         if isinstance(key, tuple):
             continue
         arr = leaf_grads[("arr", key)]
-        req = arr._grad_req
-        if req == "null":
+        if id(arr) in finalized:
             continue
-        if isinstance(g, ndarray):
-            if req == "add" and arr._grad is not None:
-                g = _add_grads(arr._grad, g)
-            if arr._grad is None:
-                arr._grad = g
-            else:
-                # x.grad must remain the SAME ndarray attach_grad created
-                # (reference writes grads INTO the attached buffer, so user
-                # aliases stay live); transplant the value and the tape
-                # node (the node carries the replay closure higher-order
-                # differentiation needs)
-                arr._grad._buf = g._buf
-                arr._grad._node = g._node
-                arr._grad._out_index = g._out_index
-        elif req == "add" and arr._grad is not None:
-            arr._grad._data = arr._grad._data + g
-        else:
-            if arr._grad is None:
-                arr._grad = _wrap_value(g)
-            else:
-                arr._grad._data = g
+        finalized.add(id(arr))
+        if _write_leaf_grad(arr, g):
+            _fire_grad_ready(arr)
 
     if not retain_graph:
         for h in heads:

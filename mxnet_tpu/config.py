@@ -104,6 +104,11 @@ register("MXNET_KVSTORE_SLICE_THRESHOLD", int, 40000, "honored",
 register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000, "honored",
          "dist: big-array slicing bound (alias of slice threshold)",
          "kvstore.dist.KVStoreDist")
+register("MXNET_KV_BUCKET_KB", int, 4096, "honored",
+         "gradient-bucket size in KB for bucketed backward-overlapped "
+         "communication (Trainer bucketing=): grads pack dtype-grouped in "
+         "reverse registration order into flat buckets of ~this size, one "
+         "fused pushpull each", "kvstore.bucketing.GradBucketer")
 register("MXNET_KVSTORE_SYNC", bool, True, "honored",
          "dist server default mode when the worker doesn't say",
          "kvstore.dist.KVStoreDistServer")

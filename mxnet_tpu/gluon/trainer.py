@@ -17,8 +17,17 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
+    """``bucketing``: pack gradients into flat ~MXNET_KV_BUCKET_KB fused
+    buckets launched as backward finalizes them (kvstore/bucketing.py).
+    ``None`` (default) enables it for multi-worker / dist stores without a
+    server-side optimizer; ``True`` forces it (still auto-disabled — with
+    a warning — for server-side-optimizer mode and sparse gradients,
+    where per-key semantics are load-bearing); ``False`` keeps the
+    per-key path."""
+
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 bucketing=None):
         if isinstance(params, dict):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -39,6 +48,10 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._compression_params = compression_params
         self._states = {}
+        self._bucketing = bucketing
+        self._bucketer = None
+        self._grad_hook_handles = []
+        self._perkey_collectives = 0  # per-key push/pull/pushpull count
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -92,6 +105,78 @@ class Trainer:
                     outs.append(p.data())
             if keys:
                 kv.broadcast(keys, vals, out=outs)
+        self._setup_bucketing()
+
+    def _setup_bucketing(self):
+        """Decide whether this trainer runs bucketed gradient comm and, if
+        so, build the GradBucketer + install grad-ready hooks so buckets
+        launch while backward is still running."""
+        kv = self._kvstore
+        if kv is None:
+            return
+        sparse = any(getattr(p, "_grad_stype", "default") != "default"
+                     for p in self._params)
+        # grad_req='add' accumulates over SEVERAL backwards before one
+        # step; bucket launches fire per backward, so they would ship
+        # partial gradients — keep those on the per-key path
+        accum = any(p.grad_req == "add" for p in self._params)
+        sparse = sparse or accum
+        want = self._bucketing
+        if want is None:
+            # default on exactly where per-key comm costs real collectives:
+            # multi-worker stores and socket-backed dist stores (worker-side
+            # optimizer).  In-process single-worker stores skip comm
+            # entirely (allreduce_grads identity), so bucketing there is
+            # opt-in.
+            want = (not self._update_on_kvstore and not sparse
+                    and (kv.num_workers > 1 or kv.type.startswith("dist")
+                         or kv.type == "p3"))
+        if not want:
+            return
+        if self._update_on_kvstore or sparse:
+            if self._bucketing:
+                import warnings
+                warnings.warn(
+                    "Trainer(bucketing=True) disabled: %s (per-key "
+                    "semantics are load-bearing there)"
+                    % ("server-side optimizer (update_on_kvstore)"
+                       if self._update_on_kvstore
+                       else "sparse or accumulating (grad_req='add') "
+                            "gradients"))
+            return
+        from ..kvstore.bucketing import GradBucketer
+        from .. import autograd as _ag
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not live:
+            return
+        self._bucketer = GradBucketer(kv, live)
+        for i, p in live:
+            if p._data is not None:
+                h = _ag.register_grad_ready_hook(
+                    p._data, self._bucketer.hook_for(i))
+                self._grad_hook_handles.append(h)
+
+    def __del__(self):
+        try:
+            if self._bucketer is not None:
+                self._bucketer.close()  # detach the bulk flush listener
+            from .. import autograd as _ag
+            for h in self._grad_hook_handles:
+                _ag.remove_grad_ready_hook(h)
+        except Exception:
+            pass
+
+    def comm_stats(self):
+        """Gradient-communication observables for this trainer: bucket
+        plan + launch counters when bucketing is active, plus the per-key
+        collective count (nonzero = per-key path ran).  The bench dp row
+        asserts on these."""
+        s = {"bucketing": self._bucketer is not None,
+             "perkey_collectives": self._perkey_collectives}
+        if self._bucketer is not None:
+            s.update(self._bucketer.stats())
+        return s
 
     @property
     def learning_rate(self):
@@ -136,12 +221,20 @@ class Trainer:
                 self._kvstore.push(str(i), p.grad() * scale, priority=-i)
             for i, p in live:
                 self._kvstore.pull(str(i), out=p.data(), priority=-i)
+            self._perkey_collectives += 2 * len(live)
             return
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad)
 
     def allreduce_grads(self):
         if self._kvstore is None:
+            return
+        if self._bucketer is not None:
+            # bucketed path: buckets whose last gradient fired a grad-ready
+            # hook already launched DURING backward; finish() launches any
+            # straggler, drains dist pulls in launch order, and leaves
+            # every p.grad() as a lazy view-unpack of its reduced bucket
+            self._bucketer.finish()
             return
         kv = self._kvstore
         if not kv.type.startswith("dist") and kv.num_workers <= 1:
@@ -163,10 +256,12 @@ class Trainer:
             for i, p in live:
                 g = p.list_grad()[0]
                 self._kvstore.pull(str(i), out=g, priority=-i)
+            self._perkey_collectives += 2 * len(live)
         except NotImplementedError:
             for i, p in live:
                 g = p.list_grad()[0]
                 self._kvstore.pushpull(str(i), g, out=g, priority=-i)
+            self._perkey_collectives += len(live)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -141,6 +141,18 @@ class KVStore(KVStoreBase):
         """Copy stored value v into destination o, densifying/sparsifying
         as the destination's stype demands."""
         from ..sparse import BaseSparseNDArray
+        from .. import _bulk
+        if (type(o) is ndarray and type(v) is ndarray
+                and type(v._buf) is _bulk.LazyArray
+                and o.shape == v.shape and o.dtype == v.dtype):
+            # lazy alias: the value is a pending bulk-segment output (the
+            # bucketed-gradient path records pack → reduce → unpack without
+            # materializing), so hand the destination the SAME pending
+            # buffer instead of forcing a flush here — the whole pushpull
+            # stays inside one compiled program (single-host stores only
+            # reach this with same-device values, so no device juggling)
+            o._set_data(v._buf)
+            return
         if isinstance(o, BaseSparseNDArray):
             src = v if isinstance(v, BaseSparseNDArray) else v.tostype(o.stype)
             src.tostype(o.stype).copyto(o)

@@ -30,14 +30,17 @@ REQUIRE a server-side optimizer (reference kvstore_dist_server.h:359
 CHECK(sync_mode_) "Updater needs to be set for async mode").
 
 Resilience (OSDI'14 parameter-server semantics; see README "Fault
-tolerance"): every worker request carries (rank, seq); transport
-failures retry with exponential backoff + transparent reconnect
-(MXNET_KV_RETRIES / MXNET_KV_BACKOFF_MS / MXNET_KV_TIMEOUT); the server
-dedups replayed pushes by (key, rank, seq) and replayed barriers by
-(rank, seq) so a resend after a lost ack never double-applies; sync
-waits carry a stall watchdog (MXNET_KV_STALL_SEC) that raises a
-diagnostic naming the stalled ranks.  Injection sites kvstore.send /
-kvstore.recv / server.apply hook `mxnet_tpu.faults`.
+tolerance"): every worker request carries (store, rank, seq) — the
+store id is a per-process creation ordinal, so several stores in one
+process (dist_sync + p3) run independent seq streams inside their own
+server-side dedup domains; transport failures retry with exponential
+backoff + transparent reconnect (MXNET_KV_RETRIES / MXNET_KV_BACKOFF_MS
+/ MXNET_KV_TIMEOUT); the server dedups replayed pushes by (store, key,
+rank, seq) and replayed barriers by (store, rank, seq) so a resend
+after a lost ack never double-applies; sync waits carry a stall
+watchdog (MXNET_KV_STALL_SEC) that raises a diagnostic naming the
+stalled ranks.  Injection sites kvstore.send / kvstore.recv /
+server.apply hook `mxnet_tpu.faults`.
 """
 from __future__ import annotations
 
@@ -169,8 +172,11 @@ class _ConnDrop(Exception):
     case a retrying worker must survive via seq dedup)."""
 
 
-# one request-id stream per worker process (see KVStoreDist.__init__)
-_GLOBAL_SEQ = itertools.count(1)
+# per-process store ordinal: the Nth store a worker process creates gets
+# logical id "sN".  All ranks run the same program, so creation order — and
+# therefore the id — agrees across workers, grouping the right stores into
+# one barrier/dedup domain on the server (ps-lite customer-id analog).
+_STORE_ORDINALS = itertools.count(1)
 
 
 # ---------------------------------------------------------------------------
@@ -197,11 +203,13 @@ class KVStoreDistServer:
         self.buf = {}            # key -> {rank: [grads]}
         self.applied_round = {}  # key -> completed rounds
         self.cond = threading.Condition()
-        self.barrier_count = 0
-        self.barrier_gen = 0
-        self._barrier_ranks = set()   # ranks waiting in the current gen
-        self._barrier_entered = {}    # rank -> (seq, gen) replay dedup
-        self._push_seen = {}          # (key, rank) -> last applied seq
+        # barrier state is kept PER STORE ID: one worker process may hold
+        # several stores (dist_sync + p3), each with its own seq counter
+        # starting at 1 — keying replay state by rank alone would read the
+        # second store's (rank, seq=1) barrier as a replay of the first
+        # store's and deadlock the round (the PR-3 known bug)
+        self._barriers = {}           # store -> {count, gen, ranks, entered}
+        self._push_seen = {}          # (store, key, rank) -> last seq
         self._dup_pushes = 0          # replayed pushes dedup'd (not
         # re-applied) — OSDI'14 replay safety observable for tests
         self._stop = False
@@ -294,41 +302,53 @@ class KVStoreDistServer:
             return {"ok": True}
         return {"ok": False, "error": "unknown op %r" % op}
 
+    def _barrier_group(self, store):
+        grp = self._barriers.get(store)
+        if grp is None:
+            grp = {"count": 0, "gen": 0, "ranks": set(), "entered": {}}
+            self._barriers[store] = grp
+        return grp
+
     def _handle_barrier(self, msg):
-        """Barrier with replay dedup: a worker whose ack was lost resends
-        the same (rank, seq); counting it twice would release a later
-        barrier early.  A replayed entry just re-waits on the generation
-        it originally joined."""
+        """Barrier with replay dedup, per (store, rank, seq): a worker
+        whose ack was lost resends the same message; counting it twice
+        would release a later barrier early.  A replayed entry just
+        re-waits on the generation it originally joined.  Each store id
+        gets its own generation counter so two stores in one process never
+        alias each other's replay state."""
         rank = msg.get("rank", -1)
         seq = msg.get("seq")
+        store = msg.get("store", "")
         with self.cond:
-            prev = self._barrier_entered.get(rank)
+            grp = self._barrier_group(store)
+            prev = grp["entered"].get(rank)
             if seq is not None and prev is not None and prev[0] == seq:
                 gen = prev[1]  # replay: already counted; wait it out
             else:
-                gen = self.barrier_gen
-                self._barrier_entered[rank] = (seq, gen)
-                self._barrier_ranks.add(rank)
-                self.barrier_count += 1
-                if self.barrier_count == self.num_workers:
-                    self.barrier_count = 0
-                    self._barrier_ranks.clear()
-                    self.barrier_gen += 1
+                gen = grp["gen"]
+                grp["entered"][rank] = (seq, gen)
+                grp["ranks"].add(rank)
+                grp["count"] += 1
+                if grp["count"] == self.num_workers:
+                    grp["count"] = 0
+                    grp["ranks"].clear()
+                    grp["gen"] += 1
                     self.cond.notify_all()
                     return {"ok": True}
             deadline = (time.monotonic() + self.stall_sec
                         if self.stall_sec > 0 else None)
-            while self.barrier_gen == gen and not self._stop:
+            while grp["gen"] == gen and not self._stop:
                 self.cond.wait(0.2)
                 if deadline is not None and time.monotonic() > deadline \
-                        and self.barrier_gen == gen:
+                        and grp["gen"] == gen:
                     missing = sorted(set(range(self.num_workers))
-                                     - self._barrier_ranks)
+                                     - grp["ranks"])
                     return {"ok": False, "stall": True,
-                            "error": "barrier stalled for %.0fs waiting "
-                                     "for rank(s) %s (arrived: %s of %d)"
-                                     % (self.stall_sec, missing,
-                                        sorted(self._barrier_ranks),
+                            "error": "barrier (store %r) stalled for "
+                                     "%.0fs waiting for rank(s) %s "
+                                     "(arrived: %s of %d)"
+                                     % (store, self.stall_sec, missing,
+                                        sorted(grp["ranks"]),
                                         self.num_workers)}
         return {"ok": True}
 
@@ -357,18 +377,22 @@ class KVStoreDistServer:
         # (create('dist_async') must not silently run synchronous); the
         # launcher env is only the default for old-style pushes
         sync = msg.get("sync", self.sync)
+        store = msg.get("store", "")
         with self.cond:
             if seq is not None:
-                # replay dedup: per (key, rank) the worker's engine
+                # replay dedup: per (store, key, rank) the worker's engine
                 # serializes pushes, so seqs arrive monotonically; a
                 # replay (retry after a lost ack) carries seq <= last and
                 # must be acked WITHOUT re-applying — a double-applied
-                # gradient silently corrupts training
-                last = self._push_seen.get((key, rank), -1)
+                # gradient silently corrupts training.  Keyed by store id
+                # too: distinct stores in one process run independent seq
+                # streams, and a fresh store's seq=1 push to a key another
+                # store already touched must not read as a replay.
+                last = self._push_seen.get((store, key, rank), -1)
                 if seq <= last:
                     self._dup_pushes += 1
                     return {"ok": True, "dup": True}
-                self._push_seen[(key, rank)] = seq
+                self._push_seen[(store, key, rank)] = seq
             if not sync:
                 # async: apply immediately.  Without a server-side
                 # optimizer an async push would accumulate raw gradients
@@ -640,16 +664,15 @@ class KVStoreDist(KVStoreBase):
                        for s in range(self._num_servers)]
         self._push_round = {}  # key -> rounds this worker pushed
         self._gc = None  # optional GradientCompression
-        # every request carries (rank, seq): the server dedups replayed
-        # mutations so a retried push/barrier can never double-apply.
-        # The counter is PROCESS-global (not per-store): the server keys
-        # replay state by rank alone, and one process may hold several
-        # stores (e.g. dist_sync + p3) whose per-store counters would
-        # collide — two distinct barriers carrying the same (rank, seq)
-        # read as a replay and deadlock the round.  itertools.count is
-        # atomic in CPython; engine key vars keep per-key push order, so
-        # per-(key, rank) seqs stay monotonic.
-        self._seq = _GLOBAL_SEQ
+        # every request carries (store, rank, seq): the server dedups
+        # replayed mutations by that triple, so a retried push/barrier can
+        # never double-apply AND two stores in one process (dist_sync +
+        # p3) can never alias each other's replay state — each store runs
+        # its own counter inside its own server-side dedup domain.
+        # itertools.count is atomic in CPython; engine key vars keep
+        # per-key push order, so per-(key, rank) seqs stay monotonic.
+        self._store_id = "s%d" % next(_STORE_ORDINALS)
+        self._seq = itertools.count(1)
 
     _server_opt = False
 
@@ -737,13 +760,15 @@ class KVStoreDist(KVStoreBase):
                 if plan is None:
                     r = self._conn_for(k).request(
                         {"op": "init", "key": k, "value": v,
-                         "rank": self._rank, "seq": next(self._seq)})
+                         "rank": self._rank, "store": self._store_id,
+                         "seq": next(self._seq)})
                     assert r["ok"], r
                 else:
                     flat = v.ravel()
                     for r in _grouped_requests(
                             [(c, {"op": "init", "key": sk,
                                   "value": flat[a:b], "rank": self._rank,
+                                  "store": self._store_id,
                                   "seq": next(self._seq)})
                              for sk, a, b, c in plan]):
                         assert r["ok"], r
@@ -798,10 +823,12 @@ class KVStoreDist(KVStoreBase):
                 if self._gc is not None:
                     packed, meta = self._gc.compress(sk, sv)
                     msg = {"op": "push", "key": sk, "rank": self._rank,
+                           "store": self._store_id,
                            "value": packed, "meta": meta,
                            "compressed": True, "sync": self._sync}
                 else:
                     msg = {"op": "push", "key": sk, "rank": self._rank,
+                           "store": self._store_id,
                            "value": sv, "sync": self._sync}
                 # seq assigned here (engine worker, per-key serialized):
                 # a RETRY of this message reuses the same seq, so the
@@ -830,7 +857,8 @@ class KVStoreDist(KVStoreBase):
             r = self._conn_for(key).request(
                 {"op": "pull", "key": key,
                  "round": self._push_round.get(key, 0),
-                 "rank": self._rank, "seq": next(self._seq)})
+                 "rank": self._rank, "store": self._store_id,
+                 "seq": next(self._seq)})
             if not r["ok"]:
                 if r.get("stall"):
                     raise TimeoutError(r["error"])
@@ -840,7 +868,8 @@ class KVStoreDist(KVStoreBase):
             replies = _grouped_requests(
                 [(c, {"op": "pull", "key": sk,
                       "round": self._push_round.get(sk, 0),
-                      "rank": self._rank, "seq": next(self._seq)})
+                      "rank": self._rank, "store": self._store_id,
+                      "seq": next(self._seq)})
                  for sk, _a, _b, c in plan])
             parts = []
             for r in replies:
@@ -872,6 +901,7 @@ class KVStoreDist(KVStoreBase):
             for c in self._conns:
                 r = c.request({"op": "set_optimizer", "optimizer": blob,
                                "rank": self._rank,
+                               "store": self._store_id,
                                "seq": next(self._seq)})
                 assert r["ok"], r
         self.barrier()
@@ -883,6 +913,7 @@ class KVStoreDist(KVStoreBase):
         # pending pushes would not be a barrier.
         self.wait_async()
         r = self._conns[0].request({"op": "barrier", "rank": self._rank,
+                                    "store": self._store_id,
                                     "seq": next(self._seq)})
         if not r.get("ok"):
             if r.get("stall"):
@@ -896,6 +927,7 @@ class KVStoreDist(KVStoreBase):
             for c in self._conns:
                 try:
                     c.request({"op": "stop", "rank": self._rank,
+                               "store": self._store_id,
                                "seq": next(self._seq)})
                 except ConnectionError:
                     pass

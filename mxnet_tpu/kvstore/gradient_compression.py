@@ -11,6 +11,16 @@ hop is the bandwidth bottleneck it exists for — on-chip ICI reductions
 ride XLA uncompressed, like the reference compresses only dist pushes).
 2-bit packs 4 values/byte {0: zero, 1: +threshold, 2: -threshold};
 1-bit packs 8 values/byte {sign}, dequantizing to ±threshold.
+
+Quantization boundaries are bit-exact by contract (tested): ``g >= t``
+quantizes to exactly ``+t``, ``g <= -t`` to exactly ``-t`` (>=/<=, not
+>/<), everything between to 0 with the full value carried in the
+residual.  Because quantization is elementwise and the residual is
+per-element, compressing a flat CONCATENATION of gradients (the bucketed
+path, kvstore/bucketing.py — one residual buffer per bucket key) yields
+byte-identical payloads to compressing each gradient under its own key,
+given the same threshold — the property test_gradient_compression.py
+pins.
 """
 from __future__ import annotations
 
@@ -23,28 +33,46 @@ class GradientCompression:
     def __init__(self, type="2bit", threshold=0.5):  # noqa: A002
         if type not in ("1bit", "2bit"):
             raise ValueError("compression type must be '1bit' or '2bit'")
+        if threshold <= 0:
+            raise ValueError("compression threshold must be > 0")
         self.type = type
         self.threshold = float(threshold)
-        self._residual = {}  # key -> error feedback
+        self._residual = {}  # key -> error feedback (same shape as grad)
 
     # -- worker side ------------------------------------------------------
+    def residual(self, key):
+        """Current error-feedback residual for a key (None before the
+        first compress) — observability for tests/debugging."""
+        return self._residual.get(key)
+
+    def reset(self, key=None):
+        """Drop residual state (all keys, or one).  The bucketed path
+        calls this when a bucket plan changes: a stale residual of a
+        different length must not leak into a re-planned bucket."""
+        if key is None:
+            self._residual.clear()
+        else:
+            self._residual.pop(key, None)
+
     def compress(self, key, grad):
-        """grad (numpy) → (packed uint8, meta).  Residual accumulates the
+        """grad (numpy, any shape — the bucketed path passes flat 1-D
+        buffers) → (packed uint8, meta).  Residual accumulates the
         quantization error (reference error feedback)."""
-        g = grad.astype(onp.float32)
+        g = onp.asarray(grad, onp.float32)
         r = self._residual.get(key)
-        if r is None:
+        if r is None or r.shape != g.shape:
+            # shape change = the key was re-planned (bucket resize) or
+            # reused for a different tensor; carrying the old residual
+            # over would corrupt (or crash) the accumulation
             r = onp.zeros_like(g)
         g = g + r
         t = self.threshold
         if self.type == "2bit":
             pos = g >= t
             neg = g <= -t
-            q = onp.zeros(g.shape, onp.uint8)
-            q[pos] = 1
-            q[neg] = 2
-            deq = onp.where(pos, t, onp.where(neg, -t, 0.0)).astype(
-                onp.float32)
+            q = pos.astype(onp.uint8) + (neg.astype(onp.uint8) << 1)
+            deq = (pos.astype(onp.float32)
+                   - neg.astype(onp.float32)) * onp.float32(t)
             packed = _pack_base4(q.ravel())
         else:  # 1bit: sign quantization around 0 → ±threshold
             pos = g >= 0
@@ -58,14 +86,15 @@ class GradientCompression:
     # -- server side ------------------------------------------------------
     @staticmethod
     def decompress(packed, meta):
-        t = meta["threshold"]
+        t = onp.float32(meta["threshold"])
         shape = tuple(meta["shape"])
         n = int(onp.prod(shape)) if shape else 1
         if meta["type"] == "2bit":
-            q = _unpack_base4(packed, n)
-            out = onp.where(q == 1, t, onp.where(q == 2, -t, 0.0))
+            q = _unpack_base4(onp.asarray(packed, onp.uint8), n)
+            out = ((q == 1).astype(onp.float32)
+                   - (q == 2).astype(onp.float32)) * t
         else:
-            bits = onp.unpackbits(packed)[:n]
+            bits = onp.unpackbits(onp.asarray(packed, onp.uint8))[:n]
             out = onp.where(bits == 1, t, -t)
         return out.astype(onp.float32).reshape(shape)
 

@@ -28,6 +28,7 @@ _AGG = {
     "ops": {},      # name -> [count, total_s, min_s, max_s]
     "memory": {},   # counter name -> [samples, last, peak]
     "events": {},   # name -> count (always on: fault trips, kv retries)
+    "comm": {},     # name -> [buckets, bytes, total_queue_s, max_queue_s]
     "lock": threading.Lock(),
 }
 
@@ -65,6 +66,24 @@ def record_event_stat(name, n=1):
         _AGG["events"][name] = _AGG["events"].get(name, 0) + n
 
 
+def record_comm_stat(name, nbytes=0, queue_s=0.0, n=1):
+    """Accumulate one gradient-communication launch (a fused bucket
+    pushpull, kvstore/bucketing.py).  Always on, like event stats — the
+    per-step bucket count / bytes / queue→launch latency are the
+    observables the overlap design is validated against (bench.py asserts
+    on them).  Read back via aggregate_stats()['comm']."""
+    with _AGG["lock"]:
+        st = _AGG["comm"].get(name)
+        if st is None:
+            _AGG["comm"][name] = [n, nbytes, queue_s, queue_s]
+        else:
+            st[0] += n
+            st[1] += nbytes
+            st[2] += queue_s
+            if queue_s > st[3]:
+                st[3] = queue_s
+
+
 def record_memory_stat(name, value):
     with _AGG["lock"]:
         st = _AGG["memory"].get(name)
@@ -87,7 +106,11 @@ def aggregate_stats():
         mem = {n: {"samples": s, "last_bytes": last, "peak_bytes": peak}
                for n, (s, last, peak) in _AGG["memory"].items()}
         events = dict(_AGG["events"])
-    return {"ops": ops, "memory": mem, "events": events}
+        comm = {n: {"count": c, "bytes": b,
+                    "queue_total_ms": tq * 1e3, "queue_max_ms": mq * 1e3,
+                    "queue_avg_ms": tq / c * 1e3 if c else 0.0}
+                for n, (c, b, tq, mq) in _AGG["comm"].items()}
+    return {"ops": ops, "memory": mem, "events": events, "comm": comm}
 
 
 def reset_stats():
@@ -95,6 +118,7 @@ def reset_stats():
         _AGG["ops"].clear()
         _AGG["memory"].clear()
         _AGG["events"].clear()
+        _AGG["comm"].clear()
 
 
 def get_summary(sort_by="total", ascending=False):
@@ -127,6 +151,14 @@ def get_summary(sort_by="total", ascending=False):
         lines.append("  %-28s %10s" % ("Name", "Count"))
         for name, count in sorted(snap["events"].items()):
             lines.append("  %-28s %10d" % (name[:28], count))
+    if snap["comm"]:
+        lines.append("  Gradient communication (fused buckets)")
+        lines.append("  %-28s %10s %14s %12s %12s" % (
+            "Name", "Buckets", "Bytes", "QAvg(ms)", "QMax(ms)"))
+        for name, st in sorted(snap["comm"].items()):
+            lines.append("  %-28s %10d %14d %12.4f %12.4f" % (
+                name[:28], st["count"], st["bytes"], st["queue_avg_ms"],
+                st["queue_max_ms"]))
     return "\n".join(lines)
 
 

@@ -71,6 +71,32 @@ def main():
         # observable fault-injection activity (MXNET_FAULT_SPEC runs)
         result["fault_trips"] = mx.faults.stats()["tripped"]
 
+    elif mode in ("bucketing", "no_bucketing"):
+        # bucketed backward-overlapped gradient comm vs the per-key path:
+        # the driver test launches BOTH modes and asserts the final
+        # replica weights are bit-identical across them (and across ranks)
+        mx.random.seed(100 + rank)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+        mx.random.seed(7)  # identical init on every worker
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=kv,
+                                update_on_kvstore=False,
+                                bucketing=(mode == "bucketing"))
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        rng = onp.random.RandomState(1234 + rank)  # different data
+        for step in range(5):
+            x = mxnp.array(rng.rand(8, 6).astype(onp.float32))
+            y = mxnp.array(rng.randint(0, 2, 8).astype(onp.float32))
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+        result["params"] = {k: p.data().asnumpy().tolist()
+                            for k, p in net.collect_params().items()}
+        result["comm"] = trainer.comm_stats()
+
     elif mode == "p3":
         # big-array slicing: value larger than the slice threshold moves
         # as independent slices across server shards

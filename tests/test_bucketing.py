@@ -1,0 +1,443 @@
+"""Bucketed, backward-overlapped gradient communication
+(mxnet_tpu/kvstore/bucketing.py + the autograd grad-ready hook surface).
+
+Tier-1 smoke per the acceptance criteria: 3 steps bucketed vs unbucketed
+on a small MLP must be BIT-identical on every store type (device,
+tpu_ici, and an in-process dist_sync server over real sockets); the
+2-process dist_sync variant lives in test_dist_kvstore.py (slow lane).
+"""
+import math
+import os
+import socket
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp, autograd, gluon, profiler
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore.bucketing import GradBucketer
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _mlp(seed=7, in_units=8, hidden=16, classes=4):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu", in_units=in_units),
+            nn.Dense(classes, in_units=hidden))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _train(net, trainer, steps=3, in_units=8, classes=4, batch=8, seed=0):
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = onp.random.RandomState(seed)
+    for _ in range(steps):
+        x = mxnp.array(rng.rand(batch, in_units).astype(onp.float32))
+        y = mxnp.array(rng.randint(0, classes, batch).astype(onp.float32))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch)
+    return {k: p.data().asnumpy()
+            for k, p in net.collect_params().items()}
+
+
+def _run(bucketing, kvstore="device", steps=3, optimizer_params=None,
+         **trainer_kw):
+    net = _mlp()
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        optimizer_params or {"learning_rate": 0.05, "momentum": 0.9},
+        kvstore=kvstore, bucketing=bucketing, **trainer_kw)
+    params = _train(net, trainer, steps=steps)
+    return params, trainer
+
+
+def _assert_bit_identical(p0, p1):
+    assert p0.keys() == p1.keys()
+    for k in p0:
+        onp.testing.assert_array_equal(p0[k], p1[k], err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# bucket planning
+# ---------------------------------------------------------------------------
+class _FakeParam:
+    def __init__(self, shape, dtype="float32"):
+        self.shape = tuple(shape)
+        self.dtype = onp.dtype(dtype)
+        self.grad_req = "write"
+
+
+class _FakeStore:
+    type = "device"
+    num_workers = 1
+
+
+def test_plan_reverse_order_and_size_cap():
+    # 6 params of 1000 floats (4 KB each), 8 KB buckets -> params pack in
+    # REVERSE registration order, two per bucket, three buckets
+    params = [(i, _FakeParam((1000,))) for i in range(6)]
+    b = GradBucketer(_FakeStore(), params, bucket_bytes=8000)
+    assert b.num_buckets == 3
+    order = [idx for bk in b.buckets for (idx, *_rest) in bk.entries]
+    assert order == [5, 4, 3, 2, 1, 0]
+    for bk in b.buckets:
+        assert bk.nbytes == 8000
+        # offsets are a contiguous flat layout
+        offs = [(off, size) for (_i, _p, off, size, _s) in bk.entries]
+        assert offs == [(0, 1000), (1000, 1000)]
+
+
+def test_plan_groups_by_dtype():
+    params = [(0, _FakeParam((10,), "float32")),
+              (1, _FakeParam((10,), "float16")),
+              (2, _FakeParam((10,), "float32"))]
+    b = GradBucketer(_FakeStore(), params, bucket_bytes=1 << 20)
+    assert b.num_buckets == 2
+    dtypes = {bk.dtype.name: [i for (i, *_r) in bk.entries]
+              for bk in b.buckets}
+    assert dtypes == {"float32": [2, 0], "float16": [1]}
+
+
+def test_collective_bound_formula():
+    params = [(i, _FakeParam((1000,))) for i in range(6)]
+    b = GradBucketer(_FakeStore(), params, bucket_bytes=8000)
+    total = 6 * 4000
+    assert b.collective_bound() == math.ceil(total / 8000) + 1
+    assert b.num_buckets <= b.collective_bound()
+
+
+def test_bucket_kb_env_controls_plan(monkeypatch):
+    monkeypatch.setenv("MXNET_KV_BUCKET_KB", "4")  # 4 KB buckets
+    params = [(i, _FakeParam((1024,))) for i in range(4)]
+    b = GradBucketer(_FakeStore(), params)
+    assert b.bucket_bytes == 4096
+    assert b.num_buckets == 4  # each 4 KB param exactly fills one bucket
+
+
+# ---------------------------------------------------------------------------
+# autograd grad-ready hooks
+# ---------------------------------------------------------------------------
+def test_grad_ready_hook_fires_once_with_final_grad():
+    x = mxnp.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    fired = []
+    h = autograd.register_grad_ready_hook(
+        x, lambda arr: fired.append(arr.grad.asnumpy().copy()))
+    try:
+        with autograd.record():
+            # two uses of x: the hook must fire only after BOTH
+            # contributions are accumulated
+            y = (x * x).sum() + (3 * x).sum()
+        y.backward()
+    finally:
+        autograd.remove_grad_ready_hook(h)
+    assert len(fired) == 1
+    onp.testing.assert_allclose(fired[0], 2 * onp.array([1, 2, 3.0]) + 3)
+
+
+def test_grad_ready_hook_fires_midwalk_before_other_leaves():
+    # z = f(a) consumed late, b consumed at the very end of the forward:
+    # backward walks in reverse, so b's grad finalizes (and fires) before
+    # a's — the property that lets buckets launch during backward
+    a = mxnp.array([1.0, 2.0])
+    b = mxnp.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    order = []
+    ha = autograd.register_grad_ready_hook(a, lambda _arr: order.append("a"))
+    hb = autograd.register_grad_ready_hook(b, lambda _arr: order.append("b"))
+    try:
+        with autograd.record():
+            y = ((a * 2.0).sum() * 1.0 + (b * b).sum())
+        y.backward()
+    finally:
+        autograd.remove_grad_ready_hook(ha)
+        autograd.remove_grad_ready_hook(hb)
+    assert sorted(order) == ["a", "b"]
+
+
+def test_grad_ready_hook_removed_stops_firing():
+    x = mxnp.array([1.0])
+    x.attach_grad()
+    fired = []
+    h = autograd.register_grad_ready_hook(x, lambda arr: fired.append(1))
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    autograd.remove_grad_ready_hook(h)
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    assert fired == [1]
+
+
+def test_backward_without_hooks_unchanged():
+    # the hook bookkeeping must not perturb plain backward numerics
+    x = mxnp.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 2).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                2 * x.asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# bucketed vs unbucketed: bit-identical training (acceptance smoke)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("store", ["device", "tpu_ici"])
+def test_bucketed_bit_identical_inprocess(store):
+    p0, t0 = _run(False, kvstore=store)
+    p1, t1 = _run(True, kvstore=store)
+    _assert_bit_identical(p0, p1)
+    s = t1.comm_stats()
+    assert s["bucketing"] and s["perkey_collectives"] == 0
+    assert s["launches"] == s["steps"] * s["num_buckets"]
+    assert s["launches_per_step"] <= s["collective_bound"]
+    # overlap observable: every step after hook installation launches its
+    # buckets DURING backward, not at step()
+    assert s["overlapped_launches"] >= s["launches"] - s["num_buckets"]
+    assert not t0.comm_stats()["bucketing"]
+
+
+def test_bucketed_multiple_buckets_bit_identical(monkeypatch):
+    # force tiny buckets so the net splits across several fused
+    # collectives; numerics must not care where the boundaries fall
+    def run(bucketing):
+        net = _mlp(hidden=64)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore="device",
+                                bucketing=bucketing)
+        return _train(net, trainer), trainer
+
+    monkeypatch.setenv("MXNET_KV_BUCKET_KB", "1")
+    p1, t1 = run(True)
+    monkeypatch.delenv("MXNET_KV_BUCKET_KB")
+    p0, _t0 = run(False)
+    _assert_bit_identical(p0, p1)
+    assert t1.comm_stats()["num_buckets"] > 1
+
+
+def test_bucketed_profiler_comm_counters():
+    profiler.reset_stats()
+    _params, tr = _run(True, kvstore="device")
+    comm = profiler.aggregate_stats()["comm"]
+    assert "comm.bucket.float32" in comm
+    st = comm["comm.bucket.float32"]
+    s = tr.comm_stats()
+    assert st["count"] == s["launches"]
+    assert st["bytes"] == s["bytes"]
+    assert st["queue_avg_ms"] >= 0.0
+    assert "comm.bucket.float32" in profiler.get_summary()
+    profiler.reset_stats()
+
+
+def test_bucketing_defaults_and_auto_disable():
+    # in-process single-worker store: default OFF (identity allreduce wins)
+    _p, tr = _run(None, kvstore="device")
+    assert tr._bucketer is None
+    # server-side optimizer: explicit True is refused with a warning
+    with pytest.warns(UserWarning, match="bucketing=True"):
+        _p, tr = _run(True, kvstore="device", update_on_kvstore=True)
+    assert tr._bucketer is None
+
+
+def test_bucketing_auto_disabled_for_sparse_grads():
+    mx.random.seed(3)
+    net = nn.Sequential()
+    net.add(nn.Embedding(16, 4, sparse_grad=True), nn.Dense(2, in_units=4))
+    net.initialize(mx.init.Xavier())
+    with pytest.warns(UserWarning, match="sparse"):
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore="device",
+                                bucketing=True)
+        x = mxnp.array(onp.arange(8))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(4)
+    assert trainer._bucketer is None
+
+
+# ---------------------------------------------------------------------------
+# in-process dist_sync over real sockets
+# ---------------------------------------------------------------------------
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def dist_server(monkeypatch):
+    from mxnet_tpu.kvstore.dist import KVStoreDistServer
+    port = _free_port()
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "15")
+    monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "5")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    srv = KVStoreDistServer(port=port, num_workers=1, sync=True,
+                            stall_sec=30)
+    ready = threading.Event()
+    t = threading.Thread(target=srv.serve, args=(ready,), daemon=True)
+    t.start()
+    assert ready.wait(10)
+    yield srv, port
+    with srv.cond:
+        srv._stop = True
+        srv.cond.notify_all()
+    t.join(5)
+
+
+def test_bucketed_bit_identical_dist_sync(dist_server):
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    results = {}
+    for bucketing in (False, True):
+        net = _mlp()
+        kv = KVStoreDist("dist_sync")
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=kv,
+                                update_on_kvstore=False, bucketing=bucketing)
+        results[bucketing] = (_train(net, trainer), trainer.comm_stats())
+        kv.close()
+    _assert_bit_identical(results[False][0], results[True][0])
+    s = results[True][1]
+    assert s["bucketing"] and s["perkey_collectives"] == 0
+    assert s["launches_per_step"] <= s["collective_bound"]
+    assert results[False][1]["perkey_collectives"] > 0
+    # dist stores default bucketing ON for the worker-side-optimizer mode
+    net = _mlp()
+    kv = KVStoreDist("dist_sync")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv,
+                            update_on_kvstore=False)
+    p_default = _train(net, trainer)
+    assert trainer._bucketer is not None
+    _assert_bit_identical(results[False][0], p_default)
+    kv.close()
+
+
+def test_bucketed_dist_with_compression_matches_perkey_tolerance(
+        dist_server):
+    """2-bit compression on the flat bucket vs the per-key path: the
+    quantizer is elementwise with per-element residuals, so the two
+    layouts must agree (satellite: flat-bucket vs per-key to tolerance)."""
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    results = {}
+    for bucketing in (False, True):
+        net = _mlp()
+        kv = KVStoreDist("dist_sync")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 1e-4})
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=kv,
+                                update_on_kvstore=False, bucketing=bucketing)
+        results[bucketing] = _train(net, trainer)
+        kv.close()
+    for k in results[False]:
+        onp.testing.assert_allclose(results[False][k], results[True][k],
+                                    rtol=0, atol=1e-3, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# two stores in one process (the PR-3 seq-collision regression)
+# ---------------------------------------------------------------------------
+def test_two_stores_one_process_no_replay_collision(dist_server):
+    """dist_sync + p3 in ONE process: each store runs its own seq counter
+    from 1, so the server MUST key replay/dedup state by (store, rank,
+    seq) — rank-only keying reads the second store's first barrier/push
+    as a replay of the first store's and deadlocks/drops it."""
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    kv_a = KVStoreDist("dist_sync")
+    kv_b = KVStoreDist("p3")
+    try:
+        assert kv_a._store_id != kv_b._store_id
+        kv_a.init("k", mxnp.zeros(4))
+        kv_a.push("k", mxnp.ones(4) * 3)
+        out = mxnp.zeros(4)
+        kv_a.pull("k", out=out)
+        onp.testing.assert_array_equal(out.asnumpy(), onp.full(4, 3.0))
+        # store B's first push to "k" carries seq=1 — the same seq store A
+        # used for this key.  Rank-only dedup would silently drop it.
+        kv_b.push("k", mxnp.ones(4) * 5)
+        kv_b.pull("k", out=out)
+        onp.testing.assert_array_equal(out.asnumpy(), onp.full(4, 5.0))
+        # interleaved barriers with colliding (rank, seq): pre-fix these
+        # read as replays of each other and hang until the stall watchdog
+        for _ in range(2):
+            kv_a.barrier()
+            kv_b.barrier()
+        srv, _port = dist_server
+        assert len(srv._barriers) >= 2  # one dedup domain per store
+    finally:
+        kv_a.close()
+        kv_b.close()
+
+
+def test_two_stores_two_ranks_barrier_groups(monkeypatch):
+    """2 logical stores x 2 ranks against one num_workers=2 server: each
+    store's barrier must complete with exactly its own two ranks.  With
+    per-store seqs both stores' barriers carry (rank, seq=1); without
+    store-keyed state the second store's entries look like replays and
+    the barrier never releases (watchdog would fire)."""
+    from mxnet_tpu.kvstore.dist import KVStoreDist, KVStoreDistServer
+    port = _free_port()
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "15")
+    monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "5")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    srv = KVStoreDistServer(port=port, num_workers=2, sync=True,
+                            stall_sec=20)
+    ready = threading.Event()
+    t = threading.Thread(target=srv.serve, args=(ready,), daemon=True)
+    t.start()
+    assert ready.wait(10)
+    stores = {}
+    try:
+        for rank in (0, 1):
+            monkeypatch.setenv("DMLC_WORKER_ID", str(rank))
+            a = KVStoreDist("dist_sync")
+            b = KVStoreDist("p3")
+            if rank == 1:
+                # in real deployments the ranks run the same program, so
+                # creation ORDER assigns matching store ids; both ranks
+                # live in this one test process, so align them by hand
+                a._store_id = stores[0][0]._store_id
+                b._store_id = stores[0][1]._store_id
+            stores[rank] = (a, b)
+        errors = []
+
+        def rank1_barriers():
+            try:
+                stores[1][0].barrier()
+                stores[1][1].barrier()
+            except Exception as e:  # surfaced by the main thread
+                errors.append(e)
+
+        helper = threading.Thread(target=rank1_barriers, daemon=True)
+        helper.start()
+        stores[0][0].barrier()  # store A: both ranks, seq=1
+        stores[0][1].barrier()  # store B: both ranks, seq=1 again
+        helper.join(30)
+        assert not helper.is_alive(), "two-store barrier deadlocked"
+        assert not errors, errors
+    finally:
+        for a, b in stores.values():
+            a.close()
+            b.close()
+        with srv.cond:
+            srv._stop = True
+            srv.cond.notify_all()
+        t.join(5)

@@ -61,6 +61,38 @@ def test_dist_trainer_replicas_stay_identical(tmp_path):
 
 
 @pytest.mark.slow
+def test_dist_bucketed_training_bit_identical(tmp_path):
+    """Acceptance: a 2-process dist_sync run with bucketed
+    backward-overlapped gradient communication finishes bit-identical to
+    the per-key run (and replicas stay identical), with the fused
+    collective count within the plan bound and no silent per-key
+    fallback."""
+    perkey_dir = tmp_path / "perkey"
+    bucket_dir = tmp_path / "bucketed"
+    perkey_dir.mkdir()
+    bucket_dir.mkdir()
+    perkey = _launch(perkey_dir, "no_bucketing", n=2, s=1)
+    bucketed = _launch(bucket_dir, "bucketing", n=2, s=1)
+    for results in (perkey, bucketed):
+        p0, p1 = results[0]["params"], results[1]["params"]
+        assert p0.keys() == p1.keys()
+        for k in p0:
+            onp.testing.assert_array_equal(
+                onp.asarray(p0[k]), onp.asarray(p1[k]),
+                err_msg="replica divergence in %s" % k)
+    for k in perkey[0]["params"]:
+        onp.testing.assert_array_equal(
+            onp.asarray(perkey[0]["params"][k]),
+            onp.asarray(bucketed[0]["params"][k]),
+            err_msg="bucketed run diverged from per-key in %s" % k)
+    for r in bucketed:
+        s = r["comm"]
+        assert s["bucketing"] and s["perkey_collectives"] == 0
+        assert s["launches_per_step"] <= s["collective_bound"]
+    assert all(r["comm"]["perkey_collectives"] > 0 for r in perkey)
+
+
+@pytest.mark.slow
 def test_dist_p3_sliced_arrays(tmp_path):
     results = _launch(tmp_path, "p3", n=2, s=2)
     assert all(r["p3_ok"] for r in results)

@@ -1,0 +1,148 @@
+"""kvstore/gradient_compression.py: 2-bit/1-bit quantization coverage —
+error-feedback residual accumulation, bit-exact behavior at the
+±threshold boundaries, and the flat-bucket path agreeing with the
+per-key path (the bucketed-communication satellite)."""
+import numpy as onp
+import pytest
+
+from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+
+
+def _roundtrip(gc, key, grad):
+    packed, meta = gc.compress(key, grad)
+    return GradientCompression.decompress(packed, meta)
+
+
+# ---------------------------------------------------------------------------
+# 2-bit semantics
+# ---------------------------------------------------------------------------
+def test_2bit_threshold_boundaries_bit_exact():
+    t = 0.5
+    gc = GradientCompression("2bit", threshold=t)
+    eps = onp.float32(1e-3)
+    g = onp.array([t, -t, t + eps, -t - eps, t - eps, -(t - eps), 0.0],
+                  onp.float32)
+    out = _roundtrip(gc, "k", g)
+    # >= t quantizes to EXACTLY +t, <= -t to EXACTLY -t (inclusive
+    # comparisons); strictly inside (-t, t) quantizes to exactly 0
+    expect = onp.array([t, -t, t, -t, 0.0, 0.0, 0.0], onp.float32)
+    onp.testing.assert_array_equal(out, expect)
+    # residual carries the exact quantization error
+    onp.testing.assert_array_equal(gc.residual("k"), g - expect)
+
+
+def test_2bit_error_feedback_accumulates_until_emitted():
+    t = 1.0
+    gc = GradientCompression("2bit", threshold=t)
+    g = onp.full(8, 0.4, onp.float32)
+    # 0.4 < t: nothing emitted, residual grows 0.4 per push...
+    out1 = _roundtrip(gc, "k", g)
+    onp.testing.assert_array_equal(out1, onp.zeros(8))
+    out2 = _roundtrip(gc, "k", g)
+    onp.testing.assert_array_equal(out2, onp.zeros(8))
+    # ...third push: accumulated 1.2 >= t emits +t, residual drops to 0.2
+    out3 = _roundtrip(gc, "k", g)
+    onp.testing.assert_array_equal(out3, onp.full(8, t, onp.float32))
+    onp.testing.assert_allclose(gc.residual("k"),
+                                onp.full(8, 0.2, onp.float32), atol=1e-6)
+
+
+def test_2bit_longrun_total_error_bounded():
+    # error feedback means the RUNNING SUM of dequantized pushes tracks
+    # the running sum of true gradients to within one threshold
+    t = 0.25
+    gc = GradientCompression("2bit", threshold=t)
+    rng = onp.random.RandomState(0)
+    true_sum = onp.zeros(64, onp.float32)
+    sent_sum = onp.zeros(64, onp.float32)
+    for _ in range(50):
+        g = rng.uniform(-0.2, 0.2, 64).astype(onp.float32)
+        true_sum += g
+        sent_sum += _roundtrip(gc, "k", g)
+    assert onp.abs(true_sum - sent_sum).max() <= t + 1e-5
+
+
+def test_2bit_packing_density_and_shapes():
+    gc = GradientCompression("2bit", threshold=0.5)
+    g = onp.random.RandomState(1).randn(3, 5).astype(onp.float32)
+    packed, meta = gc.compress("k", g)
+    assert packed.dtype == onp.uint8
+    assert len(packed) == -(-g.size // 4)  # 4 values per byte
+    out = GradientCompression.decompress(packed, meta)
+    assert out.shape == (3, 5) and out.dtype == onp.float32
+    assert set(onp.unique(out)) <= {-0.5, 0.0, 0.5}
+
+
+# ---------------------------------------------------------------------------
+# 1-bit semantics
+# ---------------------------------------------------------------------------
+def test_1bit_sign_quantization_roundtrip():
+    gc = GradientCompression("1bit", threshold=0.5)
+    g = onp.array([0.9, -0.9, 0.0, -0.1], onp.float32)
+    out = _roundtrip(gc, "k", g)
+    # sign quantization around 0 (>= 0 -> +t), 8 values/byte
+    onp.testing.assert_array_equal(out, [0.5, -0.5, 0.5, -0.5])
+    packed, _meta = gc.compress("k2", onp.zeros(16, onp.float32))
+    assert len(packed) == 2
+
+
+def test_1bit_error_feedback_compensates_bias():
+    # a tiny negative gradient pushed repeatedly: sign quantization alone
+    # would send +t forever (>=0); error feedback must flip the sign once
+    # the accumulated error goes negative
+    gc = GradientCompression("1bit", threshold=0.5)
+    sent = [float(_roundtrip(gc, "k", onp.full(1, -0.1, onp.float32))[0])
+            for _ in range(20)]
+    assert -0.5 in sent
+
+
+# ---------------------------------------------------------------------------
+# flat-bucket path vs per-key path
+# ---------------------------------------------------------------------------
+def test_flat_bucket_matches_per_key_payloads():
+    """Compressing the flat concatenation of N gradients under ONE bucket
+    key must emit byte-identical payloads (and residuals) to compressing
+    each gradient under its own key — quantization is elementwise and the
+    residual is per-element, so the bucket layout cannot change what the
+    server decodes."""
+    rng = onp.random.RandomState(2)
+    grads = [rng.randn(n).astype(onp.float32) for n in (7, 64, 13)]
+    flat_gc = GradientCompression("2bit", threshold=0.3)
+    key_gc = GradientCompression("2bit", threshold=0.3)
+    for _round in range(4):  # several rounds: residual state must track too
+        grads = [g * 0.9 + rng.randn(g.size).astype(onp.float32) * 0.1
+                 for g in grads]
+        flat = onp.concatenate(grads)
+        fpacked, fmeta = flat_gc.compress("bucket", flat)
+        fout = GradientCompression.decompress(fpacked, fmeta)
+        outs = []
+        for i, g in enumerate(grads):
+            p, m = key_gc.compress(str(i), g)
+            outs.append(GradientCompression.decompress(p, m))
+        onp.testing.assert_array_equal(fout, onp.concatenate(outs))
+    onp.testing.assert_array_equal(
+        flat_gc.residual("bucket"),
+        onp.concatenate([key_gc.residual(str(i))
+                         for i in range(len(grads))]))
+
+
+def test_residual_resets_on_shape_change():
+    # a re-planned bucket reuses its key with a different length: the
+    # stale residual must not leak (pre-fix: shape-mismatch broadcast
+    # error or silent corruption)
+    gc = GradientCompression("2bit", threshold=1.0)
+    _roundtrip(gc, "b0", onp.full(8, 0.6, onp.float32))
+    assert gc.residual("b0").shape == (8,)
+    out = _roundtrip(gc, "b0", onp.full(12, 0.6, onp.float32))
+    onp.testing.assert_array_equal(out, onp.zeros(12))  # fresh residual
+    onp.testing.assert_allclose(gc.residual("b0"),
+                                onp.full(12, 0.6, onp.float32))
+    gc.reset("b0")
+    assert gc.residual("b0") is None
+
+
+def test_invalid_params_rejected():
+    with pytest.raises(ValueError):
+        GradientCompression("3bit")
+    with pytest.raises(ValueError):
+        GradientCompression("2bit", threshold=0.0)
