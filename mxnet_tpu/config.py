@@ -162,10 +162,31 @@ register("MXNET_KV_STALL_SEC", float, 600.0, "honored",
          "longer than this raises a diagnostic naming the stalled ranks "
          "instead of hanging forever (0 disables)",
          "kvstore.dist.KVStoreDistServer")
+register("MXNET_KV_EVICT_SEC", float, 0.0, "honored",
+         "dist server escalation beyond the stall watchdog: a sync round "
+         "or barrier stalled longer than this evicts the missing rank(s) "
+         "from the membership, bumps the generation, rolls the in-flight "
+         "round back to the last step boundary, and lets survivors "
+         "continue at the smaller world size (0 disables — stalls only "
+         "diagnose)", "kvstore.dist.KVStoreDistServer")
+register("MXNET_PREEMPT_GRACE_SEC", float, 15.0, "honored",
+         "graceful-preemption grace window: after SIGTERM (or an "
+         "injected trainer.step 'preempt' fault) the in-flight step may "
+         "run this long before it is abandoned; then a crash-safe "
+         "checkpoint is written, the worker leaves the membership, and "
+         "the process exits 0", "gluon.Trainer.attach_preemption")
+register("MXNET_SERVING_RETRIES", int, 2, "honored",
+         "serving client: bounded retries on connect/connection-reset "
+         "errors for requests the server has not processed yet "
+         "(exponential backoff + jitter, the MXNET_KV_RETRIES pattern)",
+         "serving.client.ServingClient")
+register("MXNET_SERVING_BACKOFF_MS", float, 50.0, "honored",
+         "serving client: base retry backoff in ms, doubled per attempt "
+         "with jitter", "serving.client.ServingClient")
 register("MXNET_FAULT_SPEC", str, "", "honored",
          "deterministic fault injection spec: site:kind[@p=F|n=I] joined "
          "by ';' (sites: kvstore.send, kvstore.recv, server.apply, "
-         "checkpoint.write)", "faults")
+         "server.membership, trainer.step, checkpoint.write)", "faults")
 register("MXNET_FAULT_SEED", int, 0, "honored",
          "seed for probability-based fault-injection rules (deterministic "
          "trip sequences per (seed, site, kind))", "faults.FaultRule")

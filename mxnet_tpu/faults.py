@@ -9,18 +9,24 @@ design follows the classic parameter-server resilience literature
 CheckFreq-style crash-consistent checkpointing (Mohan et al., FAST'21).
 
 Sites (grep for ``faults.check``):
-  kvstore.send      worker transport, before a request frame is sent
-  kvstore.recv      worker transport, before a reply is awaited
-  server.apply      parameter server, after a push is applied but before
-                    the ack is sent ("drop" kills the connection — the
-                    replay-dedup torture case)
-  checkpoint.write  checkpoint writer ("torn" truncates the npz payload,
-                    simulating a crash mid-write on a non-atomic path)
+  kvstore.send       worker transport, before a request frame is sent
+  kvstore.recv       worker transport, before a reply is awaited
+  server.apply       parameter server, after a push is applied but before
+                     the ack is sent ("drop" kills the connection — the
+                     replay-dedup torture case)
+  server.membership  parameter server, membership ops (register/leave)
+                     and evictions (evictions count trips only — a raise
+                     inside the waiter would corrupt the round)
+  trainer.step       gluon.Trainer.step entry ("preempt" = injected
+                     SIGTERM: graceful checkpoint + leave + exit 0)
+  checkpoint.write   checkpoint writer ("torn" truncates the npz payload,
+                     simulating a crash mid-write on a non-atomic path)
 
 Kinds: ``reset`` (ConnectionResetError), ``timeout`` (socket.timeout),
 ``error``/``crash`` (RuntimeError), plus site-interpreted kinds that
 ``check`` *returns* instead of raising: ``drop`` (server kills the
-connection without replying) and ``torn`` (writer tears the file).
+connection without replying), ``torn`` (writer tears the file), and
+``preempt`` (trainer runs its graceful-preemption path).
 
 Configuration — either the env spec (parsed once, on first check):
 
@@ -64,10 +70,10 @@ _EXC_KINDS = {
     "crash": RuntimeError,
 }
 # site-interpreted kinds check() hands back to the caller
-_SOFT_KINDS = ("drop", "torn")
+_SOFT_KINDS = ("drop", "torn", "preempt")
 
 KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
-               "checkpoint.write")
+               "server.membership", "trainer.step", "checkpoint.write")
 
 
 class FaultRule:

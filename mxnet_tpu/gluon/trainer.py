@@ -5,15 +5,35 @@ TPU-native: gradients are aggregated through the kvstore abstraction —
 "local"/"device" single-process stores, or "tpu_ici" which lowers pushpull
 to an XLA all-reduce over the ICI mesh (kvstore/ici.py).  The optimizer
 update itself is a fused XLA kernel per parameter (ops/optimizer_ops.py).
+Elastic & preemption-tolerant (README "Elastic & preemption-tolerant
+training"): against a dist store, ``step`` catches the typed
+:class:`~mxnet_tpu.kvstore.MembershipChanged` reply (a worker left / was
+evicted / rejoined mid-step), resyncs to the new membership generation,
+rescales gradient averaging to the live world size, and replays the
+abandoned step under the new generation.  ``attach_preemption`` turns
+SIGTERM (or an injected ``trainer.step`` ``preempt`` fault) into a
+graceful lifecycle event: finish-or-abandon the current step within
+``MXNET_PREEMPT_GRACE_SEC``, write a crash-safe checkpoint, send a
+membership ``leave``, exit 0.
 """
 from __future__ import annotations
 
+import time
+
+from .. import config as _config
+from .. import faults
 from .. import optimizer as opt_mod
-from ..kvstore import create as kv_create, KVStoreBase
+from ..kvstore import create as kv_create, KVStoreBase, MembershipChanged
 from ..ndarray import ndarray
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
+
+
+class _StepAbandoned(Exception):
+    """Internal: the in-flight step's gradients are unrecoverable after a
+    membership change (per-key worker-side path) — count the step as
+    abandoned instead of replaying it."""
 
 
 class Trainer:
@@ -52,6 +72,22 @@ class Trainer:
         self._bucketer = None
         self._grad_hook_handles = []
         self._perkey_collectives = 0  # per-key push/pull/pushpull count
+        # elastic state: world-size rescaling keeps the effective update
+        # magnitude constant as membership shrinks/grows (factor 1.0 — and
+        # bit-identical numerics — at the configured world size)
+        self._elastic_retries = 4
+        self._initial_world = 1
+        self._live_world = 1
+        self._world_scale = 1.0
+        self._step_count = 0
+        self._steps_abandoned = 0
+        # graceful preemption (attach_preemption)
+        self._preempt_at = None
+        self._preempt_dir = None
+        self._preempt_params = None
+        self._preempt_extra = None
+        self._preempt_grace = None
+        self._prev_sigterm = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -63,13 +99,15 @@ class Trainer:
                                              **optimizer_params)
 
     def _init_kvstore(self):
-        if self._kvstore_type is None:
+        if self._kvstore is not None:
+            pass  # re-entered after a MembershipChanged mid-init: keep
+            # the registered store, replay the idempotent setup below
+        elif self._kvstore_type is None:
             self._kvstore = None
         elif isinstance(self._kvstore_type, KVStoreBase):
             self._kvstore = self._kvstore_type
         else:
             self._kvstore = kv_create(self._kvstore_type)
-        self._kv_initialized = True
         kv = self._kvstore
         if self._update_on_kvstore is None and kv is not None:
             # reference _init_kvstore defaults update_on_kvstore=True for
@@ -105,7 +143,16 @@ class Trainer:
                     outs.append(p.data())
             if keys:
                 kv.broadcast(keys, vals, out=outs)
+        if kv is not None:
+            self._initial_world = max(1, kv.num_workers)
+            self._live_world = max(1, getattr(kv, "num_workers_live",
+                                              kv.num_workers))
+            self._world_scale = self._initial_world / self._live_world
         self._setup_bucketing()
+        # marked initialized only once the whole setup landed: a
+        # MembershipChanged interrupting the broadcast must re-run init on
+        # the step replay (every phase above is idempotent), not skip it
+        self._kv_initialized = True
 
     def _setup_bucketing(self):
         """Decide whether this trainer runs bucketed gradient comm and, if
@@ -173,7 +220,11 @@ class Trainer:
         collective count (nonzero = per-key path ran).  The bench dp row
         asserts on these."""
         s = {"bucketing": self._bucketer is not None,
-             "perkey_collectives": self._perkey_collectives}
+             "perkey_collectives": self._perkey_collectives,
+             "steps": self._step_count,
+             "steps_abandoned": self._steps_abandoned,
+             "live_world": self._live_world,
+             "world_scale": self._world_scale}
         if self._bucketer is not None:
             s.update(self._bucketer.stats())
         return s
@@ -194,14 +245,151 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """allreduce grads then update (reference trainer.py:334)."""
+        """allreduce grads then update (reference trainer.py:334).
+
+        Elastic: a ``MembershipChanged`` surfacing from the dist store
+        (worker left / evicted / rejoined mid-step) resyncs to the new
+        generation — rescaling gradient averaging to the live world size
+        — and replays this step under it (the server rolled the partial
+        round back to the step boundary).  A pending preemption request
+        (SIGTERM via ``attach_preemption``, or an injected ``trainer.step``
+        ``preempt`` fault) exits gracefully at the step boundary."""
+        kind = faults.check("trainer.step")
+        if kind == "preempt":
+            self._preempt_at = time.monotonic()  # injected SIGTERM analog
+        if self._preempt_at is not None and self._preempt_dir is not None:
+            self._graceful_preempt_exit()  # boundary: previous step done
         try:
-            return self._step_impl(batch_size, ignore_stale_grad)
+            for attempt in range(self._elastic_retries + 1):
+                try:
+                    self._step_impl(batch_size, ignore_stale_grad)
+                    break
+                except MembershipChanged as e:
+                    if (self._preempt_at is not None
+                            and self._preempt_dir is not None
+                            and self._preempt_grace is not None
+                            and time.monotonic() - self._preempt_at
+                            > self._preempt_grace):
+                        # grace window expired mid-step: abandon it and go
+                        self._graceful_preempt_exit()
+                    try:
+                        self._on_membership_changed(e, attempt)
+                    except _StepAbandoned:
+                        break
+                except TimeoutError:
+                    if self._preempt_at is not None \
+                            and self._preempt_dir is not None:
+                        # the stalled collective will never finish for us:
+                        # abandon the step and leave within the window
+                        self._graceful_preempt_exit()
+                    raise
         finally:
             # deterministic bulk boundary: the whole update segment
             # dispatches as one program here (stable executable signature)
             from .. import _bulk
             _bulk.flush()
+        self._step_count += 1
+        if self._preempt_at is not None and self._preempt_dir is not None:
+            self._graceful_preempt_exit()
+
+    # -- elastic membership / graceful preemption -------------------------
+    def _on_membership_changed(self, exc, attempt):
+        """Adopt the new membership generation and decide how this step
+        continues: replayed (server-owned optimizer: gradients are intact;
+        bucketed comm: launched buckets re-send their saved flat packs) or
+        abandoned (per-key worker-side path: pulls may already have
+        replaced local gradients with reduced values)."""
+        kv = self._kvstore
+        if kv is None or not hasattr(kv, "resync") \
+                or attempt >= self._elastic_retries:
+            raise exc
+        info = kv.resync()
+        self._live_world = max(1, int(info.get("num_workers") or 1))
+        self._world_scale = self._initial_world / self._live_world
+        from .. import profiler
+        profiler.record_event_stat("elastic.membership_change")
+        if self._bucketer is not None:
+            self._bucketer.abandon_step()
+            return
+        if not self._update_on_kvstore:
+            self._steps_abandoned += 1
+            profiler.record_event_stat("elastic.step_abandoned")
+            raise _StepAbandoned()
+
+    def attach_preemption(self, ckpt_dir, params=None, extra=None,
+                          grace_sec=None, install_signal=True):
+        """Make preemption a graceful lifecycle event: on SIGTERM (or an
+        injected ``trainer.step:preempt`` fault) the in-flight step is
+        finished if it completes within ``grace_sec`` (default
+        ``MXNET_PREEMPT_GRACE_SEC``) and abandoned otherwise; then a
+        crash-safe checkpoint of ``params`` (+ this trainer's optimizer
+        state + ``extra`` metadata, under the completed-step number) is
+        written to ``ckpt_dir``, the worker sends a membership ``leave``
+        so survivors rescale instead of stalling, and the process exits 0.
+        A relaunched worker resumes via ``parallel.checkpoint.
+        resume_training`` and rejoins at the next step boundary.
+
+        ``extra`` may be a dict or a zero-arg callable evaluated at
+        preemption time.  ``install_signal=False`` skips the SIGTERM
+        handler (tests / non-main threads) — trigger programmatically with
+        ``request_preemption()``."""
+        if params is None:
+            params = {p.name: p for p in self._params}
+        elif not isinstance(params, dict):
+            params = {p.name: p for p in params}
+        self._preempt_dir = ckpt_dir
+        self._preempt_params = params
+        self._preempt_extra = extra
+        self._preempt_grace = float(
+            grace_sec if grace_sec is not None
+            else _config.get("MXNET_PREEMPT_GRACE_SEC"))
+        if install_signal:
+            import signal
+            try:
+                self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                                   self._on_sigterm)
+            except ValueError:  # not the main thread
+                self._prev_sigterm = None
+        return self
+
+    def detach_preemption(self):
+        if self._prev_sigterm is not None:
+            import signal
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+        self._preempt_dir = None
+
+    def request_preemption(self):
+        """Programmatic SIGTERM analog (tests, cluster drain agents)."""
+        self._preempt_at = time.monotonic()
+
+    def _on_sigterm(self, signum, frame):
+        self._preempt_at = time.monotonic()
+
+    def _graceful_preempt_exit(self):
+        """The graceful half of preemption: checkpoint, leave, exit 0."""
+        from ..parallel import checkpoint as _ckpt
+        from .. import profiler
+        extra = {"preempted": True, "world_size": self._live_world}
+        more = self._preempt_extra() if callable(self._preempt_extra) \
+            else self._preempt_extra
+        extra.update(more or {})
+        _ckpt.save_checkpoint(self._preempt_dir, self._preempt_params,
+                              step=self._step_count, trainer=self,
+                              extra=extra)
+        _ckpt.wait_for_saves(self._preempt_dir)
+        kv = self._kvstore
+        if kv is not None and hasattr(kv, "leave"):
+            try:
+                kv.leave()
+            except Exception:
+                pass  # server may be gone too; the checkpoint is safe
+        profiler.record_event_stat("preempt.graceful")
+        self.detach_preemption()
+        raise SystemExit(0)
 
     def _step_impl(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -213,8 +401,10 @@ class Trainer:
             # scheduled first (dist stores run them async on engine
             # workers), then pulls drain in the same priority order —
             # the reference's push-overlapping-backward pipeline
-            # (gluon/trainer.py:395-407).
-            scale = self._scale / batch_size
+            # (gluon/trainer.py:395-407).  _world_scale keeps the summed
+            # update's magnitude constant when membership shrinks (1.0 —
+            # bit-identical — at the configured world size).
+            scale = self._scale / batch_size * self._world_scale
             live = [(i, p) for i, p in enumerate(self._params)
                     if p.grad_req != "null" and p._data is not None]
             for i, p in live:
@@ -266,7 +456,8 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = (self._scale / batch_size
+                                        * self._world_scale)
         # ONE batched optimizer call for the whole parameter set: the
         # optimizer's multi-tensor path (aggregate_num) fuses groups into
         # single XLA programs instead of per-param eager dispatch
@@ -306,8 +497,18 @@ class Trainer:
             [i], [p.data()], [grad], [self._states[i]])
 
     def save_states(self, fname):
-        """Serialize optimizer states (reference Trainer.save_states)."""
-        updater = opt_mod.Updater(self._optimizer)
+        """Serialize optimizer states (reference Trainer.save_states).
+        param_dict is swapped for plain lr/wd-mult namespaces before
+        pickling — live Parameters fresh out of a backward hold tape
+        replay closures; load_states re-attaches the real ones."""
+        import copy
+        from types import SimpleNamespace
+        opt = copy.copy(self._optimizer)
+        opt.param_dict = {
+            i: SimpleNamespace(lr_mult=getattr(p, "lr_mult", 1.0),
+                               wd_mult=getattr(p, "wd_mult", 1.0))
+            for i, p in enumerate(self._params)}
+        updater = opt_mod.Updater(opt)
         updater.states = self._states
         with open(fname, "wb") as f:
             f.write(updater.get_states(dump_optimizer=True))

@@ -20,9 +20,28 @@ import jax.numpy as jnp
 
 from ..ndarray import ndarray, _wrap_value
 
-__all__ = ["KVStore", "KVStoreBase", "create"]
+__all__ = ["KVStore", "KVStoreBase", "MembershipChanged", "create"]
 
 _REGISTRY = {}
+
+
+class MembershipChanged(RuntimeError):
+    """The dist server's worker-membership generation moved past the one
+    this request carried (a worker left / was evicted / rejoined).  The
+    in-flight sync round was rolled back to the last step boundary
+    server-side; the holder must ``kv.resync()`` and replay the step under
+    the new generation (``gluon.Trainer.step`` does this automatically).
+
+    Defined here (not in ``kvstore.dist``) so the trainer can catch it
+    without importing the socket transport for in-process stores."""
+
+    def __init__(self, msg, gen=None, num_workers=None, ranks=None,
+                 round=None):
+        super().__init__(msg)
+        self.gen = gen
+        self.num_workers = num_workers
+        self.ranks = ranks
+        self.round = round
 
 
 class KVStoreBase:

@@ -70,7 +70,7 @@ def _slice_view(flat, bounds, shape):
 class _Bucket:
     __slots__ = ("index", "key", "dtype", "entries", "size", "nbytes",
                  "ready", "launched", "flat_out", "first_ready_t",
-                 "launch_t", "out_wrapper")
+                 "launch_t", "out_wrapper", "flat_sent")
 
     def __init__(self, index, dtype):
         self.index = index
@@ -85,6 +85,9 @@ class _Bucket:
         self.first_ready_t = None
         self.launch_t = None
         self.out_wrapper = None  # reused destination ndarray across steps
+        self.flat_sent = None    # dist: the flat pack as pushed, kept for
+        # the step so a MembershipChanged replay re-sends the SAME local
+        # gradients (p.grad() may already view a stale reduced buffer)
 
 
 class GradBucketer:
@@ -104,9 +107,11 @@ class GradBucketer:
         self._bucket_of = {}  # param_idx -> _Bucket
         self._build_plan(params)
         self._finished = True  # first mark_ready() of a step resets
+        self._retry = False    # replaying the step after MembershipChanged
         self._launch_order = []
         self._stats = {"steps": 0, "launches": 0, "bytes": 0,
-                       "overlapped_launches": 0, "segment_boundaries": 0}
+                       "overlapped_launches": 0, "segment_boundaries": 0,
+                       "relaunched_steps": 0}
         self._flush_listener = None
 
     # -- planning ---------------------------------------------------------
@@ -150,8 +155,10 @@ class GradBucketer:
             b.flat_out = None
             b.first_ready_t = None
             b.launch_t = None
+            b.flat_sent = None
         self._launch_order = []
         self._finished = False
+        self._retry = False
         self._stats["steps"] += 1
         if self._flush_listener is None:
             def _on_flush(_n_ops):
@@ -196,11 +203,31 @@ class GradBucketer:
             for b in self._launch_order:
                 self._pull_and_unpack(b)
         self._finished = True
+        self._retry = False
+
+    def abandon_step(self):
+        """Reset launch state after a ``MembershipChanged`` so the next
+        ``finish()`` replays this step under the new generation: buckets
+        that already launched re-send their saved flat pack (their
+        members' ``p.grad()`` may already view a reduced buffer from the
+        rolled-back round), never-launched buckets pack fresh."""
+        for b in self.buckets:
+            b.launched = False
+            b.ready.clear()
+            b.flat_out = None
+            b.launch_t = None
+        self._launch_order = []
+        self._finished = False
+        self._retry = True
+        self._stats["relaunched_steps"] += 1
 
     # -- launch / unpack --------------------------------------------------
     def _launch(self, b, overlapped=False):
-        grads = [p.grad() for (_i, p, _o, _s, _sh) in b.entries]
-        flat = apply_op(_pack_flat, *grads)
+        if self._retry and b.flat_sent is not None:
+            flat = b.flat_sent  # replay the step's exact local gradients
+        else:
+            grads = [p.grad() for (_i, p, _o, _s, _sh) in b.entries]
+            flat = apply_op(_pack_flat, *grads)
         now = time.perf_counter()
         b.launch_t = now
         queue_s = (now - b.first_ready_t) if b.first_ready_t else 0.0
@@ -218,6 +245,7 @@ class GradBucketer:
             # engine-async: socket work overlaps the rest of backward.
             # Accessing the flat value inside push materializes the pending
             # segment — the intended bulk-segment boundary per bucket.
+            b.flat_sent = flat  # kept for a MembershipChanged replay
             self._store.push(b.key, flat, priority=priority)
             b.flat_out = None  # pulled at finish(), in launch order
         else:

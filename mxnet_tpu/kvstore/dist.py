@@ -40,7 +40,23 @@ rank, seq) and replayed barriers by (store, rank, seq) so a resend
 after a lost ack never double-applies; sync waits carry a stall
 watchdog (MXNET_KV_STALL_SEC) that raises a diagnostic naming the
 stalled ranks.  Injection sites kvstore.send / kvstore.recv /
-server.apply hook `mxnet_tpu.faults`.
+server.apply / server.membership hook `mxnet_tpu.faults`.
+
+Elastic membership (TorchElastic / Elastic Horovod analog; see README
+"Elastic & preemption-tolerant training"): worker membership is a
+first-class, generation-versioned part of the protocol.  Workers
+``register`` on construction and ``leave`` on graceful preemption; the
+server tracks a membership generation, evicts a rank whose stall exceeds
+``MXNET_KV_EVICT_SEC`` (escalation beyond the diagnose-only
+``MXNET_KV_STALL_SEC`` watchdog), and answers any request carrying a
+stale generation with a typed ``membership_changed`` reply — surfaced
+worker-side as :class:`~mxnet_tpu.kvstore.MembershipChanged` — instead
+of silently applying or deadlocking.  On any membership event
+(leave/evict/rejoin) the in-flight sync round is rolled back to the last
+step boundary and push/barrier replay state is re-keyed per generation,
+so a relaunched worker's fresh seq stream can never read as replays of
+its previous incarnation.  ``gluon.Trainer`` resyncs and replays the
+abandoned step automatically.
 """
 from __future__ import annotations
 
@@ -62,9 +78,10 @@ import jax.numpy as jnp
 from .. import config as _config
 from .. import faults
 from ..ndarray import ndarray, array as nd_array
-from . import KVStoreBase, _reduce
+from . import KVStoreBase, MembershipChanged, _reduce
 
-__all__ = ["KVStoreDist", "KVStoreDistServer", "run_server"]
+__all__ = ["KVStoreDist", "KVStoreDistServer", "MembershipChanged",
+           "run_server"]
 
 _LEN = struct.Struct(">Q")
 
@@ -186,7 +203,7 @@ class KVStoreDistServer:
     """One parameter-server shard (reference kvstore_dist_server.h:155)."""
 
     def __init__(self, port=None, num_workers=None, sync=None,
-                 stall_sec=None):
+                 stall_sec=None, evict_sec=None):
         self.num_workers = int(num_workers
                                if num_workers is not None
                                else _env("DMLC_NUM_WORKER", "1"))
@@ -198,18 +215,34 @@ class KVStoreDistServer:
                                   _env("DMLC_PS_ROOT_PORT", "9090")))
         self.stall_sec = float(stall_sec if stall_sec is not None
                                else _config.get("MXNET_KV_STALL_SEC"))
+        self.evict_sec = float(evict_sec if evict_sec is not None
+                               else _config.get("MXNET_KV_EVICT_SEC"))
         self.store = {}          # key -> onp.ndarray
         self.updater = None
         self.buf = {}            # key -> {rank: [grads]}
         self.applied_round = {}  # key -> completed rounds
         self.cond = threading.Condition()
+        # elastic membership: rank -> worker incarnation id.  The
+        # generation bumps on every leave/evict/rejoin (NOT on the initial
+        # fill up to the configured worker count); requests carrying a
+        # stale generation get a typed membership_changed reply.  _target
+        # is the live world size sync rounds/barriers wait for.
+        self._members = {}
+        self._rejoin_ranks = set()   # ranks that joined mid-training
+        self._generation = 0
+        self._membership_dirty = False
+        self._target = self.num_workers
+        self._round_backup = {}      # key -> value before the last apply
         # barrier state is kept PER STORE ID: one worker process may hold
         # several stores (dist_sync + p3), each with its own seq counter
         # starting at 1 — keying replay state by rank alone would read the
         # second store's (rank, seq=1) barrier as a replay of the first
         # store's and deadlock the round (the PR-3 known bug)
         self._barriers = {}           # store -> {count, gen, ranks, entered}
-        self._push_seen = {}          # (store, key, rank) -> last seq
+        self._push_seen = {}     # (mgen, store, key, rank) -> last seq —
+        # keyed by membership generation too: a relaunched worker restarts
+        # its seq counter at 1, and only the generation bump (its register
+        # cleared the table) keeps those from reading as replays
         self._dup_pushes = 0          # replayed pushes dedup'd (not
         # re-applied) — OSDI'14 replay safety observable for tests
         self._stop = False
@@ -276,6 +309,30 @@ class KVStoreDistServer:
 
     def _handle(self, msg):
         op = msg["op"]
+        mgen = msg.get("gen")
+        if mgen is not None and op in ("init", "push", "barrier",
+                                       "set_optimizer"):
+            # stale-generation MUTATIONS must neither apply nor deadlock:
+            # the typed reply tells the worker to resync + replay the step.
+            # Pulls are read-only and checked inside their wait loop only —
+            # a completed round's value is served even under a stale gen,
+            # so a survivor draining the pulls of a round that finished
+            # just before the membership event never replays (and
+            # double-applies) that step.
+            with self.cond:
+                if mgen != self._generation:
+                    return self._membership_reply_locked()
+        if op == "register":
+            return self._handle_register(msg)
+        if op == "leave":
+            return self._handle_leave(msg)
+        if op == "status":
+            with self.cond:
+                reply = self._membership_reply_locked()
+                reply["ok"] = True
+                del reply["membership_changed"]
+                reply["dup_pushes"] = self._dup_pushes
+                return reply
         if op == "init":
             with self.cond:
                 key = msg["key"]
@@ -302,6 +359,122 @@ class KVStoreDistServer:
             return {"ok": True}
         return {"ok": False, "error": "unknown op %r" % op}
 
+    # -- elastic membership ----------------------------------------------
+    def _live_ranks_locked(self):
+        if self._members and len(self._members) >= self._target:
+            return sorted(self._members)
+        # initial fill (or legacy workers that never register): configured
+        # ranks that have not registered yet still count as expected
+        return sorted(set(self._members) | set(range(self.num_workers)))
+
+    def _base_round_locked(self):
+        """The last completed step boundary: the minimum applied round
+        across keys (every key advances exactly once per sync step)."""
+        return min(self.applied_round.values()) if self.applied_round else 0
+
+    def _membership_reply_locked(self):
+        return {"ok": False, "membership_changed": True,
+                "gen": self._generation, "num_workers": self._target,
+                "ranks": self._live_ranks_locked(),
+                "round": self._base_round_locked(),
+                "error": "membership changed: now generation %d with %d "
+                         "live worker(s) %s — resync and replay the step"
+                         % (self._generation, self._target,
+                            self._live_ranks_locked())}
+
+    def _rollback_inflight_locked(self):
+        """Abandon the in-flight sync round atomically: per-key applies
+        that already landed this round roll back to the last step boundary
+        (workers replay the whole step under the new generation), and
+        partial push buffers are dropped.  With a server-side optimizer
+        the rolled-back applies' optimizer-state mutations are not unwound
+        — exact for stateless SGD, approximate otherwise; the graceful
+        step-boundary preemption path never triggers a rollback, so the
+        bit-identical boundary guarantee is unaffected."""
+        if self.applied_round:
+            base = self._base_round_locked()
+            for key, r in list(self.applied_round.items()):
+                if r == base + 1 and \
+                        self._round_backup.get(key) is not None:
+                    self.store[key] = self._round_backup[key]
+                    self.applied_round[key] = base
+        self.buf.clear()
+        self._round_backup.clear()
+
+    def _membership_event_locked(self, kind):
+        """A leave/evict/rejoin: bump the generation, shrink/grow the sync
+        target to the live set, roll the in-flight round back to the step
+        boundary, and drop per-generation replay state.  Waiters blocked
+        in pull/barrier observe the bump and return membership_changed."""
+        self._generation += 1
+        self._membership_dirty = True
+        self._target = max(1, len(self._members))
+        self._rollback_inflight_locked()
+        self._push_seen.clear()  # re-keyed per generation
+        self._barriers.clear()
+        self.cond.notify_all()
+        from .. import profiler
+        profiler.record_event_stat("membership.%s" % kind)
+        profiler.record_counter("membership", generation=self._generation,
+                                live_workers=self._target)
+
+    def _handle_register(self, msg):
+        faults.check("server.membership")
+        rank = int(msg["rank"])
+        inc = str(msg.get("inc", ""))
+        with self.cond:
+            cur = self._members.get(rank)
+            if cur is None:
+                fill = (not self._membership_dirty
+                        and len(self._members) < self._target)
+                self._members[rank] = inc
+                if fill:
+                    # initial fill up to the configured world: silent —
+                    # bumping here would thrash every startup with resyncs
+                    from .. import profiler
+                    profiler.record_event_stat("membership.join")
+                else:
+                    self._rejoin_ranks.add(rank)
+                    self._membership_event_locked("rejoin")
+            elif cur != inc:
+                # a relaunched incarnation of a rank that never left
+                # (crash before eviction): its seq stream restarts, so its
+                # replay state MUST be invalidated via a generation bump
+                self._members[rank] = inc
+                self._rejoin_ranks.add(rank)
+                self._membership_event_locked("rejoin")
+            # cur == inc: idempotent resync — report, don't bump
+            reply = self._membership_reply_locked()
+            reply["ok"] = True
+            del reply["membership_changed"]
+            del reply["error"]
+            reply["rejoin"] = rank in self._rejoin_ranks
+            # per-key round watermarks: the registrant's sync pulls wait
+            # relative to these (a key first pushed AFTER registration
+            # starts from 0 — a single scalar base would overshoot it)
+            reply["rounds"] = {k: int(v)
+                               for k, v in self.applied_round.items()}
+            return reply
+
+    def _handle_leave(self, msg):
+        faults.check("server.membership")
+        rank = int(msg["rank"])
+        with self.cond:
+            if rank in self._members:
+                del self._members[rank]
+                self._membership_event_locked("leave")
+            return {"ok": True, "gen": self._generation,
+                    "num_workers": self._target}
+
+    def _evict_locked(self, ranks):
+        """Watchdog escalation: drop ranks that stalled a sync round or
+        barrier past MXNET_KV_EVICT_SEC from the membership so the
+        survivors continue at the smaller world size."""
+        faults.trip("server.membership")
+        for r in ranks:
+            self._members.pop(r, None)
+        self._membership_event_locked("evict")
+
     def _barrier_group(self, store):
         grp = self._barriers.get(store)
         if grp is None:
@@ -319,6 +492,7 @@ class KVStoreDistServer:
         rank = msg.get("rank", -1)
         seq = msg.get("seq")
         store = msg.get("store", "")
+        mgen = msg.get("gen")
         with self.cond:
             grp = self._barrier_group(store)
             prev = grp["entered"].get(rank)
@@ -329,7 +503,7 @@ class KVStoreDistServer:
                 grp["entered"][rank] = (seq, gen)
                 grp["ranks"].add(rank)
                 grp["count"] += 1
-                if grp["count"] == self.num_workers:
+                if grp["count"] >= self._target:
                     grp["count"] = 0
                     grp["ranks"].clear()
                     grp["gen"] += 1
@@ -337,11 +511,22 @@ class KVStoreDistServer:
                     return {"ok": True}
             deadline = (time.monotonic() + self.stall_sec
                         if self.stall_sec > 0 else None)
+            evict_at = (time.monotonic() + self.evict_sec
+                        if self.evict_sec > 0 and self._members else None)
             while grp["gen"] == gen and not self._stop:
+                if mgen is not None and mgen != self._generation:
+                    return self._membership_reply_locked()
                 self.cond.wait(0.2)
+                if evict_at is not None and time.monotonic() > evict_at \
+                        and grp["gen"] == gen:
+                    missing = [r for r in self._live_ranks_locked()
+                               if r not in grp["ranks"]]
+                    if missing:
+                        self._evict_locked(missing)
+                        continue  # gen check above returns the reply
                 if deadline is not None and time.monotonic() > deadline \
                         and grp["gen"] == gen:
-                    missing = sorted(set(range(self.num_workers))
+                    missing = sorted(set(self._live_ranks_locked())
                                      - grp["ranks"])
                     return {"ok": False, "stall": True,
                             "error": "barrier (store %r) stalled for "
@@ -349,12 +534,15 @@ class KVStoreDistServer:
                                      "(arrived: %s of %d)"
                                      % (store, self.stall_sec, missing,
                                         sorted(grp["ranks"]),
-                                        self.num_workers)}
+                                        self._target)}
         return {"ok": True}
 
     def _apply(self, key, agg):
         """Aggregate applied: run server-side optimizer or store the sum
         (reference ApplyUpdates :346 / MergeUpdates)."""
+        # one-round-deep undo log: a membership change mid-step rolls the
+        # already-applied keys of the abandoned round back to the boundary
+        self._round_backup[key] = self.store.get(key)
         if self.updater is not None:
             weight = nd_array(self.store[key])
             self.updater(int(key) if key.isdigit() else key,
@@ -388,11 +576,12 @@ class KVStoreDistServer:
                 # too: distinct stores in one process run independent seq
                 # streams, and a fresh store's seq=1 push to a key another
                 # store already touched must not read as a replay.
-                last = self._push_seen.get((store, key, rank), -1)
+                last = self._push_seen.get(
+                    (self._generation, store, key, rank), -1)
                 if seq <= last:
                     self._dup_pushes += 1
                     return {"ok": True, "dup": True}
-                self._push_seen[(store, key, rank)] = seq
+                self._push_seen[(self._generation, store, key, rank)] = seq
             if not sync:
                 # async: apply immediately.  Without a server-side
                 # optimizer an async push would accumulate raw gradients
@@ -413,7 +602,7 @@ class KVStoreDistServer:
             # desync rounds forever
             q = self.buf.setdefault(key, {})
             q.setdefault(rank, []).append(value)
-            while len(q) == self.num_workers and \
+            while len(q) >= self._target and \
                     all(len(v) > 0 for v in q.values()):
                 agg = None
                 for r in list(q):
@@ -433,13 +622,28 @@ class KVStoreDistServer:
     def _handle_pull(self, msg):
         key = msg["key"]
         want_round = msg.get("round", 0)
+        mgen = msg.get("gen")
         with self.cond:
             deadline = (time.monotonic() + self.stall_sec
                         if self.stall_sec > 0 else None)
+            evict_at = (time.monotonic() + self.evict_sec
+                        if self.evict_sec > 0 and self._members else None)
             while (self.sync
                    and self.applied_round.get(key, 0) < want_round
                    and not self._stop):
+                if mgen is not None and mgen != self._generation:
+                    return self._membership_reply_locked()
                 self.cond.wait(0.2)
+                if evict_at is not None and time.monotonic() > evict_at \
+                        and self.applied_round.get(key, 0) < want_round:
+                    # escalation beyond the diagnose-only stall watchdog:
+                    # evict the ranks that never pushed this round so the
+                    # survivors continue at the smaller world size
+                    missing = [r for r in self._live_ranks_locked()
+                               if not self.buf.get(key, {}).get(r)]
+                    if missing:
+                        self._evict_locked(missing)
+                        continue  # gen check above returns the reply
                 if deadline is not None and time.monotonic() > deadline \
                         and self.applied_round.get(key, 0) < want_round:
                     # name the culprits instead of hanging forever: ranks
@@ -447,7 +651,7 @@ class KVStoreDistServer:
                     # rest never pushed this round
                     pushed = sorted(r for r, v in
                                     self.buf.get(key, {}).items() if v)
-                    missing = sorted(set(range(self.num_workers))
+                    missing = sorted(set(self._live_ranks_locked())
                                      - set(self.buf.get(key, {})))
                     return {"ok": False, "stall": True,
                             "error": "sync pull of key %r stalled for "
@@ -637,7 +841,8 @@ class KVStoreDist(KVStoreBase):
     Keys are sharded across servers by int(key) % num_servers (the PSKV
     analog); values pushed are first reduced in-process (ICI tier)."""
 
-    def __init__(self, name="dist_sync"):
+    def __init__(self, name="dist_sync", rank=None, num_workers=None,
+                 inc=None):
         self._name = name
         self._sync = not name.endswith("async")
         # host dependency engine: pushes run async on engine workers with a
@@ -655,13 +860,17 @@ class KVStoreDist(KVStoreBase):
             "MXNET_KVSTORE_SLICE_THRESHOLD",
             "40000" if name == "p3" else "0")) or (
                 int(_env("MXNET_KVSTORE_BIGARRAY_BOUND", "0")) or 0)
-        self._rank = int(_env("DMLC_WORKER_ID", "0"))
-        self._num_workers = int(_env("DMLC_NUM_WORKER", "1"))
+        self._rank = int(rank if rank is not None
+                         else _env("DMLC_WORKER_ID", "0"))
+        self._num_workers = int(num_workers if num_workers is not None
+                                else _env("DMLC_NUM_WORKER", "1"))
         self._num_servers = int(_env("DMLC_NUM_SERVER", "1"))
         host = _env("DMLC_PS_ROOT_URI", "127.0.0.1")
         base_port = int(_env("DMLC_PS_ROOT_PORT", "9090"))
         self._conns = [_ServerConn(host, base_port + s)
                        for s in range(self._num_servers)]
+        for s, c in enumerate(self._conns):
+            c.shard = s  # messages carry the target shard's generation
         self._push_round = {}  # key -> rounds this worker pushed
         self._gc = None  # optional GradientCompression
         # every request carries (store, rank, seq): the server dedups
@@ -673,8 +882,130 @@ class KVStoreDist(KVStoreBase):
         # per-key push order, so per-(key, rank) seqs stay monotonic.
         self._store_id = "s%d" % next(_STORE_ORDINALS)
         self._seq = itertools.count(1)
+        # elastic membership: register this worker incarnation with every
+        # shard.  The incarnation id defaults to the pid so several stores
+        # in one process (dist_sync + p3) register as ONE worker, while a
+        # relaunched process registers as a rejoin (generation bump that
+        # invalidates the dead incarnation's replay state).
+        self._inc = str(inc) if inc is not None else str(os.getpid())
+        self._gens = [0] * self._num_servers  # per-shard membership gen
+        self._num_workers_live = self._num_workers
+        self._member_ranks = list(range(self._num_workers))
+        self._round_base = {}    # per-key applied-round watermark at
+        # (re)registration: sync pulls wait relative to these
+        self._boundary_round = 0  # server step boundary at registration
+        self._rejoined = False
+        self._left = False
+        self._pending_membership = None
+        self._register_all()
 
     _server_opt = False
+
+    # -- elastic membership ----------------------------------------------
+    def _register_all(self):
+        """Register (or re-register after a MembershipChanged) with every
+        shard; adopts the root shard's view of (generation, world, step
+        boundary)."""
+        replies = _grouped_requests(
+            [(c, {"op": "register", "rank": self._rank, "inc": self._inc,
+                  "store": self._store_id, "seq": next(self._seq)})
+             for c in self._conns])
+        for i, r in enumerate(replies):
+            if not r.get("ok"):
+                raise RuntimeError("kvstore register failed on shard %d: %s"
+                                   % (i, r.get("error")))
+            self._gens[i] = int(r.get("gen", 0))
+        root = replies[0]
+        self._num_workers_live = int(root.get("num_workers")
+                                     or self._num_workers)
+        self._member_ranks = list(root.get("ranks")
+                                  or range(self._num_workers))
+        self._round_base = {k: int(v)
+                            for k, v in (root.get("rounds") or {}).items()}
+        self._boundary_round = int(root.get("round", 0))
+        self._rejoined = bool(root.get("rejoin"))
+        self._left = False
+        return root
+
+    def _raise_if_membership(self, r):
+        """Translate a typed membership_changed reply into the typed
+        exception (message carries the 'membership changed' marker so the
+        engine's string-only error transport stays recognizable)."""
+        if isinstance(r, dict) and r.get("membership_changed"):
+            self._pending_membership = r
+            raise MembershipChanged(
+                r.get("error") or "membership changed",
+                gen=r.get("gen"), num_workers=r.get("num_workers"),
+                ranks=r.get("ranks"), round=r.get("round"))
+
+    def resync(self):
+        """Adopt the server's current membership generation after a
+        MembershipChanged: drain/abandon the aborted step's per-key engine
+        vars (their queued pushes carry the stale generation and are
+        rejected server-side), re-register, and reset round accounting to
+        the server's step boundary.  Returns the membership info dict the
+        caller (gluon.Trainer) uses to rescale gradient averaging."""
+        self._pending_membership = None
+        old_vars, self._key_vars = self._key_vars, {}
+        for var in old_vars.values():
+            try:
+                self._engine.wait_for_var(var)
+            except Exception:
+                pass  # poisoned by the abandoned step — expected
+            self._engine.delete_variable(var)
+        self._push_round.clear()
+        root = self._register_all()
+        from .. import profiler
+        profiler.record_event_stat("membership.resync")
+        return {"gen": self._gens[0],
+                "num_workers": self._num_workers_live,
+                "ranks": self._member_ranks,
+                "round": self._boundary_round,
+                "rejoin": self._rejoined, "status": root}
+
+    def leave(self):
+        """Graceful departure (preemption): the server drops this rank
+        from the membership so survivors continue — rescaled to the
+        smaller world — instead of stalling into the watchdog."""
+        if self._left:
+            return
+        try:
+            self.wait_async()
+        except Exception:
+            pass  # leaving anyway; the step is being abandoned
+        try:
+            _grouped_requests(
+                [(c, {"op": "leave", "rank": self._rank,
+                      "store": self._store_id, "seq": next(self._seq)})
+                 for c in self._conns])
+        except ConnectionError:
+            pass  # server gone too; nothing to leave
+        self._left = True
+
+    def server_status(self):
+        """Root shard's membership/step view: {gen, num_workers, ranks,
+        round, dup_pushes} (tests, rejoin fast-forward, dashboards)."""
+        return self._conns[0].request(
+            {"op": "status", "rank": self._rank, "store": self._store_id,
+             "seq": next(self._seq)})
+
+    def current_round(self):
+        """The server's last completed step boundary (min applied round):
+        a rejoining worker fast-forwards its step counter here."""
+        return int(self.server_status().get("round", 0))
+
+    @property
+    def num_workers_live(self):
+        """Live world size under the current membership generation (the
+        configured launch size stays in ``num_workers``)."""
+        return self._num_workers_live
+
+    @property
+    def rejoined(self):
+        """True when this store registered into a job already in progress
+        (its collective init/set_optimizer barriers are skipped — the
+        survivors are mid-step and would never meet them)."""
+        return self._rejoined
 
     def set_gradient_compression(self, compression_params):
         """2-bit/1-bit push compression with error feedback
@@ -707,10 +1038,26 @@ class KVStoreDist(KVStoreBase):
         return var
 
     def _wait_key(self, key):
-        """Drain pending async pushes for key; re-raises their errors."""
+        """Drain pending async pushes for key; re-raises their errors.
+        The engine transports errors as strings (type is lost), so a
+        poisoned var from a membership change is re-typed here via the
+        message marker + the stashed reply."""
         var = self._key_vars.get(key)
         if var is not None:
-            self._engine.wait_for_var(var)
+            try:
+                self._engine.wait_for_var(var)
+            except MembershipChanged:
+                raise
+            except Exception as e:
+                info = self._pending_membership
+                if info is not None or "membership changed" in str(e):
+                    info = info or {}
+                    raise MembershipChanged(
+                        str(e), gen=info.get("gen"),
+                        num_workers=info.get("num_workers"),
+                        ranks=info.get("ranks"),
+                        round=info.get("round")) from e
+                raise
 
     def wait_async(self):
         """Block until every scheduled push has hit the wire."""
@@ -758,10 +1105,13 @@ class KVStoreDist(KVStoreBase):
                     onp.asarray(v)
                 plan = self._slice_plan(k, v.size)
                 if plan is None:
-                    r = self._conn_for(k).request(
+                    conn = self._conn_for(k)
+                    r = conn.request(
                         {"op": "init", "key": k, "value": v,
                          "rank": self._rank, "store": self._store_id,
+                         "gen": self._gens[conn.shard],
                          "seq": next(self._seq)})
+                    self._raise_if_membership(r)
                     assert r["ok"], r
                 else:
                     flat = v.ravel()
@@ -769,9 +1119,15 @@ class KVStoreDist(KVStoreBase):
                             [(c, {"op": "init", "key": sk,
                                   "value": flat[a:b], "rank": self._rank,
                                   "store": self._store_id,
+                                  "gen": self._gens[c.shard],
                                   "seq": next(self._seq)})
                              for sk, a, b, c in plan]):
+                        self._raise_if_membership(r)
                         assert r["ok"], r
+        if self._rejoined:
+            return  # mid-job rejoin: the survivors are inside their step
+            # loop and would never meet this barrier; the server already
+            # holds the weights, so there is nothing to synchronize
         self.barrier()
 
     def push(self, key, value, priority=0):
@@ -809,6 +1165,12 @@ class KVStoreDist(KVStoreBase):
         slice_keys = [key] if plan is None else [sk for sk, _, _, _ in plan]
         for sk in slice_keys:
             self._push_round[sk] = self._push_round.get(sk, 0) + 1
+        # membership generation snapshotted at SCHEDULE time: a push from
+        # an abandoned step that the engine runs after resync() must still
+        # carry the stale generation (and be rejected) — stamping the
+        # current generation at send time would smuggle a stale gradient
+        # into the new round
+        gens = list(self._gens)
 
         def work():
             arr = src.asnumpy() if hasattr(src, "asnumpy") else \
@@ -830,6 +1192,7 @@ class KVStoreDist(KVStoreBase):
                     msg = {"op": "push", "key": sk, "rank": self._rank,
                            "store": self._store_id,
                            "value": sv, "sync": self._sync}
+                msg["gen"] = gens[conn.shard]
                 # seq assigned here (engine worker, per-key serialized):
                 # a RETRY of this message reuses the same seq, so the
                 # server can tell "resent after lost ack" from "new push"
@@ -837,6 +1200,7 @@ class KVStoreDist(KVStoreBase):
                 conn_msgs.append((conn, msg))
             replies = _grouped_requests(conn_msgs)
             for r in replies:
+                self._raise_if_membership(r)
                 if not r["ok"]:
                     raise RuntimeError("dist push failed: %s"
                                        % r.get("error"))
@@ -854,11 +1218,15 @@ class KVStoreDist(KVStoreBase):
         outs = out if isinstance(out, (list, tuple)) else [out]
         plan = self._slice_plan(key, outs[0].size)
         if plan is None:
-            r = self._conn_for(key).request(
+            conn = self._conn_for(key)
+            r = conn.request(
                 {"op": "pull", "key": key,
-                 "round": self._push_round.get(key, 0),
+                 "round": self._round_base.get(key, 0)
+                          + self._push_round.get(key, 0),
                  "rank": self._rank, "store": self._store_id,
+                 "gen": self._gens[conn.shard],
                  "seq": next(self._seq)})
+            self._raise_if_membership(r)
             if not r["ok"]:
                 if r.get("stall"):
                     raise TimeoutError(r["error"])
@@ -867,12 +1235,15 @@ class KVStoreDist(KVStoreBase):
         else:
             replies = _grouped_requests(
                 [(c, {"op": "pull", "key": sk,
-                      "round": self._push_round.get(sk, 0),
+                      "round": self._round_base.get(sk, 0)
+                               + self._push_round.get(sk, 0),
                       "rank": self._rank, "store": self._store_id,
+                      "gen": self._gens[c.shard],
                       "seq": next(self._seq)})
                  for sk, _a, _b, c in plan])
             parts = []
             for r in replies:
+                self._raise_if_membership(r)
                 if not r["ok"]:
                     if r.get("stall"):
                         raise TimeoutError(r["error"])
@@ -896,13 +1267,20 @@ class KVStoreDist(KVStoreBase):
     def set_optimizer(self, optimizer):
         self._server_opt = True  # disables big-array slicing (see
         # _slice_plan: per-slice updates break norm-based optimizers)
+        if self._rejoined:
+            # mid-job rejoin: the server-side updater (and its state) is
+            # already installed; replacing it would reset optimizer state
+            # and the survivors would never meet the trailing barrier
+            return
         if self._rank == 0:
             blob = pickle.dumps(optimizer)
             for c in self._conns:
                 r = c.request({"op": "set_optimizer", "optimizer": blob,
                                "rank": self._rank,
                                "store": self._store_id,
+                               "gen": self._gens[c.shard],
                                "seq": next(self._seq)})
+                self._raise_if_membership(r)
                 assert r["ok"], r
         self.barrier()
 
@@ -914,8 +1292,10 @@ class KVStoreDist(KVStoreBase):
         self.wait_async()
         r = self._conns[0].request({"op": "barrier", "rank": self._rank,
                                     "store": self._store_id,
+                                    "gen": self._gens[0],
                                     "seq": next(self._seq)})
         if not r.get("ok"):
+            self._raise_if_membership(r)
             if r.get("stall"):
                 raise TimeoutError(r["error"])
             raise RuntimeError("barrier failed: %s" % r.get("error"))

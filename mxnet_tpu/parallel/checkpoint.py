@@ -186,9 +186,20 @@ def _read_manifest(path, step):
 
 def _trainer_states_blob(trainer):
     """Snapshot optimizer state NOW (the async writer must not observe
-    later updates) — the same serialization as Trainer.save_states."""
+    later updates) — the same serialization as Trainer.save_states.
+    The optimizer's param_dict of live Parameters is replaced with plain
+    lr/wd-mult namespaces before pickling (a Parameter fresh out of a
+    backward holds tape replay closures, which don't pickle); the loader
+    (resume_training) re-attaches the real parameters."""
+    import copy
+    from types import SimpleNamespace
     from ..optimizer import Updater
-    u = Updater(trainer._optimizer)
+    opt = copy.copy(trainer._optimizer)
+    opt.param_dict = {
+        i: SimpleNamespace(lr_mult=getattr(p, "lr_mult", 1.0),
+                           wd_mult=getattr(p, "wd_mult", 1.0))
+        for i, p in enumerate(trainer._params)}
+    u = Updater(opt)
     u.states = trainer._states
     return u.get_states(dump_optimizer=True)
 
@@ -430,17 +441,10 @@ def latest_step(path):
 # ---------------------------------------------------------------------------
 # load / resume
 # ---------------------------------------------------------------------------
-def load_checkpoint(path, params, step=0):
-    """Restore into params (dict of name → Parameter/ndarray) in place;
-    sharded arrays are restored with their target sharding.
-
-    step: an int (that step, falling back to the newest valid one with a
-    warning if it is corrupt or missing), or None/'latest' for the
-    newest valid step."""
-    path = os.path.abspath(path)
-    wait_for_saves(path)  # pending async writes to this path land first
-    step = _resolve_step(path, step)
-    loaded = None
+def _read_step(path, step, params):
+    """Materialize step's arrays as {name: array}.  Raises OSError (incl.
+    FileNotFoundError) if the step's files vanish mid-read — the caller
+    treats that as a concurrent ``keep=N`` prune and re-resolves."""
     ocp_dir = os.path.join(path, "step_%d" % step)
     npz = os.path.join(path, "step_%d.npz" % step)
     if os.path.isdir(ocp_dir):
@@ -454,16 +458,45 @@ def load_checkpoint(path, params, step=0):
         except Exception:
             # deferred-shape params (net not yet called): restore with the
             # checkpoint's own shapes/shardings; Parameter.set_data
-            # finalizes shapes below
+            # finalizes shapes in the caller
             targets = None
-        loaded = ckptr.restore(ocp_dir, targets) if targets is not None \
+        return ckptr.restore(ocp_dir, targets) if targets is not None \
             else ckptr.restore(ocp_dir)
-    elif os.path.isfile(npz):
-        data = onp.load(npz)
-        loaded = {k: data[k] for k in data.files}
+    if os.path.isfile(npz):
+        with onp.load(npz) as data:
+            return {k: data[k] for k in data.files}
+    raise FileNotFoundError("no checkpoint at %s (step %d)" % (path, step))
+
+
+def load_checkpoint(path, params, step=0):
+    """Restore into params (dict of name → Parameter/ndarray) in place;
+    sharded arrays are restored with their target sharding.
+
+    step: an int (that step, falling back to the newest valid one with a
+    warning if it is corrupt or missing), or None/'latest' for the
+    newest valid step.
+
+    Concurrency: safe against a concurrent ``save_checkpoint(keep=N)``
+    prune — a step whose files vanish between verification and the read
+    (the prune removes its manifest FIRST, so it stops being listed) is
+    re-resolved instead of surfacing a FileNotFoundError."""
+    path = os.path.abspath(path)
+    wait_for_saves(path)  # pending async writes to this path land first
+    requested = step
+    last_exc = None
+    for _attempt in range(4):
+        step = _resolve_step(path, requested)
+        try:
+            loaded = _read_step(path, step, params)
+            break
+        except OSError as e:  # pruned between verify and read
+            last_exc = e
+            from .. import profiler
+            profiler.record_event_stat("checkpoint.prune_race")
     else:
-        raise FileNotFoundError("no checkpoint at %s (step %d)"
-                                % (path, step))
+        raise FileNotFoundError(
+            "checkpoint at %s kept vanishing mid-load (concurrent "
+            "retention prune?): %s" % (path, last_exc)) from last_exc
     import jax.numpy as jnp
     for k, v in params.items():
         if k not in loaded:
@@ -486,12 +519,24 @@ def resume_training(path, params, trainer=None, step=None):
     fast-forward epoch/batch counters."""
     path = os.path.abspath(path)
     wait_for_saves(path)
-    s = _resolve_step(path, step)
-    load_checkpoint(path, params, step=s)
-    man = _read_manifest(path, s) or {}
-    if trainer is not None and man.get("states"):
-        with open(os.path.join(path, man["states"]), "rb") as f:
-            blob = f.read()
+    for _attempt in range(4):
+        s = _resolve_step(path, step)
+        try:
+            load_checkpoint(path, params, step=s)
+            man = _read_manifest(path, s) or {}
+            blob = None
+            if trainer is not None and man.get("states"):
+                with open(os.path.join(path, man["states"]), "rb") as f:
+                    blob = f.read()
+            break
+        except OSError:  # concurrent keep=N prune took the step mid-read
+            from .. import profiler
+            profiler.record_event_stat("checkpoint.prune_race")
+    else:
+        raise FileNotFoundError(
+            "checkpoint at %s kept vanishing mid-resume (concurrent "
+            "retention prune?)" % path)
+    if blob is not None:
         from ..optimizer import Updater
         u = Updater(trainer._optimizer)
         u.set_states(blob)

@@ -79,6 +79,12 @@ class DynamicBatcher:
         self._workers = {}  # model -> Thread
         self._stopping = False
 
+    @property
+    def draining(self):
+        """True once stop()/drain() began: admissions are rejected while
+        queued work completes (the /readyz "not ready" signal)."""
+        return self._stopping
+
     # -- admission --------------------------------------------------------
     def submit(self, model, item, *, version=None, deadline_ms=None):
         """Enqueue one item; returns a ``concurrent.futures.Future`` that

@@ -5,24 +5,45 @@ JSON request/response, server error codes rehydrated into the same
 exception classes the in-process API raises (``QueueFullError`` on shed,
 ``DeadlineExceededError`` on expiry, ...), so calling code is identical
 whether it talks to the batcher directly or over the wire.
-"""
+
+Transport resilience (the ``MXNET_KV_RETRIES`` pattern from the dist
+kvstore): connect failures and connection resets retry with bounded
+exponential backoff + jitter (``MXNET_SERVING_RETRIES`` /
+``MXNET_SERVING_BACKOFF_MS``) — but ONLY for requests the server cannot
+have processed: refusals, and errors raised while sending.  A failure
+after the request reached the server retries only for idempotent GETs;
+a non-idempotent ``:predict`` whose reply was lost surfaces the error
+(re-sending could double-run it)."""
 from __future__ import annotations
 
 import http.client
 import json
+import os
+import random
+import time
 
 import numpy as onp
 
+from .. import config as _config
 from .errors import ServingError, error_for_code
 
 __all__ = ["ServingClient"]
 
 
 class ServingClient:
-    def __init__(self, host="127.0.0.1", port=8080, timeout=30.0):
+    def __init__(self, host="127.0.0.1", port=8080, timeout=30.0,
+                 retries=None, backoff_ms=None):
         self.host = host
         self.port = int(port)
         self.timeout = float(timeout)
+        self.retries = max(0, int(retries if retries is not None
+                                  else _config.get("MXNET_SERVING_RETRIES")))
+        self.backoff_ms = max(1.0, float(
+            backoff_ms if backoff_ms is not None
+            else _config.get("MXNET_SERVING_BACKOFF_MS")))
+        # jitter decorrelates retry storms across clients; never affects
+        # payloads, so a non-deterministic seed is fine
+        self._jitter = random.Random(os.getpid() ^ id(self))
         self._conn = None
 
     # -- plumbing ---------------------------------------------------------
@@ -32,21 +53,35 @@ class ServingClient:
                 self.host, self.port, timeout=self.timeout)
         return self._conn
 
-    def _request(self, method, path, body=None):
+    def _request(self, method, path, body=None, retries=None):
         payload = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if payload else {}
-        try:
-            conn = self._connection()
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-        except (ConnectionError, http.client.HTTPException, OSError):
-            # one reconnect: the server may have closed an idle keep-alive
-            self.close()
-            conn = self._connection()
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
+        retries = self.retries if retries is None else retries
+        last = None
+        for attempt in range(retries + 1):
+            phase = "send"
+            try:
+                conn = self._connection()
+                conn.request(method, path, body=payload, headers=headers)
+                phase = "recv"
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except (ConnectionError, http.client.HTTPException,
+                    OSError) as e:
+                self.close()  # a broken keep-alive stream never reuses
+                last = e
+                # not-yet-sent only: a refusal or a send-phase failure
+                # means the server never processed the request; a
+                # recv-phase loss retries only for idempotent GETs
+                retryable = (isinstance(e, ConnectionRefusedError)
+                             or phase == "send" or method == "GET")
+                if attempt >= retries or not retryable:
+                    raise
+                time.sleep(self.backoff_ms / 1e3 * (2 ** attempt)
+                           * (0.5 + self._jitter.random()))
+        else:  # pragma: no cover — loop always breaks or raises
+            raise last
         try:
             doc = json.loads(data.decode() or "{}")
         except ValueError:
@@ -90,6 +125,23 @@ class ServingClient:
             body["deadline_ms"] = float(deadline_ms)
         doc = self._request("POST", path, body)
         return onp.asarray(doc["predictions"])
+
+    def server_alive(self):
+        """Liveness probe: one /healthz round trip, no retries — True iff
+        a server is answering at (host, port)."""
+        try:
+            return bool(self._request("GET", "/healthz",
+                                      retries=0).get("ok"))
+        except (ServingError, OSError, http.client.HTTPException):
+            return False
+
+    def server_ready(self):
+        """Readiness probe: True iff /readyz reports ≥1 loaded model and
+        a non-draining batcher (503 → False, unreachable → False)."""
+        try:
+            return bool(self._request("GET", "/readyz").get("ready"))
+        except (ServingError, OSError, http.client.HTTPException):
+            return False
 
     def models(self):
         return self._request("GET", "/v1/models")["models"]

@@ -11,6 +11,11 @@ Endpoints (TF-Serving-flavoured REST, JSON bodies):
 - ``GET  /v1/stats``                           — metrics snapshot (JSON)
 - ``GET  /metrics``                            — same counters/percentiles
       in Prometheus text exposition format (scrape target)
+- ``GET  /healthz``                            — liveness: 200 whenever
+      the HTTP loop answers (orchestrator restart probe)
+- ``GET  /readyz``                             — readiness: 200 only with
+      ≥1 loaded model and the batcher not draining, else 503 (load
+      balancers stop routing BEFORE shutdown sheds requests)
 
 Error mapping is 1:1 with the serving error taxonomy (``errors.py``):
 400 bad payload, 404 unknown model, 503 shed/draining, 504 deadline —
@@ -143,6 +148,14 @@ class ModelServer:
 
     # -- request handling (transport-independent) -------------------------
     def _handle_get(self, path):
+        if path == "/healthz":
+            return 200, {"ok": True}
+        if path == "/readyz":
+            n_models = len(self.registry.models())
+            draining = bool(getattr(self.batcher, "draining", False))
+            ready = n_models > 0 and not draining
+            return (200 if ready else 503), {
+                "ready": ready, "models": n_models, "draining": draining}
         if path == "/v1/models":
             return 200, {"models": self.registry.models()}
         if path in ("/v1/stats", "/stats"):

@@ -130,6 +130,84 @@ def main():
             onp.testing.assert_allclose(out.asnumpy(), expect, atol=1e-6)
         result["gc_ok"] = True
 
+    elif mode == "elastic":
+        # elastic, preemption-tolerant training: SIGTERM is a graceful
+        # lifecycle event (checkpoint + leave + exit 0) and a relaunched
+        # worker resumes + rejoins at the next step boundary.  Driven by
+        # tools/chaos.py --scenario preempt (SIGTERMs rank 1 mid-epoch,
+        # relaunches it, asserts completion + step-count conservation).
+        import time as _time
+        from mxnet_tpu.parallel.checkpoint import (latest_step,
+                                                   resume_training)
+        total = int(os.environ.get("ELASTIC_TOTAL_STEPS", "10"))
+        delay = float(os.environ.get("ELASTIC_STEP_DELAY", "0"))
+        ckpt = os.path.join(out_dir, "ckpt_rank%d" % rank)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+        mx.random.seed(7)  # identical init on every worker
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=kv)
+        trainer.attach_preemption(ckpt, net.collect_params())
+        start = 0
+        if latest_step(ckpt) is not None:  # relaunched incarnation
+            info = resume_training(ckpt, net.collect_params(),
+                                   trainer=trainer)
+            # rejoin at the server's current (generation, step) — ahead
+            # of the checkpoint if survivors kept training meanwhile
+            start = max(info["step"], kv.current_round())
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        for step in range(start, total):
+            # data deterministic per (rank, step): a replayed or resumed
+            # step consumes the same batch, so step count conservation
+            # implies reproducible training
+            rng = onp.random.RandomState(1234 + rank * 1000 + step)
+            x = mxnp.array(rng.rand(8, 6).astype(onp.float32))
+            y = mxnp.array(rng.randint(0, 2, 8).astype(onp.float32))
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            if delay:
+                _time.sleep(delay)  # chaos pacing: SIGTERM lands mid-run
+            trainer.step(8)
+            # heartbeat for the chaos driver: lets it preempt only after
+            # real progress (never during startup compiles)
+            with open(os.path.join(out_dir,
+                                   "progress_rank%d" % rank), "w") as f:
+                f.write(str(step + 1))
+        result["params"] = {k: p.data().asnumpy().tolist()
+                            for k, p in net.collect_params().items()}
+        result["start_step"] = start
+        result["rejoined"] = kv.rejoined
+        result["comm"] = trainer.comm_stats()
+        result["status"] = {k: v for k, v in kv.server_status().items()
+                            if k in ("gen", "num_workers", "ranks",
+                                     "round")}
+        result["events"] = {
+            k: v for k, v in
+            mx.profiler.aggregate_stats()["events"].items()
+            if k.startswith(("membership.", "elastic.", "preempt."))}
+        # completion fence: every worker (incl. a late rejoiner) lands
+        # here; membership may shift under us, so resync + retry
+        for _ in range(4):
+            try:
+                kv.barrier()
+                break
+            except mx.kv.MembershipChanged:
+                kv.resync()
+        with open(os.path.join(out_dir, "worker%d.json" % rank),
+                  "w") as f:
+            json.dump(result, f)
+        for _ in range(4):
+            try:
+                kv.barrier()
+                break
+            except mx.kv.MembershipChanged:
+                kv.resync()
+        if rank == 0:
+            kv.stop_servers()
+        return
+
     elif mode == "die":
         # fault-tolerance: rank 1 vanishes mid-round (preemption); rank
         # 0's sync pull must fail FAST with a diagnostic naming the dead

@@ -211,3 +211,82 @@ def test_kill9_mid_save_leaves_loadable_checkpoint(tmp_path):
         onp.testing.assert_array_equal(b.asnumpy(), onp.full(2048, s))
     finally:
         os.environ.pop("MXNET_CKPT_BACKEND", None)
+
+
+@pytest.mark.slow
+@pytest.mark.elastic
+def test_elastic_preempt_relaunch_rejoin_acceptance(tmp_path):
+    """PR acceptance (2-process dist_sync): SIGTERM worker 1 mid-epoch —
+    it must exit 0 after a graceful checkpoint + membership leave — then
+    relaunch it; the job completes without manual intervention with the
+    step count conserved (server round count == total steps, replicas
+    identical, a rejoin recorded).  Driven by tools/chaos.py
+    --scenario preempt so operators get the same drill as CI."""
+    import subprocess
+    import sys as _sys
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_FAULT_SPEC", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--scenario", "preempt"],
+        cwd=REPO, env=env, timeout=900, capture_output=True, text=True)
+    assert r.returncode == 0, \
+        "chaos preempt scenario failed:\nSTDOUT:%s\nSTDERR:%s" \
+        % (r.stdout[-4000:], r.stderr[-4000:])
+    assert "PASS" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.elastic
+def test_elastic_no_relaunch_survivor_completes(tmp_path):
+    """No relaunch: worker 1 is SIGKILLed (no graceful leave) and never
+    comes back; with MXNET_KV_EVICT_SEC the server evicts it and worker 0
+    completes the job alone with averaging rescaled to the live world."""
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from chaos import _spawn_cluster
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_FAULT_SPEC", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_KV_BACKOFF_MS"] = "5"
+    env["ELASTIC_TOTAL_STEPS"] = "8"
+    env["ELASTIC_STEP_DELAY"] = "0.4"
+    env["MXNET_KV_EVICT_SEC"] = "6"      # >> one paced step
+    env["MXNET_KV_STALL_SEC"] = "120"
+    out_dir = str(tmp_path)
+    servers, spawn_worker = _spawn_cluster(out_dir, 2, 1, env)
+    workers = {wid: spawn_worker(wid) for wid in range(2)}
+    try:
+        _time.sleep(5.0)
+        assert workers[1].poll() is None, "worker 1 finished too early"
+        workers[1].kill()  # SIGKILL: hard preemption, no goodbye
+        rc0 = workers[0].wait(timeout=300)
+        assert rc0 == 0, "survivor exited %d" % rc0
+    finally:
+        for w in workers.values():
+            if w.poll() is None:
+                w.kill()
+        for p in servers:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in servers:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    with open(os.path.join(out_dir, "worker0.json")) as f:
+        r0 = json.load(f)
+    assert r0["status"]["round"] == 8       # every step applied once
+    assert r0["status"]["num_workers"] == 1  # shrunk to the live world
+    assert r0["comm"]["live_world"] == 1
+    assert r0["comm"]["world_scale"] == 2.0  # averaging rescaled
+    assert r0["events"].get("membership.evict", 0) == 0  # worker-side
+    assert r0["events"].get("elastic.membership_change", 0) >= 1

@@ -1,0 +1,642 @@
+"""Elastic, preemption-tolerant data-parallel training (tier-1,
+in-process, deterministic): membership generations, graceful leave,
+stall-eviction, worker rejoin with replay-state invalidation, the
+trainer's abandon-and-replay step semantics (bit-identical at a step
+boundary), graceful preemption via SIGTERM-analog / injected fault, and
+the keep=N checkpoint-retention race under concurrent save/load/verify.
+The multi-process SIGTERM + relaunch acceptance lives in
+test_dist_kvstore.py (slow lane, via tools/chaos.py --scenario preempt).
+"""
+import os
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, faults, gluon, np as mxnp, profiler
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore import MembershipChanged
+
+pytestmark = [pytest.mark.elastic, pytest.mark.faults]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# in-process cluster harness (real sockets, simulated ranks)
+# ---------------------------------------------------------------------------
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_server(port, num_workers, stall_sec=20, evict_sec=0):
+    from mxnet_tpu.kvstore.dist import KVStoreDistServer
+    srv = KVStoreDistServer(port=port, num_workers=num_workers, sync=True,
+                            stall_sec=stall_sec, evict_sec=evict_sec)
+    ready = threading.Event()
+    t = threading.Thread(target=srv.serve, args=(ready,), daemon=True)
+    t.start()
+    assert ready.wait(10)
+    return srv, t
+
+
+def _stop_server(srv, t):
+    with srv.cond:
+        srv._stop = True
+        srv.cond.notify_all()
+    t.join(5)
+
+
+def _cluster_env(monkeypatch, port, num_workers):
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "60")
+    monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "5")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", str(num_workers))
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+
+
+def _worker(rank, inc):
+    from mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist("dist_sync", rank=rank, num_workers=2, inc=inc)
+    # in real deployments every rank runs the same program, so creation
+    # ORDER assigns matching store ids; all simulated ranks live in this
+    # one test process, so align them by hand (else barriers/dedup land
+    # in per-rank domains and init deadlocks — see test_bucketing)
+    kv._store_id = "el"
+    return kv
+
+
+# ---------------------------------------------------------------------------
+# membership protocol
+# ---------------------------------------------------------------------------
+def test_register_initial_fill_keeps_generation_zero(monkeypatch):
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    srv, t = _start_server(port, 2)
+    a = b = None
+    try:
+        a = _worker(0, "w0")
+        st = a.server_status()
+        assert st["gen"] == 0 and st["num_workers"] == 2
+        b = _worker(1, "w1")
+        st = b.server_status()
+        # filling up to the configured world must NOT bump the generation
+        # (a bump per startup registration would thrash every launch)
+        assert st["gen"] == 0
+        assert st["ranks"] == [0, 1] and st["round"] == 0
+        assert not a.rejoined and not b.rejoined
+    finally:
+        for kv in (a, b):
+            if kv is not None:
+                kv.close()
+        _stop_server(srv, t)
+
+
+def test_leave_bumps_generation_and_survivor_resyncs(monkeypatch):
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    srv, t = _start_server(port, 2)
+    a = _worker(0, "w0")
+    b = _worker(1, "w1")
+    try:
+        # one full 2-worker round so the store has state
+        with srv.cond:
+            srv.store["k"] = onp.zeros(4, onp.float32)
+            srv.applied_round["k"] = 0
+        a.push("k", mxnp.ones(4))
+        b.push("k", mxnp.ones(4) * 2)
+        out = mxnp.zeros(4)
+        a.pull("k", out=out)
+        onp.testing.assert_array_equal(out.asnumpy(), onp.full(4, 3.0))
+
+        b.leave()
+        st = a.server_status()
+        assert st["gen"] == 1 and st["num_workers"] == 1
+        assert st["ranks"] == [0]
+        # survivor's next mutation carries the stale generation → typed
+        # exception (push is engine-async: surfaces at the pull)
+        a.push("k", mxnp.ones(4))
+        with pytest.raises(MembershipChanged):
+            a.pull("k", out=out)
+        info = a.resync()
+        assert info["num_workers"] == 1 and info["gen"] == 1
+        # replay the round alone: target is now 1, so it applies solo
+        # (each sync round stores the round's sum — here rank 0's alone)
+        a.push("k", mxnp.ones(4))
+        a.pull("k", out=out)
+        onp.testing.assert_array_equal(out.asnumpy(), onp.ones(4))
+    finally:
+        a.close()
+        b.close()
+        _stop_server(srv, t)
+
+
+def test_stalled_rank_is_evicted_and_survivor_continues(monkeypatch):
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    srv, t = _start_server(port, 2, stall_sec=30, evict_sec=0.4)
+    profiler.reset_stats()
+    a = _worker(0, "w0")
+    b = _worker(1, "w1")  # registers, then goes silent (wedged/crashed)
+    try:
+        with srv.cond:
+            srv.store["k"] = onp.zeros(2, onp.float32)
+            srv.applied_round["k"] = 0
+        a.push("k", mxnp.ones(2))
+        out = mxnp.zeros(2)
+        with pytest.raises(MembershipChanged) as ei:
+            a.pull("k", out=out)  # waits → server evicts rank 1
+        assert ei.value.num_workers == 1
+        assert profiler.aggregate_stats()["events"].get(
+            "membership.evict", 0) >= 1
+        a.resync()
+        a.push("k", mxnp.ones(2))
+        a.pull("k", out=out)
+        onp.testing.assert_array_equal(out.asnumpy(), onp.ones(2))
+        st = a.server_status()
+        assert st["ranks"] == [0] and st["gen"] >= 1
+    finally:
+        a.close()
+        b.close()
+        _stop_server(srv, t)
+
+
+def test_rejoin_after_leave_restores_world_and_round(monkeypatch):
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    srv, t = _start_server(port, 2)
+    a = _worker(0, "w0")
+    b = _worker(1, "w1")
+    out = mxnp.zeros(3)
+    try:
+        with srv.cond:
+            srv.store["k"] = onp.zeros(3, onp.float32)
+            srv.applied_round["k"] = 0
+        for kv in (a, b):
+            kv.push("k", mxnp.ones(3))
+        a.pull("k", out=out)
+        b.leave()
+        gen_after_leave = a.server_status()["gen"]
+
+        b2 = _worker(1, "w1-relaunch")
+        try:
+            assert b2.rejoined  # joined a job already in progress
+            st = b2.server_status()
+            assert st["gen"] == gen_after_leave + 1
+            assert st["num_workers"] == 2 and st["ranks"] == [0, 1]
+            # survivor adopts the new generation and a full 2-rank round
+            # completes; the rejoiner's per-key watermark lines up with
+            # the server (its fresh push counter starts from there)
+            a.resync()
+            a.push("k", mxnp.ones(3) * 5)
+            b2.push("k", mxnp.ones(3) * 7)
+            a.pull("k", out=out)
+            onp.testing.assert_array_equal(out.asnumpy(),
+                                           onp.full(3, 12.0))
+            b2.pull("k", out=out)
+            onp.testing.assert_array_equal(out.asnumpy(),
+                                           onp.full(3, 12.0))
+        finally:
+            b2.close()
+    finally:
+        a.close()
+        b.close()
+        _stop_server(srv, t)
+
+
+def test_relaunched_incarnation_invalidates_replay_state(monkeypatch):
+    """A relaunched worker restarts its seq counter at 1; without the
+    per-generation re-keying of the push dedup table its first pushes
+    would read as replays of the dead incarnation and be dropped."""
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    srv, t = _start_server(port, 2)
+    a = _worker(0, "w0")
+    b = _worker(1, "w1")
+    out = mxnp.zeros(2)
+    try:
+        with srv.cond:
+            srv.store["k"] = onp.zeros(2, onp.float32)
+            srv.applied_round["k"] = 0
+        a.push("k", mxnp.ones(2))
+        b.push("k", mxnp.ones(2))  # b's seqs now well past 1
+        a.pull("k", out=out)
+
+        # rank 1 comes back as a NEW incarnation without having left
+        # (hard crash): register must bump the generation
+        b2 = _worker(1, "w1-new-pid")
+        try:
+            assert b2.server_status()["gen"] >= 1
+            a.resync()
+            a.push("k", mxnp.ones(2) * 2)
+            b2.push("k", mxnp.ones(2) * 3)  # fresh seq=... must APPLY
+            a.pull("k", out=out)
+            onp.testing.assert_array_equal(out.asnumpy(), onp.full(2, 5.0))
+            assert srv._dup_pushes == 0
+        finally:
+            b2.close()
+    finally:
+        a.close()
+        b.close()
+        _stop_server(srv, t)
+
+
+def test_fault_sites_membership_and_preempt_kind():
+    rules = faults.parse_spec(
+        "trainer.step:preempt@n=2;server.membership:error@n=1")
+    assert [r.site for r in rules] == ["trainer.step", "server.membership"]
+    with faults.inject("trainer.step", "preempt", n=1):
+        assert faults.check("trainer.step") == "preempt"  # soft kind
+    assert "trainer.step" in faults.stats()["tripped"]
+
+
+# ---------------------------------------------------------------------------
+# trainer: elastic step replay / preemption (deterministic, in-process)
+# ---------------------------------------------------------------------------
+def _mk_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    mx.random.seed(7)  # identical init on every worker
+    net.initialize(mx.init.Xavier())
+    # finalize deferred shapes NOW: Xavier draws from the process-global
+    # RNG, and leaving them to the first forward would let the worker
+    # threads race for the draws (nondeterministic init per rank)
+    net(mxnp.zeros((1, 6)))
+    return net
+
+
+def _batch(rank, step):
+    rng = onp.random.RandomState(1234 + rank * 1000 + step)
+    x = mxnp.array(rng.rand(8, 6).astype(onp.float32))
+    y = mxnp.array(rng.randint(0, 2, 8).astype(onp.float32))
+    return x, y
+
+
+_COMPUTE_LOCK = threading.Lock()  # serialize autograd tape building; the
+# blocking sync comm inside trainer.step runs concurrently across ranks
+
+
+def _train_steps(net, trainer, rank, steps, loss_fn=None):
+    loss_fn = loss_fn or gluon.loss.SoftmaxCrossEntropyLoss()
+    for s in steps:
+        x, y = _batch(rank, s)
+        with _COMPUTE_LOCK:
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+        trainer.step(8)
+
+
+def _params_of(net):
+    return {k: p.data().asnumpy()
+            for k, p in net.collect_params().items()}
+
+
+def _run_uninterrupted(monkeypatch, total):
+    """Clean 2-rank baseline on a fresh server: the bit-identical oracle."""
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    srv, t = _start_server(port, 2)
+    nets, errs, threads = {}, [], []
+    # nets built sequentially in the MAIN thread: mx.random.seed is
+    # process-global, so concurrent seed+init in worker threads would
+    # interleave draws and break cross-run determinism
+    built = {0: _mk_net(), 1: _mk_net()}
+    try:
+        def run(rank):
+            try:
+                kv = _worker(rank, "base-w%d" % rank)
+                net = built[rank]
+                trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                        {"learning_rate": 0.05},
+                                        kvstore=kv)
+                _train_steps(net, trainer, rank, range(total))
+                nets[rank] = _params_of(net)
+                kv.close()
+            except BaseException as e:  # surfaced by the main thread
+                errs.append((rank, e))
+        for r in (0, 1):
+            th = threading.Thread(target=run, args=(r,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(60)
+        assert not errs, errs
+        return nets
+    finally:
+        _stop_server(srv, t)
+
+
+def test_trainer_boundary_preempt_rejoin_bit_identical(monkeypatch,
+                                                       tmp_path):
+    """The acceptance boundary case: rank 1 is gracefully preempted at a
+    step boundary (checkpoint + leave + exit 0), relaunched, resumes via
+    resume_training, and rejoins before rank 0 begins the next step.  No
+    world-1 round ever runs, so the final weights must be BIT-IDENTICAL
+    to an uninterrupted 2-rank run — and the step count is conserved."""
+    TOTAL, PREEMPT_AT = 6, 4
+    baseline = _run_uninterrupted(monkeypatch, TOTAL)
+
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    srv, t = _start_server(port, 2)
+    profiler.reset_stats()
+    ckpt = str(tmp_path / "rank1")
+    left = threading.Event()
+    rejoined = threading.Event()
+    nets, errs = {}, []
+    built = {0: _mk_net(), 1: _mk_net()}  # main thread: seed/init races
+
+    def rank0():
+        try:
+            kv = _worker(0, "el-w0")
+            net = built[0]
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.05}, kvstore=kv)
+            _train_steps(net, trainer, 0, range(PREEMPT_AT))
+            assert rejoined.wait(60), "rank 1 never rejoined"
+            _train_steps(net, trainer, 0, range(PREEMPT_AT, TOTAL))
+            nets[0] = _params_of(net)
+            nets["r0_stats"] = trainer.comm_stats()
+            kv.close()
+        except BaseException as e:
+            errs.append(("rank0", e))
+            rejoined.set()  # never leave rank0's failure hanging
+
+    def rank1_first():
+        try:
+            kv = _worker(1, "el-w1")
+            net = built[1]
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.05}, kvstore=kv)
+            trainer.attach_preemption(ckpt, net.collect_params(),
+                                      install_signal=False)
+            _train_steps(net, trainer, 1, range(PREEMPT_AT))
+            trainer.request_preemption()  # the SIGTERM moment
+            with pytest.raises(SystemExit) as ei:
+                x, y = _batch(1, PREEMPT_AT)
+                trainer.step(8)  # boundary check runs before the step
+            assert ei.value.code == 0  # preemption is a GRACEFUL exit
+            kv.close()
+            left.set()
+        except BaseException as e:
+            errs.append(("rank1a", e))
+            left.set()
+
+    t0 = threading.Thread(target=rank0, daemon=True)
+    t1 = threading.Thread(target=rank1_first, daemon=True)
+    t0.start()
+    t1.start()
+    assert left.wait(60), "rank 1 never exited"
+    assert not errs, errs
+
+    def rank1_relaunch():
+        try:
+            from mxnet_tpu.parallel.checkpoint import resume_training
+            kv = _worker(1, "el-w1-relaunch")
+            assert kv.rejoined
+            net = _mk_net()
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.05}, kvstore=kv)
+            info = resume_training(ckpt, net.collect_params(),
+                                   trainer=trainer)
+            assert info["extra"]["preempted"]
+            # rejoin at the server's current (generation, step): the
+            # checkpointed step and the server's boundary agree here
+            start = max(info["step"], kv.current_round())
+            assert start == PREEMPT_AT
+            rejoined.set()
+            _train_steps(net, trainer, 1, range(start, TOTAL))
+            nets[1] = _params_of(net)
+            kv.close()
+        except BaseException as e:
+            errs.append(("rank1b", e))
+            rejoined.set()
+
+    t2 = threading.Thread(target=rank1_relaunch, daemon=True)
+    t2.start()
+    for th in (t0, t2):
+        th.join(90)
+    assert not errs, errs
+    try:
+        # step count conserved: every step applied exactly once globally
+        assert srv.applied_round and \
+            min(srv.applied_round.values()) == TOTAL
+        # boundary case is bit-identical to the uninterrupted run
+        for k in baseline[0]:
+            onp.testing.assert_array_equal(nets[0][k], baseline[0][k],
+                                           err_msg="rank0 %s" % k)
+            onp.testing.assert_array_equal(nets[1][k], baseline[1][k],
+                                           err_msg="rank1 %s" % k)
+        ev = profiler.aggregate_stats()["events"]
+        assert ev.get("membership.leave", 0) >= 1
+        assert ev.get("membership.rejoin", 0) >= 1
+        assert ev.get("preempt.graceful", 0) >= 1
+        assert nets["r0_stats"]["steps_abandoned"] == 0
+    finally:
+        _stop_server(srv, t)
+
+
+def test_trainer_survivor_rescales_after_evict(monkeypatch):
+    """No relaunch: rank 1 wedges mid-job; the server evicts it and rank 0
+    finishes alone with gradient averaging rescaled to the live world
+    (world_scale = initial/live = 2.0) — diverging-from-baseline but
+    finite, and every remaining step applies exactly once."""
+    TOTAL, WEDGE_AT = 5, 2
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    # eviction is DISABLED for the warmup (first-step XLA compiles make a
+    # merely-slow rank look stalled — the knob contract is evict_sec >>
+    # worst-case step time); it is flipped on once rank 1 truly wedges
+    srv, t = _start_server(port, 2, stall_sec=60, evict_sec=0)
+    profiler.reset_stats()
+    nets, errs = {}, []
+    kv_b_holder = {}
+    wedged = threading.Event()
+
+    def rank0():
+        try:
+            kv = _worker(0, "ev-w0")
+            net = _mk_net()
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.05}, kvstore=kv)
+            _train_steps(net, trainer, 0, range(WEDGE_AT))
+            assert wedged.wait(60)
+            _train_steps(net, trainer, 0, range(WEDGE_AT, TOTAL))
+            nets[0] = _params_of(net)
+            nets["stats"] = trainer.comm_stats()
+            kv.close()
+        except BaseException as e:
+            errs.append(("rank0", e))
+
+    def rank1():
+        try:
+            kv = _worker(1, "ev-w1")
+            kv_b_holder["kv"] = kv
+            net = _mk_net()
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.05}, kvstore=kv)
+            _train_steps(net, trainer, 1, range(WEDGE_AT))
+            # ... and then the process wedges: no leave, no more pushes
+        except BaseException as e:
+            errs.append(("rank1", e))
+
+    t0 = threading.Thread(target=rank0, daemon=True)
+    t1 = threading.Thread(target=rank1, daemon=True)
+    t0.start()
+    t1.start()
+    t1.join(120)  # both ranks completed the warmup (sync rounds couple)
+    srv.evict_sec = 0.5
+    wedged.set()
+    t0.join(120)
+    try:
+        assert not errs, errs
+        assert not t0.is_alive(), "survivor never finished"
+        s = nets["stats"]
+        assert s["live_world"] == 1 and s["world_scale"] == 2.0
+        assert s["steps"] == TOTAL and s["steps_abandoned"] == 0
+        ev = profiler.aggregate_stats()["events"]
+        assert ev.get("membership.evict", 0) >= 1
+        assert ev.get("elastic.membership_change", 0) >= 1
+        # conservation: the evicted rank contributed to WEDGE_AT rounds,
+        # the survivor completed all TOTAL — each applied exactly once
+        assert min(srv.applied_round.values()) == TOTAL
+        for v in nets[0].values():
+            assert onp.isfinite(v).all()
+    finally:
+        kv = kv_b_holder.get("kv")
+        if kv is not None:
+            kv.close()
+        _stop_server(srv, t)
+
+
+def test_injected_preempt_fault_checkpoints_leaves_exits_zero(
+        monkeypatch, tmp_path):
+    """MXNET_FAULT_SPEC-style 'trainer.step:preempt' runs the same
+    graceful path as SIGTERM: crash-safe checkpoint at the boundary,
+    membership leave, SystemExit(0); a relaunch resumes and finishes."""
+    from mxnet_tpu.parallel.checkpoint import (latest_step,
+                                               resume_training,
+                                               verify_checkpoint)
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 1)
+    srv, t = _start_server(port, 1)
+    profiler.reset_stats()
+    ckpt = str(tmp_path / "ck")
+    try:
+        from mxnet_tpu.kvstore.dist import KVStoreDist
+        kv = KVStoreDist("dist_sync", rank=0, num_workers=1, inc="p0")
+        net = _mk_net()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=kv)
+        trainer.attach_preemption(ckpt, net.collect_params(),
+                                  extra=lambda: {"tag": "drained"},
+                                  install_signal=False)
+        _train_steps(net, trainer, 0, range(3))
+        with faults.inject("trainer.step", "preempt", n=1, max_trips=1):
+            with pytest.raises(SystemExit) as ei:
+                _train_steps(net, trainer, 0, range(3, 4))
+        assert ei.value.code == 0
+        assert faults.stats()["tripped"]["trainer.step"] == 1
+        assert latest_step(ckpt) == 3
+        ok, problems = verify_checkpoint(ckpt, 3)
+        assert ok, problems
+        assert srv._members == {}  # the leave went through
+        ev = profiler.aggregate_stats()["events"]
+        assert ev.get("preempt.graceful", 0) == 1
+        assert ev.get("fault.trainer.step", 0) == 1
+        kv.close()
+
+        # relaunch: resume from the graceful checkpoint and finish
+        kv2 = KVStoreDist("dist_sync", rank=0, num_workers=1, inc="p0b")
+        net2 = _mk_net()
+        trainer2 = gluon.Trainer(net2.collect_params(), "sgd",
+                                 {"learning_rate": 0.05}, kvstore=kv2)
+        info = resume_training(ckpt, net2.collect_params(),
+                               trainer=trainer2)
+        assert info["step"] == 3 and info["extra"]["tag"] == "drained"
+        _train_steps(net2, trainer2, 0, range(info["step"], 5))
+        assert min(srv.applied_round.values()) >= 5
+        kv2.close()
+    finally:
+        _stop_server(srv, t)
+
+
+# ---------------------------------------------------------------------------
+# keep=N retention vs concurrent load/verify (satellite)
+# ---------------------------------------------------------------------------
+def test_keep_retention_concurrent_save_load_verify(tmp_path):
+    """Hammer save_checkpoint(keep=2) while other threads load + verify:
+    no load may ever observe a half-pruned step (a FileNotFoundError
+    between verification and the read) — the loader re-resolves instead."""
+    from mxnet_tpu.parallel.checkpoint import (list_steps, load_checkpoint,
+                                               save_checkpoint,
+                                               verify_checkpoint,
+                                               wait_for_saves)
+    path = str(tmp_path / "ck")
+    params = {"w": mxnp.array(onp.arange(64, dtype=onp.float32)),
+              "b": mxnp.array(onp.ones(8, onp.float32))}
+    STEPS = 25
+    stop = threading.Event()
+    errs = []
+    loads = {"n": 0}
+
+    def loader():
+        tgt = {"w": mxnp.zeros(64), "b": mxnp.zeros(8)}
+        while not stop.is_set():
+            try:
+                load_checkpoint(path, tgt, step=None)
+                loads["n"] += 1
+                # a loaded step is a COMPLETE step
+                assert tgt["w"].asnumpy().shape == (64,)
+            except FileNotFoundError as e:
+                # only acceptable before the first save landed
+                if list_steps(path):
+                    errs.append(e)
+                    return
+            except Exception as e:
+                errs.append(e)
+                return
+
+    def verifier():
+        while not stop.is_set():
+            for s in list_steps(path):
+                try:
+                    ok, problems = verify_checkpoint(path, s)
+                    # mid-prune a step may verify invalid — but it must
+                    # never crash, and an OK verdict must mean loadable
+                except Exception as e:
+                    errs.append(e)
+                    return
+
+    threads = [threading.Thread(target=loader, daemon=True),
+               threading.Thread(target=verifier, daemon=True)]
+    for th in threads:
+        th.start()
+    for step in range(STEPS):
+        save_checkpoint(path, params, step=step, keep=2)
+        wait_for_saves(path)
+    time.sleep(0.2)
+    stop.set()
+    for th in threads:
+        th.join(10)
+    assert not errs, errs
+    assert loads["n"] > 0
+    kept = list_steps(path)
+    assert kept == [STEPS - 2, STEPS - 1]
+    ok, problems = verify_checkpoint(path, STEPS - 1)
+    assert ok, problems

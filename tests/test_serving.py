@@ -5,9 +5,13 @@ result == per-request result), per-batch-bucket precompile (no serving
 recompiles), deadline expiry, load-shed rejection on a full queue,
 graceful drain, poisoned-request isolation, multi-model registry
 isolation, versioned hot swap, and the HTTP frontend + client round
-trip with the scrapeable stats snapshot.
+trip with the scrapeable stats snapshot.  Plus the robustness surface:
+/healthz + /readyz lifecycle and the client's bounded
+connect/reset retry (idempotency-aware).
 """
+import http.client
 import threading
+import time
 
 import numpy as onp
 import pytest
@@ -322,3 +326,117 @@ def test_http_server_end_to_end():
         with pytest.raises(serving.BadRequestError):
             cli.predict("dense", onp.zeros((2, 3), dtype="float32"))
         cli.close()
+
+
+def test_healthz_readyz_lifecycle():
+    """/healthz answers whenever the HTTP loop is up; /readyz flips with
+    model availability and batcher drain (the load-balancer contract)."""
+    reg = serving.ModelRegistry()
+    srv = serving.ModelServer(reg, flush_ms=5)
+    srv.start()
+    cli = serving.ServingClient(*srv.address, timeout=10)
+    try:
+        assert cli.server_alive()
+        assert not cli.server_ready()  # no model loaded yet → 503
+        net = _dense_net()
+        reg.load("m", net, item_shape=(IN_UNITS,), max_batch_size=8)
+        assert cli.server_ready()
+        status, doc = srv._handle_get("/readyz")
+        assert status == 200 and doc["models"] == 1
+        # draining: admissions stop → not ready, but still alive
+        srv.batcher.stop(drain=True, timeout=10)
+        assert cli.server_alive()
+        assert not cli.server_ready()
+        status, doc = srv._handle_get("/readyz")
+        assert status == 503 and doc["draining"]
+    finally:
+        cli.close()
+        srv.stop()
+    assert not cli.server_alive()  # listener gone → liveness False
+
+
+def test_client_retries_connect_refused_with_backoff():
+    """Connect refusals (server not up yet / briefly restarting) retry
+    with bounded backoff+jitter and succeed once the server appears —
+    the MXNET_KV_RETRIES pattern on the serving plane."""
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    net = _dense_net()
+    reg = serving.ModelRegistry()
+    reg.load("m", net, item_shape=(IN_UNITS,), max_batch_size=8)
+    srv = serving.ModelServer(reg, host="127.0.0.1", port=port, flush_ms=5)
+
+    def late_start():
+        time.sleep(0.4)
+        srv.start()
+
+    starter = threading.Thread(target=late_start, daemon=True)
+    cli = serving.ServingClient("127.0.0.1", port, timeout=10,
+                                retries=6, backoff_ms=100)
+    try:
+        assert not cli.server_alive()  # no retries on the liveness probe
+        starter.start()
+        models = cli.models()  # retried through the refusals
+        assert "m" in models
+    finally:
+        starter.join(5)
+        cli.close()
+        srv.stop()
+
+
+def test_client_retry_is_bounded_and_post_not_replayed_after_send():
+    """A dead endpoint exhausts the bounded retries with
+    ConnectionRefusedError; a connection the server kills AFTER reading a
+    POST must NOT be replayed (non-idempotent :predict could double-run)
+    while an idempotent GET on the same failure IS retried."""
+    import socket as _socket
+    cli = serving.ServingClient("127.0.0.1", 1, timeout=2,
+                                retries=2, backoff_ms=5)
+    with pytest.raises(OSError):
+        cli.models()
+    cli.close()
+
+    # a server that accepts, reads the request, then slams the connection
+    lsock = _socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    port = lsock.getsockname()[1]
+    hits = []
+    stop = threading.Event()
+
+    def slammer():
+        lsock.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except _socket.timeout:
+                continue
+            hits.append(1)
+            try:
+                conn.recv(65536)  # let the client finish sending
+            finally:
+                conn.close()  # reset before any response
+
+    t = threading.Thread(target=slammer, daemon=True)
+    t.start()
+    try:
+        cli = serving.ServingClient("127.0.0.1", port, timeout=5,
+                                    retries=2, backoff_ms=5)
+        n0 = len(hits)
+        with pytest.raises((OSError, http.client.HTTPException)):
+            cli.predict("m", onp.zeros((1, IN_UNITS), dtype="float32"))
+        post_attempts = len(hits) - n0
+        assert post_attempts == 1  # sent once, reply lost → NOT replayed
+        n0 = len(hits)
+        with pytest.raises((OSError, http.client.HTTPException)):
+            cli.models()  # GET: same failure IS retried to the bound
+        assert len(hits) - n0 == 3  # 1 + 2 retries
+        cli.close()
+    finally:
+        stop.set()
+        t.join(5)
+        lsock.close()

@@ -10,11 +10,21 @@ weights are bit-identical to the clean run's (bounded retry + reconnect
 + server-side (key, rank, seq) dedup must never drop or double-apply a
 gradient).
 
+Scenarios (--scenario):
+  faults   (default) transport-fault chaos: faulty vs clean dist_sync
+           run, PASS when bit-identical (the PR-3 acceptance).
+  preempt  elastic preemption: SIGTERM worker 1 mid-epoch (it must exit
+           0 after a graceful checkpoint + membership leave), relaunch
+           it, and PASS when the job completes without manual
+           intervention — step count conserved (every global step
+           applied exactly once), replicas identical.
+
 Usage:
   python tools/chaos.py                       # default spec, 2 workers
   python tools/chaos.py -n 4 -s 2 \\
       --spec 'kvstore.send:reset@p=0.1;kvstore.recv:reset@p=0.05'
   python tools/chaos.py --no-compare-clean    # skip the baseline run
+  python tools/chaos.py --scenario preempt    # SIGTERM + rejoin drill
 
 Exit code 0 = all invariants held.
 """
@@ -23,9 +33,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAUNCH = os.path.join(REPO, "tools", "launch.py")
@@ -69,18 +81,171 @@ def _params_equal(a, b, label):
     return ok
 
 
+def _spawn_cluster(out_dir, n, s, env, worker_mode="elastic"):
+    """launch.py's local env contract, but with direct Popen handles so
+    the scenario can SIGTERM / relaunch individual workers."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from launch import _reserve_ports, _wait_servers_ready
+    port = _reserve_ports(s)
+    env = dict(env)
+    env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_NUM_SERVER": str(s),
+        "MXNET_KVSTORE_SYNC": "1",
+    })
+    servers = []
+    for sid in range(s):
+        senv = dict(env)
+        senv.update({"DMLC_ROLE": "server", "DMLC_SERVER_ID": str(sid),
+                     "DMLC_SERVER_PORT": str(port + sid)})
+        servers.append(subprocess.Popen(
+            [sys.executable, "-c",
+             "import mxnet_tpu as mx;"
+             "mx.kvstore._init_kvstore_server_module()"], env=senv))
+    if not _wait_servers_ready(servers, port, s):
+        raise SystemExit("chaos: servers failed to start")
+
+    def spawn_worker(wid):
+        wenv = dict(env)
+        wenv.update({"DMLC_ROLE": "worker", "DMLC_WORKER_ID": str(wid)})
+        return subprocess.Popen(
+            [sys.executable, WORKER, out_dir, worker_mode],
+            cwd=REPO, env=wenv)
+
+    return servers, spawn_worker
+
+
+def scenario_preempt(args):
+    """SIGTERM worker 1 mid-epoch; it must exit 0 (graceful checkpoint +
+    membership leave); relaunch it; the job must complete without manual
+    intervention with the step count conserved and replicas identical."""
+    n, s = args.num_workers, args.num_servers
+    total = 12
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_FAULT_SPEC", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("MXNET_KV_BACKOFF_MS", "5")
+    env["ELASTIC_TOTAL_STEPS"] = str(total)
+    # pace the steps so the SIGTERM reliably lands mid-epoch (after the
+    # first steps, well before the last)
+    env["ELASTIC_STEP_DELAY"] = "0.4"
+    env.setdefault("MXNET_PREEMPT_GRACE_SEC", "30")
+
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="chaos-preempt-") as out_dir:
+        servers, spawn_worker = _spawn_cluster(out_dir, n, s, env)
+        workers = {wid: spawn_worker(wid) for wid in range(n)}
+        try:
+            # preempt only after real progress (the workers' per-step
+            # heartbeat), never during startup compiles — and well before
+            # the end of the epoch
+            hb = os.path.join(out_dir, "progress_rank1")
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                try:
+                    with open(hb) as f:
+                        if int(f.read() or 0) >= 3:
+                            break
+                except (OSError, ValueError):
+                    pass
+                if workers[1].poll() is not None:
+                    break
+                time.sleep(0.1)
+            victim = workers[1]
+            if victim.poll() is not None:
+                print("FAIL: worker 1 finished before the preemption — "
+                      "scenario did not test anything")
+                return 1
+            print("chaos: SIGTERM worker 1 (pid %d) mid-epoch"
+                  % victim.pid)
+            victim.send_signal(signal.SIGTERM)
+            rc = victim.wait(timeout=120)
+            if rc != 0:
+                print("FAIL: preempted worker exited %d (graceful "
+                      "preemption must exit 0)" % rc)
+                ok = False
+            ckpt = os.path.join(out_dir, "ckpt_rank1")
+            if not os.path.isdir(ckpt) or not os.listdir(ckpt):
+                print("FAIL: no graceful checkpoint written at %s" % ckpt)
+                ok = False
+            print("chaos: relaunching worker 1")
+            workers[1] = spawn_worker(1)
+            for wid, w in workers.items():
+                rc = w.wait(timeout=300)
+                if rc != 0:
+                    print("FAIL: worker %d exited %d" % (wid, rc))
+                    ok = False
+            if not ok:
+                return 1
+            results = []
+            for wid in range(n):
+                with open(os.path.join(out_dir,
+                                       "worker%d.json" % wid)) as f:
+                    results.append(json.load(f))
+        finally:
+            for w in workers.values():
+                if w.poll() is None:
+                    w.kill()
+            for p in servers:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in servers:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+        # relaunched worker actually resumed (not restarted from 0)
+        if results[1]["start_step"] <= 0:
+            print("FAIL: relaunched worker started from step %d — it "
+                  "never resumed" % results[1]["start_step"])
+            ok = False
+        # step count conserved: every global step applied exactly once
+        if results[0]["status"]["round"] != total:
+            print("FAIL: server completed %s rounds, expected %d"
+                  % (results[0]["status"]["round"], total))
+            ok = False
+        if not _params_equal(results[0]["params"], results[1]["params"],
+                             "rank0 vs relaunched rank1"):
+            ok = False
+        ev = {}
+        for r in results:
+            for k, v in (r.get("events") or {}).items():
+                ev[k] = ev.get(k, 0) + v
+        print("chaos: membership events across workers: %s" % (ev or {}))
+        if not results[1].get("rejoined"):
+            print("FAIL: the relaunched worker never re-entered the "
+                  "membership as a rejoin")
+            ok = False
+        if not ev.get("elastic.membership_change"):
+            print("FAIL: no worker ever observed a membership change")
+            ok = False
+    print("chaos: %s" % ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--scenario", default="faults",
+                    choices=["faults", "preempt"],
+                    help="faults = transport chaos (bit-identical check);"
+                         " preempt = SIGTERM + relaunch + rejoin drill")
     ap.add_argument("--spec", default=DEFAULT_SPEC,
                     help="MXNET_FAULT_SPEC for the chaos run "
                          "(default: %(default)s)")
     ap.add_argument("--no-compare-clean", action="store_true",
                     help="skip the fault-free baseline run")
     args = ap.parse_args()
+    if args.scenario == "preempt":
+        return scenario_preempt(args)
 
     ok = True
     with tempfile.TemporaryDirectory(prefix="chaos-") as tmp:
