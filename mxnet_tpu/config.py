@@ -175,6 +175,52 @@ register("MXNET_PREEMPT_GRACE_SEC", float, 15.0, "honored",
          "run this long before it is abandoned; then a crash-safe "
          "checkpoint is written, the worker leaves the membership, and "
          "the process exits 0", "gluon.Trainer.attach_preemption")
+register("MXNET_KV_EVICT_EMA_K", float, 3.0, "honored",
+         "adaptive eviction threshold: once sync rounds are completing, "
+         "the effective evict deadline is max(MXNET_KV_EVICT_SEC, k x EMA "
+         "of observed round time), so an eviction window comparable to "
+         "the step time (compile-slow ranks) cannot ping-pong a merely "
+         "slow worker out of the membership (0 = fixed MXNET_KV_EVICT_SEC)",
+         "kvstore.dist.KVStoreDistServer")
+register("MXNET_FLEET_REPLICAS", int, 2, "honored",
+         "serving fleet: default replica count launched by "
+         "ServingFleet/ReplicaSupervisor", "serving.fleet.ServingFleet")
+register("MXNET_FLEET_STRIKES", int, 3, "honored",
+         "serving fleet router: consecutive passive failures "
+         "(connect/timeout/5xx) on a replica before it is ejected from "
+         "dispatch (re-admitted on probe success with backoff)",
+         "serving.router.Router")
+register("MXNET_FLEET_PROBE_MS", float, 200.0, "honored",
+         "serving fleet router: /healthz + /readyz poll interval; ejected "
+         "replicas are re-probed on an exponential backoff starting here",
+         "serving.router.Router")
+register("MXNET_FLEET_EJECT_BACKOFF_MS", float, 500.0, "honored",
+         "serving fleet router: initial re-probe backoff after an "
+         "ejection, doubled per failed probe (capped at 30x)",
+         "serving.router.Router")
+register("MXNET_FLEET_RESTART_BUDGET", int, 5, "honored",
+         "serving fleet supervisor: max auto-restarts per replica within "
+         "MXNET_FLEET_RESTART_WINDOW_SEC before the replica is declared "
+         "failed (crash-loop brake)",
+         "serving.supervisor.ReplicaSupervisor")
+register("MXNET_FLEET_RESTART_WINDOW_SEC", float, 60.0, "honored",
+         "serving fleet supervisor: sliding window the restart budget is "
+         "counted over", "serving.supervisor.ReplicaSupervisor")
+register("MXNET_FLEET_RESTART_BACKOFF_MS", float, 200.0, "honored",
+         "serving fleet supervisor: crash-loop restart backoff base, "
+         "doubled per consecutive crash (reset after a healthy run)",
+         "serving.supervisor.ReplicaSupervisor")
+register("MXNET_COMPILE_CACHE_DIR", str, "", "honored",
+         "persistent XLA compile cache directory (jax compilation "
+         "cache): registry per-bucket precompile writes it, so a "
+         "restarted/rolled-out replica re-serves in seconds instead of "
+         "paying cold compiles; shared across replicas on one host",
+         "serving.registry.maybe_enable_compile_cache")
+register("MXNET_SERVING_REPLICA_ID", str, "", "honored",
+         "replica label stamped on ServingMetrics snapshots and the "
+         "Prometheus export (the fleet supervisor sets it per replica "
+         "process; the router aggregates by it)",
+         "serving.metrics.ServingMetrics")
 register("MXNET_SERVING_RETRIES", int, 2, "honored",
          "serving client: bounded retries on connect/connection-reset "
          "errors for requests the server has not processed yet "
@@ -186,7 +232,8 @@ register("MXNET_SERVING_BACKOFF_MS", float, 50.0, "honored",
 register("MXNET_FAULT_SPEC", str, "", "honored",
          "deterministic fault injection spec: site:kind[@p=F|n=I] joined "
          "by ';' (sites: kvstore.send, kvstore.recv, server.apply, "
-         "server.membership, trainer.step, checkpoint.write)", "faults")
+         "server.membership, trainer.step, checkpoint.write, "
+         "router.dispatch, replica.crash)", "faults")
 register("MXNET_FAULT_SEED", int, 0, "honored",
          "seed for probability-based fault-injection rules (deterministic "
          "trip sequences per (seed, site, kind))", "faults.FaultRule")

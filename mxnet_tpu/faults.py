@@ -21,12 +21,18 @@ Sites (grep for ``faults.check``):
                      SIGTERM: graceful checkpoint + leave + exit 0)
   checkpoint.write   checkpoint writer ("torn" truncates the npz payload,
                      simulating a crash mid-write on a non-atomic path)
+  router.dispatch    serving-fleet router, before a request is forwarded
+                     to a replica (exception kinds read as a replica
+                     transport failure: strike, failover retry)
+  replica.crash      serving replica watchdog loop ("kill" hard-exits the
+                     replica process — the supervisor-restart drill)
 
 Kinds: ``reset`` (ConnectionResetError), ``timeout`` (socket.timeout),
 ``error``/``crash`` (RuntimeError), plus site-interpreted kinds that
 ``check`` *returns* instead of raising: ``drop`` (server kills the
-connection without replying), ``torn`` (writer tears the file), and
-``preempt`` (trainer runs its graceful-preemption path).
+connection without replying), ``torn`` (writer tears the file),
+``preempt`` (trainer runs its graceful-preemption path), and ``kill``
+(a serving replica hard-exits, SIGKILL-style — no drain, no cleanup).
 
 Configuration — either the env spec (parsed once, on first check):
 
@@ -70,10 +76,11 @@ _EXC_KINDS = {
     "crash": RuntimeError,
 }
 # site-interpreted kinds check() hands back to the caller
-_SOFT_KINDS = ("drop", "torn", "preempt")
+_SOFT_KINDS = ("drop", "torn", "preempt", "kill")
 
 KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
-               "server.membership", "trainer.step", "checkpoint.write")
+               "server.membership", "trainer.step", "checkpoint.write",
+               "router.dispatch", "replica.crash")
 
 
 class FaultRule:

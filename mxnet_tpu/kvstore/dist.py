@@ -48,7 +48,11 @@ first-class, generation-versioned part of the protocol.  Workers
 ``register`` on construction and ``leave`` on graceful preemption; the
 server tracks a membership generation, evicts a rank whose stall exceeds
 ``MXNET_KV_EVICT_SEC`` (escalation beyond the diagnose-only
-``MXNET_KV_STALL_SEC`` watchdog), and answers any request carrying a
+``MXNET_KV_STALL_SEC`` watchdog; once rounds are completing the
+effective threshold adapts to max(evict_sec, MXNET_KV_EVICT_EMA_K x
+EMA of the observed round time), so an eviction window comparable to
+the step time cannot ping-pong a compile-slow rank), and answers any
+request carrying a
 stale generation with a typed ``membership_changed`` reply — surfaced
 worker-side as :class:`~mxnet_tpu.kvstore.MembershipChanged` — instead
 of silently applying or deadlocking.  On any membership event
@@ -217,6 +221,16 @@ class KVStoreDistServer:
                                else _config.get("MXNET_KV_STALL_SEC"))
         self.evict_sec = float(evict_sec if evict_sec is not None
                                else _config.get("MXNET_KV_EVICT_SEC"))
+        # adaptive eviction (the PR-5 ping-pong fix): a fixed evict_sec
+        # comparable to the step time reads a compile-slow rank as dead,
+        # evicts it, watches it rejoin, and thrashes membership forever.
+        # Once sync rounds are completing, the effective threshold is
+        # max(evict_sec, k x EMA of the observed round time) — scaled to
+        # how slow this job actually is, not to a guess made at launch.
+        self.evict_ema_k = float(_config.get("MXNET_KV_EVICT_EMA_K"))
+        self._round_ema = None      # EMA of seconds per completed round
+        self._ema_base = 0          # last step boundary the EMA saw
+        self._ema_base_ts = None    # when that boundary completed
         self.store = {}          # key -> onp.ndarray
         self.updater = None
         self.buf = {}            # key -> {rank: [grads]}
@@ -332,6 +346,11 @@ class KVStoreDistServer:
                 reply["ok"] = True
                 del reply["membership_changed"]
                 reply["dup_pushes"] = self._dup_pushes
+                reply["round_ema_ms"] = (self._round_ema * 1e3
+                                         if self._round_ema is not None
+                                         else None)
+                reply["effective_evict_sec"] = \
+                    self._effective_evict_locked()
                 return reply
         if op == "init":
             with self.cond:
@@ -511,13 +530,17 @@ class KVStoreDistServer:
                     return {"ok": True}
             deadline = (time.monotonic() + self.stall_sec
                         if self.stall_sec > 0 else None)
-            evict_at = (time.monotonic() + self.evict_sec
-                        if self.evict_sec > 0 and self._members else None)
+            wait_start = (time.monotonic()
+                          if self.evict_sec > 0 and self._members else None)
             while grp["gen"] == gen and not self._stop:
                 if mgen is not None and mgen != self._generation:
                     return self._membership_reply_locked()
                 self.cond.wait(0.2)
-                if evict_at is not None and time.monotonic() > evict_at \
+                # adaptive escalation: the threshold is re-derived every
+                # lap — completed rounds raise it to k x EMA(round time)
+                ev = self._effective_evict_locked()
+                if wait_start is not None and ev > 0 \
+                        and time.monotonic() > wait_start + ev \
                         and grp["gen"] == gen:
                     missing = [r for r in self._live_ranks_locked()
                                if r not in grp["ranks"]]
@@ -551,6 +574,30 @@ class KVStoreDistServer:
         else:
             self.store[key] = agg
         self.applied_round[key] = self.applied_round.get(key, 0) + 1
+        self._observe_round_locked()
+
+    def _observe_round_locked(self):
+        """Track the EMA of observed round time (wall time between step
+        boundaries — every key applied once) for adaptive eviction."""
+        base = self._base_round_locked()
+        if base <= self._ema_base:
+            return
+        now = time.monotonic()
+        if self._ema_base_ts is not None:
+            dur = (now - self._ema_base_ts) / (base - self._ema_base)
+            self._round_ema = (dur if self._round_ema is None
+                               else 0.7 * self._round_ema + 0.3 * dur)
+        self._ema_base = base
+        self._ema_base_ts = now
+
+    def _effective_evict_locked(self):
+        """The live eviction threshold: the configured floor, raised to
+        k x EMA(round time) once rounds are observed (0 = eviction off)."""
+        if self.evict_sec <= 0:
+            return 0.0
+        if self._round_ema is not None and self.evict_ema_k > 0:
+            return max(self.evict_sec, self.evict_ema_k * self._round_ema)
+        return self.evict_sec
 
     def _handle_push(self, msg):
         key, rank = msg["key"], msg["rank"]
@@ -626,15 +673,19 @@ class KVStoreDistServer:
         with self.cond:
             deadline = (time.monotonic() + self.stall_sec
                         if self.stall_sec > 0 else None)
-            evict_at = (time.monotonic() + self.evict_sec
-                        if self.evict_sec > 0 and self._members else None)
+            wait_start = (time.monotonic()
+                          if self.evict_sec > 0 and self._members else None)
             while (self.sync
                    and self.applied_round.get(key, 0) < want_round
                    and not self._stop):
                 if mgen is not None and mgen != self._generation:
                     return self._membership_reply_locked()
                 self.cond.wait(0.2)
-                if evict_at is not None and time.monotonic() > evict_at \
+                # adaptive escalation (see _handle_barrier): compile-slow
+                # ranks are spared once the EMA knows the real step time
+                ev = self._effective_evict_locked()
+                if wait_start is not None and ev > 0 \
+                        and time.monotonic() > wait_start + ev \
                         and self.applied_round.get(key, 0) < want_round:
                     # escalation beyond the diagnose-only stall watchdog:
                     # evict the ranks that never pushed this round so the

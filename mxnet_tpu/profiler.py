@@ -29,6 +29,7 @@ _AGG = {
     "memory": {},   # counter name -> [samples, last, peak]
     "events": {},   # name -> count (always on: fault trips, kv retries)
     "comm": {},     # name -> [buckets, bytes, total_queue_s, max_queue_s]
+    "fleet": {},    # name -> [count, total_s, max_s] (router dispatches)
     "lock": threading.Lock(),
 }
 
@@ -84,6 +85,24 @@ def record_comm_stat(name, nbytes=0, queue_s=0.0, n=1):
                 st[3] = queue_s
 
 
+def record_fleet_stat(name, dur_s=0.0, n=1):
+    """Accumulate one serving-fleet router event (a dispatch, a failover
+    retry, a shed) with its router-side latency.  Always on, like comm
+    stats — the per-replica dispatch/retry/eject counters are the
+    observables the failover design is validated against (tools/chaos.py
+    --scenario fleet asserts on them).  Read back via
+    aggregate_stats()['fleet']."""
+    with _AGG["lock"]:
+        st = _AGG["fleet"].get(name)
+        if st is None:
+            _AGG["fleet"][name] = [n, dur_s, dur_s]
+        else:
+            st[0] += n
+            st[1] += dur_s
+            if dur_s > st[2]:
+                st[2] = dur_s
+
+
 def record_memory_stat(name, value):
     with _AGG["lock"]:
         st = _AGG["memory"].get(name)
@@ -110,7 +129,11 @@ def aggregate_stats():
                     "queue_total_ms": tq * 1e3, "queue_max_ms": mq * 1e3,
                     "queue_avg_ms": tq / c * 1e3 if c else 0.0}
                 for n, (c, b, tq, mq) in _AGG["comm"].items()}
-    return {"ops": ops, "memory": mem, "events": events, "comm": comm}
+        fleet = {n: {"count": c, "total_ms": t * 1e3, "max_ms": mx * 1e3,
+                     "avg_ms": t / c * 1e3 if c else 0.0}
+                 for n, (c, t, mx) in _AGG["fleet"].items()}
+    return {"ops": ops, "memory": mem, "events": events, "comm": comm,
+            "fleet": fleet}
 
 
 def reset_stats():
@@ -119,6 +142,7 @@ def reset_stats():
         _AGG["memory"].clear()
         _AGG["events"].clear()
         _AGG["comm"].clear()
+        _AGG["fleet"].clear()
 
 
 def get_summary(sort_by="total", ascending=False):
@@ -159,6 +183,14 @@ def get_summary(sort_by="total", ascending=False):
             lines.append("  %-28s %10d %14d %12.4f %12.4f" % (
                 name[:28], st["count"], st["bytes"], st["queue_avg_ms"],
                 st["queue_max_ms"]))
+    if snap["fleet"]:
+        lines.append("  Serving fleet (router)")
+        lines.append("  %-28s %10s %12s %12s %12s" % (
+            "Name", "Count", "Total(ms)", "Avg(ms)", "Max(ms)"))
+        for name, st in sorted(snap["fleet"].items()):
+            lines.append("  %-28s %10d %12.4f %12.4f %12.4f" % (
+                name[:28], st["count"], st["total_ms"], st["avg_ms"],
+                st["max_ms"]))
     return "\n".join(lines)
 
 

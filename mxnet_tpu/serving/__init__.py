@@ -17,6 +17,21 @@ Layers (each usable on its own):
 - ``ModelServer`` / ``ServingClient`` (``server.py`` / ``client.py``) —
   thin HTTP frontend + stdlib client.
 
+Fleet tier (replicated, self-healing serving — see README "Serving
+fleet"):
+
+- ``Router`` / ``RouterServer`` (``router.py``) — least-loaded or
+  consistent-hash dispatch over N replicas, /healthz-/readyz-driven
+  health, strike/eject/re-admit failure detection, failover retries,
+  backpressure propagation (router-level shed with Retry-After).
+- ``ReplicaSupervisor`` (``supervisor.py``) — launch/monitor/restart
+  replica processes with restart budgets and crash-loop backoff.
+- ``ServingFleet`` / ``rollout`` (``fleet.py``) — the two composed,
+  plus zero-downtime rolling model rollout with canary abort/rollback.
+- ``maybe_enable_compile_cache`` (``registry.py``) — persistent XLA
+  compile cache (``MXNET_COMPILE_CACHE_DIR``) so replica restarts and
+  rollouts re-serve in seconds instead of compile-minutes.
+
 Quick start::
 
     import mxnet_tpu as mx
@@ -30,18 +45,28 @@ Quick start::
 from __future__ import annotations
 
 from .errors import (BadRequestError, DeadlineExceededError,
-                     ModelNotFoundError, QueueFullError, ServerClosedError,
-                     ServingError)
+                     FleetUnavailableError, ModelNotFoundError,
+                     QueueFullError, RolloutAbortedError,
+                     ServerClosedError, ServingError)
 from .metrics import LatencyHistogram, ModelMetrics, ServingMetrics
-from .registry import ModelRegistry, ServedModel, default_buckets
+from .registry import (ModelRegistry, ServedModel, default_buckets,
+                       load_model_spec, maybe_enable_compile_cache,
+                       resolve_builder)
 from .batcher import DynamicBatcher
 from .server import ModelServer
 from .client import ServingClient
+from .router import FleetMetrics, Replica, Router, RouterServer
+from .supervisor import ReplicaProcess, ReplicaSupervisor
+from .fleet import ServingFleet, rollout
 
 __all__ = [
     "ServingError", "BadRequestError", "ModelNotFoundError",
     "QueueFullError", "ServerClosedError", "DeadlineExceededError",
+    "FleetUnavailableError", "RolloutAbortedError",
     "ServingMetrics", "ModelMetrics", "LatencyHistogram",
     "ModelRegistry", "ServedModel", "default_buckets",
+    "load_model_spec", "maybe_enable_compile_cache", "resolve_builder",
     "DynamicBatcher", "ModelServer", "ServingClient",
+    "FleetMetrics", "Replica", "Router", "RouterServer",
+    "ReplicaProcess", "ReplicaSupervisor", "ServingFleet", "rollout",
 ]

@@ -87,8 +87,15 @@ class ServingClient:
         except ValueError:
             doc = {"error": data.decode(errors="replace"), "code": "internal"}
         if resp.status >= 400:
-            raise error_for_code(doc.get("code", "internal"),
+            exc = error_for_code(doc.get("code", "internal"),
                                  doc.get("error", "HTTP %d" % resp.status))
+            retry_after = resp.getheader("Retry-After")
+            if retry_after is not None:
+                try:  # a router-level shed says when to come back
+                    exc.retry_after = float(retry_after)
+                except ValueError:
+                    pass
+            raise exc
         return doc
 
     def close(self):
@@ -106,11 +113,17 @@ class ServingClient:
         return False
 
     # -- API --------------------------------------------------------------
-    def predict(self, model, data, version=None, deadline_ms=None):
+    def predict(self, model, data, version=None, deadline_ms=None,
+                affinity_key=None, idempotent=None):
         """Run inference on a BATCH: ``data`` is a list of instances or
         an array whose leading axis is the batch (each instance must have
         the model's item shape — wrap a single item in a length-1 list).
-        Returns a numpy array with the batch axis first."""
+        Returns a numpy array with the batch axis first.
+
+        Fleet-router hints (ignored by a single ModelServer):
+        ``affinity_key`` steers consistent-hash dispatch (cache
+        affinity); ``idempotent=False`` forbids the router from failing
+        the request over to another replica after it may have executed."""
         if isinstance(data, (list, tuple)):
             instances = [onp.asarray(d).tolist() for d in data]
         else:
@@ -123,6 +136,10 @@ class ServingClient:
         body = {"instances": instances}
         if deadline_ms is not None:
             body["deadline_ms"] = float(deadline_ms)
+        if affinity_key is not None:
+            body["affinity_key"] = str(affinity_key)
+        if idempotent is not None:
+            body["idempotent"] = bool(idempotent)
         doc = self._request("POST", path, body)
         return onp.asarray(doc["predictions"])
 

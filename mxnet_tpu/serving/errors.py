@@ -50,11 +50,28 @@ class DeadlineExceededError(ServingError):
     code = "deadline_exceeded"
 
 
+class FleetUnavailableError(ServingError):
+    """The fleet router has no routable replica for this request (all
+    ejected/unready/failed).  503 with Retry-After: the condition is
+    expected to clear once the supervisor restarts replicas and probes
+    re-admit them."""
+    http_status = 503
+    code = "fleet_unavailable"
+
+
+class RolloutAbortedError(ServingError):
+    """A rolling model rollout was aborted (canary error rate or tail
+    latency regressed past the configured threshold) and rolled back."""
+    http_status = 500
+    code = "rollout_aborted"
+
+
 #: code string -> exception class (client-side rehydration)
 CODE_TO_ERROR = {
     cls.code: cls
     for cls in (ServingError, BadRequestError, ModelNotFoundError,
-                QueueFullError, ServerClosedError, DeadlineExceededError)
+                QueueFullError, ServerClosedError, DeadlineExceededError,
+                FleetUnavailableError, RolloutAbortedError)
 }
 
 

@@ -1,0 +1,261 @@
+"""Serving fleet: supervisor + router + zero-downtime rolling rollout.
+
+:class:`ServingFleet` is the one-object production story: N supervised
+replica processes (``supervisor.py``) behind a health-routing frontend
+(``router.py``), with model-version rollout that never drops a request.
+
+Rollout protocol (``fleet.rollout`` / module-level :func:`rollout`):
+
+1. Pin ONE fleet-wide version number (current latest + 1) so every
+   replica publishes the same version — admin loads are per-replica,
+   and letting each pick its own "latest + 1" could diverge.
+2. **Canary baseline**: probe the first replica's CURRENT latest with a
+   handful of requests; their p99 is the regression yardstick (measured
+   the same way, on the same replica, as the post-flip probes —
+   apples to apples).
+3. One replica at a time: **drain** it at the router (no new traffic;
+   in-flight requests finish; the warmup compiles compete with
+   nothing), admin-**load** the new version — the registry warms every
+   batch bucket BEFORE flipping the latest pointer, reading the
+   persistent compile cache when ``MXNET_COMPILE_CACHE_DIR`` is set —
+   then **undrain**.  Traffic on the replica never sees a gap: old
+   version until the flip, new version after, both fully compiled.
+4. The first replica is the **canary**: after its flip it is probed on
+   the new version; if the probe error rate exceeds
+   ``canary_error_rate`` or probe p99 exceeds ``canary_p99_factor`` x
+   the baseline p99, the rollout **aborts and rolls back** — the new
+   version is unloaded everywhere it landed (the registry's latest
+   falls back to the old version) and :class:`RolloutAbortedError`
+   is raised.  Replicas 2..N only ever see a version the canary
+   survived.
+
+A fleet-wide rollout is therefore: at most one replica warming at any
+moment, N-1 (or N, via the last-resort drain route) replicas serving
+the whole time, and an abort path that converges back to the old
+version without restarting anything.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import time
+
+import numpy as onp
+
+from .. import config as _config
+from .. import profiler
+from .errors import RolloutAbortedError, ServingError
+from .metrics import LatencyHistogram
+from .router import Router, RouterServer
+from .supervisor import ReplicaSupervisor
+
+__all__ = ["ServingFleet", "rollout"]
+
+
+def _replica_request(host, port, method, path, body=None, timeout=30.0):
+    """One fresh-connection round trip to a replica (admin + probes —
+    kept off the router's pooled dispatch connections)."""
+    payload = json.dumps(body).encode() if body is not None else None
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, path, body=payload,
+                     headers=({"Content-Type": "application/json"}
+                              if payload else {}))
+        resp = conn.getresponse()
+        data = resp.read()
+    finally:
+        conn.close()
+    try:
+        doc = json.loads(data.decode() or "{}")
+    except ValueError:
+        doc = {"error": data.decode(errors="replace"), "code": "internal"}
+    return resp.status, doc
+
+
+def _probe(host, port, name, version, item, n, deadline_ms=2000.0,
+           timeout=30.0):
+    """n single-item :predict probes pinned to one version on one
+    replica; returns (errors, p99_ms)."""
+    path = ("/v1/models/%s:predict" % name if version is None
+            else "/v1/models/%s/versions/%d:predict" % (name, version))
+    hist = LatencyHistogram()
+    errors = 0
+    for _ in range(n):
+        t0 = time.monotonic()
+        try:
+            status, doc = _replica_request(
+                host, port, "POST", path,
+                {"instances": [item], "deadline_ms": deadline_ms},
+                timeout=timeout)
+            if status != 200:
+                errors += 1
+        except OSError:
+            errors += 1
+        hist.observe(time.monotonic() - t0)
+    snap = hist.snapshot()
+    return errors, snap.get("p99_ms")
+
+
+def rollout(router, model_spec, *, canary_probes=8,
+            canary_error_rate=0.25, canary_p99_factor=5.0,
+            admin_timeout_s=600.0, order=None):
+    """Roll ``model_spec`` (see ``registry.load_model_spec``) across
+    every replica of ``router``, canary-first.  Returns a report dict;
+    raises :class:`RolloutAbortedError` (after rolling back) when the
+    canary regresses.  Works against any admin-enabled replicas — the
+    in-process test fleet and the supervised process fleet alike."""
+    spec = dict(model_spec)
+    name = spec.get("name")
+    if not name or not spec.get("builder"):
+        raise ServingError("rollout spec needs 'name' and 'builder'")
+    rids = list(order) if order else router.replica_ids()
+    if not rids:
+        raise ServingError("rollout: router has no replicas")
+    replicas = {rid: router._replicas[rid] for rid in rids}
+
+    # one fleet-wide version: current latest (across replicas) + 1
+    latest = 0
+    for r in replicas.values():
+        try:
+            status, doc = _replica_request(r.host, r.port, "GET",
+                                           "/v1/models/%s" % name)
+            if status == 200:
+                latest = max(latest, int(doc.get("latest", 0)))
+        except OSError:
+            continue  # ejected/dead replica: the probe loop owns it
+    version = int(spec.get("version") or latest + 1)
+    spec["version"] = version
+
+    probe_item = None
+    if spec.get("item_shape") is not None:
+        probe_item = onp.zeros(tuple(spec["item_shape"]),
+                               dtype=spec.get("dtype",
+                                              "float32")).tolist()
+
+    report = {"model": name, "version": version, "replicas": [],
+              "canary": None, "aborted": False}
+    profiler.record_event_stat("fleet.rollout_start")
+    applied = []
+
+    def _rollback(why):
+        for rid in applied:
+            r = replicas[rid]
+            try:
+                _replica_request(r.host, r.port, "POST",
+                                 "/v1/admin/unload",
+                                 {"name": name, "version": version},
+                                 timeout=admin_timeout_s)
+            except OSError:
+                pass  # dead replica reboots into the OLD spec anyway
+            router.set_drain(rid, False)
+        profiler.record_event_stat("fleet.rollout_abort")
+        report["aborted"] = True
+        report["abort_reason"] = why
+        raise RolloutAbortedError(
+            "rollout of %s v%d aborted and rolled back: %s"
+            % (name, version, why))
+
+    baseline_p99 = None
+    for i, rid in enumerate(rids):
+        r = replicas[rid]
+        if i == 0 and probe_item is not None and latest > 0:
+            # canary baseline on the OLD version, same replica, same
+            # measurement as the post-flip probes
+            _, baseline_p99 = _probe(r.host, r.port, name, None,
+                                     probe_item, canary_probes)
+        router.set_drain(rid, True)
+        try:
+            status, doc = _replica_request(
+                r.host, r.port, "POST", "/v1/admin/load", spec,
+                timeout=admin_timeout_s)
+        except OSError as e:
+            _rollback("replica %s unreachable during load: %r" % (rid, e))
+        if status != 200:
+            _rollback("replica %s load failed: %s"
+                      % (rid, doc.get("error", "HTTP %d" % status)))
+        applied.append(rid)
+        router.set_drain(rid, False)
+        report["replicas"].append({"rid": rid,
+                                   "warmed": doc["model"]["warmed"]})
+        if i == 0 and probe_item is not None:
+            errors, p99 = _probe(r.host, r.port, name, version,
+                                 probe_item, canary_probes)
+            rate = errors / float(canary_probes)
+            report["canary"] = {"rid": rid, "probes": canary_probes,
+                                "errors": errors, "error_rate": rate,
+                                "p99_ms": p99,
+                                "baseline_p99_ms": baseline_p99}
+            if rate > canary_error_rate:
+                _rollback("canary error rate %.2f > %.2f"
+                          % (rate, canary_error_rate))
+            if (baseline_p99 and p99
+                    and p99 > canary_p99_factor * baseline_p99):
+                _rollback("canary p99 %.1fms > %gx baseline %.1fms"
+                          % (p99, canary_p99_factor, baseline_p99))
+    profiler.record_event_stat("fleet.rollout_done")
+    return report
+
+
+class ServingFleet:
+    """N supervised replicas + router + rollout, as one object::
+
+        fleet = ServingFleet(
+            {"models": [{"name": "m",
+                         "builder": "mxnet_tpu.serving.replica:demo_dense",
+                         "kwargs": {"seed": 0}, "item_shape": [16],
+                         "max_batch_size": 8}]},
+            replicas=3)
+        fleet.start()
+        cli = ServingClient(*fleet.address)   # fleet looks like 1 server
+        ...
+        fleet.rollout({"name": "m", "builder": ..., "kwargs": {...},
+                       "item_shape": [16], "max_batch_size": 8})
+        fleet.stop()
+    """
+
+    def __init__(self, spec, *, replicas=None, policy="least_loaded",
+                 host="127.0.0.1", port=0, env=None,
+                 router_kwargs=None, supervisor_kwargs=None):
+        self.supervisor = ReplicaSupervisor(
+            spec, replicas=replicas, host=host, env=env,
+            **(supervisor_kwargs or {}))
+        self._policy = policy
+        self._router_kwargs = dict(router_kwargs or {})
+        self._host = host
+        self._port = int(port)
+        self.router = None
+        self.server = None
+
+    @property
+    def address(self):
+        return self.server.address
+
+    def start(self):
+        self.supervisor.start()
+        self.router = Router(self.supervisor.addresses(),
+                             policy=self._policy, **self._router_kwargs)
+        self.server = RouterServer(self.router, host=self._host,
+                                   port=self._port)
+        self.server.start()
+        return self.address
+
+    def rollout(self, model_spec, **kwargs):
+        return rollout(self.router, model_spec, **kwargs)
+
+    def status(self):
+        return {"router": self.router.snapshot() if self.router else None,
+                "supervisor": self.supervisor.states()}
+
+    def stop(self):
+        if self.server is not None:
+            self.server.stop()  # stops the router's probe loop too
+            self.server = None
+        self.supervisor.stop()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import config as _config
 from .. import profiler
 
 #: ring-buffer size per histogram — recent-window percentiles, O(1) memory
@@ -94,9 +95,17 @@ class ModelMetrics:
 
 
 class ServingMetrics:
-    """Thread-safe per-model metrics registry."""
+    """Thread-safe per-model metrics registry.
 
-    def __init__(self):
+    ``replica`` labels every snapshot (and the Prometheus export) with
+    the serving replica that produced it — the fleet supervisor stamps
+    ``MXNET_SERVING_REPLICA_ID`` into each replica process so the router
+    can aggregate per-replica stats without guessing by port."""
+
+    def __init__(self, replica=None):
+        self.replica = (str(replica) if replica is not None
+                        else (_config.get("MXNET_SERVING_REPLICA_ID")
+                              or None))
         self._lock = threading.Lock()
         self._models = {}
 
@@ -142,11 +151,15 @@ class ServingMetrics:
 
     def snapshot(self):
         """Scrapeable stats: {model: {counters, batch_occupancy,
-        queue_wait/device/total/batch_size histograms}}."""
+        queue_wait/device/total/batch_size histograms}}, labelled with
+        the replica id when one is set."""
         with self._lock:
-            return {"time": time.time(),
+            snap = {"time": time.time(),
                     "models": {n: m.snapshot()
                                for n, m in self._models.items()}}
+        if self.replica is not None:
+            snap["replica"] = self.replica
+        return snap
 
     def reset(self):
         with self._lock:

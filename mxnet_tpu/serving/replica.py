@@ -1,0 +1,159 @@
+"""Replica process entrypoint: one supervised ModelServer.
+
+``python -m mxnet_tpu.serving.replica --spec spec.json --port P --id r0``
+
+boots one fleet replica: enable the persistent XLA compile cache
+(``MXNET_COMPILE_CACHE_DIR`` — a restarted replica's per-bucket warmup
+becomes cache reads, so it re-serves in seconds instead of
+compile-minutes), load every model in the spec (warm-before-publish),
+start an admin-enabled ModelServer on the given port, and then sit in a
+watchdog loop until SIGTERM (graceful: drain the batcher, then exit 0).
+
+The spec file is JSON::
+
+    {"models": [{"name": "m", "builder": "pkg.mod:make_model",
+                 "kwargs": {...}, "item_shape": [16], "dtype": "float32",
+                 "max_batch_size": 8, "buckets": [1, 4, 8]}, ...],
+     "flush_ms": 5.0, "max_queue_depth": 256}
+
+Models are named by importable *builder path*, never shipped as code —
+only callables already on this process's PYTHONPATH can load (the
+restricted-unpickler stance, applied to serving).
+
+Fault site ``replica.crash`` is checked from the watchdog loop
+(``MXNET_FAULT_SPEC=replica.crash:kill@n=40`` etc.): the ``kill`` kind
+hard-exits the process SIGKILL-style — no drain, no cleanup — which is
+exactly the failure the supervisor + router are chaos-tested against.
+
+The ``demo_*`` builders below are the deterministic toy models the
+example, the chaos runner, and the test suite serve; ``demo_faulty``
+exists so canary-abort drills have a model that fails on purpose.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as onp
+
+__all__ = ["main", "demo_affine", "demo_dense", "demo_faulty"]
+
+
+# ---------------------------------------------------------------------------
+# demo builders (chaos drills, examples, tests)
+# ---------------------------------------------------------------------------
+def demo_affine(scale=2.0, shift=0.0, slow_ms=0.0):
+    """Pure-host affine model ``x*scale + shift``: deterministic, zero
+    compile time (fast replica boot in chaos runs).  ``slow_ms`` sleeps
+    per batch — a knob for queue-buildup/backpressure scenarios."""
+    scale, shift, slow_s = float(scale), float(shift), float(slow_ms) / 1e3
+
+    def fn(batch):
+        if slow_s:
+            time.sleep(slow_s)
+        return onp.asarray(batch) * scale + shift
+    return fn
+
+
+def demo_dense(units=4, in_units=16, seed=0):
+    """Small hybridized Dense net — the real XLA serving path (per-bucket
+    precompile, compile-cache reads) at toy size."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(int(seed))
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=int(in_units)), nn.Activation("relu"),
+            nn.Dense(int(units)))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    net(mxnp.zeros((1, int(in_units))))  # finalize deferred shapes
+    return net
+
+
+def demo_faulty(p=1.0, scale=2.0, seed=0):
+    """A model that fails on purpose with probability ``p`` per batch
+    (deterministic in sequence): the canary-abort rollout drill needs a
+    new version whose error rate regresses."""
+    import random as _random
+    rng = _random.Random(int(seed))
+    good = demo_affine(scale=scale)
+
+    def fn(batch):
+        if rng.random() < float(p):
+            raise RuntimeError("demo_faulty: injected model failure")
+        return good(batch)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# process entry
+# ---------------------------------------------------------------------------
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--spec", required=True, help="model spec JSON file")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--id", default="", help="replica id (metrics label)")
+    args = ap.parse_args(argv)
+
+    if args.id:
+        # stamp BEFORE the serving metrics object exists so every
+        # snapshot/export this process produces carries the label
+        os.environ["MXNET_SERVING_REPLICA_ID"] = args.id
+
+    from . import ModelServer
+    from .registry import (ModelRegistry, load_model_spec,
+                           maybe_enable_compile_cache)
+    from .. import faults
+
+    with open(args.spec) as f:
+        spec = json.load(f)
+
+    cache = maybe_enable_compile_cache()
+    registry = ModelRegistry()
+    t0 = time.monotonic()
+    for mspec in spec.get("models", ()):
+        load_model_spec(registry, mspec)
+    warm_s = time.monotonic() - t0
+
+    server = ModelServer(
+        registry, host=args.host, port=args.port, admin=True,
+        flush_ms=float(spec.get("flush_ms", 5.0)),
+        max_queue_depth=int(spec.get("max_queue_depth", 256)))
+    server.start()
+    print("REPLICA_READY id=%s port=%d warm_s=%.2f cache=%s"
+          % (args.id, server.port, warm_s, cache or "off"), flush=True)
+
+    stop = threading.Event()
+
+    def _sigterm(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, _sigterm)
+
+    # watchdog loop: the replica.crash fault site lives here so chaos
+    # specs can kill a serving replica deterministically mid-traffic
+    while not stop.wait(0.05):
+        try:
+            kind = faults.check("replica.crash")
+        except Exception:
+            # exception kinds = unhandled crash: die loudly, non-zero —
+            # the supervisor's restart path, not the graceful one
+            raise SystemExit(1)
+        if kind == "kill":
+            os._exit(137)  # SIGKILL-style: no drain, no atexit, nothing
+
+    # graceful: drain queued work, refuse new admissions, exit 0
+    server.stop(drain=True, timeout=30.0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

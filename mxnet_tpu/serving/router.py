@@ -1,0 +1,676 @@
+"""Fleet router: health-driven dispatch over N replica ModelServers.
+
+One thin, stateless-per-request tier in front of the replica fleet
+(TF-Serving-behind-Envoy / GFE-style), so a single crashed, compiling,
+or draining ModelServer never takes the endpoint down:
+
+- **Dispatch policies** — ``least_loaded`` (default: fewest in-flight
+  router-side requests, round-robin tie-break) or ``hash`` (consistent
+  hashing of the request's ``affinity_key`` onto a 64-vnode ring, for
+  replica-local cache affinity; keyless requests fall back to
+  least-loaded).  Ejected/unready replicas are walked over on the ring,
+  so only the keys owned by a failed replica remap.
+- **Active health**: a probe thread polls every replica's ``/readyz``
+  each ``MXNET_FLEET_PROBE_MS``; a 503 (no model yet / draining) makes
+  the replica unroutable WITHOUT ejecting it, and an unreachable probe
+  counts a strike like live traffic would.
+- **Passive failure detection**: a connect failure, timeout, reset, or
+  5xx on a live request marks the replica suspect (one strike); after
+  ``MXNET_FLEET_STRIKES`` consecutive strikes it is ejected.  Ejected
+  replicas are re-probed with exponential backoff
+  (``MXNET_FLEET_EJECT_BACKOFF_MS``, doubled per failure, capped) and
+  re-admitted on the first probe success — the classic outlier-ejection
+  loop.
+- **Failover**: a request that fails in transport retries on the next
+  replica (each replica tried at most once) within the request deadline.
+  A reply-phase loss is replayed only for idempotent requests — plain
+  ``:predict`` over a stateless model IS idempotent (replicas share no
+  request state), so the default is to fail over; callers with
+  side-effecting models pass ``"idempotent": false`` in the body.
+- **Backpressure propagation**: a replica's 503 load-shed
+  (``queue_full`` / ``server_closed``) is NOT a strike — the replica is
+  healthy, just full.  The request retries once on the least-loaded
+  alternative; when every routable replica sheds, the router sheds at
+  its own socket (503 + ``Retry-After``) instead of queueing unboundedly
+  — overload propagates out to clients, never accumulates in the middle.
+
+Observability: per-replica dispatch/retry/strike/eject/shed counters +
+a fleet-wide end-to-end latency histogram (p50/p95/p99), snapshotted at
+``/v1/stats``, exported in Prometheus text at ``/metrics``, and fed to
+``profiler.record_fleet_stat`` (the ``aggregate_stats()['fleet']``
+table).  Fault site ``router.dispatch`` (``mxnet_tpu.faults``) injects
+deterministic transport failures into the forward path for chaos tests.
+"""
+from __future__ import annotations
+
+import bisect
+import http.client
+import itertools
+import json
+import re
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import config as _config
+from .. import faults, profiler
+from .errors import (FleetUnavailableError, ModelNotFoundError,
+                     QueueFullError, ServingError)
+from .metrics import LatencyHistogram
+
+__all__ = ["Replica", "Router", "RouterServer", "FleetMetrics"]
+
+_SHED_CODES = ("queue_full", "server_closed")
+_VNODES = 64          # ring points per replica (consistent hashing)
+_BACKOFF_CAP = 30.0   # max eject-probe backoff, in multiples of the base
+
+
+def _addr_of(spec):
+    """'host:port' | (host, port) -> (host, int(port))."""
+    if isinstance(spec, str):
+        host, _, port = spec.rpartition(":")
+        return host or "127.0.0.1", int(port)
+    host, port = spec
+    return host, int(port)
+
+
+class Replica:
+    """One replica's routing state (guarded by the router lock).
+
+    States: ``healthy`` (routable while ``ready``), ``ejected`` (struck
+    out; only the probe loop talks to it).  ``ready`` mirrors the last
+    ``/readyz`` answer; ``draining`` is the rollout gate — a draining
+    replica takes no NEW requests but stays healthy (in-flight ones
+    finish, its warmup competes with nothing)."""
+
+    COUNTERS = ("dispatched", "responses", "retries", "strikes",
+                "ejections", "readmissions", "sheds", "errors")
+
+    def __init__(self, spec):
+        self.host, self.port = _addr_of(spec)
+        self.rid = "%s:%d" % (self.host, self.port)
+        self.state = "healthy"
+        self.ready = True       # optimistic until a probe says otherwise
+        self.draining = False
+        self.strikes = 0
+        self.inflight = 0
+        self.next_probe = 0.0
+        self.probe_backoff_s = 0.0
+        self.counters = dict.fromkeys(self.COUNTERS, 0)
+
+    @property
+    def routable(self):
+        return (self.state == "healthy" and self.ready
+                and not self.draining)
+
+    def describe(self):
+        return {"state": self.state, "ready": self.ready,
+                "draining": self.draining, "strikes": self.strikes,
+                "inflight": self.inflight, "counters": dict(self.counters)}
+
+
+class FleetMetrics:
+    """Router-side fleet observability: one end-to-end latency histogram
+    (what clients experience THROUGH the router, retries included) plus
+    per-replica counters, mirrored into the profiler fleet table."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latency = LatencyHistogram()
+        self.counters = {"requests_total": 0, "responses_total": 0,
+                         "retries_total": 0, "shed_total": 0,
+                         "errors_total": 0}
+
+    def count(self, name, n=1):
+        with self._lock:
+            self.counters[name] += n
+
+    def observe(self, dt_s):
+        with self._lock:
+            self.counters["responses_total"] += 1
+            self._latency.observe(dt_s)
+        profiler.record_fleet_stat("router.dispatch", dt_s)
+
+    def snapshot(self):
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "latency": self._latency.snapshot()}
+
+
+class Router:
+    """Health-driven dispatcher over replica ModelServers.
+
+    ``replicas`` is a list of ``"host:port"`` / ``(host, port)`` specs.
+    ``policy`` is ``"least_loaded"`` or ``"hash"``.  ``probe_ms=0``
+    disables the active probe loop (passive detection only — tests)."""
+
+    def __init__(self, replicas, *, policy="least_loaded", strikes=None,
+                 probe_ms=None, eject_backoff_ms=None, timeout=30.0,
+                 retry_inflight=True):
+        if policy not in ("least_loaded", "hash"):
+            raise ValueError("unknown dispatch policy %r" % (policy,))
+        self.policy = policy
+        self.timeout = float(timeout)
+        self.retry_inflight = bool(retry_inflight)
+        self.strikes = max(1, int(
+            strikes if strikes is not None
+            else _config.get("MXNET_FLEET_STRIKES")))
+        self.probe_s = float(
+            probe_ms if probe_ms is not None
+            else _config.get("MXNET_FLEET_PROBE_MS")) / 1e3
+        self.eject_backoff_s = max(1e-3, float(
+            eject_backoff_ms if eject_backoff_ms is not None
+            else _config.get("MXNET_FLEET_EJECT_BACKOFF_MS")) / 1e3)
+        self.metrics = FleetMetrics()
+        self._lock = threading.Lock()
+        self._replicas = {}   # rid -> Replica
+        self._ring = []       # sorted [(hashpoint, rid)]
+        self._rr = itertools.count()  # least-loaded tie-break
+        self._tls = threading.local()
+        self._stop = threading.Event()
+        self._probe_thread = None
+        for spec in replicas:
+            self.add_replica(spec)
+        if self.probe_s > 0:
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="mxtpu-fleet-probe",
+                daemon=True)
+            self._probe_thread.start()
+
+    # -- membership -------------------------------------------------------
+    def add_replica(self, spec):
+        r = Replica(spec)
+        with self._lock:
+            if r.rid in self._replicas:
+                return self._replicas[r.rid]
+            self._replicas[r.rid] = r
+            for v in range(_VNODES):
+                point = zlib.crc32(("%s#%d" % (r.rid, v)).encode())
+                bisect.insort(self._ring, (point, r.rid))
+        return r
+
+    def remove_replica(self, rid):
+        with self._lock:
+            r = self._replicas.pop(rid, None)
+            if r is not None:
+                self._ring = [(p, i) for p, i in self._ring if i != rid]
+        return r
+
+    def replica_ids(self):
+        with self._lock:
+            return sorted(self._replicas)
+
+    def set_drain(self, rid, draining):
+        """Rollout gate: a draining replica takes no new requests (its
+        model warmup runs undisturbed) but is not struck or ejected."""
+        with self._lock:
+            self._replicas[rid].draining = bool(draining)
+
+    # -- selection --------------------------------------------------------
+    def _routable_locked(self, exclude):
+        out = [r for r in self._replicas.values()
+               if r.routable and r.rid not in exclude]
+        if out:
+            return out
+        # last resort: a draining replica still serves correctly — route
+        # to it rather than failing the request outright
+        return [r for r in self._replicas.values()
+                if r.state == "healthy" and r.ready
+                and r.rid not in exclude]
+
+    def _pick(self, affinity_key, exclude):
+        with self._lock:
+            live = self._routable_locked(exclude)
+            if not live:
+                return None
+            if self.policy == "hash" and affinity_key is not None:
+                ok = {r.rid for r in live}
+                h = zlib.crc32(str(affinity_key).encode())
+                i = bisect.bisect_left(self._ring, (h, ""))
+                for j in range(len(self._ring)):  # walk past dead owners
+                    rid = self._ring[(i + j) % len(self._ring)][1]
+                    if rid in ok:
+                        r = self._replicas[rid]
+                        r.inflight += 1
+                        return r
+                return None
+            # least-loaded with a rotating tie-break: an idle fleet
+            # round-robins instead of pinning the first replica
+            k = next(self._rr) % len(live)
+            rotated = live[k:] + live[:k]
+            r = min(rotated, key=lambda x: x.inflight)  # stable min
+            r.inflight += 1
+            return r
+
+    # -- health accounting ------------------------------------------------
+    def _strike(self, r, why):
+        with self._lock:
+            r.strikes += 1
+            r.counters["strikes"] += 1
+            eject = r.strikes >= self.strikes and r.state == "healthy"
+            if eject:
+                r.state = "ejected"
+                r.counters["ejections"] += 1
+                r.probe_backoff_s = self.eject_backoff_s
+                r.next_probe = time.monotonic() + r.probe_backoff_s
+        profiler.record_fleet_stat("router.strike.%s" % r.rid)
+        if eject:
+            profiler.record_event_stat("fleet.eject")
+            profiler.record_counter("fleet.%s" % r.rid, ejected=1)
+        self._drop_conn(r.rid)
+
+    def _mark_ok(self, r):
+        with self._lock:
+            r.strikes = 0
+
+    def _readmit(self, r):
+        with self._lock:
+            r.state = "healthy"
+            r.ready = True
+            r.strikes = 0
+            r.probe_backoff_s = 0.0
+            r.counters["readmissions"] += 1
+        profiler.record_event_stat("fleet.readmit")
+
+    def _probe_loop(self):
+        while not self._stop.wait(self.probe_s):
+            now = time.monotonic()
+            with self._lock:
+                targets = list(self._replicas.values())
+            for r in targets:
+                if self._stop.is_set():
+                    return
+                if r.state == "ejected" and now < r.next_probe:
+                    continue  # still backing off
+                ok = self._probe_ready(r)
+                if r.state == "ejected":
+                    if ok:
+                        self._readmit(r)
+                    else:
+                        with self._lock:
+                            r.probe_backoff_s = min(
+                                r.probe_backoff_s * 2 or
+                                self.eject_backoff_s,
+                                self.eject_backoff_s * _BACKOFF_CAP)
+                            r.next_probe = (time.monotonic()
+                                            + r.probe_backoff_s)
+                elif ok is None:
+                    self._strike(r, "probe unreachable")
+                else:
+                    with self._lock:
+                        r.ready = ok
+                    if ok:
+                        self._mark_ok(r)
+
+    def _probe_ready(self, r):
+        """One /readyz round trip on a fresh connection: True = ready,
+        False = alive but not ready (503), None = unreachable."""
+        try:
+            conn = http.client.HTTPConnection(
+                r.host, r.port, timeout=max(0.5, self.probe_s * 5))
+            try:
+                conn.request("GET", "/readyz")
+                resp = conn.getresponse()
+                resp.read()
+                return resp.status == 200
+            finally:
+                conn.close()
+        except OSError:
+            return None
+
+    # -- transport --------------------------------------------------------
+    def _conns(self):
+        conns = getattr(self._tls, "conns", None)
+        if conns is None:
+            conns = self._tls.conns = {}
+        return conns
+
+    def _drop_conn(self, rid):
+        conn = self._conns().pop(rid, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _forward(self, r, method, path, body, timeout):
+        conns = self._conns()
+        conn = conns.get(r.rid)
+        if conn is None:
+            conn = conns[r.rid] = http.client.HTTPConnection(
+                r.host, r.port, timeout=timeout)
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        headers = ({"Content-Type": "application/json"}
+                   if body is not None else {})
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            doc = json.loads(data.decode() or "{}")
+        except ValueError:
+            doc = {"error": data.decode(errors="replace"),
+                   "code": "internal"}
+        return resp.status, doc
+
+    # -- dispatch ---------------------------------------------------------
+    def dispatch(self, path, body=None, *, method="POST", deadline_s=None,
+                 affinity_key=None, idempotent=True):
+        """Forward one request; returns ``(status, doc)``.
+
+        Transport failures fail over to the next replica (each tried at
+        most once) inside the deadline; reply-phase losses fail over only
+        when ``idempotent``.  Replica sheds retry once on the
+        least-loaded alternative; when everyone sheds, raises
+        :class:`QueueFullError` with ``retry_after`` set — the router's
+        own socket-level shed."""
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+        self.metrics.count("requests_total")
+        t0 = time.monotonic()
+        deadline = t0 + (deadline_s if deadline_s is not None
+                         else self.timeout)
+        tried = set()
+        sheds = 0
+        last_exc = None
+        last_5xx = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.metrics.count("errors_total")
+                if last_exc is not None:
+                    raise last_exc
+                raise FleetUnavailableError(
+                    "request deadline expired before any replica answered")
+            # a shed retry goes to the LEAST-LOADED alternative even under
+            # hash policy — the key's owner is full, affinity is moot
+            r = self._pick(None if sheds else affinity_key, tried)
+            if r is None:
+                break
+            sent = False
+            try:
+                faults.check("router.dispatch")
+                sent = True  # past the injection point = request on wire
+                status, doc = self._forward(r, method, path, body,
+                                            timeout=remaining)
+            except (OSError, http.client.HTTPException) as e:
+                # passive detection: connect/timeout/reset = one strike
+                self._strike(r, repr(e))
+                tried.add(r.rid)
+                last_exc = e
+                r.counters["errors"] += 1
+                # a send-phase failure is always safe to fail over; a
+                # reply-phase loss replays only for idempotent requests
+                if ((not sent or idempotent or method == "GET")
+                        and self.retry_inflight):
+                    self.metrics.count("retries_total")
+                    r.counters["retries"] += 1
+                    profiler.record_fleet_stat("router.retry.%s" % r.rid)
+                    continue
+                self.metrics.count("errors_total")
+                raise ServingError(
+                    "replica %s failed mid-request (non-idempotent; not "
+                    "replayed): %r" % (r.rid, e))
+            finally:
+                with self._lock:
+                    r.inflight -= 1
+                r.counters["dispatched"] += 1
+            if status == 503 and doc.get("code") in _SHED_CODES:
+                # backpressure: not a strike — the replica is healthy,
+                # just full.  One retry on the least-loaded alternative.
+                r.counters["sheds"] += 1
+                self.metrics.count("shed_total")
+                profiler.record_fleet_stat("router.shed.%s" % r.rid)
+                tried.add(r.rid)
+                sheds += 1
+                if sheds == 1:
+                    self.metrics.count("retries_total")
+                    continue
+                break  # second shed: propagate instead of hammering on
+            if status >= 500:
+                self._strike(r, "HTTP %d" % status)
+                tried.add(r.rid)
+                r.counters["errors"] += 1
+                last_5xx = (status, doc)
+                if idempotent and self.retry_inflight:
+                    self.metrics.count("retries_total")
+                    r.counters["retries"] += 1
+                    continue
+            else:
+                self._mark_ok(r)
+            r.counters["responses"] += 1
+            self.metrics.observe(time.monotonic() - t0)
+            return status, doc
+        if last_5xx is not None and not sheds:
+            # every replica answered 5xx (e.g. a poisoned request fails
+            # the model everywhere): propagate the replica's own error
+            # verbatim — this is a request problem, not fleet overload
+            self.metrics.count("errors_total")
+            return last_5xx
+        # no replica could take the request: the router sheds at its own
+        # socket instead of queueing — bounded latency beats a black hole
+        self.metrics.count("shed_total")
+        self.metrics.count("errors_total")
+        profiler.record_fleet_stat("router.shed")
+        if sheds:  # overload: every routable replica load-shed
+            exc = QueueFullError(
+                "all %d routable replica(s) shed this request — fleet at "
+                "capacity" % sheds)
+        elif last_exc is not None:  # failures, and no replica left to try
+            exc = FleetUnavailableError(
+                "no replica left to try after %d failure(s); last: %r"
+                % (len(tried), last_exc))
+        else:
+            exc = FleetUnavailableError(
+                "no routable replica (%d registered)"
+                % len(self.replica_ids()))
+        exc.retry_after = max(0.1, min(1.0, self.probe_s * 2))
+        raise exc
+
+    # -- stats / lifecycle ------------------------------------------------
+    def states(self):
+        with self._lock:
+            return {rid: r.describe()
+                    for rid, r in sorted(self._replicas.items())}
+
+    def snapshot(self):
+        snap = self.metrics.snapshot()
+        snap["policy"] = self.policy
+        snap["replicas"] = self.states()
+        return snap
+
+    def stop(self):
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(max(1.0, self.probe_s * 4))
+            self._probe_thread = None
+        for rid in list(self._conns()):
+            self._drop_conn(rid)
+
+
+_PREDICT_RE = re.compile(
+    r"^/v1/models/[^/:]+(?:/versions/\d+)?:predict$")
+
+
+class RouterServer:
+    """HTTP frontend over a :class:`Router` — same REST surface as a
+    single ModelServer, so clients can't tell a fleet from one replica
+    (``ServingClient`` pointed at the router Just Works).
+
+    Router-specific endpoints: ``/v1/stats`` reports the fleet snapshot
+    (router latency histogram + per-replica states/counters + each live
+    replica's own labelled stats), ``/readyz`` is 200 iff at least one
+    replica is routable, and a router-level shed carries a
+    ``Retry-After`` header."""
+
+    def __init__(self, router, *, host="127.0.0.1", port=0):
+        self.router = router
+        self._host = host
+        self._port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    @property
+    def address(self):
+        return (self._host, self.port)
+
+    def start(self):
+        if self._httpd is not None:
+            return self.address
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_error(self, exc):
+                status = getattr(exc, "http_status", 500)
+                code = getattr(exc, "code", "internal")
+                headers = {}
+                retry_after = getattr(exc, "retry_after", None)
+                if retry_after is not None:
+                    headers["Retry-After"] = "%g" % retry_after
+                self._reply(status, {"error": str(exc), "code": code},
+                            headers)
+
+            def do_GET(self):
+                try:
+                    self._reply(*server._handle_get(self.path))
+                except ServingError as e:
+                    self._reply_error(e)
+                except Exception as e:  # pragma: no cover - defensive
+                    self._reply_error(ServingError(
+                        "%s: %s" % (type(e).__name__, e)))
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n) if n else b""
+                    self._reply(*server._handle_post(self.path, raw))
+                except ServingError as e:
+                    self._reply_error(e)
+                except Exception as e:
+                    self._reply_error(ServingError(
+                        "%s: %s" % (type(e).__name__, e)))
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="mxtpu-fleet-router-http",
+                                        daemon=True)
+        self._thread.start()
+        return self.address
+
+    def stop(self):
+        self.router.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(10)
+            self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- handlers ---------------------------------------------------------
+    def _handle_get(self, path):
+        if path == "/healthz":
+            return 200, {"ok": True}
+        if path == "/readyz":
+            states = self.router.states()
+            n = sum(1 for s in states.values()
+                    if s["state"] == "healthy" and s["ready"])
+            ready = n > 0
+            return (200 if ready else 503), {
+                "ready": ready, "routable_replicas": n,
+                "replicas": len(states)}
+        if path in ("/v1/stats", "/stats"):
+            snap = self.router.snapshot()
+            snap["replica_stats"] = self._collect_replica_stats()
+            return 200, snap
+        if path == "/metrics":
+            return 200, {"text": self._prometheus_text()}
+        # listing / model description: any routable replica's view is the
+        # fleet's view (rollout converges them)
+        return self.router.dispatch(path, method="GET")
+
+    def _handle_post(self, path, raw_body):
+        if not _PREDICT_RE.match(path):
+            raise ModelNotFoundError("no route %r" % (path,))
+        deadline_s = None
+        affinity_key = None
+        idempotent = True
+        if raw_body:
+            try:
+                body = json.loads(raw_body.decode() or "{}")
+                if body.get("deadline_ms") is not None:
+                    deadline_s = float(body["deadline_ms"]) / 1e3 + 1.0
+                affinity_key = body.get("affinity_key")
+                idempotent = bool(body.get("idempotent", True))
+            except (ValueError, TypeError):
+                pass  # the replica rejects malformed JSON with a 400
+        return self.router.dispatch(
+            path, raw_body, deadline_s=deadline_s,
+            affinity_key=affinity_key, idempotent=idempotent)
+
+    def _collect_replica_stats(self):
+        """Best-effort fetch of each healthy replica's own labelled
+        ServingMetrics snapshot (the per-replica p50/p95/p99)."""
+        out = {}
+        for rid, st in self.router.states().items():
+            if st["state"] != "healthy":
+                continue
+            try:
+                status, doc = self.router._forward(
+                    self.router._replicas[rid], "GET", "/v1/stats", None,
+                    timeout=2.0)
+                if status == 200:
+                    out[rid] = doc
+            except (OSError, http.client.HTTPException):
+                self.router._drop_conn(rid)
+        return out
+
+    def _prometheus_text(self):
+        snap = self.router.snapshot()
+        lines = []
+        for cname, v in sorted(snap["counters"].items()):
+            lines.append("mxtpu_fleet_%s %d" % (cname, v))
+        for k, v in sorted((snap["latency"] or {}).items()):
+            if k == "count":
+                continue
+            lines.append("mxtpu_fleet_latency_%s %g" % (k, v))
+        for rid, st in sorted(snap["replicas"].items()):
+            labels = 'replica="%s"' % rid
+            lines.append('mxtpu_fleet_replica_up{%s} %d'
+                         % (labels, 1 if st["state"] == "healthy" else 0))
+            lines.append('mxtpu_fleet_replica_inflight{%s} %d'
+                         % (labels, st["inflight"]))
+            for cname, v in sorted(st["counters"].items()):
+                lines.append("mxtpu_fleet_replica_%s{%s} %d"
+                             % (cname, labels, v))
+        return "\n".join(lines) + "\n"

@@ -57,12 +57,19 @@ class ModelServer:
     """
 
     def __init__(self, registry=None, *, host="127.0.0.1", port=0,
-                 batcher=None, request_timeout_s=30.0, **batcher_kwargs):
+                 batcher=None, request_timeout_s=30.0, admin=False,
+                 **batcher_kwargs):
         self.registry = registry if registry is not None else ModelRegistry()
         self.batcher = batcher if batcher is not None else DynamicBatcher(
             self.registry, **batcher_kwargs)
         self.metrics = self.batcher.metrics
         self.request_timeout_s = float(request_timeout_s)
+        # admin=True exposes /v1/admin/load + /v1/admin/unload (model
+        # hot-load by importable builder path — the fleet rollout plane).
+        # Off by default: it lets any peer that can reach the socket load
+        # any callable on THIS process's PYTHONPATH, so only replica
+        # processes (loopback-bound, supervisor-owned) enable it.
+        self.admin = bool(admin)
         self._host = host
         self._port = int(port)
         self._httpd = None
@@ -171,6 +178,8 @@ class ModelServer:
         raise ModelNotFoundError("no route %r" % (path,))
 
     def _handle_post(self, path, raw_body):
+        if path.startswith("/v1/admin/"):
+            return self._handle_admin(path, raw_body)
         m = _PREDICT_RE.match(path)
         if not m:
             raise ModelNotFoundError("no route %r" % (path,))
@@ -203,12 +212,51 @@ class ModelServer:
         return 200, {"predictions": preds, "model": name,
                      "version": served.version}
 
+    def _handle_admin(self, path, raw_body):
+        """Model hot-load plane (``admin=True`` servers only):
+
+        - ``POST /v1/admin/load`` — body is a model spec
+          (``registry.load_model_spec``): build the model from its
+          importable builder, warm EVERY batch bucket (XLA precompile —
+          reads the persistent compile cache when
+          ``MXNET_COMPILE_CACHE_DIR`` is set), THEN flip the registry's
+          latest pointer.  Traffic keeps flowing to the old version for
+          the whole warmup — this is the zero-downtime swap primitive
+          ``fleet.rollout`` drives one replica at a time.
+        - ``POST /v1/admin/unload`` — drop one version (rollback: latest
+          falls back to the newest remaining) or a whole model.
+        """
+        if not self.admin:
+            raise ModelNotFoundError(
+                "admin API disabled on this server (ModelServer(admin="
+                "True) — replica processes enable it)")
+        try:
+            body = json.loads(raw_body.decode() or "{}")
+        except ValueError as e:
+            raise BadRequestError("invalid JSON body: %s" % (e,))
+        if path == "/v1/admin/load":
+            if not body.get("name") or not body.get("builder"):
+                raise BadRequestError(
+                    'admin load needs {"name", "builder", ...}')
+            from .registry import load_model_spec
+            served = load_model_spec(self.registry, body)
+            return 200, {"ok": True, "model": served.describe()}
+        if path == "/v1/admin/unload":
+            if not body.get("name"):
+                raise BadRequestError('admin unload needs {"name"}')
+            self.registry.unload(body["name"], body.get("version"))
+            return 200, {"ok": True}
+        raise ModelNotFoundError("no admin route %r" % (path,))
+
     def _prometheus_text(self):
         """Counters + percentiles in Prometheus exposition format."""
         snap = self.metrics.snapshot()
+        replica = snap.get("replica")
         lines = []
         for model, stats in sorted(snap["models"].items()):
             labels = 'model="%s"' % model
+            if replica is not None:
+                labels += ',replica="%s"' % replica
             for cname, v in sorted(stats["counters"].items()):
                 lines.append("mxtpu_serving_%s{%s} %d" % (cname, labels, v))
             occ = stats.get("batch_occupancy")

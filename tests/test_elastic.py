@@ -175,6 +175,75 @@ def test_stalled_rank_is_evicted_and_survivor_continues(monkeypatch):
         _stop_server(srv, t)
 
 
+def test_adaptive_eviction_spares_compile_slow_rank(monkeypatch):
+    """The PR-5 sharp edge (ROADMAP item 3): MXNET_KV_EVICT_SEC
+    comparable to the step time must not ping-pong a merely-slow rank
+    out of the membership.  After a few observed rounds the effective
+    threshold is max(evict_sec, k x EMA(round time)), so a rank that
+    takes ~2x the usual round (a compile spike) survives an eviction
+    window that would have killed it cold — while a rank that truly
+    goes silent is still evicted at the adapted threshold."""
+    port = _free_port()
+    _cluster_env(monkeypatch, port, 2)
+    # evict_sec (0.5 s) deliberately comparable to the paced round time
+    # (~0.45 s): the pre-fix behavior evicts the slow rank below
+    srv, t = _start_server(port, 2, stall_sec=60, evict_sec=0.5)
+    profiler.reset_stats()
+    a = _worker(0, "w0")
+    b = _worker(1, "w1")
+    out = mxnp.zeros(2)
+    try:
+        with srv.cond:
+            srv.store["k"] = onp.zeros(2, onp.float32)
+            srv.applied_round["k"] = 0
+        # a few paced rounds teach the server the real round time
+        for _ in range(3):
+            a.push("k", mxnp.ones(2))
+            b.push("k", mxnp.ones(2))
+            a.pull("k", out=out)
+            b.pull("k", out=out)
+            time.sleep(0.45)
+        st = a.server_status()
+        assert st["round_ema_ms"] is not None and st["round_ema_ms"] > 200
+        assert st["effective_evict_sec"] > srv.evict_sec  # adapted UP
+
+        # the compile-slow round: rank 1 arrives ~1 s late (2x the EMA,
+        # 2x evict_sec) while rank 0 waits in the sync pull
+        a.push("k", mxnp.ones(2))
+        errs = []
+
+        def slow_rank1():
+            try:
+                time.sleep(1.0)  # the "compile"
+                b.push("k", mxnp.ones(2))
+                b.pull("k", out=mxnp.zeros(2))
+            except BaseException as e:
+                errs.append(e)
+
+        th = threading.Thread(target=slow_rank1, daemon=True)
+        th.start()
+        a.pull("k", out=out)  # would evict rank 1 under the fixed 0.5 s
+        th.join(30)
+        assert not errs, errs
+        # no eviction, no generation bump, no membership thrash
+        st = a.server_status()
+        assert st["gen"] == 0 and st["ranks"] == [0, 1]
+        assert profiler.aggregate_stats()["events"].get(
+            "membership.evict", 0) == 0
+
+        # a rank that is actually GONE is still evicted — at the adapted
+        # threshold, not never
+        a.push("k", mxnp.ones(2))
+        with pytest.raises(MembershipChanged):
+            a.pull("k", out=out)
+        assert profiler.aggregate_stats()["events"].get(
+            "membership.evict", 0) >= 1
+    finally:
+        a.close()
+        b.close()
+        _stop_server(srv, t)
+
+
 def test_rejoin_after_leave_restores_world_and_round(monkeypatch):
     port = _free_port()
     _cluster_env(monkeypatch, port, 2)

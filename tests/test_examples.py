@@ -21,6 +21,7 @@ EXAMPLES = [
     "example/gluon/lipnet.py",
     "example/gluon/audio_classification.py",
     "example/serving/serving_resnet50.py",
+    "example/serving/serving_fleet.py",
 ]
 
 

@@ -18,6 +18,15 @@ Scenarios (--scenario):
            it, and PASS when the job completes without manual
            intervention — step count conserved (every global step
            applied exactly once), replicas identical.
+  fleet    serving-fleet failover: N supervised replicas behind the
+           router under sustained closed-loop load; SIGKILL one replica
+           mid-traffic.  PASS when (1) ZERO requests fail (the router
+           fails in-flight idempotent predicts over to a survivor),
+           (2) the kill-window p99 stays < 5x the steady-state p99,
+           (3) the supervisor restores the full replica count, and
+           (4) a subsequent rolling model rollout (canary + drain one
+           at a time) completes during traffic with zero dropped
+           requests and the new version serving everywhere.
 
 Usage:
   python tools/chaos.py                       # default spec, 2 workers
@@ -25,6 +34,7 @@ Usage:
       --spec 'kvstore.send:reset@p=0.1;kvstore.recv:reset@p=0.05'
   python tools/chaos.py --no-compare-clean    # skip the baseline run
   python tools/chaos.py --scenario preempt    # SIGTERM + rejoin drill
+  python tools/chaos.py --scenario fleet -n 3 # kill-a-replica drill
 
 Exit code 0 = all invariants held.
 """
@@ -228,6 +238,148 @@ def scenario_preempt(args):
     return 0 if ok else 1
 
 
+def scenario_fleet(args):
+    """SIGKILL one of N serving replicas at sustained load, then roll a
+    new model version out — the full production-failover drill (see the
+    module docstring for the PASS conditions)."""
+    import threading
+
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as onp
+
+    from mxnet_tpu import profiler, serving
+
+    n = max(2, args.num_workers)  # replicas (reuses the -n flag)
+    clients = 4
+    steady_s, kill_s, rollout_min_s = 4.0, 8.0, 2.0
+    item = onp.ones((1, 8), dtype="float32")
+
+    spec = {"models": [{"name": "m",
+                        "builder": "mxnet_tpu.serving.replica:demo_affine",
+                        "kwargs": {"scale": 2.0, "slow_ms": 2.0},
+                        "item_shape": [8], "max_batch_size": 8,
+                        "warmup": False}],
+            "flush_ms": 2.0, "max_queue_depth": 512}
+    fleet = serving.ServingFleet(
+        spec, replicas=n,
+        router_kwargs={"probe_ms": 50},
+        supervisor_kwargs={"restart_backoff_ms": 100})
+    print("chaos-fleet: starting %d replicas" % n)
+    fleet.start()
+    ok = True
+    samples = []          # (t_done, latency_s, ok, expected_scale_ok)
+    samples_lock = threading.Lock()
+    stop = threading.Event()
+    expect_scale = [2.0]  # flips to {2,3} during rollout, 3 after
+
+    def load_client(cid):
+        cli = serving.ServingClient(*fleet.address, timeout=30, retries=0)
+        while not stop.is_set():
+            # judge against the expectation at request START: a request
+            # in flight while the rollout completes may legally serve
+            # either version
+            exp = expect_scale[0]
+            t0 = time.monotonic()
+            good = True
+            try:
+                out = cli.predict("m", item)
+                ratio = float(out[0][0])  # input is ones: out == scale
+                if ratio not in (2.0, 3.0) or \
+                        (exp == 3.0 and ratio != 3.0):
+                    good = False
+                    print("chaos-fleet: WRONG result %r (expected %r)"
+                          % (ratio, exp))
+            except Exception as e:
+                good = False
+                print("chaos-fleet: request FAILED: %r" % (e,))
+            with samples_lock:
+                samples.append((time.monotonic(), time.monotonic() - t0,
+                                good))
+        cli.close()
+
+    threads = [threading.Thread(target=load_client, args=(c,),
+                                daemon=True) for c in range(clients)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(steady_s)
+        t_kill = time.monotonic()
+        victim = fleet.supervisor.kill(1, signal.SIGKILL)
+        print("chaos-fleet: SIGKILL replica %s (pid was on port %d) "
+              "mid-traffic" % (victim.rid, victim.port))
+        # sustained load while the router ejects + fails over and the
+        # supervisor restarts the replica
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and \
+                fleet.supervisor.ready_count() < n:
+            time.sleep(0.2)
+        restored = fleet.supervisor.ready_count()
+        time.sleep(max(0.0, kill_s - (time.monotonic() - t_kill)))
+        t_recovered = time.monotonic()
+
+        # rolling rollout DURING traffic: drain-one-at-a-time + canary
+        expect_scale[0] = 0.0  # mixed versions are legal mid-rollout
+        report = fleet.rollout(
+            {"name": "m",
+             "builder": "mxnet_tpu.serving.replica:demo_affine",
+             "kwargs": {"scale": 3.0, "slow_ms": 2.0},
+             "item_shape": [8], "max_batch_size": 8, "warmup": False},
+            canary_probes=6)
+        expect_scale[0] = 3.0
+        time.sleep(rollout_min_s)  # post-rollout traffic on the new v
+        stop.set()
+        for t in threads:
+            t.join(30)
+
+        with samples_lock:
+            all_s = list(samples)
+        failed = [s for s in all_s if not s[2]]
+        steady = [s[1] for s in all_s if s[0] < t_kill]
+        killwin = [s[1] for s in all_s if t_kill <= s[0] < t_recovered]
+        p99_steady = float(onp.percentile(steady, 99)) if steady else 0.0
+        p99_kill = float(onp.percentile(killwin, 99)) if killwin else 0.0
+        print("chaos-fleet: %d requests total, %d failed; steady p99 "
+              "%.1f ms, kill-window p99 %.1f ms (%.1fx); replicas "
+              "restored: %d/%d; rollout: v%d, canary %s"
+              % (len(all_s), len(failed), p99_steady * 1e3,
+                 p99_kill * 1e3,
+                 (p99_kill / p99_steady) if p99_steady else 0.0,
+                 restored, n, report["version"], report["canary"]))
+        ev = profiler.aggregate_stats()["events"]
+        print("chaos-fleet: events: %s" % {
+            k: v for k, v in sorted(ev.items()) if k.startswith("fleet.")})
+
+        if failed:
+            print("FAIL: %d request(s) failed — the kill must not cost "
+                  "a single idempotent request" % len(failed))
+            ok = False
+        if not steady or not killwin:
+            print("FAIL: load generator produced no samples in a phase "
+                  "(steady=%d kill=%d)" % (len(steady), len(killwin)))
+            ok = False
+        elif p99_kill > 5.0 * max(p99_steady, 0.01):
+            print("FAIL: kill-window p99 %.1f ms exceeds 5x steady "
+                  "%.1f ms" % (p99_kill * 1e3, p99_steady * 1e3))
+            ok = False
+        if restored < n:
+            print("FAIL: supervisor restored %d/%d replicas" %
+                  (restored, n))
+            ok = False
+        if report["aborted"]:
+            print("FAIL: rollout aborted: %s" % report.get("abort_reason"))
+            ok = False
+        if not ev.get("fleet.replica_restart"):
+            print("FAIL: no supervisor restart was recorded — the kill "
+                  "tested nothing")
+            ok = False
+    finally:
+        stop.set()
+        fleet.stop()
+    print("chaos: %s" % ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -235,9 +387,11 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--scenario", default="faults",
-                    choices=["faults", "preempt"],
+                    choices=["faults", "preempt", "fleet"],
                     help="faults = transport chaos (bit-identical check);"
-                         " preempt = SIGTERM + relaunch + rejoin drill")
+                         " preempt = SIGTERM + relaunch + rejoin drill;"
+                         " fleet = SIGKILL a serving replica under load"
+                         " + rolling rollout (-n = replica count)")
     ap.add_argument("--spec", default=DEFAULT_SPEC,
                     help="MXNET_FAULT_SPEC for the chaos run "
                          "(default: %(default)s)")
@@ -246,6 +400,8 @@ def main():
     args = ap.parse_args()
     if args.scenario == "preempt":
         return scenario_preempt(args)
+    if args.scenario == "fleet":
+        return scenario_fleet(args)
 
     ok = True
     with tempfile.TemporaryDirectory(prefix="chaos-") as tmp:
