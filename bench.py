@@ -53,6 +53,10 @@ BASELINES = {
     # scaling vs the fleet's own 1-replica run and the kill-mid-bench
     # recovery invariants (zero failures, bounded p99, restored count)
     "serving_fleet_imgs_per_sec": None,
+    # LLM decode serving: no published reference at this model scale —
+    # the bar is the row's own static-batch decode baseline (the Orca
+    # claim: continuous batching >= 1.5x at mixed sequence lengths)
+    "llm_decode_serving_tokens_per_sec": None,
 }
 
 
@@ -690,6 +694,97 @@ def bench_serving_fleet():
 # ---------------------------------------------------------------------------
 # config 4: data-parallel via kvstore=tpu_ici (imperative Trainer path)
 # ---------------------------------------------------------------------------
+def bench_llm_decode():
+    """Continuous-batching LLM decode (paged KV cache) vs a static-batch
+    decode baseline, at MIXED prompt/output lengths.
+
+    Both runs use the identical engine, kernels, chunked prefill, and
+    paged cache — the only difference is scheduling: the baseline admits
+    a new batch only when the previous one fully drains (so every batch
+    runs at the speed and occupancy of its longest member), while
+    continuous batching re-forms the batch every decode step.  Reported:
+    generated tokens/s, p50/p99 TTFT and inter-token latency, decode
+    occupancy, and peak KV-page occupancy.  CPU-honest numbers on this
+    box; on the bench chip the decode step runs the Pallas
+    paged-attention kernel and the same row is the acceptance bar
+    (>= 1.5x over static at mixed lengths)."""
+    from mxnet_tpu.models.decoder import decoder_tiny_lm
+    from mxnet_tpu.serving.generate import DecodeEngine
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        model_kw = dict(vocab_size=2048, num_layers=4, units=256,
+                        hidden_size=512, num_heads=8, num_kv_heads=4,
+                        max_length=512)
+        n_req, slots, page, chunk, max_ctx = 96, 16, 16, 64, 256
+    else:
+        model_kw = dict(vocab_size=256, num_layers=2, units=64,
+                        hidden_size=128, num_heads=4, num_kv_heads=2,
+                        max_length=128)
+        n_req, slots, page, chunk, max_ctx = 48, 8, 8, 32, 128
+    lm = decoder_tiny_lm(seed=0, **model_kw)
+
+    # mixed lengths are the continuous-batching case.  Output lengths
+    # are heavy-tailed (most replies short, some long — real decode
+    # traffic), which is exactly where batch-level scheduling drowns:
+    # every static batch runs as long as its longest member.  Seeded —
+    # both runs see the identical workload.
+    rng = onp.random.RandomState(0)
+    lo, hi = (8, 48) if on_tpu else (4, 32)
+    prompts = [list(rng.randint(1, model_kw["vocab_size"],
+                                size=rng.randint(lo, hi + 1)))
+               for _ in range(n_req)]
+    long_lo, long_hi = (max_ctx // 2, max_ctx - hi)
+    outs = [int(rng.randint(long_lo, long_hi + 1)) if rng.rand() < 0.2
+            else int(rng.randint(4, 25)) for _ in range(n_req)]
+
+    def run(static):
+        eng = DecodeEngine(lm, name="llm", slots=slots, page_size=page,
+                           prefill_chunk=chunk, max_ctx=max_ctx,
+                           max_queue_depth=4 * n_req,
+                           static_batching=static)
+        eng.warmup()  # compile prefill+decode outside the window
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, outs)]
+        tokens = sum(len(f.result(timeout=1200)["tokens"]) for f in futs)
+        dt = time.perf_counter() - t0
+        snap = eng.metrics.snapshot()["models"]["llm"]
+        eng.stop()
+        assert eng.alloc.num_used == 0, "page leak after drain"
+        gen = snap["generate"]
+        return tokens / dt, {
+            "ttft_p50_ms": gen["ttft"].get("p50_ms"),
+            "ttft_p99_ms": gen["ttft"].get("p99_ms"),
+            "inter_token_p50_ms": gen["inter_token"].get("p50_ms"),
+            "inter_token_p99_ms": gen["inter_token"].get("p99_ms"),
+            "decode_occupancy": gen["decode_occupancy"],
+            "kv_peak_pages": gen["kv_cache"]["peak_used_pages"],
+            "kv_total_pages": gen["kv_cache"]["total_pages"],
+        }
+
+    # peak-of-2 per arm (the _best_window convention): the speedup is a
+    # scheduling property, but each wall-clock sample is exposed to box
+    # interference — occupancies are deterministic, throughput is not
+    static_tps, static_m = max((run(static=True) for _ in range(2)),
+                               key=lambda r: r[0])
+    cont_tps, cont_m = max((run(static=False) for _ in range(2)),
+                           key=lambda r: r[0])
+    extra = {"continuous": cont_m, "static_batch": static_m,
+             "static_tokens_per_s": round(static_tps, 2),
+             "speedup_vs_static": round(cont_tps / static_tps, 3),
+             "requests": n_req, "slots": slots, "page_size": page,
+             "prefill_chunk": chunk,
+             "backend": jax.default_backend(),
+             "notes": "mixed lengths: uniform prompts, heavy-tailed "
+                      "outputs (80% short / 20% long), greedy decode; "
+                      "identical kernels+workload both runs — the delta "
+                      "is iteration-level scheduling.  Acceptance bar "
+                      ">= 1.5x vs static on this box (CPU-honest; the "
+                      "bench chip runs the Pallas paged kernel)."}
+    return cont_tps, extra
+
+
 def bench_resnet50_dp_kvstore():
     """Data-parallel ResNet-50 through kvstore=tpu_ici, bucketed vs
     per-key gradient communication (kvstore/bucketing.py).  The bucketed
@@ -1075,6 +1170,8 @@ BENCHES = [
      bench_int8_serving),
     ("serving_fleet", "serving_fleet_imgs_per_sec", "img/s",
      bench_serving_fleet),
+    ("llm_decode_serving", "llm_decode_serving_tokens_per_sec",
+     "tokens/s", bench_llm_decode),
 ]
 
 

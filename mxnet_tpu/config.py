@@ -254,6 +254,31 @@ register("MXNET_EXEC_BULK_FUSE_BACKWARD_UPDATE", bool, True, "honored",
          " Set 0 to restore a flush at backward() — use if the merged "
          "program's live set presses HBM on very large models",
          "autograd.backward")
+register("MXNET_GEN_SLOTS", int, 8, "honored",
+         "decode batch width of the continuous-batching LLM engine "
+         "(sequences decoded per step)", "serving.DecodeEngine")
+register("MXNET_GEN_PAGE_SIZE", int, 16, "honored",
+         "tokens per KV-cache page (paged attention page granularity)",
+         "serving.DecodeEngine")
+register("MXNET_GEN_PAGES", int, 0, "honored",
+         "total KV-cache pages incl. the scratch page (0 = fully "
+         "provision slots x pages_per_seq + 1: no preemption pressure)",
+         "serving.DecodeEngine")
+register("MXNET_GEN_PREFILL_CHUNK", int, 32, "honored",
+         "prompt tokens cached per engine step (chunked prefill: long "
+         "prompts never stall the decode batch)", "serving.DecodeEngine")
+register("MXNET_GEN_MAX_CTX", int, 0, "honored",
+         "max prompt+output tokens per sequence (0 = model max_length)",
+         "serving.DecodeEngine")
+register("MXNET_GEN_SESSION_TTL", float, 300.0, "honored",
+         "idle parked decode-session lifetime in seconds before its KV "
+         "pages are reclaimed (resume after that -> SessionResetError)",
+         "serving.DecodeEngine")
+register("MXNET_PAGED_ATTENTION", str, "", "honored",
+         "paged-attention dispatch: '' auto (Pallas kernel on TPU, XLA "
+         "gather reference on CPU), '0' forces the reference, "
+         "'interpret' forces the Pallas kernel in interpreter mode",
+         "ops.pallas.paged_attention")
 register("MXNET_RNN_SCAN_UNROLL", int, 5, "honored",
          "RNN time-scan unroll factor", "ops.rnn")
 register("MXNET_RNN_WAVEFRONT", bool, True, "honored",

@@ -26,6 +26,12 @@ Sites (grep for ``faults.check``):
                      transport failure: strike, failover retry)
   replica.crash      serving replica watchdog loop ("kill" hard-exits the
                      replica process — the supervisor-restart drill)
+  decode.step        LLM decode engine, before one whole-batch decode
+                     iteration (exception kinds poison the in-flight
+                     decode batch typed; the engine keeps serving)
+  kvcache.alloc      paged KV-cache page allocation (exception kinds fail
+                     only the allocating sequence; genuine exhaustion is
+                     NOT a fault — it triggers preemption)
 
 Kinds: ``reset`` (ConnectionResetError), ``timeout`` (socket.timeout),
 ``error``/``crash`` (RuntimeError), plus site-interpreted kinds that
@@ -80,7 +86,8 @@ _SOFT_KINDS = ("drop", "torn", "preempt", "kill")
 
 KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
                "server.membership", "trainer.step", "checkpoint.write",
-               "router.dispatch", "replica.crash")
+               "router.dispatch", "replica.crash", "decode.step",
+               "kvcache.alloc")
 
 
 class FaultRule:

@@ -1,0 +1,356 @@
+"""Causal decoder LM for autoregressive decode serving.
+
+The model half of ``serving/generate.py``'s continuous-batching engine:
+a small GPT-style decoder built from the SAME blocks the BERT encoder
+uses (``nn.Dense``/``nn.LayerNorm`` parameter containers, the reused
+``PositionwiseFFN``, the PR-2 fused ``bias_gelu`` epilogue kernel, the
+flash-attention kernel for the full-sequence path) — plus the pieces an
+LLM server needs that an encoder never does:
+
+- ``full_forward``      — whole-sequence causal forward (training /
+  one-shot scoring / the greedy-parity oracle).  Flash attention with
+  ``causal=True`` (Pallas on TPU, XLA reference on CPU).
+- ``make_prefill_chunk`` — jitted fixed-shape chunk prefill: process
+  ``chunk`` prompt tokens of ONE sequence, scatter their KV into cache
+  pages, attend causally against the sequence's own pages.  Long
+  prompts run as a series of these, interleaved with decode steps.
+- ``make_decode_step``  — jitted one-token-per-sequence decode over the
+  whole slot batch: scatter this step's KV into pages, paged attention
+  (``ops/pallas/paged_attention``), greedy next token.  KV page arrays
+  are donated, so the cache is updated in place on accelerators.
+
+GQA layout: ``num_heads`` query heads grouped onto ``num_kv_heads`` KV
+heads (head ``h`` reads KV head ``h // (H // KVH)``) — the grouping the
+TPU paged-attention kernel expects, consistent across all three paths.
+
+Weights are read once through :meth:`CausalLM.jax_params` (raw
+``jax.Array`` pytree) and treated as frozen for serving — the registry
+hot-swap path replaces the whole model, never mutates weights in place.
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from ..ops import attention as _attention
+from ..ops.pallas import epilogue as _epilogue
+from ..ops.pallas import paged_attention as _paged
+from .bert import PositionwiseFFN
+
+# jax warns when buffer donation is requested on backends that ignore it
+# (CPU); donation is a no-op there and the hint is correct for TPU
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+__all__ = ["DecoderConfig", "CausalLM", "full_forward", "make_decode_step",
+           "make_prefill_chunk", "decoder_tiny", "decoder_tiny_lm"]
+
+
+class DecoderConfig(NamedTuple):
+    """Static (hashable) model geometry — the jit-cache key for the
+    decode/prefill programs."""
+    vocab_size: int
+    num_layers: int
+    units: int
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_length: int
+
+
+def _ln(x, gamma, beta, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * gamma + beta).astype(
+        x.dtype)
+
+
+def _proj(x, w, b=None):
+    """Dense with the gluon (out, in) weight convention."""
+    y = jnp.dot(x, w.T)
+    return y if b is None else y + b
+
+
+def _ffn(x, lp):
+    """PositionwiseFFN math via the fused bias_gelu epilogue (the PR-2
+    kernel: Pallas on accelerators, the XLA-fused chain on CPU)."""
+    h = _epilogue.bias_gelu(_proj(x, lp["w1"]), lp["b1"])
+    return _proj(h, lp["w2"], lp["b2"])
+
+
+def _qkv(x, lp, cfg):
+    """x: (..., C) -> q (..., H, D), k/v (..., KVH, D)."""
+    lead = x.shape[:-1]
+    q = _proj(x, lp["wq"], lp["bq"]).reshape(
+        lead + (cfg.num_heads, cfg.head_dim))
+    k = _proj(x, lp["wk"], lp["bk"]).reshape(
+        lead + (cfg.num_kv_heads, cfg.head_dim))
+    v = _proj(x, lp["wv"], lp["bv"]).reshape(
+        lead + (cfg.num_kv_heads, cfg.head_dim))
+    return q, k, v
+
+
+def _layer_tail(x, att_merged, lp):
+    """Shared post-attention epilogue: proj + residual LN + FFN + LN
+    (post-LN, the TransformerLayer convention)."""
+    o = _proj(att_merged, lp["wo"], lp["bo"])
+    x = _ln(x + o, lp["ln1g"], lp["ln1b"])
+    f = _ffn(x, lp)
+    return _ln(x + f, lp["ln2g"], lp["ln2b"])
+
+
+# ---------------------------------------------------------------------------
+# full-sequence causal forward (training / scoring / parity oracle)
+# ---------------------------------------------------------------------------
+def full_forward(params, cfg, tokens):
+    """tokens: (B, L) int32 -> logits (B, L, vocab) float32.
+
+    Whole-sequence causal attention through the flash kernel; the greedy
+    parity oracle for the incremental paged decode path."""
+    B, L = tokens.shape
+    g = cfg.num_heads // cfg.num_kv_heads
+    x = params["embed"][tokens] + params["pos"][:L]
+    for lp in params["layers"]:
+        q, k, v = _qkv(x, lp, cfg)                      # (B, L, H/KVH, D)
+        q4 = jnp.transpose(q, (0, 2, 1, 3))             # (B, H, L, D)
+        k4 = jnp.repeat(jnp.transpose(k, (0, 2, 1, 3)), g, axis=1)
+        v4 = jnp.repeat(jnp.transpose(v, (0, 2, 1, 3)), g, axis=1)
+        att = _attention.flash_attention(q4, k4, v4, causal=True)
+        merged = jnp.transpose(att, (0, 2, 1, 3)).reshape(B, L, cfg.units)
+        x = _layer_tail(x, merged, lp)
+    return jnp.dot(x.astype(jnp.float32),
+                   params["embed"].astype(jnp.float32).T)
+
+
+# ---------------------------------------------------------------------------
+# incremental decode over the paged KV cache
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=16)
+def make_decode_step(cfg, page_size):
+    """Build the jitted batched decode step for (cfg, page_size).
+
+    fn(params, k_pages, v_pages, tokens, positions, page_tables, active)
+      k_pages/v_pages: (layers, KVH, total_pages, page_size, head_dim)
+                       (donated: updated in place on accelerators)
+      tokens:     (B,) int32 — this step's input token per slot
+      positions:  (B,) int32 — cache index the token lands at
+      page_tables:(B, pages_per_seq) int32
+      active:     (B,) bool — inactive slots write the scratch page and
+                  read garbage; the engine discards their outputs
+    -> (k_pages, v_pages, next_tokens (B,) int32, logits (B, vocab) f32)
+    """
+    S = int(page_size)
+
+    def step(params, k_pages, v_pages, tokens, positions, page_tables,
+             active):
+        B = tokens.shape[0]
+        x = (params["embed"][tokens]
+             + params["pos"][jnp.clip(positions, 0, cfg.max_length - 1)])
+        page_of = jnp.take_along_axis(
+            page_tables, (positions // S)[:, None], axis=1)[:, 0]
+        # inactive slots scatter to page 0 — the allocator's reserved
+        # scratch page (serving/kvcache.py) — and read length 0
+        wp = jnp.where(active, page_of, 0)
+        ws = jnp.where(active, positions % S, 0)
+        lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+        for li, lp in enumerate(params["layers"]):
+            q, k, v = _qkv(x, lp, cfg)                  # (B, H/KVH, D)
+            # advanced indices split by ':' put the batch dim first:
+            # the target block is (B, KVH, D) — k/v's native layout
+            k_pages = k_pages.at[li, :, wp, ws, :].set(k)
+            v_pages = v_pages.at[li, :, wp, ws, :].set(v)
+            att = _paged.paged_attention(
+                q, k_pages[li], v_pages[li], lengths, page_tables)
+            x = _layer_tail(x, att.reshape(B, cfg.units), lp)
+        logits = jnp.dot(x.astype(jnp.float32),
+                         params["embed"].astype(jnp.float32).T)
+        return (k_pages, v_pages,
+                jnp.argmax(logits, axis=-1).astype(jnp.int32), logits)
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=16)
+def make_prefill_chunk(cfg, page_size, chunk):
+    """Build the jitted single-sequence chunk prefill for
+    (cfg, page_size, chunk).
+
+    fn(params, k_pages, v_pages, tokens, pos0, n_valid, page_row)
+      tokens:  (chunk,) int32 — prompt slice, padded past n_valid
+      pos0:    () int32 — absolute cache position of tokens[0]
+      n_valid: () int32 — valid tokens in this chunk
+      page_row:(pages_per_seq,) int32 — THIS sequence's page table
+    -> (k_pages, v_pages, next_token () int32, last_logits (vocab,) f32)
+
+    The chunk's KV is scattered into the sequence's pages first, then
+    the chunk queries attend over the gathered pages (prefix + chunk)
+    under a causal + validity mask — so arbitrarily long prompts cost a
+    bounded slice of each engine step instead of stalling the decode
+    batch (Sarathi-style chunked prefill).
+    """
+    S = int(page_size)
+    P = int(chunk)
+    g = cfg.num_heads // cfg.num_kv_heads
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+
+    def prefill(params, k_pages, v_pages, tokens, pos0, n_valid, page_row):
+        idx = pos0 + jnp.arange(P, dtype=jnp.int32)
+        valid = jnp.arange(P) < n_valid
+        x = (params["embed"][tokens]
+             + params["pos"][jnp.clip(idx, 0, cfg.max_length - 1)])
+        wp = jnp.where(valid, page_row[idx // S], 0)
+        ws = jnp.where(valid, idx % S, 0)
+        for li, lp in enumerate(params["layers"]):
+            q, k, v = _qkv(x, lp, cfg)                  # (P, H/KVH, D)
+            k_pages = k_pages.at[li, :, wp, ws, :].set(k)
+            v_pages = v_pages.at[li, :, wp, ws, :].set(v)
+            # gather THIS sequence's pages (prefix + the chunk just
+            # written) back to a contiguous (C, KVH, D) view
+            kc = _paged.gather_pages(k_pages[li], page_row[None])[0]
+            vc = _paged.gather_pages(v_pages[li], page_row[None])[0]
+            kr = jnp.repeat(kc, g, axis=0)              # (H, C, D)
+            vr = jnp.repeat(vc, g, axis=0)
+            qf = q.astype(jnp.float32).swapaxes(0, 1) * scale  # (H, P, D)
+            logits = jnp.einsum("hpd,hcd->hpc", qf,
+                                kr.astype(jnp.float32))
+            causal = (jnp.arange(kr.shape[1])[None, :]
+                      <= idx[:, None])                  # key <= query pos
+            logits = jnp.where(causal[None], logits, -jnp.inf)
+            p = jax.nn.softmax(logits, axis=-1)
+            p = jnp.where(jnp.isnan(p), 0.0, p)
+            att = jnp.einsum("hpc,hcd->hpd", p, vr.astype(jnp.float32))
+            merged = att.swapaxes(0, 1).reshape(P, cfg.units).astype(x.dtype)
+            x = _layer_tail(x, merged, lp)
+        last = x[jnp.clip(n_valid - 1, 0, P - 1)]
+        last_logits = jnp.dot(last.astype(jnp.float32),
+                              params["embed"].astype(jnp.float32).T)
+        return (k_pages, v_pages,
+                jnp.argmax(last_logits).astype(jnp.int32), last_logits)
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
+# ---------------------------------------------------------------------------
+# gluon parameter container
+# ---------------------------------------------------------------------------
+class DecoderLayer(HybridBlock):
+    """Parameter container mirroring TransformerLayer's shape (post-LN,
+    reused PositionwiseFFN); compute lives in the pure functions above."""
+
+    def __init__(self, units, hidden_size, num_heads, num_kv_heads):
+        super().__init__()
+        head_dim = units // num_heads
+        kv_units = num_kv_heads * head_dim
+        self.wq = nn.Dense(units, flatten=False, in_units=units)
+        self.wk = nn.Dense(kv_units, flatten=False, in_units=units)
+        self.wv = nn.Dense(kv_units, flatten=False, in_units=units)
+        self.wo = nn.Dense(units, flatten=False, in_units=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout=0.0)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+
+
+class CausalLM(HybridBlock):
+    """GPT-style causal decoder LM (tied input/output embedding).
+
+    ``forward(tokens)`` is the full-sequence path (scoring, the serving
+    registry's predict route); incremental generation runs through
+    ``serving.DecodeEngine``, which drives the jitted prefill/decode
+    programs against this block's parameters."""
+
+    def __init__(self, vocab_size=512, num_layers=2, units=128,
+                 hidden_size=256, num_heads=4, num_kv_heads=None,
+                 max_length=512, eos_id=None):
+        super().__init__()
+        num_kv_heads = num_kv_heads or num_heads
+        assert units % num_heads == 0
+        assert num_heads % num_kv_heads == 0
+        self._cfg = DecoderConfig(
+            vocab_size=int(vocab_size), num_layers=int(num_layers),
+            units=int(units), hidden_size=int(hidden_size),
+            num_heads=int(num_heads), num_kv_heads=int(num_kv_heads),
+            head_dim=units // num_heads, max_length=int(max_length))
+        self.eos_id = eos_id
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.position_embed = Parameter("position_embed",
+                                        shape=(max_length, units))
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(DecoderLayer(units, hidden_size, num_heads,
+                                         num_kv_heads))
+        self._jax_params = None
+
+    @property
+    def config(self):
+        return self._cfg
+
+    def jax_params(self):
+        """Raw jax.Array pytree of the weights (cached: serving treats
+        weights as frozen — hot swap replaces the model object)."""
+        if self._jax_params is not None:
+            return self._jax_params
+
+        def raw(p):
+            return p.data()._data
+
+        layers = []
+        for layer in self.layers:
+            layers.append({
+                "wq": raw(layer.wq.weight), "bq": raw(layer.wq.bias),
+                "wk": raw(layer.wk.weight), "bk": raw(layer.wk.bias),
+                "wv": raw(layer.wv.weight), "bv": raw(layer.wv.bias),
+                "wo": raw(layer.wo.weight), "bo": raw(layer.wo.bias),
+                "w1": raw(layer.ffn.ffn1.weight),
+                "b1": raw(layer.ffn.ffn1.bias),
+                "w2": raw(layer.ffn.ffn2.weight),
+                "b2": raw(layer.ffn.ffn2.bias),
+                "ln1g": raw(layer.ln1.gamma), "ln1b": raw(layer.ln1.beta),
+                "ln2g": raw(layer.ln2.gamma), "ln2b": raw(layer.ln2.beta),
+            })
+        self._jax_params = {
+            "embed": raw(self.word_embed.weight),
+            "pos": raw(self.position_embed),
+            "layers": layers,
+        }
+        return self._jax_params
+
+    def forward(self, tokens):
+        raw = tokens._data if hasattr(tokens, "_data") else jnp.asarray(
+            tokens)
+        logits = full_forward(self.jax_params(), self._cfg,
+                              raw.astype(jnp.int32))
+        from .. import np as mxnp
+        return mxnp.array(logits)
+
+
+# ---------------------------------------------------------------------------
+# builders (tests, bench, replica model specs)
+# ---------------------------------------------------------------------------
+def decoder_tiny(vocab_size=128, **kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("units", 64)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_kv_heads", 2)
+    kw.setdefault("max_length", 128)
+    return CausalLM(vocab_size, **kw)
+
+
+def decoder_tiny_lm(seed=0, vocab_size=128, **kw):
+    """Initialized, deterministic tiny LM — the importable builder the
+    replica spec / chaos drills serve
+    (``mxnet_tpu.models.decoder:decoder_tiny_lm``)."""
+    import mxnet_tpu as mx
+    mx.random.seed(int(seed))
+    net = decoder_tiny(vocab_size, **kw)
+    net.initialize(mx.init.Xavier())
+    return net

@@ -1,0 +1,184 @@
+"""Paged attention for autoregressive decode over a page-granular KV cache.
+
+The decode-serving memory problem (vLLM, Kwon et al. SOSP'23): a dense
+per-sequence KV cache must reserve `max_ctx` slots per sequence up
+front, so real fleets run at 20-40% cache utilization.  Paging fixes it
+the way virtual memory does — the cache is a pool of fixed-size pages
+(``k_pages``/``v_pages``: ``(num_kv_heads, total_pages, page_size,
+head_dim)``), each sequence owns a *page table* (``page_indices`` row),
+and attention gathers through the table.  Allocation/eviction become
+O(1) free-list ops (``serving/kvcache.py``) and admission control is
+exact page accounting instead of worst-case reservation.
+
+Two backends behind one call, the repo's probe-and-latch dispatch shape
+(ops/attention.py, ops/pallas/epilogue.py):
+
+- **TPU**: ``jax.experimental.pallas.ops.tpu.paged_attention`` — the
+  Pallas GQA kernel (SNIPPETS [3] shards this very kernel along KV
+  heads for the multi-chip tier).  The kernel applies no softmax scale,
+  so queries are pre-scaled here.
+- **CPU / fallback**: an XLA gather-based reference — pages are gathered
+  back into a contiguous ``(B, KVH, pages_per_seq * page_size, D)``
+  view and attention runs as masked f32 softmax.  The whole decode
+  engine is therefore tier-1 testable on CPU, and the reference IS the
+  bit-exactness oracle: gathering a sequence's pages yields exactly the
+  contiguous cache a non-paged decoder would hold, so paged decode must
+  match a full-cache decode bit for bit under greedy decoding.
+
+``MXNET_PAGED_ATTENTION`` — ``0``/``off`` forces the reference,
+``interpret`` forces the Pallas kernel in interpreter mode (CPU test
+lane for the kernel wrapper itself), default auto-probes like the flash
+and epilogue kernels do.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention", "paged_attention_reference", "last_path"]
+
+# Which path the last call took: "pallas" | "pallas-interpret" | "xla".
+# Tests assert on this to guarantee the kernel is actually exercised.
+last_path = None
+
+_probe_result = None
+_fallback_warned = False
+
+
+def _probe_pallas():
+    """One-time capability probe on tiny shapes (latched): a non-TPU
+    accelerator pays the failed Mosaic compile exactly once."""
+    global _probe_result
+    if _probe_result is None:
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention as kernel)
+            q = jnp.zeros((1, 2, 128), jnp.float32)
+            kv = jnp.zeros((1, 8, 16, 128), jnp.float32)
+            lengths = jnp.ones((1,), jnp.int32)
+            pages = jnp.zeros((1, 8), jnp.int32)
+            jax.block_until_ready(
+                kernel(q, kv, kv, lengths, pages, pages_per_compute_block=4))
+            _probe_result = True
+        except Exception:  # pragma: no cover - depends on platform
+            _probe_result = False
+    return _probe_result
+
+
+def _mode():
+    """'compiled' | 'interpret' | None (XLA reference)."""
+    flag = os.environ.get("MXNET_PAGED_ATTENTION", "").lower()
+    if flag in ("0", "off", "false"):
+        return None
+    if flag == "interpret":
+        return "interpret"
+    try:
+        if jax.default_backend() != "cpu" and _probe_pallas():
+            return "compiled"
+    except Exception:  # pragma: no cover
+        pass
+    return None
+
+
+def _pages_per_block(pages_per_seq):
+    """Largest power-of-two divisor of pages_per_seq, capped at 8 — the
+    kernel requires the compute block to tile the sequence's pages."""
+    b = 1
+    while b * 2 <= min(pages_per_seq, 8) and pages_per_seq % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def gather_pages(pages, page_indices):
+    """Gather per-sequence pages into contiguous per-sequence caches.
+
+    pages: (KVH, P, S, D); page_indices: (B, pages_per_seq) int32
+    -> (B, KVH, pages_per_seq * S, D), token-major per sequence — exactly
+    the contiguous cache layout a non-paged decoder would hold.
+    """
+    kvh, _, s, d = pages.shape
+    b, pps = page_indices.shape
+    # (KVH, B, pps, S, D) -> (B, KVH, pps*S, D)
+    g = jnp.swapaxes(pages[:, page_indices], 0, 1)
+    return g.reshape(b, kvh, pps * s, d)
+
+
+def attend_ctx(q, k_ctx, v_ctx, lengths, scale):
+    """Masked decode attention over contiguous per-sequence caches.
+
+    q: (B, H, D); k_ctx/v_ctx: (B, KVH, C, D); lengths: (B,) valid keys.
+    f32 softmax, GQA by head grouping.  This inner math is shared by the
+    paged reference (after gather) and by full-cache reference decoders,
+    which is what makes "paged == full-cache" a bit-exact statement.
+    """
+    b, h, d = q.shape
+    kvh, c = k_ctx.shape[1], k_ctx.shape[2]
+    g = h // kvh
+    qf = (q.astype(jnp.float32) * scale).reshape(b, kvh, g, d)
+    logits = jnp.einsum("bkgd,bkcd->bkgc", qf, k_ctx.astype(jnp.float32))
+    mask = jnp.arange(c)[None, None, None, :] < lengths[:, None, None, None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # length-0 rows (inactive slots)
+    out = jnp.einsum("bkgc,bkcd->bkgd", p, v_ctx.astype(jnp.float32))
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def paged_attention_reference(q, k_pages, v_pages, lengths, page_indices,
+                              scale=None):
+    """XLA gather-based reference: pages -> contiguous view -> masked
+    f32 softmax.  Correct for any (GQA) head grouping and inactive
+    (length-0) rows."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    k_ctx = gather_pages(k_pages, page_indices)
+    v_ctx = gather_pages(v_pages, page_indices)
+    return attend_ctx(q, k_ctx, v_ctx, lengths, scale)
+
+
+def paged_attention(q, k_pages, v_pages, lengths, page_indices, scale=None):
+    """Decode-phase paged attention (one query token per sequence).
+
+    q:            (B, num_heads, head_dim) — this step's query rows
+    k_pages/v_pages: (num_kv_heads, total_pages, page_size, head_dim)
+    lengths:      (B,) int32 — valid context length per sequence
+                  (inactive batch slots pass 0: their output is garbage
+                  by contract and masked off by the caller)
+    page_indices: (B, pages_per_seq) int32 page table rows
+
+    Returns (B, num_heads, head_dim) in q.dtype.
+    """
+    global last_path, _fallback_warned
+    mode = _mode()
+    if mode is not None:
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention as kernel)
+            d = q.shape[-1]
+            s = scale if scale is not None else 1.0 / (d ** 0.5)
+            # the TPU kernel masks length-0 rows itself but divides by a
+            # zero denominator; clamp to 1 (reads the scratch page, the
+            # caller discards inactive rows either way)
+            safe_len = jnp.maximum(lengths.astype(jnp.int32), 1)
+            out = kernel(
+                (q * jnp.asarray(s, q.dtype)), k_pages, v_pages,
+                safe_len, page_indices.astype(jnp.int32),
+                pages_per_compute_block=_pages_per_block(
+                    page_indices.shape[1]))
+            last_path = ("pallas" if mode == "compiled"
+                         else "pallas-interpret")
+            return out
+        except Exception as e:  # pragma: no cover - platform dependent
+            if not _fallback_warned:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "paged_attention: Pallas kernel failed (%s: %s); using "
+                    "the XLA gather reference for this process",
+                    type(e).__name__, e)
+                _fallback_warned = True
+    last_path = "xla"
+    return paged_attention_reference(q, k_pages, v_pages, lengths,
+                                     page_indices, scale=scale)

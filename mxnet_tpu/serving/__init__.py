@@ -32,6 +32,21 @@ fleet"):
   compile cache (``MXNET_COMPILE_CACHE_DIR``) so replica restarts and
   rollouts re-serve in seconds instead of compile-minutes.
 
+LLM tier (continuous-batching decode serving — see README "LLM
+serving"):
+
+- ``DecodeEngine`` (``generate.py``) — iteration-level (continuous)
+  batching: the decode batch re-forms every step, with chunked prefill,
+  decode sessions, and preemption-by-recompute under cache pressure.
+- ``PageAllocator`` (``kvcache.py``) — the paged KV cache's free-list
+  allocator and occupancy accounting; the device-side paged attention
+  lives in ``ops/pallas/paged_attention.py`` (Pallas kernel on TPU, XLA
+  gather reference on CPU).
+- ``/v1/models/<name>:generate`` + ``ServingClient.generate`` — the
+  HTTP surface; with the fleet router, a generation ``session`` rides
+  the consistent-hash ``affinity_key`` back to the replica holding its
+  KV pages (``SessionResetError`` when that replica is gone).
+
 Quick start::
 
     import mxnet_tpu as mx
@@ -47,12 +62,14 @@ from __future__ import annotations
 from .errors import (BadRequestError, DeadlineExceededError,
                      FleetUnavailableError, ModelNotFoundError,
                      QueueFullError, RolloutAbortedError,
-                     ServerClosedError, ServingError)
+                     ServerClosedError, ServingError, SessionResetError)
 from .metrics import LatencyHistogram, ModelMetrics, ServingMetrics
 from .registry import (ModelRegistry, ServedModel, default_buckets,
                        load_model_spec, maybe_enable_compile_cache,
                        resolve_builder)
 from .batcher import DynamicBatcher
+from .kvcache import PageAllocator
+from .generate import DecodeEngine
 from .server import ModelServer
 from .client import ServingClient
 from .router import FleetMetrics, Replica, Router, RouterServer
@@ -62,11 +79,12 @@ from .fleet import ServingFleet, rollout
 __all__ = [
     "ServingError", "BadRequestError", "ModelNotFoundError",
     "QueueFullError", "ServerClosedError", "DeadlineExceededError",
-    "FleetUnavailableError", "RolloutAbortedError",
+    "SessionResetError", "FleetUnavailableError", "RolloutAbortedError",
     "ServingMetrics", "ModelMetrics", "LatencyHistogram",
     "ModelRegistry", "ServedModel", "default_buckets",
     "load_model_spec", "maybe_enable_compile_cache", "resolve_builder",
-    "DynamicBatcher", "ModelServer", "ServingClient",
+    "DynamicBatcher", "PageAllocator", "DecodeEngine",
+    "ModelServer", "ServingClient",
     "FleetMetrics", "Replica", "Router", "RouterServer",
     "ReplicaProcess", "ReplicaSupervisor", "ServingFleet", "rollout",
 ]

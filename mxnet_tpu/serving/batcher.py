@@ -77,6 +77,7 @@ class DynamicBatcher:
         self._queues = {}   # model -> {key: deque[_Request]}
         self._depth = {}    # model -> queued request count
         self._workers = {}  # model -> Thread
+        self._engines = {}  # model -> DecodeEngine (generation path)
         self._stopping = False
 
     @property
@@ -126,6 +127,41 @@ class DynamicBatcher:
         with self._lock:
             return self._depth.get(model, 0)
 
+    # -- generation (continuous-batching decode engines) ------------------
+    def register_engine(self, model, engine):
+        """Attach a :class:`~.generate.DecodeEngine` as ``model``'s
+        generation path.  The engine inherits this batcher's metrics and
+        queue-depth bound, and drains/stops with it — one admission
+        policy for both request kinds."""
+        engine.metrics = self.metrics
+        engine.max_queue_depth = self.max_queue_depth
+        with self._cond:
+            self._engines[model] = engine
+        return engine
+
+    def engine(self, model):
+        with self._cond:
+            return self._engines.get(model)
+
+    def submit_generate(self, model, prompt, **kwargs):
+        """Admit one generation request through the same
+        deadline/load-shed/drain machinery as ``submit()``: a draining
+        batcher refuses (``ServerClosedError``), a full engine queue
+        sheds (``QueueFullError``), deadlines expire typed.  Returns the
+        engine future."""
+        with self._cond:
+            if self._stopping:
+                self.metrics.count(model, "shed_total")
+                raise ServerClosedError(
+                    "batcher is draining; not accepting new requests")
+            engine = self._engines.get(model)
+        if engine is None:
+            from .errors import ModelNotFoundError
+            raise ModelNotFoundError(
+                "model %r has no generation engine (have: %s)"
+                % (model, sorted(self._engines)))
+        return engine.submit(prompt, **kwargs)
+
     # -- worker -----------------------------------------------------------
     def _max_batch(self, served):
         if self._max_batch_override is not None:
@@ -166,13 +202,36 @@ class DynamicBatcher:
                 del queues[key]
                 return []
             target = self._max_batch(served)
-            # size-or-timeout flush: wait for the batch to fill until the
-            # oldest request has aged flush_s
+            # size-or-timeout flush, CAPPED by the head request's
+            # deadline: a request due to expire sooner than the flush
+            # window must not hold the window open — it is expired (and
+            # rejected) at its deadline, not at flush_s
             while (len(q) < target and not self._stopping):
-                remaining = q[0].t_enqueue + self.flush_s - time.perf_counter()
+                cap = q[0].t_enqueue + self.flush_s
+                if q[0].deadline is not None:
+                    cap = min(cap, q[0].deadline)
+                remaining = cap - time.perf_counter()
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
+            # expire-before-dispatch: already-dead head requests are
+            # rejected here instead of padding the batch (the tail of
+            # the queue keeps its own flush window)
+            now = time.perf_counter()
+            expired = []
+            while q and q[0].expired(now):
+                expired.append(q.popleft())
+            if expired:
+                self._depth[model] -= len(expired)
+                for r in expired:
+                    self.metrics.count(model, "deadline_expired_total")
+                    r.future.set_exception(DeadlineExceededError(
+                        "request expired after %.1f ms in queue (deadline)"
+                        % ((now - r.t_enqueue) * 1e3)))
+                if not q:
+                    del queues[key]
+                    self._cond.notify_all()
+                    return []
             n = min(len(q), target)
             batch = [q.popleft() for _ in range(n)]
             if not q:
@@ -250,8 +309,13 @@ class DynamicBatcher:
                 self._queues.clear()
             self._cond.notify_all()
             workers = list(self._workers.values())
+            engines = list(self._engines.values())
         deadline = time.monotonic() + timeout
         ok = True
+        for engine in engines:  # generation drains under the same policy
+            ok = engine.stop(
+                drain=drain,
+                timeout=max(0.0, deadline - time.monotonic())) and ok
         for t in workers:
             t.join(max(0.0, deadline - time.monotonic()))
             ok = ok and not t.is_alive()

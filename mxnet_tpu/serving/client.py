@@ -143,6 +143,32 @@ class ServingClient:
         doc = self._request("POST", path, body)
         return onp.asarray(doc["predictions"])
 
+    def generate(self, model, prompt, max_tokens=16, *, session=None,
+                 resume=False, deadline_ms=None):
+        """Autoregressive generation: ``prompt`` is a list of token ids;
+        returns the server's result dict (``tokens``, ``finish_reason``,
+        token counts).
+
+        ``session`` keeps the KV cache parked server-side for follow-up
+        calls; it is sent as the fleet router's ``affinity_key`` so a
+        multi-call session sticks to the replica holding its pages, and
+        marks the request non-idempotent (a mid-flight failover must not
+        double-advance the session).  ``resume=True`` demands the
+        session exist — a replica that lost it answers with the typed
+        :class:`~.errors.SessionResetError` (409) and the caller
+        restarts generation from the full prompt."""
+        body = {"prompt": [int(t) for t in prompt],
+                "max_tokens": int(max_tokens)}
+        if deadline_ms is not None:
+            body["deadline_ms"] = float(deadline_ms)
+        if session is not None:
+            body["session"] = str(session)
+            body["affinity_key"] = str(session)
+            body["idempotent"] = False
+            body["resume"] = bool(resume)
+        return self._request("POST", "/v1/models/%s:generate" % model,
+                             body)
+
     def server_alive(self):
         """Liveness probe: one /healthz round trip, no retries — True iff
         a server is answering at (host, port)."""

@@ -50,6 +50,16 @@ class DeadlineExceededError(ServingError):
     code = "deadline_exceeded"
 
 
+class SessionResetError(ServingError):
+    """A generation request tried to RESUME a decode session this
+    replica does not hold (the replica restarted, was ejected and the
+    ring remapped the key, or the session expired) — the KV pages are
+    gone, so silently continuing would decode against an empty cache.
+    409: the client restarts generation from the full prompt."""
+    http_status = 409
+    code = "session_reset"
+
+
 class FleetUnavailableError(ServingError):
     """The fleet router has no routable replica for this request (all
     ejected/unready/failed).  503 with Retry-After: the condition is
@@ -71,7 +81,8 @@ CODE_TO_ERROR = {
     cls.code: cls
     for cls in (ServingError, BadRequestError, ModelNotFoundError,
                 QueueFullError, ServerClosedError, DeadlineExceededError,
-                FleetUnavailableError, RolloutAbortedError)
+                SessionResetError, FleetUnavailableError,
+                RolloutAbortedError)
 }
 
 

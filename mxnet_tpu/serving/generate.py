@@ -1,0 +1,731 @@
+"""Continuous-batching autoregressive decode engine over a paged KV cache.
+
+The Orca + vLLM serving recipe, grown onto this repo's serving stack:
+
+- **Iteration-level (continuous) batching** — the decode batch is
+  re-formed every step: a sequence is admitted into a free slot the
+  moment one opens, and evicted the step it finishes (EOS / max tokens /
+  deadline).  A static batch runs at the speed (and occupancy) of its
+  longest member; continuous batching keeps every slot producing real
+  tokens, which is the whole throughput story of LLM serving.
+- **Paged KV cache** — per-sequence KV lives in fixed-size pages handed
+  out by ``kvcache.PageAllocator`` (free list, exact occupancy);
+  attention reads through per-slot page tables
+  (``ops/pallas/paged_attention``: Pallas kernel on TPU, XLA gather
+  reference on CPU — the engine is tier-1 testable end to end).
+  When the pool runs dry the engine **preempts** the youngest sequence
+  (frees its pages, requeues it for recompute with its progress kept)
+  instead of failing — vLLM's recompute eviction.
+- **Chunked prefill** — prompts are cached ``prefill_chunk`` tokens per
+  engine step (Sarathi-style), interleaved with decode steps, so a long
+  prompt costs every in-flight sequence one bounded slice of latency
+  per step instead of a full-prompt stall.
+- **Decode sessions** — a request carrying ``session=<id>`` parks its
+  pages on completion; a later request with the same id continues
+  decoding against the cached context (multi-turn without re-prefill).
+  Resuming a session this process does not hold raises the typed
+  :class:`~.errors.SessionResetError` — the fleet router's
+  consistent-hash ``affinity_key`` keeps a session on its replica, and
+  the typed error is what a client sees when that replica was replaced.
+
+Admission control mirrors ``DynamicBatcher`` exactly (and composes with
+it via ``DynamicBatcher.register_engine``): bounded queue sheds with
+``QueueFullError``, draining rejects with ``ServerClosedError``,
+deadlines expire typed, and a failed sequence poisons only its own
+future.  Fault sites: ``decode.step`` (one decode iteration) and
+``kvcache.alloc`` (page allocation) — see ``tools/chaos.py
+--scenario llm``.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+from .. import config as _config
+from .. import faults
+from ..models import decoder as _decoder
+from .errors import (BadRequestError, DeadlineExceededError, QueueFullError,
+                     ServerClosedError, ServingError, SessionResetError)
+from .kvcache import CacheOOM, PageAllocator, pages_for
+from .metrics import ServingMetrics
+
+__all__ = ["DecodeEngine"]
+
+_log = logging.getLogger(__name__)
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "deadline", "future", "session",
+                 "resume", "t_enqueue", "prefix", "ttft_recorded",
+                 "prompt_tokens", "started")
+
+    def __init__(self, prompt, max_new, deadline, session, resume):
+        self.prompt = list(prompt)
+        self.prompt_tokens = len(self.prompt)  # as submitted (reporting)
+        self.max_new = int(max_new)
+        self.deadline = deadline          # absolute perf_counter or None
+        self.session = session
+        self.resume = bool(resume)
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.prefix = []                  # tokens emitted before a preempt
+        self.ttft_recorded = False
+        self.started = False              # future already marked running
+
+    def expired(self, now):
+        return self.deadline is not None and now > self.deadline
+
+
+class _Slot:
+    __slots__ = ("req", "state", "owner", "prompt", "done", "pos",
+                 "history", "generated", "pending", "t_last", "admit_seq",
+                 "idx")
+
+    def __init__(self, idx):
+        self.idx = idx
+        self.req = None
+        self.state = "idle"   # idle | prefill | decode
+
+    @property
+    def active(self):
+        return self.state != "idle"
+
+
+class _Session:
+    __slots__ = ("sid", "owner", "pos", "pending", "history", "last_used",
+                 "busy")
+
+    def __init__(self, sid, owner):
+        self.sid = sid
+        self.owner = owner
+        self.pos = 0
+        self.pending = None
+        self.history = []
+        self.last_used = time.monotonic()
+        self.busy = False
+
+
+class DecodeEngine:
+    """Continuous-batching decode scheduler for one causal LM.
+
+    ``model`` is a :class:`mxnet_tpu.models.decoder.CausalLM` (or any
+    object with ``jax_params()``/``config``).  One worker thread owns
+    the KV pages and re-forms the decode batch every step.
+
+    Knobs (env defaults in parentheses):
+      slots          — decode batch width (``MXNET_GEN_SLOTS``)
+      page_size      — tokens per KV page (``MXNET_GEN_PAGE_SIZE``)
+      total_pages    — KV pool size incl. the scratch page
+                       (``MXNET_GEN_PAGES``; 0 = fully provision
+                       ``slots * pages_per_seq + 1`` — no preemption)
+      max_ctx        — max prompt+output tokens per sequence
+                       (``MXNET_GEN_MAX_CTX``; 0 = model max_length)
+      prefill_chunk  — prompt tokens cached per engine step
+                       (``MXNET_GEN_PREFILL_CHUNK``)
+      session_ttl_s  — idle parked-session lifetime
+                       (``MXNET_GEN_SESSION_TTL``)
+      static_batching— True = the A/B baseline: admissions wait for the
+                       WHOLE batch to drain (batch-level scheduling);
+                       everything else identical
+    """
+
+    def __init__(self, model, *, name="llm", slots=None, page_size=None,
+                 total_pages=None, max_ctx=None, prefill_chunk=None,
+                 eos_id=None, max_queue_depth=256, metrics=None,
+                 static_batching=False, session_ttl_s=None):
+        self.model = model
+        self.name = name
+        self.cfg = model.config
+        self.params = model.jax_params()
+        self.slots = int(slots if slots is not None
+                         else _config.get("MXNET_GEN_SLOTS"))
+        self.page_size = int(page_size if page_size is not None
+                             else _config.get("MXNET_GEN_PAGE_SIZE"))
+        self.max_ctx = int(max_ctx or _config.get("MXNET_GEN_MAX_CTX")
+                           or self.cfg.max_length)
+        self.max_ctx = min(self.max_ctx, self.cfg.max_length)
+        self.pages_per_seq = pages_for(self.max_ctx, self.page_size)
+        total = int(total_pages if total_pages is not None
+                    else _config.get("MXNET_GEN_PAGES"))
+        if not total:
+            total = self.slots * self.pages_per_seq + 1
+        self.prefill_chunk = int(prefill_chunk if prefill_chunk is not None
+                                 else _config.get("MXNET_GEN_PREFILL_CHUNK"))
+        self.eos_id = eos_id if eos_id is not None else getattr(
+            model, "eos_id", None)
+        self.max_queue_depth = int(max_queue_depth)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.static_batching = bool(static_batching)
+        self.session_ttl_s = float(
+            session_ttl_s if session_ttl_s is not None
+            else _config.get("MXNET_GEN_SESSION_TTL"))
+
+        self.alloc = PageAllocator(total, self.page_size)
+        cfg = self.cfg
+        shape = (cfg.num_layers, cfg.num_kv_heads, total, self.page_size,
+                 cfg.head_dim)
+        self._kp = jnp.zeros(shape, jnp.float32)
+        self._vp = jnp.zeros(shape, jnp.float32)
+        self._tables = onp.zeros((self.slots, self.pages_per_seq),
+                                 onp.int32)
+        self._tables_dev = None  # device copy, rebuilt when rows change
+        self._decode_fn = _decoder.make_decode_step(cfg, self.page_size)
+        self._prefill_fn = _decoder.make_prefill_chunk(
+            cfg, self.page_size, self.prefill_chunk)
+
+        self._slots = [_Slot(i) for i in range(self.slots)]
+        self._sessions = {}           # sid -> _Session (parked or busy)
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._worker = None
+        self._stopping = False
+        self._drain_mode = True
+        self._seq = 0                 # admission counter (owner ids)
+        self._prefill_rr = 0
+        self.steps = 0
+
+    # -- admission --------------------------------------------------------
+    @property
+    def draining(self):
+        return self._stopping
+
+    def queue_depth(self):
+        with self._cond:
+            return len(self._queue)
+
+    def active_count(self):
+        with self._cond:
+            return sum(1 for s in self._slots if s.active)
+
+    def submit(self, prompt, max_new_tokens=16, *, deadline_ms=None,
+               session=None, resume=False):
+        """Enqueue one generation; returns a Future resolving to
+        ``{"tokens", "finish_reason", "session", "prompt_tokens",
+        "completion_tokens"}``.  Shed/deadline/reset failures rethrow
+        typed at ``future.result()`` (or synchronously at submit for
+        admission-time refusals), matching the batcher's contract."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise BadRequestError("generate: prompt must be non-empty")
+        if any(t < 0 or t >= self.cfg.vocab_size for t in prompt):
+            raise BadRequestError(
+                "generate: token ids must be in [0, %d)"
+                % self.cfg.vocab_size)
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise BadRequestError("generate: max_tokens must be >= 1")
+        if session is None and len(prompt) + max_new > self.max_ctx:
+            raise BadRequestError(
+                "generate: prompt (%d) + max_tokens (%d) exceeds "
+                "max_ctx=%d" % (len(prompt), max_new, self.max_ctx))
+        deadline = (time.perf_counter() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        self.metrics.count(self.name, "requests_total")
+        with self._cond:
+            if self._stopping:
+                self.metrics.count(self.name, "shed_total")
+                raise ServerClosedError(
+                    "decode engine is draining; not accepting new requests")
+            if len(self._queue) >= self.max_queue_depth:
+                self.metrics.count(self.name, "shed_total")
+                raise QueueFullError(
+                    "model %r generate queue full (%d >= %d)"
+                    % (self.name, len(self._queue), self.max_queue_depth))
+            if resume and session is not None \
+                    and session not in self._sessions:
+                self.metrics.count(self.name, "sessions_reset_total")
+                raise SessionResetError(
+                    "session %r is not held by this replica (restarted or "
+                    "expired); restart generation" % (session,))
+            req = _Request(prompt, max_new, deadline, session, resume)
+            self._queue.append(req)
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._run, name="mxtpu-decode-%s" % self.name,
+                    daemon=True)
+                self._worker.start()
+            self._cond.notify_all()
+        return req.future
+
+    # -- worker -----------------------------------------------------------
+    def _run(self):
+        while True:
+            with self._cond:
+                while (not self._stopping and not self._queue
+                       and not any(s.active for s in self._slots)):
+                    self._cond.wait(0.1)
+                    self._expire_sessions_locked()
+                if self._stopping:
+                    busy = (any(s.active for s in self._slots)
+                            or (self._drain_mode and self._queue))
+                    if not busy:
+                        return
+            try:
+                self._step()
+            except Exception:  # pragma: no cover - defensive
+                _log.exception("decode engine step failed; continuing")
+                time.sleep(0.01)
+
+    def _step(self):
+        now = time.perf_counter()
+        self._expire_queued(now)
+        with self._cond:
+            self._expire_sessions_locked()
+        self._admit()
+        self._prefill_phase()
+        self._decode()
+        self.metrics.observe_kv_cache(
+            self.name, self.alloc.num_used, self.alloc.total_pages - 1)
+        self.steps += 1
+
+    def _expire_queued(self, now):
+        with self._cond:
+            expired = [r for r in self._queue if r.expired(now)]
+            for r in expired:
+                self._queue.remove(r)
+        for r in expired:
+            self.metrics.count(self.name, "deadline_expired_total")
+            r.future.set_exception(DeadlineExceededError(
+                "generate request expired after %.1f ms in queue"
+                % ((now - r.t_enqueue) * 1e3)))
+
+    def _expire_sessions_locked(self):
+        if not self.session_ttl_s:
+            return
+        cutoff = time.monotonic() - self.session_ttl_s
+        for sid in [sid for sid, s in self._sessions.items()
+                    if not s.busy and s.last_used < cutoff]:
+            sess = self._sessions.pop(sid)
+            self.alloc.free(sess.owner)
+
+    # -- scheduling -------------------------------------------------------
+    def _free_slot(self):
+        for s in self._slots:
+            if not s.active:
+                return s
+        return None
+
+    def _admit(self):
+        if self.static_batching:
+            # batch-level scheduling (the A/B baseline): a new batch
+            # forms only once the previous one fully drained, then fills
+            # every slot it can in one go
+            with self._cond:
+                if any(s.active for s in self._slots):
+                    return
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return
+                slot = self._free_slot()
+                if slot is None:
+                    return
+                req = self._queue[0]
+                sess = (self._sessions.get(req.session)
+                        if req.session is not None else None)
+                if sess is not None and sess.busy:
+                    return  # head-of-line: continuation waits for its turn
+                self._queue.popleft()
+            if not self._activate(slot, req, sess):
+                return
+
+    def _activate(self, slot, req, sess):
+        """Place ``req`` into ``slot``; returns False when admission must
+        pause (page watermark) — the request goes back to the head."""
+        if req.session is not None and sess is None \
+                and self._resume_missing(req):
+            return True  # rejected typed; keep admitting
+        if sess is not None:
+            # the session's last emitted token was never fed back; it
+            # leads the continuation prompt (None: parked mid-prefill)
+            prefill = (([sess.pending] if sess.pending is not None else [])
+                       + req.prompt)
+            base, owner = sess.pos, sess.owner
+            history = list(sess.history)
+        else:
+            prefill = list(req.prompt)
+            base, history = 0, []
+            self._seq += 1
+            owner = ("req", self._seq)
+        remaining_new = req.max_new - len(req.prefix)
+        final_ctx = base + len(prefill) + max(0, remaining_new - 1)
+        if final_ctx > self.max_ctx:
+            req.future.set_exception(BadRequestError(
+                "generate: session context (%d) + prompt + max_tokens "
+                "exceeds max_ctx=%d" % (base, self.max_ctx)))
+            if sess is not None:
+                sess.last_used = time.monotonic()
+            return True
+        # watermark: enough pages to finish prefill + the first decode
+        # token, otherwise leave it queued until evictions free pages —
+        # under pressure, idle parked sessions are reclaimed LRU-first
+        # (their later resume gets the typed SessionResetError)
+        need_now = (pages_for(base + len(prefill) + 1, self.page_size)
+                    - len(self.alloc.pages(owner)))
+        while (need_now > self.alloc.num_free
+               and self._evict_lru_session(keep=req.session)):
+            pass
+        if need_now > self.alloc.num_free:
+            with self._cond:
+                self._queue.appendleft(req)
+            return False
+        if not req.started and not req.future.set_running_or_notify_cancel():
+            return True  # client cancelled while queued
+        req.started = True
+        self._seq += 1
+        slot.req = req
+        slot.state = "prefill"
+        slot.owner = owner
+        slot.prompt = prefill
+        slot.done = 0
+        slot.pos = base
+        slot.history = history
+        slot.generated = []
+        slot.pending = None
+        slot.t_last = time.perf_counter()
+        slot.admit_seq = self._seq
+        if req.session is not None:
+            sess = self._sessions.get(req.session)
+            if sess is None:
+                sess = self._sessions[req.session] = _Session(
+                    req.session, owner)
+            sess.busy = True
+        self.metrics.count(self.name, "sequences_total")
+        self._sync_table(slot)
+        return True
+
+    def _evict_lru_session(self, keep=None):
+        """Reclaim the least-recently-used idle parked session's pages
+        (cache pressure).  Returns True when one was evicted."""
+        with self._cond:
+            idle = [s for s in self._sessions.values()
+                    if not s.busy and s.sid != keep]
+            if not idle:
+                return False
+            victim = min(idle, key=lambda s: s.last_used)
+            del self._sessions[victim.sid]
+        self.alloc.free(victim.owner)
+        return True
+
+    def _resume_missing(self, req):
+        """resume=True but the session is gone (TTL/restart/preempt):
+        reject typed.  Returns True when the request was rejected."""
+        if req.resume:
+            self.metrics.count(self.name, "sessions_reset_total")
+            req.future.set_exception(SessionResetError(
+                "session %r is not held by this replica (restarted or "
+                "expired); restart generation" % (req.session,)))
+            return True
+        return False
+
+    def _sync_table(self, slot):
+        row = self.alloc.pages(slot.owner)
+        self._tables[slot.idx, :] = 0
+        if row:
+            self._tables[slot.idx, :len(row)] = row
+        self._tables_dev = None  # invalidate the device copy
+
+    def _tables_device(self):
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables)
+        return self._tables_dev
+
+    def _ensure_pages(self, slot, tokens_ahead):
+        """Grow the slot's page list to cover ``tokens_ahead`` more cache
+        positions; preempts the youngest other sequence on exhaustion.
+        Returns False when the SLOT ITSELF was failed (nothing fits)."""
+        need = (pages_for(slot.pos + tokens_ahead, self.page_size)
+                - len(self.alloc.pages(slot.owner)))
+        while need > 0:
+            try:
+                self.alloc.alloc(slot.owner, need)
+                self._sync_table(slot)
+                return True
+            except CacheOOM:
+                victim = self._preempt_victim(exclude=slot)
+                if victim is None:
+                    self._fail_slot(slot, ServingError(
+                        "kv cache too small for this sequence (%d pages "
+                        "total)" % (self.alloc.total_pages - 1,)))
+                    return False
+                self._preempt(victim)
+            except Exception as e:
+                # injected kvcache.alloc fault (or a real allocator bug):
+                # fail only this sequence, keep the engine serving
+                self._fail_slot(slot, e if isinstance(e, ServingError)
+                                else ServingError(
+                                    "kv page allocation failed: %r" % (e,)))
+                return False
+        self._sync_table(slot)
+        return True
+
+    def _preempt_victim(self, exclude):
+        victim = None
+        for s in self._slots:
+            if s.active and s is not exclude:
+                if victim is None or s.admit_seq > victim.admit_seq:
+                    victim = s
+        return victim
+
+    def _preempt(self, slot):
+        """vLLM recompute eviction: free the slot's pages, requeue the
+        request at the head with its emitted tokens folded into the
+        prompt (the continuation decodes on, nothing is lost)."""
+        req = slot.req
+        recompute = list(slot.history) + slot.prompt[slot.done:]
+        if slot.state == "decode" and slot.pending is not None:
+            recompute.append(slot.pending)
+        new = _Request(recompute, req.max_new, req.deadline, req.session,
+                       False)
+        new.future = req.future
+        new.started = req.started
+        new.t_enqueue = req.t_enqueue
+        new.prefix = req.prefix + slot.generated
+        new.ttft_recorded = req.ttft_recorded
+        new.prompt_tokens = req.prompt_tokens
+        self.alloc.free(slot.owner)
+        if req.session is not None:
+            # the parked context is gone with the pages; the requeued
+            # request re-creates the session from the full history
+            self._sessions.pop(req.session, None)
+        self._clear(slot)
+        with self._cond:
+            self._queue.appendleft(new)
+        self.metrics.count(self.name, "preemptions_total")
+
+    # -- prefill ----------------------------------------------------------
+    def _prefill_phase(self):
+        """Advance EVERY prefill-state slot one chunk (round-robin
+        start).  Per engine step, decode therefore stalls for at most
+        one bounded chunk per admitted-but-not-ready slot — a long
+        prompt still cannot monopolize the engine."""
+        order = [self._slots[(self._prefill_rr + i) % self.slots]
+                 for i in range(self.slots)]
+        pending = [s for s in order if s.state == "prefill"]
+        if pending:
+            self._prefill_rr = (pending[0].idx + 1) % self.slots
+        for slot in pending:
+            if slot.state == "prefill":  # peers may preempt it mid-loop
+                self._prefill_chunk_step(slot)
+
+    def _prefill_chunk_step(self, slot):
+        now = time.perf_counter()
+        if slot.req.expired(now):
+            self._finish(slot, "deadline")
+            return
+        n = min(self.prefill_chunk, len(slot.prompt) - slot.done)
+        if not self._ensure_pages(slot, n):
+            return
+        chunk = slot.prompt[slot.done:slot.done + n]
+        padded = onp.zeros(self.prefill_chunk, onp.int32)
+        padded[:n] = chunk
+        row = jnp.asarray(self._tables[slot.idx])
+        self._kp, self._vp, next_tok, _ = self._prefill_fn(
+            self.params, self._kp, self._vp, jnp.asarray(padded),
+            jnp.int32(slot.pos), jnp.int32(n), row)
+        slot.history.extend(chunk)
+        slot.pos += n
+        slot.done += n
+        self.metrics.count(self.name, "prefill_tokens_total", n)
+        if slot.done < len(slot.prompt):
+            return
+        # prompt fully cached: the prefill's last logits ARE the first
+        # generated token — time-to-first-token lands here
+        tok = int(next_tok)
+        now = time.perf_counter()
+        if not slot.req.ttft_recorded:
+            self.metrics.observe_ttft(self.name, now - slot.req.t_enqueue)
+            slot.req.ttft_recorded = True
+        slot.generated.append(tok)
+        slot.pending = tok
+        slot.state = "decode"
+        slot.t_last = now
+        self._maybe_finish(slot, now)
+
+    # -- decode -----------------------------------------------------------
+    def _decode(self):
+        batch = [s for s in self._slots if s.state == "decode"]
+        if not batch:
+            return
+        try:
+            faults.check("decode.step")
+        except Exception as e:
+            # a decode-step fault poisons the in-flight decode batch
+            # (typed), frees its pages, and the engine keeps serving —
+            # prefills and fresh admissions are unaffected
+            for s in batch:
+                self._fail_slot(s, ServingError(
+                    "decode step failed: %r" % (e,)))
+            return
+        live = []
+        for s in batch:
+            if s.req.expired(time.perf_counter()):
+                self._finish(s, "deadline")
+            elif self._ensure_pages(s, 1):
+                if s.state == "decode":  # _ensure_pages may preempt peers
+                    live.append(s)
+        live = [s for s in live if s.state == "decode"]
+        if not live:
+            return
+        tokens = onp.zeros(self.slots, onp.int32)
+        positions = onp.zeros(self.slots, onp.int32)
+        active = onp.zeros(self.slots, bool)
+        for s in live:
+            tokens[s.idx] = s.pending
+            positions[s.idx] = s.pos
+            active[s.idx] = True
+        t0 = time.perf_counter()
+        self._kp, self._vp, next_tokens, _ = self._decode_fn(
+            self.params, self._kp, self._vp, jnp.asarray(tokens),
+            jnp.asarray(positions), self._tables_device(),
+            jnp.asarray(active))
+        next_tokens = onp.asarray(next_tokens)
+        now = time.perf_counter()
+        for s in live:
+            tok = int(next_tokens[s.idx])
+            s.history.append(s.pending)
+            s.pos += 1
+            s.generated.append(tok)
+            s.pending = tok
+            self.metrics.observe_inter_token(self.name, now - s.t_last)
+            s.t_last = now
+            self._maybe_finish(s, now)
+        self.metrics.observe_decode_step(
+            self.name, now - t0, now - t0, len(live), self.slots,
+            len(live))
+
+    # -- completion -------------------------------------------------------
+    def _maybe_finish(self, slot, now):
+        req = slot.req
+        if self.eos_id is not None and slot.pending == self.eos_id:
+            self._finish(slot, "eos")
+        elif len(slot.generated) + len(req.prefix) >= req.max_new:
+            self._finish(slot, "length")
+        elif req.expired(now):
+            self._finish(slot, "deadline")
+
+    def _finish(self, slot, reason):
+        req = slot.req
+        tokens = req.prefix + slot.generated
+        now = time.perf_counter()
+        if req.session is not None:
+            sess = self._sessions.get(req.session)
+            if sess is None:
+                sess = self._sessions[req.session] = _Session(
+                    req.session, slot.owner)
+            sess.owner = slot.owner
+            sess.pos = slot.pos
+            sess.pending = slot.pending
+            sess.history = list(slot.history)
+            sess.busy = False
+            sess.last_used = time.monotonic()
+        else:
+            self.alloc.free(slot.owner)
+        self.metrics.count(self.name, "sequences_completed_total")
+        self.metrics.observe_generate_done(self.name, now - req.t_enqueue)
+        self._clear(slot)
+        req.future.set_result({
+            "tokens": tokens,
+            "finish_reason": reason,
+            "session": req.session,
+            "prompt_tokens": req.prompt_tokens,
+            "completion_tokens": len(tokens),
+        })
+        with self._cond:
+            self._cond.notify_all()
+
+    def _fail_slot(self, slot, exc):
+        req = slot.req
+        self.alloc.free(slot.owner)
+        if req.session is not None:
+            self._sessions.pop(req.session, None)
+        self.metrics.count(self.name, "errors_total")
+        self._clear(slot)
+        req.future.set_exception(exc)
+
+    def _clear(self, slot):
+        slot.req = None
+        slot.state = "idle"
+        slot.owner = None
+        slot.generated = []
+        slot.history = []
+        slot.pending = None
+        self._tables[slot.idx, :] = 0
+        self._tables_dev = None
+
+    # -- lifecycle / stats ------------------------------------------------
+    def warmup(self):
+        """Compile the prefill + decode programs now (dummy inputs
+        against the scratch page) so the first client request never pays
+        XLA compile; with ``MXNET_COMPILE_CACHE_DIR`` set these become
+        cache reads on replica restart, like the registry's bucket
+        warmup."""
+        import jax
+        zrow = jnp.zeros(self.pages_per_seq, jnp.int32)
+        self._kp, self._vp, tok, _ = self._prefill_fn(
+            self.params, self._kp, self._vp,
+            jnp.zeros(self.prefill_chunk, jnp.int32), jnp.int32(0),
+            jnp.int32(1), zrow)
+        self._kp, self._vp, toks, _ = self._decode_fn(
+            self.params, self._kp, self._vp,
+            jnp.zeros(self.slots, jnp.int32),
+            jnp.zeros(self.slots, jnp.int32),
+            jnp.zeros((self.slots, self.pages_per_seq), jnp.int32),
+            jnp.zeros(self.slots, bool))
+        jax.block_until_ready(toks)
+        return 2
+
+    def drain(self, timeout=30.0):
+        return self.stop(drain=True, timeout=timeout)
+
+    def stop(self, drain=True, timeout=30.0):
+        """Stop admissions; ``drain=True`` serves everything queued and
+        in flight first.  Parked sessions are released either way (their
+        pages return to the pool — occupancy ends at zero)."""
+        with self._cond:
+            self._stopping = True
+            self._drain_mode = bool(drain)
+            if not drain:
+                for r in self._queue:
+                    r.future.set_exception(ServerClosedError(
+                        "decode engine stopped before this request ran"))
+                self._queue.clear()
+                for s in self._slots:
+                    if s.active:
+                        s.req.future.set_exception(ServerClosedError(
+                            "decode engine stopped mid-generation"))
+                        self.alloc.free(s.owner)
+                        self._clear(s)
+            self._cond.notify_all()
+            worker = self._worker
+        ok = True
+        if worker is not None:
+            worker.join(timeout)
+            ok = not worker.is_alive()
+        with self._cond:
+            for sess in self._sessions.values():
+                self.alloc.free(sess.owner)
+            self._sessions.clear()
+        return ok
+
+    def stats(self):
+        with self._cond:
+            active = sum(1 for s in self._slots if s.active)
+            queued = len(self._queue)
+            sessions = len(self._sessions)
+        out = {"slots": self.slots, "active": active, "queued": queued,
+               "sessions": sessions, "steps": self.steps,
+               "static_batching": self.static_batching,
+               "page_size": self.page_size,
+               "pages_per_seq": self.pages_per_seq,
+               "prefill_chunk": self.prefill_chunk,
+               "max_ctx": self.max_ctx,
+               "kv": self.alloc.stats()}
+        return out

@@ -1,0 +1,162 @@
+"""Page-granular KV-cache allocator for continuous-batching decode.
+
+The vLLM memory model at the serving layer: the device-side KV cache is
+a fixed pool of ``total_pages`` pages of ``page_size`` tokens each
+(``ops/pallas/paged_attention.py`` owns the device layout and the
+attention over it); this module owns the HOST-side bookkeeping —
+
+- a LIFO **free list** (freed pages are re-used hottest-first),
+- per-owner **page lists** (the sequence's page table, in allocation
+  order == token order),
+- exact **occupancy accounting** (used/total, peak, alloc/free/fail
+  counters) — the admission-control signal and the serving metric.
+
+Page 0 is reserved as the *scratch page*: inactive batch slots and
+padded prefill tokens scatter their (garbage) KV there, so the decode
+step never needs a dynamic shape or a host round-trip to mask writes.
+It is excluded from the free list and from occupancy math.
+
+Fault site ``kvcache.alloc`` (``mxnet_tpu.faults``) trips inside
+:meth:`PageAllocator.alloc`, so chaos tests can fail allocations
+deterministically; genuine exhaustion raises :class:`CacheOOM`, which
+the decode engine turns into preemption (evict-youngest + recompute)
+rather than an error.
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import faults
+
+__all__ = ["CacheOOM", "PageAllocator", "pages_for"]
+
+#: page id reserved for garbage writes from inactive/padded batch rows
+SCRATCH_PAGE = 0
+
+
+class CacheOOM(RuntimeError):
+    """The free list cannot satisfy an allocation.  Internal to the
+    decode engine: the scheduler responds by preempting (or, with
+    nothing to preempt, failing the request typed) — callers outside
+    the engine never see this."""
+
+
+def pages_for(tokens, page_size):
+    """Pages needed to hold ``tokens`` cache slots."""
+    return -(-int(tokens) // int(page_size))
+
+
+class PageAllocator:
+    """Thread-safe free-list allocator over a fixed page pool.
+
+    ``total_pages`` counts the scratch page, mirroring the device
+    arrays' leading page dimension; capacity available to sequences is
+    ``total_pages - 1``.
+    """
+
+    def __init__(self, total_pages, page_size):
+        if total_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the scratch page)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.total_pages = int(total_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        # LIFO: freshly freed pages go back out first (warm reuse)
+        self._free = list(range(self.total_pages - 1, SCRATCH_PAGE, -1))
+        self._owned = {}   # owner -> [page, ...] in allocation order
+        self.peak_used = 0
+        self.counters = {"allocs": 0, "frees": 0, "failed_allocs": 0}
+
+    # -- allocation -------------------------------------------------------
+    def alloc(self, owner, n=1):
+        """Append ``n`` pages to ``owner``'s page list; returns the new
+        pages.  Raises :class:`CacheOOM` when the free list is short
+        (nothing is partially allocated), and whatever the
+        ``kvcache.alloc`` fault site injects."""
+        n = int(n)
+        if n <= 0:
+            return []
+        faults.check("kvcache.alloc")
+        with self._lock:
+            if len(self._free) < n:
+                self.counters["failed_allocs"] += 1
+                raise CacheOOM(
+                    "kv cache exhausted: want %d page(s), %d free of %d"
+                    % (n, len(self._free), self.total_pages - 1))
+            pages = [self._free.pop() for _ in range(n)]
+            self._owned.setdefault(owner, []).extend(pages)
+            self.counters["allocs"] += n
+            self.peak_used = max(self.peak_used, self._used_locked())
+            return pages
+
+    def free(self, owner):
+        """Return ALL of ``owner``'s pages to the free list (eviction,
+        EOS, drain).  Returns the number freed; unknown owners free 0
+        (idempotent — a preempted slot may race its own completion)."""
+        with self._lock:
+            pages = self._owned.pop(owner, None)
+            if not pages:
+                return 0
+            # reversed: LIFO free list re-issues the owner's last pages
+            # first, keeping page ids dense for the next sequence
+            self._free.extend(reversed(pages))
+            self.counters["frees"] += len(pages)
+            return len(pages)
+
+    def pages(self, owner):
+        """The owner's page list (copy), allocation order == token order."""
+        with self._lock:
+            return list(self._owned.get(owner, ()))
+
+    # -- accounting -------------------------------------------------------
+    def _used_locked(self):
+        return (self.total_pages - 1) - len(self._free)
+
+    @property
+    def num_free(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def num_used(self):
+        with self._lock:
+            return self._used_locked()
+
+    def occupancy(self):
+        """Used fraction of the allocatable pool (scratch page excluded)."""
+        with self._lock:
+            cap = self.total_pages - 1
+            return self._used_locked() / cap if cap else 0.0
+
+    def owners(self):
+        with self._lock:
+            return sorted(self._owned, key=str)
+
+    def check_leaks(self):
+        """Invariant check for tests: every page is exactly once in the
+        free list or an owner list.  Returns the owner count."""
+        with self._lock:
+            held = [p for pages in self._owned.values() for p in pages]
+            seen = set(held) | set(self._free)
+            assert len(held) + len(self._free) == self.total_pages - 1, (
+                "page leak: %d held + %d free != %d allocatable"
+                % (len(held), len(self._free), self.total_pages - 1))
+            assert len(seen) == self.total_pages - 1, "duplicate page ids"
+            assert SCRATCH_PAGE not in seen, "scratch page escaped"
+            return len(self._owned)
+
+    def stats(self):
+        with self._lock:
+            cap = self.total_pages - 1
+            used = self._used_locked()
+            return {
+                "page_size": self.page_size,
+                "total_pages": cap,
+                "used_pages": used,
+                "free_pages": len(self._free),
+                "occupancy": round(used / cap, 4) if cap else 0.0,
+                "peak_used_pages": self.peak_used,
+                "owners": len(self._owned),
+                "counters": dict(self.counters),
+            }

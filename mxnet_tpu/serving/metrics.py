@@ -72,7 +72,12 @@ class ModelMetrics:
 
     COUNTERS = ("requests_total", "responses_total", "shed_total",
                 "deadline_expired_total", "errors_total", "batches_total",
-                "items_total", "bucket_slots_total")
+                "items_total", "bucket_slots_total",
+                # generation (continuous-batching decode engine)
+                "tokens_generated_total", "prefill_tokens_total",
+                "sequences_total", "sequences_completed_total",
+                "decode_steps_total", "decode_slot_steps_total",
+                "preemptions_total", "sessions_reset_total")
 
     def __init__(self):
         self.counters = dict.fromkeys(self.COUNTERS, 0)
@@ -80,11 +85,21 @@ class ModelMetrics:
         self.device = LatencyHistogram()       # model execution per batch
         self.total = LatencyHistogram()        # submit -> response
         self.batch_size = LatencyHistogram()   # items per dispatched batch
+        # generation-path histograms (empty unless a DecodeEngine serves
+        # this model): TTFT = submit -> first generated token; inter-token
+        # = gap between consecutive tokens of one sequence; decode_step =
+        # device time of one whole-batch decode step
+        self.ttft = LatencyHistogram()
+        self.inter_token = LatencyHistogram()
+        self.decode_step = LatencyHistogram()
+        self.kv_cache = {"used_pages": 0, "total_pages": 0,
+                         "peak_used_pages": 0}
+        self.tokens_per_s = 0.0  # EMA over decode steps
 
     def snapshot(self):
         items = self.counters["items_total"]
         slots = self.counters["bucket_slots_total"]
-        return {
+        out = {
             "counters": dict(self.counters),
             "batch_occupancy": round(items / slots, 4) if slots else None,
             "queue_wait": self.queue_wait.snapshot(),
@@ -92,6 +107,26 @@ class ModelMetrics:
             "total": self.total.snapshot(),
             "batch_size": self.batch_size.snapshot(),
         }
+        steps = self.counters["decode_steps_total"]
+        if steps or self.counters["sequences_total"]:
+            total = self.kv_cache["total_pages"]
+            slot_steps = self.counters["decode_slot_steps_total"]
+            out["generate"] = {
+                "ttft": self.ttft.snapshot(),
+                "inter_token": self.inter_token.snapshot(),
+                "decode_step": self.decode_step.snapshot(),
+                "tokens_per_s": round(self.tokens_per_s, 2),
+                # fraction of dispatched decode-slot work that produced a
+                # real token — the continuous-batching win over static
+                "decode_occupancy": (round(
+                    self.counters["tokens_generated_total"]
+                    / slot_steps, 4) if slot_steps else None),
+                "kv_occupancy": (round(
+                    self.kv_cache["used_pages"] / total, 4)
+                    if total else None),
+                "kv_cache": dict(self.kv_cache),
+            }
+        return out
 
 
 class ServingMetrics:
@@ -148,6 +183,54 @@ class ServingMetrics:
             m.counters["responses_total"] += 1
             m.queue_wait.observe(queue_wait_s)
             m.total.observe(total_s)
+
+    # -- generation (continuous-batching decode engine) -------------------
+    def observe_generate_done(self, name, total_s):
+        """One completed generation (queue-wait is folded into TTFT, so
+        only the end-to-end latency histogram is fed here)."""
+        with self._lock:
+            m = self._model(name)
+            m.counters["responses_total"] += 1
+            m.total.observe(total_s)
+
+    def observe_ttft(self, name, ttft_s):
+        with self._lock:
+            self._model(name).ttft.observe(ttft_s)
+        profiler.record_counter("serving::%s::ttft" % name,
+                                ttft_ms=ttft_s * 1e3)
+
+    def observe_inter_token(self, name, gap_s):
+        with self._lock:
+            self._model(name).inter_token.observe(gap_s)
+
+    def observe_decode_step(self, name, device_s, wall_s, active, slots,
+                            new_tokens):
+        """One whole-batch decode step: ``active`` of ``slots`` decode
+        slots produced ``new_tokens`` tokens in ``device_s`` seconds."""
+        with self._lock:
+            m = self._model(name)
+            m.counters["decode_steps_total"] += 1
+            m.counters["decode_slot_steps_total"] += slots
+            m.counters["tokens_generated_total"] += new_tokens
+            m.decode_step.observe(device_s)
+            rate = new_tokens / max(wall_s, 1e-9)
+            m.tokens_per_s = (rate if m.tokens_per_s == 0.0
+                              else 0.9 * m.tokens_per_s + 0.1 * rate)
+        if profiler._AGG["enabled"]:
+            profiler.record_op_stat("serving::%s::decode_step" % name,
+                                    device_s)
+        profiler.record_counter("serving::%s::decode" % name,
+                                active=active, tokens=new_tokens)
+
+    def observe_kv_cache(self, name, used_pages, total_pages):
+        with self._lock:
+            kv = self._model(name).kv_cache
+            kv["used_pages"] = int(used_pages)
+            kv["total_pages"] = int(total_pages)
+            kv["peak_used_pages"] = max(kv["peak_used_pages"],
+                                        int(used_pages))
+        profiler.record_counter("serving::%s::kv_cache" % name,
+                                used_pages=used_pages)
 
     def snapshot(self):
         """Scrapeable stats: {model: {counters, batch_occupancy,

@@ -16,6 +16,12 @@ The spec file is JSON::
                  "max_batch_size": 8, "buckets": [1, 4, 8]}, ...],
      "flush_ms": 5.0, "max_queue_depth": 256}
 
+A model spec may instead carry ``"generate": {...}`` (DecodeEngine
+kwargs: ``slots``, ``page_size``, ``prefill_chunk``, ``eos_id``, ...):
+the builder's model is then served as an LLM decode engine on
+``/v1/models/<name>:generate`` (e.g. builder
+``mxnet_tpu.models.decoder:decoder_tiny_lm``).
+
 Models are named by importable *builder path*, never shipped as code —
 only callables already on this process's PYTHONPATH can load (the
 restricted-unpickler stance, applied to serving).
@@ -118,14 +124,25 @@ def main(argv=None):
     cache = maybe_enable_compile_cache()
     registry = ModelRegistry()
     t0 = time.monotonic()
+    generators = []  # (name, model, DecodeEngine kwargs)
     for mspec in spec.get("models", ()):
-        load_model_spec(registry, mspec)
+        if mspec.get("generate") is not None:
+            from .registry import resolve_builder
+            builder = resolve_builder(mspec["builder"])
+            model = builder(**(mspec.get("kwargs") or {}))
+            generators.append((mspec["name"], model,
+                               dict(mspec["generate"])))
+        else:
+            load_model_spec(registry, mspec)
     warm_s = time.monotonic() - t0
 
     server = ModelServer(
         registry, host=args.host, port=args.port, admin=True,
         flush_ms=float(spec.get("flush_ms", 5.0)),
         max_queue_depth=int(spec.get("max_queue_depth", 256)))
+    for name, model, genkw in generators:
+        from .generate import DecodeEngine
+        server.attach_engine(name, DecodeEngine(model, name=name, **genkw))
     server.start()
     print("REPLICA_READY id=%s port=%d warm_s=%.2f cache=%s"
           % (args.id, server.port, warm_s, cache or "off"), flush=True)

@@ -66,6 +66,20 @@ _VNODES = 64          # ring points per replica (consistent hashing)
 _BACKOFF_CAP = 30.0   # max eject-probe backoff, in multiples of the base
 
 
+def _key_hash(key):
+    """Ring-point hash for affinity keys and vnodes: crc32 + the
+    murmur3 fmix32 finalizer.  Bare crc32 has no avalanche — sequential
+    keys ("session-1", "session-2", ...) land on the same ring arc and
+    pile onto one replica; the finalizer spreads single-bit input
+    deltas over all 32 output bits."""
+    h = zlib.crc32(str(key).encode()) & 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    return h ^ (h >> 16)
+
+
 def _addr_of(spec):
     """'host:port' | (host, port) -> (host, int(port))."""
     if isinstance(spec, str):
@@ -186,7 +200,7 @@ class Router:
                 return self._replicas[r.rid]
             self._replicas[r.rid] = r
             for v in range(_VNODES):
-                point = zlib.crc32(("%s#%d" % (r.rid, v)).encode())
+                point = _key_hash("%s#%d" % (r.rid, v))
                 bisect.insort(self._ring, (point, r.rid))
         return r
 
@@ -226,7 +240,7 @@ class Router:
                 return None
             if self.policy == "hash" and affinity_key is not None:
                 ok = {r.rid for r in live}
-                h = zlib.crc32(str(affinity_key).encode())
+                h = _key_hash(affinity_key)
                 i = bisect.bisect_left(self._ring, (h, ""))
                 for j in range(len(self._ring)):  # walk past dead owners
                     rid = self._ring[(i + j) % len(self._ring)][1]
@@ -337,7 +351,8 @@ class Router:
     def _forward(self, r, method, path, body, timeout):
         conns = self._conns()
         conn = conns.get(r.rid)
-        if conn is None:
+        fresh = conn is None
+        if fresh:
             conn = conns[r.rid] = http.client.HTTPConnection(
                 r.host, r.port, timeout=timeout)
         conn.timeout = timeout
@@ -345,7 +360,20 @@ class Router:
             conn.sock.settimeout(timeout)
         headers = ({"Content-Type": "application/json"}
                    if body is not None else {})
-        conn.request(method, path, body=body, headers=headers)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+        except (BrokenPipeError, ConnectionResetError,
+                http.client.CannotSendRequest):
+            if fresh:
+                raise
+            # stale pooled keep-alive (the replica restarted between
+            # requests): the send failed, so the replica never saw this
+            # request — one clean retry on a fresh connection is safe
+            # even for non-idempotent requests
+            self._drop_conn(r.rid)
+            conn = conns[r.rid] = http.client.HTTPConnection(
+                r.host, r.port, timeout=timeout)
+            conn.request(method, path, body=body, headers=headers)
         resp = conn.getresponse()
         data = resp.read()
         try:
@@ -491,7 +519,7 @@ class Router:
 
 
 _PREDICT_RE = re.compile(
-    r"^/v1/models/[^/:]+(?:/versions/\d+)?:predict$")
+    r"^/v1/models/[^/:]+(?:/versions/\d+)?:(?:predict|generate)$")
 
 
 class RouterServer:
@@ -630,8 +658,14 @@ class RouterServer:
                 body = json.loads(raw_body.decode() or "{}")
                 if body.get("deadline_ms") is not None:
                     deadline_s = float(body["deadline_ms"]) / 1e3 + 1.0
-                affinity_key = body.get("affinity_key")
-                idempotent = bool(body.get("idempotent", True))
+                # sticky decode sessions: the session id doubles as the
+                # consistent-hash affinity key (and a session-carrying
+                # generate is non-idempotent by default — replaying a
+                # reply-phase loss would double-advance the session)
+                affinity_key = (body.get("affinity_key")
+                                or body.get("session"))
+                idempotent = bool(body.get(
+                    "idempotent", body.get("session") is None))
             except (ValueError, TypeError):
                 pass  # the replica rejects malformed JSON with a 400
         return self.router.dispatch(

@@ -45,6 +45,7 @@ __all__ = ["ModelServer"]
 
 _PREDICT_RE = re.compile(
     r"^/v1/models/(?P<name>[^/:]+)(?:/versions/(?P<version>\d+))?:predict$")
+_GENERATE_RE = re.compile(r"^/v1/models/(?P<name>[^/:]+):generate$")
 _MODEL_RE = re.compile(r"^/v1/models/(?P<name>[^/:]+)$")
 
 
@@ -74,6 +75,20 @@ class ModelServer:
         self._port = int(port)
         self._httpd = None
         self._thread = None
+
+    # -- generation -------------------------------------------------------
+    def attach_engine(self, name, engine):
+        """Serve ``engine`` (a :class:`~.generate.DecodeEngine`) as
+        ``name``'s generation path (``POST /v1/models/<name>:generate``
+        and ``/v1/generate``).  The engine joins this server's metrics
+        and drain lifecycle; the LM itself is listed in the registry so
+        ``/v1/models`` shows what this replica serves."""
+        if name not in self.registry:
+            self.registry.load(name, engine.model, item_shape=None,
+                               dtype="int32", warmup=False)
+        engine.name = name
+        engine.warmup()  # compile prefill/decode before taking traffic
+        return self.batcher.register_engine(name, engine)
 
     # -- lifecycle --------------------------------------------------------
     @property
@@ -166,7 +181,12 @@ class ModelServer:
         if path == "/v1/models":
             return 200, {"models": self.registry.models()}
         if path in ("/v1/stats", "/stats"):
-            return 200, self.metrics.snapshot()
+            snap = self.metrics.snapshot()
+            engines = {name: e.stats()
+                       for name, e in self.batcher._engines.items()}
+            if engines:
+                snap["generators"] = engines
+            return 200, snap
         if path == "/metrics":
             return 200, {"text": self._prometheus_text()}
         m = _MODEL_RE.match(path)
@@ -180,6 +200,10 @@ class ModelServer:
     def _handle_post(self, path, raw_body):
         if path.startswith("/v1/admin/"):
             return self._handle_admin(path, raw_body)
+        m = _GENERATE_RE.match(path)
+        if m or path == "/v1/generate":
+            return self._handle_generate(m.group("name") if m else None,
+                                         raw_body)
         m = _PREDICT_RE.match(path)
         if not m:
             raise ModelNotFoundError("no route %r" % (path,))
@@ -211,6 +235,48 @@ class ModelServer:
         served = self.registry.get(name, version)
         return 200, {"predictions": preds, "model": name,
                      "version": served.version}
+
+    def _handle_generate(self, name, raw_body):
+        """``POST /v1/models/<name>:generate`` (or ``/v1/generate`` with
+        ``"model"`` in the body): autoregressive generation through the
+        model's continuous-batching decode engine.
+
+        Body: ``{"prompt": [token ids], "max_tokens": n,
+        "deadline_ms": opt, "session": opt id, "resume": opt bool}``.
+        ``session`` parks the KV pages for a follow-up call (pass the
+        session as the router ``affinity_key`` so the fleet returns to
+        the replica that holds them); ``resume=true`` makes a missing
+        session a typed 409 ``session_reset`` instead of a silent
+        fresh start."""
+        try:
+            body = json.loads(raw_body.decode() or "{}")
+        except ValueError as e:
+            raise BadRequestError("invalid JSON body: %s" % (e,))
+        if name is None:
+            name = body.get("model")
+            if not name:
+                raise BadRequestError(
+                    '/v1/generate body must carry "model"')
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            raise BadRequestError(
+                'generate body must carry "prompt": [token ids]')
+        deadline_ms = body.get("deadline_ms")
+        future = self.batcher.submit_generate(
+            name, prompt,
+            max_new_tokens=body.get("max_tokens", 16),
+            deadline_ms=deadline_ms,
+            session=body.get("session"),
+            resume=bool(body.get("resume", False)))
+        timeout = (float(deadline_ms) / 1e3 + 1.0 if deadline_ms is not None
+                   else self.request_timeout_s)
+        try:
+            result = future.result(timeout=timeout)
+        except FutureTimeoutError:
+            raise DeadlineExceededError("no response within %.1fs" % timeout)
+        result = dict(result)
+        result["model"] = name
+        return 200, result
 
     def _handle_admin(self, path, raw_body):
         """Model hot-load plane (``admin=True`` servers only):
@@ -270,4 +336,17 @@ class ModelServer:
                         continue
                     lines.append("mxtpu_serving_%s_%s{%s} %g"
                                  % (hist, k, labels, v))
+            gen = stats.get("generate")
+            if gen:
+                for hist in ("ttft", "inter_token", "decode_step"):
+                    for k, v in sorted((gen.get(hist) or {}).items()):
+                        if k == "count":
+                            continue
+                        lines.append("mxtpu_serving_%s_%s{%s} %g"
+                                     % (hist, k, labels, v))
+                for gauge in ("tokens_per_s", "decode_occupancy",
+                              "kv_occupancy"):
+                    if gen.get(gauge) is not None:
+                        lines.append("mxtpu_serving_%s{%s} %g"
+                                     % (gauge, labels, gen[gauge]))
         return "\n".join(lines) + "\n"

@@ -27,6 +27,15 @@ Scenarios (--scenario):
            (4) a subsequent rolling model rollout (canary + drain one
            at a time) completes during traffic with zero dropped
            requests and the new version serving everywhere.
+  llm      LLM decode failover: N replicas serving a causal LM through
+           the continuous-batching decode engine (consistent-hash
+           session affinity); SIGKILL one mid-generation under
+           sustained decode traffic.  PASS when sessionless generations
+           never fail, every session failure is TYPED
+           (SessionResetError / explicit non-idempotent error — no
+           silent misroute to a replica without the KV pages), the
+           supervisor restores the fleet, fresh sessions work, and
+           router-level failures are zero.
 
 Usage:
   python tools/chaos.py                       # default spec, 2 workers
@@ -380,6 +389,245 @@ def scenario_fleet(args):
     return 0 if ok else 1
 
 
+def scenario_llm(args):
+    """SIGKILL a replica mid-generation under sustained continuous-
+    batching decode traffic (sessions pinned by consistent hash).
+
+    PASS conditions: (1) sessionless generations NEVER fail — they are
+    idempotent and the router fails them over; (2) every session-traffic
+    failure is TYPED (SessionResetError after the owner died, or the
+    router's explicit non-idempotent mid-request error) — never a silent
+    misroute to a replica without the KV pages; (3) the supervisor
+    restores the full replica count and fresh sessions work everywhere;
+    (4) zero router-level failures (FleetUnavailableError) — the fleet
+    always had someone to answer."""
+    import threading
+
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.errors import (FleetUnavailableError,
+                                          SessionResetError)
+
+    n = max(2, args.num_workers)
+    clients = 4
+    steady_s = 3.0
+
+    spec = {"models": [{"name": "llm",
+                        "builder":
+                            "mxnet_tpu.models.decoder:decoder_tiny_lm",
+                        "kwargs": {"seed": 0},
+                        # pool sized so parked sessions never hit the
+                        # LRU reclaim during the run: the drill tests
+                        # failover resets, not cache-pressure resets
+                        "generate": {"slots": 4, "page_size": 8,
+                                     "prefill_chunk": 8, "max_ctx": 64,
+                                     "total_pages": 513}}],
+            "max_queue_depth": 512}
+    fleet = serving.ServingFleet(
+        spec, replicas=n, policy="hash",
+        router_kwargs={"probe_ms": 50},
+        supervisor_kwargs={"restart_backoff_ms": 100,
+                           "startup_timeout_s": 300})
+    print("chaos-llm: starting %d LLM replicas (compiling decode "
+          "programs)" % n)
+    fleet.start()
+    ok = True
+    stop = threading.Event()
+    counters = {"ok": 0, "reset": 0, "typed_midflight": 0, "ctx_full": 0,
+                "router": 0, "other": 0}
+    lock = threading.Lock()
+
+    def bump(key):
+        with lock:
+            counters[key] += 1
+
+    def load_client(cid):
+        """Sustained decode traffic: sessionless generations (idempotent
+        — must never fail) interleaved with create+resume session
+        pairs (typed failures allowed only while the owner is dead)."""
+        cli = serving.ServingClient(*fleet.address, timeout=60, retries=0)
+        i = 0
+        epoch = [0, 0, 0, 0]
+        while not stop.is_set():
+            i += 1
+            # a bounded rotating session set: real clients re-use
+            # conversations, and start a fresh one when the context
+            # window fills (the typed BadRequest is that signal)
+            slot = i % 4
+            sid = "c%d-%d-e%d" % (cid, slot, epoch[slot])
+            try:
+                if i % 3:  # sessionless: failover makes these lossless
+                    cli.generate("llm", [cid + 1, 2, 3], max_tokens=6)
+                else:
+                    cli.generate("llm", [cid + 1, 2, 3], max_tokens=4,
+                                 session=sid)
+                    cli.generate("llm", [5], max_tokens=4, session=sid,
+                                 resume=True)
+                bump("ok")
+            except serving.BadRequestError as e:
+                if "max_ctx" in str(e):  # conversation full: rotate
+                    epoch[slot] += 1
+                    bump("ctx_full")
+                else:
+                    bump("other")
+                    print("chaos-llm: UNTYPED failure: %r" % (e,))
+            except SessionResetError:
+                bump("reset")
+            except FleetUnavailableError:
+                bump("router")
+                print("chaos-llm: ROUTER-LEVEL failure (must be zero)")
+            except serving.ServingError as e:
+                if "non-idempotent" in str(e):
+                    bump("typed_midflight")
+                else:
+                    bump("other")
+                    print("chaos-llm: UNTYPED failure: %r" % (e,))
+            except Exception as e:
+                bump("other")
+                print("chaos-llm: UNTYPED failure: %r" % (e,))
+        cli.close()
+
+    threads = [threading.Thread(target=load_client, args=(c,),
+                                daemon=True) for c in range(clients)]
+    try:
+        # park a known set of sessions BEFORE the kill: the victim's
+        # share must come back as typed SessionResetError on resume
+        warm_cli = serving.ServingClient(*fleet.address, timeout=60)
+        warm = ["warm-%d" % i for i in range(3 * n)]
+        for sid in warm:
+            warm_cli.generate("llm", [1, 2, 3], max_tokens=3, session=sid)
+
+        for t in threads:
+            t.start()
+        time.sleep(steady_s)
+        # kill a replica that actually HOLDS warm sessions, so the
+        # typed-reset path is provably exercised
+        import http.client as _http
+        import json as _json
+
+        def _session_count(port):
+            try:
+                c = _http.HTTPConnection("127.0.0.1", port, timeout=10)
+                c.request("GET", "/v1/stats")
+                doc = _json.loads(c.getresponse().read())
+                c.close()
+                return (doc.get("generators", {}).get("llm", {})
+                        .get("sessions", 0))
+            except Exception:
+                return 0
+
+        counts = [_session_count(r.port)
+                  for r in fleet.supervisor.replicas]
+        victim_idx = max(range(n), key=lambda i: counts[i])
+        victim = fleet.supervisor.kill(victim_idx, signal.SIGKILL)
+        print("chaos-llm: SIGKILL replica %s (held %d sessions) "
+              "mid-generation" % (victim.rid, counts[victim_idx]))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and \
+                fleet.supervisor.ready_count() < n:
+            time.sleep(0.2)
+        restored = fleet.supervisor.ready_count()
+        # let the router's probe loop re-admit the restarted replica so
+        # the consistent-hash ring is stable again before session checks
+        settle = time.monotonic() + 30
+        while time.monotonic() < settle:
+            states = fleet.router.states()
+            if all(s["state"] == "healthy" and s["ready"]
+                   for s in states.values()):
+                break
+            time.sleep(0.2)
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(60)
+
+        # resume every pre-kill session: survivors continue, the
+        # victim's sessions fail typed — and ONLY typed
+        resumed, resets, untyped = 0, 0, 0
+        for sid in warm:
+            for attempt in (0, 1):
+                try:
+                    warm_cli.generate("llm", [7], max_tokens=3,
+                                      session=sid, resume=True)
+                    resumed += 1
+                except SessionResetError:
+                    resets += 1
+                except serving.ServingError as e:
+                    # a typed mid-flight loss is the protocol answer for
+                    # an ambiguous non-idempotent failure; one re-resume
+                    # resolves it (reset or continue)
+                    if "non-idempotent" in str(e) and attempt == 0:
+                        continue
+                    untyped += 1
+                    print("chaos-llm: UNTYPED warm-resume failure: %r"
+                          % (e,))
+                except Exception as e:
+                    untyped += 1
+                    print("chaos-llm: UNTYPED warm-resume failure: %r"
+                          % (e,))
+                break
+        # fresh sessions after recovery must work everywhere
+        fresh_fail = 0
+        for i in range(2 * n):
+            for attempt in (0, 1):
+                try:
+                    sid = "fresh-%d-%d" % (i, attempt)
+                    warm_cli.generate("llm", [1, 2], max_tokens=3,
+                                      session=sid)
+                    warm_cli.generate("llm", [4], max_tokens=3,
+                                      session=sid, resume=True)
+                except SessionResetError:
+                    # ring-remap race while a replica's readiness
+                    # settles: the protocol answer is restart — one
+                    # retry must succeed on a stable ring
+                    if attempt == 0:
+                        continue
+                    fresh_fail += 1
+                    print("chaos-llm: fresh session FAILED after retry")
+                except Exception as e:
+                    fresh_fail += 1
+                    print("chaos-llm: fresh session FAILED: %r" % (e,))
+                break
+        warm_cli.close()
+
+        print("chaos-llm: load %s; warm resumes: %d ok, %d reset, %d "
+              "untyped; fresh failures: %d; replicas restored %d/%d"
+              % (counters, resumed, resets, untyped, fresh_fail,
+                 restored, n))
+        if counters["router"]:
+            print("FAIL: %d router-level failure(s)" % counters["router"])
+            ok = False
+        if counters["other"] or untyped:
+            print("FAIL: untyped failures under session traffic")
+            ok = False
+        if restored < n:
+            print("FAIL: supervisor restored %d/%d replicas"
+                  % (restored, n))
+            ok = False
+        if fresh_fail:
+            print("FAIL: %d fresh session(s) failed after recovery"
+                  % fresh_fail)
+            ok = False
+        if resets == 0:
+            print("FAIL: no warm session was reset — the kill tested "
+                  "nothing (victim held no sessions?)")
+            ok = False
+        if resumed == 0:
+            print("FAIL: every warm session reset — survivors lost "
+                  "state they should have kept")
+            ok = False
+        if not counters["ok"]:
+            print("FAIL: load generator completed no requests")
+            ok = False
+    finally:
+        stop.set()
+        fleet.stop()
+    print("chaos: %s" % ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -387,11 +635,14 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--scenario", default="faults",
-                    choices=["faults", "preempt", "fleet"],
+                    choices=["faults", "preempt", "fleet", "llm"],
                     help="faults = transport chaos (bit-identical check);"
                          " preempt = SIGTERM + relaunch + rejoin drill;"
                          " fleet = SIGKILL a serving replica under load"
-                         " + rolling rollout (-n = replica count)")
+                         " + rolling rollout (-n = replica count);"
+                         " llm = SIGKILL a replica under sustained"
+                         " continuous-batching decode traffic (typed"
+                         " session resets, lossless sessionless traffic)")
     ap.add_argument("--spec", default=DEFAULT_SPEC,
                     help="MXNET_FAULT_SPEC for the chaos run "
                          "(default: %(default)s)")
@@ -402,6 +653,8 @@ def main():
         return scenario_preempt(args)
     if args.scenario == "fleet":
         return scenario_fleet(args)
+    if args.scenario == "llm":
+        return scenario_llm(args)
 
     ok = True
     with tempfile.TemporaryDirectory(prefix="chaos-") as tmp:
