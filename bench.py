@@ -738,30 +738,42 @@ def bench_llm_decode():
     outs = [int(rng.randint(long_lo, long_hi + 1)) if rng.rand() < 0.2
             else int(rng.randint(4, 25)) for _ in range(n_req)]
 
-    def run(static):
-        eng = DecodeEngine(lm, name="llm", slots=slots, page_size=page,
-                           prefill_chunk=chunk, max_ctx=max_ctx,
-                           max_queue_depth=4 * n_req,
-                           static_batching=static)
-        eng.warmup()  # compile prefill+decode outside the window
-        t0 = time.perf_counter()
-        futs = [eng.submit(p, max_new_tokens=n)
-                for p, n in zip(prompts, outs)]
-        tokens = sum(len(f.result(timeout=1200)["tokens"]) for f in futs)
-        dt = time.perf_counter() - t0
-        snap = eng.metrics.snapshot()["models"]["llm"]
-        eng.stop()
-        assert eng.alloc.num_used == 0, "page leak after drain"
-        gen = snap["generate"]
-        return tokens / dt, {
-            "ttft_p50_ms": gen["ttft"].get("p50_ms"),
-            "ttft_p99_ms": gen["ttft"].get("p99_ms"),
-            "inter_token_p50_ms": gen["inter_token"].get("p50_ms"),
-            "inter_token_p99_ms": gen["inter_token"].get("p99_ms"),
-            "decode_occupancy": gen["decode_occupancy"],
-            "kv_peak_pages": gen["kv_cache"]["peak_used_pages"],
-            "kv_total_pages": gen["kv_cache"]["total_pages"],
-        }
+    def run(static, decode_fused=None):
+        if decode_fused is not None:
+            os.environ["MXNET_DECODE_FUSED"] = decode_fused
+        try:
+            eng = DecodeEngine(lm, name="llm", slots=slots,
+                               page_size=page, prefill_chunk=chunk,
+                               max_ctx=max_ctx,
+                               max_queue_depth=4 * n_req,
+                               static_batching=static)
+            eng.warmup()  # compile prefill+decode outside the window
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_new_tokens=n)
+                    for p, n in zip(prompts, outs)]
+            tokens = sum(len(f.result(timeout=1200)["tokens"])
+                         for f in futs)
+            dt = time.perf_counter() - t0
+            snap = eng.metrics.snapshot()["models"]["llm"]
+            launches = dict(eng.launch_stats)
+            fused_mode = eng.decode_fused_mode
+            eng.stop()
+            assert eng.alloc.num_used == 0, "page leak after drain"
+            gen = snap["generate"]
+            return tokens / dt, {
+                "ttft_p50_ms": gen["ttft"].get("p50_ms"),
+                "ttft_p99_ms": gen["ttft"].get("p99_ms"),
+                "inter_token_p50_ms": gen["inter_token"].get("p50_ms"),
+                "inter_token_p99_ms": gen["inter_token"].get("p99_ms"),
+                "decode_occupancy": gen["decode_occupancy"],
+                "kv_peak_pages": gen["kv_cache"]["peak_used_pages"],
+                "kv_total_pages": gen["kv_cache"]["total_pages"],
+                "decode_fused": fused_mode,
+                "decode_launches": launches,
+            }
+        finally:
+            if decode_fused is not None:
+                os.environ.pop("MXNET_DECODE_FUSED", None)
 
     # peak-of-2 per arm (the _best_window convention): the speedup is a
     # scheduling property, but each wall-clock sample is exposed to box
@@ -770,18 +782,42 @@ def bench_llm_decode():
                                key=lambda r: r[0])
     cont_tps, cont_m = max((run(static=False) for _ in range(2)),
                            key=lambda r: r[0])
+    # fused-decode A/B: on the bench chip the auto gate runs the
+    # persistent kernel, so compare inter-token latency against a
+    # forced-unfused arm; on CPU (auto = per-op path) record the STATIC
+    # launch census of both paths instead — counts are backend-exact
+    from mxnet_tpu.models import decoder as _dec
+    pps = (max_ctx + page - 1) // page
+    census_tower = _dec.decode_launch_stats(
+        lm.jax_params(), lm.config, page, slots, pps,
+        slots * pps + 1, fused=False)
+    census_fused = _dec.decode_launch_stats(
+        lm.jax_params(), lm.config, page, slots, pps,
+        slots * pps + 1, fused=True, mode="interpret")
+    assert census_fused["pallas_per_group"] <= 1, census_fused
+    unfused_m = None
+    if _on_tpu():
+        _tps_u, unfused_m = max((run(static=False, decode_fused="0")
+                                 for _ in range(2)), key=lambda r: r[0])
     extra = {"continuous": cont_m, "static_batch": static_m,
              "static_tokens_per_s": round(static_tps, 2),
              "speedup_vs_static": round(cont_tps / static_tps, 3),
              "requests": n_req, "slots": slots, "page_size": page,
              "prefill_chunk": chunk,
+             "decode_launches_tower": census_tower,
+             "decode_launches_fused": census_fused,
+             "continuous_unfused": unfused_m,
              "backend": jax.default_backend(),
              "notes": "mixed lengths: uniform prompts, heavy-tailed "
                       "outputs (80% short / 20% long), greedy decode; "
                       "identical kernels+workload both runs — the delta "
                       "is iteration-level scheduling.  Acceptance bar "
                       ">= 1.5x vs static on this box (CPU-honest; the "
-                      "bench chip runs the Pallas paged kernel)."}
+                      "bench chip runs the Pallas paged kernel).  "
+                      "decode_launches_*: static launches/step census "
+                      "(fused = one Pallas launch per layer group); "
+                      "continuous_unfused (chip only) is the "
+                      "inter-token A/B against the per-op tower."}
     return cont_tps, extra
 
 
@@ -1019,11 +1055,23 @@ def bench_bert_long():
 # ---------------------------------------------------------------------------
 # config 5: LSTM word LM (example/rnn medium config)
 # ---------------------------------------------------------------------------
-def bench_lstm_lm():
+def bench_lstm_lm_sample():
+    """ONE fresh-process sample of the LSTM word-LM row: fused-cell vs
+    scan A/B arms (same net, same data, separate traces), plus the
+    static launches/step census and the interpret-mode parity check
+    that back the CPU-honest fallback claim.
+
+    The fused arm's throughput is measured only where the Pallas kernel
+    actually compiles (accelerator backends); on CPU the arm reports
+    the census + parity instead of a meaningless interpreter timing.
+    """
     import mxnet_tpu as mx
     from mxnet_tpu import np as mxnp
     from mxnet_tpu.gluon import nn, rnn, HybridBlock
+    from mxnet_tpu.ops import rnn as oprnn
+    from mxnet_tpu.ops.pallas import fused_cell as _fc
     from mxnet_tpu.parallel import functionalize
+    import benchmark.steplat as steplat
 
     on_tpu = _on_tpu()
     vocab, emsize, nhid, nlayers = 10000, 650, 650, 2
@@ -1050,42 +1098,133 @@ def bench_lstm_lm():
     net.initialize(mx.init.Xavier())
     tokens = mxnp.random.randint(0, vocab, size=(B, T))
     net(tokens)
-    fn, params = functionalize(net, train=True)
-    # bf16 training (same methodology as bench_bert: the V100 baseline
-    # estimate is fp16-class cuDNN; bf16 is the TPU-idiomatic equivalent
-    # and needs no loss scaler)
-    pvals = {k: (p._data._data.astype(jnp.bfloat16)
-                 if p._data._data.dtype == jnp.float32 else p._data._data)
-             for k, p in params.items()}
     labels = jax.random.randint(jax.random.key(0), (B, T), 0, vocab)
-
-    def loss_fn(pv, tok, lab):
-        out, _aux = fn(pv, tok)
-        lp = jax.nn.log_softmax(out.astype(jnp.float32))
-        return -jnp.mean(jnp.take_along_axis(lp, lab[..., None], -1))
-
-    @jax.jit
-    def step(pv, tok, lab):
-        l, g = jax.value_and_grad(loss_fn)(pv, tok, lab)
-        return l, jax.tree.map(
-            lambda p, gg: p - 0.1 * gg.astype(p.dtype), pv, g)
-
     tok = tokens._data
-    l, pv = step(pvals, tok, labels)
-    jax.block_until_ready(l)
-    first = float(l)
 
-    def window():
-        nonlocal pv
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            l, pv = step(pv, tok, labels)
-        last = float(l)
-        dt = time.perf_counter() - t0
-        assert onp.isfinite(last) and last != first, (first, last)
-        return iters * B * T / dt
+    def run_arm(fused_env):
+        """Build a FRESH jitted train step under the given gate value
+        (the rnn fused gate is resolved at trace time)."""
+        os.environ["MXNET_RNN_FUSED_CELL"] = fused_env
+        try:
+            fn, params = functionalize(net, train=True)
+            # bf16 training (same methodology as bench_bert: the V100
+            # baseline estimate is fp16-class cuDNN; bf16 is the
+            # TPU-idiomatic equivalent and needs no loss scaler)
+            pvals = {k: (p._data._data.astype(jnp.bfloat16)
+                         if p._data._data.dtype == jnp.float32
+                         else p._data._data)
+                     for k, p in params.items()}
 
-    return _best_window(window)
+            def loss_fn(pv, tok, lab):
+                out, _aux = fn(pv, tok)
+                lp = jax.nn.log_softmax(out.astype(jnp.float32))
+                return -jnp.mean(jnp.take_along_axis(lp, lab[..., None],
+                                                     -1))
+
+            @jax.jit
+            def step(pv, tok, lab):
+                l, g = jax.value_and_grad(loss_fn)(pv, tok, lab)
+                return l, jax.tree.map(
+                    lambda p, gg: p - 0.1 * gg.astype(p.dtype), pv, g)
+
+            before = _fc.trace_counts["lstm_sequence"]
+            l, pv = step(pvals, tok, labels)
+            jax.block_until_ready(l)
+            first = float(l)
+            traced_fused = _fc.trace_counts["lstm_sequence"] - before
+
+            def window():
+                nonlocal pv
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    l, pv = step(pv, tok, labels)
+                last = float(l)
+                dt = time.perf_counter() - t0
+                assert onp.isfinite(last) and last != first, (first, last)
+                return iters * B * T / dt
+
+            return _best_window(window), traced_fused
+        finally:
+            os.environ.pop("MXNET_RNN_FUSED_CELL", None)
+
+    scan_tps, scan_traced = run_arm("0")
+    assert scan_traced == 0, "scan arm traced the fused kernel"
+    fused_tps = fused_traced = None
+    if on_tpu:
+        fused_tps, fused_traced = run_arm("")  # auto: Pallas on chip
+        assert fused_traced > 0, "fused arm did not trace the kernel"
+
+    # static launches/step census at the REAL config (trace-only; the
+    # count is identical for compiled and interpret kernels)
+    census = steplat.lstm_steplat(T=35, B=32, I=emsize, H=nhid,
+                                  L=nlayers, measure=False,
+                                  fused_mode="interpret")
+
+    # interpret-mode parity (small shapes: the CPU-honest green light)
+    xs, ps, h0s, c0s = (jax.random.normal(jax.random.key(9), (8, 2, 16)),
+                        jax.random.normal(
+                            jax.random.key(10),
+                            (oprnn.param_size("lstm", 16, 16, 2),)) * 0.2,
+                        jnp.zeros((2, 2, 16)), jnp.zeros((2, 2, 16)))
+    o_s, _, _ = oprnn.rnn_forward(xs, ps, h0s, c0s, "lstm", 16, 2,
+                                  fused=None)
+    o_f, _, _ = oprnn.rnn_forward(xs, ps, h0s, c0s, "lstm", 16, 2,
+                                  fused="interpret")
+    parity_err = float(jnp.abs(o_f - o_s).max())
+
+    value = fused_tps if fused_tps is not None else scan_tps
+    extra = {
+        "tokens_per_sec_scan": round(scan_tps, 2),
+        "tokens_per_sec_fused": (round(fused_tps, 2)
+                                 if fused_tps is not None else None),
+        "fused_speedup": (round(fused_tps / scan_tps, 3)
+                          if fused_tps is not None else None),
+        "fused_kernels_traced": fused_traced,
+        "launches_per_step_scan": census["scan"]["launches_per_step"],
+        "launches_per_step_fused": census["fused"]["launches_per_step"],
+        "fused_pallas_per_layer":
+            census["fused"]["pallas_total"] / nlayers,
+        "fused_parity_interpret_max_abs_err": parity_err,
+        "fused_parity_green": parity_err < 1e-4,
+        "backend": jax.default_backend(),
+    }
+    return value, extra
+
+
+def bench_lstm_lm(k=3):
+    """The committed lstm row: min/median/max over k fresh-SUBPROCESS
+    samples (each sample is its own backend/heap/trace — the 153-243k
+    tok/s band is tunnel variance, so a single sample cannot support a
+    step-change claim), with the fused-vs-scan A/B columns from the
+    median sample."""
+    samples = []
+    for _ in range(k):
+        res = _run_config_subprocess("lstm_sample")
+        res = res.get("lstm_lm_sample_tokens_per_sec", res)
+        if "error" in res:
+            raise RuntimeError("lstm sample failed: %s" % res["error"])
+        samples.append(res)
+    vals = sorted(s["value"] for s in samples)
+    med = samples[[s["value"] for s in samples].index(vals[len(vals) // 2])]
+    extra = {key: med.get(key) for key in (
+        "tokens_per_sec_scan", "tokens_per_sec_fused", "fused_speedup",
+        "fused_kernels_traced", "launches_per_step_scan",
+        "launches_per_step_fused", "fused_pallas_per_layer",
+        "fused_parity_interpret_max_abs_err", "fused_parity_green",
+        "backend")}
+    extra.update({
+        "samples_tokens_per_sec": [round(v, 2) for v in vals],
+        "tokens_per_sec_min": round(vals[0], 2),
+        "tokens_per_sec_median": round(vals[len(vals) // 2], 2),
+        "tokens_per_sec_max": round(vals[-1], 2),
+        "k": len(vals),
+        "notes": "each sample is a fresh subprocess (fresh backend + "
+                 "traces); value = median sample.  Fused arm measured "
+                 "on accelerator backends only — on CPU the row is "
+                 "scan-throughput + interpret parity + the static "
+                 "launches/step census (CPU-honest fallback).",
+    })
+    return vals[len(vals) // 2], extra
 
 
 # ---------------------------------------------------------------------------
@@ -1154,6 +1293,10 @@ BENCHES = [
      "tokens/s", bench_bert_long),
     ("lstm", "lstm_lm_train_tokens_per_sec_per_chip", "tokens/s",
      bench_lstm_lm),
+    # hidden: one fresh-process A/B sample, spawned k times by the lstm
+    # row's aggregator (never run directly by main())
+    ("lstm_sample", "lstm_lm_sample_tokens_per_sec", "tokens/s",
+     bench_lstm_lm_sample),
     ("resnet50_dp", "resnet50_dp_kvstore_ici_imgs_per_sec_per_chip", "img/s",
      bench_resnet50_dp_kvstore),
     ("lenet", "lenet_imperative_imgs_per_sec", "img/s", bench_lenet),
@@ -1173,6 +1316,10 @@ BENCHES = [
     ("llm_decode_serving", "llm_decode_serving_tokens_per_sec",
      "tokens/s", bench_llm_decode),
 ]
+
+#: rows main() never runs directly — subprocess samples owned by an
+#: aggregator row (reachable via `--one <key>` only)
+_HIDDEN = {"lstm_sample"}
 
 
 def _run_config(key, metric, unit, thunk):
@@ -1232,6 +1379,8 @@ def main():
     only = set(s.strip() for s in only.split(",")) if only else None
     all_results = {}
     for key, metric, unit, thunk in BENCHES:
+        if key in _HIDDEN and (only is None or key not in only):
+            continue  # sample rows run only via their aggregator
         if only is not None and key not in only:
             continue
         result = None

@@ -1,0 +1,160 @@
+"""Per-step dispatch-latency microbench for the persistent fused-cell
+kernels (ROADMAP item 4 / ISSUE 8).
+
+The latency-bound workloads this repo cares about are serial towers of
+small steps: the LSTM cell loop (~T sequential cell iterations per
+training step) and the LLM decode step (one token per sequence per
+iteration).  This bench reports, for each, the two numbers that matter
+and that CI can gate on without opperf-style flake risk:
+
+- **launches/step** — a STATIC census of launch-class primitives in the
+  traced step program (``ops/pallas/fused_cell.count_launches``:
+  matmuls, gathers/scatters, reductions, pallas calls; elementwise
+  chains fuse away).  Deterministic and load-independent; the tier-1
+  gate in tests/test_fused_cell.py asserts the fused paths' counts.
+- **host-gap μs/step** — measured wall time per step of the jitted
+  program (informational: timing IS load-dependent, so only the counts
+  are gated).
+
+Run: ``python benchmark/steplat.py`` → one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+
+def _median_wall_us(fn, *args, iters=10, per=1):
+    """Median wall μs of ``fn(*args)`` over ``iters`` calls, divided by
+    ``per`` (steps amortized inside one call)."""
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        samples.append((time.perf_counter() - t0) * 1e6 / per)
+    samples.sort()
+    return round(samples[len(samples) // 2], 2)
+
+
+def lstm_steplat(T=35, B=32, I=128, H=128, L=2, measure=True, iters=10,
+                 fused_mode=None):
+    """LSTM cell-step dispatch census + latency, scan vs fused.
+
+    ``fused_mode`` None → 'interpret' on CPU (counts identical to the
+    compiled kernel; timings meaningless and skipped unless measure).
+    Returns {scan: {...}, fused: {...}} with launches_per_step,
+    launches_total, pallas_total, and host_gap_us_per_step when
+    measured.
+    """
+    from mxnet_tpu.ops import rnn as oprnn
+    from mxnet_tpu.ops.pallas import fused_cell as fc
+
+    if fused_mode is None:
+        fused_mode = ("compiled" if jax.default_backend() != "cpu"
+                      else "interpret")
+    rng = jax.random.key(0)
+    ks = jax.random.split(rng, 3)
+    x = jax.random.normal(ks[0], (T, B, I), jnp.float32)
+    params = jax.random.normal(
+        ks[1], (oprnn.param_size("lstm", I, H, L),), jnp.float32) * 0.1
+    h0 = jnp.zeros((L, B, H), jnp.float32)
+    c0 = jnp.zeros((L, B, H), jnp.float32)
+
+    def fwd(fused):
+        def f(x, params, h0, c0):
+            out, hT, cT = oprnn.rnn_forward(
+                x, params, h0, c0, "lstm", H, L, fused=fused)
+            return out
+        return f
+
+    out = {}
+    for name, fused in (("scan", None), ("fused", fused_mode)):
+        f = fwd(fused)
+        jaxpr = jax.make_jaxpr(f)(x, params, h0, c0)
+        total = fc.count_launches(jaxpr)
+        pallas = fc.count_pallas_calls(jaxpr)
+        row = {"launches_total": int(total),
+               "launches_per_step": round(total / T, 3),
+               "pallas_total": int(pallas)}
+        # timing the interpret lane is meaningless (python-level grid)
+        if measure and (fused is None or fused == "compiled"):
+            jf = jax.jit(f)
+            jax.block_until_ready(jf(x, params, h0, c0))  # compile
+            row["host_gap_us_per_step"] = _median_wall_us(
+                jf, x, params, h0, c0, iters=iters, per=T)
+        out[name] = row
+    out["T"] = T
+    out["layers"] = L
+    return out
+
+
+def decode_steplat(measure=True, iters=10, fused_mode=None, slots=8,
+                   page_size=8, layer_group=0, model_kw=None):
+    """LLM decode-step dispatch census + latency, per-op tower vs the
+    fused layer-group kernel.  Counts come from
+    models.decoder.decode_launch_stats (the same census the engine
+    exports in its metrics)."""
+    from mxnet_tpu.models import decoder as dec
+
+    if fused_mode is None:
+        fused_mode = ("compiled" if jax.default_backend() != "cpu"
+                      else "interpret")
+    kw = dict(vocab_size=128, num_layers=2, units=64, hidden_size=128,
+              num_heads=4, num_kv_heads=2, max_length=128)
+    kw.update(model_kw or {})
+    lm = dec.decoder_tiny_lm(seed=0, **kw)
+    cfg = lm.config
+    params = lm.jax_params()
+    pps = (kw["max_length"] + page_size - 1) // page_size
+    total = slots * pps + 1
+
+    out = {}
+    for name, fused in (("tower", False), ("fused", True)):
+        stats = dec.decode_launch_stats(
+            params, cfg, page_size, slots, pps, total, fused=fused,
+            layer_group=layer_group, mode=fused_mode)
+        row = dict(stats)
+        if measure and (not fused or fused_mode == "compiled"):
+            fn = (dec.make_decode_step_fused(cfg, page_size, layer_group,
+                                             fused_mode) if fused
+                  else dec.make_decode_step(cfg, page_size))
+            shape = (cfg.num_layers, cfg.num_kv_heads, total, page_size,
+                     cfg.head_dim)
+
+            def run(fn=fn, shape=shape):
+                kp = jnp.zeros(shape, jnp.float32)
+                vp = jnp.zeros(shape, jnp.float32)
+                return fn(params, kp, vp,
+                          jnp.zeros(slots, jnp.int32),
+                          jnp.zeros(slots, jnp.int32),
+                          jnp.zeros((slots, pps), jnp.int32),
+                          jnp.zeros(slots, bool))[2]
+            jax.block_until_ready(run())  # compile
+            row["host_gap_us_per_step"] = _median_wall_us(
+                run, iters=iters)
+        out[name] = row
+    out["slots"] = slots
+    out["num_layers"] = kw["num_layers"]
+    return out
+
+
+def main():
+    result = {
+        "backend": jax.default_backend(),
+        "lstm": lstm_steplat(),
+        "decode": decode_steplat(),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

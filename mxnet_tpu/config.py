@@ -280,10 +280,34 @@ register("MXNET_PAGED_ATTENTION", str, "", "honored",
          "'interpret' forces the Pallas kernel in interpreter mode",
          "ops.pallas.paged_attention")
 register("MXNET_RNN_SCAN_UNROLL", int, 5, "honored",
-         "RNN time-scan unroll factor", "ops.rnn")
+         "RNN time-scan unroll factor (read per call; any seq_len "
+         "remainder is handled by lax.scan)", "ops.rnn")
 register("MXNET_RNN_WAVEFRONT", bool, True, "honored",
          "layer-diagonal fused schedule for stacked unidirectional RNNs",
          "ops.rnn")
+register("MXNET_RNN_FUSED_CELL", str, "", "honored",
+         "persistent fused-cell LSTM kernel: one Pallas launch owns the "
+         "whole time loop (recurrent weights latched in VMEM, gates + "
+         "state update fused, custom VJP).  '' auto (probe on "
+         "accelerator backends, scan on CPU), '0' forces the scan/"
+         "wavefront paths, 'interpret' forces the kernel in interpreter "
+         "mode (CPU test lane)", "ops.pallas.fused_cell.rnn_mode")
+register("MXNET_DECODE_FUSED", str, "", "honored",
+         "persistent fused decode-step kernel for the LLM engine: one "
+         "Pallas launch per layer group (qkv + KV append + paged "
+         "attention + FFN epilogue chain) instead of the per-op XLA "
+         "tower.  '' auto (accelerator backends), '0' off, 'interpret' "
+         "CPU test lane", "ops.pallas.fused_cell.decode_mode")
+register("MXNET_DECODE_LAYER_GROUP", int, 0, "honored",
+         "decoder layers per fused decode-step kernel launch (0 = all "
+         "layers in ONE group — one launch per token per engine step)",
+         "serving.DecodeEngine")
+register("MXNET_GEN_FN_CACHE", int, 16, "honored",
+         "LRU capacity of the per-geometry jitted decode/prefill "
+         "program cache: admit/evict churn across many (batch, pages) "
+         "geometries cannot grow compiled-program memory unboundedly; "
+         "compile/evict counts are exported in ServingMetrics",
+         "models.decoder._FnCache")
 register("MXNET_INT64_TENSOR_SIZE", bool, False, "honored",
          "enable true int64 tensors/indices (reference USE_INT64_TENSOR_SIZE"
          " build flag; here it flips jax_enable_x64 at import). Off: int64"

@@ -386,13 +386,15 @@ class HybridBlock(Block):
         training = autograd.is_training()
         from ..ops import nn as _ops_nn
         from ..ops.pallas.epilogue import fuse_epilogue_enabled
+        from ..ops.pallas.fused_cell import rnn_mode
         amp = _ops_nn._amp_state()  # amp scope traces its own graph
         amp_key = (str(amp[0]), amp[1]) if amp is not None else None
-        # the epilogue-fusion gate changes the traced graph (Dense/BERT
-        # fused fast paths): flipping MXNET_FUSE_EPILOGUE must retrace,
-        # not reuse a stale cache
+        # the epilogue-fusion and fused-cell gates change the traced
+        # graph (Dense/BERT fused fast paths; the LSTM persistent
+        # kernel): flipping MXNET_FUSE_EPILOGUE / MXNET_RNN_FUSED_CELL
+        # must retrace, not reuse a stale cache
         return (tuple((a.shape, str(a.dtype)) for a in flat_inputs),
-                training, amp_key, fuse_epilogue_enabled())
+                training, amp_key, fuse_epilogue_enabled(), rnn_mode())
 
     def _build_cache(self, args, kwargs, flat_inputs):
         """Trace forward into a jitted pure function.

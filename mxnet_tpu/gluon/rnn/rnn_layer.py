@@ -4,8 +4,10 @@ RNN/LSTM/GRU backed by the fused rnn op `src/operator/rnn.cc`).
 TPU-native: per-layer i2h/h2h Parameters (so initializers see proper 2-D
 shapes, like the reference's {l0..}_{i2h,h2h}_{weight,bias}) are packed
 into the fused kernel's flat vector at forward; the time loop is one
-lax.scan per layer/direction (ops/rnn.py), whole net compiles to one XLA
-program under hybridize()."""
+lax.scan per layer/direction (ops/rnn.py) — or, for LSTM with
+MXNET_RNN_FUSED_CELL enabled, ONE persistent Pallas kernel per layer
+(ops/pallas/fused_cell: weights latched in VMEM across the sequence);
+whole net compiles to one XLA program under hybridize()."""
 from __future__ import annotations
 
 import numpy as onp

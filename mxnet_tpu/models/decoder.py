@@ -29,18 +29,21 @@ hot-swap path replaces the whole model, never mutates weights in place.
 """
 from __future__ import annotations
 
-import functools
+import collections
+import threading
 import warnings
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
+from .. import config as _config
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..gluon.parameter import Parameter
 from ..ops import attention as _attention
 from ..ops.pallas import epilogue as _epilogue
+from ..ops.pallas import fused_cell as _fused
 from ..ops.pallas import paged_attention as _paged
 from .bert import PositionwiseFFN
 
@@ -50,7 +53,75 @@ warnings.filterwarnings(
     "ignore", message="Some donated buffers were not usable")
 
 __all__ = ["DecoderConfig", "CausalLM", "full_forward", "make_decode_step",
-           "make_prefill_chunk", "decoder_tiny", "decoder_tiny_lm"]
+           "make_decode_step_fused", "make_prefill_chunk", "fn_cache_stats",
+           "decode_launch_stats", "decoder_tiny", "decoder_tiny_lm"]
+
+
+# ---------------------------------------------------------------------------
+# bounded per-geometry program cache
+# ---------------------------------------------------------------------------
+class _FnCache:
+    """LRU cache for the jitted decode/prefill builders.
+
+    Each (cfg, page_size, …) geometry compiles its own fixed-shape XLA
+    program; an unbounded cache lets admit/evict churn across many
+    (batch, pages) geometries grow compiled-program memory without
+    limit.  Capacity comes from ``MXNET_GEN_FN_CACHE`` (read per miss so
+    tests/ops can retune live); compile/evict counts are exported via
+    :func:`fn_cache_stats` and surface in ServingMetrics.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._od = collections.OrderedDict()
+        self.compiles = 0
+        self.evictions = 0
+
+    def _cap(self):
+        try:
+            return max(1, int(_config.get("MXNET_GEN_FN_CACHE")))
+        except (TypeError, ValueError):
+            return 16
+
+    def get(self, key, builder):
+        with self._lock:
+            fn = self._od.get(key)
+            if fn is not None:
+                self._od.move_to_end(key)
+                return fn
+        fn = builder()  # build outside the lock (tracing can be slow)
+        with self._lock:
+            if key not in self._od:
+                self._od[key] = fn
+                self.compiles += 1
+                cap = self._cap()
+                while len(self._od) > cap:
+                    self._od.popitem(last=False)
+                    self.evictions += 1
+            else:
+                self._od.move_to_end(key)
+            return self._od[key]
+
+    def stats(self):
+        with self._lock:
+            return {"size": len(self._od), "cap": self._cap(),
+                    "compiles": self.compiles,
+                    "evictions": self.evictions}
+
+    def clear(self):
+        with self._lock:
+            self._od.clear()
+            self.compiles = 0
+            self.evictions = 0
+
+
+_fn_cache = _FnCache()
+
+
+def fn_cache_stats():
+    """{size, cap, compiles, evictions} of the decode/prefill program
+    cache (shared across decode, fused-decode, and prefill builders)."""
+    return _fn_cache.stats()
 
 
 class DecoderConfig(NamedTuple):
@@ -134,9 +205,9 @@ def full_forward(params, cfg, tokens):
 # ---------------------------------------------------------------------------
 # incremental decode over the paged KV cache
 # ---------------------------------------------------------------------------
-@functools.lru_cache(maxsize=16)
 def make_decode_step(cfg, page_size):
-    """Build the jitted batched decode step for (cfg, page_size).
+    """Build (or fetch) the jitted batched decode step for
+    (cfg, page_size) — cached in the bounded per-geometry LRU.
 
     fn(params, k_pages, v_pages, tokens, positions, page_tables, active)
       k_pages/v_pages: (layers, KVH, total_pages, page_size, head_dim)
@@ -148,6 +219,11 @@ def make_decode_step(cfg, page_size):
                   read garbage; the engine discards their outputs
     -> (k_pages, v_pages, next_tokens (B,) int32, logits (B, vocab) f32)
     """
+    return _fn_cache.get(("decode", cfg, int(page_size)),
+                         lambda: _build_decode_step(cfg, int(page_size)))
+
+
+def _build_decode_step(cfg, page_size):
     S = int(page_size)
 
     def step(params, k_pages, v_pages, tokens, positions, page_tables,
@@ -179,10 +255,113 @@ def make_decode_step(cfg, page_size):
     return jax.jit(step, donate_argnums=(1, 2))
 
 
-@functools.lru_cache(maxsize=16)
+def _group_bounds(num_layers, layer_group):
+    """[(lo, hi), …] contiguous layer groups of size ≤ layer_group
+    (0 / >=L collapses to one group — the default: ONE launch/step)."""
+    g = int(layer_group) or num_layers
+    g = max(1, min(g, num_layers))
+    return [(lo, min(lo + g, num_layers))
+            for lo in range(0, num_layers, g)]
+
+
+def _stack_layer_params(params, lo, hi):
+    keys = params["layers"][0].keys()
+    return {k: jnp.stack([params["layers"][li][k]
+                          for li in range(lo, hi)]) for k in keys}
+
+
+def make_decode_step_fused(cfg, page_size, layer_group=0, mode="interpret"):
+    """Build (or fetch) the PERSISTENT-KERNEL decode step: one
+    ``fused_cell.decode_layer_group`` Pallas launch per layer group
+    (default: all layers in one group) instead of the per-op XLA tower.
+    Same signature and donation contract as :func:`make_decode_step`;
+    greedy next-token parity is asserted by tests/test_fused_cell.py.
+    """
+    key = ("decode_fused", cfg, int(page_size), int(layer_group),
+           str(mode))
+    return _fn_cache.get(key, lambda: _build_decode_step_fused(
+        cfg, int(page_size), int(layer_group), mode))
+
+
+def _build_decode_step_fused(cfg, page_size, layer_group, mode):
+    S = int(page_size)
+    groups = _group_bounds(cfg.num_layers, layer_group)
+
+    def step(params, k_pages, v_pages, tokens, positions, page_tables,
+             active):
+        x = (params["embed"][tokens]
+             + params["pos"][jnp.clip(positions, 0, cfg.max_length - 1)])
+        page_of = jnp.take_along_axis(
+            page_tables, (positions // S)[:, None], axis=1)[:, 0]
+        wp = jnp.where(active, page_of, 0).astype(jnp.int32)
+        ws = jnp.where(active, positions % S, 0).astype(jnp.int32)
+        lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+        meta = jnp.stack([wp, ws])
+        pt = page_tables.astype(jnp.int32)
+        for (lo, hi) in groups:
+            stacked = _stack_layer_params(params, lo, hi)
+            if len(groups) == 1:
+                kp_g, vp_g = k_pages, v_pages
+            else:
+                kp_g, vp_g = k_pages[lo:hi], v_pages[lo:hi]
+            kp_g, vp_g, x = _fused.decode_layer_group(
+                x, kp_g, vp_g, stacked, meta, pt, lengths[:, None],
+                cfg, mode)
+            if len(groups) == 1:
+                k_pages, v_pages = kp_g, vp_g
+            else:
+                k_pages = jax.lax.dynamic_update_slice_in_dim(
+                    k_pages, kp_g, lo, axis=0)
+                v_pages = jax.lax.dynamic_update_slice_in_dim(
+                    v_pages, vp_g, lo, axis=0)
+        logits = jnp.dot(x.astype(jnp.float32),
+                         params["embed"].astype(jnp.float32).T)
+        return (k_pages, v_pages,
+                jnp.argmax(logits, axis=-1).astype(jnp.int32), logits)
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def decode_launch_stats(params, cfg, page_size, slots, pages_per_seq,
+                        total_pages, fused, layer_group=0,
+                        mode="interpret"):
+    """Static launch census of one decode step (the dispatch-count
+    audit): traces the chosen step program and counts launch-class
+    primitives with ``fused_cell.count_launches`` — deterministic and
+    load-independent, safe to gate CI and bench rows on.
+
+    Returns {fused, layer_groups, launches_per_step, pallas_per_step,
+    pallas_per_group}.
+    """
+    S = int(page_size)
+    if fused:
+        fn = make_decode_step_fused(cfg, S, layer_group, mode)
+        n_groups = len(_group_bounds(cfg.num_layers, layer_group))
+    else:
+        fn = make_decode_step(cfg, S)
+        n_groups = cfg.num_layers
+    shape = (cfg.num_layers, cfg.num_kv_heads, int(total_pages), S,
+             cfg.head_dim)
+    kp = jax.ShapeDtypeStruct(shape, jnp.float32)
+    args = (jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+            kp, kp,
+            jax.ShapeDtypeStruct((slots,), jnp.int32),
+            jax.ShapeDtypeStruct((slots,), jnp.int32),
+            jax.ShapeDtypeStruct((slots, pages_per_seq), jnp.int32),
+            jax.ShapeDtypeStruct((slots,), jnp.bool_))
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    launches = _fused.count_launches(jaxpr)
+    pallas = _fused.count_pallas_calls(jaxpr)
+    return {"fused": bool(fused), "layer_groups": int(n_groups),
+            "launches_per_step": int(launches),
+            "pallas_per_step": int(pallas),
+            "pallas_per_group": (pallas / n_groups if n_groups else 0.0)}
+
+
 def make_prefill_chunk(cfg, page_size, chunk):
-    """Build the jitted single-sequence chunk prefill for
-    (cfg, page_size, chunk).
+    """Build (or fetch) the jitted single-sequence chunk prefill for
+    (cfg, page_size, chunk) — cached in the bounded per-geometry LRU.
 
     fn(params, k_pages, v_pages, tokens, pos0, n_valid, page_row)
       tokens:  (chunk,) int32 — prompt slice, padded past n_valid
@@ -197,6 +376,12 @@ def make_prefill_chunk(cfg, page_size, chunk):
     bounded slice of each engine step instead of stalling the decode
     batch (Sarathi-style chunked prefill).
     """
+    return _fn_cache.get(("prefill", cfg, int(page_size), int(chunk)),
+                         lambda: _build_prefill_chunk(cfg, int(page_size),
+                                                      int(chunk)))
+
+
+def _build_prefill_chunk(cfg, page_size, chunk):
     S = int(page_size)
     P = int(chunk)
     g = cfg.num_heads // cfg.num_kv_heads

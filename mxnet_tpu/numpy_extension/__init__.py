@@ -317,6 +317,12 @@ def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
 
     data: (T, B, I); parameters: flat vector; state: (L*D, B, H)."""
     dropout_key = next_key() if (p > 0 and autograd.is_training()) else None
+    # resolve the fused-cell gate OUTSIDE the op closure: the bulk
+    # segment cache keys on closure constants, so flipping
+    # MXNET_RNN_FUSED_CELL between eager calls re-traces instead of
+    # reusing a stale compiled segment
+    from ..ops.pallas import fused_cell as _fc
+    fused = _fc.rnn_mode()
 
     if mode == "lstm":
         def f(x, params, h0, c0):
@@ -324,7 +330,8 @@ def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
             x, params = _amp_cast2("rnn", x, params)
             out, hT, cT = _rnn.rnn_forward(
                 x, params, h0, c0, mode, state_size, num_layers,
-                bidirectional, p if autograd.is_training() else 0.0, dropout_key)
+                bidirectional, p if autograd.is_training() else 0.0,
+                dropout_key, fused=fused)
             return out, hT, cT
 
         out, hT, cT = apply_op(f, data, parameters, state, state_cell)
@@ -335,7 +342,8 @@ def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
         x, params = _amp_cast2("rnn", x, params)
         out, hT, _ = _rnn.rnn_forward(
             x, params, h0, None, mode, state_size, num_layers,
-            bidirectional, p if autograd.is_training() else 0.0, dropout_key)
+            bidirectional, p if autograd.is_training() else 0.0,
+            dropout_key, fused=fused)
         return out, hT
 
     out, hT = apply_op(f, data, parameters, state)

@@ -3,4 +3,8 @@
 Parity: this tier replaces the reference's cuDNN/fused-CUDA kernels
 (`src/operator/contrib/transformer.cu`, `rnn-inl.h` cuDNN path, fusion RTC)
 with TPU systolic-array kernels written in Pallas.
+
+`fused_cell` is the persistent-kernel tier for latency-bound serial
+loops: the LSTM time loop and the LLM decode step each run as one
+kernel launch with weights latched in VMEM.
 """

@@ -110,24 +110,43 @@ def _cell_step(mode, state_size):
 
 # scan unroll factor: amortizes per-step loop overhead and lets XLA
 # software-pipeline consecutive cells' matmul + elementwise phases
-# (MXNET_RNN_SCAN_UNROLL overrides; 5 won the 1/5/7/35 sweep on v5e)
+# (MXNET_RNN_SCAN_UNROLL overrides; 5 won the 1/5/7/35 sweep on v5e).
+# Read per call, not at import — the knob is an A/B lever and jax.scan
+# handles any remainder when seq_len is not divisible by it.
 import os as _os
-_SCAN_UNROLL = int(_os.environ.get("MXNET_RNN_SCAN_UNROLL", "5"))
 
 
-def _single_layer(x, h0, c0, p, mode, reverse=False):
-    """x: (T, B, I). Returns (out (T, B, H), hT, cT)."""
+def _scan_unroll():
+    try:
+        return max(1, int(_os.environ.get("MXNET_RNN_SCAN_UNROLL", "5")))
+    except ValueError:
+        return 5
+
+
+def _single_layer(x, h0, c0, p, mode, reverse=False, fused=None):
+    """x: (T, B, I). Returns (out (T, B, H), hT, cT).
+
+    ``fused`` ('compiled'|'interpret'|None) routes the LSTM forward
+    direction through the persistent fused-cell Pallas kernel
+    (ops/pallas/fused_cell): the i2h GEMM stays hoisted here, the whole
+    time loop runs as ONE kernel launch.  GRU/vanilla and the reverse
+    direction fall back to the scan."""
     gates_x = jnp.einsum("tbi,gi->tbg", x, p["w_i2h"]) + p["b_i2h"]
+    w_h2h_t = p["w_h2h"].T  # hoisted: one transpose per call, not per step
+    if fused is not None and mode == "lstm" and not reverse:
+        from .pallas import fused_cell as _fc
+        c0v = c0 if c0 is not None else jnp.zeros_like(h0)
+        return _fc.lstm_sequence(gates_x, h0, c0v, w_h2h_t, p["b_h2h"],
+                                 mode=fused)
     step = _cell_step(mode, p["w_h2h"].shape[1])
     carry = (h0, c0) if mode == "lstm" else (h0,)
-    w_h2h_t = p["w_h2h"].T  # hoisted: one transpose per call, not per step
 
     def scan_fn(carry, gx):
         new_carry, out = step(carry, gx, w_h2h_t, p["b_h2h"])
         return new_carry, out
 
     carry, outs = lax.scan(scan_fn, carry, gates_x, reverse=reverse,
-                           unroll=_SCAN_UNROLL)
+                           unroll=_scan_unroll())
     hT = carry[0]
     cT = carry[1] if mode == "lstm" else None
     return outs, hT, cT
@@ -217,29 +236,47 @@ def _stacked_wavefront(x, layers, h0, c0, mode, state_size):
               else jnp.zeros_like(h0))
     (hT, cT, _), outs = lax.scan(
         body, (h0, c_init, pend0), jnp.arange(T + L - 1),
-        unroll=min(_SCAN_UNROLL, T + L - 1))
+        unroll=min(_scan_unroll(), T + L - 1))
     out_seq = outs[L - 1:]                                   # (T,B,H)
     return out_seq, hT, (cT if is_lstm else None)
 
 
 def rnn_forward(x, params, h0, c0, mode, state_size, num_layers=1,
-                bidirectional=False, dropout_rate=0.0, dropout_key=None):
+                bidirectional=False, dropout_rate=0.0, dropout_key=None,
+                fused="auto"):
     """Full stacked RNN. x: (T, B, I); h0/c0: (L*D, B, H).
 
     Returns (out (T, B, H*D), hT (L*D, B, H), cT or None).
+
+    ``fused``: the persistent fused-cell kernel gate for the LSTM time
+    loop — "auto" resolves MXNET_RNN_FUSED_CELL (probe-and-latch: Pallas
+    on accelerator backends, off on CPU), None/False disables,
+    'compiled'/'interpret' force.  Callers that jit-trace this function
+    (npx.rnn, bench A/B arms) resolve the gate OUTSIDE and pass the
+    value through so their trace caches key on it.
     """
     d = 2 if bidirectional else 1
     layers = unpack_params(params, mode, x.shape[-1], state_size, num_layers,
                            bidirectional)
 
+    if fused == "auto":
+        from .pallas import fused_cell as _fc
+        fused = _fc.rnn_mode()
+    elif not fused:
+        fused = None
+    fused = fused if mode == "lstm" else None
+
     # fused wavefront path: unidirectional stacks without inter-layer
     # dropout.  (Layer-0's input projection is precomputed for all T, so
     # any input width works; layers 1..L-1 have in_size == state_size by
     # construction when d == 1.)  MXNET_RNN_WAVEFRONT=0 forces the
-    # layer-by-layer scan (A/B lever).
+    # layer-by-layer scan (A/B lever).  The persistent fused-cell kernel
+    # outranks the wavefront for LSTM: the wavefront shrank the serial
+    # chain to T+L-1 dispatches, the fused kernel collapses it to one
+    # launch per layer.
     no_drop = (dropout_rate == 0.0 or dropout_key is None
                or num_layers == 1)
-    if d == 1 and no_drop and \
+    if d == 1 and no_drop and fused is None and \
             _os.environ.get("MXNET_RNN_WAVEFRONT", "1") != "0":
         return _stacked_wavefront(
             x, layers, h0, c0 if mode == "lstm" else None, mode,
@@ -252,7 +289,7 @@ def rnn_forward(x, params, h0, c0, mode, state_size, num_layers=1,
             s = li * d + di
             out, hT, cT = _single_layer(
                 inp, h0[s], c0[s] if c0 is not None else None, p, mode,
-                reverse=(di == 1))
+                reverse=(di == 1), fused=fused)
             outs.append(out)
             hTs.append(hT)
             if cT is not None:

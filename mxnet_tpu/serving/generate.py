@@ -51,6 +51,7 @@ import jax.numpy as jnp
 from .. import config as _config
 from .. import faults
 from ..models import decoder as _decoder
+from ..ops.pallas import fused_cell as _fused_cell
 from .errors import (BadRequestError, DeadlineExceededError, QueueFullError,
                      ServerClosedError, ServingError, SessionResetError)
 from .kvcache import CacheOOM, PageAllocator, pages_for
@@ -134,6 +135,15 @@ class DecodeEngine:
       static_batching— True = the A/B baseline: admissions wait for the
                        WHOLE batch to drain (batch-level scheduling);
                        everything else identical
+
+    ``MXNET_DECODE_FUSED`` routes the decode step through the
+    persistent fused-cell kernel (``ops/pallas/fused_cell``): one
+    Pallas launch per ``MXNET_DECODE_LAYER_GROUP`` decoder layers
+    (default: all in one group) instead of the per-op XLA tower.  The
+    static launch census lands in ``stats()["launches"]`` and the
+    metrics ``generate`` snapshot; the per-geometry decode/prefill
+    program cache is LRU-bounded by ``MXNET_GEN_FN_CACHE`` with
+    compile/evict gauges next to it.
     """
 
     def __init__(self, model, *, name="llm", slots=None, page_size=None,
@@ -176,9 +186,35 @@ class DecodeEngine:
         self._tables = onp.zeros((self.slots, self.pages_per_seq),
                                  onp.int32)
         self._tables_dev = None  # device copy, rebuilt when rows change
-        self._decode_fn = _decoder.make_decode_step(cfg, self.page_size)
+        # persistent-kernel decode step (MXNET_DECODE_FUSED): one Pallas
+        # launch per layer group instead of the per-op XLA tower.  The
+        # launch census is static (trace-time) and exported as the
+        # engine's dispatch-count metric — the _bulk-flush analog.
+        self.decode_fused_mode = _fused_cell.decode_mode()
+        self.layer_group = (int(_config.get("MXNET_DECODE_LAYER_GROUP"))
+                            or cfg.num_layers)
+        if self.decode_fused_mode is not None:
+            self._decode_fn = _decoder.make_decode_step_fused(
+                cfg, self.page_size, self.layer_group,
+                self.decode_fused_mode)
+        else:
+            self._decode_fn = _decoder.make_decode_step(cfg,
+                                                        self.page_size)
+        self._decode_fn_unfused = None   # lazy fallback (compile fail)
         self._prefill_fn = _decoder.make_prefill_chunk(
             cfg, self.page_size, self.prefill_chunk)
+        try:
+            self.launch_stats = _decoder.decode_launch_stats(
+                self.params, cfg, self.page_size, self.slots,
+                self.pages_per_seq, total,
+                fused=self.decode_fused_mode is not None,
+                layer_group=self.layer_group,
+                mode=self.decode_fused_mode or "interpret")
+        except Exception:  # pragma: no cover - tracing is best-effort
+            _log.exception("decode launch census failed")
+            self.launch_stats = {"fused": self.decode_fused_mode
+                                 is not None}
+        self.metrics.observe_decode_launches(self.name, self.launch_stats)
 
         self._slots = [_Slot(i) for i in range(self.slots)]
         self._sessions = {}           # sid -> _Session (parked or busy)
@@ -283,6 +319,8 @@ class DecodeEngine:
         self._decode()
         self.metrics.observe_kv_cache(
             self.name, self.alloc.num_used, self.alloc.total_pages - 1)
+        self.metrics.observe_fn_cache(self.name,
+                                      _decoder.fn_cache_stats())
         self.steps += 1
 
     def _expire_queued(self, now):
@@ -437,6 +475,32 @@ class DecodeEngine:
             self._tables_dev = jnp.asarray(self._tables)
         return self._tables_dev
 
+    def _run_decode_fn(self, *args):
+        """Dispatch one decode step; if the fused persistent kernel
+        fails its FIRST real compile (non-TPU accelerator, VMEM
+        overflow on a huge model), latch the per-op XLA path for the
+        process and re-issue — same probe-and-fallback contract as the
+        flash/epilogue/paged kernels."""
+        if self._decode_fn_unfused is not None:
+            return self._decode_fn_unfused(*args)
+        try:
+            return self._decode_fn(*args)
+        except Exception:
+            if self.decode_fused_mode is None:
+                raise
+            _log.exception(
+                "fused decode kernel failed; falling back to the "
+                "per-op decode step for this engine")
+            self.decode_fused_mode = None
+            self._decode_fn_unfused = _decoder.make_decode_step(
+                self.cfg, self.page_size)
+            self.launch_stats = _decoder.decode_launch_stats(
+                self.params, self.cfg, self.page_size, self.slots,
+                self.pages_per_seq, self.alloc.total_pages, fused=False)
+            self.metrics.observe_decode_launches(self.name,
+                                                 self.launch_stats)
+            return self._decode_fn_unfused(*args)
+
     def _ensure_pages(self, slot, tokens_ahead):
         """Grow the slot's page list to cover ``tokens_ahead`` more cache
         positions; preempts the youngest other sequence on exhaustion.
@@ -582,7 +646,7 @@ class DecodeEngine:
             positions[s.idx] = s.pos
             active[s.idx] = True
         t0 = time.perf_counter()
-        self._kp, self._vp, next_tokens, _ = self._decode_fn(
+        self._kp, self._vp, next_tokens, _ = self._run_decode_fn(
             self.params, self._kp, self._vp, jnp.asarray(tokens),
             jnp.asarray(positions), self._tables_device(),
             jnp.asarray(active))
@@ -673,7 +737,7 @@ class DecodeEngine:
             self.params, self._kp, self._vp,
             jnp.zeros(self.prefill_chunk, jnp.int32), jnp.int32(0),
             jnp.int32(1), zrow)
-        self._kp, self._vp, toks, _ = self._decode_fn(
+        self._kp, self._vp, toks, _ = self._run_decode_fn(
             self.params, self._kp, self._vp,
             jnp.zeros(self.slots, jnp.int32),
             jnp.zeros(self.slots, jnp.int32),
@@ -727,5 +791,8 @@ class DecodeEngine:
                "pages_per_seq": self.pages_per_seq,
                "prefill_chunk": self.prefill_chunk,
                "max_ctx": self.max_ctx,
-               "kv": self.alloc.stats()}
+               "kv": self.alloc.stats(),
+               "decode_fused": self.decode_fused_mode,
+               "launches": dict(self.launch_stats),
+               "fn_cache": _decoder.fn_cache_stats()}
         return out

@@ -95,6 +95,11 @@ class ModelMetrics:
         self.kv_cache = {"used_pages": 0, "total_pages": 0,
                          "peak_used_pages": 0}
         self.tokens_per_s = 0.0  # EMA over decode steps
+        # static gauges (set once per engine): the dispatch-count audit
+        # of one decode step (fused_cell.count_launches — deterministic,
+        # load-independent) and the bounded decode/prefill program cache
+        self.decode_launches = None
+        self.fn_cache = None
 
     def snapshot(self):
         items = self.counters["items_total"]
@@ -126,6 +131,11 @@ class ModelMetrics:
                     if total else None),
                 "kv_cache": dict(self.kv_cache),
             }
+            if self.decode_launches is not None:
+                out["generate"]["decode_launches"] = dict(
+                    self.decode_launches)
+            if self.fn_cache is not None:
+                out["generate"]["fn_cache"] = dict(self.fn_cache)
         return out
 
 
@@ -221,6 +231,23 @@ class ServingMetrics:
                                     device_s)
         profiler.record_counter("serving::%s::decode" % name,
                                 active=active, tokens=new_tokens)
+
+    def observe_decode_launches(self, name, stats):
+        """Static launch census of the engine's decode step (see
+        models.decoder.decode_launch_stats): launches/step,
+        pallas_per_group — the _bulk-flush-counter analog for the decode
+        path; tests and bench rows assert on it."""
+        with self._lock:
+            self._model(name).decode_launches = dict(stats)
+        profiler.record_counter(
+            "serving::%s::decode_launches" % name,
+            launches=stats.get("launches_per_step", 0))
+
+    def observe_fn_cache(self, name, stats):
+        """Decode/prefill program-cache gauges ({size, cap, compiles,
+        evictions} from models.decoder.fn_cache_stats)."""
+        with self._lock:
+            self._model(name).fn_cache = dict(stats)
 
     def observe_kv_cache(self, name, used_pages, total_pages):
         with self._lock:

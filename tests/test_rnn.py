@@ -7,6 +7,8 @@ import mxnet_tpu as mx
 from mxnet_tpu import np, autograd, gluon
 from mxnet_tpu.gluon import nn, rnn
 
+pytestmark = pytest.mark.rnn
+
 
 @pytest.mark.parametrize("cls,mode", [(rnn.LSTM, "lstm"), (rnn.GRU, "gru"),
                                       (rnn.RNN, "rnn")])
