@@ -28,6 +28,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+if "jax" not in sys.modules and "xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    # the sharded census needs a multi-device mesh; carve 8 virtual CPU
+    # devices (affects only the host platform — TPU backends unchanged)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
 import jax
 import jax.numpy as jnp
 import numpy as onp
@@ -147,12 +155,63 @@ def decode_steplat(measure=True, iters=10, fused_mode=None, slots=8,
     return out
 
 
+def sharded_steplat(mesh_shape=(4, 2), axis_names=("dp", "tp"), B=8, L=32,
+                    units=64, hidden=128, heads=2, measure=True, iters=10):
+    """Collective census + latency of the dp×tp sharded train step.
+
+    Like the launch census, the collective counts are a STATIC property
+    of the compiled program (GSPMD inserts them at partitioning time):
+    deterministic and load-independent, so CI gates on the per-class
+    counts (tests/test_sharding.py) while the wall time stays
+    informational.  Returns {mesh, collectives: {class: n, total},
+    host_gap_us_per_step?}.
+    """
+    from mxnet_tpu.parallel import (ShardingConfig, DataParallelTrainer,
+                                    collective_census)
+    from mxnet_tpu.models.bert import TransformerLayer
+    import mxnet_tpu as mx
+
+    cfg = ShardingConfig.for_transformer(mesh_shape=mesh_shape,
+                                         axis_names=axis_names)
+    net = TransformerLayer(units=units, hidden_size=hidden, num_heads=heads,
+                           dropout=0.0)
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .randn(B, L, units).astype("float32"))
+    net(x)  # materialize deferred shapes
+    trainer = DataParallelTrainer(
+        net, lambda out, y: (out - y) ** 2, "sgd",
+        {"learning_rate": 0.1}, sharding=cfg)
+    state = trainer.init_state()
+    step = trainer.build_step(donate=False)
+    xb = x._data
+    yb = jnp.zeros_like(xb)
+    key = jax.random.key(0)
+    lr = jnp.float32(0.1)
+    lowered = step.lower(state, xb, yb, key, lr)
+    row = {"mesh": cfg.describe(),
+           "collectives": collective_census(lowered)}
+    if measure:
+        jax.block_until_ready(step(state, xb, yb, key, lr))  # compile
+        row["host_gap_us_per_step"] = _median_wall_us(
+            step, state, xb, yb, key, lr, iters=iters)
+    return row
+
+
 def main():
     result = {
         "backend": jax.default_backend(),
         "lstm": lstm_steplat(),
         "decode": decode_steplat(),
     }
+    sharded = {}
+    for name, shape, axes in (("dp8", (8,), ("dp",)),
+                              ("dp4tp2", (4, 2), ("dp", "tp"))):
+        try:
+            sharded[name] = sharded_steplat(shape, axes)
+        except ValueError as e:  # mesh doesn't fit this host
+            sharded[name] = {"skipped": str(e)[:120]}
+    result["sharded"] = sharded
     print(json.dumps(result))
 
 

@@ -146,6 +146,24 @@ register("MXNET_FLASH_AUTOTUNE", bool, False, "honored",
          "1 = pick flash-attention block sizes by a one-time on-device "
          "sweep per (L, D, dtype, causal), cached for the process; "
          "0 = use the static table", "ops.pallas.flash_attention")
+register("MXNET_MESH_SHAPE", str, "", "honored",
+         "default mesh shape for ShardingConfig.from_env as a comma list "
+         "('4,2'); unset = all local devices on the first axis",
+         "parallel.shardcfg.ShardingConfig.from_env")
+register("MXNET_MESH_AXES", str, "", "honored",
+         "mesh axis names for ShardingConfig.from_env ('dp,tp'); axis "
+         "vocabulary dp/tp/sp/pp/ep; may be longer than MXNET_MESH_SHAPE "
+         "(missing sizes default to 1)",
+         "parallel.shardcfg.ShardingConfig.from_env")
+register("MXNET_SHARDED_FLASH", str, "", "honored",
+         "''/'1' = flash_attention reroutes through the shard_map entry "
+         "when a ShardingConfig is active on a >1-device mesh; '0'/'off' "
+         "= always the single-device dispatch",
+         "ops.attention._active_sharding")
+register("MXNET_SPLASH_ATTENTION", str, "", "honored",
+         "''/'1' = causal sharded attention may use the TPU splash "
+         "kernel (probe-and-latch, compiled Pallas lane only); '0'/'off' "
+         "= always this repo's flash kernel", "ops.attention._splash_ok")
 register("MXNET_KV_TIMEOUT", float, 300.0, "honored",
          "dist kvstore socket timeout in seconds (send/recv/connect on a "
          "server shard stream); also the reconnect deadline after a "

@@ -51,6 +51,26 @@ def _keyless_dummy():
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
+def _sharding_token():
+    """Trace-cache token for the ACTIVE ShardingConfig (None when the
+    parallel package was never imported or no config scope is open).
+    sys.modules guard: layers pay nothing in unsharded processes."""
+    import sys
+    sc = sys.modules.get("mxnet_tpu.parallel.shardcfg")
+    return sc.active_token() if sc is not None else None
+
+
+def _maybe_constrain(x, kind):
+    """Sharding constraint at a named activation point under the ACTIVE
+    ShardingConfig; identity otherwise.  Layers call this at their
+    constraint points (Dense output, BERT q/k/v, FFN/token streams)."""
+    import sys
+    sc = sys.modules.get("mxnet_tpu.parallel.shardcfg")
+    if sc is None:
+        return x
+    return sc.maybe_constrain_nd(x, kind)
+
+
 def _flatten_arrays(obj, out):
     if isinstance(obj, ndarray):
         out.append(obj)
@@ -392,9 +412,11 @@ class HybridBlock(Block):
         # the epilogue-fusion and fused-cell gates change the traced
         # graph (Dense/BERT fused fast paths; the LSTM persistent
         # kernel): flipping MXNET_FUSE_EPILOGUE / MXNET_RNN_FUSED_CELL
-        # must retrace, not reuse a stale cache
+        # must retrace, not reuse a stale cache; likewise an ACTIVE
+        # ShardingConfig inserts sharding constraints into the graph
         return (tuple((a.shape, str(a.dtype)) for a in flat_inputs),
-                training, amp_key, fuse_epilogue_enabled(), rnn_mode())
+                training, amp_key, fuse_epilogue_enabled(), rnn_mode(),
+                _sharding_token())
 
     def _build_cache(self, args, kwargs, flat_inputs):
         """Trace forward into a jitted pure function.

@@ -9,7 +9,7 @@ import numpy as onp
 
 from ... import numpy_extension as npx
 from ... import numpy as np_mod
-from ..block import Block, HybridBlock
+from ..block import Block, HybridBlock, _maybe_constrain
 from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
@@ -136,14 +136,15 @@ class Dense(HybridBlock):
                 out = npx.fully_connected(
                     x, self.weight.data(), None, num_hidden=self._units,
                     no_bias=True, flatten=self._flatten)
-                return npx.bias_gelu(out, self.bias.data())
+                return _maybe_constrain(npx.bias_gelu(out, self.bias.data()),
+                                        "act")
         out = npx.fully_connected(
             x, self.weight.data(), self.bias.data() if self.bias is not None else None,
             num_hidden=self._units, no_bias=self.bias is None,
             flatten=self._flatten)
         if self._activation is not None:
             out = npx.activation(out, self._activation)
-        return out
+        return _maybe_constrain(out, "act")
 
     def __repr__(self):
         return "Dense(%s -> %d, %s)" % (

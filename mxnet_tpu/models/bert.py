@@ -16,7 +16,7 @@ from .. import autograd
 from .. import numpy as np
 from .. import numpy_extension as npx
 from ..gluon import nn
-from ..gluon.block import HybridBlock
+from ..gluon.block import HybridBlock, _maybe_constrain
 from ..gluon.parameter import Parameter
 from ..ops.pallas.epilogue import fuse_epilogue_enabled
 
@@ -56,9 +56,13 @@ class MultiHeadAttention(HybridBlock):
         # output-selection (reference Symbol semantics), while np.split's
         # list works identically in eager and traced form
         parts = np.split(qkv, 3, axis=0)
-        q = parts[0].squeeze(0)
-        k = parts[1].squeeze(0)
-        v = parts[2].squeeze(0)
+        # under an active ShardingConfig, pin the heads layout: batch
+        # over dp, heads over tp (SNIPPETS [1]'s q/k/v constraint in our
+        # (B, H, L, D) layout) — GSPMD then keeps the whole attention
+        # block head-parallel instead of re-gathering after the qkv GEMM
+        q = _maybe_constrain(parts[0].squeeze(0), "attention")
+        k = _maybe_constrain(parts[1].squeeze(0), "attention")
+        v = _maybe_constrain(parts[2].squeeze(0), "attention")
         # the flash kernel covers attention-probability dropout (in-kernel
         # hash mask) and padding given as a (B,) valid-length vector; only
         # DENSE masks fall back to the unfused masked-softmax path
@@ -128,6 +132,9 @@ class TransformerLayer(HybridBlock):
         self._dropout = dropout
 
     def forward(self, x, mask=None):
+        # token-stream constraint points: the residual stream stays
+        # (B over dp, L over sp, C replicated) through both sublayers
+        x = _maybe_constrain(x, "tokens")
         if fuse_epilogue_enabled():
             # attention/ffn return PRE-bias projections; each residual
             # join is one fused bias+dropout+residual kernel instead of
@@ -136,8 +143,8 @@ class TransformerLayer(HybridBlock):
             x = self.ln1(npx.bias_dropout_residual(
                 h, self.attention.proj.bias.data(), x, p=self._dropout))
             h = self.ffn(x)
-            return self.ln2(npx.bias_dropout_residual(
-                h, self.ffn.ffn2.bias.data(), x, p=self._dropout))
+            return _maybe_constrain(self.ln2(npx.bias_dropout_residual(
+                h, self.ffn.ffn2.bias.data(), x, p=self._dropout)), "tokens")
         h = self.attention(x, mask)
         if self._dropout:
             h = npx.dropout(h, p=self._dropout)
@@ -145,7 +152,7 @@ class TransformerLayer(HybridBlock):
         h = self.ffn(x)
         if self._dropout:
             h = npx.dropout(h, p=self._dropout)
-        return self.ln2(x + h)
+        return _maybe_constrain(self.ln2(x + h), "tokens")
 
 
 class BERTEncoder(HybridBlock):

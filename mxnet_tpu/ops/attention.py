@@ -165,17 +165,11 @@ def _pallas_mode():
     return None
 
 
-def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None,
-                    dropout=0.0, dropout_key=None, kv_length=None):
-    """Blockwise O(L)-memory attention with a Pallas-kernel custom VJP.
-    Uses the Pallas TPU kernel (fwd + bwd) on any accelerator backend;
-    falls back to the XLA reference path on CPU or for features the kernel
-    does not cover (dense masks, cross-attention with Lq != Lk).
-
-    `dropout` (with `dropout_key`, a jax PRNG key) applies attention-
-    probability dropout IN KERNEL (hash-based mask, regenerated by the
-    backward kernels); `kv_length` (B,) is a padding mask as a per-row
-    valid key count.  Both keep the call on the Pallas fast path."""
+def _flash_local(q, k, v, mask=None, causal=False, window=None, scale=None,
+                 dropout=0.0, dropout_key=None, kv_length=None):
+    """Single-device flash attention dispatch: Pallas kernel (compiled or
+    interpret) when eligible, XLA reference otherwise.  This is the
+    per-shard body of the sharded entry too."""
     global last_path, _fallback_warned
     if not 0.0 <= dropout < 1.0:
         # matches the eager Dropout op's validation; rate >= 1 would put
@@ -211,6 +205,218 @@ def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None,
     return attention_reference(q, k, v, mask=mask, causal=causal,
                                window=window, scale=scale, dropout=dropout,
                                dropout_key=dropout_key, kv_length=kv_length)
+
+
+# --------------------------------------------------------------------------
+# mesh-sharded flash attention (shard_map entry over the named mesh)
+# --------------------------------------------------------------------------
+# Which sharded route the last flash_attention call took: "shard_map"
+# (dp×tp shard_map around the local kernel), "ring" (sequence-sharded sp
+# route), or None (unsharded dispatch).  Tests assert on this.
+last_sharded = None
+_splash_probe = None  # latched: True/False once probed
+_splash_warned = False
+
+
+def _active_sharding():
+    """The ACTIVE ShardingConfig, if any, without importing the parallel
+    package: a process that never built a config pays nothing (the
+    sys.modules guard is the same trick the epilogue/rnn gates use)."""
+    import os
+    import sys
+    flag = os.environ.get("MXNET_SHARDED_FLASH", "").lower()
+    if flag in ("0", "off", "false"):
+        return None
+    mod = sys.modules.get("mxnet_tpu.parallel.shardcfg")
+    if mod is None:
+        return None
+    cfg = mod.current()
+    if cfg is None or not cfg.active:
+        return None
+    return cfg
+
+
+def _sharded_eligible(cfg, q, k, mask, dropout, kv_length):
+    """Whether the sharded entry can serve this call: self-attention
+    (Lq == Lk, no dense mask), 4-D heads layout, and every sharded dim
+    divisible by its mesh axis.  The sp (ring) route additionally has no
+    dropout/kv_length support — those fall back to the local dispatch."""
+    if mask is not None or getattr(q, "ndim", 0) != 4:
+        return False
+    if q.shape[-2] != k.shape[-2]:
+        return False
+    B, H, L, _ = q.shape
+    dp, tp, sp = (cfg.axis_size("dp"), cfg.axis_size("tp"),
+                  cfg.axis_size("sp"))
+    if dp * tp * sp == 1:
+        return False
+    if B % dp or H % tp or L % sp:
+        return False
+    if sp > 1 and (dropout or kv_length is not None):
+        return False
+    return True
+
+
+def _splash_ok():
+    """Probe-and-latch for the TPU splash-attention kernel (SNIPPETS [2]
+    pattern): gated by MXNET_SPLASH_ATTENTION, requires the compiled
+    Pallas lane, and one tiny compile+run must succeed before the
+    sharded body ever routes to it."""
+    global _splash_probe, _splash_warned
+    import os
+    flag = os.environ.get("MXNET_SPLASH_ATTENTION", "").lower()
+    if flag in ("0", "off", "false"):
+        return False
+    if _pallas_mode() != "compiled":
+        return False
+    if _splash_probe is None:
+        try:
+            from jax.experimental.pallas.ops.tpu.splash_attention import (
+                splash_attention_kernel as _sk,
+                splash_attention_mask as _sm)
+            L, D = 256, 128
+            mhm = _sm.MultiHeadMask([_sm.CausalMask((L, L))])
+            kern = _sk.make_splash_mha(mhm, head_shards=1, q_seq_shards=1)
+            tiny = jnp.zeros((1, L, D), jnp.float32)
+            jax.block_until_ready(jax.vmap(kern)(tiny[None], tiny[None],
+                                                 tiny[None]))
+            _splash_probe = True
+        except Exception as e:
+            _splash_probe = False
+            if not _splash_warned:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "flash_attention: splash probe failed on backend %r "
+                    "(%s: %s); causal sharded calls use the flash kernel",
+                    jax.default_backend(), type(e).__name__, e)
+                _splash_warned = True
+    return _splash_probe
+
+
+def _splash_causal(qb, kb, vb, scale):
+    """Per-shard splash-attention call: qb (Bl, Hl, L, D) -> same.  The
+    splash kernel takes (H, L, D) with scale folded into q."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk, splash_attention_mask as _sm)
+    Hl, L = qb.shape[1], qb.shape[2]
+    mhm = _sm.MultiHeadMask([_sm.CausalMask((L, L)) for _ in range(Hl)])
+    kern = _sk.make_splash_mha(mhm, head_shards=1, q_seq_shards=1)
+    s = scale if scale is not None else 1.0 / math.sqrt(qb.shape[-1])
+    out = jax.vmap(kern)((qb * s).astype(qb.dtype), kb, vb)
+    return out.astype(qb.dtype)
+
+
+def flash_attention_sharded(q, k, v, cfg=None, causal=False, window=None,
+                            scale=None, dropout=0.0, dropout_key=None,
+                            kv_length=None):
+    """Mesh-sharded flash attention over the active (or given)
+    ShardingConfig: q/k/v constrained to the config's "attention" point
+    (batch over dp, heads over tp, sequence over sp in this repo's
+    (B, H, L, D) layout), then
+
+    - sp > 1: the ring route (`parallel.ring_attention`) — K/V rotate
+      over the ICI ring so every query shard sees every key shard;
+    - else: a `shard_map` over (dp, tp) whose per-shard body is the
+      ordinary local dispatch (Pallas flash with the existing block-size
+      autotune + custom VJP, or the splash causal kernel on TPU), so the
+      sharded entry composes with everything the local one has.
+    """
+    global last_sharded, last_path
+    if cfg is None:
+        cfg = _active_sharding()
+        if cfg is None:
+            raise ValueError("flash_attention_sharded: no ShardingConfig "
+                             "active (use `with cfg.scope():`) and none "
+                             "passed")
+    mesh = cfg.mesh
+    q = cfg.constrain(q, "attention")
+    k = cfg.constrain(k, "attention")
+    v = cfg.constrain(v, "attention")
+
+    if cfg.axis_size("sp") > 1:
+        from mxnet_tpu.parallel.ring_attention import ring_attention
+        spec = cfg.spec_for("attention", shape=q.shape)
+        out = ring_attention(q, k, v, mesh=mesh, seq_axis="sp",
+                             causal=causal, window=window, scale=scale,
+                             spec=spec)
+        last_sharded = "ring"
+        last_path = "ring"
+        return out
+
+    from mxnet_tpu.parallel.pipeline import (shard_map,
+                                             _shard_map_compat_kwargs)
+    spec = cfg.spec_for("attention", shape=q.shape, ndim=4)
+    shard_axes = [a for a in ("dp", "tp") if cfg.axis_size(a) > 1]
+    use_kl = kv_length is not None
+    use_drop = bool(dropout) and dropout_key is not None
+
+    args = [q, k, v]
+    in_specs = [spec, spec, spec]
+    if use_kl:
+        args.append(jnp.asarray(kv_length).reshape(-1))
+        in_specs.append(cfg.resolve_spec(("dp",), ndim=1))
+    if use_drop:
+        args.append(dropout_key)
+        in_specs.append(jax.sharding.PartitionSpec())
+
+    def body(*ops):
+        qb, kb, vb = ops[:3]
+        i = 3
+        klb = None
+        keyb = None
+        if use_kl:
+            klb = ops[i]
+            i += 1
+        if use_drop:
+            # decorrelate the in-kernel dropout mask across shards: fold
+            # the linear shard index into the key (same key on every
+            # shard would repeat masks batch-slice to batch-slice)
+            idx = jnp.int32(0)
+            for a in shard_axes:
+                idx = idx * cfg.axis_size(a) + lax.axis_index(a)
+            keyb = jax.random.fold_in(ops[i], idx)
+        if causal and not (window or use_drop or use_kl) and _splash_ok():
+            global last_path
+            out = _splash_causal(qb, kb, vb, scale)
+            last_path = "splash"
+            return out
+        return _flash_local(qb, kb, vb, causal=causal, window=window,
+                            scale=scale, dropout=dropout, dropout_key=keyb,
+                            kv_length=klb)
+
+    out = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                    out_specs=spec, **_shard_map_compat_kwargs())(*args)
+    last_sharded = "shard_map"
+    return out
+
+
+def flash_attention(q, k, v, mask=None, causal=False, window=None, scale=None,
+                    dropout=0.0, dropout_key=None, kv_length=None):
+    """Blockwise O(L)-memory attention with a Pallas-kernel custom VJP.
+    Uses the Pallas TPU kernel (fwd + bwd) on any accelerator backend;
+    falls back to the XLA reference path on CPU or for features the kernel
+    does not cover (dense masks, cross-attention with Lq != Lk).
+
+    `dropout` (with `dropout_key`, a jax PRNG key) applies attention-
+    probability dropout IN KERNEL (hash-based mask, regenerated by the
+    backward kernels); `kv_length` (B,) is a padding mask as a per-row
+    valid key count.  Both keep the call on the Pallas fast path.
+
+    Under an ACTIVE ShardingConfig (``with cfg.scope():`` on a >1-device
+    mesh, e.g. inside DataParallelTrainer's step) eligible calls reroute
+    through `flash_attention_sharded` — a shard_map over the named mesh
+    (gate: MXNET_SHARDED_FLASH)."""
+    global last_sharded
+    cfg = _active_sharding()
+    if cfg is not None and _sharded_eligible(cfg, q, k, mask, dropout,
+                                             kv_length):
+        return flash_attention_sharded(
+            q, k, v, cfg=cfg, causal=causal, window=window, scale=scale,
+            dropout=dropout, dropout_key=dropout_key, kv_length=kv_length)
+    last_sharded = None
+    return _flash_local(q, k, v, mask=mask, causal=causal, window=window,
+                        scale=scale, dropout=dropout, dropout_key=dropout_key,
+                        kv_length=kv_length)
 
 
 # --------------------------------------------------------------------------

@@ -26,19 +26,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import autograd
 from .._rng import trace_keys
 from ..ndarray import ndarray, _wrap_value
+from .shardcfg import (ShardingConfig, ShardingRule, make_mesh,
+                       collective_census, census_fn)
 
 __all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "functionalize",
-           "DataParallelTrainer", "replicate", "shard_batch"]
-
-
-def make_mesh(shape=None, axis_names=("dp",), devices=None):
-    """Create a Mesh over local devices.  shape=None → all devices on the
-    first axis."""
-    devices = devices if devices is not None else jax.devices()
-    if shape is None:
-        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
-    arr = onp.array(devices).reshape(shape)
-    return Mesh(arr, axis_names)
+           "DataParallelTrainer", "replicate", "shard_batch",
+           "ShardingConfig", "ShardingRule", "collective_census",
+           "census_fn"]
 
 
 def functionalize(net, train=False):
@@ -111,21 +105,36 @@ class DataParallelTrainer:
     """
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, train=True, param_pspec=None, data_axis=None):
+                 mesh=None, train=True, param_pspec=None, data_axis=None,
+                 sharding=None):
         from .. import optimizer as opt_mod
         self.net = net
         self.loss_fn = loss_fn
-        self.mesh = mesh if mesh is not None else make_mesh()
+        # ONE source of truth for layout: a ShardingConfig.  The legacy
+        # (mesh=, param_pspec=) surface wraps into a config so old callers
+        # keep their exact shardings (param_pspec becomes param_fn).
+        if sharding is not None:
+            if mesh is not None and mesh is not sharding.mesh:
+                raise ValueError("DataParallelTrainer: pass either mesh= or "
+                                 "sharding=, not conflicting both")
+            if param_pspec is not None:
+                raise ValueError("DataParallelTrainer: param_pspec= is the "
+                                 "legacy surface; put rules/param_fn on the "
+                                 "ShardingConfig instead")
+            self.sharding = sharding
+        else:
+            mesh = mesh if mesh is not None else make_mesh()
+            self.sharding = ShardingConfig(
+                mesh=mesh, param_fn=param_pspec,
+                data_axis=data_axis or mesh.axis_names[0])
+        self.mesh = self.sharding.mesh
         opt = (optimizer if isinstance(optimizer, opt_mod.Optimizer)
                else opt_mod.create(optimizer, **(optimizer_params or {})))
         self.optimizer = opt
         self.train = train
         self._step = None
         self._fn, self._params = functionalize(net, train=train)
-        # param_pspec(name, shape) -> PartitionSpec for tensor parallelism
-        # (reference has no TP; this is the GSPMD extension slot, SURVEY §5.7)
-        self.param_pspec = param_pspec or (lambda name, shape: P())
-        self.data_axis = data_axis or self.mesh.axis_names[0]
+        self.data_axis = data_axis or self.sharding.data_axis
         # optimizer state as pure pytree (fp32 slots like the reference's
         # create_state)
         self._opt_kind, self._hp = self._opt_signature(opt)
@@ -149,30 +158,28 @@ class DataParallelTrainer:
             "steps; got %r (use gluon.Trainer for the others)"
             % type(opt).__name__)
 
-    def _param_sharding(self, name, shape):
-        return NamedSharding(self.mesh, self.param_pspec(name, shape))
-
     def init_state(self):
-        """Build the (sharded) training state: params placed per
-        param_pspec (GSPMD lays out TP shards), fp32 optimizer slots
-        co-sharded with their parameter."""
+        """Build the (sharded) training state: params placed per the
+        ShardingConfig's rules/param_fn (GSPMD lays out TP shards), fp32
+        optimizer slots co-sharded with their parameter."""
+        shard_of = self.sharding.param_sharding
         pvals = {}
         for k, p in self._params.items():
             v = p._data._data
-            pvals[k] = jax.device_put(v, self._param_sharding(k, v.shape))
+            pvals[k] = jax.device_put(v, shard_of(k, v.shape))
         trainable = [k for k, p in self._params.items()
                      if p.grad_req != "null"]
         if self._opt_kind == "sgd":
             slots = {}
         elif self._opt_kind == "sgd_mom":
             slots = {k: jax.device_put(jnp.zeros(pvals[k].shape, jnp.float32),
-                                       self._param_sharding(k, pvals[k].shape))
+                                       shard_of(k, pvals[k].shape))
                      for k in trainable}
         else:  # adam/adamw
             slots = {k: (jax.device_put(jnp.zeros(pvals[k].shape, jnp.float32),
-                                        self._param_sharding(k, pvals[k].shape)),
+                                        shard_of(k, pvals[k].shape)),
                          jax.device_put(jnp.zeros(pvals[k].shape, jnp.float32),
-                                        self._param_sharding(k, pvals[k].shape)))
+                                        shard_of(k, pvals[k].shape)))
                      for k in trainable}
         return {"params": pvals, "slots": slots, "t": jnp.zeros((), jnp.int32)}
 
@@ -180,7 +187,7 @@ class DataParallelTrainer:
         fn = self._fn
         loss_fn = self.loss_fn
         kind, hp = self._opt_kind, self._hp
-        lr_holder = self
+        sharding = self.sharding
 
         grad_names = [k for k, p in self._params.items()
                       if p.grad_req != "null"]
@@ -191,7 +198,11 @@ class DataParallelTrainer:
             def loss_of(diff_pvals):
                 full = dict(pvals)
                 full.update(diff_pvals)
-                out, aux = fn(full, batch, key=key)
+                # activate the config so gluon-level constraint points
+                # (Dense/attention/FFN) and the sharded flash entry see
+                # it at trace time
+                with sharding.scope():
+                    out, aux = fn(full, batch, key=key)
                 out_nd = (_wrap_value(out) if not isinstance(out, tuple)
                           else tuple(_wrap_value(o) for o in out))
                 lbl_nd = tuple(_wrap_value(l) for l in labels) \
@@ -243,7 +254,7 @@ class DataParallelTrainer:
         data_sh = NamedSharding(mesh, P(self.data_axis))
 
         pvals = {k: p._data._data for k, p in self._params.items()}
-        param_sh = {k: self._param_sharding(k, v.shape)
+        param_sh = {k: self.sharding.param_sharding(k, v.shape)
                     for k, v in pvals.items()}
         trainable = [k for k, p in self._params.items()
                      if p.grad_req != "null"]

@@ -29,8 +29,12 @@ __all__ = ["MoELayer"]
 class MoELayer:
     """Top-1 (Switch) MoE FFN with experts sharded over the `ep` axis."""
 
-    def __init__(self, num_experts, d_model, d_hidden, mesh, axis="ep",
-                 capacity_factor=2.0):
+    def __init__(self, num_experts, d_model, d_hidden, mesh=None, axis="ep",
+                 capacity_factor=2.0, sharding=None):
+        if sharding is not None:
+            mesh = sharding.mesh
+        if mesh is None:
+            raise ValueError("MoELayer needs mesh= or sharding=")
         self.E = num_experts
         self.d_model = d_model
         self.d_hidden = d_hidden

@@ -45,7 +45,11 @@ def _shard_map_compat_kwargs():
 
 
 class PipelineRunner:
-    def __init__(self, stage_fns, mesh, axis="pp"):
+    def __init__(self, stage_fns, mesh=None, axis="pp", sharding=None):
+        if sharding is not None:
+            mesh = sharding.mesh
+        if mesh is None:
+            raise ValueError("PipelineRunner needs mesh= or sharding=")
         self.stage_fns = list(stage_fns)
         self.mesh = mesh
         self.axis = axis
@@ -136,10 +140,10 @@ class PipelineRunner:
         return out.reshape(B, *out.shape[2:])
 
 
-def pipeline_apply(stage_fns, stage_params, x, mesh, axis="pp",
-                   n_microbatches=None):
+def pipeline_apply(stage_fns, stage_params, x, mesh=None, axis="pp",
+                   n_microbatches=None, sharding=None):
     """Functional one-shot wrapper around PipelineRunner."""
-    return PipelineRunner(stage_fns, mesh, axis).apply(
+    return PipelineRunner(stage_fns, mesh, axis, sharding=sharding).apply(
         stage_params, x, n_microbatches)
 
 
@@ -168,9 +172,14 @@ class PipelineTrainer:
     """
 
     def __init__(self, prologue, stages, epilogue, loss_fn, optimizer,
-                 hp, mesh, axis="pp", n_microbatches=None):
+                 hp, mesh=None, axis="pp", n_microbatches=None,
+                 sharding=None):
         from . import functionalize  # late: parallel/__init__ imports us
 
+        if sharding is not None:
+            mesh = sharding.mesh
+        if mesh is None:
+            raise ValueError("PipelineTrainer needs mesh= or sharding=")
         self.mesh = mesh
         self.axis = axis
         self.loss_fn = loss_fn

@@ -50,10 +50,18 @@ def _flash_block(q, k_blk, v_blk, o, m, l, scale, q_start, k_start,
     return o_new, m_new, l_new
 
 
-def ring_attention(q, k, v, mesh, seq_axis="sp", causal=False, window=None,
-                   scale=None):
+def ring_attention(q, k, v, mesh=None, seq_axis="sp", causal=False,
+                   window=None, scale=None, sharding=None, spec=None):
     """Attention over sequence-sharded q/k/v: (B, H, L, D) with L split
-    across `seq_axis`.  Returns (B, H, L, D) with the same sharding."""
+    across `seq_axis`.  Returns (B, H, L, D) with the same sharding.
+
+    `spec` overrides the default P(None, None, seq_axis, None) so batch/
+    head dims can ride dp/tp at the same time (the body only indexes the
+    `seq_axis`, so any extra sharded dims compose transparently)."""
+    if sharding is not None:
+        mesh = sharding.mesh
+    if mesh is None:
+        raise ValueError("ring_attention needs mesh= or sharding=")
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     n = mesh.shape[seq_axis]
@@ -84,13 +92,17 @@ def ring_attention(q, k, v, mesh, seq_axis="sp", causal=False, window=None,
         l = jnp.where(l == 0.0, 1.0, l)
         return (o / l).astype(qs.dtype)
 
-    spec = P(None, None, seq_axis, None)
+    if spec is None:
+        spec = P(None, None, seq_axis, None)
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)
 
 
-def ring_attention_sharded(q, k, v, mesh, seq_axis="sp", **kw):
+def ring_attention_sharded(q, k, v, mesh=None, seq_axis="sp", sharding=None,
+                           **kw):
     """Convenience: device_put inputs with the sequence sharding first."""
+    if sharding is not None:
+        mesh = sharding.mesh
     sh = NamedSharding(mesh, P(None, None, seq_axis, None))
     return ring_attention(jax.device_put(q, sh), jax.device_put(k, sh),
                           jax.device_put(v, sh), mesh, seq_axis, **kw)

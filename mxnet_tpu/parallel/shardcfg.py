@@ -1,0 +1,488 @@
+"""Mesh-native composed sharding: ONE config object for every axis.
+
+ROADMAP item 2 names the unlock for every later scale item: a single
+mesh/sharding config threaded through gluon + ops instead of per-module
+ad-hoc specs.  `ShardingConfig` is that object:
+
+- the named mesh (axes drawn from dp/tp/sp/pp/ep; any subset, any order),
+  built once and cached, or bound to an existing `jax.sharding.Mesh`;
+- per-param-family `PartitionSpec` rules (ordered regex -> spec template,
+  Megatron dp×tp BERT rules shipped as `ShardingConfig.for_transformer`);
+- activation constraint points (`constrain(x, kind)` inserts GSPMD
+  `with_sharding_constraint`s at the named points: "data", "act",
+  "tokens", "attention" — the SNIPPETS [1] pattern);
+- serialization (`to_dict`/`from_dict`) so checkpoints can record the
+  layout they were written under (resharding on membership change,
+  ROADMAP item 3, starts from exactly this metadata).
+
+Consumers: `DataParallelTrainer(sharding=cfg)` lays out params and
+optimizer slots by `param_sharding`; `PipelineRunner`/`PipelineTrainer`/
+`MoELayer`/`ring_attention` take `sharding=cfg` and pick their axis off
+the one mesh; `ops.attention.flash_attention` consults the ACTIVE config
+(`cfg.scope()` / `current()`) and reroutes through a `shard_map` entry
+over the named mesh (batch over dp, heads over tp, sequence over sp —
+see `ops.attention.flash_attention_sharded`).
+
+Spec templates are resolved against the mesh AND the concrete shape:
+axis names the mesh does not carry are dropped, and an axis whose size
+does not divide the dimension falls back to replicated for that dim —
+one config object therefore works unchanged across mesh shapes
+(dp-only, dp×tp, dp×tp×sp, a single device).
+
+This module imports nothing from mxnet_tpu at import time: gluon blocks
+and ops consult it through ``sys.modules`` guards, so a process that
+never builds a config pays nothing.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+import numpy as onp
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingConfig", "make_mesh", "current", "active_token",
+           "maybe_constrain_nd", "collective_census", "MESH_AXES"]
+
+#: canonical axis vocabulary (any subset, any order, may appear size-1)
+MESH_AXES = ("dp", "tp", "sp", "pp", "ep")
+
+
+def make_mesh(shape=None, axis_names=("dp",), devices=None):
+    """Create a Mesh over local devices.
+
+    - ``shape=None`` puts all devices on the first axis (trailing axes
+      size 1).
+    - ``axis_names`` longer than ``shape`` pads the shape with size-1
+      axes (a (4, 2) shape under ("dp", "tp", "sp") means sp=1).
+    - A shape whose product exceeds the available device count raises a
+      clear error (instead of propagating numpy's reshape failure); a
+      product smaller than the device count uses the first
+      ``prod(shape)`` devices.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    axis_names = tuple(axis_names)
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    shape = tuple(int(s) for s in shape)
+    if any(s < 1 for s in shape):
+        raise ValueError("make_mesh: mesh shape %r has a non-positive "
+                         "axis size" % (shape,))
+    if len(axis_names) > len(shape):
+        shape = shape + (1,) * (len(axis_names) - len(shape))
+    if len(shape) > len(axis_names):
+        raise ValueError(
+            "make_mesh: shape %r has %d axes but only %d axis names %r; "
+            "name every mesh axis" % (shape, len(shape), len(axis_names),
+                                      axis_names))
+    need = 1
+    for s in shape:
+        need *= s
+    if need > len(devices):
+        raise ValueError(
+            "make_mesh: mesh shape %r (=%s) needs %d devices but only %d "
+            "are available; pick a shape that factors the device count "
+            "(e.g. XLA_FLAGS=--xla_force_host_platform_device_count=%d "
+            "for a virtual CPU mesh)"
+            % (shape, "x".join(str(s) for s in shape), need, len(devices),
+               need))
+    arr = onp.array(devices[:need]).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+# ---------------------------------------------------------------------------
+# param-family rules
+# ---------------------------------------------------------------------------
+class ShardingRule:
+    """One per-param-family rule: a name regex and a spec template.
+
+    ``spec`` is a tuple with one entry per leading dimension: an axis
+    name (str), a tuple of axis names, or None (replicated).  Trailing
+    dims not covered by the template stay replicated.
+    """
+
+    __slots__ = ("pattern", "spec", "_re")
+
+    def __init__(self, pattern, spec):
+        self.pattern = str(pattern)
+        self.spec = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                          for a in spec)
+        self._re = re.compile(self.pattern)
+
+    def matches(self, name):
+        return self._re.search(name) is not None
+
+    def to_dict(self):
+        return {"pattern": self.pattern,
+                "spec": [list(a) if isinstance(a, tuple) else a
+                         for a in self.spec]}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["pattern"], d["spec"])
+
+    def __repr__(self):
+        return "ShardingRule(%r -> %r)" % (self.pattern, self.spec)
+
+    def __eq__(self, other):
+        return (isinstance(other, ShardingRule)
+                and self.pattern == other.pattern and self.spec == other.spec)
+
+
+# default activation constraint points: dim templates aligned to the
+# LEADING dims of whatever value is constrained (extra dims replicated)
+_DEFAULT_CONSTRAINTS = {
+    # any batch-major value: batch over dp
+    "data": ("dp",),
+    # generic layer activation (B, ..., C): batch over dp only — GSPMD
+    # propagates tp through the matmuls from the param shardings
+    "act": ("dp",),
+    # token stream (B, L, C): batch over dp, sequence over sp
+    "tokens": ("dp", "sp", None),
+    # attention heads layout (B, H, L, D): batch over dp, heads over tp,
+    # sequence over sp (SNIPPETS [1]'s q/k/v constraint in this repo's
+    # B,H,L,D layout)
+    "attention": ("dp", "tp", "sp", None),
+}
+
+_TLS = threading.local()
+
+
+def _stack():
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def current():
+    """The innermost active ShardingConfig (``with cfg.scope():``), or
+    None.  Consulted by gluon layers and ops.attention at trace time."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def active_token():
+    """Hashable token describing the active config for trace-cache keys
+    (HybridBlock._signature): flipping the active config retraces."""
+    cfg = current()
+    return cfg.signature() if cfg is not None else None
+
+
+def maybe_constrain_nd(x, kind):
+    """Constrain a gluon ndarray at a named point under the ACTIVE config
+    (no-op without one).  Recorded through apply_op so the autograd tape
+    sees it (the VJP of a sharding constraint is the same constraint)."""
+    cfg = current()
+    if cfg is None or not cfg.active:
+        return x
+    from mxnet_tpu.ndarray import apply_op, ndarray
+    if not isinstance(x, ndarray):
+        return cfg.constrain(x, kind)
+    return apply_op(lambda v: cfg.constrain(v, kind), x)
+
+
+class ShardingConfig:
+    """One config object for mesh axes, param layouts and activation
+    constraint points.
+
+    Args:
+      mesh: bind an existing jax.sharding.Mesh (axis_names/shape derived)
+      mesh_shape / axis_names: build the mesh lazily over local devices
+        (`make_mesh` semantics: names may outnumber shape entries)
+      rules: ordered ShardingRule list (or dicts) — first match wins
+      param_fn: escape hatch callable (name, shape) -> PartitionSpec
+        checked BEFORE rules (not serializable; to_dict refuses)
+      constraints: override/extend the named activation constraint points
+      data_axis: batch axis for input sharding (default: first mesh axis
+        named "dp", else the first axis)
+      devices: explicit device list for lazy mesh construction
+    """
+
+    def __init__(self, mesh=None, mesh_shape=None, axis_names=None,
+                 rules=(), param_fn=None, constraints=None, data_axis=None,
+                 devices=None):
+        if mesh is not None:
+            self._mesh = mesh
+            self.axis_names = tuple(mesh.axis_names)
+            self.mesh_shape = tuple(mesh.devices.shape)
+        else:
+            self._mesh = None
+            self.axis_names = tuple(axis_names) if axis_names else ("dp",)
+            if mesh_shape is not None:
+                mesh_shape = tuple(int(s) for s in mesh_shape)
+                if len(self.axis_names) > len(mesh_shape):
+                    mesh_shape = mesh_shape + (1,) * (
+                        len(self.axis_names) - len(mesh_shape))
+            self.mesh_shape = mesh_shape
+        self._devices = list(devices) if devices is not None else None
+        self.rules = [r if isinstance(r, ShardingRule)
+                      else ShardingRule.from_dict(r) for r in rules]
+        self.param_fn = param_fn
+        self.constraints = dict(_DEFAULT_CONSTRAINTS)
+        if constraints:
+            self.constraints.update(
+                {k: tuple(v) for k, v in constraints.items()})
+        if data_axis is None:
+            data_axis = "dp" if "dp" in self.axis_names else self.axis_names[0]
+        self.data_axis = data_axis
+
+    # -- mesh ---------------------------------------------------------------
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = make_mesh(self.mesh_shape, self.axis_names,
+                                   self._devices)
+            self.mesh_shape = tuple(self._mesh.devices.shape)
+        return self._mesh
+
+    def axis_size(self, name):
+        """Size of a mesh axis, 1 when the mesh does not carry it."""
+        if name not in self.axis_names:
+            return 1
+        return int(self.mesh.shape[name])
+
+    @property
+    def n_devices(self):
+        return int(self.mesh.devices.size)
+
+    @property
+    def active(self):
+        """Whether this config shards anything at all (>1 device)."""
+        return self.n_devices > 1
+
+    def describe(self):
+        return "x".join("%s=%d" % (a, self.axis_size(a))
+                        for a in self.axis_names)
+
+    # -- spec resolution ----------------------------------------------------
+    def _axis_factor(self, entry):
+        """Mesh size product of a spec entry (str | tuple | None), only
+        counting axes the mesh carries; returns (kept_entry, size)."""
+        if entry is None:
+            return None, 1
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(n for n in names if n in self.axis_names)
+        size = 1
+        for n in kept:
+            size *= self.axis_size(n)
+        if not kept or size == 1:
+            return None, 1
+        return (kept if len(kept) > 1 else kept[0]), size
+
+    def resolve_spec(self, template, shape=None, ndim=None):
+        """Resolve a spec template against this mesh (and a shape, when
+        given): unknown axes drop, non-dividing axes fall back to
+        replicated for that dim, trailing dims are replicated."""
+        template = tuple(template)
+        if ndim is None:
+            ndim = len(shape) if shape is not None else len(template)
+        out = []
+        for i in range(min(ndim, len(template))):
+            entry, size = self._axis_factor(template[i])
+            if entry is not None and shape is not None \
+                    and shape[i] % size != 0:
+                entry = None
+            out.append(entry)
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def param_spec(self, name, shape):
+        """PartitionSpec for a parameter: param_fn, then first matching
+        rule, else replicated."""
+        if self.param_fn is not None:
+            spec = self.param_fn(name, shape)
+            if spec is not None:
+                return self.resolve_spec(tuple(spec), shape)
+        for rule in self.rules:
+            if rule.matches(name):
+                return self.resolve_spec(rule.spec, shape)
+        return P()
+
+    def param_sharding(self, name, shape):
+        return NamedSharding(self.mesh, self.param_spec(name, shape))
+
+    def data_spec(self):
+        return self.resolve_spec((self.data_axis,))
+
+    def data_sharding(self):
+        return NamedSharding(self.mesh, self.data_spec())
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    # -- activation constraint points ---------------------------------------
+    def spec_for(self, kind, shape=None, ndim=None):
+        tmpl = self.constraints.get(kind)
+        if tmpl is None:
+            raise KeyError("unknown constraint point %r (known: %s)"
+                           % (kind, sorted(self.constraints)))
+        return self.resolve_spec(tmpl, shape=shape, ndim=ndim)
+
+    def constrain(self, x, kind):
+        """GSPMD sharding constraint at a named point (identity on a
+        1-device mesh).  Safe under jit/grad: with_sharding_constraint
+        is differentiable and its transpose is itself."""
+        if not self.active:
+            return x
+        shape = tuple(getattr(x, "shape", ()) or ())
+        spec = self.spec_for(kind, shape=shape if shape else None,
+                             ndim=len(shape) if shape else 0)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, spec))
+
+    # -- scope / identity ---------------------------------------------------
+    def scope(self):
+        """Context manager activating this config for gluon layers and
+        ops dispatched inside (see `current()`)."""
+        cfg = self
+
+        class _Scope:
+            def __enter__(self):
+                _stack().append(cfg)
+                return cfg
+
+            def __exit__(self, *exc):
+                st = _stack()
+                if st and st[-1] is cfg:
+                    st.pop()
+                elif cfg in st:  # defensive: unbalanced exit
+                    st.remove(cfg)
+                return False
+
+        return _Scope()
+
+    def signature(self):
+        """Content-hashable identity: two configs with the same axes,
+        shape, rules and constraint points trace-cache-share."""
+        return (self.axis_names, self.mesh_shape,
+                tuple((r.pattern, r.spec) for r in self.rules),
+                id(self.param_fn) if self.param_fn is not None else None,
+                tuple(sorted((k, tuple(v))
+                             for k, v in self.constraints.items())),
+                self.data_axis)
+
+    def __repr__(self):
+        return "ShardingConfig(%s, rules=%d%s)" % (
+            self.describe() if self._mesh is not None or self.mesh_shape
+            else ",".join(self.axis_names),
+            len(self.rules), ", param_fn" if self.param_fn else "")
+
+    # -- serialization (checkpoint metadata) --------------------------------
+    def to_dict(self):
+        if self.param_fn is not None:
+            raise ValueError(
+                "ShardingConfig with a param_fn callable is not "
+                "serializable; express the layout as ShardingRule "
+                "patterns instead")
+        # mesh_shape may still be unresolved (lazy mesh): resolve via the
+        # property only when a mesh was ever needed; None serializes fine
+        return {
+            "axis_names": list(self.axis_names),
+            "mesh_shape": list(self.mesh_shape) if self.mesh_shape else None,
+            "rules": [r.to_dict() for r in self.rules],
+            "constraints": {k: list(v) for k, v in self.constraints.items()},
+            "data_axis": self.data_axis,
+        }
+
+    @classmethod
+    def from_dict(cls, d, devices=None):
+        return cls(mesh_shape=d.get("mesh_shape"),
+                   axis_names=d.get("axis_names") or ("dp",),
+                   rules=[ShardingRule.from_dict(r)
+                          for r in d.get("rules", [])],
+                   constraints=d.get("constraints"),
+                   data_axis=d.get("data_axis"),
+                   devices=devices)
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_env(cls, devices=None, **kw):
+        """Build from MXNET_MESH_SHAPE ("4,2") + MXNET_MESH_AXES
+        ("dp,tp"); unset -> all devices on dp."""
+        shape_s = os.environ.get("MXNET_MESH_SHAPE", "").strip()
+        axes_s = os.environ.get("MXNET_MESH_AXES", "").strip()
+        axes = tuple(a.strip() for a in axes_s.split(",") if a.strip()) \
+            if axes_s else None
+        shape = None
+        if shape_s:
+            try:
+                shape = tuple(int(s) for s in shape_s.split(",") if s.strip())
+            except ValueError:
+                raise ValueError(
+                    "MXNET_MESH_SHAPE=%r is not a comma-separated int "
+                    "list (e.g. '4,2')" % shape_s)
+            if axes is None:
+                axes = MESH_AXES[:len(shape)]
+        return cls(mesh_shape=shape, axis_names=axes or ("dp",),
+                   devices=devices, **kw)
+
+    @classmethod
+    def for_transformer(cls, mesh=None, mesh_shape=None, axis_names=None,
+                        devices=None, **kw):
+        """Megatron-style dp×tp rules for this repo's transformer blocks
+        (BERT MHA/FFN Dense names): qkv/ffn1 column-parallel (units dim),
+        proj/ffn2 row-parallel (in_units dim), their biases follow the
+        column split, everything else replicated.  Works on ANY mesh —
+        axes the mesh lacks resolve away."""
+        rules = [
+            # column-parallel GEMMs: out-features dim 0 over tp
+            ShardingRule(r"(qkv|ffn1)\.weight$", ("tp", None)),
+            ShardingRule(r"(qkv|ffn1)\.bias$", ("tp",)),
+            # row-parallel GEMMs: in-features dim 1 over tp
+            ShardingRule(r"(attention\.proj|ffn2)\.weight$", (None, "tp")),
+            # row-parallel bias is a full-size add after the tp-reduce:
+            # replicated (no rule needed; default)
+        ]
+        return cls(mesh=mesh, mesh_shape=mesh_shape, axis_names=axis_names,
+                   rules=rules, devices=devices, **kw)
+
+
+# ---------------------------------------------------------------------------
+# collective census (steplat / CI gates)
+# ---------------------------------------------------------------------------
+#: HLO collective classes counted by `collective_census`
+COLLECTIVE_CLASSES = ("all-reduce", "all-gather", "reduce-scatter",
+                      "collective-permute", "all-to-all")
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s+[^=\s]*\s*(all-reduce|all-gather|reduce-scatter|"
+    r"collective-permute|all-to-all)(?:-start)?\(")
+
+
+def collective_census(compiled):
+    """Count collectives per class in optimized HLO.
+
+    `compiled` is a jax Compiled (``jit(f).lower(...).compile()``), a
+    Lowered, or raw HLO text.  Async pairs (``-start``/``-done``) count
+    once.  Deterministic and load-independent — safe to gate CI on,
+    exactly like the decode-launch census (fused_cell.count_launches):
+    the counts depend only on the program and partitioner, never on
+    machine load.
+    """
+    if hasattr(compiled, "compile"):        # Lowered -> Compiled
+        compiled = compiled.compile()
+    if hasattr(compiled, "as_text"):
+        text = compiled.as_text()
+    else:
+        text = str(compiled)
+    counts = {c: 0 for c in COLLECTIVE_CLASSES}
+    for line in text.splitlines():
+        if "-done(" in line:
+            continue
+        m = _COLLECTIVE_RE.search(line)
+        if m:
+            counts[m.group(1)] += 1
+    counts["total"] = sum(counts[c] for c in COLLECTIVE_CLASSES)
+    return counts
+
+
+def census_fn(fn, *args, **kwargs):
+    """Convenience: lower+compile ``fn`` on the given args and census its
+    collectives.  ``fn`` may already be jitted."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    return collective_census(jitted.lower(*args, **kwargs))

@@ -1,0 +1,420 @@
+"""Composed-sharding suite (ISSUE 10): ONE ShardingConfig threaded
+through gluon + ops on the 8-fake-device CPU mesh.
+
+Covers: make_mesh error/padding contract, the DataParallelTrainer
+param-sharding regression (ShardingConfig vs the legacy param_pspec
+surface), sharded flash attention fwd+grad parity vs the unsharded
+oracle, dp×tp BERT layer forward parity, pipeline/moe/ring_attention
+constructed from one config, config round-trip (checkpoint metadata),
+and the load-independent collective-census gates on the dp×tp train
+step (same strategy as the decode-launch gate from PR 8: counts are a
+static property of the compiled program, never of machine load).
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.models.bert import TransformerLayer
+from mxnet_tpu.ops import attention as att
+from mxnet_tpu.parallel import (DataParallelTrainer, ShardingConfig,
+                                ShardingRule, collective_census, make_mesh)
+from mxnet_tpu.parallel import shardcfg
+
+pytestmark = pytest.mark.multichip
+
+
+@pytest.fixture
+def eight_devices():
+    """Host-device-count fixture: the suite needs the virtual 8-device
+    CPU mesh conftest.py forces via XLA_FLAGS (or real hardware)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices "
+                    "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    return jax.devices()[:8]
+
+
+# ---------------------------------------------------------------------------
+# make_mesh contract (satellite 1)
+# ---------------------------------------------------------------------------
+def test_make_mesh_clear_error_on_bad_factorization(eight_devices):
+    with pytest.raises(ValueError) as ei:
+        make_mesh((5, 3), ("dp", "tp"))
+    msg = str(ei.value)
+    assert "15 devices" in msg and "8" in msg  # names both counts
+
+
+def test_make_mesh_pads_axis_names(eight_devices):
+    mesh = make_mesh((4, 2), ("dp", "tp", "sp"))
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2, "sp": 1}
+
+
+def test_make_mesh_rejects_unnamed_axes(eight_devices):
+    with pytest.raises(ValueError):
+        make_mesh((2, 2), ("dp",))
+
+
+def test_make_mesh_slices_extra_devices(eight_devices):
+    mesh = make_mesh((2,), ("dp",))
+    assert mesh.devices.size == 2
+
+
+# ---------------------------------------------------------------------------
+# ShardingConfig: rules, resolution, round-trip
+# ---------------------------------------------------------------------------
+def test_param_rules_megatron_layout(eight_devices):
+    cfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                         axis_names=("dp", "tp"))
+    assert cfg.param_spec("enc.l0.attention.qkv.weight", (192, 64)) \
+        == P("tp")
+    assert cfg.param_spec("enc.l0.attention.qkv.bias", (192,)) == P("tp")
+    assert cfg.param_spec("enc.l0.attention.proj.weight", (64, 64)) \
+        == P(None, "tp")
+    assert cfg.param_spec("enc.l0.ffn.ffn2.weight", (64, 128)) \
+        == P(None, "tp")
+    # non-matching + non-dividing both resolve to replicated
+    assert cfg.param_spec("enc.embed.weight", (1000, 64)) == P()
+    assert cfg.param_spec("x.qkv.weight", (3, 64)) == P()
+
+
+def test_spec_resolution_drops_unknown_axes(eight_devices):
+    cfg = ShardingConfig(mesh_shape=(8,), axis_names=("dp",))
+    # attention template names tp/sp; on a dp-only mesh they resolve away
+    assert cfg.spec_for("attention", shape=(8, 4, 64, 16)) == P("dp")
+
+
+def test_config_round_trip(eight_devices):
+    cfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                         axis_names=("dp", "tp"))
+    d = cfg.to_dict()
+    cfg2 = ShardingConfig.from_dict(d)
+    assert cfg2.to_dict() == d
+    assert cfg2.axis_names == cfg.axis_names
+    assert cfg2.param_spec("a.qkv.weight", (192, 64)) \
+        == cfg.param_spec("a.qkv.weight", (192, 64))
+    # the callable escape hatch is not serializable — must refuse loudly
+    cfg3 = ShardingConfig(mesh_shape=(8,), axis_names=("dp",),
+                          param_fn=lambda n, s: P())
+    with pytest.raises(ValueError):
+        cfg3.to_dict()
+
+
+def test_from_env(eight_devices, monkeypatch):
+    monkeypatch.setenv("MXNET_MESH_SHAPE", "4,2")
+    monkeypatch.setenv("MXNET_MESH_AXES", "dp,tp")
+    cfg = ShardingConfig.from_env()
+    assert cfg.describe() == "dp=4xtp=2"
+    monkeypatch.setenv("MXNET_MESH_SHAPE", "oops")
+    with pytest.raises(ValueError):
+        ShardingConfig.from_env()
+
+
+def test_scope_stack_and_token(eight_devices):
+    cfg = ShardingConfig(mesh_shape=(8,), axis_names=("dp",))
+    assert shardcfg.current() is None
+    with cfg.scope():
+        assert shardcfg.current() is cfg
+        tok = shardcfg.active_token()
+        assert tok is not None and hash(tok) is not None
+    assert shardcfg.current() is None and shardcfg.active_token() is None
+
+
+# ---------------------------------------------------------------------------
+# DataParallelTrainer regression (satellite 2): ShardingConfig routes
+# produce EXACTLY the shardings the deleted _param_sharding produced
+# ---------------------------------------------------------------------------
+def test_trainer_param_sharding_regression_dp_only(eight_devices):
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = np.random.uniform(size=(8, 8))
+    net(x[:1])
+    loss_obj = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh((8,), ("dp",))
+    tr = DataParallelTrainer(net, lambda o, l: loss_obj(o, l), "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9},
+                             mesh=mesh)
+    state = tr.init_state()
+    # pre-refactor contract: every param and slot replicated on a dp-only
+    # mesh (param_pspec default = P()), batch sharded over dp
+    for k, v in state["params"].items():
+        want = NamedSharding(mesh, P())
+        assert v.sharding.is_equivalent_to(want, v.ndim), k
+    for k, s in state["slots"].items():
+        assert s.sharding.is_equivalent_to(
+            NamedSharding(mesh, P()), s.ndim), k
+    # and the one source of truth is the config object
+    assert tr.sharding.data_sharding().is_equivalent_to(
+        NamedSharding(mesh, P("dp")), 2)
+    assert not hasattr(tr, "_param_sharding")
+
+
+def test_trainer_legacy_pspec_equals_config_rules(eight_devices):
+    """The legacy param_pspec surface and equivalent ShardingRules place
+    every parameter identically (tp Megatron layout on dp×tp)."""
+    def build(**kw):
+        mx.random.seed(1)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+        net.initialize()
+        x = np.random.uniform(size=(8, 4))
+        net(x[:1])
+        loss_obj = gluon.loss.SoftmaxCrossEntropyLoss()
+        tr = DataParallelTrainer(net, lambda o, l: loss_obj(o, l), "sgd",
+                                 {"learning_rate": 0.1}, **kw)
+        return tr, tr.init_state()
+
+    mesh = make_mesh((4, 2), ("dp", "tp"))
+
+    def pspec(name, shape):
+        if name.endswith("weight") and len(shape) == 2 \
+                and shape[0] % 2 == 0:
+            return P("tp", None)
+        return P()
+
+    cfg = ShardingConfig(mesh=mesh,
+                         rules=[ShardingRule(r"weight$", ("tp", None))])
+    tr_legacy, st_legacy = build(mesh=mesh, param_pspec=pspec,
+                                 data_axis="dp")
+    tr_cfg, st_cfg = build(sharding=cfg)
+    for k in st_legacy["params"]:
+        a, b = st_legacy["params"][k], st_cfg["params"][k]
+        assert a.sharding.is_equivalent_to(b.sharding, a.ndim), k
+
+
+# ---------------------------------------------------------------------------
+# sharded flash attention: fwd + grad parity vs the unsharded oracle
+# ---------------------------------------------------------------------------
+def _qkv(B=8, H=4, L=64, D=16, seed=0):
+    rng = onp.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(B, H, L, D), jnp.float32)
+                 for _ in range(3))
+
+
+def test_sharded_flash_forward_parity(eight_devices):
+    q, k, v = _qkv()
+    cfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                         axis_names=("dp", "tp"))
+    ref = att.flash_attention(q, k, v)
+    assert att.last_sharded is None
+    with cfg.scope():
+        out = att.flash_attention(q, k, v)
+    assert att.last_sharded == "shard_map"
+    onp.testing.assert_array_equal(onp.asarray(out), onp.asarray(ref))
+
+
+@pytest.mark.parametrize("causal,window", [(False, None), (True, None),
+                                           (False, 8)])
+def test_sharded_flash_grad_parity(eight_devices, causal, window):
+    q, k, v = _qkv()
+    cfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                         axis_names=("dp", "tp"))
+
+    def loss_sharded(q, k, v):
+        with cfg.scope():
+            return jnp.sum(att.flash_attention(q, k, v, causal=causal,
+                                               window=window) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(att.flash_attention(q, k, v, causal=causal,
+                                           window=window) ** 2)
+
+    gs = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_flash_kv_length_parity(eight_devices):
+    q, k, v = _qkv()
+    kl = jnp.asarray(onp.random.RandomState(1).randint(1, 64, size=(8,)),
+                     jnp.int32)
+    cfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                         axis_names=("dp", "tp"))
+    ref = att.attention_reference(q, k, v, kv_length=kl)
+    with cfg.scope():
+        out = att.flash_attention(q, k, v, kv_length=kl)
+    assert att.last_sharded == "shard_map"
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_flash_ring_route_on_sp(eight_devices):
+    q, k, v = _qkv()
+    cfg = ShardingConfig.for_transformer(mesh_shape=(2, 2, 2),
+                                         axis_names=("dp", "tp", "sp"))
+    ref = att.attention_reference(q, k, v, causal=True)
+    with cfg.scope():
+        out = att.flash_attention(q, k, v, causal=True)
+    assert att.last_sharded == "ring"
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_flash_ineligible_falls_back(eight_devices):
+    q, k, v = _qkv()
+    cfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                         axis_names=("dp", "tp"))
+    mask = jnp.ones((8, 4, 64, 64), bool)
+    with cfg.scope():
+        att.flash_attention(q, k, v, mask=mask)  # dense mask → local
+        assert att.last_sharded is None
+        # gate off → local even though the config is active
+        import os
+        os.environ["MXNET_SHARDED_FLASH"] = "0"
+        try:
+            att.flash_attention(q, k, v)
+            assert att.last_sharded is None
+        finally:
+            os.environ.pop("MXNET_SHARDED_FLASH")
+
+
+def test_sharded_flash_dropout_decorrelated(eight_devices):
+    """In-kernel dropout under dp must use per-shard keys: the sharded
+    output differs from the single-key local output, and parity holds
+    with dropout off."""
+    q, k, v = _qkv()
+    cfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                         axis_names=("dp", "tp"))
+    key = jax.random.key(7)
+    with cfg.scope():
+        od = att.flash_attention(q, k, v, dropout=0.5, dropout_key=key)
+    assert att.last_sharded == "shard_map"
+    ol = att._flash_local(q, k, v, dropout=0.5, dropout_key=key)
+    assert bool(jnp.any(od != ol))
+
+
+# ---------------------------------------------------------------------------
+# dp×tp BERT layer forward parity (gluon threading: constraints +
+# signature token + sharded flash, eager AND hybridized)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_bert_layer_dp_tp_forward_parity(eight_devices, hybridize):
+    mx.random.seed(0)
+    layer = TransformerLayer(units=64, hidden_size=128, num_heads=2,
+                             dropout=0.0)
+    layer.initialize()
+    if hybridize:
+        layer.hybridize()
+    x = np.array(onp.random.RandomState(0)
+                 .randn(8, 32, 64).astype("float32"))
+    cfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                         axis_names=("dp", "tp"))
+    ref = layer(x)
+    with cfg.scope():
+        out = layer(x)
+    assert float(np.abs(out - ref).max()) == 0.0
+    # flipping the active config must retrace, not reuse a stale cache
+    flat = [x]
+    sig_off = layer._signature([a for a in flat])
+    with cfg.scope():
+        sig_on = layer._signature([a for a in flat])
+    assert sig_off != sig_on
+
+
+# ---------------------------------------------------------------------------
+# one config object constructs pipeline / moe / ring_attention
+# ---------------------------------------------------------------------------
+def test_one_config_builds_pp_ep_sp(eight_devices):
+    cfg = ShardingConfig(mesh_shape=(2, 2, 2),
+                         axis_names=("pp", "sp", "ep"))
+    from mxnet_tpu.parallel.moe import MoELayer
+    from mxnet_tpu.parallel.pipeline import PipelineRunner
+    from mxnet_tpu.parallel.ring_attention import ring_attention
+
+    # pp: 2-stage pipeline off the pp axis of the SAME mesh
+    def stage(params, h):
+        return h @ params["w"]
+
+    runner = PipelineRunner([stage, stage], sharding=cfg, axis="pp")
+    w = jnp.eye(4, dtype=jnp.float32)
+    y = runner.apply([{"w": w}, {"w": 2.0 * w}],
+                     jnp.ones((4, 4), jnp.float32), n_microbatches=2)
+    onp.testing.assert_allclose(onp.asarray(y), 2.0 * onp.ones((4, 4)),
+                                rtol=1e-6)
+
+    # ep: MoE off the ep axis
+    moe = MoELayer(num_experts=4, d_model=8, d_hidden=16, sharding=cfg,
+                   axis="ep", capacity_factor=64.0)
+    mp = moe.init(jax.random.key(0))
+    toks = jax.random.normal(jax.random.key(1), (8, 8))
+    onp.testing.assert_allclose(onp.asarray(moe.apply(mp, toks)),
+                                onp.asarray(moe.dense_reference(mp, toks)),
+                                atol=1e-4)
+
+    # sp: ring attention off the sp axis
+    rng = onp.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 2, 8, 4).astype(onp.float32))
+    out = ring_attention(q, q, q, sharding=cfg, seq_axis="sp")
+    ref = att.attention_reference(q, q, q)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# collective-census gate (satellite 5): static, load-independent counts
+# ---------------------------------------------------------------------------
+def _census_of_step(cfg, B=8, L=16, units=32):
+    mx.random.seed(2)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(units, activation="relu", flatten=False),
+            nn.Dense(units, flatten=False))
+    net.initialize()
+    x = np.random.uniform(size=(B, L, units))
+    net(x)
+    tr = DataParallelTrainer(net, lambda o, l: (o - l) ** 2, "sgd",
+                             {"learning_rate": 0.1}, sharding=cfg)
+    state = tr.init_state()
+    step = tr.build_step(donate=False)
+    xb = x._data
+    return collective_census(step.lower(
+        state, xb, jnp.zeros_like(xb), jax.random.key(0),
+        jnp.float32(0.1)))
+
+
+def test_collective_census_gate_dp(eight_devices):
+    cfg = ShardingConfig(mesh_shape=(8,), axis_names=("dp",))
+    c = _census_of_step(cfg)
+    # dp grad sync is all-reduce only: no resharding collectives
+    assert c["all-reduce"] >= 1
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0
+    assert c["all-to-all"] == 0 and c["collective-permute"] == 0
+
+
+def test_collective_census_gate_dp_tp(eight_devices):
+    cfg = ShardingConfig(
+        mesh_shape=(4, 2), axis_names=("dp", "tp"),
+        rules=[ShardingRule(r"weight$", ("tp", None))])
+    c = _census_of_step(cfg)
+    assert c["all-reduce"] >= 1          # dp grad sync
+    assert c["all-to-all"] == 0          # no ep traffic in a dense step
+    assert c["collective-permute"] == 0  # no ring traffic without sp
+
+
+def test_collective_census_load_independent(eight_devices):
+    """The gate's premise: counts are a property of the PROGRAM — they
+    must not change with the per-step data volume (batch size)."""
+    cfg = ShardingConfig(
+        mesh_shape=(4, 2), axis_names=("dp", "tp"),
+        rules=[ShardingRule(r"weight$", ("tp", None))])
+    c_small = _census_of_step(cfg, B=8)
+    c_large = _census_of_step(cfg, B=32)
+    assert c_small == c_large
+
+
+def test_census_counts_async_pairs_once():
+    hlo = """
+  a = f32[4] all-reduce-start(b), replica_groups={}
+  c = f32[4] all-reduce-done(a)
+  d = f32[4] all-gather(e), replica_groups={}
+"""
+    c = collective_census(hlo)
+    assert c["all-reduce"] == 1 and c["all-gather"] == 1
+    assert c["total"] == 2
