@@ -292,6 +292,34 @@ register("MXNET_GEN_SESSION_TTL", float, 300.0, "honored",
          "idle parked decode-session lifetime in seconds before its KV "
          "pages are reclaimed (resume after that -> SessionResetError)",
          "serving.DecodeEngine")
+register("MXNET_GEN_PREFIX_CACHE", int, 1, "honored",
+         "1 = share prompt-prefix KV pages copy-on-write across "
+         "sequences (vLLM-style prefix caching); 0 = every sequence "
+         "prefills privately",
+         "serving.DecodeEngine")
+register("MXNET_GEN_MIGRATE", int, 1, "honored",
+         "1 = decode sessions are migratable: parked-session "
+         "transcripts (and, on drain/rollout, full KV page blobs) are "
+         "pushed to the fleet page store so a surviving replica can "
+         "pull or recompute them instead of raising SessionResetError; "
+         "0 = sessions die with their replica (pre-PR-11 behavior)",
+         "serving.DecodeEngine")
+register("MXNET_GEN_PAGESTORE", str, "", "honored",
+         "host:port of the fleet page store (kvstore-framed transport "
+         "for KV session blobs); empty = no store, migration disabled. "
+         "ServingFleet starts one in-process and stamps this into "
+         "every replica",
+         "serving.DecodeEngine")
+register("MXNET_GEN_ROLE", str, "mixed", "honored",
+         "replica specialization: 'prefill' (chunk long prompts, hand "
+         "finished KV pages to a decode replica via the page store), "
+         "'decode', or 'mixed' (default: both phases)",
+         "serving.DecodeEngine")
+register("MXNET_GEN_DISAGG_MIN_PROMPT", int, 32, "honored",
+         "router: fresh prompts at least this many tokens long are "
+         "split prefill/decode across specialized replicas (ignored "
+         "unless the fleet has both a prefill and a decode pool)",
+         "serving.Router")
 register("MXNET_PAGED_ATTENTION", str, "", "honored",
          "paged-attention dispatch: '' auto (Pallas kernel on TPU, XLA "
          "gather reference on CPU), '0' forces the reference, "

@@ -32,6 +32,12 @@ Sites (grep for ``faults.check``):
   kvcache.alloc      paged KV-cache page allocation (exception kinds fail
                      only the allocating sequence; genuine exhaustion is
                      NOT a fault — it triggers preemption)
+  session.export     decode-session KV export (serialize page table +
+                     pages for migration); a raise aborts the export —
+                     the session stays parked on the source replica
+  session.import     decode-session KV import on the receiving replica
+                     (torn-transfer drill: a raise drops the pulled
+                     record, so the resume sees the typed reset path)
 
 Kinds: ``reset`` (ConnectionResetError), ``timeout`` (socket.timeout),
 ``error``/``crash`` (RuntimeError), plus site-interpreted kinds that
@@ -87,7 +93,7 @@ _SOFT_KINDS = ("drop", "torn", "preempt", "kill")
 KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
                "server.membership", "trainer.step", "checkpoint.write",
                "router.dispatch", "replica.crash", "decode.step",
-               "kvcache.alloc")
+               "kvcache.alloc", "session.export", "session.import")
 
 
 class FaultRule:

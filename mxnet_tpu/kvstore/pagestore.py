@@ -1,0 +1,225 @@
+"""Fleet page store: the rendezvous for migrating decode-session KV.
+
+Session migration (serving PR 11) needs a place a dying, draining, or
+prefill-specialized replica can PUSH a session's state and a surviving
+(or decode-specialized) replica can PULL it — without the two ever
+talking directly, because the puller usually outlives the pusher.  This
+module is that store: a tiny in-memory record server speaking the
+kvstore framed wire protocol (``dist._encode_msg``/``_recv_msg`` — the
+same 8-byte length-prefixed JSON header + raw frames that carries
+parameter shards), with clients riding ``dist._ServerConn`` so pushes
+and pulls inherit the kvstore's bounded-retry / reconnect / backoff
+machinery for free.
+
+Records are keyed ``"<model>/<session-id>"`` and are one of
+
+- ``{"kind": "pages", "blob": <bytes>}`` — a full
+  ``kvcache.pack_session`` buffer (page table + live pages, CRC-guarded;
+  import is bit-identical), pushed on drain/rollout/prefill-handoff;
+- ``{"kind": "transcript", "history": [...], "pending": tok|None}`` —
+  the replay recipe, pushed synchronously at every session park so even
+  SIGKILL loses nothing a recompute can't rebuild (prefix caching makes
+  the recompute cheap).
+
+Two properties the migration protocol leans on:
+
+- **``take`` is destructive and atomic** — exactly one puller wins a
+  record, so a session never decodes on two replicas at once.
+- **Generation fencing** — every record carries a ``gen`` counter
+  (bumped at each park); the store remembers the high-water ``gen`` per
+  key even after a take, a put must STRICTLY exceed it, and a take
+  claims ``gen + 1`` for the taker — so a lagging replica (e.g. a
+  drained one exporting after a survivor already claimed the session)
+  can never re-push state the taker has superseded.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+
+from .dist import _ServerConn, _recv_msg, _send_msg
+
+__all__ = ["PageStoreServer", "PageStoreClient"]
+
+_log = logging.getLogger(__name__)
+
+
+class PageStoreServer:
+    """In-memory keyed record store over the kvstore wire protocol.
+
+    One accept loop + one thread per connection (replica counts are
+    small); all state is a dict under one lock.  Ops:
+
+      {"op": "put", "key", "gen", "rec"} -> {"ok": bool}   (gen fencing)
+      {"op": "take", "key"}             -> {"rec": rec|None, "gen": int}
+      {"op": "delete", "key"}           -> {"ok": True}
+      {"op": "stats"}                   -> {"records", "gens", counters}
+    """
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self.host = host
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._lock = threading.Lock()
+        self._records = {}   # key -> (gen, rec)
+        self._gens = {}      # key -> high-water gen (survives take)
+        self.counters = {"puts": 0, "stale_puts": 0, "takes": 0,
+                         "misses": 0, "deletes": 0}
+        self._stop = threading.Event()
+        self._accept = None
+
+    @property
+    def address(self):
+        return "%s:%d" % (self.host, self.port)
+
+    def start(self):
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        name="mxtpu-pagestore",
+                                        daemon=True)
+        self._accept.start()
+        return self.address
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._accept is not None:
+            self._accept.join(5.0)
+
+    # -- server loop ------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._stop.is_set():
+                msg = _recv_msg(conn)
+                _send_msg(conn, self._handle(msg))
+        except (OSError, ValueError):
+            pass  # client went away / torn frame: drop the conn
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg):
+        op = msg.get("op")
+        key = msg.get("key")
+        with self._lock:
+            if op == "put":
+                gen = int(msg.get("gen", 0))
+                if gen <= self._gens.get(key, -1):
+                    self.counters["stale_puts"] += 1
+                    return {"ok": False, "gen": self._gens[key]}
+                self._gens[key] = gen
+                self._records[key] = (gen, msg["rec"])
+                self.counters["puts"] += 1
+                return {"ok": True, "gen": gen}
+            if op == "take":
+                item = self._records.pop(key, None)
+                if item is None:
+                    self.counters["misses"] += 1
+                    return {"rec": None, "gen": self._gens.get(key, 0)}
+                # the taker CLAIMS the next generation: high-water moves
+                # to gen+1, so a lagging previous holder (a drained
+                # replica exporting after the handoff) can never re-push
+                # state the taker has already superseded
+                claimed = item[0] + 1
+                self._gens[key] = max(self._gens.get(key, -1), claimed)
+                self.counters["takes"] += 1
+                return {"rec": item[1], "gen": claimed}
+            if op == "delete":
+                self._records.pop(key, None)
+                self._gens.pop(key, None)
+                self.counters["deletes"] += 1
+                return {"ok": True}
+            if op == "stats":
+                return {"records": len(self._records),
+                        "gens": len(self._gens),
+                        "counters": dict(self.counters)}
+            return {"error": "unknown op %r" % (op,)}
+
+
+class PageStoreClient:
+    """One replica's handle on the page store (lazy, self-healing).
+
+    Wraps ``dist._ServerConn`` — requests retry with backoff through
+    transparent reconnects, so a store hiccup degrades to latency, not
+    session loss.  All methods swallow transport failure into a soft
+    result (put -> False, take -> None): migration is best-effort by
+    contract; the typed ``SessionResetError`` fallback still exists."""
+
+    def __init__(self, host, port, timeout=10.0):
+        self.host, self.port = host, int(port)
+        self._timeout = float(timeout)
+        self._conn = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_addr(cls, addr, timeout=10.0):
+        host, _, port = addr.rpartition(":")
+        return cls(host or "127.0.0.1", int(port), timeout)
+
+    def _connection(self):
+        with self._lock:
+            if self._conn is None:
+                self._conn = _ServerConn(self.host, self.port,
+                                         timeout=self._timeout)
+            return self._conn
+
+    def _request(self, msg):
+        return self._connection().request(msg)
+
+    def put(self, key, rec, gen=0):
+        """Store ``rec`` under ``key`` unless the store has seen a newer
+        generation; returns True when accepted."""
+        try:
+            return bool(self._request({"op": "put", "key": key,
+                                       "gen": int(gen),
+                                       "rec": rec}).get("ok"))
+        except (OSError, RuntimeError) as e:
+            _log.warning("pagestore put %s failed: %r", key, e)
+            return False
+
+    def take(self, key):
+        """Atomically claim and remove ``key``'s record; returns
+        ``(rec, gen)`` or ``(None, gen)`` when absent/unreachable."""
+        try:
+            out = self._request({"op": "take", "key": key})
+            return out.get("rec"), int(out.get("gen", 0))
+        except (OSError, RuntimeError) as e:
+            _log.warning("pagestore take %s failed: %r", key, e)
+            return None, 0
+
+    def delete(self, key):
+        try:
+            return bool(self._request({"op": "delete",
+                                       "key": key}).get("ok"))
+        except (OSError, RuntimeError):
+            return False
+
+    def stats(self):
+        try:
+            return self._request({"op": "stats"})
+        except (OSError, RuntimeError):
+            return None
+
+    def close(self):
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None

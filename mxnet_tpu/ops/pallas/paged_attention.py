@@ -37,7 +37,8 @@ import os
 import jax
 import jax.numpy as jnp
 
-__all__ = ["paged_attention", "paged_attention_reference", "last_path"]
+__all__ = ["paged_attention", "paged_attention_reference", "copy_page",
+           "last_path"]
 
 # Which path the last call took: "pallas" | "pallas-interpret" | "xla".
 # Tests assert on this to guarantee the kernel is actually exercised.
@@ -97,12 +98,30 @@ def gather_pages(pages, page_indices):
     pages: (KVH, P, S, D); page_indices: (B, pages_per_seq) int32
     -> (B, KVH, pages_per_seq * S, D), token-major per sequence — exactly
     the contiguous cache layout a non-paged decoder would hold.
+
+    Page tables may alias: with copy-on-write prefix caching
+    (``serving/kvcache.PrefixCache``) the same physical page id appears
+    in several rows (and the scratch page in many), and a gather reads
+    each reference independently — shared pages need no special casing
+    here, only the write path must never scatter into a page whose
+    refcount exceeds one (the engine forks first).
     """
     kvh, _, s, d = pages.shape
     b, pps = page_indices.shape
     # (KVH, B, pps, S, D) -> (B, KVH, pps*S, D)
     g = jnp.swapaxes(pages[:, page_indices], 0, 1)
     return g.reshape(b, kvh, pps * s, d)
+
+
+def copy_page(pages, src, dst):
+    """Duplicate one physical page: ``pages[..., dst, :, :] <-
+    pages[..., src, :, :]``.  Works on any layout whose page axis is
+    third-from-last — both the kernel layout ``(KVH, P, S, D)`` and the
+    engine's stacked ``(L, KVH, P, S, D)``.  This is the device half of
+    a copy-on-write fork (``PageAllocator.fork`` is the bookkeeping
+    half): the writer copies the shared page into its fresh private one
+    before the first divergent write."""
+    return pages.at[..., dst, :, :].set(pages[..., src, :, :])
 
 
 def attend_ctx(q, k_ctx, v_ctx, lengths, scale):

@@ -47,6 +47,24 @@ serving"):
   the consistent-hash ``affinity_key`` back to the replica holding its
   KV pages (``SessionResetError`` when that replica is gone).
 
+Session-migration tier (sessions outlive their replica — see README
+"Session migration & prefix caching"):
+
+- ``PrefixCache`` / ``PageAllocator`` refcounts (``kvcache.py``) —
+  content-addressed shared prompt-prefix pages, forked copy-on-write at
+  the first divergent write; ``pack_session``/``unpack_session`` are
+  the CRC-guarded bit-exact session wire format.
+- ``PageStoreServer``/``PageStoreClient`` (``kvstore/pagestore.py``) —
+  the generation-fenced rendezvous a dying replica pushes sessions to
+  and a survivor pulls them from; ``ServingFleet`` boots one and
+  ``rollout`` migrates parked sessions instead of resetting them.
+- Role specialization — ``roles=["prefill", "decode", ...]`` splits the
+  fleet into a prefill pool (chunked long-prompt prefill, KV handoff
+  through the store) and a decode pool; the router runs the two-phase
+  disaggregated dispatch.
+- ``ServingClient.generate(resume_on_reset=True)`` — transparent
+  client-side transcript replay when every server-side copy is gone.
+
 Quick start::
 
     import mxnet_tpu as mx
@@ -60,15 +78,17 @@ Quick start::
 from __future__ import annotations
 
 from .errors import (BadRequestError, DeadlineExceededError,
-                     FleetUnavailableError, ModelNotFoundError,
-                     QueueFullError, RolloutAbortedError,
-                     ServerClosedError, ServingError, SessionResetError)
+                     FleetUnavailableError, KVLeakError,
+                     ModelNotFoundError, QueueFullError,
+                     RolloutAbortedError, ServerClosedError,
+                     ServingError, SessionResetError)
 from .metrics import LatencyHistogram, ModelMetrics, ServingMetrics
 from .registry import (ModelRegistry, ServedModel, default_buckets,
                        load_model_spec, maybe_enable_compile_cache,
                        resolve_builder)
 from .batcher import DynamicBatcher
-from .kvcache import PageAllocator
+from .kvcache import (PageAllocator, PrefixCache, pack_session,
+                      unpack_session)
 from .generate import DecodeEngine
 from .server import ModelServer
 from .client import ServingClient
@@ -80,10 +100,12 @@ __all__ = [
     "ServingError", "BadRequestError", "ModelNotFoundError",
     "QueueFullError", "ServerClosedError", "DeadlineExceededError",
     "SessionResetError", "FleetUnavailableError", "RolloutAbortedError",
+    "KVLeakError",
     "ServingMetrics", "ModelMetrics", "LatencyHistogram",
     "ModelRegistry", "ServedModel", "default_buckets",
     "load_model_spec", "maybe_enable_compile_cache", "resolve_builder",
-    "DynamicBatcher", "PageAllocator", "DecodeEngine",
+    "DynamicBatcher", "PageAllocator", "PrefixCache", "pack_session",
+    "unpack_session", "DecodeEngine",
     "ModelServer", "ServingClient",
     "FleetMetrics", "Replica", "Router", "RouterServer",
     "ReplicaProcess", "ReplicaSupervisor", "ServingFleet", "rollout",

@@ -25,7 +25,7 @@ import time
 import numpy as onp
 
 from .. import config as _config
-from .errors import ServingError, error_for_code
+from .errors import ServingError, SessionResetError, error_for_code
 
 __all__ = ["ServingClient"]
 
@@ -45,6 +45,9 @@ class ServingClient:
         # payloads, so a non-deterministic seed is fine
         self._jitter = random.Random(os.getpid() ^ id(self))
         self._conn = None
+        # (model, session) -> full token transcript (prompts + replies),
+        # the client-side replay recipe behind resume_on_reset
+        self._transcripts = {}
 
     # -- plumbing ---------------------------------------------------------
     def _connection(self):
@@ -144,7 +147,7 @@ class ServingClient:
         return onp.asarray(doc["predictions"])
 
     def generate(self, model, prompt, max_tokens=16, *, session=None,
-                 resume=False, deadline_ms=None):
+                 resume=False, resume_on_reset=False, deadline_ms=None):
         """Autoregressive generation: ``prompt`` is a list of token ids;
         returns the server's result dict (``tokens``, ``finish_reason``,
         token counts).
@@ -156,9 +159,19 @@ class ServingClient:
         double-advance the session).  ``resume=True`` demands the
         session exist — a replica that lost it answers with the typed
         :class:`~.errors.SessionResetError` (409) and the caller
-        restarts generation from the full prompt."""
-        body = {"prompt": [int(t) for t in prompt],
-                "max_tokens": int(max_tokens)}
+        restarts generation from the full prompt.
+
+        ``resume_on_reset=True`` makes that restart transparent: the
+        client accumulates the session's transcript (every prompt and
+        every reply) and, on a 409, replays it ONCE as a fresh prompt
+        under the same session id — one attempt, still non-idempotent
+        (the reset reply proves the server did not advance the session,
+        so the replay cannot double-run anything; a second consecutive
+        409 surfaces)."""
+        prompt = [int(t) for t in prompt]
+        skey = (model, str(session)) if session is not None else None
+        hist = list(self._transcripts.get(skey, ())) if skey else []
+        body = {"prompt": prompt, "max_tokens": int(max_tokens)}
         if deadline_ms is not None:
             body["deadline_ms"] = float(deadline_ms)
         if session is not None:
@@ -166,8 +179,21 @@ class ServingClient:
             body["affinity_key"] = str(session)
             body["idempotent"] = False
             body["resume"] = bool(resume)
-        return self._request("POST", "/v1/models/%s:generate" % model,
-                             body)
+        path = "/v1/models/%s:generate" % model
+        try:
+            doc = self._request("POST", path, body)
+        except SessionResetError:
+            if not (resume_on_reset and skey):
+                raise
+            # the server lost the session but processed nothing: replay
+            # the whole transcript + this turn as a fresh prompt
+            body = dict(body, prompt=hist + prompt, resume=False)
+            doc = self._request("POST", path, body)
+        if skey:
+            self._transcripts[skey] = (hist + prompt
+                                       + [int(t) for t in
+                                          doc.get("tokens", ())])
+        return doc
 
     def server_alive(self):
         """Liveness probe: one /healthz round trip, no retries — True iff

@@ -60,6 +60,20 @@ class SessionResetError(ServingError):
     code = "session_reset"
 
 
+class KVLeakError(ServingError):
+    """The page allocator's conservation invariant broke: a page is
+    missing from (or duplicated across) the free list and the owner
+    lists, or the scratch page escaped into circulation.  Carries the
+    offending page ids in ``pages`` — this is a serving bug, not a
+    client error, so it maps to 500."""
+    http_status = 500
+    code = "kv_leak"
+
+    def __init__(self, message, pages=()):
+        super().__init__(message)
+        self.pages = sorted(pages)
+
+
 class FleetUnavailableError(ServingError):
     """The fleet router has no routable replica for this request (all
     ejected/unready/failed).  503 with Retry-After: the condition is
@@ -81,7 +95,7 @@ CODE_TO_ERROR = {
     cls.code: cls
     for cls in (ServingError, BadRequestError, ModelNotFoundError,
                 QueueFullError, ServerClosedError, DeadlineExceededError,
-                SessionResetError, FleetUnavailableError,
+                SessionResetError, KVLeakError, FleetUnavailableError,
                 RolloutAbortedError)
 }
 

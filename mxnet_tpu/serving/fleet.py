@@ -33,6 +33,17 @@ A fleet-wide rollout is therefore: at most one replica warming at any
 moment, N-1 (or N, via the last-resort drain route) replicas serving
 the whole time, and an abort path that converges back to the old
 version without restarting anything.
+
+Session migration (serving PR 11): the fleet boots a
+:class:`~mxnet_tpu.kvstore.pagestore.PageStoreServer` and hands its
+address to every replica (``MXNET_GEN_PAGESTORE``), so decode sessions
+outlive any single replica — a drained/rolled/killed replica's parked
+sessions are pushed (or, after SIGKILL, recovered from their replayed
+transcripts) and pulled by whichever survivor the router picks next.
+``rollout`` migrates each replica's parked sessions out before the
+admin load instead of resetting them, and ``roles=`` specializes
+replicas into prefill/decode pools (``router.Router`` routes fresh long
+prompts to prefill, everything else to decode).
 """
 from __future__ import annotations
 
@@ -44,6 +55,7 @@ import numpy as onp
 
 from .. import config as _config
 from .. import profiler
+from ..kvstore.pagestore import PageStoreServer
 from .errors import RolloutAbortedError, ServingError
 from .metrics import LatencyHistogram
 from .router import Router, RouterServer
@@ -94,6 +106,27 @@ def _probe(host, port, name, version, item, n, deadline_ms=2000.0,
         hist.observe(time.monotonic() - t0)
     snap = hist.snapshot()
     return errors, snap.get("p99_ms")
+
+
+def _migrate_sessions(host, port, timeout=30.0):
+    """Push every generate engine's parked sessions on one replica out
+    to the fleet page store (best-effort: a replica without generators,
+    without a store, or already dead migrates nothing)."""
+    migrated = 0
+    try:
+        status, doc = _replica_request(host, port, "GET", "/v1/stats",
+                                       timeout=timeout)
+        if status != 200:
+            return 0
+        for gname in (doc.get("generators") or {}):
+            status, out = _replica_request(
+                host, port, "POST", "/v1/admin/migrate_out",
+                {"name": gname}, timeout=timeout)
+            if status == 200:
+                migrated += int(out.get("migrated", 0))
+    except OSError:
+        return migrated
+    return migrated
 
 
 def rollout(router, model_spec, *, canary_probes=8,
@@ -164,6 +197,12 @@ def rollout(router, model_spec, *, canary_probes=8,
             _, baseline_p99 = _probe(r.host, r.port, name, None,
                                      probe_item, canary_probes)
         router.set_drain(rid, True)
+        # migrate parked decode sessions out BEFORE the load: a rollout
+        # that swaps a generate engine must not reset anyone's chat —
+        # the sessions sit in the page store until their next turn
+        # pulls them (usually right back onto this replica, re-warmed)
+        migrated = _migrate_sessions(r.host, r.port,
+                                     timeout=admin_timeout_s)
         try:
             status, doc = _replica_request(
                 r.host, r.port, "POST", "/v1/admin/load", spec,
@@ -176,7 +215,8 @@ def rollout(router, model_spec, *, canary_probes=8,
         applied.append(rid)
         router.set_drain(rid, False)
         report["replicas"].append({"rid": rid,
-                                   "warmed": doc["model"]["warmed"]})
+                                   "warmed": doc["model"]["warmed"],
+                                   "migrated_sessions": migrated})
         if i == 0 and probe_item is not None:
             errors, p99 = _probe(r.host, r.port, name, version,
                                  probe_item, canary_probes)
@@ -214,26 +254,46 @@ class ServingFleet:
     """
 
     def __init__(self, spec, *, replicas=None, policy="least_loaded",
-                 host="127.0.0.1", port=0, env=None,
+                 host="127.0.0.1", port=0, env=None, roles=None,
                  router_kwargs=None, supervisor_kwargs=None):
         self.supervisor = ReplicaSupervisor(
             spec, replicas=replicas, host=host, env=env,
             **(supervisor_kwargs or {}))
+        # roles: per-replica "prefill" | "decode" | "mixed", by index
+        # (spec may also carry a "roles" list); short lists pad "mixed"
+        roles = list(roles if roles is not None
+                     else (spec.get("roles") or []))
+        self._roles = [str(roles[i]) if i < len(roles) else "mixed"
+                       for i in range(len(self.supervisor.replicas))]
+        for r, role in zip(self.supervisor.replicas, self._roles):
+            if role != "mixed":
+                self.supervisor.env_by_rid.setdefault(
+                    r.rid, {})["MXNET_GEN_ROLE"] = role
         self._policy = policy
         self._router_kwargs = dict(router_kwargs or {})
         self._host = host
         self._port = int(port)
         self.router = None
         self.server = None
+        self.pagestore = None
 
     @property
     def address(self):
         return self.server.address
 
     def start(self):
+        # the fleet page store is the session-migration rendezvous; every
+        # replica learns its address through the environment (an env=
+        # override of MXNET_GEN_PAGESTORE wins — e.g. an external store)
+        if (int(_config.get("MXNET_GEN_MIGRATE"))
+                and "MXNET_GEN_PAGESTORE" not in self.supervisor.env):
+            self.pagestore = PageStoreServer(host=self._host)
+            self.supervisor.env["MXNET_GEN_PAGESTORE"] = (
+                self.pagestore.start())
         self.supervisor.start()
         self.router = Router(self.supervisor.addresses(),
-                             policy=self._policy, **self._router_kwargs)
+                             policy=self._policy, roles=self._roles,
+                             **self._router_kwargs)
         self.server = RouterServer(self.router, host=self._host,
                                    port=self._port)
         self.server.start()
@@ -251,6 +311,9 @@ class ServingFleet:
             self.server.stop()  # stops the router's probe loop too
             self.server = None
         self.supervisor.stop()
+        if self.pagestore is not None:
+            self.pagestore.stop()
+            self.pagestore = None
 
     def __enter__(self):
         self.start()

@@ -27,6 +27,27 @@ The Orca + vLLM serving recipe, grown onto this repo's serving stack:
   :class:`~.errors.SessionResetError` — the fleet router's
   consistent-hash ``affinity_key`` keeps a session on its replica, and
   the typed error is what a client sees when that replica was replaced.
+- **Copy-on-write prefix caching** (``MXNET_GEN_PREFIX_CACHE``) —
+  prompt-prefix pages are content-addressed in ``kvcache.PrefixCache``
+  and attached to new sequences as shared references; a hit on the
+  trailing partial page is forked copy-on-write before its first write
+  lands.  N users sharing a system prompt pay its prefill once
+  (``prefix_hits`` / ``prefix_tokens_saved`` / ``cow_forks`` metrics).
+- **Session migration** (``MXNET_GEN_MIGRATE`` +
+  ``MXNET_GEN_PAGESTORE``) — sessions outlive their replica.  Every
+  park synchronously pushes the session's replay transcript to the
+  fleet page store (before the client sees the response, so any acked
+  turn is recoverable); drain/rollout pushes full KV page blobs via
+  :meth:`DecodeEngine.migrate_out`.  A resume this replica does not
+  hold first tries to PULL the session from the store — a page blob
+  imports bit-identically, a transcript rebuilds the pages by replay
+  (prefix caching makes that cheap) — and only a store miss raises the
+  typed reset.  Fault sites ``session.export`` / ``session.import``
+  make torn transfers injectable.
+- **Role specialization** (``MXNET_GEN_ROLE``) — a ``prefill`` engine
+  hands each finished prompt's KV pages to the store for a ``decode``
+  replica to claim (DistServe/Splitwise disaggregation); the fleet
+  router splits long fresh prompts across the two pools.
 
 Admission control mirrors ``DynamicBatcher`` exactly (and composes with
 it via ``DynamicBatcher.register_engine``): bounded queue sheds with
@@ -52,9 +73,11 @@ from .. import config as _config
 from .. import faults
 from ..models import decoder as _decoder
 from ..ops.pallas import fused_cell as _fused_cell
+from ..ops.pallas.paged_attention import copy_page as _copy_page
 from .errors import (BadRequestError, DeadlineExceededError, QueueFullError,
                      ServerClosedError, ServingError, SessionResetError)
-from .kvcache import CacheOOM, PageAllocator, pages_for
+from .kvcache import (CacheOOM, PageAllocator, PrefixCache, pack_session,
+                      pages_for, unpack_session)
 from .metrics import ServingMetrics
 
 __all__ = ["DecodeEngine"]
@@ -87,7 +110,7 @@ class _Request:
 class _Slot:
     __slots__ = ("req", "state", "owner", "prompt", "done", "pos",
                  "history", "generated", "pending", "t_last", "admit_seq",
-                 "idx")
+                 "idx", "cacheable")
 
     def __init__(self, idx):
         self.idx = idx
@@ -101,7 +124,7 @@ class _Slot:
 
 class _Session:
     __slots__ = ("sid", "owner", "pos", "pending", "history", "last_used",
-                 "busy")
+                 "busy", "replay", "gen")
 
     def __init__(self, sid, owner):
         self.sid = sid
@@ -111,6 +134,11 @@ class _Session:
         self.history = []
         self.last_used = time.monotonic()
         self.busy = False
+        # migration: a pulled transcript record parks here until the
+        # next request replays it (pages rebuilt by recompute); gen is
+        # the generation fence stamped onto every page-store push
+        self.replay = None
+        self.gen = 0
 
 
 class DecodeEngine:
@@ -149,7 +177,9 @@ class DecodeEngine:
     def __init__(self, model, *, name="llm", slots=None, page_size=None,
                  total_pages=None, max_ctx=None, prefill_chunk=None,
                  eos_id=None, max_queue_depth=256, metrics=None,
-                 static_batching=False, session_ttl_s=None):
+                 static_batching=False, session_ttl_s=None,
+                 prefix_cache=None, role=None, migrate=None,
+                 pagestore=None):
         self.model = model
         self.name = name
         self.cfg = model.config
@@ -227,6 +257,24 @@ class DecodeEngine:
         self._prefill_rr = 0
         self.steps = 0
 
+        # prefix caching + session migration + role specialization
+        self.role = str(role if role is not None
+                        else _config.get("MXNET_GEN_ROLE") or "mixed")
+        if self.role not in ("prefill", "decode", "mixed"):
+            raise ValueError("role must be prefill|decode|mixed, got %r"
+                             % (self.role,))
+        use_pfx = (bool(prefix_cache) if prefix_cache is not None
+                   else bool(_config.get("MXNET_GEN_PREFIX_CACHE")))
+        self.prefix_cache = PrefixCache(self.alloc) if use_pfx else None
+        self.migrate = (bool(migrate) if migrate is not None
+                        else bool(_config.get("MXNET_GEN_MIGRATE")))
+        self._pagestore_addr = str(
+            pagestore if pagestore is not None
+            else _config.get("MXNET_GEN_PAGESTORE") or "")
+        self._store_client = None     # lazy; False = gave up connecting
+        self._ops = collections.deque()   # (fn, Future|None) — worker ops
+        self._pending_imports = set()     # sids with a queued import op
+
     # -- admission --------------------------------------------------------
     @property
     def draining(self):
@@ -248,7 +296,10 @@ class DecodeEngine:
         typed at ``future.result()`` (or synchronously at submit for
         admission-time refusals), matching the batcher's contract."""
         prompt = [int(t) for t in prompt]
-        if not prompt:
+        if not prompt and not (resume and session is not None):
+            # an empty prompt is legal only as a resume continuation
+            # (the disaggregated decode phase: "keep generating from the
+            # migrated context, nothing new to prefill")
             raise BadRequestError("generate: prompt must be non-empty")
         if any(t < 0 or t >= self.cfg.vocab_size for t in prompt):
             raise BadRequestError(
@@ -274,32 +325,51 @@ class DecodeEngine:
                 raise QueueFullError(
                     "model %r generate queue full (%d >= %d)"
                     % (self.name, len(self._queue), self.max_queue_depth))
+            missing = (session is not None
+                       and session not in self._sessions
+                       and session not in self._pending_imports)
+        if missing:
+            # migration pull-on-miss: before declaring the session dead,
+            # try to claim its state from the fleet page store (outside
+            # the lock — this is a network round trip)
+            self._pull_session(session)
+        with self._cond:
+            if self._stopping:
+                self.metrics.count(self.name, "shed_total")
+                raise ServerClosedError(
+                    "decode engine is draining; not accepting new requests")
             if resume and session is not None \
-                    and session not in self._sessions:
+                    and session not in self._sessions \
+                    and session not in self._pending_imports:
                 self.metrics.count(self.name, "sessions_reset_total")
                 raise SessionResetError(
                     "session %r is not held by this replica (restarted or "
                     "expired); restart generation" % (session,))
             req = _Request(prompt, max_new, deadline, session, resume)
             self._queue.append(req)
-            if self._worker is None:
-                self._worker = threading.Thread(
-                    target=self._run, name="mxtpu-decode-%s" % self.name,
-                    daemon=True)
-                self._worker.start()
+            self._ensure_worker_locked()
             self._cond.notify_all()
         return req.future
+
+    def _ensure_worker_locked(self):
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._run, name="mxtpu-decode-%s" % self.name,
+                daemon=True)
+            self._worker.start()
 
     # -- worker -----------------------------------------------------------
     def _run(self):
         while True:
             with self._cond:
                 while (not self._stopping and not self._queue
+                       and not self._ops
                        and not any(s.active for s in self._slots)):
                     self._cond.wait(0.1)
                     self._expire_sessions_locked()
                 if self._stopping:
                     busy = (any(s.active for s in self._slots)
+                            or self._ops
                             or (self._drain_mode and self._queue))
                     if not busy:
                         return
@@ -311,17 +381,322 @@ class DecodeEngine:
 
     def _step(self):
         now = time.perf_counter()
+        self._drain_ops()
         self._expire_queued(now)
         with self._cond:
             self._expire_sessions_locked()
         self._admit()
         self._prefill_phase()
         self._decode()
+        kv = self.alloc.stats()
         self.metrics.observe_kv_cache(
-            self.name, self.alloc.num_used, self.alloc.total_pages - 1)
+            self.name, kv["used_pages"], kv["total_pages"],
+            kv["shared_pages"], kv["leaked_pages"])
         self.metrics.observe_fn_cache(self.name,
                                       _decoder.fn_cache_stats())
         self.steps += 1
+
+    def _drain_ops(self):
+        """Run queued worker-thread ops (session imports/exports).  Only
+        the worker may touch the donated ``_kp``/``_vp`` arrays, so
+        other threads enqueue here and the ops run at step start —
+        imports land before this step's admissions."""
+        while True:
+            with self._cond:
+                if not self._ops:
+                    return
+                fn, fut = self._ops.popleft()
+            try:
+                out = fn()
+            except Exception as e:
+                if fut is not None:
+                    fut.set_exception(e)
+                else:
+                    _log.warning("engine %s op failed: %r", self.name, e)
+            else:
+                if fut is not None:
+                    fut.set_result(out)
+
+    # -- session migration (fleet page store) -----------------------------
+    def _migration_active(self):
+        return bool(self.migrate and self._pagestore_addr)
+
+    def _store(self):
+        """Lazy page-store client (``False`` latches a failed connect so
+        an unreachable store costs one warning, not one per park)."""
+        if self._store_client is None:
+            if not self._migration_active():
+                self._store_client = False
+            else:
+                try:
+                    from ..kvstore.pagestore import PageStoreClient
+                    self._store_client = PageStoreClient.from_addr(
+                        self._pagestore_addr)
+                except Exception as e:
+                    _log.warning("page store %r unusable: %r",
+                                 self._pagestore_addr, e)
+                    self._store_client = False
+        return self._store_client or None
+
+    def _store_key(self, sid):
+        return "%s/%s" % (self.name, sid)
+
+    def _run_op(self, fn, timeout=30.0):
+        """Run ``fn`` on the worker thread — the only thread allowed to
+        touch the donated ``_kp``/``_vp`` arrays.  Runs inline when no
+        worker is alive (stopped or never-started engine) or when
+        already called from the worker itself."""
+        with self._cond:
+            worker = self._worker
+            if (worker is not None and worker.is_alive()
+                    and worker is not threading.current_thread()):
+                fut = Future()
+                self._ops.append((fn, fut))
+                self._cond.notify_all()
+            else:
+                fut = None
+        if fut is None:
+            return fn()
+        return fut.result(timeout)
+
+    def _pull_session(self, sid):
+        """Pull-on-miss: before declaring a session dead, try to claim
+        its record from the fleet page store.  On a claim, the import
+        is queued as a worker op (it writes device pages) and ``sid``
+        parks in ``_pending_imports`` so admission waits for it."""
+        if not self._migration_active():
+            return False
+        store = self._store()
+        if store is None:
+            return False
+        rec, gen = store.take(self._store_key(sid))
+        if rec is None:
+            return False
+
+        def op():
+            try:
+                self._install_record(sid, rec, gen)
+            finally:
+                with self._cond:
+                    self._pending_imports.discard(sid)
+                    self._cond.notify_all()
+
+        with self._cond:
+            self._pending_imports.add(sid)
+            self._ops.append((op, None))
+            self._ensure_worker_locked()
+            self._cond.notify_all()
+        return True
+
+    def _install_record(self, sid, rec, gen):
+        """Materialize a page-store record as a parked session (worker
+        thread only).  ``pages`` records scatter the serialized KV back
+        into the pool (bit-exact); on pool pressure (or any import
+        damage) they degrade to the transcript-replay path, which
+        recomputes the same cache from tokens."""
+        faults.check("session.import")
+        if rec.get("kind") == "pages":
+            try:
+                self._install_pages(sid, bytes(rec["blob"]), gen)
+                self.metrics.count(self.name, "migrations_in_total")
+                return sid
+            except Exception as e:
+                try:
+                    meta, _k, _v = unpack_session(bytes(rec["blob"]))
+                except Exception:
+                    raise e
+                _log.warning(
+                    "session %r page import failed (%r); falling back to "
+                    "transcript replay", sid, e)
+                rec = {"kind": "transcript",
+                       "history": meta.get("history", []),
+                       "pending": meta.get("pending")}
+        hist = [int(t) for t in rec.get("history") or []]
+        pending = rec.get("pending")
+        sess = _Session(sid, None)
+        sess.replay = hist + ([int(pending)] if pending is not None else [])
+        sess.gen = int(gen)
+        with self._cond:
+            self._sessions[sid] = sess
+        self.metrics.count(self.name, "migrations_in_total")
+        return sid
+
+    def _install_pages(self, sid, blob, gen=None):
+        """Unpack a ``pack_session`` blob into fresh pool pages and park
+        the session (worker thread only)."""
+        meta, k, v = unpack_session(blob)
+        sid = sid if sid is not None else meta["sid"]
+        cfg = self.cfg
+        want = (cfg.num_layers, cfg.num_kv_heads, self.page_size,
+                cfg.head_dim)
+        got = (k.shape[0], k.shape[1], k.shape[3], k.shape[4])
+        if got != want:
+            raise ValueError(
+                "imported session KV geometry %r does not match this "
+                "engine's %r" % (got, want))
+        n = k.shape[2]
+        self._seq += 1
+        owner = ("imp", self._seq)
+        while True:
+            try:
+                pages = self.alloc.alloc(owner, n) if n else []
+                break
+            except CacheOOM:
+                if not self._reclaim(keep=sid):
+                    raise
+        if n:
+            idx = jnp.asarray(onp.asarray(pages, onp.int32))
+            self._kp = self._kp.at[:, :, idx].set(jnp.asarray(k))
+            self._vp = self._vp.at[:, :, idx].set(jnp.asarray(v))
+        sess = _Session(sid, owner)
+        sess.pos = int(meta["pos"])
+        sess.pending = (int(meta["pending"])
+                        if meta.get("pending") is not None else None)
+        sess.history = [int(t) for t in meta.get("history") or []]
+        sess.gen = int(gen if gen is not None else meta.get("gen", 0))
+        with self._cond:
+            self._sessions[sid] = sess
+        return sid
+
+    def _export_state(self, sid, pos, pending, history, owner, gen):
+        """Serialize one sequence's page table + live KV pages into a
+        flat ``pack_session`` buffer (worker thread only).  Shared
+        prefix pages are copied out like any other page — the importer
+        gets private copies, refcounts stay conserved on both sides."""
+        faults.check("session.export")
+        pages = self.alloc.pages(owner)
+        cfg = self.cfg
+        if pages:
+            idx = jnp.asarray(onp.asarray(pages, onp.int32))
+            k = onp.asarray(jnp.take(self._kp, idx, axis=2))
+            v = onp.asarray(jnp.take(self._vp, idx, axis=2))
+        else:
+            shape = (cfg.num_layers, cfg.num_kv_heads, 0, self.page_size,
+                     cfg.head_dim)
+            k = onp.zeros(shape, onp.float32)
+            v = onp.zeros(shape, onp.float32)
+        meta = {"sid": sid, "pos": int(pos),
+                "pending": int(pending) if pending is not None else None,
+                "history": [int(t) for t in history],
+                "gen": int(gen)}
+        return pack_session(meta, k, v)
+
+    def export_session(self, session):
+        """Serialize a parked session into a flat buffer;
+        :meth:`import_session` on any engine with the same model
+        geometry restores it bit-exactly (same pages, same greedy
+        continuation).  Raises ``KeyError`` for unknown sessions and
+        ``RuntimeError`` for busy or replay-pending ones."""
+        def op():
+            with self._cond:
+                sess = self._sessions.get(session)
+                if sess is None:
+                    raise KeyError("unknown session %r" % (session,))
+                if sess.busy:
+                    raise RuntimeError(
+                        "session %r is mid-generation; drain first"
+                        % (session,))
+                if sess.replay is not None:
+                    raise RuntimeError(
+                        "session %r holds a replay transcript, not pages"
+                        % (session,))
+            return self._export_state(session, sess.pos, sess.pending,
+                                      sess.history, sess.owner, sess.gen)
+        return self._run_op(op)
+
+    def import_session(self, blob, gen=None):
+        """Install an :meth:`export_session` buffer as a parked session
+        on this engine; returns the session id."""
+        def op():
+            faults.check("session.import")
+            sid = self._install_pages(None, bytes(blob), gen)
+            self.metrics.count(self.name, "migrations_in_total")
+            return sid
+        return self._run_op(op)
+
+    def migrate_out(self):
+        """Push every parked session to the fleet page store (drain,
+        rollout, role handoff); returns the number shipped.  Sessions
+        the store refuses (stale generation or unreachable) stay local —
+        migration degrades, it never destroys."""
+        def op():
+            store = self._store()
+            if store is None:
+                return 0
+            moved = 0
+            with self._cond:
+                parked = [s for s in self._sessions.values() if not s.busy]
+            for sess in parked:
+                sess.gen += 1
+                try:
+                    if sess.replay is not None:
+                        rec = {"kind": "transcript",
+                               "history": [int(t) for t in sess.replay],
+                               "pending": None}
+                    else:
+                        rec = {"kind": "pages",
+                               "blob": self._export_state(
+                                   sess.sid, sess.pos, sess.pending,
+                                   sess.history, sess.owner, sess.gen)}
+                except Exception as e:
+                    _log.warning("migrate_out: export of session %r "
+                                 "failed: %r", sess.sid, e)
+                    continue
+                if store.put(self._store_key(sess.sid), rec,
+                             gen=sess.gen):
+                    with self._cond:
+                        self._sessions.pop(sess.sid, None)
+                    self.alloc.free(sess.owner)
+                    moved += 1
+                    self.metrics.count(self.name, "migrations_out_total")
+                else:
+                    _log.warning("migrate_out: store rejected session %r "
+                                 "(stale gen or unreachable); kept local",
+                                 sess.sid)
+            return moved
+        return self._run_op(op, timeout=60.0)
+
+    def _push_transcript(self, sess):
+        """Courier the park-point transcript to the page store BEFORE
+        the client sees this turn's result: once a turn is acked, even
+        SIGKILL cannot lose it — a survivor replays the transcript and
+        recomputes the identical cache (worker thread only)."""
+        store = self._store() if self._migration_active() else None
+        if store is None:
+            return
+        sess.gen += 1
+        rec = {"kind": "transcript",
+               "history": [int(t) for t in sess.history],
+               "pending": (int(sess.pending)
+                           if sess.pending is not None else None)}
+        if not store.put(self._store_key(sess.sid), rec, gen=sess.gen):
+            _log.warning("transcript push for session %r rejected",
+                         sess.sid)
+
+    def _handoff(self, slot, req):
+        """Prefill-role disaggregation: ship the freshly prefilled
+        session's KV pages to the page store for a decode replica to
+        claim, instead of parking locally.  Returns True when shipped
+        (False falls back to a normal local park)."""
+        store = self._store() if self._migration_active() else None
+        if store is None:
+            return False
+        sess = self._sessions.get(req.session)
+        gen = (sess.gen if sess is not None else 0) + 1
+        try:
+            blob = self._export_state(req.session, slot.pos, slot.pending,
+                                      list(slot.history), slot.owner, gen)
+        except Exception as e:
+            _log.warning("prefill handoff export failed: %r", e)
+            return False
+        if not store.put(self._store_key(req.session),
+                         {"kind": "pages", "blob": blob}, gen=gen):
+            return False
+        with self._cond:
+            self._sessions.pop(req.session, None)
+        self.alloc.free(slot.owner)
+        self.metrics.count(self.name, "migrations_out_total")
+        return True
 
     def _expire_queued(self, now):
         with self._cond:
@@ -380,7 +755,18 @@ class DecodeEngine:
         if req.session is not None and sess is None \
                 and self._resume_missing(req):
             return True  # rejected typed; keep admitting
-        if sess is not None:
+        replaying = False
+        pfx_pages, pfx_partial = [], False
+        if sess is not None and sess.replay is not None:
+            # a migrated transcript: rebuild the pages by replaying the
+            # whole conversation as a fresh prefill (recompute is
+            # bit-identical to the lost cache — the _preempt oracle)
+            prefill = list(sess.replay) + req.prompt
+            base, history = 0, []
+            self._seq += 1
+            owner = ("req", self._seq)
+            replaying = True
+        elif sess is not None:
             # the session's last emitted token was never fed back; it
             # leads the continuation prompt (None: parked mid-prefill)
             prefill = (([sess.pending] if sess.pending is not None else [])
@@ -392,6 +778,23 @@ class DecodeEngine:
             base, history = 0, []
             self._seq += 1
             owner = ("req", self._seq)
+        if ((sess is None or replaying) and self.prefix_cache is not None
+                and len(prefill) > 1):
+            # fresh prompts AND replayed transcripts prefill from zero —
+            # both can skip whatever prefix the cache already holds
+            pages, covered, pfx_partial = self.prefix_cache.lookup(prefill)
+            if covered:
+                pfx_pages = pages
+                history = prefill[:covered]
+                prefill = prefill[covered:]
+                base = covered
+        if not prefill:
+            req.future.set_exception(BadRequestError(
+                "generate: nothing to prefill (empty prompt and no "
+                "pending session context)"))
+            if sess is not None:
+                sess.last_used = time.monotonic()
+            return True
         remaining_new = req.max_new - len(req.prefix)
         final_ctx = base + len(prefill) + max(0, remaining_new - 1)
         if final_ctx > self.max_ctx:
@@ -401,20 +804,30 @@ class DecodeEngine:
             if sess is not None:
                 sess.last_used = time.monotonic()
             return True
+        if pfx_pages:
+            # take shared references NOW so pool-pressure eviction below
+            # cannot free the pages out from under the hit
+            self.alloc.share(owner, pfx_pages)
         # watermark: enough pages to finish prefill + the first decode
-        # token, otherwise leave it queued until evictions free pages —
-        # under pressure, idle parked sessions are reclaimed LRU-first
-        # (their later resume gets the typed SessionResetError)
+        # token (plus one for the copy-on-write fork of a shared partial
+        # page), otherwise leave it queued until evictions free pages —
+        # under pressure, prefix-cache entries go first (LRU), then idle
+        # parked sessions (their later resume migrates or resets typed)
         need_now = (pages_for(base + len(prefill) + 1, self.page_size)
-                    - len(self.alloc.pages(owner)))
+                    - len(self.alloc.pages(owner))
+                    + (1 if pfx_partial else 0))
         while (need_now > self.alloc.num_free
-               and self._evict_lru_session(keep=req.session)):
+               and self._reclaim(keep=req.session)):
             pass
         if need_now > self.alloc.num_free:
+            if pfx_pages or replaying:
+                self.alloc.free(owner)  # drop shared refs; retry relooks
             with self._cond:
                 self._queue.appendleft(req)
             return False
         if not req.started and not req.future.set_running_or_notify_cancel():
+            if pfx_pages or replaying:
+                self.alloc.free(owner)
             return True  # client cancelled while queued
         req.started = True
         self._seq += 1
@@ -429,15 +842,45 @@ class DecodeEngine:
         slot.pending = None
         slot.t_last = time.perf_counter()
         slot.admit_seq = self._seq
+        slot.cacheable = (self.prefix_cache is not None
+                          and (sess is None or replaying))
         if req.session is not None:
             sess = self._sessions.get(req.session)
             if sess is None:
                 sess = self._sessions[req.session] = _Session(
                     req.session, owner)
+            if replaying:
+                sess.replay = None
+                sess.owner = owner
+                sess.pos = 0
+                sess.pending = None
+                sess.history = []
+                self.metrics.count(self.name, "migrations_replayed_total")
             sess.busy = True
+        if pfx_pages:
+            self.metrics.count(self.name, "prefix_hits_total")
+            self.metrics.count(self.name, "prefix_tokens_saved_total",
+                               base)
+            if pfx_partial:
+                # the trailing shared page is partially filled and this
+                # sequence will write into it: fork copy-on-write before
+                # the first divergent write lands
+                old = pfx_pages[-1]
+                new = self.alloc.fork(owner, old)
+                self._kp = _copy_page(self._kp, old, new)
+                self._vp = _copy_page(self._vp, old, new)
+                self.metrics.count(self.name, "cow_forks_total")
         self.metrics.count(self.name, "sequences_total")
         self._sync_table(slot)
         return True
+
+    def _reclaim(self, keep=None):
+        """Free pool pages under pressure: LRU prefix-cache entries
+        first (pure capacity, nothing breaks), then idle parked
+        sessions.  Returns True while there is anything left to try."""
+        if self.prefix_cache is not None and self.prefix_cache.evict_one():
+            return True
+        return self._evict_lru_session(keep=keep)
 
     def _evict_lru_session(self, keep=None):
         """Reclaim the least-recently-used idle parked session's pages
@@ -513,6 +956,11 @@ class DecodeEngine:
                 self._sync_table(slot)
                 return True
             except CacheOOM:
+                # cheapest relief first: drop an LRU prefix-cache entry
+                # (pure capacity) before preempting live work
+                if self.prefix_cache is not None \
+                        and self.prefix_cache.evict_one():
+                    continue
                 victim = self._preempt_victim(exclude=slot)
                 if victim is None:
                     self._fail_slot(slot, ServingError(
@@ -602,6 +1050,14 @@ class DecodeEngine:
             return
         # prompt fully cached: the prefill's last logits ARE the first
         # generated token — time-to-first-token lands here
+        if slot.cacheable:
+            # publish the prompt's pages for prefix sharing (pure
+            # refcount bumps — consumes no free pages).  Decode will
+            # keep writing into the trailing partial page, but only at
+            # offsets past its published token count, which hitters
+            # never read (and a hitter forks it copy-on-write anyway).
+            self.prefix_cache.insert(list(slot.history),
+                                     self.alloc.pages(slot.owner))
         tok = int(next_tok)
         now = time.perf_counter()
         if not slot.req.ttft_recorded:
@@ -680,16 +1136,24 @@ class DecodeEngine:
         tokens = req.prefix + slot.generated
         now = time.perf_counter()
         if req.session is not None:
-            sess = self._sessions.get(req.session)
-            if sess is None:
-                sess = self._sessions[req.session] = _Session(
-                    req.session, slot.owner)
-            sess.owner = slot.owner
-            sess.pos = slot.pos
-            sess.pending = slot.pending
-            sess.history = list(slot.history)
-            sess.busy = False
-            sess.last_used = time.monotonic()
+            if self.role == "prefill" and self._handoff(slot, req):
+                pass  # pages shipped to the store for a decode replica
+            else:
+                sess = self._sessions.get(req.session)
+                if sess is None:
+                    sess = self._sessions[req.session] = _Session(
+                        req.session, slot.owner)
+                sess.owner = slot.owner
+                sess.pos = slot.pos
+                sess.pending = slot.pending
+                sess.history = list(slot.history)
+                sess.busy = False
+                sess.last_used = time.monotonic()
+                # durability point: the transcript reaches the store
+                # before the future resolves, so any turn the client has
+                # seen acked is recoverable on a survivor — even after
+                # SIGKILL of this replica
+                self._push_transcript(sess)
         else:
             self.alloc.free(slot.owner)
         self.metrics.count(self.name, "sequences_completed_total")
@@ -773,10 +1237,22 @@ class DecodeEngine:
         if worker is not None:
             worker.join(timeout)
             ok = not worker.is_alive()
+        if ok:
+            # worker is gone, so migrate_out runs inline: every parked
+            # session ships to the fleet page store (no-op when no store
+            # is configured) — a clean stop loses nothing
+            try:
+                self.migrate_out()
+            except Exception:  # pragma: no cover - best-effort
+                _log.exception("migrate_out on stop failed")
         with self._cond:
             for sess in self._sessions.values():
                 self.alloc.free(sess.owner)
             self._sessions.clear()
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+        if self._store_client:
+            self._store_client.close()
         return ok
 
     def stats(self):
@@ -791,8 +1267,13 @@ class DecodeEngine:
                "pages_per_seq": self.pages_per_seq,
                "prefill_chunk": self.prefill_chunk,
                "max_ctx": self.max_ctx,
+               "role": self.role,
                "kv": self.alloc.stats(),
+               "migration": {"enabled": self._migration_active(),
+                             "pagestore": self._pagestore_addr or None},
                "decode_fused": self.decode_fused_mode,
                "launches": dict(self.launch_stats),
                "fn_cache": _decoder.fn_cache_stats()}
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
         return out

@@ -8,27 +8,54 @@ attention over it); this module owns the HOST-side bookkeeping —
 - a LIFO **free list** (freed pages are re-used hottest-first),
 - per-owner **page lists** (the sequence's page table, in allocation
   order == token order),
-- exact **occupancy accounting** (used/total, peak, alloc/free/fail
-  counters) — the admission-control signal and the serving metric.
+- per-page **refcounts**: a page may appear in several owners' page
+  tables at once (vLLM-style prefix sharing); it returns to the free
+  list only when the last reference drops.  :meth:`PageAllocator.share`
+  attaches existing pages to another owner, :meth:`PageAllocator.fork`
+  is the copy-on-write bookkeeping half (the caller copies the device
+  contents),
+- exact **occupancy accounting** (used/total, peak, shared pages,
+  alloc/free/fail counters) — the admission-control signal and the
+  serving metric.
 
 Page 0 is reserved as the *scratch page*: inactive batch slots and
 padded prefill tokens scatter their (garbage) KV there, so the decode
 step never needs a dynamic shape or a host round-trip to mask writes.
 It is excluded from the free list and from occupancy math.
 
+On top of the allocator this module provides the two pieces that make
+KV state portable and shareable:
+
+- :func:`pack_session` / :func:`unpack_session` — the flat, CRC-guarded
+  wire format for one session's page table + live pages (the
+  serialization half of KV migration; the engine owns gathering and
+  scattering the device arrays),
+- :class:`PrefixCache` — content-addressed prompt-prefix pages (full
+  pages keyed by their exact token prefix, plus the trailing partial
+  page), shared copy-on-write so N sequences with a common system
+  prompt pay its prefill once.
+
 Fault site ``kvcache.alloc`` (``mxnet_tpu.faults``) trips inside
 :meth:`PageAllocator.alloc`, so chaos tests can fail allocations
 deterministically; genuine exhaustion raises :class:`CacheOOM`, which
 the decode engine turns into preemption (evict-youngest + recompute)
-rather than an error.
+rather than an error.  Invariant violations raise the typed
+:class:`~.errors.KVLeakError` from :meth:`PageAllocator.check_leaks`.
 """
 from __future__ import annotations
 
+import json
+import struct
 import threading
+import zlib
+
+import numpy as onp
 
 from .. import faults
+from .errors import KVLeakError
 
-__all__ = ["CacheOOM", "PageAllocator", "pages_for"]
+__all__ = ["CacheOOM", "PageAllocator", "PrefixCache", "pages_for",
+           "pack_session", "unpack_session"]
 
 #: page id reserved for garbage writes from inactive/padded batch rows
 SCRATCH_PAGE = 0
@@ -47,11 +74,15 @@ def pages_for(tokens, page_size):
 
 
 class PageAllocator:
-    """Thread-safe free-list allocator over a fixed page pool.
+    """Thread-safe refcounted free-list allocator over a fixed pool.
 
     ``total_pages`` counts the scratch page, mirroring the device
     arrays' leading page dimension; capacity available to sequences is
-    ``total_pages - 1``.
+    ``total_pages - 1``.  A page freshly allocated has refcount 1;
+    :meth:`share` bumps it (prefix hits, cache retention), and
+    :meth:`free`/:meth:`fork` drop references — the page rejoins the
+    free list only at refcount zero, so occupancy counts every
+    physically-resident page exactly once however many tables map it.
     """
 
     def __init__(self, total_pages, page_size):
@@ -65,15 +96,18 @@ class PageAllocator:
         # LIFO: freshly freed pages go back out first (warm reuse)
         self._free = list(range(self.total_pages - 1, SCRATCH_PAGE, -1))
         self._owned = {}   # owner -> [page, ...] in allocation order
+        self._refs = {}    # page -> live reference count
         self.peak_used = 0
-        self.counters = {"allocs": 0, "frees": 0, "failed_allocs": 0}
+        self.counters = {"allocs": 0, "frees": 0, "failed_allocs": 0,
+                         "shares": 0, "forks": 0, "leak_checks": 0}
+        self.last_leak = []
 
     # -- allocation -------------------------------------------------------
     def alloc(self, owner, n=1):
-        """Append ``n`` pages to ``owner``'s page list; returns the new
-        pages.  Raises :class:`CacheOOM` when the free list is short
-        (nothing is partially allocated), and whatever the
-        ``kvcache.alloc`` fault site injects."""
+        """Append ``n`` fresh (refcount-1) pages to ``owner``'s page
+        list; returns the new pages.  Raises :class:`CacheOOM` when the
+        free list is short (nothing is partially allocated), and
+        whatever the ``kvcache.alloc`` fault site injects."""
         n = int(n)
         if n <= 0:
             return []
@@ -85,29 +119,85 @@ class PageAllocator:
                     "kv cache exhausted: want %d page(s), %d free of %d"
                     % (n, len(self._free), self.total_pages - 1))
             pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._refs[p] = 1
             self._owned.setdefault(owner, []).extend(pages)
             self.counters["allocs"] += n
             self.peak_used = max(self.peak_used, self._used_locked())
             return pages
 
+    def share(self, owner, pages):
+        """Attach already-live ``pages`` to ``owner``'s table as shared
+        (read-only by convention) references — the prefix-cache hit
+        path.  Refcounts go up; occupancy does not."""
+        pages = list(pages)
+        with self._lock:
+            for p in pages:
+                if p not in self._refs:
+                    raise ValueError("share: page %d is not live" % p)
+            for p in pages:
+                self._refs[p] += 1
+            self._owned.setdefault(owner, []).extend(pages)
+            self.counters["shares"] += len(pages)
+        return pages
+
+    def fork(self, owner, page):
+        """Copy-on-write bookkeeping: replace ``owner``'s reference to a
+        shared ``page`` with a fresh private page (same position in the
+        table) and drop the shared reference.  Returns the new page id;
+        the CALLER must copy the device contents old -> new before
+        writing.  Raises :class:`CacheOOM` when no page is free."""
+        with self._lock:
+            table = self._owned.get(owner)
+            if not table or page not in table:
+                raise ValueError("fork: owner %r does not hold page %d"
+                                 % (owner, page))
+            if not self._free:
+                self.counters["failed_allocs"] += 1
+                raise CacheOOM("kv cache exhausted: fork needs 1 page")
+            new = self._free.pop()
+            self._refs[new] = 1
+            table[table.index(page)] = new
+            self._deref_locked(page)
+            self.counters["allocs"] += 1
+            self.counters["forks"] += 1
+            self.peak_used = max(self.peak_used, self._used_locked())
+            return new
+
+    def _deref_locked(self, page):
+        left = self._refs[page] - 1
+        if left:
+            self._refs[page] = left
+        else:
+            del self._refs[page]
+            self._free.append(page)
+            self.counters["frees"] += 1
+
     def free(self, owner):
-        """Return ALL of ``owner``'s pages to the free list (eviction,
-        EOS, drain).  Returns the number freed; unknown owners free 0
-        (idempotent — a preempted slot may race its own completion)."""
+        """Drop ALL of ``owner``'s page references (eviction, EOS,
+        drain).  Returns the number of pages actually returned to the
+        free list (shared pages survive under their other owners);
+        unknown owners free 0 (idempotent — a preempted slot may race
+        its own completion)."""
         with self._lock:
             pages = self._owned.pop(owner, None)
             if not pages:
                 return 0
+            freed0 = self.counters["frees"]
             # reversed: LIFO free list re-issues the owner's last pages
             # first, keeping page ids dense for the next sequence
-            self._free.extend(reversed(pages))
-            self.counters["frees"] += len(pages)
-            return len(pages)
+            for p in reversed(pages):
+                self._deref_locked(p)
+            return self.counters["frees"] - freed0
 
     def pages(self, owner):
         """The owner's page list (copy), allocation order == token order."""
         with self._lock:
             return list(self._owned.get(owner, ()))
+
+    def refcount(self, page):
+        with self._lock:
+            return self._refs.get(page, 0)
 
     # -- accounting -------------------------------------------------------
     def _used_locked(self):
@@ -133,17 +223,45 @@ class PageAllocator:
         with self._lock:
             return sorted(self._owned, key=str)
 
+    def _shared_locked(self):
+        return sum(1 for c in self._refs.values() if c > 1)
+
     def check_leaks(self):
-        """Invariant check for tests: every page is exactly once in the
-        free list or an owner list.  Returns the owner count."""
+        """Conservation check: every allocatable page is either in the
+        free list (refcount 0) or referenced by at least one owner list,
+        with refcounts exactly matching the table references.  Raises
+        the typed :class:`KVLeakError` (leaked/duplicated page ids
+        attached) on violation; returns the owner count when clean."""
         with self._lock:
-            held = [p for pages in self._owned.values() for p in pages]
-            seen = set(held) | set(self._free)
-            assert len(held) + len(self._free) == self.total_pages - 1, (
-                "page leak: %d held + %d free != %d allocatable"
-                % (len(held), len(self._free), self.total_pages - 1))
-            assert len(seen) == self.total_pages - 1, "duplicate page ids"
-            assert SCRATCH_PAGE not in seen, "scratch page escaped"
+            self.counters["leak_checks"] += 1
+            want = dict.fromkeys(range(1, self.total_pages), 0)
+            bad = set()
+            for pages in self._owned.values():
+                for p in pages:
+                    if p in want:
+                        want[p] += 1
+                    else:
+                        bad.add(p)   # scratch or out-of-range id
+            for p in self._free:
+                if p not in want or want[p]:
+                    bad.add(p)       # freed while referenced / bogus id
+            free = set(self._free)
+            if len(free) != len(self._free):
+                bad |= {p for p in free if self._free.count(p) > 1}
+            for p, n in want.items():
+                have = self._refs.get(p, 0)
+                in_free = p in free
+                if n != have or (n == 0) == (not in_free):
+                    # refcount drift, or a page neither free nor held
+                    if not (n == 0 and have == 0 and in_free):
+                        bad.add(p)
+            if bad:
+                self.last_leak = sorted(bad)
+                raise KVLeakError(
+                    "kv page conservation violated: %d page(s) leaked, "
+                    "duplicated, or miscounted: %s"
+                    % (len(bad), self.last_leak), pages=bad)
+            self.last_leak = []
             return len(self._owned)
 
     def stats(self):
@@ -158,5 +276,208 @@ class PageAllocator:
                 "occupancy": round(used / cap, 4) if cap else 0.0,
                 "peak_used_pages": self.peak_used,
                 "owners": len(self._owned),
+                "shared_pages": self._shared_locked(),
+                "leaked_pages": len(self.last_leak),
                 "counters": dict(self.counters),
             }
+
+
+# -- session wire format --------------------------------------------------
+#
+# One exported session is a flat self-describing buffer:
+#
+#   b"MXKV" | u32 header_len | header JSON | k_pages bytes | v_pages bytes
+#
+# The header carries the session metadata dict, the block shape/dtype of
+# the gathered pages (layers, kv_heads, n_pages, page_size, head_dim),
+# and a CRC32 over the raw page bytes — a torn transfer fails loudly at
+# import instead of decoding against garbage.  numpy round-trips the
+# bytes exactly, so serialize -> ship -> import is bit-identical (the
+# oracle the migration tests pin).
+
+_MAGIC = b"MXKV"
+_U32 = struct.Struct(">I")
+
+
+def pack_session(meta, k_block, v_block):
+    """Serialize one session: ``meta`` (JSON-safe dict) plus the k/v
+    page blocks (numpy arrays, identical shape/dtype) into one buffer."""
+    k = onp.ascontiguousarray(k_block)
+    v = onp.ascontiguousarray(v_block)
+    if k.shape != v.shape or k.dtype != v.dtype:
+        raise ValueError("pack_session: k/v block shape or dtype mismatch")
+    kb, vb = k.tobytes(), v.tobytes()
+    header = json.dumps({
+        "v": 1,
+        "meta": meta,
+        "dtype": k.dtype.str,
+        "shape": list(k.shape),
+        "crc": zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF,
+    }).encode("utf-8")
+    return b"".join([_MAGIC, _U32.pack(len(header)), header, kb, vb])
+
+
+def unpack_session(blob):
+    """Inverse of :func:`pack_session`; returns ``(meta, k_block,
+    v_block)``.  Raises ``ValueError`` on a torn or corrupt buffer
+    (bad magic, truncation, CRC mismatch)."""
+    if len(blob) < len(_MAGIC) + _U32.size or blob[:4] != _MAGIC:
+        raise ValueError("unpack_session: bad magic (torn transfer?)")
+    (hlen,) = _U32.unpack_from(blob, 4)
+    off = 4 + _U32.size
+    if len(blob) < off + hlen:
+        raise ValueError("unpack_session: truncated header")
+    header = json.loads(blob[off:off + hlen].decode("utf-8"))
+    off += hlen
+    dtype = onp.dtype(header["dtype"])
+    shape = tuple(header["shape"])
+    nbytes = dtype.itemsize * int(onp.prod(shape)) if shape else 0
+    if len(blob) != off + 2 * nbytes:
+        raise ValueError("unpack_session: truncated page payload "
+                         "(%d != %d)" % (len(blob) - off, 2 * nbytes))
+    kb = blob[off:off + nbytes]
+    vb = blob[off + nbytes:off + 2 * nbytes]
+    crc = zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF
+    if crc != header["crc"]:
+        raise ValueError("unpack_session: CRC mismatch (torn transfer)")
+    k = onp.frombuffer(kb, dtype=dtype).reshape(shape)
+    v = onp.frombuffer(vb, dtype=dtype).reshape(shape)
+    return header["meta"], k, v
+
+
+# -- prefix cache ---------------------------------------------------------
+class _PrefixEntry:
+    __slots__ = ("key", "page", "tokens", "partial", "owner", "tick")
+
+    def __init__(self, key, page, tokens, partial, owner, tick):
+        self.key = key          # exact token prefix this page completes
+        self.page = page
+        self.tokens = tokens    # cache positions this entry vouches for
+        self.partial = partial  # True: trailing partially-filled page
+        self.owner = owner      # allocator owner holding the cache's ref
+        self.tick = tick        # LRU clock
+
+
+class PrefixCache:
+    """Content-addressed prompt-prefix pages, shared copy-on-write.
+
+    Full pages are keyed by the exact token prefix they complete
+    (position-dependent KV makes anything weaker unsound); the trailing
+    partial page of a prompt is cached too, keyed by the full prefix it
+    holds.  A lookup returns the longest chain of cached pages covering
+    a strict prefix of the prompt (at least one token is always left to
+    prefill — its logits seed generation).  The cache holds one
+    allocator reference per entry, so hit pages stay live across the
+    inserting sequence's exit; eviction is LRU and only reclaims pool
+    space once no sequence shares the page.
+
+    Writers never mutate a shared full page (decode appends past it);
+    a hit on a *partial* page is forked copy-on-write by the engine
+    before its first write lands (``cow_forks`` in the metrics).
+    """
+
+    def __init__(self, alloc):
+        self.alloc = alloc
+        self._lock = threading.Lock()
+        self._entries = {}   # key tuple -> _PrefixEntry
+        self._serial = 0
+        self._tick = 0
+        self.counters = {"hits": 0, "misses": 0, "inserts": 0,
+                         "evictions": 0, "tokens_saved": 0}
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def lookup(self, prompt):
+        """Longest cached cover of a strict prefix of ``prompt``;
+        returns ``(pages, covered_tokens, partial_hit)`` (all falsy on
+        a miss).  The returned pages are NOT yet referenced — the
+        caller must :meth:`PageAllocator.share` them immediately."""
+        S = self.alloc.page_size
+        limit = len(prompt) - 1          # always leave >=1 token to prefill
+        with self._lock:
+            self._tick += 1
+            pages, covered = [], 0
+            while covered + S <= limit:
+                e = self._entries.get(tuple(prompt[:covered + S]))
+                if e is None or e.partial:
+                    break
+                e.tick = self._tick
+                pages.append(e.page)
+                covered += S
+            partial = False
+            for m in range(min(S - 1, limit - covered), 0, -1):
+                e = self._entries.get(tuple(prompt[:covered + m]))
+                if e is not None and e.partial:
+                    e.tick = self._tick
+                    pages.append(e.page)
+                    covered += m
+                    partial = True
+                    break
+            if covered:
+                self.counters["hits"] += 1
+                self.counters["tokens_saved"] += covered
+            else:
+                self.counters["misses"] += 1
+            return pages, covered, partial
+
+    def insert(self, tokens, owner_pages):
+        """Publish a freshly-prefilled sequence's pages: every full page
+        (and the trailing partial one) becomes a cache entry under its
+        exact prefix key, with the cache taking one shared reference.
+        Existing entries win (first writer published identical KV)."""
+        S = self.alloc.page_size
+        new = 0
+        with self._lock:
+            self._tick += 1
+            nfull = len(tokens) // S
+            for i in range(min(nfull, len(owner_pages))):
+                new += self._insert_locked(tuple(tokens[:(i + 1) * S]),
+                                           owner_pages[i], S, False)
+            m = len(tokens) - nfull * S
+            if m and nfull < len(owner_pages):
+                new += self._insert_locked(tuple(tokens),
+                                           owner_pages[nfull], m, True)
+        return new
+
+    def _insert_locked(self, key, page, tokens, partial):
+        if key in self._entries:
+            self._entries[key].tick = self._tick
+            return 0
+        self._serial += 1
+        owner = ("pfx", self._serial)
+        try:
+            self.alloc.share(owner, [page])
+        except ValueError:      # page raced off (owner already freed)
+            return 0
+        self._entries[key] = _PrefixEntry(key, page, tokens, partial,
+                                          owner, self._tick)
+        self.counters["inserts"] += 1
+        return 1
+
+    def evict_one(self):
+        """Drop the LRU entry (pool pressure).  Returns True when an
+        entry was dropped — its page rejoins the pool only if no
+        sequence still shares it."""
+        with self._lock:
+            if not self._entries:
+                return False
+            key = min(self._entries.values(), key=lambda e: e.tick).key
+            e = self._entries.pop(key)
+            self.counters["evictions"] += 1
+        self.alloc.free(e.owner)
+        return True
+
+    def clear(self):
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for e in entries:
+            self.alloc.free(e.owner)
+        return len(entries)
+
+    def stats(self):
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "counters": dict(self.counters)}

@@ -77,7 +77,11 @@ class ModelMetrics:
                 "tokens_generated_total", "prefill_tokens_total",
                 "sequences_total", "sequences_completed_total",
                 "decode_steps_total", "decode_slot_steps_total",
-                "preemptions_total", "sessions_reset_total")
+                "preemptions_total", "sessions_reset_total",
+                # prefix caching + session migration (PR 11)
+                "prefix_hits_total", "prefix_tokens_saved_total",
+                "cow_forks_total", "migrations_out_total",
+                "migrations_in_total", "migrations_replayed_total")
 
     def __init__(self):
         self.counters = dict.fromkeys(self.COUNTERS, 0)
@@ -93,7 +97,8 @@ class ModelMetrics:
         self.inter_token = LatencyHistogram()
         self.decode_step = LatencyHistogram()
         self.kv_cache = {"used_pages": 0, "total_pages": 0,
-                         "peak_used_pages": 0}
+                         "peak_used_pages": 0, "shared_pages": 0,
+                         "leaked_pages": 0}
         self.tokens_per_s = 0.0  # EMA over decode steps
         # static gauges (set once per engine): the dispatch-count audit
         # of one decode step (fused_cell.count_launches — deterministic,
@@ -249,11 +254,14 @@ class ServingMetrics:
         with self._lock:
             self._model(name).fn_cache = dict(stats)
 
-    def observe_kv_cache(self, name, used_pages, total_pages):
+    def observe_kv_cache(self, name, used_pages, total_pages,
+                         shared_pages=0, leaked_pages=0):
         with self._lock:
             kv = self._model(name).kv_cache
             kv["used_pages"] = int(used_pages)
             kv["total_pages"] = int(total_pages)
+            kv["shared_pages"] = int(shared_pages)
+            kv["leaked_pages"] = int(leaked_pages)
             kv["peak_used_pages"] = max(kv["peak_used_pages"],
                                         int(used_pages))
         profiler.record_counter("serving::%s::kv_cache" % name,

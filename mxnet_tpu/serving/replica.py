@@ -20,7 +20,12 @@ A model spec may instead carry ``"generate": {...}`` (DecodeEngine
 kwargs: ``slots``, ``page_size``, ``prefill_chunk``, ``eos_id``, ...):
 the builder's model is then served as an LLM decode engine on
 ``/v1/models/<name>:generate`` (e.g. builder
-``mxnet_tpu.models.decoder:decoder_tiny_lm``).
+``mxnet_tpu.models.decoder:decoder_tiny_lm``).  The engine's
+session-migration posture comes from the environment the supervisor
+stamps per replica: ``MXNET_GEN_PAGESTORE`` (fleet page-store address;
+set by ``ServingFleet.start``) and ``MXNET_GEN_ROLE``
+(``prefill`` | ``decode`` | ``mixed`` — ``ServingFleet(roles=[...])``),
+or explicitly via ``"generate": {"role": ..., "pagestore": ...}``.
 
 Models are named by importable *builder path*, never shipped as code —
 only callables already on this process's PYTHONPATH can load (the

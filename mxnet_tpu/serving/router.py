@@ -47,6 +47,7 @@ import bisect
 import http.client
 import itertools
 import json
+import os
 import re
 import threading
 import time
@@ -101,12 +102,17 @@ class Replica:
     COUNTERS = ("dispatched", "responses", "retries", "strikes",
                 "ejections", "readmissions", "sheds", "errors")
 
-    def __init__(self, spec):
+    def __init__(self, spec, role="mixed"):
         self.host, self.port = _addr_of(spec)
         self.rid = "%s:%d" % (self.host, self.port)
         self.state = "healthy"
         self.ready = True       # optimistic until a probe says otherwise
         self.draining = False
+        # prefill/decode disaggregation (DistServe-style): "prefill"
+        # replicas chunk long prompts and hand the finished KV pages to
+        # the "decode" pool through the fleet page store; "mixed" serves
+        # both phases (and backfills either pool)
+        self.role = str(role or "mixed")
         self.strikes = 0
         self.inflight = 0
         self.next_probe = 0.0
@@ -120,7 +126,8 @@ class Replica:
 
     def describe(self):
         return {"state": self.state, "ready": self.ready,
-                "draining": self.draining, "strikes": self.strikes,
+                "draining": self.draining, "role": self.role,
+                "strikes": self.strikes,
                 "inflight": self.inflight, "counters": dict(self.counters)}
 
 
@@ -161,7 +168,7 @@ class Router:
 
     def __init__(self, replicas, *, policy="least_loaded", strikes=None,
                  probe_ms=None, eject_backoff_ms=None, timeout=30.0,
-                 retry_inflight=True):
+                 retry_inflight=True, roles=None):
         if policy not in ("least_loaded", "hash"):
             raise ValueError("unknown dispatch policy %r" % (policy,))
         self.policy = policy
@@ -184,8 +191,10 @@ class Router:
         self._tls = threading.local()
         self._stop = threading.Event()
         self._probe_thread = None
-        for spec in replicas:
-            self.add_replica(spec)
+        roles = list(roles or ())
+        for i, spec in enumerate(replicas):
+            self.add_replica(spec,
+                             role=roles[i] if i < len(roles) else "mixed")
         if self.probe_s > 0:
             self._probe_thread = threading.Thread(
                 target=self._probe_loop, name="mxtpu-fleet-probe",
@@ -193,8 +202,8 @@ class Router:
             self._probe_thread.start()
 
     # -- membership -------------------------------------------------------
-    def add_replica(self, spec):
-        r = Replica(spec)
+    def add_replica(self, spec, role="mixed"):
+        r = Replica(spec, role=role)
         with self._lock:
             if r.rid in self._replicas:
                 return self._replicas[r.rid]
@@ -221,21 +230,38 @@ class Router:
         with self._lock:
             self._replicas[rid].draining = bool(draining)
 
+    def role_split(self):
+        """True when the fleet has specialized prefill/decode replicas
+        (DistServe-style disaggregation is worth orchestrating)."""
+        with self._lock:
+            roles = {r.role for r in self._replicas.values()}
+        return bool(roles & {"prefill", "decode"})
+
     # -- selection --------------------------------------------------------
-    def _routable_locked(self, exclude):
+    _POOL_ROLES = {"prefill": ("prefill", "mixed"),
+                   "decode": ("decode", "mixed")}
+
+    def _routable_locked(self, exclude, pool=None):
         out = [r for r in self._replicas.values()
                if r.routable and r.rid not in exclude]
-        if out:
-            return out
-        # last resort: a draining replica still serves correctly — route
-        # to it rather than failing the request outright
-        return [r for r in self._replicas.values()
-                if r.state == "healthy" and r.ready
-                and r.rid not in exclude]
+        if not out:
+            # last resort: a draining replica still serves correctly —
+            # route to it rather than failing the request outright
+            out = [r for r in self._replicas.values()
+                   if r.state == "healthy" and r.ready
+                   and r.rid not in exclude]
+        want = self._POOL_ROLES.get(pool)
+        if want:
+            pooled = [r for r in out if r.role in want]
+            if pooled:
+                return pooled
+            # pool empty (all specialized peers down): availability beats
+            # specialization — any live replica serves both phases
+        return out
 
-    def _pick(self, affinity_key, exclude):
+    def _pick(self, affinity_key, exclude, pool=None):
         with self._lock:
-            live = self._routable_locked(exclude)
+            live = self._routable_locked(exclude, pool)
             if not live:
                 return None
             if self.policy == "hash" and affinity_key is not None:
@@ -385,7 +411,7 @@ class Router:
 
     # -- dispatch ---------------------------------------------------------
     def dispatch(self, path, body=None, *, method="POST", deadline_s=None,
-                 affinity_key=None, idempotent=True):
+                 affinity_key=None, idempotent=True, pool=None):
         """Forward one request; returns ``(status, doc)``.
 
         Transport failures fail over to the next replica (each tried at
@@ -414,7 +440,7 @@ class Router:
                     "request deadline expired before any replica answered")
             # a shed retry goes to the LEAST-LOADED alternative even under
             # hash policy — the key's owner is full, affinity is moot
-            r = self._pick(None if sheds else affinity_key, tried)
+            r = self._pick(None if sheds else affinity_key, tried, pool)
             if r is None:
                 break
             sent = False
@@ -539,6 +565,7 @@ class RouterServer:
         self._port = int(port)
         self._httpd = None
         self._thread = None
+        self._disagg_seq = itertools.count(1)  # synthesized session ids
 
     @property
     def port(self):
@@ -653,24 +680,100 @@ class RouterServer:
         deadline_s = None
         affinity_key = None
         idempotent = True
+        body = None
         if raw_body:
             try:
                 body = json.loads(raw_body.decode() or "{}")
-                if body.get("deadline_ms") is not None:
-                    deadline_s = float(body["deadline_ms"]) / 1e3 + 1.0
-                # sticky decode sessions: the session id doubles as the
-                # consistent-hash affinity key (and a session-carrying
-                # generate is non-idempotent by default — replaying a
-                # reply-phase loss would double-advance the session)
-                affinity_key = (body.get("affinity_key")
-                                or body.get("session"))
-                idempotent = bool(body.get(
-                    "idempotent", body.get("session") is None))
             except (ValueError, TypeError):
-                pass  # the replica rejects malformed JSON with a 400
+                body = None  # the replica rejects malformed JSON (400)
+        if isinstance(body, dict):
+            if body.get("deadline_ms") is not None:
+                deadline_s = float(body["deadline_ms"]) / 1e3 + 1.0
+            # sticky decode sessions: the session id doubles as the
+            # consistent-hash affinity key (and a session-carrying
+            # generate is non-idempotent by default — replaying a
+            # reply-phase loss would double-advance the session)
+            affinity_key = (body.get("affinity_key")
+                            or body.get("session"))
+            idempotent = bool(body.get(
+                "idempotent", body.get("session") is None))
+        pool = None
+        if (path.endswith(":generate") and isinstance(body, dict)
+                and self.router.role_split()):
+            prompt = body.get("prompt") or []
+            max_new = int(body.get("max_tokens") or 16)
+            if (not body.get("session") and not body.get("resume")
+                    and max_new > 1 and isinstance(prompt, list)
+                    and len(prompt) >= int(
+                        _config.get("MXNET_GEN_DISAGG_MIN_PROMPT"))):
+                return self._disagg_generate(path, body, deadline_s)
+            # everything else on a role-split fleet lands on the decode
+            # pool: sessions live there, mixed replicas backfill
+            pool = "decode"
         return self.router.dispatch(
             path, raw_body, deadline_s=deadline_s,
-            affinity_key=affinity_key, idempotent=idempotent)
+            affinity_key=affinity_key, idempotent=idempotent, pool=pool)
+
+    def _disagg_generate(self, path, body, deadline_s):
+        """DistServe-style two-phase generate: the prefill pool chunks
+        the long prompt, computes its KV pages + first token, and hands
+        the pages through the fleet page store; the decode pool claims
+        the session and streams the rest.  Any phase-2 failure falls
+        back ONCE to an ordinary single-pool dispatch — disaggregation
+        degrades, it never fails a request on its own."""
+        synthesized = not body.get("session")
+        sid = body.get("session") or (
+            "disagg-%d-%d" % (os.getpid(), next(self._disagg_seq)))
+        max_new = int(body.get("max_tokens") or 16)
+        p1 = dict(body)
+        p1["session"] = sid
+        p1["max_tokens"] = 1
+        try:
+            status, doc = self.router.dispatch(
+                path, p1, deadline_s=deadline_s, affinity_key=sid,
+                idempotent=False, pool="prefill")
+        except (FleetUnavailableError, QueueFullError):
+            # the request never landed on a replica — nothing was parked
+            # under ``sid``, so an ordinary fresh dispatch is safe
+            status, doc = None, None
+        if status == 200 and doc.get("finish_reason") == "length" \
+                and max_new > 1:
+            p2 = {"prompt": [], "session": sid, "resume": True,
+                  "max_tokens": max_new - 1}
+            if body.get("deadline_ms") is not None:
+                p2["deadline_ms"] = body["deadline_ms"]
+            # phase 2 may not silently rerun from scratch once phase 1
+            # parked state under a CLIENT-owned session id (a fresh rerun
+            # would collide with the stored pages and double-prefill), so
+            # its dispatch failures propagate typed; the decode pool
+            # itself already failed over across its replicas
+            status2, doc2 = self.router.dispatch(
+                path, p2, deadline_s=deadline_s, affinity_key=sid,
+                idempotent=False, pool="decode")
+            if status2 == 200:
+                tokens = (list(doc.get("tokens") or [])
+                          + list(doc2.get("tokens") or []))
+                out = dict(doc2)
+                out["tokens"] = tokens
+                out["prompt_tokens"] = doc.get("prompt_tokens")
+                out["completion_tokens"] = len(tokens)
+                out["session"] = None if synthesized else sid
+                out["disaggregated"] = True
+                return 200, out
+            return status2, doc2
+        if status == 200:
+            # eos/deadline on the very first token: phase 1 IS the answer
+            out = dict(doc)
+            out["session"] = None if synthesized else sid
+            out["disaggregated"] = True
+            return 200, out
+        # phase 1 never parked anything usable: one clean ordinary
+        # dispatch of the ORIGINAL request (synthesized ids are dropped,
+        # so nothing can collide with the failed attempt)
+        return self.router.dispatch(
+            path, body, deadline_s=deadline_s,
+            affinity_key=body.get("session"),
+            idempotent=body.get("session") is None, pool="decode")
 
     def _collect_replica_stats(self):
         """Best-effort fetch of each healthy replica's own labelled

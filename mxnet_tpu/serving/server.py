@@ -258,7 +258,12 @@ class ModelServer:
                 raise BadRequestError(
                     '/v1/generate body must carry "model"')
         prompt = body.get("prompt")
-        if not isinstance(prompt, list) or not prompt:
+        resume = bool(body.get("resume", False))
+        if not isinstance(prompt, list) or (
+                not prompt and not (resume and body.get("session"))):
+            # an empty prompt is legal only as a resume continuation —
+            # the disaggregated decode phase: "keep generating from the
+            # migrated session, nothing new to prefill"
             raise BadRequestError(
                 'generate body must carry "prompt": [token ids]')
         deadline_ms = body.get("deadline_ms")
@@ -267,7 +272,7 @@ class ModelServer:
             max_new_tokens=body.get("max_tokens", 16),
             deadline_ms=deadline_ms,
             session=body.get("session"),
-            resume=bool(body.get("resume", False)))
+            resume=resume)
         timeout = (float(deadline_ms) / 1e3 + 1.0 if deadline_ms is not None
                    else self.request_timeout_s)
         try:
@@ -304,6 +309,8 @@ class ModelServer:
             if not body.get("name") or not body.get("builder"):
                 raise BadRequestError(
                     'admin load needs {"name", "builder", ...}')
+            if body.get("generate") is not None:
+                return self._admin_load_generate(body)
             from .registry import load_model_spec
             served = load_model_spec(self.registry, body)
             return 200, {"ok": True, "model": served.describe()}
@@ -312,7 +319,46 @@ class ModelServer:
                 raise BadRequestError('admin unload needs {"name"}')
             self.registry.unload(body["name"], body.get("version"))
             return 200, {"ok": True}
+        if path == "/v1/admin/migrate_out":
+            name = body.get("model") or body.get("name")
+            engine = self.batcher._engines.get(name)
+            if engine is None:
+                raise ModelNotFoundError(
+                    "no decode engine %r on this replica" % (name,))
+            return 200, {"ok": True, "migrated": engine.migrate_out()}
         raise ModelNotFoundError("no admin route %r" % (path,))
+
+    def _admin_load_generate(self, body):
+        """Hot-swap a decode engine: build + warm the NEW engine first
+        (traffic keeps flowing to the old one the whole time), swap it
+        in, then drain the old engine — whose ``stop()`` migrates every
+        parked session to the fleet page store, so in-progress
+        conversations survive the swap instead of resetting."""
+        from .generate import DecodeEngine
+        from .registry import resolve_builder
+        name = body["name"]
+        builder = resolve_builder(body["builder"])
+        model = builder(**(body.get("kwargs") or {}))
+        engine = DecodeEngine(model, name=name,
+                              **dict(body["generate"]))
+        old = self.batcher._engines.get(name)
+        self.attach_engine(name, engine)  # warms, then swaps the route
+        migrated = 0
+        if old is not None and old is not engine:
+            try:
+                migrated = old.migrate_out()  # parked sessions, now
+                # in-flight requests finish during the drain; stop()'s
+                # own migrate_out ships their late parks (counted in
+                # migrations_out_total, not in this reply)
+                old.stop(drain=True)
+            except Exception:  # pragma: no cover - best-effort
+                import logging
+                logging.getLogger(__name__).exception(
+                    "old engine drain failed during generate hot-swap")
+        return 200, {"ok": True,
+                     "model": {"name": name, "warmed": 2,
+                               "generate": True,
+                               "migrated_sessions": migrated}}
 
     def _prometheus_text(self):
         """Counters + percentiles in Prometheus exposition format."""
@@ -349,4 +395,9 @@ class ModelServer:
                     if gen.get(gauge) is not None:
                         lines.append("mxtpu_serving_%s{%s} %g"
                                      % (gauge, labels, gen[gauge]))
+                for k, v in sorted((gen.get("kv_cache") or {}).items()):
+                    # used/total/peak_used/shared/leaked page gauges —
+                    # leaked_pages nonzero is the alert condition
+                    lines.append("mxtpu_serving_kv_%s{%s} %d"
+                                 % (k, labels, v))
         return "\n".join(lines) + "\n"

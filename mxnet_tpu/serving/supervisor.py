@@ -117,6 +117,7 @@ class ReplicaSupervisor:
             restart_backoff_ms if restart_backoff_ms is not None
             else _config.get("MXNET_FLEET_RESTART_BACKOFF_MS")) / 1e3)
         self.env = dict(env or {})
+        self.env_by_rid = {}  # rid -> extra env (e.g. MXNET_GEN_ROLE)
         self.startup_timeout_s = float(startup_timeout_s)
         ports = list(ports) if ports else _reserve_ports(self.n, host)
         if len(ports) != self.n:
@@ -150,6 +151,7 @@ class ReplicaSupervisor:
     def _spawn(self, r):
         env = dict(os.environ)
         env.update(self.env)
+        env.update(self.env_by_rid.get(r.rid, {}))
         env["MXNET_SERVING_REPLICA_ID"] = r.rid
         # the package must be importable from a bare `python -m`
         pkg_root = os.path.dirname(os.path.dirname(

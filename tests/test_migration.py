@@ -1,0 +1,513 @@
+"""Session migration & prefix caching: KV-page export/import,
+refcounted copy-on-write prefix sharing, the generation-fenced page
+store, transcript-replay recovery, and prefill/decode disaggregation
+(`migration` marker, CPU tier-1).
+
+The acceptance matrix for "sessions outlive their replica":
+
+- ``pack_session``/``unpack_session`` round-trips bit-identically and a
+  torn/corrupt buffer fails loudly (CRC), never decodes garbage;
+- the refcounted allocator conserves pages under share/fork/free and
+  ``check_leaks`` raises the typed :class:`KVLeakError` on violation;
+- a prefix-cache hit and a copy-on-write fork both produce generations
+  BIT-IDENTICAL to the cold path (shared pages hold exactly the KV the
+  sharer would have computed — anything else is unsound);
+- ``export_session`` -> ``import_session`` across engines preserves the
+  greedy continuation bit for bit, including sessions whose tables map
+  shared prefix pages (the importer gets private copies; refcounts stay
+  conserved on BOTH sides and both pools drain leak-free);
+- the page store's generation fencing: a lagging holder's late push
+  after a survivor claimed the session is rejected, so a migrated
+  session can never be clobbered by stale state;
+- SIGKILL-style abandonment recovers through the parked transcript
+  (replay recomputes the identical cache); explicit ``migrate_out``
+  recovers through the serialized pages — same bits either way;
+- ``ServingClient.generate(resume_on_reset=True)`` turns the 409 into
+  one transparent transcript replay;
+- role-split fleets: the router's two-phase disaggregated dispatch
+  (prefill pool -> page handoff -> decode pool) equals the one-replica
+  answer.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as onp
+import pytest
+
+import jax.numpy as jnp
+
+from mxnet_tpu import faults, serving
+from mxnet_tpu.kvstore.pagestore import PageStoreClient, PageStoreServer
+from mxnet_tpu.models import decoder
+from mxnet_tpu.serving.kvcache import (CacheOOM, PageAllocator,
+                                       PrefixCache, pack_session,
+                                       unpack_session)
+
+pytestmark = [pytest.mark.migration, pytest.mark.llm]
+
+VOCAB = 128
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return decoder.decoder_tiny_lm(seed=0, vocab_size=VOCAB)
+
+
+@pytest.fixture()
+def store():
+    s = PageStoreServer()
+    s.start()
+    yield s
+    s.stop()
+
+
+def make_engine(lm, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("max_ctx", 64)
+    return serving.DecodeEngine(lm, name="llm", **kw)
+
+
+def greedy_oracle(lm, prompt, n):
+    params, cfg = lm.jax_params(), lm.config
+    toks = list(prompt)
+    for _ in range(n):
+        logits = decoder.full_forward(params, cfg,
+                                      jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+def test_pack_unpack_bit_identical_and_crc():
+    rng = onp.random.RandomState(0)
+    k = rng.randn(2, 2, 3, 8, 4).astype("float32")
+    v = rng.randn(2, 2, 3, 8, 4).astype("float32")
+    meta = {"sid": "s", "pos": 17, "pending": 5, "history": [1, 2, 3],
+            "gen": 2}
+    blob = pack_session(meta, k, v)
+    m2, k2, v2 = unpack_session(blob)
+    assert m2 == meta
+    assert k2.tobytes() == k.tobytes()          # bit-identical
+    assert v2.tobytes() == v.tobytes()
+    # corruption fails loudly: flipped payload byte -> CRC mismatch
+    bad = bytearray(blob)
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="CRC"):
+        unpack_session(bytes(bad))
+    with pytest.raises(ValueError, match="magic"):
+        unpack_session(b"JUNK" + blob[4:])
+    with pytest.raises(ValueError, match="truncated"):
+        unpack_session(blob[:len(blob) // 2])
+    with pytest.raises(ValueError):
+        pack_session({}, k, v[..., :2])         # k/v shape mismatch
+
+
+# ---------------------------------------------------------------------------
+# refcounted allocator
+# ---------------------------------------------------------------------------
+def test_allocator_share_fork_refcounts():
+    a = PageAllocator(total_pages=9, page_size=4)   # 8 usable
+    p = a.alloc("s1", 2)
+    a.share("s2", p)
+    assert a.refcount(p[0]) == 2 and a.num_used == 2
+    assert a.stats()["shared_pages"] == 2
+    # first free drops references, pages stay live under s2
+    assert a.free("s1") == 0
+    assert a.refcount(p[0]) == 1 and a.num_used == 2
+    a.check_leaks()
+    # CoW fork: s2's table swaps in a private page at the same position
+    a.share("s3", [p[1]])
+    new = a.fork("s3", p[1])
+    assert new != p[1] and a.pages("s3") == [new]
+    assert a.refcount(p[1]) == 1 and a.refcount(new) == 1
+    assert a.counters["forks"] == 1
+    a.check_leaks()
+    assert a.free("s2") == 2 and a.free("s3") == 1
+    assert a.num_used == 0
+    a.check_leaks()
+    with pytest.raises(ValueError):
+        a.share("x", [3])            # not live
+    with pytest.raises(ValueError):
+        a.fork("x", 3)               # not held
+
+
+def test_check_leaks_typed_error():
+    a = PageAllocator(total_pages=5, page_size=4)
+    a.alloc("s", 2)
+    assert a.check_leaks() == 1
+    # manufacture a conservation violation: an owner table referencing a
+    # page with no matching refcount
+    a._owned["ghost"] = [a._free[-1]]
+    with pytest.raises(serving.KVLeakError) as ei:
+        a.check_leaks()
+    assert ei.value.pages and ei.value.http_status == 500
+    assert a.stats()["leaked_pages"] == len(ei.value.pages)
+
+
+def test_prefix_cache_lookup_insert_evict():
+    a = PageAllocator(total_pages=9, page_size=4)
+    pc = PrefixCache(a)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]      # 2 full pages + 2
+    pages = a.alloc("seq", 3)
+    assert pc.insert(toks, pages) == 3          # 2 full + 1 partial
+    a.free("seq")                               # cache refs keep them live
+    assert a.num_used == 3
+    # full cover of a strict prefix; the partial page caps the chain
+    hit, covered, partial = pc.lookup(toks + [11, 12])
+    assert hit == pages and covered == 10 and partial
+    # always leaves >= 1 token to prefill
+    hit, covered, partial = pc.lookup(toks[:8])
+    assert hit == [pages[0]] and covered == 4 and not partial
+    # miss on divergent content
+    hit, covered, _ = pc.lookup([9, 9, 9, 9, 9])
+    assert not hit and covered == 0
+    # LRU eviction returns pages to the pool once unshared
+    while pc.evict_one():
+        pass
+    assert len(pc) == 0 and a.num_used == 0
+    a.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# engine: prefix hits + CoW, bit-identical
+# ---------------------------------------------------------------------------
+def test_prefix_hit_and_cow_bit_identical(lm):
+    eng = make_engine(lm, prefix_cache=True)
+    sys_prompt = list(range(1, 17))             # 2 full pages
+    try:
+        cold = eng.submit(sys_prompt + [20, 21], 6).result(30)
+        assert cold["tokens"] == greedy_oracle(lm, sys_prompt + [20, 21], 6)
+        # same system prompt, divergent tail: full-page prefix hit
+        warm = eng.submit(sys_prompt + [30, 31], 6).result(30)
+        assert warm["tokens"] == greedy_oracle(lm, sys_prompt + [30, 31], 6)
+        snap = eng.metrics.snapshot()["models"]["llm"]
+        assert snap["counters"]["prefix_hits_total"] >= 1
+        assert snap["counters"]["prefix_tokens_saved_total"] >= 16
+        # partial-page hit (a prompt EXTENDING a cached one mid-page)
+        # forks copy-on-write before the first divergent write
+        base = sys_prompt + [40, 41]            # 18 toks: partial page
+        one = eng.submit(base, 6).result(30)
+        assert one["tokens"] == greedy_oracle(lm, base, 6)
+        two = eng.submit(base + [60, 61], 6).result(30)
+        assert two["tokens"] == greedy_oracle(lm, base + [60, 61], 6)
+        snap = eng.metrics.snapshot()["models"]["llm"]
+        assert snap["counters"]["cow_forks_total"] >= 1
+        assert eng.prefix_cache.stats()["counters"]["hits"] >= 2
+        eng.alloc.check_leaks()
+    finally:
+        eng.stop()
+    assert eng.alloc.num_used == 0
+    eng.alloc.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# export / import
+# ---------------------------------------------------------------------------
+def test_export_import_bit_identical(lm):
+    e1 = make_engine(lm)
+    e2 = make_engine(lm)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    try:
+        r1 = e1.submit(prompt, 5, session="mig").result(30)
+        blob = e1.export_session("mig")
+        meta, k, v = unpack_session(blob)
+        assert meta["sid"] == "mig" and k.shape == v.shape
+        sid = e2.import_session(blob)
+        assert sid == "mig"
+        # continuation on the importer == continuation the exporter
+        # would have produced == the full-context oracle
+        hist = prompt + r1["tokens"]
+        r2 = e2.submit([7], 5, session="mig", resume=True).result(30)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [7], 5)
+        assert e2.metrics.snapshot()["models"]["llm"]["counters"][
+            "migrations_in_total"] >= 1
+        with pytest.raises(KeyError):
+            e1.export_session("no-such-session")
+    finally:
+        e1.stop()
+        e2.stop()
+    for e in (e1, e2):
+        assert e.alloc.num_used == 0
+        e.alloc.check_leaks()
+
+
+def test_export_import_with_shared_prefix_pages(lm):
+    """A session whose page table maps shared prefix pages exports
+    private copies; refcounts are conserved on both sides and both
+    pools drain leak-free."""
+    e1 = make_engine(lm, prefix_cache=True)
+    e2 = make_engine(lm)
+    sys_prompt = list(range(1, 17))
+    try:
+        e1.submit(sys_prompt + [20], 4).result(30)        # seeds the cache
+        r = e1.submit(sys_prompt + [30], 4, session="sh").result(30)
+        assert e1.alloc.stats()["shared_pages"] >= 2       # table aliases
+        blob = e1.export_session("sh")
+        e2.import_session(blob)
+        hist = sys_prompt + [30] + r["tokens"]
+        r2 = e2.submit([40], 4, session="sh", resume=True).result(30)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [40], 4)
+        # exporter still owns its shared refs; both sides conserve pages
+        e1.alloc.check_leaks()
+        e2.alloc.check_leaks()
+    finally:
+        e1.stop()
+        e2.stop()
+    for e in (e1, e2):
+        assert e.alloc.num_used == 0
+        e.alloc.check_leaks()
+
+
+def test_export_import_fault_sites(lm):
+    eng = make_engine(lm)
+    try:
+        eng.submit([1, 2, 3], 3, session="f").result(30)
+        with faults.inject("session.export", "error", n=1, max_trips=1):
+            with pytest.raises(RuntimeError):
+                eng.export_session("f")
+        blob = eng.export_session("f")              # site clean again
+        with faults.inject("session.import", "error", n=1, max_trips=1):
+            with pytest.raises(RuntimeError):
+                eng.import_session(blob)
+    finally:
+        eng.stop()
+    assert eng.alloc.num_used == 0
+    eng.alloc.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# page store: generation fencing
+# ---------------------------------------------------------------------------
+def test_pagestore_generation_fencing(store):
+    cli = PageStoreClient.from_addr(store.address)
+    try:
+        assert cli.put("llm/s", {"kind": "transcript", "history": [1]},
+                       gen=1)
+        # stale and equal generations are rejected
+        assert not cli.put("llm/s", {"kind": "transcript"}, gen=1)
+        assert not cli.put("llm/s", {"kind": "transcript"}, gen=0)
+        rec, gen = cli.take("llm/s")
+        assert rec["history"] == [1] and gen == 2   # taker claims gen+1
+        # the lagging previous holder pushes its drain-time export at
+        # old_gen+1 == the claimed gen: fenced off
+        assert not cli.put("llm/s", {"kind": "transcript"}, gen=2)
+        # the taker's own next park (claimed+1) is accepted
+        assert cli.put("llm/s", {"kind": "transcript"}, gen=3)
+        # take on a missing key reports the high-water mark
+        cli.delete("llm/s")
+        rec, _ = cli.take("llm/s")
+        assert rec is None
+        st = cli.stats()
+        assert st["counters"]["stale_puts"] == 3
+        # bytes survive the framed transport intact (the blob path)
+        payload = bytes(range(256)) * 3
+        assert cli.put("llm/b", {"kind": "pages", "blob": payload}, gen=1)
+        rec, _ = cli.take("llm/b")
+        assert bytes(rec["blob"]) == payload
+    finally:
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# migration through the store
+# ---------------------------------------------------------------------------
+def test_sigkill_recovery_via_transcript_replay(lm, store):
+    """An abandoned engine (never drained — the SIGKILL analog) loses
+    its pages but not the session: every park pushed the transcript, so
+    a survivor replays and recomputes the identical cache."""
+    e1 = make_engine(lm, pagestore=store.address)
+    e2 = make_engine(lm, pagestore=store.address)
+    prompt = [2, 7, 1, 8, 2, 8]
+    try:
+        r1 = e1.submit(prompt, 4, session="k9").result(30)
+        hist = prompt + r1["tokens"]
+        # no drain, no migrate_out on e1: the survivor pulls the parked
+        # transcript on miss and replays
+        r2 = e2.submit([9], 4, session="k9", resume=True).result(30)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [9], 4)
+        snap = e2.metrics.snapshot()["models"]["llm"]["counters"]
+        assert snap["migrations_in_total"] >= 1
+        assert snap["migrations_replayed_total"] >= 1
+        # e1 now holds a stale copy; its drain-time push is fenced off
+        # and the session stays local there (degraded, not destroyed)
+        assert e1.migrate_out() == 0
+        assert "k9" in e1._sessions
+    finally:
+        e1.stop()
+        e2.stop()
+    for e in (e1, e2):
+        assert e.alloc.num_used == 0
+        e.alloc.check_leaks()
+
+
+def test_migrate_out_pages_pull_bit_identical(lm, store):
+    """Drain-style migration ships serialized pages; the puller
+    continues without any recompute, bit-identically."""
+    e1 = make_engine(lm, pagestore=store.address)
+    e2 = make_engine(lm, pagestore=store.address)
+    prompt = [5, 4, 3, 2, 1, 0, 1, 2, 3]
+    try:
+        r1 = e1.submit(prompt, 4, session="mv").result(30)
+        assert e1.migrate_out() == 1
+        assert "mv" not in e1._sessions
+        snap1 = e1.metrics.snapshot()["models"]["llm"]["counters"]
+        assert snap1["migrations_out_total"] >= 1
+        hist = prompt + r1["tokens"]
+        r2 = e2.submit([8], 4, session="mv", resume=True).result(30)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [8], 4)
+        snap2 = e2.metrics.snapshot()["models"]["llm"]["counters"]
+        assert snap2["migrations_in_total"] >= 1
+        assert snap2["migrations_replayed_total"] == 0   # pages, not replay
+    finally:
+        e1.stop()
+        e2.stop()
+    for e in (e1, e2):
+        assert e.alloc.num_used == 0
+        e.alloc.check_leaks()
+
+
+def test_stop_drain_auto_migrates(lm, store):
+    """stop(drain=True) ships parked sessions without being asked —
+    rollout/drain must never reset anyone's chat."""
+    e1 = make_engine(lm, pagestore=store.address)
+    e2 = make_engine(lm, pagestore=store.address)
+    prompt = [6, 6, 6, 1, 2]
+    try:
+        r1 = e1.submit(prompt, 4, session="auto").result(30)
+        e1.stop(drain=True)
+        hist = prompt + r1["tokens"]
+        r2 = e2.submit([3], 4, session="auto", resume=True).result(30)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [3], 4)
+    finally:
+        e1.stop()
+        e2.stop()
+    for e in (e1, e2):
+        assert e.alloc.num_used == 0
+        e.alloc.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: admin migrate_out, client resume_on_reset
+# ---------------------------------------------------------------------------
+def test_admin_migrate_out_and_stats_surface(lm, store):
+    eng = make_engine(lm, pagestore=store.address)
+    with serving.ModelServer(serving.ModelRegistry(), admin=True) as srv:
+        srv.attach_engine("llm", eng)
+        cli = serving.ServingClient(*srv.address)
+        cli.generate("llm", [1, 2, 3, 4], max_tokens=3, session="adm")
+        stats = cli.stats()["generators"]["llm"]
+        assert stats["migration"]["enabled"]
+        assert stats["kv"]["leaked_pages"] == 0
+        doc = cli._request("POST", "/v1/admin/migrate_out",
+                           {"name": "llm"})
+        assert doc["ok"] and doc["migrated"] == 1
+        text = cli.metrics_text()
+        assert "mxtpu_serving_kv_used_pages" in text
+        assert "mxtpu_serving_kv_leaked_pages" in text
+        with pytest.raises(serving.ModelNotFoundError):
+            cli._request("POST", "/v1/admin/migrate_out",
+                         {"name": "nope"})
+    eng.stop()
+    assert eng.alloc.num_used == 0
+    eng.alloc.check_leaks()
+
+
+def test_client_resume_on_reset_transparent_replay(lm):
+    """When every server-side copy of a session is gone (no page store),
+    resume_on_reset replays the client-kept transcript once — the
+    caller sees a normal answer, bit-identical to an unbroken session."""
+    e1 = make_engine(lm)
+    srv = serving.ModelServer(serving.ModelRegistry())
+    srv.start()
+    srv.attach_engine("llm", e1)
+    prompt = [9, 8, 7, 6]
+    try:
+        cli = serving.ServingClient(*srv.address)
+        r1 = cli.generate("llm", prompt, max_tokens=4, session="ror",
+                          resume_on_reset=True)
+        # replace the engine: the session is gone for good
+        e2 = make_engine(lm)
+        srv.attach_engine("llm", e2)
+        e1.stop()
+        hist = prompt + r1["tokens"]
+        r2 = cli.generate("llm", [5], max_tokens=4, session="ror",
+                          resume=True, resume_on_reset=True)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [5], 4)
+        # without the flag the 409 still surfaces typed
+        e3 = make_engine(lm)
+        srv.attach_engine("llm", e3)
+        e2.stop()
+        with pytest.raises(serving.SessionResetError):
+            cli.generate("llm", [4], max_tokens=2, session="ror",
+                         resume=True)
+    finally:
+        srv.stop()
+    for e in (e1, e2, e3):
+        e.stop()
+        assert e.alloc.num_used == 0
+        e.alloc.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode through the router
+# ---------------------------------------------------------------------------
+def test_role_split_disaggregated_dispatch(lm, store, monkeypatch):
+    """Two-phase dispatch: a fresh long prompt prefills on the prefill
+    replica, its pages hand off through the store, and the decode
+    replica generates the rest — stitched answer == the one-replica
+    oracle."""
+    monkeypatch.setenv("MXNET_GEN_DISAGG_MIN_PROMPT", "8")
+    ep = make_engine(lm, role="prefill", pagestore=store.address)
+    ed = make_engine(lm, role="decode", pagestore=store.address)
+    sp = serving.ModelServer(serving.ModelRegistry())
+    sp.start()
+    sp.attach_engine("llm", ep)
+    sd = serving.ModelServer(serving.ModelRegistry())
+    sd.start()
+    sd.attach_engine("llm", ed)
+    router = serving.Router(
+        ["127.0.0.1:%d" % sp.port, "127.0.0.1:%d" % sd.port],
+        policy="hash", probe_ms=0, roles=["prefill", "decode"])
+    assert router.role_split()
+    rs = serving.RouterServer(router)
+    rs.start()
+    try:
+        cli = serving.ServingClient(*rs.address)
+        prompt = list(range(1, 13))
+        doc = cli.generate("llm", prompt, max_tokens=6)
+        assert doc.get("disaggregated") is True
+        assert doc["tokens"] == greedy_oracle(lm, prompt, 6)
+        assert doc["completion_tokens"] == 6
+        pc = ep.metrics.snapshot()["models"]["llm"]["counters"]
+        dc = ed.metrics.snapshot()["models"]["llm"]["counters"]
+        assert pc["migrations_out_total"] >= 1     # the page handoff
+        assert dc["migrations_in_total"] >= 1
+        # short prompts skip the split and answer on the decode pool
+        doc = cli.generate("llm", [1, 2, 3], max_tokens=2)
+        assert doc.get("disaggregated") is None
+        assert doc["tokens"] == greedy_oracle(lm, [1, 2, 3], 2)
+    finally:
+        rs.stop()
+        sp.stop()
+        sd.stop()
+    for e in (ep, ed):
+        e.stop()
+        assert e.alloc.num_used == 0
+        e.alloc.check_leaks()
+
+
+def test_fleet_rollout_migrates_sessions_report():
+    """The rollout report carries the migrated-session count per
+    replica (the fleet half is exercised multi-process in the chaos
+    drill; here the helper path against a live replica-shaped server)."""
+    from mxnet_tpu.serving.fleet import _migrate_sessions
+    # a server with no generators migrates nothing, cleanly
+    with serving.ModelServer(serving.ModelRegistry(), admin=True) as srv:
+        assert _migrate_sessions("127.0.0.1", srv.port) == 0
+    # unreachable replica: best-effort zero, no raise
+    assert _migrate_sessions("127.0.0.1", srv.port) == 0

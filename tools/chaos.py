@@ -27,15 +27,17 @@ Scenarios (--scenario):
            (4) a subsequent rolling model rollout (canary + drain one
            at a time) completes during traffic with zero dropped
            requests and the new version serving everywhere.
-  llm      LLM decode failover: N replicas serving a causal LM through
-           the continuous-batching decode engine (consistent-hash
-           session affinity); SIGKILL one mid-generation under
-           sustained decode traffic.  PASS when sessionless generations
-           never fail, every session failure is TYPED
-           (SessionResetError / explicit non-idempotent error — no
-           silent misroute to a replica without the KV pages), the
-           supervisor restores the fleet, fresh sessions work, and
-           router-level failures are zero.
+  llm      LLM decode failover + session migration: N replicas serving
+           a causal LM through the continuous-batching decode engine
+           (consistent-hash session affinity, fleet page store);
+           SIGKILL one mid-generation under sustained decode traffic,
+           then roll the generate engine with sessions parked.  PASS
+           when sessionless generations never fail, every session
+           failure is TYPED (explicit non-idempotent error — no silent
+           misroute), ZERO sessions reset (SIGKILL and rollout both
+           recover through the page store: pages when pushed, replayed
+           transcripts otherwise), the supervisor restores the fleet,
+           fresh sessions work, and router-level failures are zero.
 
 Usage:
   python tools/chaos.py                       # default spec, 2 workers
@@ -391,16 +393,22 @@ def scenario_fleet(args):
 
 def scenario_llm(args):
     """SIGKILL a replica mid-generation under sustained continuous-
-    batching decode traffic (sessions pinned by consistent hash).
+    batching decode traffic (sessions pinned by consistent hash), then
+    a rolling generate-engine swap with sessions parked.
 
-    PASS conditions: (1) sessionless generations NEVER fail — they are
-    idempotent and the router fails them over; (2) every session-traffic
-    failure is TYPED (SessionResetError after the owner died, or the
-    router's explicit non-idempotent mid-request error) — never a silent
-    misroute to a replica without the KV pages; (3) the supervisor
-    restores the full replica count and fresh sessions work everywhere;
-    (4) zero router-level failures (FleetUnavailableError) — the fleet
-    always had someone to answer."""
+    PASS conditions (session-migration bar — the fleet page store makes
+    sessions survive their replica): (1) sessionless generations NEVER
+    fail — they are idempotent and the router fails them over; (2) every
+    session-traffic failure is TYPED (the router's explicit
+    non-idempotent mid-request error) — never a silent misroute; (3)
+    ZERO SessionResetErrors, SIGKILL included — every parked turn's
+    transcript was couriered to the page store before the client saw
+    its result, so survivors replay instead of resetting; (4) the
+    supervisor restores the full replica count and fresh sessions work
+    everywhere; (5) a rollout with parked sessions migrates them —
+    every one resumes afterwards, zero resets; (6) zero router-level
+    failures (FleetUnavailableError) — the fleet always had someone to
+    answer."""
     import threading
 
     sys.path.insert(0, REPO)
@@ -590,12 +598,51 @@ def scenario_llm(args):
                     fresh_fail += 1
                     print("chaos-llm: fresh session FAILED: %r" % (e,))
                 break
+
+        # rollout-during-sessions drill: park sessions, roll the
+        # generate engine across every replica, resume them all — the
+        # rollout must MIGRATE parked sessions, never reset them
+        roll = ["roll-%d" % i for i in range(2 * n)]
+        for sid in roll:
+            warm_cli.generate("llm", [2, 4, 6], max_tokens=3,
+                              session=sid)
+        rollout_fail, roll_resets, roll_ok = 0, 0, 0
+        try:
+            rep = fleet.rollout(dict(spec["models"][0]))
+            migrated = sum(r.get("migrated_sessions", 0)
+                           for r in rep["replicas"])
+            print("chaos-llm: rollout migrated %d parked session(s)"
+                  % migrated)
+        except Exception as e:
+            rollout_fail = 1
+            print("chaos-llm: rollout FAILED: %r" % (e,))
+        for sid in roll:
+            for attempt in (0, 1):
+                try:
+                    warm_cli.generate("llm", [8], max_tokens=3,
+                                      session=sid, resume=True)
+                    roll_ok += 1
+                except SessionResetError:
+                    roll_resets += 1
+                    print("chaos-llm: session %s RESET by rollout" % sid)
+                except serving.ServingError as e:
+                    if attempt == 0:  # readiness settle: one retry
+                        continue
+                    roll_resets += 1
+                    print("chaos-llm: post-rollout resume failed: %r"
+                          % (e,))
+                except Exception as e:
+                    roll_resets += 1
+                    print("chaos-llm: post-rollout resume failed: %r"
+                          % (e,))
+                break
         warm_cli.close()
 
         print("chaos-llm: load %s; warm resumes: %d ok, %d reset, %d "
-              "untyped; fresh failures: %d; replicas restored %d/%d"
+              "untyped; fresh failures: %d; replicas restored %d/%d; "
+              "rollout resumes: %d ok, %d reset"
               % (counters, resumed, resets, untyped, fresh_fail,
-                 restored, n))
+                 restored, n, roll_ok, roll_resets))
         if counters["router"]:
             print("FAIL: %d router-level failure(s)" % counters["router"])
             ok = False
@@ -610,13 +657,23 @@ def scenario_llm(args):
             print("FAIL: %d fresh session(s) failed after recovery"
                   % fresh_fail)
             ok = False
-        if resets == 0:
-            print("FAIL: no warm session was reset — the kill tested "
-                  "nothing (victim held no sessions?)")
+        if resets or counters["reset"]:
+            print("FAIL: %d session reset(s) — with the page store, "
+                  "SIGKILL must lose ZERO sessions (transcripts are "
+                  "couriered at every park)"
+                  % (resets + counters["reset"]))
             ok = False
-        if resumed == 0:
-            print("FAIL: every warm session reset — survivors lost "
-                  "state they should have kept")
+        if resumed < len(warm):
+            print("FAIL: only %d/%d warm sessions resumed after the "
+                  "kill" % (resumed, len(warm)))
+            ok = False
+        if rollout_fail:
+            print("FAIL: rollout raised")
+            ok = False
+        if roll_resets:
+            print("FAIL: %d session(s) reset by the rollout — it must "
+                  "migrate parked sessions, not reset them"
+                  % roll_resets)
             ok = False
         if not counters["ok"]:
             print("FAIL: load generator completed no requests")
