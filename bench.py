@@ -739,7 +739,7 @@ def bench_llm_decode():
             else int(rng.randint(4, 25)) for _ in range(n_req)]
 
     def run(static, decode_fused=None, workload=None, prefix_cache=False,
-            total_pages=None):
+            total_pages=None, speculate=False, spec_k=None):
         if decode_fused is not None:
             os.environ["MXNET_DECODE_FUSED"] = decode_fused
         wl_prompts, wl_outs = workload or (prompts, outs)
@@ -749,7 +749,9 @@ def bench_llm_decode():
                                max_ctx=max_ctx, total_pages=total_pages,
                                max_queue_depth=4 * n_req,
                                static_batching=static,
-                               prefix_cache=prefix_cache)
+                               prefix_cache=prefix_cache,
+                               speculate=speculate, spec_k=spec_k,
+                               drafter="ngram" if speculate else None)
             eng.warmup()  # compile prefill+decode outside the window
             t0 = time.perf_counter()
             futs = [eng.submit(p, max_new_tokens=n)
@@ -778,6 +780,11 @@ def bench_llm_decode():
             }
             if pfx is not None:
                 m["prefix_cache"] = pfx
+            spec = gen.get("speculative")
+            if spec is not None:
+                m["accepted_token_rate"] = spec["accepted_token_rate"]
+                m["tokens_per_step_p50"] = (
+                    gen["tokens_per_step"].get("p50"))
             return tokens / dt, m
         finally:
             if decode_fused is not None:
@@ -816,6 +823,26 @@ def bench_llm_decode():
         (run(static=False, workload=shared_wl, prefix_cache=True,
              total_pages=shared_pages)
          for _ in range(2)), key=lambda r: r[0])
+    # speculative A/B: a repetitive high-acceptance stream (short motifs
+    # repeated — templated output / code-completion shape) decoded with
+    # and without the n-gram drafter, IDENTICAL requests both arms.
+    # With acceptance high the wide verify emits several tokens per
+    # launch, so inter-token p50 divides by the emitted count while the
+    # launch bill stays one program per step (see benchmark/steplat.py's
+    # launches-per-emitted-token census).  Accepted-token rate rides in
+    # the row — it is the number to read before trusting the speedup.
+    motifs = [list(rng.randint(1, model_kw["vocab_size"], size=4))
+              for _ in range(6)]
+    rep_prompts = [motifs[i % len(motifs)] * 6 for i in range(n_req)]
+    rep_new = min(48, max_ctx - len(rep_prompts[0]) - 1)
+    spec_wl = (rep_prompts, [rep_new] * n_req)
+    spec_off_tps, spec_off_m = max(
+        (run(static=False, workload=spec_wl, total_pages=shared_pages)
+         for _ in range(2)), key=lambda r: r[0])
+    spec_on_tps, spec_on_m = max(
+        (run(static=False, workload=spec_wl, total_pages=shared_pages,
+             speculate=True, spec_k=4)
+         for _ in range(2)), key=lambda r: r[0])
     # fused-decode A/B: on the bench chip the auto gate runs the
     # persistent kernel, so compare inter-token latency against a
     # forced-unfused arm; on CPU (auto = per-op path) record the STATIC
@@ -844,6 +871,16 @@ def bench_llm_decode():
              "shared_prefix_ttft_speedup": round(
                  shared_cold_m["ttft_p50_ms"] / shared_m["ttft_p50_ms"],
                  3) if shared_m.get("ttft_p50_ms") else None,
+             "speculative": spec_on_m,
+             "speculative_off": spec_off_m,
+             "speculative_tokens_per_s": round(spec_on_tps, 2),
+             "speculative_off_tokens_per_s": round(spec_off_tps, 2),
+             "speculative_inter_token_speedup": round(
+                 spec_off_m["inter_token_p50_ms"]
+                 / spec_on_m["inter_token_p50_ms"], 3)
+             if spec_on_m.get("inter_token_p50_ms") else None,
+             "speculative_accepted_token_rate":
+                 spec_on_m.get("accepted_token_rate"),
              "requests": n_req, "slots": slots, "page_size": page,
              "prefill_chunk": chunk,
              "decode_launches_tower": census_tower,
@@ -867,7 +904,13 @@ def bench_llm_decode():
                       "shared arms to each other, not to the mixed "
                       "rows: the shared workload's prompts are ~2x "
                       "longer, so its absolute TTFT sits above the "
-                      "single-pool mixed row by construction."}
+                      "single-pool mixed row by construction.  "
+                      "speculative vs speculative_off: identical "
+                      "repetitive stream with the n-gram drafter on "
+                      "vs off (greedy output bit-identical) — the "
+                      "inter-token p50 ratio is the speculative win; "
+                      "acceptance bar >= 1.5x at high accepted-token "
+                      "rate on this box."}
     return cont_tps, extra
 
 

@@ -155,6 +155,61 @@ def decode_steplat(measure=True, iters=10, fused_mode=None, slots=8,
     return out
 
 
+def speculative_steplat(measure=True, iters=10, slots=8, page_size=8,
+                        ks=(1, 2, 4), model_kw=None):
+    """Launches-per-emitted-token census of the speculative wide-verify
+    step at several speculation depths, next to the plain decode step.
+
+    The verify program's launch count is STATIC — a property of
+    (cfg, page_size, width) fixed at trace time, independent of how
+    many drafts the target accepts (acceptance only selects which
+    outputs are kept).  At depth k the one launch can emit up to k + 1
+    tokens, so ``launches_per_emitted_token`` is the per-token dispatch
+    bill at full acceptance; the plain decode row is the k = 0
+    baseline.  tests/test_speculative.py gates the census; wall time
+    stays informational."""
+    from mxnet_tpu.models import decoder as dec
+
+    kw = dict(vocab_size=128, num_layers=2, units=64, hidden_size=128,
+              num_heads=4, num_kv_heads=2, max_length=128)
+    kw.update(model_kw or {})
+    lm = dec.decoder_tiny_lm(seed=0, **kw)
+    cfg = lm.config
+    params = lm.jax_params()
+    pps = (kw["max_length"] + page_size - 1) // page_size
+    total = slots * pps + 1
+
+    plain = dec.decode_launch_stats(params, cfg, page_size, slots, pps,
+                                    total, fused=False)
+    out = {"decode": {
+        "launches_per_step": plain["launches_per_step"],
+        "launches_per_emitted_token": plain["launches_per_step"]}}
+    shape = (cfg.num_layers, cfg.num_kv_heads, total, page_size,
+             cfg.head_dim)
+    for k in ks:
+        width = k + 1
+        row = dict(dec.verify_launch_stats(params, cfg, page_size,
+                                           width, slots, pps, total))
+        if measure:
+            fn = dec.make_verify_step(cfg, page_size, width)
+
+            def run(fn=fn, width=width):
+                kp = jnp.zeros(shape, jnp.float32)
+                vp = jnp.zeros(shape, jnp.float32)
+                return fn(params, kp, vp,
+                          jnp.zeros((slots, width), jnp.int32),
+                          jnp.zeros(slots, jnp.int32),
+                          jnp.zeros(slots, jnp.int32),
+                          jnp.zeros((slots, pps), jnp.int32),
+                          jnp.zeros(slots, bool))[2]
+            jax.block_until_ready(run())  # compile
+            row["host_gap_us_per_step"] = _median_wall_us(run,
+                                                          iters=iters)
+        out["k%d" % k] = row
+    out["slots"] = slots
+    return out
+
+
 def sharded_steplat(mesh_shape=(4, 2), axis_names=("dp", "tp"), B=8, L=32,
                     units=64, hidden=128, heads=2, measure=True, iters=10):
     """Collective census + latency of the dp×tp sharded train step.
@@ -203,6 +258,7 @@ def main():
         "backend": jax.default_backend(),
         "lstm": lstm_steplat(),
         "decode": decode_steplat(),
+        "speculative": speculative_steplat(),
     }
     sharded = {}
     for name, shape, axes in (("dp8", (8,), ("dp",)),

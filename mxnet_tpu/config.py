@@ -251,7 +251,9 @@ register("MXNET_FAULT_SPEC", str, "", "honored",
          "deterministic fault injection spec: site:kind[@p=F|n=I] joined "
          "by ';' (sites: kvstore.send, kvstore.recv, server.apply, "
          "server.membership, trainer.step, checkpoint.write, "
-         "router.dispatch, replica.crash)", "faults")
+         "router.dispatch, replica.crash, decode.step, kvcache.alloc, "
+         "session.export, session.import, speculate.draft, "
+         "speculate.verify)", "faults")
 register("MXNET_FAULT_SEED", int, 0, "honored",
          "seed for probability-based fault-injection rules (deterministic "
          "trip sequences per (seed, site, kind))", "faults.FaultRule")
@@ -347,6 +349,31 @@ register("MXNET_DECODE_FUSED", str, "", "honored",
 register("MXNET_DECODE_LAYER_GROUP", int, 0, "honored",
          "decoder layers per fused decode-step kernel launch (0 = all "
          "layers in ONE group — one launch per token per engine step)",
+         "serving.DecodeEngine")
+register("MXNET_GEN_SPECULATE", int, 0, "honored",
+         "1 = speculative decoding in the LLM engine: a drafter "
+         "proposes up to MXNET_GEN_SPEC_K tokens per slot and one wide "
+         "verify launch scores them; greedy output stays bit-identical "
+         "to plain decode (off by default until the bench bar on the "
+         "target chip is confirmed)", "serving.DecodeEngine")
+register("MXNET_GEN_SPEC_K", int, 4, "honored",
+         "speculation depth cap: the per-sequence adaptive-k "
+         "controller moves between 1 and this many drafted tokens per "
+         "step (0 disables a sequence when acceptance collapses)",
+         "serving.speculate.SpeculativeScheduler")
+register("MXNET_GEN_SPEC_DRAFTER", str, "ngram", "honored",
+         "drafter choice: 'ngram' (prompt-lookup over the transcript, "
+         "model-free) or 'model' (a small draft CausalLM with its own "
+         "paged KV cache; see MXNET_GEN_SPEC_DRAFT_BUILDER)",
+         "serving.DecodeEngine")
+register("MXNET_GEN_SPEC_NGRAM", int, 3, "honored",
+         "longest transcript n-gram the prompt-lookup drafter matches "
+         "before backing off to shorter ones",
+         "serving.speculate.NGramDrafter")
+register("MXNET_GEN_SPEC_DRAFT_BUILDER", str, "", "honored",
+         "'module:callable' building the draft model from the target "
+         "(callable(target_model) -> CausalLM); empty = "
+         "models.decoder.decoder_draft's reduced-depth/width default",
          "serving.DecodeEngine")
 register("MXNET_GEN_FN_CACHE", int, 16, "honored",
          "LRU capacity of the per-geometry jitted decode/prefill "

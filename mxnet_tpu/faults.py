@@ -38,6 +38,14 @@ Sites (grep for ``faults.check``):
   session.import     decode-session KV import on the receiving replica
                      (torn-transfer drill: a raise drops the pulled
                      record, so the resume sees the typed reset path)
+  speculate.draft    speculative-decoding draft proposal (exception kinds
+                     poison ONE sequence's adaptive-k controller — that
+                     sequence degrades to plain decode, the engine keeps
+                     serving)
+  speculate.verify   speculative-decoding wide verify, before the launch
+                     (exception kinds degrade the whole step to plain
+                     decode and poison the planned sequences' controllers
+                     — no tokens are lost, no resets)
 
 Kinds: ``reset`` (ConnectionResetError), ``timeout`` (socket.timeout),
 ``error``/``crash`` (RuntimeError), plus site-interpreted kinds that
@@ -93,7 +101,8 @@ _SOFT_KINDS = ("drop", "torn", "preempt", "kill")
 KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
                "server.membership", "trainer.step", "checkpoint.write",
                "router.dispatch", "replica.crash", "decode.step",
-               "kvcache.alloc", "session.export", "session.import")
+               "kvcache.alloc", "session.export", "session.import",
+               "speculate.draft", "speculate.verify")
 
 
 class FaultRule:

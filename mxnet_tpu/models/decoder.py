@@ -53,8 +53,10 @@ warnings.filterwarnings(
     "ignore", message="Some donated buffers were not usable")
 
 __all__ = ["DecoderConfig", "CausalLM", "full_forward", "make_decode_step",
-           "make_decode_step_fused", "make_prefill_chunk", "fn_cache_stats",
-           "decode_launch_stats", "decoder_tiny", "decoder_tiny_lm"]
+           "make_decode_step_fused", "make_prefill_chunk",
+           "make_verify_step", "fn_cache_stats", "decode_launch_stats",
+           "verify_launch_stats", "decoder_tiny", "decoder_tiny_lm",
+           "decoder_draft"]
 
 
 # ---------------------------------------------------------------------------
@@ -424,6 +426,118 @@ def _build_prefill_chunk(cfg, page_size, chunk):
     return jax.jit(prefill, donate_argnums=(1, 2))
 
 
+def make_verify_step(cfg, page_size, width):
+    """Build (or fetch) the jitted wide VERIFY step for speculative
+    decoding — cached per (cfg, page_size, width) in the same bounded
+    per-geometry LRU as the decode/prefill programs.
+
+    One launch scores ``width`` candidate tokens per slot against the
+    target model (the slot's pending token plus up to ``width - 1``
+    drafted ones): their KV is scattered into the slot's pages exactly
+    like a prefill chunk, the queries attend causally over the slot's
+    own gathered pages, and the argmax at EVERY position comes back —
+    position ``i``'s output is the greedy successor of the prefix ending
+    at token ``i``, which is what longest-prefix acceptance compares the
+    draft against.  Rejected positions leave garbage KV behind; the
+    engine rolls those pages back (``PageAllocator.trim``) and masked
+    reads never see them.
+
+    fn(params, k_pages, v_pages, tokens, positions, n_valid,
+       page_tables, active)
+      tokens:     (B, width) int32 — [pending, draft...] per slot,
+                  zero-padded past n_valid
+      positions:  (B,) int32 — cache index tokens[:, 0] lands at
+      n_valid:    (B,) int32 — real tokens this step per slot (1 =
+                  plain decode riding the wide program)
+      page_tables:(B, pages_per_seq) int32
+      active:     (B,) bool — inactive slots write the scratch page
+    -> (k_pages, v_pages, out_tokens (B, width) int32)
+    """
+    return _fn_cache.get(("verify", cfg, int(page_size), int(width)),
+                         lambda: _build_verify_step(cfg, int(page_size),
+                                                    int(width)))
+
+
+def _build_verify_step(cfg, page_size, width):
+    S = int(page_size)
+    W = int(width)
+    g = cfg.num_heads // cfg.num_kv_heads
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+
+    def verify(params, k_pages, v_pages, tokens, positions, n_valid,
+               page_tables, active):
+        B = tokens.shape[0]
+        pps = page_tables.shape[1]
+        idx = positions[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        valid = ((jnp.arange(W)[None, :] < n_valid[:, None])
+                 & active[:, None])
+        x = (params["embed"][tokens]
+             + params["pos"][jnp.clip(idx, 0, cfg.max_length - 1)])
+        page_of = jnp.take_along_axis(
+            page_tables, jnp.clip(idx // S, 0, pps - 1), axis=1)
+        # invalid/padded positions scatter to the reserved scratch page
+        wp = jnp.where(valid, page_of, 0)
+        ws = jnp.where(valid, idx % S, 0)
+        for li, lp in enumerate(params["layers"]):
+            q, k, v = _qkv(x, lp, cfg)                  # (B, W, H/KVH, D)
+            k_pages = k_pages.at[li, :, wp, ws, :].set(k)
+            v_pages = v_pages.at[li, :, wp, ws, :].set(v)
+            kc = _paged.gather_pages(k_pages[li], page_tables)
+            vc = _paged.gather_pages(v_pages[li], page_tables)
+            kr = jnp.repeat(kc, g, axis=1)              # (B, H, C, D)
+            vr = jnp.repeat(vc, g, axis=1)
+            qf = q.astype(jnp.float32).transpose(0, 2, 1, 3) * scale
+            logits = jnp.einsum("bhwd,bhcd->bhwc", qf,
+                                kr.astype(jnp.float32))
+            causal = (jnp.arange(kr.shape[2])[None, None, :]
+                      <= idx[:, :, None])               # key <= query pos
+            logits = jnp.where(causal[:, None], logits, -jnp.inf)
+            p = jax.nn.softmax(logits, axis=-1)
+            p = jnp.where(jnp.isnan(p), 0.0, p)
+            att = jnp.einsum("bhwc,bhcd->bhwd", p, vr.astype(jnp.float32))
+            merged = att.transpose(0, 2, 1, 3).reshape(
+                B, W, cfg.units).astype(x.dtype)
+            x = _layer_tail(x, merged, lp)
+        logits = jnp.dot(x.astype(jnp.float32),
+                         params["embed"].astype(jnp.float32).T)
+        return (k_pages, v_pages,
+                jnp.argmax(logits, axis=-1).astype(jnp.int32))
+
+    return jax.jit(verify, donate_argnums=(1, 2))
+
+
+def verify_launch_stats(params, cfg, page_size, width, slots,
+                        pages_per_seq, total_pages):
+    """Static launch census of one wide verify step (the speculative
+    analog of :func:`decode_launch_stats`): traced, deterministic, and
+    independent of acceptance — the launch count is a property of
+    (cfg, page_size, width) alone, never of which drafts land.
+
+    Returns {width, launches_per_step, pallas_per_step,
+    launches_per_emitted_token} where the per-emitted figure assumes
+    full acceptance (``width`` tokens emitted by the one launch)."""
+    S = int(page_size)
+    W = int(width)
+    fn = make_verify_step(cfg, S, W)
+    shape = (cfg.num_layers, cfg.num_kv_heads, int(total_pages), S,
+             cfg.head_dim)
+    kp = jax.ShapeDtypeStruct(shape, jnp.float32)
+    args = (jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+            kp, kp,
+            jax.ShapeDtypeStruct((slots, W), jnp.int32),
+            jax.ShapeDtypeStruct((slots,), jnp.int32),
+            jax.ShapeDtypeStruct((slots,), jnp.int32),
+            jax.ShapeDtypeStruct((slots, pages_per_seq), jnp.int32),
+            jax.ShapeDtypeStruct((slots,), jnp.bool_))
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    launches = _fused.count_launches(jaxpr)
+    return {"width": W,
+            "launches_per_step": int(launches),
+            "pallas_per_step": int(_fused.count_pallas_calls(jaxpr)),
+            "launches_per_emitted_token": launches / float(W)}
+
+
 # ---------------------------------------------------------------------------
 # gluon parameter container
 # ---------------------------------------------------------------------------
@@ -537,5 +651,26 @@ def decoder_tiny_lm(seed=0, vocab_size=128, **kw):
     import mxnet_tpu as mx
     mx.random.seed(int(seed))
     net = decoder_tiny(vocab_size, **kw)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def decoder_draft(target, seed=0, num_layers=1, units=32, hidden_size=64,
+                  num_heads=2, num_kv_heads=1):
+    """Reduced-depth/width draft LM for speculative decoding: shares the
+    target's tokenizer (vocab) and context length but runs a fraction of
+    its compute per token.  ``target`` is the CausalLM (or its
+    DecoderConfig) the drafts will be verified against — a vocab
+    mismatch would make the draft tokens meaningless, so geometry is
+    copied rather than trusted to the caller."""
+    import mxnet_tpu as mx
+    cfg = target.config if hasattr(target, "config") else target
+    mx.random.seed(int(seed))
+    net = CausalLM(cfg.vocab_size, num_layers=int(num_layers),
+                   units=int(units), hidden_size=int(hidden_size),
+                   num_heads=int(num_heads),
+                   num_kv_heads=int(num_kv_heads),
+                   max_length=cfg.max_length,
+                   eos_id=getattr(target, "eos_id", None))
     net.initialize(mx.init.Xavier())
     return net

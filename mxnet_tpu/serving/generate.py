@@ -44,6 +44,14 @@ The Orca + vLLM serving recipe, grown onto this repo's serving stack:
   (prefix caching makes that cheap) — and only a store miss raises the
   typed reset.  Fault sites ``session.export`` / ``session.import``
   make torn transfers injectable.
+- **Speculative decoding** (``MXNET_GEN_SPECULATE``) — a cheap drafter
+  (n-gram prompt lookup or a small draft model, ``serving/speculate``)
+  proposes up to ``MXNET_GEN_SPEC_K`` tokens per slot and ONE wide
+  verify launch scores the whole batch; longest-prefix greedy
+  acceptance keeps the emitted stream bit-identical to plain decode,
+  rejected positions roll back via ``PageAllocator.trim`` (CoW-aware),
+  and a per-sequence adaptive-k controller turns speculation off for
+  streams that stop accepting.
 - **Role specialization** (``MXNET_GEN_ROLE``) — a ``prefill`` engine
   hands each finished prompt's KV pages to the store for a ``decode``
   replica to claim (DistServe/Splitwise disaggregation); the fleet
@@ -179,7 +187,8 @@ class DecodeEngine:
                  eos_id=None, max_queue_depth=256, metrics=None,
                  static_batching=False, session_ttl_s=None,
                  prefix_cache=None, role=None, migrate=None,
-                 pagestore=None):
+                 pagestore=None, speculate=None, spec_k=None,
+                 drafter=None, draft_model=None):
         self.model = model
         self.name = name
         self.cfg = model.config
@@ -274,6 +283,16 @@ class DecodeEngine:
         self._store_client = None     # lazy; False = gave up connecting
         self._ops = collections.deque()   # (fn, Future|None) — worker ops
         self._pending_imports = set()     # sids with a queued import op
+
+        # speculative decoding (MXNET_GEN_SPECULATE): a drafter proposes
+        # k tokens per decode slot and one wide verify launch scores all
+        # of them — see serving/speculate.py.  A prefill-role engine
+        # never decodes, so it never speculates.
+        self._spec = None
+        use_spec = (bool(speculate) if speculate is not None
+                    else bool(_config.get("MXNET_GEN_SPECULATE")))
+        if use_spec and self.role != "prefill":
+            self._spec = self._build_spec(drafter, draft_model, spec_k)
 
     # -- admission --------------------------------------------------------
     @property
@@ -647,6 +666,7 @@ class DecodeEngine:
                     with self._cond:
                         self._sessions.pop(sess.sid, None)
                     self.alloc.free(sess.owner)
+                    self._spec_release(sess.owner, sess.sid)
                     moved += 1
                     self.metrics.count(self.name, "migrations_out_total")
                 else:
@@ -695,6 +715,7 @@ class DecodeEngine:
         with self._cond:
             self._sessions.pop(req.session, None)
         self.alloc.free(slot.owner)
+        self._spec_release(slot.owner, req.session)
         self.metrics.count(self.name, "migrations_out_total")
         return True
 
@@ -717,6 +738,7 @@ class DecodeEngine:
                     if not s.busy and s.last_used < cutoff]:
             sess = self._sessions.pop(sid)
             self.alloc.free(sess.owner)
+            self._spec_release(sess.owner, sid)
 
     # -- scheduling -------------------------------------------------------
     def _free_slot(self):
@@ -893,6 +915,7 @@ class DecodeEngine:
             victim = min(idle, key=lambda s: s.last_used)
             del self._sessions[victim.sid]
         self.alloc.free(victim.owner)
+        self._spec_release(victim.owner, victim.sid)
         return True
 
     def _resume_missing(self, req):
@@ -1003,6 +1026,7 @@ class DecodeEngine:
         new.ttft_recorded = req.ttft_recorded
         new.prompt_tokens = req.prompt_tokens
         self.alloc.free(slot.owner)
+        self._spec_release(slot.owner)  # draft cache is stale with the pages
         if req.session is not None:
             # the parked context is gone with the pages; the requeued
             # request re-creates the session from the full history
@@ -1094,6 +1118,8 @@ class DecodeEngine:
         live = [s for s in live if s.state == "decode"]
         if not live:
             return
+        if self._spec is not None and self._decode_speculative(live):
+            return
         tokens = onp.zeros(self.slots, onp.int32)
         positions = onp.zeros(self.slots, onp.int32)
         active = onp.zeros(self.slots, bool)
@@ -1120,6 +1146,208 @@ class DecodeEngine:
         self.metrics.observe_decode_step(
             self.name, now - t0, now - t0, len(live), self.slots,
             len(live))
+
+    # -- speculative decoding ---------------------------------------------
+    def _build_spec(self, drafter, draft_model, spec_k):
+        from .speculate import (DraftModelDrafter, Drafter, NGramDrafter,
+                                SpeculativeScheduler)
+        if isinstance(drafter, Drafter):
+            d = drafter
+        else:
+            kind = str(drafter if drafter is not None
+                       else _config.get("MXNET_GEN_SPEC_DRAFTER")
+                       or "ngram")
+            if kind == "model" or draft_model is not None:
+                dm = draft_model
+                if dm is None:
+                    builder = str(_config.get(
+                        "MXNET_GEN_SPEC_DRAFT_BUILDER") or "")
+                    if builder:
+                        import importlib
+                        mod, _, attr = builder.partition(":")
+                        dm = getattr(importlib.import_module(mod),
+                                     attr)(self.model)
+                    else:
+                        dm = _decoder.decoder_draft(self.model)
+                d = DraftModelDrafter(dm, page_size=self.page_size)
+            else:
+                d = NGramDrafter()
+        return SpeculativeScheduler(d, k_cap=spec_k, name=self.name)
+
+    def _spec_key(self, slot):
+        """Controller key: the session id for session requests (learned
+        acceptance carries across turns), else the slot's owner."""
+        if slot.req is not None and slot.req.session is not None:
+            return slot.req.session
+        return slot.owner
+
+    def _spec_release(self, owner, key=None):
+        """Drop per-sequence drafter state when ``owner``'s pages are
+        retired (finish/fail/preempt/evict/migrate); with ``key`` the
+        adaptive-k controller goes too."""
+        if self._spec is None or owner is None:
+            return
+        try:
+            self._spec.release(owner, key)
+        except Exception:  # pragma: no cover - drafter bug must not kill
+            _log.exception("drafter release failed")
+
+    def _decode_speculative(self, live):
+        """One draft → wide-verify → accept/rollback step over the whole
+        decode batch.  Returns False (nothing consumed) when no slot has
+        a draft this step or the verify fault gate trips — the caller
+        falls through to the plain one-token decode step.
+
+        Every live slot rides the SAME wide launch: speculating slots
+        feed ``1 + k`` positions, plain slots feed their single pending
+        token with ``n_valid = 1`` — mixed batches cost nothing extra
+        and the launch count stays static per (geometry, width),
+        independent of acceptance."""
+        spec = self._spec
+        plan = {}                       # slot.idx -> draft token list
+        for s in live:
+            req = s.req
+            budget = req.max_new - len(req.prefix) - len(s.generated)
+            max_k = min(spec.k_cap, budget - 1, self.max_ctx - s.pos - 1)
+            k = spec.budget(self._spec_key(s), max_k)
+            if k <= 0:
+                continue
+            t0 = time.perf_counter()
+            draft = spec.propose(self._spec_key(s), s.owner,
+                                 list(s.history) + [s.pending], k)
+            self.metrics.observe_draft(self.name,
+                                       time.perf_counter() - t0)
+            if draft:
+                plan[s.idx] = [int(t) for t in draft]
+        if not plan:
+            return False
+        if not spec.verify_gate([self._spec_key(s) for s in live
+                                 if s.idx in plan]):
+            return False
+        # page growth AFTER the gate: a speculating slot writes 1 + k
+        # cache positions this step (peers may be preempted to fit)
+        survivors = []
+        for s in live:
+            if s.state != "decode":
+                plan.pop(s.idx, None)
+                continue
+            if self._ensure_pages(s, 1 + len(plan.get(s.idx, ()))):
+                if s.state == "decode":
+                    survivors.append(s)
+                    continue
+            plan.pop(s.idx, None)
+        live = [s for s in survivors if s.state == "decode"]
+        if not live:
+            return True   # the page scramble consumed the whole batch
+        if not plan:
+            return False  # every draft's slot died: plain decode is fine
+        width = 1 + max(len(d) for d in plan.values())
+        verify_fn = _decoder.make_verify_step(self.cfg, self.page_size,
+                                              width)
+        tokens = onp.zeros((self.slots, width), onp.int32)
+        positions = onp.zeros(self.slots, onp.int32)
+        n_valid = onp.zeros(self.slots, onp.int32)
+        active = onp.zeros(self.slots, bool)
+        fed = {}
+        for s in live:
+            row = [s.pending] + plan.get(s.idx, [])
+            fed[s.idx] = row
+            tokens[s.idx, :len(row)] = row
+            positions[s.idx] = s.pos
+            n_valid[s.idx] = len(row)
+            active[s.idx] = True
+        t0 = time.perf_counter()
+        self._kp, self._vp, out = verify_fn(
+            self.params, self._kp, self._vp, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(n_valid),
+            self._tables_device(), jnp.asarray(active))
+        out = onp.asarray(out)
+        now = time.perf_counter()
+        self.metrics.observe_verify(self.name, now - t0)
+        self.metrics.count(self.name, "spec_verify_steps_total")
+        emitted_total = 0
+        for s in live:
+            row = fed[s.idx]
+            nv = len(row)
+            pos0 = s.pos
+            preds = [int(t) for t in out[s.idx, :nv]]
+            # longest-prefix greedy acceptance: draft token i survives
+            # iff it equals the target's own argmax after consuming
+            # everything before it — the emitted stream is exactly what
+            # plain decode would have produced, token for token
+            accepted = 0
+            while accepted < nv - 1 and row[accepted + 1] == preds[accepted]:
+                accepted += 1
+            emitted = preds[:accepted + 1]
+            budget = (s.req.max_new - len(s.req.prefix)
+                      - len(s.generated))
+            emitted = emitted[:max(1, budget)]
+            if self.eos_id is not None and self.eos_id in emitted:
+                emitted = emitted[:emitted.index(self.eos_id) + 1]
+            gap = (now - s.t_last) / len(emitted)
+            for tok in emitted:
+                s.history.append(s.pending)
+                s.pos += 1
+                s.generated.append(tok)
+                s.pending = tok
+                self.metrics.observe_inter_token(self.name, gap)
+            s.t_last = now
+            emitted_total += len(emitted)
+            drafted = nv - 1
+            if drafted:
+                key = self._spec_key(s)
+                spec.observe(key, drafted, accepted)
+                self.metrics.count(self.name, "spec_draft_tokens_total",
+                                   drafted)
+                self.metrics.count(self.name,
+                                   "spec_accepted_tokens_total", accepted)
+            self._rollback_kv(s, pos0 + nv)
+            self._maybe_finish(s, now)
+        self.metrics.observe_decode_step(
+            self.name, now - t0, now - t0, len(live), self.slots,
+            emitted_total)
+        return True
+
+    def _rollback_kv(self, slot, written_end):
+        """Return the slot's page list to exactly what its confirmed
+        length needs after a verify wrote ``written_end`` positions.
+
+        Rejected positions leave garbage KV at offsets the causal mask
+        never reads (attention only sees key positions ``<= query``),
+        so rollback is pure accounting: whole pages past the confirmed
+        length are freed through :meth:`PageAllocator.trim`.  If the
+        kept boundary page is SHARED (a published prefix page, refcount
+        > 1) and this verify dirtied positions past the confirmed
+        length, it is forked copy-on-write first so the truncation
+        never mutates a page another sequence (or the prefix cache)
+        still references."""
+        keep = pages_for(slot.pos, self.page_size)
+        pages = self.alloc.pages(slot.owner)
+        if written_end > slot.pos and keep > 0 and keep <= len(pages) \
+                and slot.pos % self.page_size != 0 \
+                and self.alloc.refcount(pages[keep - 1]) > 1:
+            old = pages[keep - 1]
+            try:
+                new = self.alloc.fork(slot.owner, old)
+            except CacheOOM:
+                if self._reclaim(keep=slot.req.session
+                                 if slot.req else None):
+                    try:
+                        new = self.alloc.fork(slot.owner, old)
+                    except CacheOOM:
+                        new = None
+                else:
+                    new = None
+            if new is not None:
+                self._kp = _copy_page(self._kp, old, new)
+                self._vp = _copy_page(self._vp, old, new)
+                self.metrics.count(self.name, "cow_forks_total")
+            # (an unforkable pool is safe anyway: the dirty offsets sit
+            # past every sharer's published token count, which readers
+            # never touch — forking just keeps the invariant airtight)
+        if self.alloc.trim(slot.owner, keep):
+            self.metrics.count(self.name, "spec_rollbacks_total")
+        self._sync_table(slot)
 
     # -- completion -------------------------------------------------------
     def _maybe_finish(self, slot, now):
@@ -1156,6 +1384,7 @@ class DecodeEngine:
                 self._push_transcript(sess)
         else:
             self.alloc.free(slot.owner)
+            self._spec_release(slot.owner, slot.owner)
         self.metrics.count(self.name, "sequences_completed_total")
         self.metrics.observe_generate_done(self.name, now - req.t_enqueue)
         self._clear(slot)
@@ -1172,6 +1401,7 @@ class DecodeEngine:
     def _fail_slot(self, slot, exc):
         req = slot.req
         self.alloc.free(slot.owner)
+        self._spec_release(slot.owner, self._spec_key(slot))
         if req.session is not None:
             self._sessions.pop(req.session, None)
         self.metrics.count(self.name, "errors_total")
@@ -1208,7 +1438,25 @@ class DecodeEngine:
             jnp.zeros((self.slots, self.pages_per_seq), jnp.int32),
             jnp.zeros(self.slots, bool))
         jax.block_until_ready(toks)
-        return 2
+        compiled = 2
+        if self._spec is not None:
+            # pre-compile every verify width the adaptive-k controller
+            # can reach (2 .. k_cap + 1) so acceptance swings never pay
+            # a mid-stream XLA compile
+            for w in range(2, self._spec.k_cap + 2):
+                vf = _decoder.make_verify_step(self.cfg, self.page_size,
+                                               w)
+                self._kp, self._vp, out = vf(
+                    self.params, self._kp, self._vp,
+                    jnp.zeros((self.slots, w), jnp.int32),
+                    jnp.zeros(self.slots, jnp.int32),
+                    jnp.zeros(self.slots, jnp.int32),
+                    jnp.zeros((self.slots, self.pages_per_seq),
+                              jnp.int32),
+                    jnp.zeros(self.slots, bool))
+                jax.block_until_ready(out)
+                compiled += 1
+        return compiled
 
     def drain(self, timeout=30.0):
         return self.stop(drain=True, timeout=timeout)
@@ -1230,6 +1478,7 @@ class DecodeEngine:
                         s.req.future.set_exception(ServerClosedError(
                             "decode engine stopped mid-generation"))
                         self.alloc.free(s.owner)
+                        self._spec_release(s.owner, self._spec_key(s))
                         self._clear(s)
             self._cond.notify_all()
             worker = self._worker
@@ -1248,6 +1497,7 @@ class DecodeEngine:
         with self._cond:
             for sess in self._sessions.values():
                 self.alloc.free(sess.owner)
+                self._spec_release(sess.owner, sess.sid)
             self._sessions.clear()
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
@@ -1276,4 +1526,6 @@ class DecodeEngine:
                "fn_cache": _decoder.fn_cache_stats()}
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
+        if self._spec is not None:
+            out["speculative"] = self._spec.stats()
         return out

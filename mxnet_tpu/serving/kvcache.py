@@ -99,7 +99,8 @@ class PageAllocator:
         self._refs = {}    # page -> live reference count
         self.peak_used = 0
         self.counters = {"allocs": 0, "frees": 0, "failed_allocs": 0,
-                         "shares": 0, "forks": 0, "leak_checks": 0}
+                         "shares": 0, "forks": 0, "trims": 0,
+                         "leak_checks": 0}
         self.last_leak = []
 
     # -- allocation -------------------------------------------------------
@@ -189,6 +190,36 @@ class PageAllocator:
             for p in reversed(pages):
                 self._deref_locked(p)
             return self.counters["frees"] - freed0
+
+    def trim(self, owner, keep):
+        """Truncate ``owner``'s page list to its first ``keep`` pages,
+        dereferencing the tail in reverse allocation order — the
+        speculative-decode rollback primitive (rejected draft tokens
+        hand their pages straight back).  Copy-on-write aware the same
+        way :meth:`free` is: a trimmed page that other owners (a prefix
+        cache entry, a peer sequence) still reference only drops this
+        owner's refcount and stays resident; it rejoins the free list at
+        refcount zero.  The page CONTAINING the new write boundary is
+        kept — when it is shared, the caller must :meth:`fork` it before
+        re-writing rolled-back offsets (the engine's ``_rollback_kv``
+        does exactly that).  Returns the number of references dropped;
+        unknown owners and ``keep >= len(pages)`` trim 0 (idempotent).
+        """
+        keep = max(0, int(keep))
+        with self._lock:
+            pages = self._owned.get(owner)
+            if pages is None or len(pages) <= keep:
+                return 0
+            tail = pages[keep:]
+            del pages[keep:]
+            if not pages:
+                del self._owned[owner]
+            # reversed: LIFO free list re-issues the rolled-back pages
+            # first, same warm-reuse policy as free()
+            for p in reversed(tail):
+                self._deref_locked(p)
+            self.counters["trims"] += 1
+            return len(tail)
 
     def pages(self, owner):
         """The owner's page list (copy), allocation order == token order."""

@@ -51,19 +51,22 @@ class LatencyHistogram:
             self._ring[self._idx] = value_s
             self._idx = (self._idx + 1) % _RESERVOIR
 
-    def snapshot(self):
-        """{count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms} (ms floats)."""
+    def snapshot(self, scale=1e3, suffix="_ms"):
+        """{count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms} (ms floats).
+        Dimensionless reservoirs (e.g. tokens-per-step) pass
+        ``scale=1, suffix=""`` to report raw values."""
         if not self._ring:
             return {"count": 0}
         srt = sorted(self._ring)
         out = {"count": self.count,
-               "mean_ms": round(self.total / self.count * 1e3, 3),
-               "max_ms": round(srt[-1] * 1e3, 3)}
+               "mean%s" % suffix: round(self.total / self.count * scale,
+                                        3),
+               "max%s" % suffix: round(srt[-1] * scale, 3)}
         n = len(srt)
         for p in PERCENTILES:
             # nearest-rank percentile over the recent window
             k = min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))
-            out["p%d_ms" % p] = round(srt[k] * 1e3, 3)
+            out["p%d%s" % (p, suffix)] = round(srt[k] * scale, 3)
         return out
 
 
@@ -81,7 +84,10 @@ class ModelMetrics:
                 # prefix caching + session migration (PR 11)
                 "prefix_hits_total", "prefix_tokens_saved_total",
                 "cow_forks_total", "migrations_out_total",
-                "migrations_in_total", "migrations_replayed_total")
+                "migrations_in_total", "migrations_replayed_total",
+                # speculative decoding (PR 12)
+                "spec_draft_tokens_total", "spec_accepted_tokens_total",
+                "spec_verify_steps_total", "spec_rollbacks_total")
 
     def __init__(self):
         self.counters = dict.fromkeys(self.COUNTERS, 0)
@@ -96,6 +102,12 @@ class ModelMetrics:
         self.ttft = LatencyHistogram()
         self.inter_token = LatencyHistogram()
         self.decode_step = LatencyHistogram()
+        # speculative decoding: tokens EMITTED per decode step (a wide
+        # verify can land several — this is where >1 token/step shows),
+        # plus the draft/verify latency split
+        self.tokens_per_step = LatencyHistogram()
+        self.draft_step = LatencyHistogram()
+        self.verify_step = LatencyHistogram()
         self.kv_cache = {"used_pages": 0, "total_pages": 0,
                          "peak_used_pages": 0, "shared_pages": 0,
                          "leaked_pages": 0}
@@ -136,6 +148,19 @@ class ModelMetrics:
                     if total else None),
                 "kv_cache": dict(self.kv_cache),
             }
+            out["generate"]["tokens_per_step"] = (
+                self.tokens_per_step.snapshot(scale=1, suffix=""))
+            drafted = self.counters["spec_draft_tokens_total"]
+            if drafted or self.counters["spec_verify_steps_total"]:
+                out["generate"]["speculative"] = {
+                    "draft_step": self.draft_step.snapshot(),
+                    "verify_step": self.verify_step.snapshot(),
+                    # the one-number health read: of every drafted
+                    # token, how many did the target keep
+                    "accepted_token_rate": (round(
+                        self.counters["spec_accepted_tokens_total"]
+                        / drafted, 4) if drafted else None),
+                }
             if self.decode_launches is not None:
                 out["generate"]["decode_launches"] = dict(
                     self.decode_launches)
@@ -228,6 +253,7 @@ class ServingMetrics:
             m.counters["decode_slot_steps_total"] += slots
             m.counters["tokens_generated_total"] += new_tokens
             m.decode_step.observe(device_s)
+            m.tokens_per_step.observe(float(new_tokens))
             rate = new_tokens / max(wall_s, 1e-9)
             m.tokens_per_s = (rate if m.tokens_per_s == 0.0
                               else 0.9 * m.tokens_per_s + 0.1 * rate)
@@ -236,6 +262,19 @@ class ServingMetrics:
                                     device_s)
         profiler.record_counter("serving::%s::decode" % name,
                                 active=active, tokens=new_tokens)
+
+    def observe_draft(self, name, draft_s):
+        """Wall time of one slot's draft proposal (speculative path)."""
+        with self._lock:
+            self._model(name).draft_step.observe(draft_s)
+
+    def observe_verify(self, name, verify_s):
+        """Wall time of one whole-batch wide verify launch."""
+        with self._lock:
+            self._model(name).verify_step.observe(verify_s)
+        if profiler._AGG["enabled"]:
+            profiler.record_op_stat("serving::%s::verify_step" % name,
+                                    verify_s)
 
     def observe_decode_launches(self, name, stats):
         """Static launch census of the engine's decode step (see

@@ -429,9 +429,14 @@ def scenario_llm(args):
                         # pool sized so parked sessions never hit the
                         # LRU reclaim during the run: the drill tests
                         # failover resets, not cache-pressure resets
+                        # speculation on (n-gram drafter, k=2): the
+                        # zero-reset bar must hold with draft/verify/
+                        # rollback in the loop — spec output is
+                        # bit-identical, so the oracle checks unchanged
                         "generate": {"slots": 4, "page_size": 8,
                                      "prefill_chunk": 8, "max_ctx": 64,
-                                     "total_pages": 513}}],
+                                     "total_pages": 513,
+                                     "speculate": True, "spec_k": 2}}],
             "max_queue_depth": 512}
     fleet = serving.ServingFleet(
         spec, replicas=n, policy="hash",
