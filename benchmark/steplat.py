@@ -253,6 +253,52 @@ def sharded_steplat(mesh_shape=(4, 2), axis_names=("dp", "tp"), B=8, L=32,
     return row
 
 
+def decode_tp_steplat(mesh_shape=(4, 2), axis_names=("dp", "tp"),
+                      slots=8, page_size=8, batch_probe=None,
+                      fused_mode="interpret", model_kw=None):
+    """Collective census of the TENSOR-PARALLEL decode step (ISSUE 13).
+
+    Lowers the dp×tp decode step (tower and fused layer-group variants)
+    and counts the GSPMD collectives per class — the static property the
+    tier-1 gate asserts: all-reduce ONLY (two per layer, the Megatron
+    row-parallel reductions after attention proj and ffn2), every other
+    collective class zero, and counts INVARIANT to batch size (KV paging
+    and slot scheduling must add no cross-chip traffic as the batch
+    grows).  ``batch_probe`` lists the extra slot counts checked for
+    invariance (default: 2× the base).  Returns {mesh, tp, tower:
+    {collectives}, fused: {collectives}, batch_invariant: bool}.
+    """
+    from mxnet_tpu.models import decoder as dec
+    from mxnet_tpu.parallel.shardcfg import ShardingConfig
+
+    kw = dict(vocab_size=128, num_layers=2, units=64, hidden_size=128,
+              num_heads=4, num_kv_heads=2, max_length=128)
+    kw.update(model_kw or {})
+    lm = dec.decoder_tiny_lm(seed=0, **kw)
+    cfg = lm.config
+    params = lm.jax_params()
+    pps = (kw["max_length"] + page_size - 1) // page_size
+    scfg = ShardingConfig.for_transformer(mesh_shape=mesh_shape,
+                                          axis_names=axis_names)
+    out = {"mesh": scfg.describe(), "tp": scfg.axis_size("tp"),
+           "num_layers": kw["num_layers"], "slots": slots}
+    probes = list(batch_probe or (slots * 2,))
+    invariant = True
+    for name, fused in (("tower", False), ("fused", True)):
+        stats = dec.decode_collective_stats(
+            params, cfg, page_size, slots, pps, slots * pps + 1, scfg,
+            fused=fused, mode=fused_mode)
+        out[name] = {"collectives": stats["collectives"]}
+        for b in probes:
+            alt = dec.decode_collective_stats(
+                params, cfg, page_size, b, pps, b * pps + 1, scfg,
+                fused=fused, mode=fused_mode)
+            if alt["collectives"] != stats["collectives"]:
+                invariant = False
+    out["batch_invariant"] = invariant
+    return out
+
+
 def main():
     result = {
         "backend": jax.default_backend(),
@@ -268,6 +314,10 @@ def main():
         except ValueError as e:  # mesh doesn't fit this host
             sharded[name] = {"skipped": str(e)[:120]}
     result["sharded"] = sharded
+    try:
+        result["decode_tp"] = decode_tp_steplat()
+    except ValueError as e:  # mesh doesn't fit this host
+        result["decode_tp"] = {"skipped": str(e)[:120]}
     print(json.dumps(result))
 
 

@@ -55,8 +55,8 @@ warnings.filterwarnings(
 __all__ = ["DecoderConfig", "CausalLM", "full_forward", "make_decode_step",
            "make_decode_step_fused", "make_prefill_chunk",
            "make_verify_step", "fn_cache_stats", "decode_launch_stats",
-           "verify_launch_stats", "decoder_tiny", "decoder_tiny_lm",
-           "decoder_draft"]
+           "verify_launch_stats", "decode_collective_stats", "tp_plan",
+           "TPPlan", "decoder_tiny", "decoder_tiny_lm", "decoder_draft"]
 
 
 # ---------------------------------------------------------------------------
@@ -172,13 +172,199 @@ def _qkv(x, lp, cfg):
     return q, k, v
 
 
-def _layer_tail(x, att_merged, lp):
+def _layer_tail(x, att_merged, lp, axis=None):
     """Shared post-attention epilogue: proj + residual LN + FFN + LN
-    (post-LN, the TransformerLayer convention)."""
-    o = _proj(att_merged, lp["wo"], lp["bo"])
+    (post-LN, the TransformerLayer convention).
+
+    With ``axis`` set this is the row-parallel tail of a Megatron layer:
+    ``wo``/``w2`` are in-feature shards, so their dots produce PARTIAL
+    sums that all-reduce over the named mesh axis; the replicated biases
+    are added after the reduce.  These two psums are the ONLY cross-chip
+    traffic of a tensor-parallel decode layer."""
+    if axis is None:
+        o = _proj(att_merged, lp["wo"], lp["bo"])
+    else:
+        o = jax.lax.psum(jnp.dot(att_merged, lp["wo"].T), axis) + lp["bo"]
     x = _ln(x + o, lp["ln1g"], lp["ln1b"])
-    f = _ffn(x, lp)
+    if axis is None:
+        f = _ffn(x, lp)
+    else:
+        h = _epilogue.bias_gelu(_proj(x, lp["w1"]), lp["b1"])
+        f = jax.lax.psum(jnp.dot(h, lp["w2"].T), axis) + lp["b2"]
     return _ln(x + f, lp["ln2g"], lp["ln2b"])
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel plan (ShardingConfig -> per-shard decode geometry)
+# ---------------------------------------------------------------------------
+# The raw jax_params pytree has no gluon path names, but the layout rules
+# (ShardingConfig.for_transformer) are written against them — synthesize
+# the paths the gluon blocks would carry so ONE rule set covers training
+# and serving.  LN/embeddings have no entry: they resolve replicated.
+_TP_PARAM_PATHS = {
+    "wq": "attention.qkv.weight", "bq": "attention.qkv.bias",
+    "wk": "attention.qkv.weight", "bk": "attention.qkv.bias",
+    "wv": "attention.qkv.weight", "bv": "attention.qkv.bias",
+    "wo": "attention.proj.weight", "bo": "attention.proj.bias",
+    "w1": "ffn.ffn1.weight", "b1": "ffn.ffn1.bias",
+    "w2": "ffn.ffn2.weight", "b2": "ffn.ffn2.bias",
+}
+
+
+def _shard_token(sharding):
+    """Hashable cache-key component for the active sharding: config
+    signature + mesh device identity (same signature on a different
+    device set must NOT share a compiled program).  With no explicit
+    config the ambient scope's token keys the entry, so flipping the
+    active config cannot serve a stale program."""
+    if sharding is None:
+        from ..parallel import shardcfg as _shardcfg
+        return _shardcfg.active_token()
+    return (sharding.signature(),
+            tuple(int(d.id) for d in sharding.mesh.devices.flat))
+
+
+class TPPlan:
+    """Resolved tensor-parallel serving layout for one (cfg, sharding).
+
+    Holds the local (per-shard) decode geometry — heads, KV heads and
+    FFN width divided by tp; ``units``/``head_dim`` stay FULL because
+    activations are replicated — plus the PartitionSpecs for the param
+    pytree and the paged KV slabs (KV-head axis over tp, the Pope et al.
+    layout SNIPPETS.md [3] uses).  Built via :func:`tp_plan`.
+    """
+
+    def __init__(self, sharding, cfg):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self.sharding = sharding
+        self.cfg = cfg
+        self.axis = "tp"
+        self.tp = int(sharding.axis_size("tp"))
+        self.mesh = sharding.mesh
+        self.local_cfg = cfg._replace(
+            num_heads=cfg.num_heads // self.tp,
+            num_kv_heads=cfg.num_kv_heads // self.tp,
+            hidden_size=cfg.hidden_size // self.tp)
+        # engine page layout (L, KVH, total_pages, S, D): KV heads over
+        # tp; the per-layer kernel view drops L -> P("tp", None, None,
+        # None) exactly as the ISSUE/SNIPPETS layout reads
+        self.kv_spec = P(None, "tp", None, None, None)
+        self.kv_sharding = NamedSharding(self.mesh, self.kv_spec)
+
+    def leaf_spec(self, kind, shape):
+        """PartitionSpec for one layer-param leaf (``wq``/``b2``/…),
+        resolved through the config's rules against the synthesized
+        gluon path — unmatched leaves (LN, embeddings) replicate."""
+        from jax.sharding import PartitionSpec as P
+        path = _TP_PARAM_PATHS.get(kind)
+        if path is None:
+            return P()
+        return self.sharding.param_spec("layers.0." + path, shape)
+
+    def _layer_shapes(self):
+        c = self.cfg
+        kvu = c.num_kv_heads * c.head_dim
+        return {"wq": (c.units, c.units), "bq": (c.units,),
+                "wk": (kvu, c.units), "bk": (kvu,),
+                "wv": (kvu, c.units), "bv": (kvu,),
+                "wo": (c.units, c.units), "bo": (c.units,),
+                "w1": (c.hidden_size, c.units), "b1": (c.hidden_size,),
+                "w2": (c.units, c.hidden_size), "b2": (c.units,),
+                "ln1g": (c.units,), "ln1b": (c.units,),
+                "ln2g": (c.units,), "ln2b": (c.units,)}
+
+    def param_specs(self):
+        """Spec pytree matching the jax_params structure (shapes are a
+        function of cfg alone, so builders need no live params)."""
+        from jax.sharding import PartitionSpec as P
+        lp = {k: self.leaf_spec(k, s)
+              for k, s in self._layer_shapes().items()}
+        return {"embed": P(), "pos": P(),
+                "layers": [dict(lp) for _ in range(self.cfg.num_layers)]}
+
+    def place_params(self, params):
+        """device_put the param pytree onto the mesh per the plan (the
+        one-time layout move at engine init)."""
+        from jax.sharding import NamedSharding
+
+        def put(a, spec):
+            return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+        specs = self.param_specs()
+        return {"embed": put(params["embed"], specs["embed"]),
+                "pos": put(params["pos"], specs["pos"]),
+                "layers": [
+                    {k: put(v, specs["layers"][li][k])
+                     for k, v in lp.items()}
+                    for li, lp in enumerate(params["layers"])]}
+
+    def place_kv(self, pages):
+        """(Re)pin a page array to the KV-head sharding — used at init
+        and after host-side page mutations (install/import) that may
+        have produced a differently-placed result."""
+        return jax.device_put(pages, self.kv_sharding)
+
+    def wrap(self, fn, n_rest, n_out_rest):
+        """jit(shard_map(fn)) with the plan's layout: params + KV pages
+        sharded, every other operand/result replicated; pages donated so
+        the cache stays in place across steps."""
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.pipeline import (shard_map,
+                                         _shard_map_compat_kwargs)
+        rep = P()
+        in_specs = ((self.param_specs(), self.kv_spec, self.kv_spec)
+                    + (rep,) * n_rest)
+        out_specs = (self.kv_spec, self.kv_spec) + (rep,) * n_out_rest
+        smapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                            out_specs=out_specs,
+                            **_shard_map_compat_kwargs())
+        return jax.jit(smapped, donate_argnums=(1, 2))
+
+
+def tp_plan(cfg, sharding):
+    """Resolve (cfg, ShardingConfig) to a :class:`TPPlan`, or None when
+    the engine should serve replicated: no config, tp absent/1, a mesh
+    that does not fit this host, geometry tp does not divide (the GQA
+    ``kv_heads % tp`` constraint and friends), or rules that do not
+    resolve to the Megatron column/row layout.  Every fallback except
+    "no tp requested" warns loudly — silently serving replicated when
+    the operator asked for TP would look like a perf bug."""
+    if sharding is None:
+        return None
+    try:
+        tp = int(sharding.axis_size("tp"))
+    except ValueError as e:  # mesh does not fit this host
+        warnings.warn("decoder: sharding mesh unavailable (%s); serving "
+                      "REPLICATED" % e, stacklevel=2)
+        return None
+    if tp <= 1:
+        return None
+    bad = [s for s, n in (("num_heads=%d" % cfg.num_heads, cfg.num_heads),
+                          ("num_kv_heads=%d" % cfg.num_kv_heads,
+                           cfg.num_kv_heads),
+                          ("hidden_size=%d" % cfg.hidden_size,
+                           cfg.hidden_size)) if n % tp != 0]
+    if bad:
+        warnings.warn(
+            "decoder: tp=%d does not divide %s; serving REPLICATED "
+            "(pick tp dividing the head/FFN geometry)" % (tp, ", ".join(bad)),
+            stacklevel=2)
+        return None
+    plan = TPPlan(sharding, cfg)
+    shapes = plan._layer_shapes()
+    want = {"wq": ("tp",), "wk": ("tp",), "wv": ("tp",), "bq": ("tp",),
+            "w1": ("tp",), "b1": ("tp",),
+            "wo": (None, "tp"), "w2": (None, "tp")}
+    off = [k for k, w in want.items()
+           if tuple(plan.leaf_spec(k, shapes[k])) != w]
+    if off:
+        warnings.warn(
+            "decoder: sharding rules do not resolve the Megatron "
+            "column/row layout for %s (use ShardingConfig."
+            "for_transformer); serving REPLICATED" % ", ".join(sorted(off)),
+            stacklevel=2)
+        return None
+    return plan
 
 
 # ---------------------------------------------------------------------------
@@ -207,9 +393,15 @@ def full_forward(params, cfg, tokens):
 # ---------------------------------------------------------------------------
 # incremental decode over the paged KV cache
 # ---------------------------------------------------------------------------
-def make_decode_step(cfg, page_size):
+def make_decode_step(cfg, page_size, sharding=None):
     """Build (or fetch) the jitted batched decode step for
     (cfg, page_size) — cached in the bounded per-geometry LRU.
+
+    With ``sharding`` carrying an active tp axis the step runs per-shard
+    under ``shard_map`` (params column/row-split, KV pages split along
+    KV heads); otherwise the 1-chip program.  The sharding token is part
+    of the cache key, so toggling the config never serves a stale
+    program.
 
     fn(params, k_pages, v_pages, tokens, positions, page_tables, active)
       k_pages/v_pages: (layers, KVH, total_pages, page_size, head_dim)
@@ -221,12 +413,18 @@ def make_decode_step(cfg, page_size):
                   read garbage; the engine discards their outputs
     -> (k_pages, v_pages, next_tokens (B,) int32, logits (B, vocab) f32)
     """
-    return _fn_cache.get(("decode", cfg, int(page_size)),
-                         lambda: _build_decode_step(cfg, int(page_size)))
+    key = ("decode", cfg, int(page_size), _shard_token(sharding))
+    return _fn_cache.get(key, lambda: _build_decode_step(
+        cfg, int(page_size), tp_plan(cfg, sharding)))
 
 
-def _build_decode_step(cfg, page_size):
+def _build_decode_step(cfg, page_size, plan=None):
     S = int(page_size)
+    # per-shard geometry: local head counts, FULL activation width (the
+    # all-reduce at the layer tail re-replicates x before the next qkv)
+    qcfg = plan.local_cfg if plan is not None else cfg
+    Cl = qcfg.num_heads * cfg.head_dim
+    axis = plan.axis if plan is not None else None
 
     def step(params, k_pages, v_pages, tokens, positions, page_tables,
              active):
@@ -241,20 +439,22 @@ def _build_decode_step(cfg, page_size):
         ws = jnp.where(active, positions % S, 0)
         lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
         for li, lp in enumerate(params["layers"]):
-            q, k, v = _qkv(x, lp, cfg)                  # (B, H/KVH, D)
+            q, k, v = _qkv(x, lp, qcfg)                 # (B, H/KVH, D)
             # advanced indices split by ':' put the batch dim first:
             # the target block is (B, KVH, D) — k/v's native layout
             k_pages = k_pages.at[li, :, wp, ws, :].set(k)
             v_pages = v_pages.at[li, :, wp, ws, :].set(v)
             att = _paged.paged_attention(
                 q, k_pages[li], v_pages[li], lengths, page_tables)
-            x = _layer_tail(x, att.reshape(B, cfg.units), lp)
+            x = _layer_tail(x, att.reshape(B, Cl), lp, axis=axis)
         logits = jnp.dot(x.astype(jnp.float32),
                          params["embed"].astype(jnp.float32).T)
         return (k_pages, v_pages,
                 jnp.argmax(logits, axis=-1).astype(jnp.int32), logits)
 
-    return jax.jit(step, donate_argnums=(1, 2))
+    if plan is None:
+        return jax.jit(step, donate_argnums=(1, 2))
+    return plan.wrap(step, n_rest=4, n_out_rest=2)
 
 
 def _group_bounds(num_layers, layer_group):
@@ -272,22 +472,31 @@ def _stack_layer_params(params, lo, hi):
                           for li in range(lo, hi)]) for k in keys}
 
 
-def make_decode_step_fused(cfg, page_size, layer_group=0, mode="interpret"):
+def make_decode_step_fused(cfg, page_size, layer_group=0, mode="interpret",
+                           sharding=None):
     """Build (or fetch) the PERSISTENT-KERNEL decode step: one
     ``fused_cell.decode_layer_group`` Pallas launch per layer group
     (default: all layers in one group) instead of the per-op XLA tower.
     Same signature and donation contract as :func:`make_decode_step`;
     greedy next-token parity is asserted by tests/test_fused_cell.py.
+
+    Under an active tp sharding the fusion splits at the two collective
+    boundaries of each layer (a Pallas body cannot carry a psum): one
+    attention-phase launch (qkv + KV append + paged read + local
+    out-proj partial), the row-parallel all-reduce, then one FFN-phase
+    launch, the second all-reduce — still the only cross-chip traffic.
     """
     key = ("decode_fused", cfg, int(page_size), int(layer_group),
-           str(mode))
+           str(mode), _shard_token(sharding))
     return _fn_cache.get(key, lambda: _build_decode_step_fused(
-        cfg, int(page_size), int(layer_group), mode))
+        cfg, int(page_size), int(layer_group), mode,
+        tp_plan(cfg, sharding)))
 
 
-def _build_decode_step_fused(cfg, page_size, layer_group, mode):
+def _build_decode_step_fused(cfg, page_size, layer_group, mode, plan=None):
     S = int(page_size)
     groups = _group_bounds(cfg.num_layers, layer_group)
+    qcfg = plan.local_cfg if plan is not None else cfg
 
     def step(params, k_pages, v_pages, tokens, positions, page_tables,
              active):
@@ -300,58 +509,90 @@ def _build_decode_step_fused(cfg, page_size, layer_group, mode):
         lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
         meta = jnp.stack([wp, ws])
         pt = page_tables.astype(jnp.int32)
-        for (lo, hi) in groups:
-            stacked = _stack_layer_params(params, lo, hi)
-            if len(groups) == 1:
-                kp_g, vp_g = k_pages, v_pages
-            else:
-                kp_g, vp_g = k_pages[lo:hi], v_pages[lo:hi]
-            kp_g, vp_g, x = _fused.decode_layer_group(
-                x, kp_g, vp_g, stacked, meta, pt, lengths[:, None],
-                cfg, mode)
-            if len(groups) == 1:
-                k_pages, v_pages = kp_g, vp_g
-            else:
+        if plan is not None:
+            # per-layer phase kernels with the collective in between
+            for li, lp in enumerate(params["layers"]):
+                kp_l, vp_l, o_part = _fused.decode_attn_phase(
+                    x, k_pages[li], v_pages[li], lp, meta, pt,
+                    lengths[:, None], qcfg, mode)
                 k_pages = jax.lax.dynamic_update_slice_in_dim(
-                    k_pages, kp_g, lo, axis=0)
+                    k_pages, kp_l[None], li, axis=0)
                 v_pages = jax.lax.dynamic_update_slice_in_dim(
-                    v_pages, vp_g, lo, axis=0)
+                    v_pages, vp_l[None], li, axis=0)
+                o = jax.lax.psum(o_part, plan.axis) + lp["bo"]
+                x = _ln(x + o, lp["ln1g"], lp["ln1b"])
+                f_part = _fused.decode_ffn_phase(
+                    x, lp["w1"], lp["b1"], lp["w2"], mode)
+                f = jax.lax.psum(f_part, plan.axis) + lp["b2"]
+                x = _ln(x + f, lp["ln2g"], lp["ln2b"])
+        else:
+            for (lo, hi) in groups:
+                stacked = _stack_layer_params(params, lo, hi)
+                if len(groups) == 1:
+                    kp_g, vp_g = k_pages, v_pages
+                else:
+                    kp_g, vp_g = k_pages[lo:hi], v_pages[lo:hi]
+                kp_g, vp_g, x = _fused.decode_layer_group(
+                    x, kp_g, vp_g, stacked, meta, pt, lengths[:, None],
+                    cfg, mode)
+                if len(groups) == 1:
+                    k_pages, v_pages = kp_g, vp_g
+                else:
+                    k_pages = jax.lax.dynamic_update_slice_in_dim(
+                        k_pages, kp_g, lo, axis=0)
+                    v_pages = jax.lax.dynamic_update_slice_in_dim(
+                        v_pages, vp_g, lo, axis=0)
         logits = jnp.dot(x.astype(jnp.float32),
                          params["embed"].astype(jnp.float32).T)
         return (k_pages, v_pages,
                 jnp.argmax(logits, axis=-1).astype(jnp.int32), logits)
 
-    return jax.jit(step, donate_argnums=(1, 2))
+    if plan is None:
+        return jax.jit(step, donate_argnums=(1, 2))
+    return plan.wrap(step, n_rest=4, n_out_rest=2)
 
 
-def decode_launch_stats(params, cfg, page_size, slots, pages_per_seq,
-                        total_pages, fused, layer_group=0,
-                        mode="interpret"):
-    """Static launch census of one decode step (the dispatch-count
-    audit): traces the chosen step program and counts launch-class
-    primitives with ``fused_cell.count_launches`` — deterministic and
-    load-independent, safe to gate CI and bench rows on.
-
-    Returns {fused, layer_groups, launches_per_step, pallas_per_step,
-    pallas_per_group}.
-    """
-    S = int(page_size)
-    if fused:
-        fn = make_decode_step_fused(cfg, S, layer_group, mode)
-        n_groups = len(_group_bounds(cfg.num_layers, layer_group))
-    else:
-        fn = make_decode_step(cfg, S)
-        n_groups = cfg.num_layers
-    shape = (cfg.num_layers, cfg.num_kv_heads, int(total_pages), S,
-             cfg.head_dim)
+def _decode_step_structs(params, cfg, page_size, slots, pages_per_seq,
+                         total_pages):
+    """ShapeDtypeStruct argument tuple of one decode step (census
+    tracing/lowering without touching real buffers)."""
+    shape = (cfg.num_layers, cfg.num_kv_heads, int(total_pages),
+             int(page_size), cfg.head_dim)
     kp = jax.ShapeDtypeStruct(shape, jnp.float32)
-    args = (jax.tree.map(
+    return (jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
             kp, kp,
             jax.ShapeDtypeStruct((slots,), jnp.int32),
             jax.ShapeDtypeStruct((slots,), jnp.int32),
             jax.ShapeDtypeStruct((slots, pages_per_seq), jnp.int32),
             jax.ShapeDtypeStruct((slots,), jnp.bool_))
+
+
+def decode_launch_stats(params, cfg, page_size, slots, pages_per_seq,
+                        total_pages, fused, layer_group=0,
+                        mode="interpret", sharding=None):
+    """Static launch census of one decode step (the dispatch-count
+    audit): traces the chosen step program and counts launch-class
+    primitives with ``fused_cell.count_launches`` — deterministic and
+    load-independent, safe to gate CI and bench rows on.  With
+    ``sharding`` the census covers the PER-SHARD program (collectives
+    are not launch-class; see :func:`decode_collective_stats`).
+
+    Returns {fused, layer_groups, launches_per_step, pallas_per_step,
+    pallas_per_group}.
+    """
+    S = int(page_size)
+    if fused:
+        fn = make_decode_step_fused(cfg, S, layer_group, mode,
+                                    sharding=sharding)
+        n_groups = len(_group_bounds(cfg.num_layers, layer_group))
+        if tp_plan(cfg, sharding) is not None:
+            n_groups = cfg.num_layers      # per-layer phase kernels
+    else:
+        fn = make_decode_step(cfg, S, sharding=sharding)
+        n_groups = cfg.num_layers
+    args = _decode_step_structs(params, cfg, S, slots, pages_per_seq,
+                                total_pages)
     jaxpr = jax.make_jaxpr(fn)(*args)
     launches = _fused.count_launches(jaxpr)
     pallas = _fused.count_pallas_calls(jaxpr)
@@ -361,7 +602,37 @@ def decode_launch_stats(params, cfg, page_size, slots, pages_per_seq,
             "pallas_per_group": (pallas / n_groups if n_groups else 0.0)}
 
 
-def make_prefill_chunk(cfg, page_size, chunk):
+def decode_collective_stats(params, cfg, page_size, slots, pages_per_seq,
+                            total_pages, sharding, fused=False,
+                            layer_group=0, mode="interpret"):
+    """Static COLLECTIVE census of one sharded decode step: lowers the
+    shard_map program through the partitioner and counts HLO collectives
+    per class (``parallel.shardcfg.collective_census``).  Like the
+    launch census this is a property of the program alone — the tier-1
+    gate asserts all-reduce-only (2 row-parallel reduces per layer) with
+    counts invariant to batch size.
+
+    Returns {mesh, tp, fused, collectives: {class: n, ..., total}}.
+    """
+    from ..parallel import shardcfg as _shardcfg
+    plan = tp_plan(cfg, sharding)
+    if plan is None:
+        raise ValueError("decode_collective_stats needs a sharding with "
+                         "an active tp axis that divides the geometry")
+    S = int(page_size)
+    if fused:
+        fn = make_decode_step_fused(cfg, S, layer_group, mode,
+                                    sharding=sharding)
+    else:
+        fn = make_decode_step(cfg, S, sharding=sharding)
+    args = _decode_step_structs(params, cfg, S, slots, pages_per_seq,
+                                total_pages)
+    census = _shardcfg.collective_census(fn.lower(*args))
+    return {"mesh": sharding.describe(), "tp": plan.tp,
+            "fused": bool(fused), "collectives": census}
+
+
+def make_prefill_chunk(cfg, page_size, chunk, sharding=None):
     """Build (or fetch) the jitted single-sequence chunk prefill for
     (cfg, page_size, chunk) — cached in the bounded per-geometry LRU.
 
@@ -377,16 +648,24 @@ def make_prefill_chunk(cfg, page_size, chunk):
     under a causal + validity mask — so arbitrarily long prompts cost a
     bounded slice of each engine step instead of stalling the decode
     batch (Sarathi-style chunked prefill).
+
+    ``sharding`` with an active tp axis runs the chunk per-shard under
+    ``shard_map`` (local heads, row-parallel all-reduce at the tail),
+    bit-compatible with the sharded decode step's pages.
     """
-    return _fn_cache.get(("prefill", cfg, int(page_size), int(chunk)),
-                         lambda: _build_prefill_chunk(cfg, int(page_size),
-                                                      int(chunk)))
+    key = ("prefill", cfg, int(page_size), int(chunk),
+           _shard_token(sharding))
+    return _fn_cache.get(key, lambda: _build_prefill_chunk(
+        cfg, int(page_size), int(chunk), tp_plan(cfg, sharding)))
 
 
-def _build_prefill_chunk(cfg, page_size, chunk):
+def _build_prefill_chunk(cfg, page_size, chunk, plan=None):
     S = int(page_size)
     P = int(chunk)
-    g = cfg.num_heads // cfg.num_kv_heads
+    qcfg = plan.local_cfg if plan is not None else cfg
+    Cl = qcfg.num_heads * cfg.head_dim
+    axis = plan.axis if plan is not None else None
+    g = qcfg.num_heads // qcfg.num_kv_heads
     scale = 1.0 / (cfg.head_dim ** 0.5)
 
     def prefill(params, k_pages, v_pages, tokens, pos0, n_valid, page_row):
@@ -397,7 +676,7 @@ def _build_prefill_chunk(cfg, page_size, chunk):
         wp = jnp.where(valid, page_row[idx // S], 0)
         ws = jnp.where(valid, idx % S, 0)
         for li, lp in enumerate(params["layers"]):
-            q, k, v = _qkv(x, lp, cfg)                  # (P, H/KVH, D)
+            q, k, v = _qkv(x, lp, qcfg)                 # (P, H/KVH, D)
             k_pages = k_pages.at[li, :, wp, ws, :].set(k)
             v_pages = v_pages.at[li, :, wp, ws, :].set(v)
             # gather THIS sequence's pages (prefix + the chunk just
@@ -415,18 +694,20 @@ def _build_prefill_chunk(cfg, page_size, chunk):
             p = jax.nn.softmax(logits, axis=-1)
             p = jnp.where(jnp.isnan(p), 0.0, p)
             att = jnp.einsum("hpc,hcd->hpd", p, vr.astype(jnp.float32))
-            merged = att.swapaxes(0, 1).reshape(P, cfg.units).astype(x.dtype)
-            x = _layer_tail(x, merged, lp)
+            merged = att.swapaxes(0, 1).reshape(P, Cl).astype(x.dtype)
+            x = _layer_tail(x, merged, lp, axis=axis)
         last = x[jnp.clip(n_valid - 1, 0, P - 1)]
         last_logits = jnp.dot(last.astype(jnp.float32),
                               params["embed"].astype(jnp.float32).T)
         return (k_pages, v_pages,
                 jnp.argmax(last_logits).astype(jnp.int32), last_logits)
 
-    return jax.jit(prefill, donate_argnums=(1, 2))
+    if plan is None:
+        return jax.jit(prefill, donate_argnums=(1, 2))
+    return plan.wrap(prefill, n_rest=4, n_out_rest=2)
 
 
-def make_verify_step(cfg, page_size, width):
+def make_verify_step(cfg, page_size, width, sharding=None):
     """Build (or fetch) the jitted wide VERIFY step for speculative
     decoding — cached per (cfg, page_size, width) in the same bounded
     per-geometry LRU as the decode/prefill programs.
@@ -452,16 +733,24 @@ def make_verify_step(cfg, page_size, width):
       page_tables:(B, pages_per_seq) int32
       active:     (B,) bool — inactive slots write the scratch page
     -> (k_pages, v_pages, out_tokens (B, width) int32)
+
+    ``sharding`` with an active tp axis runs verification per-shard
+    under ``shard_map`` — speculative decoding rides the TP engine
+    unmodified (the acceptance logic only sees replicated out_tokens).
     """
-    return _fn_cache.get(("verify", cfg, int(page_size), int(width)),
-                         lambda: _build_verify_step(cfg, int(page_size),
-                                                    int(width)))
+    key = ("verify", cfg, int(page_size), int(width),
+           _shard_token(sharding))
+    return _fn_cache.get(key, lambda: _build_verify_step(
+        cfg, int(page_size), int(width), tp_plan(cfg, sharding)))
 
 
-def _build_verify_step(cfg, page_size, width):
+def _build_verify_step(cfg, page_size, width, plan=None):
     S = int(page_size)
     W = int(width)
-    g = cfg.num_heads // cfg.num_kv_heads
+    qcfg = plan.local_cfg if plan is not None else cfg
+    Cl = qcfg.num_heads * cfg.head_dim
+    axis = plan.axis if plan is not None else None
+    g = qcfg.num_heads // qcfg.num_kv_heads
     scale = 1.0 / (cfg.head_dim ** 0.5)
 
     def verify(params, k_pages, v_pages, tokens, positions, n_valid,
@@ -479,7 +768,7 @@ def _build_verify_step(cfg, page_size, width):
         wp = jnp.where(valid, page_of, 0)
         ws = jnp.where(valid, idx % S, 0)
         for li, lp in enumerate(params["layers"]):
-            q, k, v = _qkv(x, lp, cfg)                  # (B, W, H/KVH, D)
+            q, k, v = _qkv(x, lp, qcfg)                 # (B, W, H/KVH, D)
             k_pages = k_pages.at[li, :, wp, ws, :].set(k)
             v_pages = v_pages.at[li, :, wp, ws, :].set(v)
             kc = _paged.gather_pages(k_pages[li], page_tables)
@@ -496,14 +785,16 @@ def _build_verify_step(cfg, page_size, width):
             p = jnp.where(jnp.isnan(p), 0.0, p)
             att = jnp.einsum("bhwc,bhcd->bhwd", p, vr.astype(jnp.float32))
             merged = att.transpose(0, 2, 1, 3).reshape(
-                B, W, cfg.units).astype(x.dtype)
-            x = _layer_tail(x, merged, lp)
+                B, W, Cl).astype(x.dtype)
+            x = _layer_tail(x, merged, lp, axis=axis)
         logits = jnp.dot(x.astype(jnp.float32),
                          params["embed"].astype(jnp.float32).T)
         return (k_pages, v_pages,
                 jnp.argmax(logits, axis=-1).astype(jnp.int32))
 
-    return jax.jit(verify, donate_argnums=(1, 2))
+    if plan is None:
+        return jax.jit(verify, donate_argnums=(1, 2))
+    return plan.wrap(verify, n_rest=5, n_out_rest=1)
 
 
 def verify_launch_stats(params, cfg, page_size, width, slots,

@@ -67,14 +67,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import _CompilerParams
 
-__all__ = ["lstm_sequence", "decode_layer_group", "rnn_mode", "decode_mode",
+__all__ = ["lstm_sequence", "decode_layer_group", "decode_attn_phase",
+           "decode_ffn_phase", "rnn_mode", "decode_mode",
            "count_launches", "trace_counts", "last_path"]
 
 _SQRT_HALF = math.sqrt(0.5)
 
 # per-op trace counters (bench/tests assert the fused path is actually in
 # the compiled program, the PR-2 epilogue convention)
-trace_counts = {"lstm_sequence": 0, "decode_layer_group": 0}
+trace_counts = {"lstm_sequence": 0, "decode_layer_group": 0,
+                "decode_attn_phase": 0, "decode_ffn_phase": 0}
 # "pallas" | "pallas-interpret" — which backend the last call latched
 last_path = None
 
@@ -491,6 +493,154 @@ def decode_layer_group(x, kp, vp, stacked, meta, page_tables, lengths,
         interpret=(mode == "interpret"),
     )(x, kp, vp, *w_arrays, meta, page_tables, lengths)
     return kp2, vp2, x_out
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel phase kernels (the persistent decode step under tp)
+# ---------------------------------------------------------------------------
+# A Pallas body cannot carry a cross-chip collective, so under tensor
+# parallelism the layer-group fusion splits at the two reduce points of
+# a Megatron layer: an ATTENTION phase (qkv + KV append + paged read +
+# local out-proj partial — everything left of the first all-reduce) and
+# an FFN phase (ffn1 + erf GELU + local ffn2 partial — everything left
+# of the second).  The caller (models/decoder) psums between them; the
+# residual-LN glue runs in XLA where it fuses into the reduce epilogue.
+
+def _decode_attn_phase_kernel(x_ref, kp_ref, vp_ref,
+                              wq_ref, bq_ref, wk_ref, bk_ref,
+                              wv_ref, bv_ref, wo_ref,
+                              meta_ref, pt_ref, len_ref,
+                              kp_out, vp_out, o_out, *, cfg_tuple):
+    """One LOCAL layer shard: qkv over the shard's heads, KV append into
+    the shard's page slab, paged-attention read, and the out-proj
+    PARTIAL product (no bias — the bias is replicated and must be added
+    after the tp all-reduce).  Same math as the first half of
+    ``_decode_group_kernel`` with H/KVH the per-shard counts."""
+    (B, H, KVH, D, C, S, P, pps) = cfg_tuple
+    g = H // KVH
+    scale = 1.0 / (D ** 0.5)
+
+    kp_out[...] = kp_ref[...]
+    vp_out[...] = vp_ref[...]
+
+    x = x_ref[...].astype(jnp.float32)                 # (B, C) replicated
+    q = (jnp.dot(x, wq_ref[...].astype(jnp.float32).T,
+                 preferred_element_type=jnp.float32)
+         + bq_ref[...].astype(jnp.float32)).reshape(B, KVH, g, D)
+    k = (jnp.dot(x, wk_ref[...].astype(jnp.float32).T,
+                 preferred_element_type=jnp.float32)
+         + bk_ref[...].astype(jnp.float32)).reshape(B, KVH, D)
+    v = (jnp.dot(x, wv_ref[...].astype(jnp.float32).T,
+                 preferred_element_type=jnp.float32)
+         + bv_ref[...].astype(jnp.float32)).reshape(B, KVH, D)
+
+    for b in range(B):
+        wp_b = meta_ref[0, b]
+        ws_b = meta_ref[1, b]
+        kp_out[:, wp_b, ws_b, :] = k[b].astype(kp_out.dtype)
+        vp_out[:, wp_b, ws_b, :] = v[b].astype(vp_out.dtype)
+
+    k_all = kp_out[...].astype(jnp.float32).reshape(KVH, P * S, D)
+    v_all = vp_out[...].astype(jnp.float32).reshape(KVH, P * S, D)
+    slot_page = jax.lax.broadcasted_iota(jnp.int32, (1, P * S), 1) // S
+    slot_in = jax.lax.broadcasted_iota(jnp.int32, (1, P * S), 1) % S
+    lengths = len_ref[...]                               # (B, 1)
+    mask = jnp.zeros((B, P * S), jnp.bool_)
+    for j in range(pps):
+        pt_j = pt_ref[:, j].reshape(B, 1)
+        hit = (slot_page == pt_j) & (slot_in + j * S < lengths)
+        mask = mask | hit
+    logits = jax.lax.dot_general(
+        q * scale, k_all,
+        dimension_numbers=(((3,), (2,)), ((1,), (0,))),
+        preferred_element_type=jnp.float32)              # (KVH,B,g,N)
+    logits = jnp.where(mask[None, :, None, :], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m)
+    p = jnp.where(mask[None, :, None, :], p, 0.0)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.where(denom == 0.0, 1.0, denom)
+    att = jax.lax.dot_general(
+        p, v_all, dimension_numbers=(((3,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)              # (KVH,B,g,D)
+    merged = jnp.transpose(att, (1, 0, 2, 3)).reshape(B, H * D)
+    o_out[...] = jnp.dot(merged, wo_ref[...].astype(jnp.float32).T,
+                         preferred_element_type=jnp.float32)
+
+
+def decode_attn_phase(x, kp, vp, lp, meta, page_tables, lengths, cfg,
+                      mode):
+    """Attention phase of one tensor-parallel decode layer: ONE launch
+    per layer per shard, run INSIDE shard_map on per-shard operands.
+
+    x:           (B, C) activations — C is the FULL model width
+                 (replicated; the tail all-reduce restores it)
+    kp/vp:       (KVH_local, P, S, D) this layer's LOCAL page slab
+                 (updated in place via input_output_aliases)
+    lp:          this layer's per-shard params (wq…wo used here)
+    meta:        (2, B) int32 write page/slot rows (SMEM)
+    page_tables: (B, pages_per_seq) int32
+    lengths:     (B, 1) int32
+    cfg:         the LOCAL DecoderConfig (per-shard head counts)
+
+    Returns (kp, vp, o_partial (B, C) f32) — o_partial is the
+    un-reduced, bias-less out-proj contribution of this shard.
+    """
+    trace_counts["decode_attn_phase"] += 1
+    global last_path
+    last_path = "pallas" if mode == "compiled" else "pallas-interpret"
+    KVH, P, S, D = kp.shape
+    B, C = x.shape
+    pps = page_tables.shape[1]
+    cfg_tuple = (B, cfg.num_heads, KVH, D, C, S, P, pps)
+    kernel = functools.partial(_decode_attn_phase_kernel,
+                               cfg_tuple=cfg_tuple)
+    w_arrays = [lp[k] for k in ("wq", "bq", "wk", "bk", "wv", "bv", "wo")]
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    in_specs = ([vmem, vmem, vmem]
+                + [vmem] * len(w_arrays)
+                + [pl.BlockSpec(memory_space=pltpu.SMEM), vmem, vmem])
+    kp2, vp2, o_part = pl.pallas_call(
+        kernel,
+        in_specs=in_specs,
+        out_specs=[vmem, vmem, vmem],
+        out_shape=[jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+                   jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+                   jax.ShapeDtypeStruct((B, C), jnp.float32)],
+        input_output_aliases={1: 0, 2: 1},
+        interpret=(mode == "interpret"),
+    )(x, kp, vp, *w_arrays, meta, page_tables, lengths)
+    return kp2, vp2, o_part
+
+
+def _decode_ffn_phase_kernel(x_ref, w1_ref, b1_ref, w2_ref, f_out):
+    x = x_ref[...].astype(jnp.float32)
+    h = _gelu_erf(jnp.dot(x, w1_ref[...].astype(jnp.float32).T,
+                          preferred_element_type=jnp.float32)
+                  + b1_ref[...].astype(jnp.float32))
+    f_out[...] = jnp.dot(h, w2_ref[...].astype(jnp.float32).T,
+                         preferred_element_type=jnp.float32)
+
+
+def decode_ffn_phase(x, w1, b1, w2, mode):
+    """FFN phase of one tensor-parallel decode layer: ffn1 (column
+    shard) + erf GELU + ffn2 PARTIAL (row shard, no bias) fused into one
+    launch.  Returns the un-reduced (B, C) f32 contribution; the caller
+    psums and adds the replicated b2."""
+    trace_counts["decode_ffn_phase"] += 1
+    global last_path
+    last_path = "pallas" if mode == "compiled" else "pallas-interpret"
+    B, C = x.shape
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    f_out = pl.pallas_call(
+        _decode_ffn_phase_kernel,
+        in_specs=[vmem, vmem, vmem, vmem],
+        out_specs=vmem,
+        out_shape=jax.ShapeDtypeStruct((B, C), jnp.float32),
+        interpret=(mode == "interpret"),
+    )(x, w1, b1, w2)
+    return f_out
 
 
 # ---------------------------------------------------------------------------

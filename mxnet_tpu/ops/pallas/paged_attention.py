@@ -169,6 +169,15 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices, scale=None):
     page_indices: (B, pages_per_seq) int32 page table rows
 
     Returns (B, num_heads, head_dim) in q.dtype.
+
+    Shard-oblivious by design: under a tensor-parallel decode step
+    (``models.decoder.tp_plan``) this runs INSIDE ``shard_map``, so
+    ``num_heads``/``num_kv_heads`` here are the per-shard counts
+    (global // tp) and the page axis is full on every shard.  Heads
+    shard contiguously, so each shard's local GQA group structure —
+    head ``h`` reads KV head ``h // (num_heads // num_kv_heads)`` —
+    is exactly the global one and the kernel needs no sharding
+    awareness at all; attention is embarrassingly parallel over heads.
     """
     global last_path, _fallback_warned
     mode = _mode()

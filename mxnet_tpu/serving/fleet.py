@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import time
 
 import numpy as onp
@@ -255,7 +256,8 @@ class ServingFleet:
 
     def __init__(self, spec, *, replicas=None, policy="least_loaded",
                  host="127.0.0.1", port=0, env=None, roles=None,
-                 router_kwargs=None, supervisor_kwargs=None):
+                 sharding=None, router_kwargs=None,
+                 supervisor_kwargs=None):
         self.supervisor = ReplicaSupervisor(
             spec, replicas=replicas, host=host, env=env,
             **(supervisor_kwargs or {}))
@@ -269,6 +271,32 @@ class ServingFleet:
             if role != "mixed":
                 self.supervisor.env_by_rid.setdefault(
                     r.rid, {})["MXNET_GEN_ROLE"] = role
+        # sharding: per-replica mesh stamping ("sharding" kwarg or spec
+        # key) — a dict applies to every replica, a list assigns by
+        # index (None/missing entries serve replicated).  The stamped
+        # MXNET_MESH_SHAPE / MXNET_MESH_AXES are what a generate spec's
+        # {"sharding": {"from_env": true}} block resolves against in
+        # the replica process (ShardingConfig.from_env); "host_devices"
+        # forces fake host devices so a CPU replica can build the mesh.
+        shd = sharding if sharding is not None else spec.get("sharding")
+        if shd is None or isinstance(shd, dict):
+            shd = [shd] * len(self.supervisor.replicas)
+        for r, blk in zip(self.supervisor.replicas, shd):
+            if not blk:
+                continue
+            renv = self.supervisor.env_by_rid.setdefault(r.rid, {})
+            shape = blk.get("mesh_shape")
+            if shape:
+                renv["MXNET_MESH_SHAPE"] = ",".join(
+                    str(int(s)) for s in shape)
+            axes = blk.get("axis_names")
+            if axes:
+                renv["MXNET_MESH_AXES"] = ",".join(axes)
+            if blk.get("host_devices"):
+                renv["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=%d"
+                    % int(blk["host_devices"])).strip()
         self._policy = policy
         self._router_kwargs = dict(router_kwargs or {})
         self._host = host

@@ -188,7 +188,7 @@ class DecodeEngine:
                  static_batching=False, session_ttl_s=None,
                  prefix_cache=None, role=None, migrate=None,
                  pagestore=None, speculate=None, spec_k=None,
-                 drafter=None, draft_model=None):
+                 drafter=None, draft_model=None, sharding=None):
         self.model = model
         self.name = name
         self.cfg = model.config
@@ -220,8 +220,21 @@ class DecodeEngine:
         cfg = self.cfg
         shape = (cfg.num_layers, cfg.num_kv_heads, total, self.page_size,
                  cfg.head_dim)
-        self._kp = jnp.zeros(shape, jnp.float32)
-        self._vp = jnp.zeros(shape, jnp.float32)
+        # tensor-parallel serving (ISSUE 13): resolve the sharding into a
+        # TPPlan BEFORE building any program — params go column/row-
+        # parallel, KV pages split along KV heads, and every decode/
+        # prefill/verify builder below gets the config so its program
+        # runs per-shard under shard_map.  A config that cannot shard
+        # this geometry resolves to None (decoder.tp_plan warns loudly)
+        # and the engine serves replicated.  PageAllocator bookkeeping is
+        # host-side and shard-agnostic either way.
+        self._tp_plan = _decoder.tp_plan(cfg, sharding)
+        self.sharding = sharding if self._tp_plan is not None else None
+        self.tp = self._tp_plan.tp if self._tp_plan is not None else 1
+        if self._tp_plan is not None:
+            self.params = self._tp_plan.place_params(self.params)
+        self._kp = self._place_kv(jnp.zeros(shape, jnp.float32))
+        self._vp = self._place_kv(jnp.zeros(shape, jnp.float32))
         self._tables = onp.zeros((self.slots, self.pages_per_seq),
                                  onp.int32)
         self._tables_dev = None  # device copy, rebuilt when rows change
@@ -235,25 +248,46 @@ class DecodeEngine:
         if self.decode_fused_mode is not None:
             self._decode_fn = _decoder.make_decode_step_fused(
                 cfg, self.page_size, self.layer_group,
-                self.decode_fused_mode)
+                self.decode_fused_mode, sharding=self.sharding)
         else:
-            self._decode_fn = _decoder.make_decode_step(cfg,
-                                                        self.page_size)
+            self._decode_fn = _decoder.make_decode_step(
+                cfg, self.page_size, sharding=self.sharding)
         self._decode_fn_unfused = None   # lazy fallback (compile fail)
         self._prefill_fn = _decoder.make_prefill_chunk(
-            cfg, self.page_size, self.prefill_chunk)
+            cfg, self.page_size, self.prefill_chunk,
+            sharding=self.sharding)
         try:
             self.launch_stats = _decoder.decode_launch_stats(
                 self.params, cfg, self.page_size, self.slots,
                 self.pages_per_seq, total,
                 fused=self.decode_fused_mode is not None,
                 layer_group=self.layer_group,
-                mode=self.decode_fused_mode or "interpret")
+                mode=self.decode_fused_mode or "interpret",
+                sharding=self.sharding)
         except Exception:  # pragma: no cover - tracing is best-effort
             _log.exception("decode launch census failed")
             self.launch_stats = {"fused": self.decode_fused_mode
                                  is not None}
         self.metrics.observe_decode_launches(self.name, self.launch_stats)
+        # static collective census (once, at engine attach): what the
+        # sharded decode step moves cross-chip per step — all-reduce
+        # only, counts invariant to batch size.  Surfaces in /v1/stats
+        # so the fleet router can tell a TP replica from a dp replica.
+        self.collective_stats = None
+        if self._tp_plan is not None:
+            try:
+                self.collective_stats = _decoder.decode_collective_stats(
+                    self.params, cfg, self.page_size, self.slots,
+                    self.pages_per_seq, total, self.sharding,
+                    fused=self.decode_fused_mode is not None,
+                    layer_group=self.layer_group,
+                    mode=self.decode_fused_mode or "interpret")
+            except Exception:  # pragma: no cover - census is best-effort
+                _log.exception("decode collective census failed")
+                self.collective_stats = {
+                    "mesh": self.sharding.describe(), "tp": self.tp}
+            self.metrics.observe_decode_collectives(self.name,
+                                                    self.collective_stats)
 
         self._slots = [_Slot(i) for i in range(self.slots)]
         self._sessions = {}           # sid -> _Session (parked or busy)
@@ -565,8 +599,10 @@ class DecodeEngine:
                     raise
         if n:
             idx = jnp.asarray(onp.asarray(pages, onp.int32))
-            self._kp = self._kp.at[:, :, idx].set(jnp.asarray(k))
-            self._vp = self._vp.at[:, :, idx].set(jnp.asarray(v))
+            self._kp = self._place_kv(
+                self._kp.at[:, :, idx].set(jnp.asarray(k)))
+            self._vp = self._place_kv(
+                self._vp.at[:, :, idx].set(jnp.asarray(v)))
         sess = _Session(sid, owner)
         sess.pos = int(meta["pos"])
         sess.pending = (int(meta["pending"])
@@ -889,8 +925,8 @@ class DecodeEngine:
                 # the first divergent write lands
                 old = pfx_pages[-1]
                 new = self.alloc.fork(owner, old)
-                self._kp = _copy_page(self._kp, old, new)
-                self._vp = _copy_page(self._vp, old, new)
+                self._kp = self._place_kv(_copy_page(self._kp, old, new))
+                self._vp = self._place_kv(_copy_page(self._vp, old, new))
                 self.metrics.count(self.name, "cow_forks_total")
         self.metrics.count(self.name, "sequences_total")
         self._sync_table(slot)
@@ -941,6 +977,17 @@ class DecodeEngine:
             self._tables_dev = jnp.asarray(self._tables)
         return self._tables_dev
 
+    def _place_kv(self, pages):
+        """Pin (or re-pin) a page array to the TP KV sharding.  No-op
+        when serving replicated.  Host-side page mutations (`.at[].set`
+        imports, copy-on-write forks) produce fresh arrays whose
+        placement XLA chooses freely; re-pinning keeps every update on
+        the head-sharded layout so the next decode step never inserts a
+        resharding transfer."""
+        if self._tp_plan is None:
+            return pages
+        return self._tp_plan.place_kv(pages)
+
     def _run_decode_fn(self, *args):
         """Dispatch one decode step; if the fused persistent kernel
         fails its FIRST real compile (non-TPU accelerator, VMEM
@@ -959,10 +1006,11 @@ class DecodeEngine:
                 "per-op decode step for this engine")
             self.decode_fused_mode = None
             self._decode_fn_unfused = _decoder.make_decode_step(
-                self.cfg, self.page_size)
+                self.cfg, self.page_size, sharding=self.sharding)
             self.launch_stats = _decoder.decode_launch_stats(
                 self.params, self.cfg, self.page_size, self.slots,
-                self.pages_per_seq, self.alloc.total_pages, fused=False)
+                self.pages_per_seq, self.alloc.total_pages, fused=False,
+                sharding=self.sharding)
             self.metrics.observe_decode_launches(self.name,
                                                  self.launch_stats)
             return self._decode_fn_unfused(*args)
@@ -1243,7 +1291,8 @@ class DecodeEngine:
             return False  # every draft's slot died: plain decode is fine
         width = 1 + max(len(d) for d in plan.values())
         verify_fn = _decoder.make_verify_step(self.cfg, self.page_size,
-                                              width)
+                                              width,
+                                              sharding=self.sharding)
         tokens = onp.zeros((self.slots, width), onp.int32)
         positions = onp.zeros(self.slots, onp.int32)
         n_valid = onp.zeros(self.slots, onp.int32)
@@ -1445,7 +1494,7 @@ class DecodeEngine:
             # a mid-stream XLA compile
             for w in range(2, self._spec.k_cap + 2):
                 vf = _decoder.make_verify_step(self.cfg, self.page_size,
-                                               w)
+                                               w, sharding=self.sharding)
                 self._kp, self._vp, out = vf(
                     self.params, self._kp, self._vp,
                     jnp.zeros((self.slots, w), jnp.int32),
@@ -1524,6 +1573,12 @@ class DecodeEngine:
                "decode_fused": self.decode_fused_mode,
                "launches": dict(self.launch_stats),
                "fn_cache": _decoder.fn_cache_stats()}
+        if self.sharding is not None:
+            out["sharding"] = {"mesh": self.sharding.describe(),
+                               "tp": self.tp}
+            if self.collective_stats is not None:
+                out["sharding"]["collectives"] = dict(
+                    self.collective_stats.get("collectives", {}))
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
         if self._spec is not None:

@@ -35,6 +35,15 @@ KV state portable and shareable:
   page), shared copy-on-write so N sequences with a common system
   prompt pay its prefill once.
 
+Tensor-parallel serving (``DecodeEngine(sharding=...)``) changes NONE
+of this bookkeeping: page ids, refcounts, and occupancy are per-page
+regardless of how the device pool is laid out, and the pool splits
+along the KV-head axis — every shard holds the same pages, each with
+``num_kv_heads // tp`` of the heads.  ``pack_session`` blobs always
+carry FULL-head pages: the engine gathers shards to host on export and
+re-pins to the mesh on import, so a session migrates freely between
+replicated and TP replicas of any degree.
+
 Fault site ``kvcache.alloc`` (``mxnet_tpu.faults``) trips inside
 :meth:`PageAllocator.alloc`, so chaos tests can fail allocations
 deterministically; genuine exhaustion raises :class:`CacheOOM`, which

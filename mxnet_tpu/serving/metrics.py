@@ -117,6 +117,10 @@ class ModelMetrics:
         # load-independent) and the bounded decode/prefill program cache
         self.decode_launches = None
         self.fn_cache = None
+        # static cross-chip census (set once at engine attach when the
+        # engine is tensor-parallel): mesh shape + per-step collective
+        # counts — how the fleet router tells a TP replica from a dp one
+        self.decode_collectives = None
 
     def snapshot(self):
         items = self.counters["items_total"]
@@ -166,6 +170,11 @@ class ModelMetrics:
                     self.decode_launches)
             if self.fn_cache is not None:
                 out["generate"]["fn_cache"] = dict(self.fn_cache)
+        if self.decode_collectives is not None:
+            # static census — surfaced from attach time on, before any
+            # traffic lands (it never changes while the engine lives)
+            out.setdefault("generate", {})["sharding"] = dict(
+                self.decode_collectives)
         return out
 
 
@@ -286,6 +295,19 @@ class ServingMetrics:
         profiler.record_counter(
             "serving::%s::decode_launches" % name,
             launches=stats.get("launches_per_step", 0))
+
+    def observe_decode_collectives(self, name, stats):
+        """Static per-step collective census of a tensor-parallel
+        engine's decode program (models.decoder.decode_collective_stats):
+        mesh shape, tp degree, {collective: count}.  Recorded once at
+        engine attach — the census is a property of the compiled program,
+        not of traffic."""
+        with self._lock:
+            self._model(name).decode_collectives = dict(stats)
+        cols = stats.get("collectives") or {}
+        profiler.record_counter(
+            "serving::%s::decode_collectives" % name,
+            all_reduce=cols.get("all-reduce", 0))
 
     def observe_fn_cache(self, name, stats):
         """Decode/prefill program-cache gauges ({size, cap, compiles,

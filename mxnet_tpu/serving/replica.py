@@ -27,6 +27,15 @@ set by ``ServingFleet.start``) and ``MXNET_GEN_ROLE``
 (``prefill`` | ``decode`` | ``mixed`` — ``ServingFleet(roles=[...])``),
 or explicitly via ``"generate": {"role": ..., "pagestore": ...}``.
 
+A generate spec may also carry a ``"sharding"`` block, making the
+replica a tensor-parallel engine: ``{"from_env": true}`` builds the
+mesh from the supervisor-stamped ``MXNET_MESH_SHAPE``/``MXNET_MESH_AXES``
+(``ServingFleet`` replica specs stamp these per replica), or the block
+names it explicitly — ``{"mesh_shape": [1, 2],
+"axis_names": ["dp", "tp"]}``.  Either way the Megatron
+``for_transformer()`` rules apply (qkv/ffn1 column-parallel, proj/ffn2
+row-parallel) and the KV pages shard along KV heads.
+
 Models are named by importable *builder path*, never shipped as code —
 only callables already on this process's PYTHONPATH can load (the
 restricted-unpickler stance, applied to serving).
@@ -52,7 +61,8 @@ import time
 
 import numpy as onp
 
-__all__ = ["main", "demo_affine", "demo_dense", "demo_faulty"]
+__all__ = ["main", "demo_affine", "demo_dense", "demo_faulty",
+           "resolve_sharding"]
 
 
 # ---------------------------------------------------------------------------
@@ -103,6 +113,30 @@ def demo_faulty(p=1.0, scale=2.0, seed=0):
 
 
 # ---------------------------------------------------------------------------
+# sharding spec resolution
+# ---------------------------------------------------------------------------
+def resolve_sharding(block):
+    """Resolve a generate-spec ``"sharding"`` block into a
+    :class:`~mxnet_tpu.parallel.shardcfg.ShardingConfig` carrying the
+    Megatron transformer rules.  ``{"from_env": true}`` reads the
+    supervisor-stamped ``MXNET_MESH_SHAPE``/``MXNET_MESH_AXES``;
+    otherwise the block names the mesh explicitly
+    (``{"mesh_shape": [1, 2], "axis_names": ["dp", "tp"]}``).
+    ``None``/empty resolves to ``None`` (replicated serving)."""
+    if not block:
+        return None
+    from ..parallel.shardcfg import ShardingConfig
+    rules = ShardingConfig.for_transformer(mesh_shape=(1,)).rules
+    if block.get("from_env"):
+        return ShardingConfig.from_env(rules=rules)
+    shape = block.get("mesh_shape")
+    axes = block.get("axis_names")
+    return ShardingConfig.for_transformer(
+        mesh_shape=tuple(int(s) for s in shape) if shape else None,
+        axis_names=tuple(axes) if axes else None)
+
+
+# ---------------------------------------------------------------------------
 # process entry
 # ---------------------------------------------------------------------------
 def main(argv=None):
@@ -147,6 +181,7 @@ def main(argv=None):
         max_queue_depth=int(spec.get("max_queue_depth", 256)))
     for name, model, genkw in generators:
         from .generate import DecodeEngine
+        genkw["sharding"] = resolve_sharding(genkw.get("sharding"))
         server.attach_engine(name, DecodeEngine(model, name=name, **genkw))
     server.start()
     print("REPLICA_READY id=%s port=%d warm_s=%.2f cache=%s"

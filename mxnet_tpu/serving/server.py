@@ -336,11 +336,13 @@ class ModelServer:
         conversations survive the swap instead of resetting."""
         from .generate import DecodeEngine
         from .registry import resolve_builder
+        from .replica import resolve_sharding
         name = body["name"]
         builder = resolve_builder(body["builder"])
         model = builder(**(body.get("kwargs") or {}))
-        engine = DecodeEngine(model, name=name,
-                              **dict(body["generate"]))
+        genkw = dict(body["generate"])
+        genkw["sharding"] = resolve_sharding(genkw.get("sharding"))
+        engine = DecodeEngine(model, name=name, **genkw)
         old = self.batcher._engines.get(name)
         self.attach_engine(name, engine)  # warms, then swaps the route
         migrated = 0

@@ -236,6 +236,42 @@ def test_export_import_bit_identical(lm):
         e.alloc.check_leaks()
 
 
+@pytest.mark.multichip
+@pytest.mark.parametrize("direction", ["tp_to_1chip", "1chip_to_tp"])
+def test_export_import_tensor_parallel_round_trip(lm, direction):
+    """TP arm (ISSUE 13): pack_session round-trips between a
+    tensor-parallel engine (head-sharded KV pages, gathered to host on
+    export) and a 1-chip one (re-sharded on import) — continuation
+    oracle-exact in BOTH directions."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from mxnet_tpu.parallel.shardcfg import ShardingConfig
+    scfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                          axis_names=("dp", "tp"))
+    tp_first = direction == "tp_to_1chip"
+    e1 = make_engine(lm, sharding=scfg if tp_first else None)
+    e2 = make_engine(lm, sharding=None if tp_first else scfg)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    try:
+        assert (e1 if tp_first else e2).tp == 2
+        r1 = e1.submit(prompt, 5, session="mig").result(60)
+        blob = e1.export_session("mig")
+        meta, k, v = unpack_session(blob)
+        # the blob carries FULL-head pages regardless of the exporter
+        assert k.shape[1] == lm.config.num_kv_heads
+        e2.import_session(blob)
+        hist = prompt + r1["tokens"]
+        r2 = e2.submit([7], 5, session="mig", resume=True).result(60)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [7], 5)
+    finally:
+        e1.stop()
+        e2.stop()
+    for e in (e1, e2):
+        assert e.alloc.num_used == 0
+        e.alloc.check_leaks()
+
+
 def test_export_import_with_shared_prefix_pages(lm):
     """A session whose page table maps shared prefix pages exports
     private copies; refcounts are conserved on both sides and both

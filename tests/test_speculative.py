@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from mxnet_tpu import faults, serving
@@ -132,6 +133,27 @@ def test_parity_matrix(lm, draft_lm, baseline, k, kind, pfx):
     drain(eng)
     assert got == baseline
     assert st["speculative"]["drafter"] == kind
+    assert st["speculative"]["k_cap"] == k
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("k", [1, 2])
+def test_parity_tensor_parallel_engine(lm, baseline, k):
+    """TP arm (ISSUE 13): the dp×tp-sharded verify program accepts and
+    rejects exactly like the 1-chip engine — greedy tokens bit-equal to
+    the plain baseline with the KV pages head-sharded underneath."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from mxnet_tpu.parallel.shardcfg import ShardingConfig
+    scfg = ShardingConfig.for_transformer(mesh_shape=(4, 2),
+                                          axis_names=("dp", "tp"))
+    eng = make_engine(lm, speculate=True, spec_k=k, drafter="ngram",
+                      sharding=scfg)
+    got = run_batch(eng)
+    st = eng.stats()
+    drain(eng)
+    assert got == baseline
+    assert st["sharding"]["tp"] == 2
     assert st["speculative"]["k_cap"] == k
 
 

@@ -436,15 +436,22 @@ def scenario_llm(args):
                         "generate": {"slots": 4, "page_size": 8,
                                      "prefill_chunk": 8, "max_ctx": 64,
                                      "total_pages": 513,
-                                     "speculate": True, "spec_k": 2}}],
+                                     "speculate": True, "spec_k": 2,
+                                     # resolved per replica from the
+                                     # supervisor-stamped mesh env:
+                                     # replica 0 serves dp=1xtp=2, the
+                                     # rest (no env) serve replicated
+                                     "sharding": {"from_env": True}}}],
             "max_queue_depth": 512}
     fleet = serving.ServingFleet(
         spec, replicas=n, policy="hash",
+        sharding=[{"mesh_shape": [1, 2], "axis_names": ["dp", "tp"],
+                   "host_devices": 2}],
         router_kwargs={"probe_ms": 50},
         supervisor_kwargs={"restart_backoff_ms": 100,
                            "startup_timeout_s": 300})
-    print("chaos-llm: starting %d LLM replicas (compiling decode "
-          "programs)" % n)
+    print("chaos-llm: starting %d LLM replicas (replica 0 "
+          "tensor-parallel tp=2; compiling decode programs)" % n)
     fleet.start()
     ok = True
     stop = threading.Event()
@@ -504,7 +511,33 @@ def scenario_llm(args):
 
     threads = [threading.Thread(target=load_client, args=(c,),
                                 daemon=True) for c in range(clients)]
+
+    def _gen_stats(port):
+        import http.client as _http
+        import json as _json
+        try:
+            c = _http.HTTPConnection("127.0.0.1", port, timeout=10)
+            c.request("GET", "/v1/stats")
+            doc = _json.loads(c.getresponse().read())
+            c.close()
+            return doc.get("generators", {}).get("llm", {})
+        except Exception:
+            return {}
+
+    tp_ok = True
     try:
+        # the TP replica must actually BE tensor-parallel (a silent
+        # fallback to replicated would pass every traffic check below
+        # without exercising the sharded path at all)
+        r0 = fleet.supervisor.replicas[0]
+        shd = _gen_stats(r0.port).get("sharding") or {}
+        if shd.get("tp") != 2:
+            print("chaos-llm: FAIL replica 0 not tensor-parallel: %r"
+                  % (shd,))
+            tp_ok = False
+        else:
+            print("chaos-llm: replica 0 serving %s, decode collectives "
+                  "%r" % (shd.get("mesh"), shd.get("collectives")))
         # park a known set of sessions BEFORE the kill: the victim's
         # share must come back as typed SessionResetError on resume
         warm_cli = serving.ServingClient(*fleet.address, timeout=60)
@@ -682,6 +715,10 @@ def scenario_llm(args):
             ok = False
         if not counters["ok"]:
             print("FAIL: load generator completed no requests")
+            ok = False
+        if not tp_ok:
+            print("FAIL: the fleet's TP replica did not serve "
+                  "tensor-parallel")
             ok = False
     finally:
         stop.set()
