@@ -200,6 +200,19 @@ register("MXNET_KV_EVICT_EMA_K", float, 3.0, "honored",
          "the step time (compile-slow ranks) cannot ping-pong a merely "
          "slow worker out of the membership (0 = fixed MXNET_KV_EVICT_SEC)",
          "kvstore.dist.KVStoreDistServer")
+register("MXNET_MESH_TP_FALLBACK", bool, True, "honored",
+         "elastic mesh shrink ladder: when the surviving device count "
+         "cannot keep the tp extent (dp-first shrink fails), 1 = allow "
+         "refactoring tp down to a divisor (tp=1 means fully replicated "
+         "params) with a loud warning; 0 = raise MeshShrinkError instead",
+         "parallel.shardcfg.ShardingConfig.shrink_to")
+register("MXNET_MESH_SAVE_EVERY", int, 1, "honored",
+         "elastic mesh training: write a sharded crash-safe checkpoint "
+         "every N step boundaries so a lost chip's irreplaceable shards "
+         "are at most N-1 steps stale (recovery rewinds survivors to the "
+         "same boundary, keeping the resumed run bit-identical to a "
+         "fresh start from that checkpoint)",
+         "gluon.Trainer.attach_mesh")
 register("MXNET_FLEET_REPLICAS", int, 2, "honored",
          "serving fleet: default replica count launched by "
          "ServingFleet/ReplicaSupervisor", "serving.fleet.ServingFleet")

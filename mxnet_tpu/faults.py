@@ -20,7 +20,19 @@ Sites (grep for ``faults.check``):
   trainer.step       gluon.Trainer.step entry ("preempt" = injected
                      SIGTERM: graceful checkpoint + leave + exit 0)
   checkpoint.write   checkpoint writer ("torn" truncates the npz payload,
-                     simulating a crash mid-write on a non-atomic path)
+                     simulating a crash mid-write on a non-atomic path;
+                     under a sharded format-2 save, "torn" tears the last
+                     shard file — the manifest keeps the true CRCs, so
+                     the loader must fall back a step)
+  checkpoint.shard_read  format-2 sharded-checkpoint shard read ("torn"
+                     reads as a corrupt shard: the loader excludes the
+                     step and falls back to the newest step whose full
+                     shard set verifies; error/timeout surface to the
+                     caller — the no-kill recovery drill)
+  mesh.reshard       elastic mesh recovery, after the shrunk mesh is
+                     chosen but before missing shards are restored
+                     (exception kinds abort the recovery attempt — the
+                     retry/abort policy drill for survivors)
   router.dispatch    serving-fleet router, before a request is forwarded
                      to a replica (exception kinds read as a replica
                      transport failure: strike, failover retry)
@@ -102,7 +114,8 @@ KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
                "server.membership", "trainer.step", "checkpoint.write",
                "router.dispatch", "replica.crash", "decode.step",
                "kvcache.alloc", "session.export", "session.import",
-               "speculate.draft", "speculate.verify")
+               "speculate.draft", "speculate.verify",
+               "mesh.reshard", "checkpoint.shard_read")
 
 
 class FaultRule:

@@ -15,6 +15,18 @@ SIGTERM (or an injected ``trainer.step`` ``preempt`` fault) into a
 graceful lifecycle event: finish-or-abandon the current step within
 ``MXNET_PREEMPT_GRACE_SEC``, write a crash-safe checkpoint, send a
 membership ``leave``, exit 0.
+
+``attach_mesh`` extends elasticity to mesh-SHARDED state (the ZeRO /
+TorchElastic composition): under a dp×tp ShardingConfig a lost worker
+holds param shards nobody else has, so on ``MembershipChanged`` the
+survivors shrink the mesh to the surviving device budget
+(``ShardingConfig.shrink_to`` — dp first, then tp refactor/replicated),
+recover state (pure re-placement when every slab still has a live
+replica, else slice-on-read from the newest format-2 sharded boundary
+checkpoint), rewind to that boundary, and raise the typed
+:class:`MeshResharded` — the training loop rebuilds its jitted step for
+``trainer.mesh_config`` (fresh program keyed on the new sharding token)
+and continues from ``resume_step``.
 """
 from __future__ import annotations
 
@@ -27,13 +39,37 @@ from ..kvstore import create as kv_create, KVStoreBase, MembershipChanged
 from ..ndarray import ndarray
 from .parameter import Parameter
 
-__all__ = ["Trainer"]
+__all__ = ["Trainer", "MeshResharded"]
 
 
 class _StepAbandoned(Exception):
     """Internal: the in-flight step's gradients are unrecoverable after a
     membership change (per-key worker-side path) — count the step as
     abandoned instead of replaying it."""
+
+
+class MeshResharded(RuntimeError):
+    """Raised by ``Trainer.step`` after a SUCCESSFUL elastic mesh
+    recovery: survivors rebuilt a smaller mesh and restored boundary
+    state, but the trainer cannot re-run the user's forward/backward
+    under the new mesh.  The training loop catches this, rebuilds any
+    jitted step against ``trainer.mesh_config`` (the new sharding token
+    keys a fresh program — no stale collectives), and continues from
+    ``resume_step``.
+
+    source: "memory" (every slab had a surviving replica — pure
+    re-placement, no rewind) or "checkpoint" (irreplaceable shards were
+    sliced from the newest sharded checkpoint; the step counter was
+    rewound to its boundary).  plan: the full reshard_plan dict."""
+
+    def __init__(self, msg, old=None, new=None, resume_step=0,
+                 source=None, plan=None):
+        super().__init__(msg)
+        self.old = old
+        self.new = new
+        self.resume_step = int(resume_step)
+        self.source = source
+        self.plan = plan
 
 
 class Trainer:
@@ -81,6 +117,11 @@ class Trainer:
         self._world_scale = 1.0
         self._step_count = 0
         self._steps_abandoned = 0
+        # elastic mesh resharding (attach_mesh)
+        self._mesh_cfg = None
+        self._mesh_dir = None
+        self._mesh_params = None
+        self._mesh_save_every = 1
         # graceful preemption (attach_preemption)
         self._preempt_at = None
         self._preempt_dir = None
@@ -289,6 +330,12 @@ class Trainer:
             from .. import _bulk
             _bulk.flush()
         self._step_count += 1
+        if self._mesh_dir is not None and \
+                self._step_count % self._mesh_save_every == 0:
+            # boundary checkpoint: a chip lost NOW costs at most
+            # save_every-1 steps of replay, and the sharded layout is what
+            # survivors slice their missing shards out of
+            self._save_mesh_boundary()
         if self._preempt_at is not None and self._preempt_dir is not None:
             self._graceful_preempt_exit()
 
@@ -308,6 +355,11 @@ class Trainer:
         self._world_scale = self._initial_world / self._live_world
         from .. import profiler
         profiler.record_event_stat("elastic.membership_change")
+        if self._mesh_cfg is not None:
+            if self._bucketer is not None:
+                self._bucketer.abandon_step()
+            self._steps_abandoned += 1
+            self._mesh_reshard(info)  # raises MeshResharded
         if self._bucketer is not None:
             self._bucketer.abandon_step()
             return
@@ -315,6 +367,132 @@ class Trainer:
             self._steps_abandoned += 1
             profiler.record_event_stat("elastic.step_abandoned")
             raise _StepAbandoned()
+
+    @property
+    def mesh_config(self):
+        """The active ShardingConfig (updated in place by a reshard) —
+        the training loop keys its jitted step on this config's token."""
+        return self._mesh_cfg
+
+    def attach_mesh(self, sharding, ckpt_dir, params=None,
+                    save_every=None):
+        """Make losing a chip that holds irreplaceable shards a typed,
+        recoverable event (TorchElastic for mesh-sharded state).
+
+        sharding: the active ShardingConfig (dp×tp×... mesh) the params
+        are placed with.  ckpt_dir: where format-2 sharded boundary
+        checkpoints go — one is written immediately (the pre-step-1
+        irreplaceability window) and then every ``save_every`` (default
+        ``MXNET_MESH_SAVE_EVERY``) completed steps, asynchronously.
+
+        On ``MembershipChanged`` the trainer resyncs, shrinks the mesh to
+        the surviving device budget (the census carried by the event),
+        recovers state — pure re-placement when every slab still has a
+        live replica, else slice-on-read from the newest checkpoint whose
+        full shard set verifies — rewinds to that boundary, and raises
+        :class:`MeshResharded` for the training loop to rebuild its step
+        program and resume.
+
+        Requires a worker-side optimizer (``update_on_kvstore=False``):
+        params must still be at the last step boundary when an in-flight
+        step aborts, which a server-owned update cannot guarantee for
+        sharded state."""
+        if self._update_on_kvstore:
+            raise ValueError(
+                "attach_mesh needs a worker-side optimizer "
+                "(update_on_kvstore=False): server-owned updates cannot "
+                "keep sharded params at the step boundary when a step "
+                "aborts")
+        if self._update_on_kvstore is None:
+            self._update_on_kvstore = False
+        if params is None:
+            params = {p.name: p for p in self._params}
+        elif not isinstance(params, dict):
+            params = {p.name: p for p in params}
+        self._mesh_cfg = sharding
+        self._mesh_dir = ckpt_dir
+        self._mesh_params = params
+        self._mesh_save_every = max(1, int(
+            save_every if save_every is not None
+            else _config.get("MXNET_MESH_SAVE_EVERY")))
+        self._save_mesh_boundary()
+        return self
+
+    def detach_mesh(self):
+        self._mesh_cfg = None
+        self._mesh_dir = None
+        self._mesh_params = None
+
+    def _save_mesh_boundary(self):
+        from ..parallel import checkpoint as _ckpt
+        _ckpt.save_checkpoint(
+            self._mesh_dir, self._mesh_params, step=self._step_count,
+            trainer=self,
+            extra={"mesh": self._mesh_cfg.describe(),
+                   "world_size": self._live_world},
+            sharding=self._mesh_cfg)
+
+    def _mesh_reshard(self, info):
+        """The recovery half of attach_mesh: shrink the mesh to the
+        surviving device budget, restore boundary state under it, and
+        raise MeshResharded for the training loop.  The ``mesh.reshard``
+        fault site (error/timeout kinds) aborts the attempt here — after
+        the resync, before any state moves."""
+        import jax
+        from ..parallel import checkpoint as _ckpt
+        from ..parallel.shardcfg import reshard_plan
+        from .. import profiler
+        faults.check("mesh.reshard")
+        old_cfg = self._mesh_cfg
+        local = list(jax.devices())
+        # surviving chip budget: the membership census (rank → ndev), not
+        # the rank count — one lost host can take several chips with it
+        budget = int(info.get("total_devices") or 0) \
+            or max(1, int(info.get("num_workers") or 1))
+        budget = min(budget, len(local))
+        new_cfg = old_cfg.shrink_to(local[:budget])
+        keep = {d.id for d in local[:budget]}
+        lost = [d for d in old_cfg.mesh.devices.flat if d.id not in keep]
+        params = self._mesh_params
+        shapes = {k: tuple(int(s) for s in p.shape)
+                  for k, p in params.items()}
+        plan = reshard_plan(old_cfg, new_cfg, shapes, lost_devices=lost)
+        summary = plan["__summary__"]
+        if summary["checkpoint"] == 0:
+            # every slab still has a live replica: peer-copy path — pure
+            # re-placement onto the new mesh, no rewind past the aborted
+            # step (its rollback left params at the boundary)
+            from jax.sharding import NamedSharding
+            for name, p in params.items():
+                arr = p.data()
+                raw = arr._data if hasattr(arr, "_data") else arr
+                ns = NamedSharding(new_cfg.mesh,
+                                   new_cfg.param_spec(name, raw.shape))
+                p.set_data(jax.device_put(raw, ns))
+            resume = self._step_count
+            source = "memory"
+        else:
+            # irreplaceable shards died with the lost chips: slice them
+            # (and, for a consistent boundary, everything else) out of the
+            # newest sharded checkpoint whose full shard set verifies
+            arrays, meta = _ckpt.load_resharded(self._mesh_dir, shapes,
+                                                new_cfg)
+            for name, p in params.items():
+                p.set_data(arrays[name])
+            _ckpt.restore_trainer_states(self._mesh_dir, meta["step"],
+                                         self)
+            resume = int(meta["step"])
+            source = "checkpoint"
+            self._step_count = resume
+        self._mesh_cfg = new_cfg
+        profiler.record_event_stat("elastic.mesh_reshard")
+        profiler.record_counter("mesh", devices=budget,
+                                generation=info.get("gen") or 0)
+        raise MeshResharded(
+            "mesh resharded %s -> %s (%s-sourced recovery; resume at "
+            "step %d)" % (summary["old"], summary["new"], source, resume),
+            old=old_cfg, new=new_cfg, resume_step=resume, source=source,
+            plan=plan)
 
     def attach_preemption(self, ckpt_dir, params=None, extra=None,
                           grace_sec=None, install_signal=True):

@@ -33,15 +33,23 @@ class MembershipChanged(RuntimeError):
     the new generation (``gluon.Trainer.step`` does this automatically).
 
     Defined here (not in ``kvstore.dist``) so the trainer can catch it
-    without importing the socket transport for in-process stores."""
+    without importing the socket transport for in-process stores.
+
+    Besides rank identity, the event carries DEVICE identity (``devices``:
+    surviving rank → local device count, ``total_devices``: their sum) so
+    a mesh-sharded holder can rebuild a shrunk device mesh — elastic
+    recovery needs to know how many chips survive, not just how many
+    processes."""
 
     def __init__(self, msg, gen=None, num_workers=None, ranks=None,
-                 round=None):
+                 round=None, devices=None, total_devices=None):
         super().__init__(msg)
         self.gen = gen
         self.num_workers = num_workers
         self.ranks = ranks
         self.round = round
+        self.devices = devices
+        self.total_devices = total_devices
 
 
 class KVStoreBase:

@@ -187,6 +187,14 @@ def _env(name, default=None):
     return v if v is not None else default
 
 
+def _devmap(devices, ranks):
+    """Normalize a wire devices map (JSON headers stringify int keys) to
+    {int rank: int ndev}; missing entries default to 1 chip."""
+    devices = devices or {}
+    return {int(r): max(1, int(devices.get(str(r), devices.get(r, 1))))
+            for r in ranks}
+
+
 class _ConnDrop(Exception):
     """Raised inside a server handler to kill the connection without
     replying (fault injection: server.apply@drop — the ack-lost replay
@@ -242,6 +250,9 @@ class KVStoreDistServer:
         # stale generation get a typed membership_changed reply.  _target
         # is the live world size sync rounds/barriers wait for.
         self._members = {}
+        self._devices = {}   # rank -> local device (chip) count, from the
+        # register message: membership events must carry DEVICE identity,
+        # not just ranks, so mesh-sharded survivors can size the new mesh
         self._rejoin_ranks = set()   # ranks that joined mid-training
         self._generation = 0
         self._membership_dirty = False
@@ -391,10 +402,19 @@ class KVStoreDistServer:
         across keys (every key advances exactly once per sync step)."""
         return min(self.applied_round.values()) if self.applied_round else 0
 
+    def _devices_locked(self):
+        """Surviving rank → device count (unregistered expected ranks
+        count as 1 chip — the pre-census legacy assumption)."""
+        return {r: int(self._devices.get(r, 1))
+                for r in self._live_ranks_locked()}
+
     def _membership_reply_locked(self):
+        devices = self._devices_locked()
         return {"ok": False, "membership_changed": True,
                 "gen": self._generation, "num_workers": self._target,
                 "ranks": self._live_ranks_locked(),
+                "devices": devices,
+                "total_devices": sum(devices.values()),
                 "round": self._base_round_locked(),
                 "error": "membership changed: now generation %d with %d "
                          "live worker(s) %s — resync and replay the step"
@@ -442,6 +462,7 @@ class KVStoreDistServer:
         rank = int(msg["rank"])
         inc = str(msg.get("inc", ""))
         with self.cond:
+            self._devices[rank] = max(1, int(msg.get("ndev", 1)))
             cur = self._members.get(rank)
             if cur is None:
                 fill = (not self._membership_dirty
@@ -481,6 +502,7 @@ class KVStoreDistServer:
         with self.cond:
             if rank in self._members:
                 del self._members[rank]
+                self._devices.pop(rank, None)
                 self._membership_event_locked("leave")
             return {"ok": True, "gen": self._generation,
                     "num_workers": self._target}
@@ -492,6 +514,7 @@ class KVStoreDistServer:
         faults.trip("server.membership")
         for r in ranks:
             self._members.pop(r, None)
+            self._devices.pop(r, None)
         self._membership_event_locked("evict")
 
     def _barrier_group(self, store):
@@ -893,7 +916,7 @@ class KVStoreDist(KVStoreBase):
     analog); values pushed are first reduced in-process (ICI tier)."""
 
     def __init__(self, name="dist_sync", rank=None, num_workers=None,
-                 inc=None):
+                 inc=None, ndev=None):
         self._name = name
         self._sync = not name.endswith("async")
         # host dependency engine: pushes run async on engine workers with a
@@ -939,9 +962,16 @@ class KVStoreDist(KVStoreBase):
         # relaunched process registers as a rejoin (generation bump that
         # invalidates the dead incarnation's replay state).
         self._inc = str(inc) if inc is not None else str(os.getpid())
+        # device census: how many chips this worker drives (default: the
+        # DMLC_NDEV env, else 1).  Registered with the membership so a
+        # MembershipChanged names the surviving device budget — the input
+        # to ShardingConfig.shrink_to, not derivable from rank counts.
+        self._ndev = max(1, int(ndev if ndev is not None
+                                else _env("DMLC_NDEV", "1")))
         self._gens = [0] * self._num_servers  # per-shard membership gen
         self._num_workers_live = self._num_workers
         self._member_ranks = list(range(self._num_workers))
+        self._member_devices = {r: 1 for r in self._member_ranks}
         self._round_base = {}    # per-key applied-round watermark at
         # (re)registration: sync pulls wait relative to these
         self._boundary_round = 0  # server step boundary at registration
@@ -959,7 +989,8 @@ class KVStoreDist(KVStoreBase):
         boundary)."""
         replies = _grouped_requests(
             [(c, {"op": "register", "rank": self._rank, "inc": self._inc,
-                  "store": self._store_id, "seq": next(self._seq)})
+                  "ndev": self._ndev, "store": self._store_id,
+                  "seq": next(self._seq)})
              for c in self._conns])
         for i, r in enumerate(replies):
             if not r.get("ok"):
@@ -971,6 +1002,8 @@ class KVStoreDist(KVStoreBase):
                                      or self._num_workers)
         self._member_ranks = list(root.get("ranks")
                                   or range(self._num_workers))
+        self._member_devices = _devmap(root.get("devices"),
+                                       self._member_ranks)
         self._round_base = {k: int(v)
                             for k, v in (root.get("rounds") or {}).items()}
         self._boundary_round = int(root.get("round", 0))
@@ -984,10 +1017,14 @@ class KVStoreDist(KVStoreBase):
         engine's string-only error transport stays recognizable)."""
         if isinstance(r, dict) and r.get("membership_changed"):
             self._pending_membership = r
+            devices = _devmap(r.get("devices"), r.get("ranks") or ())
             raise MembershipChanged(
                 r.get("error") or "membership changed",
                 gen=r.get("gen"), num_workers=r.get("num_workers"),
-                ranks=r.get("ranks"), round=r.get("round"))
+                ranks=r.get("ranks"), round=r.get("round"),
+                devices=devices,
+                total_devices=r.get("total_devices",
+                                    sum(devices.values()) or None))
 
     def resync(self):
         """Adopt the server's current membership generation after a
@@ -1011,6 +1048,8 @@ class KVStoreDist(KVStoreBase):
         return {"gen": self._gens[0],
                 "num_workers": self._num_workers_live,
                 "ranks": self._member_ranks,
+                "devices": dict(self._member_devices),
+                "total_devices": sum(self._member_devices.values()),
                 "round": self._boundary_round,
                 "rejoin": self._rejoined, "status": root}
 
@@ -1050,6 +1089,18 @@ class KVStoreDist(KVStoreBase):
         """Live world size under the current membership generation (the
         configured launch size stays in ``num_workers``)."""
         return self._num_workers_live
+
+    @property
+    def member_devices(self):
+        """{rank: local device count} under the current membership
+        generation (from each worker's register census)."""
+        return dict(self._member_devices)
+
+    @property
+    def num_devices_live(self):
+        """Total surviving chips — the device budget
+        ShardingConfig.shrink_to sizes the recovery mesh from."""
+        return sum(self._member_devices.values()) or self._num_workers_live
 
     @property
     def rejoined(self):

@@ -27,12 +27,13 @@ from .. import autograd
 from .._rng import trace_keys
 from ..ndarray import ndarray, _wrap_value
 from .shardcfg import (ShardingConfig, ShardingRule, make_mesh,
-                       collective_census, census_fn)
+                       collective_census, census_fn, MeshShrinkError,
+                       reshard_plan, shard_slabs)
 
 __all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "functionalize",
            "DataParallelTrainer", "replicate", "shard_batch",
            "ShardingConfig", "ShardingRule", "collective_census",
-           "census_fn"]
+           "census_fn", "MeshShrinkError", "reshard_plan", "shard_slabs"]
 
 
 def functionalize(net, train=False):
@@ -289,8 +290,33 @@ class DataParallelTrainer:
         for k, p in self._params.items():
             p._data._set_data(state["params"][k])
 
+    def reshard(self, sharding, state):
+        """Adopt a new (typically shrunk-after-chip-loss) ShardingConfig:
+        re-place every state leaf onto the new mesh and drop the compiled
+        step so the next call rebuilds against the new config — the fresh
+        program traces under the new sharding token, so a stale program
+        with the old mesh's collectives can never run (the
+        collective_census gate on the resharded step checks exactly
+        this).  Returns the re-placed state."""
+        shard_of = sharding.param_sharding
+        pvals = {k: jax.device_put(v, shard_of(k, v.shape))
+                 for k, v in state["params"].items()}
+        slots = {}
+        for k, s in state["slots"].items():
+            if isinstance(s, tuple):
+                slots[k] = tuple(jax.device_put(x, shard_of(k, x.shape))
+                                 for x in s)
+            else:
+                slots[k] = jax.device_put(s, shard_of(k, s.shape))
+        t = jax.device_put(state["t"], NamedSharding(sharding.mesh, P()))
+        self.sharding = sharding
+        self.mesh = sharding.mesh
+        self._step = None
+        return {"params": pvals, "slots": slots, "t": t}
+
 from .checkpoint import (  # noqa: F401,E402
     save_checkpoint, load_checkpoint, wait_for_saves, list_steps,
-    latest_step, verify_checkpoint, resume_training)
+    latest_step, verify_checkpoint, resume_training, load_resharded,
+    restore_trainer_states)
 from .pipeline import PipelineRunner, pipeline_apply  # noqa: F401,E402
 from .moe import MoELayer  # noqa: F401,E402

@@ -32,6 +32,21 @@ path's var, re-raising any async save failure.  The writer carries the
 ``checkpoint.write`` fault-injection site (kinds: ``torn`` tears the npz
 payload, ``error``/``crash`` fail the write) for deterministic
 crash-consistency tests.
+
+Elastic mesh recovery adds a *sharded* layout (manifest ``format: 2``):
+``save_checkpoint(..., sharding=cfg)`` writes one npz per owning device
+slot holding the slabs that device is the first replica of (replicated
+slabs land on disk exactly once), and the manifest records
+``ShardingConfig.to_dict()`` plus every slab's [start, stop) box and
+crc32 — so a reader under ANY mesh knows which slices it needs.
+``load_resharded`` is that slice-on-read path: given a (possibly
+different, e.g. shrunk-after-chip-loss) ShardingConfig, it reads only
+the shard files whose recorded boxes overlap each device's slices.
+Per-shard CRCs verify independently; a missing/torn shard invalidates
+the whole step and the loader falls back to the newest step whose full
+shard set verifies.  The read side carries the ``checkpoint.shard_read``
+fault site (``torn`` reads as a corrupt shard → fallback; ``error``/
+``timeout`` surface to the caller).
 """
 from __future__ import annotations
 
@@ -54,7 +69,7 @@ from ..ndarray import ndarray
 
 __all__ = ["save_checkpoint", "load_checkpoint", "wait_for_saves",
            "list_steps", "latest_step", "verify_checkpoint",
-           "resume_training"]
+           "resume_training", "load_resharded", "restore_trainer_states"]
 
 _save_vars = {}  # abspath -> engine var (write-ordered saves per path)
 _save_lock = threading.Lock()
@@ -62,6 +77,7 @@ _save_lock = threading.Lock()
 _MANIFEST_RE = re.compile(r"^step_(\d+)\.manifest\.json$")
 _NPZ_RE = re.compile(r"^step_(\d+)\.npz$")
 _DIR_RE = re.compile(r"^step_(\d+)$")
+_SHARD_RE = re.compile(r"^step_(\d+)\.shard_(\d+)\.npz$")
 
 
 def _path_var(path):
@@ -208,7 +224,7 @@ def _trainer_states_blob(trainer):
 # save
 # ---------------------------------------------------------------------------
 def save_checkpoint(path, params, step=0, trainer=None, extra=None,
-                    keep=None):
+                    keep=None, sharding=None):
     """Write a (possibly sharded) checkpoint.
 
     params: dict of name → Parameter/ndarray/jax.Array (sharded arrays
@@ -219,6 +235,10 @@ def save_checkpoint(path, params, step=0, trainer=None, extra=None,
     step's manifest.
     keep: retain only the newest `keep` steps after a successful write
     (default: MXNET_CKPT_KEEP; 0/None = keep everything).
+    sharding: optional ShardingConfig — write the format-2 sharded
+    layout (one npz per owning device slot + a manifest carrying the
+    full sharding dict and per-slab boxes/CRCs) instead of a monolithic
+    npz, so `load_resharded` can slice-on-read under a different mesh.
     """
     path = os.path.abspath(path)
     step = int(step)
@@ -229,6 +249,7 @@ def save_checkpoint(path, params, step=0, trainer=None, extra=None,
     extra = dict(extra) if extra else {}
     if keep is None:
         keep = int(_config.get("MXNET_CKPT_KEEP")) or 0
+    cfg_dict = sharding.to_dict() if sharding is not None else None
     eng, var = _path_var(path)
 
     def write():
@@ -238,6 +259,12 @@ def save_checkpoint(path, params, step=0, trainer=None, extra=None,
         # exception kinds abort the write (the engine var is poisoned and
         # the failure surfaces at wait_for_saves/load_checkpoint)
         kind = faults.check("checkpoint.write")
+        if sharding is not None:
+            _write_sharded(path, step, tree, sharding, cfg_dict, extra,
+                           states_blob, kind)
+            if keep:
+                _prune(path, keep)
+            return
         manifest = {"format": 1, "step": step, "backend": backend,
                     "extra": extra}
         if backend == "orbax":
@@ -302,6 +329,75 @@ def save_checkpoint(path, params, step=0, trainer=None, extra=None,
     return path
 
 
+def _spec_json(spec):
+    """PartitionSpec → JSON-able per-dim list (None | axis | [axes])."""
+    out = []
+    for p in tuple(spec):
+        if p is None or isinstance(p, str):
+            out.append(p)
+        else:
+            out.append(list(p))
+    return out
+
+
+def _write_sharded(path, step, tree, cfg, cfg_dict, extra, states_blob,
+                   kind):
+    """Format-2 writer: one npz per owning device slot, each holding the
+    slabs that device is the FIRST replica of (replicated slabs land on
+    disk exactly once), plus a manifest recording the sharding dict and
+    every slab's [start, stop) box and crc32.  'torn' tears the last
+    shard file written — the manifest keeps the true checksums, so the
+    step fails verification and the loader falls back a step."""
+    from jax.sharding import NamedSharding
+    from .shardcfg import shard_slabs
+    mesh = cfg.mesh
+    linear = {d.id: i for i, d in enumerate(mesh.devices.flat)}
+    owner_slabs = {}   # owner slot -> {name: np slab}
+    man_arrays = {}
+    for name, v in tree.items():
+        arr = onp.asarray(v)
+        spec = cfg.param_spec(name, arr.shape)
+        slabs = shard_slabs(NamedSharding(mesh, spec), arr.shape)
+        shards = []
+        for key in sorted(slabs):
+            idx, devs = slabs[key]
+            owner = min(linear[d.id] for d in devs)
+            slab = onp.ascontiguousarray(arr[idx])
+            owner_slabs.setdefault(owner, {})[name] = slab
+            shards.append({"file": "step_%d.shard_%d.npz" % (step, owner),
+                           "start": [a for a, _ in key],
+                           "stop": [b for _, b in key],
+                           "crc32": _crc(slab)})
+        man_arrays[name] = {"shape": list(arr.shape),
+                            "dtype": arr.dtype.str,
+                            "spec": _spec_json(spec),
+                            "shards": shards}
+    owners = sorted(owner_slabs)
+    for j, owner in enumerate(owners):
+        buf = io.BytesIO()
+        onp.savez(buf, **owner_slabs[owner])
+        data = buf.getvalue()
+        final = os.path.join(path, "step_%d.shard_%d.npz" % (step, owner))
+        if kind == "torn" and j == len(owners) - 1:
+            with open(final, "wb") as f:  # mid-write kill: half the bytes
+                f.write(data[:max(1, len(data) // 2)])
+        else:
+            _atomic_write(final, data)
+    manifest = {"format": 2, "step": step, "backend": "npz",
+                "extra": extra, "sharding": cfg_dict,
+                "arrays": man_arrays,
+                "shard_files": ["step_%d.shard_%d.npz" % (step, o)
+                                for o in owners]}
+    if states_blob is not None:
+        states_name = "step_%d.states" % step
+        _atomic_write(os.path.join(path, states_name), states_blob)
+        manifest["states"] = states_name
+        manifest["states_crc32"] = zlib.crc32(states_blob) & 0xFFFFFFFF
+    _atomic_write(_manifest_path(path, step),
+                  json.dumps(manifest, indent=1).encode())
+    _fsync_dir(path)
+
+
 def _prune(path, keep):
     """Drop everything but the newest `keep` steps (manifest first, so a
     crash mid-prune can't leave a manifest pointing at deleted data)."""
@@ -316,6 +412,16 @@ def _prune(path, keep):
                 os.remove(os.path.join(path, name))
             except OSError:
                 pass
+        try:
+            for n in os.listdir(path):
+                m = _SHARD_RE.match(n)
+                if m and int(m.group(1)) == s:
+                    try:
+                        os.remove(os.path.join(path, n))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
         step_dir = os.path.join(path, "step_%d" % s)
         if os.path.isdir(step_dir):
             import shutil
@@ -365,6 +471,9 @@ def verify_checkpoint(path, step):
             except Exception as e:
                 return False, ["legacy npz unreadable: %s" % e]
         return False, ["no data for step %d" % step]
+    if man.get("format") == 2:
+        problems = _verify_sharded(path, man) + _verify_states(path, man)
+        return not problems, problems
     data_name = man.get("data")
     data_path = os.path.join(path, data_name) if data_name else None
     if data_path is None or not os.path.exists(data_path):
@@ -381,6 +490,12 @@ def verify_checkpoint(path, step):
                         problems.append("array %r checksum mismatch" % k)
         except Exception as e:
             problems.append("npz unreadable: %s" % e)
+    problems += _verify_states(path, man)
+    return not problems, problems
+
+
+def _verify_states(path, man):
+    problems = []
     states = man.get("states")
     if states:
         sp = os.path.join(path, states)
@@ -392,12 +507,59 @@ def verify_checkpoint(path, step):
                 problems.append("optimizer states checksum mismatch")
         except OSError as e:
             problems.append("states file unreadable: %s" % e)
-    return not problems, problems
+    return problems
 
 
-def _resolve_step(path, step):
+def _verify_sharded(path, man):
+    """Per-shard verification: every slab of every array is checked
+    independently (file present, slab present, box shape, crc32), so a
+    single torn shard names itself precisely — and invalidates the whole
+    step (a partially-recoverable step must not be resumed from)."""
+    problems = []
+    cache = {}
+    try:
+        for name, meta in (man.get("arrays") or {}).items():
+            for sh in meta.get("shards", ()):
+                fname = sh.get("file", "")
+                npz = cache.get(fname)
+                if npz is None:
+                    try:
+                        npz = onp.load(os.path.join(path, fname))
+                    except Exception as e:
+                        npz = e
+                    cache[fname] = npz
+                if isinstance(npz, Exception):
+                    problems.append("shard %r unreadable: %s"
+                                    % (fname, npz))
+                    continue
+                if name not in npz.files:
+                    problems.append("shard %r missing slab %r"
+                                    % (fname, name))
+                    continue
+                try:
+                    slab = npz[name]
+                except Exception as e:
+                    problems.append("shard %r slab %r unreadable: %s"
+                                    % (fname, name, e))
+                    continue
+                box = [b - a for a, b in zip(sh["start"], sh["stop"])]
+                if list(slab.shape) != box:
+                    problems.append("shard %r slab %r shape %s != box %s"
+                                    % (fname, name, list(slab.shape),
+                                       box))
+                elif _crc(slab) != sh.get("crc32"):
+                    problems.append("shard %r slab %r checksum mismatch"
+                                    % (fname, name))
+    finally:
+        _close_cache(cache)
+    return problems
+
+
+def _resolve_step(path, step, exclude=()):
     """Pick the step to load: the requested one if valid, else the newest
-    valid one (with a warning).  step=None/'latest'/-1 → newest valid."""
+    valid one (with a warning).  step=None/'latest'/-1 → newest valid.
+    exclude: steps already proven unreadable (shard-read fallback) —
+    skipped without re-verification."""
     explicit = step is not None and step != "latest" and int(step) >= 0
     steps = list_steps(path)
     order = []
@@ -407,24 +569,28 @@ def _resolve_step(path, step):
                           if s != step]
     else:
         order = sorted(steps, reverse=True)
+    order = [s for s in order if s not in exclude]
     for s in order:
         ok, problems = verify_checkpoint(path, s)
         if ok:
             if explicit and s != step:
+                if step in exclude:
+                    reason = "unreadable (shard read failed)"
+                elif step not in steps:
+                    reason = "missing"
+                else:
+                    reason = "corrupt (%s)" % "; ".join(
+                        verify_checkpoint(path, step)[1])
                 warnings.warn(
                     "checkpoint step %d at %s is %s; falling back to "
-                    "newest valid step %d"
-                    % (step, path,
-                       "missing" if step not in steps else "corrupt "
-                       "(%s)" % "; ".join(
-                           verify_checkpoint(path, step)[1]), s))
+                    "newest valid step %d" % (step, path, reason, s))
                 from .. import profiler
                 profiler.record_event_stat("checkpoint.fallback")
             return s
         if explicit and s == step:
             from .. import profiler
             profiler.record_event_stat("checkpoint.invalid")
-    if explicit:
+    if explicit and step not in exclude:
         raise FileNotFoundError("no checkpoint at %s (step %d)"
                                 % (path, step))
     raise FileNotFoundError("no valid checkpoint at %s" % path)
@@ -441,10 +607,94 @@ def latest_step(path):
 # ---------------------------------------------------------------------------
 # load / resume
 # ---------------------------------------------------------------------------
+class _ShardCorrupt(OSError):
+    """A format-2 shard read failed (missing/torn/CRC mismatch): the
+    loader excludes this step and falls back to an older one."""
+
+
+def _close_cache(cache):
+    for npz in cache.values():
+        if hasattr(npz, "close"):
+            try:
+                npz.close()
+            except Exception:
+                pass
+
+
+def _shard_slab(path, sh, name, cache):
+    """One slab off disk, fault-checked and CRC-verified: a torn write
+    that slipped past verification — or an injected torn read — surfaces
+    here as _ShardCorrupt, never as silent garbage."""
+    kind = faults.check("checkpoint.shard_read")
+    fname = sh.get("file", "")
+    if kind == "torn":
+        raise _ShardCorrupt("injected torn read of shard %r" % fname)
+    npz = cache.get(fname)
+    if npz is None:
+        try:
+            npz = onp.load(os.path.join(path, fname))
+        except Exception as e:
+            raise _ShardCorrupt("shard %r unreadable: %s"
+                                % (fname, e)) from e
+        cache[fname] = npz
+    try:
+        slab = npz[name]
+    except Exception as e:
+        raise _ShardCorrupt("shard %r slab %r unreadable: %s"
+                            % (fname, name, e)) from e
+    if sh.get("crc32") is not None and _crc(slab) != sh["crc32"]:
+        raise _ShardCorrupt("shard %r slab %r checksum mismatch"
+                            % (fname, name))
+    return slab
+
+
+def _read_slice(path, man, name, starts, stops, cache):
+    """Slice-on-read: materialize [starts, stops) of one array from a
+    format-2 checkpoint, touching only the shard files whose recorded
+    boxes overlap the request."""
+    meta = (man.get("arrays") or {}).get(name)
+    if meta is None:
+        raise KeyError("sharded checkpoint missing %r" % name)
+    out = onp.empty([b - a for a, b in zip(starts, stops)],
+                    dtype=onp.dtype(meta["dtype"]))
+    filled = 0
+    for sh in meta.get("shards", ()):
+        lo = [max(a, c) for a, c in zip(starts, sh["start"])]
+        hi = [min(b, d) for b, d in zip(stops, sh["stop"])]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        slab = _shard_slab(path, sh, name, cache)
+        src = tuple(slice(l - c, h - c)
+                    for l, h, c in zip(lo, hi, sh["start"]))
+        dst = tuple(slice(l - a, h - a)
+                    for l, h, a in zip(lo, hi, starts))
+        out[dst] = slab[src]
+        n = 1
+        for l, h in zip(lo, hi):
+            n *= h - l
+        filled += n
+    if filled != out.size:
+        raise _ShardCorrupt(
+            "sharded checkpoint covers only %d of %d elements of %r "
+            "[%s:%s] — incomplete manifest" % (filled, out.size, name,
+                                               starts, stops))
+    return out
+
+
 def _read_step(path, step, params):
     """Materialize step's arrays as {name: array}.  Raises OSError (incl.
     FileNotFoundError) if the step's files vanish mid-read — the caller
     treats that as a concurrent ``keep=N`` prune and re-resolves."""
+    man = _read_manifest(path, step)
+    if man is not None and man.get("format") == 2:
+        cache = {}
+        try:
+            return {name: _read_slice(path, man, name,
+                                      [0] * len(meta["shape"]),
+                                      list(meta["shape"]), cache)
+                    for name, meta in (man.get("arrays") or {}).items()}
+        finally:
+            _close_cache(cache)
     ocp_dir = os.path.join(path, "step_%d" % step)
     npz = os.path.join(path, "step_%d.npz" % step)
     if os.path.isdir(ocp_dir):
@@ -468,35 +718,37 @@ def _read_step(path, step, params):
     raise FileNotFoundError("no checkpoint at %s (step %d)" % (path, step))
 
 
-def load_checkpoint(path, params, step=0):
-    """Restore into params (dict of name → Parameter/ndarray) in place;
-    sharded arrays are restored with their target sharding.
-
-    step: an int (that step, falling back to the newest valid one with a
-    warning if it is corrupt or missing), or None/'latest' for the
-    newest valid step.
-
-    Concurrency: safe against a concurrent ``save_checkpoint(keep=N)``
-    prune — a step whose files vanish between verification and the read
-    (the prune removes its manifest FIRST, so it stops being listed) is
-    re-resolved instead of surfacing a FileNotFoundError."""
-    path = os.path.abspath(path)
-    wait_for_saves(path)  # pending async writes to this path land first
-    requested = step
+def _load_arrays(path, requested, params):
+    """Resolve + read with fallback.  A step whose shard read fails
+    (_ShardCorrupt: torn/missing/CRC-mismatched shard, or an injected
+    torn read) is excluded and the newest step whose FULL shard set
+    verifies is tried next; a step whose files vanish mid-read
+    (concurrent ``keep=N`` prune) is re-resolved.  Returns
+    (step, {name: array})."""
+    bad = set()
     last_exc = None
-    for _attempt in range(4):
-        step = _resolve_step(path, requested)
+    for _attempt in range(6):
+        step = _resolve_step(path, requested, exclude=bad)
         try:
-            loaded = _read_step(path, step, params)
-            break
+            return step, _read_step(path, step, params)
+        except _ShardCorrupt as e:
+            bad.add(step)
+            last_exc = e
+            warnings.warn("checkpoint step %d at %s failed its shard "
+                          "read (%s); falling back" % (step, path, e))
+            from .. import profiler
+            profiler.record_event_stat("checkpoint.shard_fallback")
         except OSError as e:  # pruned between verify and read
             last_exc = e
             from .. import profiler
             profiler.record_event_stat("checkpoint.prune_race")
-    else:
-        raise FileNotFoundError(
-            "checkpoint at %s kept vanishing mid-load (concurrent "
-            "retention prune?): %s" % (path, last_exc)) from last_exc
+    raise FileNotFoundError(
+        "checkpoint at %s kept failing mid-load (torn shards or a "
+        "concurrent retention prune?): %s"
+        % (path, last_exc)) from last_exc
+
+
+def _apply_loaded(params, loaded):
     import jax.numpy as jnp
     for k, v in params.items():
         if k not in loaded:
@@ -508,7 +760,111 @@ def load_checkpoint(path, params, step=0):
             v._data._set_data(new)
         elif isinstance(v, ndarray):
             v._set_data(new)
+
+
+def load_checkpoint(path, params, step=0):
+    """Restore into params (dict of name → Parameter/ndarray) in place;
+    sharded arrays are restored with their target sharding.  Format-2
+    (sharded) steps are reassembled from their shard files; to restore
+    under a different mesh without materializing full arrays, use
+    `load_resharded`.
+
+    step: an int (that step, falling back to the newest valid one with a
+    warning if it is corrupt or missing), or None/'latest' for the
+    newest valid step.
+
+    Concurrency: safe against a concurrent ``save_checkpoint(keep=N)``
+    prune — a step whose files vanish between verification and the read
+    (the prune removes its manifest FIRST, so it stops being listed) is
+    re-resolved instead of surfacing a FileNotFoundError."""
+    path = os.path.abspath(path)
+    wait_for_saves(path)  # pending async writes to this path land first
+    _s, loaded = _load_arrays(path, step, params)
+    _apply_loaded(params, loaded)
     return params
+
+
+def load_resharded(path, shapes, sharding, step=None):
+    """Slice-on-read restore under ANY mesh — the elastic-recovery path.
+
+    shapes: {name: global shape} of the arrays wanted.
+    sharding: the ShardingConfig of the CURRENT (possibly shrunk) mesh.
+    Each array comes back as a jax.Array placed with
+    ``NamedSharding(sharding.mesh, sharding.param_spec(name, shape))``,
+    and only the shard files whose manifest boxes (recorded under the
+    WRITER's mesh) overlap this host's slices are read off disk.
+
+    Returns ``({name: jax.Array}, {"step", "extra", "sharding"})``,
+    where "sharding" is the writer's ``ShardingConfig.to_dict()``.  A
+    step whose shard set fails to read falls back to the newest step
+    whose full shard set verifies, like `load_checkpoint`."""
+    from jax.sharding import NamedSharding
+    path = os.path.abspath(path)
+    wait_for_saves(path)
+    mesh = sharding.mesh
+    bad = set()
+    last_exc = None
+    for _attempt in range(6):
+        s = _resolve_step(path, step, exclude=bad)
+        man = _read_manifest(path, s)
+        if man is None or man.get("format") != 2:
+            raise ValueError(
+                "checkpoint step %s at %s is not a sharded (format-2) "
+                "checkpoint; write it with save_checkpoint(..., "
+                "sharding=cfg)" % (s, path))
+        cache = {}
+        try:
+            out = {}
+            for name, shape in shapes.items():
+                shape = tuple(int(x) for x in shape)
+                ns = NamedSharding(mesh, sharding.param_spec(name, shape))
+
+                def read_cb(idx, _name=name, _shape=shape):
+                    starts = [0 if sl.start is None else int(sl.start)
+                              for sl in idx]
+                    stops = [int(_shape[d]) if sl.stop is None
+                             else int(sl.stop)
+                             for d, sl in enumerate(idx)]
+                    return _read_slice(path, man, _name, starts, stops,
+                                       cache)
+
+                out[name] = jax.make_array_from_callback(shape, ns,
+                                                         read_cb)
+            return out, {"step": s, "extra": man.get("extra") or {},
+                         "sharding": man.get("sharding")}
+        except _ShardCorrupt as e:
+            bad.add(s)
+            last_exc = e
+            warnings.warn("checkpoint step %d at %s failed its shard "
+                          "read (%s); falling back" % (s, path, e))
+            from .. import profiler
+            profiler.record_event_stat("checkpoint.shard_fallback")
+        finally:
+            _close_cache(cache)
+    raise FileNotFoundError(
+        "no sharded checkpoint at %s readable under the current mesh: %s"
+        % (path, last_exc)) from last_exc
+
+
+def restore_trainer_states(path, step, trainer):
+    """Re-attach the optimizer state saved at `step` to `trainer` — the
+    states half of `resume_training`, for callers that restored the
+    arrays another way (e.g. `load_resharded` under a shrunk mesh).
+    Returns False when the step carries no states blob."""
+    path = os.path.abspath(path)
+    man = _read_manifest(path, int(step)) or {}
+    if not man.get("states"):
+        return False
+    with open(os.path.join(path, man["states"]), "rb") as f:
+        blob = f.read()
+    from ..optimizer import Updater
+    u = Updater(trainer._optimizer)
+    u.set_states(blob)
+    trainer._states = u.states
+    trainer._optimizer = u.optimizer
+    trainer._optimizer.param_dict = {
+        i: p for i, p in enumerate(trainer._params)}
+    return True
 
 
 def resume_training(path, params, trainer=None, step=None):
@@ -520,11 +876,10 @@ def resume_training(path, params, trainer=None, step=None):
     path = os.path.abspath(path)
     wait_for_saves(path)
     for _attempt in range(4):
-        s = _resolve_step(path, step)
+        s, loaded = _load_arrays(path, step, params)
+        man = _read_manifest(path, s) or {}
+        blob = None
         try:
-            load_checkpoint(path, params, step=s)
-            man = _read_manifest(path, s) or {}
-            blob = None
             if trainer is not None and man.get("states"):
                 with open(os.path.join(path, man["states"]), "rb") as f:
                     blob = f.read()
@@ -536,6 +891,7 @@ def resume_training(path, params, trainer=None, step=None):
         raise FileNotFoundError(
             "checkpoint at %s kept vanishing mid-resume (concurrent "
             "retention prune?)" % path)
+    _apply_loaded(params, loaded)
     if blob is not None:
         from ..optimizer import Updater
         u = Updater(trainer._optimizer)

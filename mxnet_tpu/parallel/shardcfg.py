@@ -45,7 +45,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ShardingConfig", "make_mesh", "current", "active_token",
-           "maybe_constrain_nd", "collective_census", "MESH_AXES"]
+           "maybe_constrain_nd", "collective_census", "MESH_AXES",
+           "MeshShrinkError", "reshard_plan", "shard_slabs"]
 
 #: canonical axis vocabulary (any subset, any order, may appear size-1)
 MESH_AXES = ("dp", "tp", "sp", "pp", "ep")
@@ -91,6 +92,23 @@ def make_mesh(shape=None, axis_names=("dp",), devices=None):
                need))
     arr = onp.array(devices[:need]).reshape(shape)
     return Mesh(arr, axis_names)
+
+
+class MeshShrinkError(ValueError):
+    """No valid mesh factoring exists for the surviving device count.
+
+    Extends the PR-9 non-factoring ValueError contract: the message names
+    BOTH geometries (the old mesh and the surviving device count) so an
+    operator can see at a glance why the shrink ladder bottomed out.
+    Carries ``old_shape``/``axis_names``/``n_devices`` for programmatic
+    handling (the elastic trainer surfaces it unrecovered)."""
+
+    def __init__(self, msg, old_shape=None, axis_names=None,
+                 n_devices=None):
+        super().__init__(msg)
+        self.old_shape = tuple(old_shape) if old_shape else None
+        self.axis_names = tuple(axis_names) if axis_names else None
+        self.n_devices = n_devices
 
 
 # ---------------------------------------------------------------------------
@@ -240,9 +258,16 @@ class ShardingConfig:
         return self._mesh
 
     def axis_size(self, name):
-        """Size of a mesh axis, 1 when the mesh does not carry it."""
+        """Size of a mesh axis, 1 when the mesh does not carry it.
+
+        Resolved from the declared ``mesh_shape`` when the mesh itself was
+        never built — a config deserialized from checkpoint metadata must
+        answer spec-resolution questions on hosts that can't materialize
+        the writer's mesh (slice-on-read under a shrunken device set)."""
         if name not in self.axis_names:
             return 1
+        if self._mesh is None and self.mesh_shape is not None:
+            return int(self.mesh_shape[self.axis_names.index(name)])
         return int(self.mesh.shape[name])
 
     @property
@@ -399,6 +424,98 @@ class ShardingConfig:
                    data_axis=d.get("data_axis"),
                    devices=devices)
 
+    # -- elastic resharding (membership change) -----------------------------
+    def shrink_to(self, devices):
+        """Re-factor this config's mesh onto a smaller device set.
+
+        ``devices`` is the surviving device list (or a bare count; a list
+        also pins the new mesh to exactly those devices).  The shrink
+        ladder, in order:
+
+        1. **dp-first**: every non-dp axis keeps its size and dp absorbs
+           the loss (dp' = n // prod(other axes)) — a lost dp row costs
+           throughput, never layout.
+        2. **tp refactor**: when dp can't absorb it, tp shrinks to the
+           largest divisor of the old tp size that still factors the
+           surviving count (each new tp shard is a whole union of old
+           shards) — loud warning.
+        3. **replicated fallback**: tp'=1 (every tp rule resolves away) —
+           louder warning.  Gated by MXNET_MESH_TP_FALLBACK; disabled, the
+           ladder stops at step 1.
+
+        Raises :class:`MeshShrinkError` naming both geometries when no
+        rung fits (e.g. a prime survivor count under sp>1).  The returned
+        config shares rules/constraints/data_axis — specs re-resolve
+        against the new mesh through the existing drop/replicate rules, so
+        the SAME rule list lays out params under any rung of the ladder.
+        """
+        from .. import config as _config
+        if isinstance(devices, int):
+            dev_list, n = None, int(devices)
+        else:
+            dev_list = list(devices)
+            n = len(dev_list)
+        old_shape = tuple(self.mesh_shape or ())
+        if not old_shape:  # lazy config never materialized: force it
+            old_shape = tuple(self.mesh.devices.shape)
+        names = self.axis_names
+        if n < 1:
+            raise MeshShrinkError(
+                "shrink_to: no surviving devices (old mesh %s)"
+                % self.describe(), old_shape, names, n)
+        sizes = dict(zip(names, old_shape))
+        dp_ax = "dp" if "dp" in sizes else names[0]
+        non_dp = 1
+        for a, s in sizes.items():
+            if a != dp_ax:
+                non_dp *= s
+        new_sizes = None
+        if n % non_dp == 0:
+            new_sizes = dict(sizes)
+            new_sizes[dp_ax] = n // non_dp  # rung 1: dp absorbs the loss
+        elif "tp" in sizes and sizes["tp"] > 1 \
+                and bool(_config.get("MXNET_MESH_TP_FALLBACK")):
+            rest = non_dp // sizes["tp"]  # sp/pp/ep must survive intact
+            if n % rest == 0:
+                budget = n // rest
+                old_tp = sizes["tp"]
+                tp2 = 1
+                for cand in range(old_tp, 0, -1):
+                    if old_tp % cand == 0 and budget % cand == 0:
+                        tp2 = cand
+                        break
+                new_sizes = dict(sizes)
+                new_sizes["tp"] = tp2
+                new_sizes[dp_ax] = budget // tp2
+                import warnings
+                if tp2 == 1:
+                    warnings.warn(
+                        "shrink_to: %d surviving device(s) admit no tp>1 "
+                        "factoring of mesh %s — tensor-parallel params "
+                        "fall back to REPLICATED (tp rules resolve away); "
+                        "expect higher per-device memory"
+                        % (n, self.describe()))
+                else:
+                    warnings.warn(
+                        "shrink_to: mesh %s re-factored to tp=%d over %d "
+                        "surviving device(s) (dp-first shrink did not "
+                        "divide)" % (self.describe(), tp2, n))
+        if new_sizes is None:
+            raise MeshShrinkError(
+                "shrink_to: cannot factor %d surviving device(s) into "
+                "mesh %s (axes %s): the non-dp extent %d does not divide "
+                "%d%s" % (n, self.describe(), ",".join(names), non_dp, n,
+                          "" if bool(_config.get("MXNET_MESH_TP_FALLBACK"))
+                          else " and MXNET_MESH_TP_FALLBACK=0 forbids the "
+                               "tp refactor/replicated rungs"),
+                old_shape, names, n)
+        new_shape = tuple(new_sizes[a] for a in names)
+        return ShardingConfig(
+            mesh_shape=new_shape, axis_names=names, rules=list(self.rules),
+            param_fn=self.param_fn,
+            constraints={k: tuple(v) for k, v in self.constraints.items()},
+            data_axis=self.data_axis, devices=dev_list)
+
     # -- constructors -------------------------------------------------------
     @classmethod
     def from_env(cls, devices=None, **kw):
@@ -440,6 +557,87 @@ class ShardingConfig:
         ]
         return cls(mesh=mesh, mesh_shape=mesh_shape, axis_names=axis_names,
                    rules=rules, devices=devices, **kw)
+
+
+# ---------------------------------------------------------------------------
+# elastic resharding: slab geometry + recovery plan
+# ---------------------------------------------------------------------------
+def shard_slabs(sharding, shape):
+    """Distinct shard slabs of an array under a NamedSharding.
+
+    Returns ``{slab_key: (slices, [devices])}`` where ``slab_key`` is a
+    hashable ``((start, stop), ...)`` per dim (None bounds resolved to the
+    full extent) and the device list holds every replica of that slab.
+    GSPMD shards form a regular grid, so the slabs partition the array.
+    """
+    out = {}
+    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+        key = tuple(
+            (0 if s.start is None else int(s.start),
+             int(shape[d]) if s.stop is None else int(s.stop))
+            for d, s in enumerate(idx))
+        if key in out:
+            out[key][1].append(dev)
+        else:
+            out[key] = (idx, [dev])
+    return out
+
+
+def reshard_plan(old_cfg, new_cfg, shapes, lost_devices=()):
+    """Per-array recovery plan for a mesh membership change.
+
+    ``old_cfg`` is the layout state was written/held under (typically
+    ``ShardingConfig.from_dict`` of checkpoint metadata), ``new_cfg`` the
+    survivors' shrunken config, ``shapes`` a ``{name: shape}`` dict and
+    ``lost_devices`` the devices (or device ids) that left the mesh.
+
+    Each entry records the old/new resolved specs and a recovery
+    ``source``:
+
+    - ``"memory"``: every distinct slab of the old placement still has at
+      least one replica on a surviving device — survivors re-place the
+      live array (peer copy; on a multi-host mesh this is a gather from
+      surviving peers).
+    - ``"checkpoint"``: some slab lived ONLY on lost devices — the slices
+      must come from the newest crash-safe sharded checkpoint.
+
+    When the old mesh can no longer be constructed over the surviving
+    process (fewer local devices than the old mesh needs), every array
+    conservatively plans ``"checkpoint"`` — correctness never depends on
+    reading a shard that might be gone.
+    """
+    lost = {getattr(d, "id", d) for d in lost_devices}
+    old_shardings = None
+    try:
+        mesh = old_cfg.mesh  # may raise: old geometry needs gone devices
+        old_shardings = lambda name, shape: NamedSharding(  # noqa: E731
+            mesh, old_cfg.param_spec(name, shape))
+    except ValueError:
+        pass
+    plan = {}
+    n_mem = n_ckpt = 0
+    for name, shape in shapes.items():
+        shape = tuple(int(s) for s in shape)
+        old_spec = old_cfg.param_spec(name, shape)
+        new_spec = new_cfg.param_spec(name, shape)
+        source = "checkpoint"
+        if old_shardings is not None:
+            source = "memory"
+            slabs = shard_slabs(old_shardings(name, shape), shape)
+            for _key, (_idx, devs) in slabs.items():
+                if all(getattr(d, "id", d) in lost for d in devs):
+                    source = "checkpoint"  # slab only lost replicas held
+                    break
+        plan[name] = {"old_spec": old_spec, "new_spec": new_spec,
+                      "source": source, "moved": old_spec != new_spec}
+        if source == "memory":
+            n_mem += 1
+        else:
+            n_ckpt += 1
+    plan["__summary__"] = {"memory": n_mem, "checkpoint": n_ckpt,
+                           "old": old_cfg.describe(),
+                           "new": new_cfg.describe()}
+    return plan
 
 
 # ---------------------------------------------------------------------------

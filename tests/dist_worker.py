@@ -16,9 +16,80 @@ from mxnet_tpu import np as mxnp, autograd, gluon  # noqa: E402
 from mxnet_tpu.gluon import nn  # noqa: E402
 
 
+def _mesh_shape():
+    return tuple(int(x) for x in
+                 os.environ.get("MESH_SHAPE", "4,2").split(","))
+
+
+def _mesh_trainer(shape):
+    """Model + compiled dp×tp trainer for the mesh chaos scenario.
+
+    Identical on every worker AND in the reference run, so the final
+    params are a pure function of (checkpoint, steps, mesh) — that is
+    what makes the driver's bit-identity oracle meaningful.
+    """
+    from mxnet_tpu.parallel import (DataParallelTrainer, ShardingConfig,
+                                    ShardingRule)
+    mx.random.seed(11)  # identical init everywhere
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mxnp.zeros((1, 6)))  # materialize parameter shapes
+    mx.waitall()  # drain lazy warm-up before the donating step runs
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    cfg = ShardingConfig(
+        mesh_shape=shape, axis_names=("dp", "tp"),
+        rules=[ShardingRule(r"weight$", ("tp", None))])
+    return DataParallelTrainer(net, lambda o, l: loss_fn(o, l), "sgd",
+                               {"learning_rate": 0.05}, sharding=cfg)
+
+
+def _mesh_batch(step):
+    """Global batch, deterministic per STEP (not per rank): every worker
+    runs the same full-mesh SPMD program, so the post-reshard trajectory
+    can be replayed exactly by the mesh_ref oracle."""
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(4321 + step)
+    x = jnp.asarray(rng.rand(8, 6).astype(onp.float32))
+    y = jnp.asarray(rng.randint(0, 4, 8).astype(onp.float32))
+    return x, y
+
+
+def _mesh_ref(out_dir):
+    """Bit-identity oracle for chaos --scenario mesh: a FRESH process at
+    the surviving world size (no kvstore, no reshard history) resumes
+    from the survivor's checkpoint boundary and trains to the end.  The
+    survivor's recovered run must land bit-identical to this."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import load_resharded
+    ckpt = os.environ["MESH_REF_CKPT"]
+    start = int(os.environ["MESH_REF_START"])
+    total = int(os.environ.get("MESH_TOTAL_STEPS", "8"))
+    tr = _mesh_trainer(_mesh_shape())
+    state = tr.init_state()
+    shapes = {k: tuple(v.shape) for k, v in state["params"].items()}
+    arrays, meta = load_resharded(ckpt, shapes, tr.sharding, step=start)
+    state = tr.reshard(tr.sharding, {
+        "params": arrays, "slots": {},
+        "t": jnp.asarray(meta["step"], jnp.int32)})
+    key = jax.random.PRNGKey(0)
+    lr = jnp.float32(0.05)
+    for step in range(start, total):
+        x, y = _mesh_batch(step)
+        state, _ = tr.step(state, x, y, key, lr)
+    with open(os.path.join(out_dir, "mesh_ref.json"), "w") as f:
+        json.dump({"start": start, "mesh": tr.sharding.describe(),
+                   "params": {k: onp.asarray(v).tolist()
+                              for k, v in state["params"].items()}}, f)
+
+
 def main():
     out_dir = sys.argv[1]
     mode = sys.argv[2] if len(sys.argv) > 2 else "kv"
+    if mode == "mesh_ref":
+        _mesh_ref(out_dir)  # standalone: no kvstore
+        return
     kv = mx.kv.create("dist_sync")
     rank, nw = kv.rank, kv.num_workers
     result = {"rank": rank, "num_workers": nw}
@@ -189,6 +260,100 @@ def main():
             if k.startswith(("membership.", "elastic.", "preempt."))}
         # completion fence: every worker (incl. a late rejoiner) lands
         # here; membership may shift under us, so resync + retry
+        for _ in range(4):
+            try:
+                kv.barrier()
+                break
+            except mx.kv.MembershipChanged:
+                kv.resync()
+        with open(os.path.join(out_dir, "worker%d.json" % rank),
+                  "w") as f:
+            json.dump(result, f)
+        for _ in range(4):
+            try:
+                kv.barrier()
+                break
+            except mx.kv.MembershipChanged:
+                kv.resync()
+        if rank == 0:
+            kv.stop_servers()
+        return
+
+    elif mode == "mesh":
+        # elastic dp×tp mesh training, driven by tools/chaos.py
+        # --scenario mesh: every worker runs the SAME full-mesh SPMD
+        # program over the fake-device lane (the dist kvstore is the
+        # membership control plane + device census).  When a SIGKILLed
+        # worker is evicted, the per-step barrier raises
+        # MembershipChanged; survivors shrink the mesh to the surviving
+        # device budget and recover every shard from the newest sharded
+        # boundary checkpoint, then train to completion.
+        import time as _time
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.parallel import load_resharded, save_checkpoint
+        total = int(os.environ.get("MESH_TOTAL_STEPS", "8"))
+        delay = float(os.environ.get("MESH_STEP_DELAY", "0"))
+        ckpt = os.path.join(out_dir, "ckpt_rank%d" % rank)
+        tr = _mesh_trainer(_mesh_shape())
+        state = tr.init_state()
+        shapes = {k: tuple(v.shape) for k, v in state["params"].items()}
+        key = jax.random.PRNGKey(0)
+        lr = jnp.float32(0.05)
+        result["mesh_before"] = tr.sharding.describe()
+        result["resharded"] = False
+        # step-0 boundary: the window before the first step is
+        # recoverable too
+        save_checkpoint(ckpt, state["params"], step=0,
+                        sharding=tr.sharding,
+                        extra={"mesh": tr.sharding.describe()})
+        step = 0
+        while step < total:
+            try:
+                # membership sync point: eviction of the killed worker
+                # surfaces here as a typed MembershipChanged
+                kv.barrier()
+            except mx.kv.MembershipChanged:
+                kv.resync()
+                budget = min(kv.num_devices_live,
+                             jax.local_device_count())
+                new_cfg = tr.sharding.shrink_to(
+                    list(jax.devices())[:budget])
+                arrays, meta = load_resharded(ckpt, shapes, new_cfg)
+                state = tr.reshard(new_cfg, {
+                    "params": arrays, "slots": {},
+                    "t": jnp.asarray(meta["step"], jnp.int32)})
+                step = meta["step"]
+                result["resharded"] = True
+                result["mesh_after"] = new_cfg.describe()
+                result["mesh_shape_after"] = list(new_cfg.mesh_shape)
+                result["resume_step"] = step
+                result["devices_live"] = kv.num_devices_live
+                result["unrecovered_shards"] = sum(
+                    1 for k in shapes if k not in arrays)
+                continue
+            x, y = _mesh_batch(step)
+            state, _ = tr.step(state, x, y, key, lr)
+            step += 1
+            save_checkpoint(ckpt, state["params"], step=step,
+                            sharding=tr.sharding,
+                            extra={"mesh": tr.sharding.describe()})
+            # heartbeat before pacing sleep: the chaos driver kills the
+            # victim only after real progress, and the sleep gives it a
+            # wide mid-epoch window to land the SIGKILL in
+            with open(os.path.join(out_dir,
+                                   "progress_rank%d" % rank), "w") as f:
+                f.write(str(step))
+            if delay:
+                _time.sleep(delay)
+        result["params"] = {k: onp.asarray(v).tolist()
+                            for k, v in state["params"].items()}
+        result["mesh_final"] = tr.sharding.describe()
+        result["events"] = {
+            k: v for k, v in
+            mx.profiler.aggregate_stats()["events"].items()
+            if k.startswith(("membership.", "elastic.", "checkpoint."))}
+        # completion fence: membership may still shift under us
         for _ in range(4):
             try:
                 kv.barrier()

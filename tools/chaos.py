@@ -18,6 +18,14 @@ Scenarios (--scenario):
            it, and PASS when the job completes without manual
            intervention — step count conserved (every global step
            applied exactly once), replicas identical.
+  mesh     elastic mesh resharding: SIGKILL one worker of a dp=4xtp=2
+           mesh run mid-epoch (its chips hold irreplaceable tp shards).
+           The server evicts it, survivors shrink the mesh dp-first,
+           recover every shard from the newest sharded boundary
+           checkpoint, and finish.  PASS when zero shards are
+           unrecovered, the checkpoint dir leaks no orphan shard files,
+           and the survivor's final params are bit-identical to a fresh
+           run at the surviving world size from the same checkpoint.
   fleet    serving-fleet failover: N supervised replicas behind the
            router under sustained closed-loop load; SIGKILL one replica
            mid-traffic.  PASS when (1) ZERO requests fail (the router
@@ -245,6 +253,158 @@ def scenario_preempt(args):
         if not ev.get("elastic.membership_change"):
             print("FAIL: no worker ever observed a membership change")
             ok = False
+    print("chaos: %s" % ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+def scenario_mesh(args):
+    """SIGKILL one worker of a dp×tp elastic-mesh run mid-epoch: the
+    server evicts it (MXNET_KV_EVICT_SEC), the survivor's barrier raises
+    MembershipChanged, and the survivor must shrink the mesh to the
+    surviving device budget, recover EVERY shard from the newest sharded
+    boundary checkpoint, and finish.  PASS when (1) the survivor
+    resharded (dp=4xtp=2 → dp=2xtp=2 here) with zero unrecovered
+    shards, (2) the checkpoint dir leaks no orphan shard files, and (3)
+    the survivor's final params are bit-identical to a FRESH reference
+    run started at the surviving world size from the same checkpoint
+    boundary (the mesh_ref oracle)."""
+    n, s = args.num_workers, args.num_servers
+    total = 10
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the fake-device lane: 8 CPU "chips" per worker process stand in
+    # for the dp=4 x tp=2 mesh
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("MXNET_FAULT_SPEC", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("MXNET_KV_BACKOFF_MS", "5")
+    # a SIGKILLed worker never leaves gracefully: the server must EVICT
+    # it from a stalled barrier, well before the stall watchdog trips
+    env["MXNET_KV_EVICT_SEC"] = "3"
+    env["MXNET_KV_STALL_SEC"] = "60"
+    env["MESH_TOTAL_STEPS"] = str(total)
+    env["MESH_STEP_DELAY"] = "0.4"  # SIGKILL lands mid-epoch
+    env["MESH_SHAPE"] = "4,2"
+    env["DMLC_NDEV"] = "4"  # each worker reports 4 of the 8 chips
+
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="chaos-mesh-") as out_dir:
+        servers, spawn_worker = _spawn_cluster(out_dir, n, s, env,
+                                               worker_mode="mesh")
+        workers = {wid: spawn_worker(wid) for wid in range(n)}
+        try:
+            # kill only after real progress (per-step heartbeat), never
+            # during startup compiles
+            hb = os.path.join(out_dir, "progress_rank1")
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                try:
+                    with open(hb) as f:
+                        if int(f.read() or 0) >= 2:
+                            break
+                except (OSError, ValueError):
+                    pass
+                if workers[1].poll() is not None:
+                    break
+                time.sleep(0.1)
+            victim = workers[1]
+            if victim.poll() is not None:
+                print("FAIL: worker 1 finished before the kill — "
+                      "scenario did not test anything")
+                return 1
+            print("chaos-mesh: SIGKILL worker 1 (pid %d) mid-epoch — "
+                  "its 4 chips hold irreplaceable tp shards"
+                  % victim.pid)
+            victim.kill()
+            victim.wait(timeout=30)
+            rc = workers[0].wait(timeout=300)
+            if rc != 0:
+                print("FAIL: surviving worker exited %d" % rc)
+                return 1
+            with open(os.path.join(out_dir, "worker0.json")) as f:
+                survivor = json.load(f)
+        finally:
+            for w in workers.values():
+                if w.poll() is None:
+                    w.kill()
+            for p in servers:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in servers:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+        print("chaos-mesh: survivor %s -> %s, resumed at step %s, "
+              "devices live %s" % (survivor.get("mesh_before"),
+                                   survivor.get("mesh_after"),
+                                   survivor.get("resume_step"),
+                                   survivor.get("devices_live")))
+        if not survivor.get("resharded"):
+            print("FAIL: the survivor never resharded — the eviction "
+                  "was not observed")
+            ok = False
+        if survivor.get("unrecovered_shards", -1) != 0:
+            print("FAIL: %s unrecovered shard(s) after resharding"
+                  % survivor.get("unrecovered_shards"))
+            ok = False
+        if survivor.get("mesh_after") == survivor.get("mesh_before"):
+            print("FAIL: mesh did not shrink (%s)"
+                  % survivor.get("mesh_after"))
+            ok = False
+
+        # zero leaked shards: every shard file in the survivor's
+        # checkpoint dir belongs to a manifest-complete step, and no
+        # half-written temp files remain
+        import re as _re
+        ckpt = os.path.join(out_dir, "ckpt_rank0")
+        shard_re = _re.compile(r"^step_(\d+)\.shard_\d+\.npz$")
+        leaked = []
+        for fn in sorted(os.listdir(ckpt)):
+            if ".tmp" in fn:
+                leaked.append(fn)
+                continue
+            m = shard_re.match(fn)
+            if m and not os.path.exists(os.path.join(
+                    ckpt, "step_%s.manifest.json" % m.group(1))):
+                leaked.append(fn)
+        if leaked:
+            print("FAIL: %d leaked shard file(s): %s"
+                  % (len(leaked), leaked[:6]))
+            ok = False
+        else:
+            print("chaos-mesh: zero leaked shards in %d checkpoint "
+                  "file(s)" % len(os.listdir(ckpt)))
+
+        if not ok:
+            print("chaos: FAIL")
+            return 1
+
+        # bit-identity oracle: a FRESH run at the surviving world size,
+        # from the same checkpoint boundary, must land bit-identical
+        print("chaos-mesh: reference run at %s from step %s"
+              % (survivor["mesh_after"], survivor["resume_step"]))
+        ref_env = dict(env)
+        ref_env["MESH_REF_CKPT"] = ckpt
+        ref_env["MESH_REF_START"] = str(survivor["resume_step"])
+        ref_env["MESH_SHAPE"] = ",".join(
+            str(x) for x in survivor["mesh_shape_after"])
+        r = subprocess.run(
+            [sys.executable, WORKER, out_dir, "mesh_ref"],
+            cwd=REPO, env=ref_env, timeout=300)
+        if r.returncode != 0:
+            print("FAIL: reference run exited %d" % r.returncode)
+            ok = False
+        else:
+            with open(os.path.join(out_dir, "mesh_ref.json")) as f:
+                ref = json.load(f)
+            if _params_equal(survivor["params"], ref["params"],
+                             "survivor vs fresh-start reference"):
+                print("chaos-mesh: survivor is bit-identical to a "
+                      "fresh run at the surviving world size")
+            else:
+                ok = False
     print("chaos: %s" % ("PASS" if ok else "FAIL"))
     return 0 if ok else 1
 
@@ -734,9 +894,12 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--scenario", default="faults",
-                    choices=["faults", "preempt", "fleet", "llm"],
+                    choices=["faults", "preempt", "mesh", "fleet", "llm"],
                     help="faults = transport chaos (bit-identical check);"
                          " preempt = SIGTERM + relaunch + rejoin drill;"
+                         " mesh = SIGKILL a worker holding irreplaceable"
+                         " dp×tp shards; survivors shrink the mesh and"
+                         " recover from the sharded boundary checkpoint;"
                          " fleet = SIGKILL a serving replica under load"
                          " + rolling rollout (-n = replica count);"
                          " llm = SIGKILL a replica under sustained"
@@ -750,6 +913,8 @@ def main():
     args = ap.parse_args()
     if args.scenario == "preempt":
         return scenario_preempt(args)
+    if args.scenario == "mesh":
+        return scenario_mesh(args)
     if args.scenario == "fleet":
         return scenario_fleet(args)
     if args.scenario == "llm":
