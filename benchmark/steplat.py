@@ -211,14 +211,20 @@ def speculative_steplat(measure=True, iters=10, slots=8, page_size=8,
 
 
 def sharded_steplat(mesh_shape=(4, 2), axis_names=("dp", "tp"), B=8, L=32,
-                    units=64, hidden=128, heads=2, measure=True, iters=10):
+                    units=64, hidden=128, heads=2, measure=True, iters=10,
+                    zero=0, remat=None):
     """Collective census + latency of the dp×tp sharded train step.
 
     Like the launch census, the collective counts are a STATIC property
-    of the compiled program (GSPMD inserts them at partitioning time):
+    of the compiled program (GSPMD inserts them at partitioning time;
+    the ZeRO lowering hand-places its reduce-scatter/all-gather):
     deterministic and load-independent, so CI gates on the per-class
-    counts (tests/test_sharding.py) while the wall time stays
-    informational.  Returns {mesh, collectives: {class: n, total},
+    counts (tests/test_sharding.py, tests/test_zero.py) while the wall
+    time stays informational.  ``zero``/``remat`` thread the ISSUE-15
+    knobs onto the config — the zero-1 dp row's gate is the layout
+    proof: grad comm = reduce-scatter + all-gather (one per sharded
+    param), the only all-reduce left is the scalar loss mean.  Returns
+    {mesh, zero, remat, collectives: {class: n, total},
     host_gap_us_per_step?}.
     """
     from mxnet_tpu.parallel import (ShardingConfig, DataParallelTrainer,
@@ -227,7 +233,8 @@ def sharded_steplat(mesh_shape=(4, 2), axis_names=("dp", "tp"), B=8, L=32,
     import mxnet_tpu as mx
 
     cfg = ShardingConfig.for_transformer(mesh_shape=mesh_shape,
-                                         axis_names=axis_names)
+                                         axis_names=axis_names,
+                                         zero=zero, remat=remat)
     net = TransformerLayer(units=units, hidden_size=hidden, num_heads=heads,
                            dropout=0.0)
     net.initialize()
@@ -244,7 +251,7 @@ def sharded_steplat(mesh_shape=(4, 2), axis_names=("dp", "tp"), B=8, L=32,
     key = jax.random.key(0)
     lr = jnp.float32(0.1)
     lowered = step.lower(state, xb, yb, key, lr)
-    row = {"mesh": cfg.describe(),
+    row = {"mesh": cfg.describe(), "zero": zero, "remat": remat,
            "collectives": collective_census(lowered)}
     if measure:
         jax.block_until_ready(step(state, xb, yb, key, lr))  # compile
@@ -307,10 +314,17 @@ def main():
         "speculative": speculative_steplat(),
     }
     sharded = {}
-    for name, shape, axes in (("dp8", (8,), ("dp",)),
-                              ("dp4tp2", (4, 2), ("dp", "tp"))):
+    for name, shape, axes, kw in (
+            ("dp8", (8,), ("dp",), {}),
+            ("dp4tp2", (4, 2), ("dp", "tp"), {}),
+            # ISSUE 15 gate rows: zero-1 dp grad comm must lower to
+            # reduce-scatter + all-gather (no grad all-reduce) and remat
+            # must not change the collective layout.
+            ("dp8_zero1", (8,), ("dp",), {"zero": 1}),
+            ("dp8_zero1_remat", (8,), ("dp",),
+             {"zero": 1, "remat": "attention"})):
         try:
-            sharded[name] = sharded_steplat(shape, axes)
+            sharded[name] = sharded_steplat(shape, axes, **kw)
         except ValueError as e:  # mesh doesn't fit this host
             sharded[name] = {"skipped": str(e)[:120]}
     result["sharded"] = sharded

@@ -155,6 +155,21 @@ register("MXNET_MESH_AXES", str, "", "honored",
          "vocabulary dp/tp/sp/pp/ep; may be longer than MXNET_MESH_SHAPE "
          "(missing sizes default to 1)",
          "parallel.shardcfg.ShardingConfig.from_env")
+register("MXNET_ZERO_STAGE", int, 0, "honored",
+         "ZeRO state-sharding stage for ShardingConfig.from_env: 0 = "
+         "fully replicated training state, 1 = fp32 optimizer slots "
+         "shard over dp (reduce-scatter(grads) -> local shard update -> "
+         "all-gather(params) step), 2 = grads too (lowered like 1: the "
+         "fused step never materializes a persistent full gradient), "
+         "3 = params at rest also shard over dp",
+         "parallel.shardcfg.ShardingConfig.from_env")
+register("MXNET_REMAT_POLICY", str, "", "honored",
+         "activation rematerialization policy for "
+         "ShardingConfig.from_env: ''/'off' = save every residual, "
+         "'tokens' = keep only layer-boundary token streams, "
+         "'attention' = tokens + q/k/v heads; backward recomputes "
+         "everything between the saved points",
+         "parallel.shardcfg.ShardingConfig.from_env")
 register("MXNET_SHARDED_FLASH", str, "", "honored",
          "''/'1' = flash_attention reroutes through the shard_map entry "
          "when a ShardingConfig is active on a >1-device mesh; '0'/'off' "

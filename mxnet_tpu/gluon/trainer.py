@@ -106,6 +106,7 @@ class Trainer:
         self._states = {}
         self._bucketing = bucketing
         self._bucketer = None
+        self._zero_seen = 0  # ZeRO stage observed at the bucketing decision
         self._grad_hook_handles = []
         self._perkey_collectives = 0  # per-key push/pull/pushpull count
         # elastic state: world-size rescaling keeps the effective update
@@ -209,6 +210,19 @@ class Trainer:
         # partial gradients — keep those on the per-key path
         accum = any(p.grad_req == "add" for p in self._params)
         sparse = sparse or accum
+        zero = self._zero_seen = self._zero_stage()
+        if zero >= 1 and getattr(kv, "type", "") in ("device", "tpu_ici"):
+            # the ZeRO step owns gradient communication (reduce-scatter
+            # inside the compiled step): a bucketed pushpull on top would
+            # double-communicate every gradient
+            if self._bucketing:
+                import warnings
+                warnings.warn(
+                    "Trainer(bucketing=True) disabled: ZeRO stage %d "
+                    "shards optimizer state over dp and its "
+                    "reduce-scatter step owns gradient communication — "
+                    "bucketed pushpull would double-communicate" % zero)
+            return
         want = self._bucketing
         if want is None:
             # default on exactly where per-key comm costs real collectives:
@@ -255,17 +269,36 @@ class Trainer:
         except Exception:
             pass
 
+    def _zero_stage(self):
+        """ZeRO stage of the governing ShardingConfig: the attached mesh
+        config (attach_mesh) first, else the ambient active scope.  0
+        without one (sys.modules guard — unsharded processes pay
+        nothing)."""
+        cfg = self._mesh_cfg
+        if cfg is None:
+            import sys
+            sc = sys.modules.get("mxnet_tpu.parallel.shardcfg")
+            cfg = sc.current() if sc is not None else None
+        if cfg is None:
+            return 0
+        return int(getattr(cfg, "zero", 0) or 0)
+
     def comm_stats(self):
         """Gradient-communication observables for this trainer: bucket
         plan + launch counters when bucketing is active, plus the per-key
         collective count (nonzero = per-key path ran).  The bench dp row
-        asserts on these."""
+        asserts on these.  `zero_stage` >= 1 implies `bucketing` False —
+        the ZeRO step owns grad comms, so there is no double
+        communication path."""
         s = {"bucketing": self._bucketer is not None,
              "perkey_collectives": self._perkey_collectives,
              "steps": self._step_count,
              "steps_abandoned": self._steps_abandoned,
              "live_world": self._live_world,
-             "world_scale": self._world_scale}
+             "world_scale": self._world_scale,
+             # the stage that governed the bucketing decision (sticky),
+             # else whatever config governs right now
+             "zero_stage": self._zero_seen or self._zero_stage()}
         if self._bucketer is not None:
             s.update(self._bucketer.stats())
         return s

@@ -230,6 +230,12 @@ def _active_sharding():
     mod = sys.modules.get("mxnet_tpu.parallel.shardcfg")
     if mod is None:
         return None
+    manual = getattr(mod, "manual_mode", None)
+    if manual is not None and manual():
+        # inside a manual-collective region (the ZeRO step's shard_map
+        # body): operands are already per-shard local, and a nested
+        # shard_map over the same mesh axes would be rejected
+        return None
     cfg = mod.current()
     if cfg is None or not cfg.active:
         return None

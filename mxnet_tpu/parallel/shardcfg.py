@@ -46,10 +46,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ShardingConfig", "make_mesh", "current", "active_token",
            "maybe_constrain_nd", "collective_census", "MESH_AXES",
-           "MeshShrinkError", "reshard_plan", "shard_slabs"]
+           "MeshShrinkError", "reshard_plan", "shard_slabs",
+           "manual_mode", "manual_lowering", "REMAT_POLICIES",
+           "ZERO_SLOT_PREFIXES"]
 
 #: canonical axis vocabulary (any subset, any order, may appear size-1)
 MESH_AXES = ("dp", "tp", "sp", "pp", "ep")
+
+#: remat policy name -> constraint-point names SAVED across backward
+#: (everything else is recomputed).  "tokens" keeps only the layer-
+#: boundary token streams (classic sublinear per-layer checkpointing);
+#: "attention" additionally keeps the q/k/v heads so the attention entry
+#: itself is not recomputed (more residual memory, less recompute).
+REMAT_POLICIES = {
+    "tokens": ("tokens",),
+    "attention": ("tokens", "attention"),
+}
+
+#: optimizer-slot name prefixes understood by `ShardingConfig.param_spec`
+#: ("slot0::<param>" / "slot1::<param>"): the spec resolves through
+#: `slot_spec` of the underlying parameter, so format-2 checkpoints and
+#: `reshard_plan` lay out / classify ZeRO slot shards with no extra code.
+ZERO_SLOT_PREFIXES = ("slot0::", "slot1::")
 
 
 def make_mesh(shape=None, axis_names=("dp",), devices=None):
@@ -185,22 +203,78 @@ def current():
 
 def active_token():
     """Hashable token describing the active config for trace-cache keys
-    (HybridBlock._signature): flipping the active config retraces."""
+    (HybridBlock._signature): flipping the active config retraces.  The
+    manual-lowering flag is part of the token — the same config traces
+    WITHOUT GSPMD constraints inside a manual region (the ZeRO step's
+    shard_map body), and those traces must not cache-share."""
     cfg = current()
-    return cfg.signature() if cfg is not None else None
+    if cfg is None:
+        return None
+    return (cfg.signature(), manual_mode())
+
+
+def _manual_depth():
+    return getattr(_TLS, "manual", 0)
+
+
+def manual_mode():
+    """True inside a manual-collective lowering region (`manual_lowering`):
+    the enclosing code is a shard_map body where mesh axes are manual, so
+    GSPMD `with_sharding_constraint`s would be rejected and sharded op
+    dispatch (the flash shard_map entry) must stay local."""
+    return _manual_depth() > 0
+
+
+def manual_lowering():
+    """Context manager marking a manual-collective region (the ZeRO
+    trainer's shard_map body): constraint points skip GSPMD constraints
+    (data is already per-shard local) but still apply remat
+    checkpoint-name tags; `ops.attention` keeps dispatch local."""
+
+    class _Manual:
+        def __enter__(self):
+            _TLS.manual = _manual_depth() + 1
+            return self
+
+        def __exit__(self, *exc):
+            _TLS.manual = max(0, _manual_depth() - 1)
+            return False
+
+    return _Manual()
 
 
 def maybe_constrain_nd(x, kind):
     """Constrain a gluon ndarray at a named point under the ACTIVE config
     (no-op without one).  Recorded through apply_op so the autograd tape
-    sees it (the VJP of a sharding constraint is the same constraint)."""
+    sees it (the VJP of a sharding constraint is the same constraint).
+
+    When the active config carries a `remat` policy, the value is ALSO
+    tagged with `jax.ad_checkpoint.checkpoint_name(x, kind)` — the
+    `save_only_these_names` policy then keeps exactly these boundary
+    tensors as residuals and recomputes everything between them.  Tagging
+    applies even on a 1-device mesh (remat is a memory knob, not a
+    sharding one) and inside manual-lowering regions (where the GSPMD
+    constraint itself is skipped)."""
     cfg = current()
-    if cfg is None or not cfg.active:
+    if cfg is None:
         return x
+    tag = kind in cfg.remat_saved_names()
+    constrain = cfg.active and not manual_mode()
+    if not (tag or constrain):
+        return x
+
+    def op(v):
+        if constrain:
+            v = cfg.constrain(v, kind)
+        if tag:
+            from jax.ad_checkpoint import checkpoint_name
+            v = checkpoint_name(v, kind)
+        return v
+
     from mxnet_tpu.ndarray import apply_op, ndarray
     if not isinstance(x, ndarray):
-        return cfg.constrain(x, kind)
-    return apply_op(lambda v: cfg.constrain(v, kind), x)
+        return op(x)
+    return apply_op(op, x)
 
 
 class ShardingConfig:
@@ -218,11 +292,23 @@ class ShardingConfig:
       data_axis: batch axis for input sharding (default: first mesh axis
         named "dp", else the first axis)
       devices: explicit device list for lazy mesh construction
+      zero: ZeRO state-sharding stage over the dp axis (Rajbhandari et
+        al. 2020).  0 = fully replicated state (today); 1 = fp32
+        optimizer slots shard over dp (`slot_spec`); 2 = grads shard too
+        (in the fused one-program step gradients are already transient —
+        the reduce-scatter lowering never materializes a persistent full
+        gradient, so 2 lowers like 1); 3 = params at rest ALSO shard over
+        dp (`param_spec` gains the dp dim; the step all-gathers them on
+        entry instead of on exit)
+      remat: activation rematerialization policy — None/"off" (save
+        everything, today), or a key of REMAT_POLICIES ("tokens",
+        "attention"): backward keeps only the tensors tagged at those
+        named constraint points and recomputes the rest
     """
 
     def __init__(self, mesh=None, mesh_shape=None, axis_names=None,
                  rules=(), param_fn=None, constraints=None, data_axis=None,
-                 devices=None):
+                 devices=None, zero=0, remat=None):
         if mesh is not None:
             self._mesh = mesh
             self.axis_names = tuple(mesh.axis_names)
@@ -247,6 +333,19 @@ class ShardingConfig:
         if data_axis is None:
             data_axis = "dp" if "dp" in self.axis_names else self.axis_names[0]
         self.data_axis = data_axis
+        self.zero = int(zero)
+        if self.zero not in (0, 1, 2, 3):
+            raise ValueError("ShardingConfig: zero stage must be 0..3, "
+                             "got %r" % (zero,))
+        if isinstance(remat, str):
+            remat = remat.strip().lower() or None
+            if remat in ("off", "none", "0"):
+                remat = None
+        if remat is not None and remat not in REMAT_POLICIES:
+            raise ValueError(
+                "ShardingConfig: unknown remat policy %r (known: off, %s)"
+                % (remat, ", ".join(sorted(REMAT_POLICIES))))
+        self.remat = remat
 
     # -- mesh ---------------------------------------------------------------
     @property
@@ -318,7 +417,23 @@ class ShardingConfig:
 
     def param_spec(self, name, shape):
         """PartitionSpec for a parameter: param_fn, then first matching
-        rule, else replicated."""
+        rule, else replicated.
+
+        Optimizer-slot names ("slot0::<param>"/"slot1::<param>", the
+        DataParallelTrainer/checkpoint flattening) resolve through
+        `slot_spec` of the underlying parameter — ZeRO slot shards get
+        format-2 checkpoint slabs and `reshard_plan` classification with
+        no slot-specific code anywhere else.  At zero >= 3 parameters
+        themselves gain the dp dim (params-at-rest shard)."""
+        for pre in ZERO_SLOT_PREFIXES:
+            if name.startswith(pre):
+                return self.slot_spec(name[len(pre):], shape)
+        spec = self._base_param_spec(name, shape)
+        if self.zero >= 3:
+            spec = self._with_dp(spec, shape)
+        return spec
+
+    def _base_param_spec(self, name, shape):
         if self.param_fn is not None:
             spec = self.param_fn(name, shape)
             if spec is not None:
@@ -330,6 +445,76 @@ class ShardingConfig:
 
     def param_sharding(self, name, shape):
         return NamedSharding(self.mesh, self.param_spec(name, shape))
+
+    # -- ZeRO state sharding -------------------------------------------------
+    def zero_dim(self, name, shape, spec=None):
+        """The dim of `name` the dp axis subdivides for ZeRO state
+        sharding: the FIRST dim the remaining dp factor divides (on top
+        of whatever the param spec already shards there), or None when no
+        dim is divisible, dp is absent/size-1, or the spec already
+        carries dp somewhere."""
+        dp = self.axis_size("dp")
+        if self.zero < 1 or dp <= 1:
+            return None
+        if spec is None:
+            spec = self._base_param_spec(name, tuple(shape))
+        for entry in spec:
+            names = (entry,) if isinstance(entry, str) else tuple(entry or ())
+            if "dp" in names:
+                return None
+        for d, size in enumerate(shape):
+            entry = spec[d] if d < len(spec) else None
+            names = (entry,) if isinstance(entry, str) else tuple(entry or ())
+            factor = 1
+            for n in names:
+                factor *= self.axis_size(n)
+            if size and size % (factor * dp) == 0:
+                return d
+        return None
+
+    def _with_dp(self, spec, shape):
+        """Insert dp into `spec` at `zero_dim` (identity when None)."""
+        d = self.zero_dim("", shape, spec=spec)
+        if d is None:
+            return spec
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+        e = entries[d]
+        if e is None:
+            entries[d] = "dp"
+        else:
+            entries[d] = ((e,) if isinstance(e, str) else tuple(e)) + ("dp",)
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+
+    def slot_spec(self, name, shape):
+        """PartitionSpec for `name`'s fp32 optimizer slots: the param's
+        own spec, plus — at zero >= 1 — the dp axis on the first
+        divisible dim (`P("dp", ...)` for a replicated param).  Equal to
+        the param spec at zero 0 (slots co-sharded with their param)."""
+        shape = tuple(shape)
+        spec = self._base_param_spec(name, shape)
+        if self.zero < 1:
+            return spec
+        return self._with_dp(spec, shape)
+
+    def slot_sharding(self, name, shape):
+        return NamedSharding(self.mesh, self.slot_spec(name, shape))
+
+    # -- activation rematerialization ----------------------------------------
+    def remat_saved_names(self):
+        """Constraint-point names SAVED across backward under the remat
+        policy (empty tuple = no policy = save everything)."""
+        return REMAT_POLICIES.get(self.remat, ())
+
+    def remat_policy(self):
+        """The `jax.checkpoint` policy for this config's remat knob
+        (None without one): save ONLY the tensors tagged at the policy's
+        constraint points, recompute the rest in backward."""
+        if not self.remat:
+            return None
+        return jax.checkpoint_policies.save_only_these_names(
+            *self.remat_saved_names())
 
     def data_spec(self):
         return self.resolve_spec((self.data_axis,))
@@ -389,13 +574,15 @@ class ShardingConfig:
                 id(self.param_fn) if self.param_fn is not None else None,
                 tuple(sorted((k, tuple(v))
                              for k, v in self.constraints.items())),
-                self.data_axis)
+                self.data_axis, self.zero, self.remat)
 
     def __repr__(self):
-        return "ShardingConfig(%s, rules=%d%s)" % (
+        return "ShardingConfig(%s, rules=%d%s%s%s)" % (
             self.describe() if self._mesh is not None or self.mesh_shape
             else ",".join(self.axis_names),
-            len(self.rules), ", param_fn" if self.param_fn else "")
+            len(self.rules), ", param_fn" if self.param_fn else "",
+            ", zero=%d" % self.zero if self.zero else "",
+            ", remat=%s" % self.remat if self.remat else "")
 
     # -- serialization (checkpoint metadata) --------------------------------
     def to_dict(self):
@@ -412,6 +599,8 @@ class ShardingConfig:
             "rules": [r.to_dict() for r in self.rules],
             "constraints": {k: list(v) for k, v in self.constraints.items()},
             "data_axis": self.data_axis,
+            "zero": self.zero,
+            "remat": self.remat,
         }
 
     @classmethod
@@ -422,7 +611,9 @@ class ShardingConfig:
                           for r in d.get("rules", [])],
                    constraints=d.get("constraints"),
                    data_axis=d.get("data_axis"),
-                   devices=devices)
+                   devices=devices,
+                   zero=d.get("zero", 0),
+                   remat=d.get("remat"))
 
     # -- elastic resharding (membership change) -----------------------------
     def shrink_to(self, devices):
@@ -514,13 +705,26 @@ class ShardingConfig:
             mesh_shape=new_shape, axis_names=names, rules=list(self.rules),
             param_fn=self.param_fn,
             constraints={k: tuple(v) for k, v in self.constraints.items()},
-            data_axis=self.data_axis, devices=dev_list)
+            data_axis=self.data_axis, devices=dev_list,
+            zero=self.zero, remat=self.remat)
 
     # -- constructors -------------------------------------------------------
     @classmethod
     def from_env(cls, devices=None, **kw):
         """Build from MXNET_MESH_SHAPE ("4,2") + MXNET_MESH_AXES
-        ("dp,tp"); unset -> all devices on dp."""
+        ("dp,tp"); unset -> all devices on dp.  MXNET_ZERO_STAGE and
+        MXNET_REMAT_POLICY seed the zero/remat knobs (explicit kwargs
+        win)."""
+        zero_s = os.environ.get("MXNET_ZERO_STAGE", "").strip()
+        if zero_s and "zero" not in kw:
+            try:
+                kw["zero"] = int(zero_s)
+            except ValueError:
+                raise ValueError("MXNET_ZERO_STAGE=%r is not an int (0..3)"
+                                 % zero_s)
+        remat_s = os.environ.get("MXNET_REMAT_POLICY", "").strip()
+        if remat_s and "remat" not in kw:
+            kw["remat"] = remat_s
         shape_s = os.environ.get("MXNET_MESH_SHAPE", "").strip()
         axes_s = os.environ.get("MXNET_MESH_AXES", "").strip()
         axes = tuple(a.strip() for a in axes_s.split(",") if a.strip()) \

@@ -146,6 +146,30 @@ def test_reshard_plan_checkpoint_when_slab_irreplaceable(eight_devices):
     assert plan["__summary__"]["checkpoint"] == 1
 
 
+def test_reshard_plan_classifies_zero_slot_shards(eight_devices):
+    """ISSUE 15 regression: ZeRO-1 dp-sharded optimizer slots have ONE
+    replica per dp row, so losing any device makes that slot slab
+    checkpoint-sourced while the replicated param itself survives in
+    memory — the plan must see slots through the slot0::/slot1:: naming,
+    not treat them as replicated."""
+    devs = list(jax.devices())
+    old = ShardingConfig(mesh_shape=(8,), axis_names=("dp",), zero=1)
+    new = old.shrink_to(devs[:7])
+    assert new.zero == 1
+    lost = [d for d in old.mesh.devices.flat if d.id == devs[7].id]
+    shapes = {"l.weight": (16, 8), "slot0::l.weight": (16, 8),
+              "slot1::l.weight": (16, 8)}
+    plan = reshard_plan(old, new, shapes, lost_devices=lost)
+    # the param is replicated on all 8 -> a copy survives
+    assert plan["l.weight"]["source"] == "memory"
+    # each slot slab lives on exactly one dp row -> the lost row's slab
+    # is irreplaceable from memory
+    assert plan["slot0::l.weight"]["source"] == "checkpoint"
+    assert plan["slot1::l.weight"]["source"] == "checkpoint"
+    assert plan["slot0::l.weight"]["old_spec"] == P("dp")
+    assert plan["__summary__"]["checkpoint"] == 2
+
+
 # ---------------------------------------------------------------------------
 # format-2 sharded checkpoints (satellite 3)
 # ---------------------------------------------------------------------------
