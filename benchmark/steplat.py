@@ -150,6 +150,17 @@ def decode_steplat(measure=True, iters=10, fused_mode=None, slots=8,
             row["host_gap_us_per_step"] = _median_wall_us(
                 run, iters=iters)
         out[name] = row
+    # quantized arm (ISSUE 16): int8 weights + int8 KV pages run the
+    # per-op tower (the fused cell is an fp-weight program), so the
+    # census to gate is twofold — the quant step stays tower-shaped,
+    # and the fp fused path above is UNTOUCHED by the quant code paths
+    # (bench.py pins it at its historical launches/step)
+    from mxnet_tpu.serving.quantize import quantize_lm
+    qparams = quantize_lm(lm, "int8").jax_params()
+    out["quant_int8"] = dec.decode_launch_stats(
+        qparams, cfg, page_size, slots, pps, total, fused=True,
+        layer_group=layer_group, mode=fused_mode, quant=("int8",),
+        kv_dtype="int8")
     out["slots"] = slots
     out["num_layers"] = kw["num_layers"]
     return out

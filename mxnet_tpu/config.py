@@ -409,6 +409,28 @@ register("MXNET_GEN_FN_CACHE", int, 16, "honored",
          "geometries cannot grow compiled-program memory unboundedly; "
          "compile/evict counts are exported in ServingMetrics",
          "models.decoder._FnCache")
+register("MXNET_QUANT_WEIGHTS", str, "", "honored",
+         "weight-only quantized LLM serving: 'int8' (per-output-channel "
+         "scales) or 'int4' (per-group, see MXNET_QUANT_GROUP) "
+         "quantizes the decode GEMM weights of any model attached to a "
+         "DecodeEngine; '' serves fp32.  Activations stay fp32 — the "
+         "fused dequant-matmul unpacks inside the kernel",
+         "serving.DecodeEngine")
+register("MXNET_QUANT_GROUP", int, 128, "honored",
+         "int4 scale-group size (input elements per scale, the AWQ/GPTQ "
+         "convention); shrunk automatically to divide the (per-shard) "
+         "input dim", "serving.quantize.quantize_lm")
+register("MXNET_QUANT_KV", str, "", "honored",
+         "KV-cache page dtype for the LLM engine: 'int8' stores pages "
+         "as int8 codes + one scale per (layer, kv_head, page) — ~4x "
+         "more resident tokens at fixed pool bytes; '' keeps fp32 "
+         "pages", "serving.DecodeEngine")
+register("MXNET_QUANT_MATMUL", str, "", "honored",
+         "fused dequant-matmul kernel gate: '' auto (Pallas on "
+         "accelerator backends, XLA dequant reference on CPU), '0' "
+         "forces the XLA reference, 'interpret' forces the kernel in "
+         "interpreter mode (CPU bit-exactness lane)",
+         "ops.pallas.quant_matmul.quant_mode")
 register("MXNET_INT64_TENSOR_SIZE", bool, False, "honored",
          "enable true int64 tensors/indices (reference USE_INT64_TENSOR_SIZE"
          " build flag; here it flips jax_enable_x64 at import). Off: int64"

@@ -82,144 +82,14 @@ def requantize(data, min_range, max_range, min_calib_range,
 
 
 # ---------------------------------------------------------------------------
-# calibration
+# calibration — shared observers live in contrib.calib (one implementation
+# for the CNN pass, the symbol-graph pass, and LLM serving quantization);
+# the historical private names stay importable from here.
 # ---------------------------------------------------------------------------
-class _LayerStats:
-    __slots__ = ("min", "max", "hist", "edges")
-
-    def __init__(self):
-        self.min = onp.inf
-        self.max = -onp.inf
-        self.hist = None
-        self.edges = None
-
-
-class CalibrationCollector:
-    """Collects per-layer input ranges via forward pre-hooks
-    (parity: _LayerOutputCollector / _LayerOutputMinMaxCollector in
-    contrib/quantization.py)."""
-
-    NUM_BINS = 2048  # calibrate.cc default histogram size
-
-    def __init__(self, mode="naive"):
-        assert mode in ("naive", "entropy")
-        self.mode = mode
-        self.stats = OrderedDict()
-        self._handles = []
-
-    def observe(self, name, a):
-        """Accumulate one concrete activation for `name` (min/max, and in
-        entropy mode a bin-aligned |x| histogram — widening the range
-        REBINS the existing histogram so multi-batch sums stay aligned)."""
-        st = self.stats[name]
-        st.min = min(st.min, float(a.min()))
-        st.max = max(st.max, float(a.max()))
-        if self.mode == "entropy":
-            amax = float(onp.abs(a).max())
-            if st.hist is None:
-                st.edges = onp.linspace(0, max(amax, 1e-8),
-                                        self.NUM_BINS + 1)
-                st.hist = onp.zeros(self.NUM_BINS)
-            elif amax > st.edges[-1]:
-                # rebin the old histogram onto wider edges
-                new_edges = onp.linspace(0, amax, self.NUM_BINS + 1)
-                centers = (st.edges[:-1] + st.edges[1:]) / 2
-                new_hist, _ = onp.histogram(centers, bins=new_edges,
-                                            weights=st.hist)
-                st.edges, st.hist = new_edges, new_hist
-            h, _ = onp.histogram(onp.abs(a), bins=st.edges)
-            st.hist += h
-
-    def attach(self, layers):
-        for name, layer in layers.items():
-            self.stats[name] = _LayerStats()
-
-            def hook(block, inputs, _name=name):
-                x = inputs[0]
-                a = x.asnumpy() if isinstance(x, ndarray) else onp.asarray(x)
-                self.observe(_name, a)
-
-            self._handles.append(layer.register_forward_pre_hook(hook))
-
-    def detach(self):
-        for h in self._handles:
-            h.detach()
-        self._handles = []
-
-    def thresholds(self):
-        """name → (min_range, max_range) for activation quantization."""
-        out = {}
-        for name, st in self.stats.items():
-            if self.mode == "naive" or st.hist is None:
-                out[name] = (st.min, st.max)
-            else:
-                t = _optimal_threshold_kl(st.hist, st.edges)
-                out[name] = (-t, t) if st.min < 0 else (0.0, t)
-        return out
-
-
-def _smooth(d, eps=0.0001):
-    """Move eps mass onto zero bins (calibrate.cc SmoothDistribution).
-    Falls back to smaller eps when a nonzero bin holds less mass than the
-    redistribution share (a lone outlier count would otherwise make every
-    candidate unsmoothable and disable clipping entirely)."""
-    is_zero = d == 0
-    n_zeros = int(is_zero.sum())
-    n_nonzeros = d.size - n_zeros
-    if n_nonzeros == 0:
-        return None
-    out = d.astype(onp.float64).copy()
-    if n_zeros:
-        for e in (eps, eps / 100, eps / 10000):
-            eps1 = e * n_zeros / n_nonzeros
-            if (out[~is_zero] > eps1).all():
-                out[is_zero] = e
-                out[~is_zero] -= eps1
-                return out
-        return None
-    return out
-
-
-def _optimal_threshold_kl(hist, edges, num_quantized_bins=255):
-    """KL-divergence-optimal |threshold| from an |activation| histogram
-    (parity: calibrate.cc ComputeEntropy / quantization.py
-    _get_optimal_threshold :262).  Key detail from the reference: the
-    candidate distribution p carries the clipped outlier mass in its last
-    bin, while q is quantized from the histogram WITHOUT that mass — so
-    aggressive clipping pays a KL penalty."""
-    num_bins = len(hist)
-    assert num_bins >= num_quantized_bins
-    best_kl = onp.inf
-    best_t = float(edges[-1])
-    total = hist.sum()
-    if total == 0:
-        return best_t
-    step = max(1, (num_bins - num_quantized_bins) // 128)
-    for i in range(num_quantized_bins, num_bins + 1, step):
-        sliced = hist[:i].astype(onp.float64)
-        p = sliced.copy()
-        p[-1] += hist[i:].sum()  # clip outliers into the last bin
-        # quantize the *unaugmented* slice into num_quantized_bins and
-        # expand back over p's nonzero support
-        chunks = onp.array_split(onp.arange(i), num_quantized_bins)
-        q = onp.zeros(i)
-        for ch in chunks:
-            csum = sliced[ch].sum()
-            nz = (sliced[ch] > 0).sum()
-            if nz:
-                q[ch] = onp.where(sliced[ch] > 0, csum / nz, 0)
-        pn = _smooth(p / p.sum())
-        qs = q.sum()
-        if qs == 0 or pn is None:
-            continue
-        qn = _smooth(q / qs)
-        if qn is None:
-            continue
-        kl = float((pn * onp.log(pn / qn)).sum())
-        if kl < best_kl:
-            best_kl = kl
-            best_t = float(edges[i])
-    return best_t
+from .calib import (CalibrationCollector,              # noqa: F401
+                    LayerStats as _LayerStats,
+                    smooth_distribution as _smooth,
+                    optimal_threshold_kl as _optimal_threshold_kl)
 
 
 # ---------------------------------------------------------------------------

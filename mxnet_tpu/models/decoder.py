@@ -45,6 +45,7 @@ from ..ops import attention as _attention
 from ..ops.pallas import epilogue as _epilogue
 from ..ops.pallas import fused_cell as _fused
 from ..ops.pallas import paged_attention as _paged
+from ..ops.pallas import quant_matmul as _qmm
 from .bert import PositionwiseFFN
 
 # jax warns when buffer donation is requested on backends that ignore it
@@ -147,9 +148,21 @@ def _ln(x, gamma, beta, eps=1e-5):
         x.dtype)
 
 
+def _dot_t(x, w):
+    """``x @ w.T`` with the gluon (out, in) weight convention —
+    dispatching integer weight leaves (``quant_matmul.QuantW8/W4``,
+    produced by ``serving.quantize.quantize_lm``) through the fused
+    dequant-matmul.  Every GEMM of every decode path funnels through
+    here, so a quantized param pytree quantizes ALL of prefill, decode,
+    verify, and the full-forward oracle at once."""
+    if _qmm.is_quantized(w):
+        return _qmm.quant_matmul(x, w)
+    return jnp.dot(x, w.T)
+
+
 def _proj(x, w, b=None):
     """Dense with the gluon (out, in) weight convention."""
-    y = jnp.dot(x, w.T)
+    y = _dot_t(x, w)
     return y if b is None else y + b
 
 
@@ -184,14 +197,65 @@ def _layer_tail(x, att_merged, lp, axis=None):
     if axis is None:
         o = _proj(att_merged, lp["wo"], lp["bo"])
     else:
-        o = jax.lax.psum(jnp.dot(att_merged, lp["wo"].T), axis) + lp["bo"]
+        o = jax.lax.psum(_dot_t(att_merged, lp["wo"]), axis) + lp["bo"]
     x = _ln(x + o, lp["ln1g"], lp["ln1b"])
     if axis is None:
         f = _ffn(x, lp)
     else:
         h = _epilogue.bias_gelu(_proj(x, lp["w1"]), lp["b1"])
-        f = jax.lax.psum(jnp.dot(h, lp["w2"].T), axis) + lp["b2"]
+        f = jax.lax.psum(_dot_t(h, lp["w2"]), axis) + lp["b2"]
     return _ln(x + f, lp["ln2g"], lp["ln2b"])
+
+
+# ---------------------------------------------------------------------------
+# KV page access — fp arrays or int8 QPages behind one set of helpers
+# ---------------------------------------------------------------------------
+def _kv_append(pages, li, wp, ws, val):
+    """Scatter new tokens into layer ``li``'s pages.
+
+    ``wp``/``ws``: (..., T) int write page/slot per token; the LAST axis
+    indexes CONSECUTIVE positions of one sequence (decode passes T=1 by
+    expanding a singleton axis; prefill passes the chunk; verify the
+    spec window).  ``val``: ``ws.shape + (KVH, D)``.
+
+    fp pages scatter directly.  int8 :class:`~..ops.pallas.
+    paged_attention.QPages` quantize with the page-start scale latch: a
+    token landing at page slot 0 sets its page's per-head scale to
+    ``amax/127``; every other token reuses the scale its page start
+    latched — looked up within this call's window when the start is in
+    it (``src = t - ws``), from the scales pool otherwise.  Duplicate
+    scale writes within a window all carry the same value, so the
+    scatter is order-independent."""
+    if not isinstance(pages, _paged.QPages):
+        return pages.at[li, :, wp, ws, :].set(val)
+    amax = jnp.abs(val.astype(jnp.float32)).max(axis=-1)   # ws.shape+(KVH,)
+    fresh = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    old = pages.s[li, :, wp]                               # ws.shape+(KVH,)
+    t = ws.shape[-1]
+    src = jnp.arange(t, dtype=jnp.int32) - ws              # page-start idx
+    start_fresh = jnp.take_along_axis(
+        fresh, jnp.clip(src, 0, t - 1)[..., None], axis=-2)
+    snew = jnp.where((src >= 0)[..., None], start_fresh, old)
+    codes = jnp.clip(jnp.round(val.astype(jnp.float32) / snew[..., None]),
+                     -127, 127).astype(jnp.int8)
+    return _paged.QPages(q=pages.q.at[li, :, wp, ws, :].set(codes),
+                         s=pages.s.at[li, :, wp].set(snew))
+
+
+def _kv_layer(pages, li):
+    """Layer ``li``'s page view — NamedTuple-safe (QPages[li] would
+    index the tuple fields, not the layer axis)."""
+    if isinstance(pages, _paged.QPages):
+        return _paged.QPages(q=pages.q[li], s=pages.s[li])
+    return pages[li]
+
+
+def _gather_kv(pages_li, tables):
+    """Contiguous fp32 per-sequence context from one layer's pages —
+    plain gather for fp, gather + dequant for int8."""
+    if isinstance(pages_li, _paged.QPages):
+        return _paged.gather_pages_deq(pages_li.q, pages_li.s, tables)
+    return _paged.gather_pages(pages_li, tables)
 
 
 # ---------------------------------------------------------------------------
@@ -209,6 +273,10 @@ _TP_PARAM_PATHS = {
     "w1": "ffn.ffn1.weight", "b1": "ffn.ffn1.bias",
     "w2": "ffn.ffn2.weight", "b2": "ffn.ffn2.bias",
 }
+
+#: the GEMM leaves quantize_lm replaces with QuantW8/QuantW4 structures
+#: (biases, LN params and embeddings stay fp)
+_QUANT_KINDS = ("wq", "wk", "wv", "wo", "w1", "w2")
 
 
 def _shard_token(sharding):
@@ -234,7 +302,7 @@ class TPPlan:
     layout SNIPPETS.md [3] uses).  Built via :func:`tp_plan`.
     """
 
-    def __init__(self, sharding, cfg):
+    def __init__(self, sharding, cfg, quant=None, kv_int8=False):
         from jax.sharding import NamedSharding, PartitionSpec as P
         self.sharding = sharding
         self.cfg = cfg
@@ -245,11 +313,25 @@ class TPPlan:
             num_heads=cfg.num_heads // self.tp,
             num_kv_heads=cfg.num_kv_heads // self.tp,
             hidden_size=cfg.hidden_size // self.tp)
+        #: quant token (None | ("int8",) | ("int4", group)) — switches
+        #: the GEMM param leaves to QuantW8/QuantW4 spec structures
+        self.quant = quant
+        self.kv_int8 = bool(kv_int8)
         # engine page layout (L, KVH, total_pages, S, D): KV heads over
         # tp; the per-layer kernel view drops L -> P("tp", None, None,
         # None) exactly as the ISSUE/SNIPPETS layout reads
         self.kv_spec = P(None, "tp", None, None, None)
-        self.kv_sharding = NamedSharding(self.mesh, self.kv_spec)
+        if self.kv_int8:
+            # int8 pages: codes pool shards like fp pages; the parallel
+            # scales pool (L, KVH, P) shards along the same KV-head axis
+            self.kv_in_spec = _paged.QPages(q=self.kv_spec,
+                                            s=P(None, "tp", None))
+            self.kv_sharding = _paged.QPages(
+                q=NamedSharding(self.mesh, self.kv_spec),
+                s=NamedSharding(self.mesh, P(None, "tp", None)))
+        else:
+            self.kv_in_spec = self.kv_spec
+            self.kv_sharding = NamedSharding(self.mesh, self.kv_spec)
 
     def leaf_spec(self, kind, shape):
         """PartitionSpec for one layer-param leaf (``wq``/``b2``/…),
@@ -275,28 +357,44 @@ class TPPlan:
 
     def param_specs(self):
         """Spec pytree matching the jax_params structure (shapes are a
-        function of cfg alone, so builders need no live params)."""
+        function of cfg alone, so builders need no live params).
+
+        With a quant token the six GEMM leaves become QuantW8/QuantW4
+        spec structures: the integer codes inherit the fp weight's
+        column/row axes; int8 per-oc scales follow the output axis only
+        (replicated for row-parallel — the global per-oc amax is
+        shard-consistent); int4 per-group scales follow both axes
+        (groups are shard-local by construction — the serving quantizer
+        re-derives the group size against the LOCAL input dim)."""
         from jax.sharding import PartitionSpec as P
         lp = {k: self.leaf_spec(k, s)
               for k, s in self._layer_shapes().items()}
+        if self.quant is not None:
+            mode = self.quant[0]
+            for k in _QUANT_KINDS:
+                base = tuple(lp[k]) + (None,) * (2 - len(tuple(lp[k])))
+                o_ax, i_ax = base[0], base[1]
+                if mode == "int8":
+                    lp[k] = _qmm.QuantW8(q=P(o_ax, i_ax), s=P(o_ax))
+                else:
+                    lp[k] = _qmm.QuantW4(q=P(o_ax, i_ax), s=P(o_ax, i_ax))
         return {"embed": P(), "pos": P(),
                 "layers": [dict(lp) for _ in range(self.cfg.num_layers)]}
 
     def place_params(self, params):
         """device_put the param pytree onto the mesh per the plan (the
-        one-time layout move at engine init)."""
-        from jax.sharding import NamedSharding
-
-        def put(a, spec):
-            return jax.device_put(a, NamedSharding(self.mesh, spec))
+        one-time layout move at engine init).  Flatten-and-zip rather
+        than a shape-specific walk so QuantW8/QuantW4 leaves place
+        through the same code path as raw arrays."""
+        from jax.sharding import NamedSharding, PartitionSpec
 
         specs = self.param_specs()
-        return {"embed": put(params["embed"], specs["embed"]),
-                "pos": put(params["pos"], specs["pos"]),
-                "layers": [
-                    {k: put(v, specs["layers"][li][k])
-                     for k, v in lp.items()}
-                    for li, lp in enumerate(params["layers"])]}
+        leaves, treedef = jax.tree.flatten(params)
+        spec_leaves = jax.tree.flatten(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec))[0]
+        placed = [jax.device_put(a, NamedSharding(self.mesh, s))
+                  for a, s in zip(leaves, spec_leaves)]
+        return jax.tree.unflatten(treedef, placed)
 
     def place_kv(self, pages):
         """(Re)pin a page array to the KV-head sharding — used at init
@@ -312,16 +410,16 @@ class TPPlan:
         from ..parallel.pipeline import (shard_map,
                                          _shard_map_compat_kwargs)
         rep = P()
-        in_specs = ((self.param_specs(), self.kv_spec, self.kv_spec)
+        in_specs = ((self.param_specs(), self.kv_in_spec, self.kv_in_spec)
                     + (rep,) * n_rest)
-        out_specs = (self.kv_spec, self.kv_spec) + (rep,) * n_out_rest
+        out_specs = (self.kv_in_spec, self.kv_in_spec) + (rep,) * n_out_rest
         smapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                             out_specs=out_specs,
                             **_shard_map_compat_kwargs())
         return jax.jit(smapped, donate_argnums=(1, 2))
 
 
-def tp_plan(cfg, sharding):
+def tp_plan(cfg, sharding, quant=None, kv_int8=False):
     """Resolve (cfg, ShardingConfig) to a :class:`TPPlan`, or None when
     the engine should serve replicated: no config, tp absent/1, a mesh
     that does not fit this host, geometry tp does not divide (the GQA
@@ -350,7 +448,7 @@ def tp_plan(cfg, sharding):
             "(pick tp dividing the head/FFN geometry)" % (tp, ", ".join(bad)),
             stacklevel=2)
         return None
-    plan = TPPlan(sharding, cfg)
+    plan = TPPlan(sharding, cfg, quant=quant, kv_int8=kv_int8)
     shapes = plan._layer_shapes()
     want = {"wq": ("tp",), "wk": ("tp",), "wv": ("tp",), "bq": ("tp",),
             "w1": ("tp",), "b1": ("tp",),
@@ -393,7 +491,8 @@ def full_forward(params, cfg, tokens):
 # ---------------------------------------------------------------------------
 # incremental decode over the paged KV cache
 # ---------------------------------------------------------------------------
-def make_decode_step(cfg, page_size, sharding=None):
+def make_decode_step(cfg, page_size, sharding=None, quant=None,
+                     kv_dtype="float32"):
     """Build (or fetch) the jitted batched decode step for
     (cfg, page_size) — cached in the bounded per-geometry LRU.
 
@@ -401,11 +500,15 @@ def make_decode_step(cfg, page_size, sharding=None):
     under ``shard_map`` (params column/row-split, KV pages split along
     KV heads); otherwise the 1-chip program.  The sharding token is part
     of the cache key, so toggling the config never serves a stale
-    program.
+    program; the quant token (None | ("int8",) | ("int4", group)) and
+    the KV dtype key the same way — a quantized engine never shares a
+    program with an fp one even at identical geometry.
 
     fn(params, k_pages, v_pages, tokens, positions, page_tables, active)
       k_pages/v_pages: (layers, KVH, total_pages, page_size, head_dim)
-                       (donated: updated in place on accelerators)
+                       (donated: updated in place on accelerators);
+                       with kv_dtype="int8" a QPages (codes, scales)
+                       pytree of the same page geometry
       tokens:     (B,) int32 — this step's input token per slot
       positions:  (B,) int32 — cache index the token lands at
       page_tables:(B, pages_per_seq) int32
@@ -413,9 +516,11 @@ def make_decode_step(cfg, page_size, sharding=None):
                   read garbage; the engine discards their outputs
     -> (k_pages, v_pages, next_tokens (B,) int32, logits (B, vocab) f32)
     """
-    key = ("decode", cfg, int(page_size), _shard_token(sharding))
+    key = ("decode", cfg, int(page_size), _shard_token(sharding),
+           quant, str(kv_dtype))
     return _fn_cache.get(key, lambda: _build_decode_step(
-        cfg, int(page_size), tp_plan(cfg, sharding)))
+        cfg, int(page_size), tp_plan(cfg, sharding, quant=quant,
+                                     kv_int8=(kv_dtype == "int8"))))
 
 
 def _build_decode_step(cfg, page_size, plan=None):
@@ -441,11 +546,16 @@ def _build_decode_step(cfg, page_size, plan=None):
         for li, lp in enumerate(params["layers"]):
             q, k, v = _qkv(x, lp, qcfg)                 # (B, H/KVH, D)
             # advanced indices split by ':' put the batch dim first:
-            # the target block is (B, KVH, D) — k/v's native layout
-            k_pages = k_pages.at[li, :, wp, ws, :].set(k)
-            v_pages = v_pages.at[li, :, wp, ws, :].set(v)
+            # the target block is (B, 1, KVH, D) — k/v's native layout
+            # behind a singleton token axis (each slot is its own
+            # sequence, so the scale-latch window is one token wide)
+            k_pages = _kv_append(k_pages, li, wp[:, None], ws[:, None],
+                                 k[:, None])
+            v_pages = _kv_append(v_pages, li, wp[:, None], ws[:, None],
+                                 v[:, None])
             att = _paged.paged_attention(
-                q, k_pages[li], v_pages[li], lengths, page_tables)
+                q, _kv_layer(k_pages, li), _kv_layer(v_pages, li),
+                lengths, page_tables)
             x = _layer_tail(x, att.reshape(B, Cl), lp, axis=axis)
         logits = jnp.dot(x.astype(jnp.float32),
                          params["embed"].astype(jnp.float32).T)
@@ -473,7 +583,7 @@ def _stack_layer_params(params, lo, hi):
 
 
 def make_decode_step_fused(cfg, page_size, layer_group=0, mode="interpret",
-                           sharding=None):
+                           sharding=None, quant=None, kv_dtype="float32"):
     """Build (or fetch) the PERSISTENT-KERNEL decode step: one
     ``fused_cell.decode_layer_group`` Pallas launch per layer group
     (default: all layers in one group) instead of the per-op XLA tower.
@@ -485,7 +595,21 @@ def make_decode_step_fused(cfg, page_size, layer_group=0, mode="interpret",
     attention-phase launch (qkv + KV append + paged read + local
     out-proj partial), the row-parallel all-reduce, then one FFN-phase
     launch, the second all-reduce — still the only cross-chip traffic.
+
+    The persistent kernel is fp-only: its body latches fp weight slabs
+    and fp page slabs in VMEM.  A quant token or int8 KV falls back
+    (loudly) to the per-op step, whose GEMMs run the fused
+    dequant-matmul kernel instead — quantization trades the single-launch
+    program for the bandwidth win, it does not stack with it.
     """
+    if quant is not None or str(kv_dtype) != "float32":
+        warnings.warn(
+            "decoder: the fused decode step is fp-only; serving the "
+            "per-op path with quant=%r kv_dtype=%s (the dequant-matmul "
+            "kernel carries the quantized GEMMs)" % (quant, kv_dtype),
+            stacklevel=2)
+        return make_decode_step(cfg, page_size, sharding=sharding,
+                                quant=quant, kv_dtype=kv_dtype)
     key = ("decode_fused", cfg, int(page_size), int(layer_group),
            str(mode), _shard_token(sharding))
     return _fn_cache.get(key, lambda: _build_decode_step_fused(
@@ -552,13 +676,23 @@ def _build_decode_step_fused(cfg, page_size, layer_group, mode, plan=None):
     return plan.wrap(step, n_rest=4, n_out_rest=2)
 
 
-def _decode_step_structs(params, cfg, page_size, slots, pages_per_seq,
-                         total_pages):
-    """ShapeDtypeStruct argument tuple of one decode step (census
-    tracing/lowering without touching real buffers)."""
+def _kv_structs(cfg, page_size, total_pages, kv_dtype="float32"):
+    """ShapeDtypeStruct of one page pool (fp array or int8 QPages)."""
     shape = (cfg.num_layers, cfg.num_kv_heads, int(total_pages),
              int(page_size), cfg.head_dim)
-    kp = jax.ShapeDtypeStruct(shape, jnp.float32)
+    if str(kv_dtype) == "int8":
+        return _paged.QPages(
+            q=jax.ShapeDtypeStruct(shape, jnp.int8),
+            s=jax.ShapeDtypeStruct(shape[:3], jnp.float32))
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _decode_step_structs(params, cfg, page_size, slots, pages_per_seq,
+                         total_pages, kv_dtype="float32"):
+    """ShapeDtypeStruct argument tuple of one decode step (census
+    tracing/lowering without touching real buffers).  Quantized param
+    leaves (QuantW8/QuantW4 pytrees) map leaf-wise like raw arrays."""
+    kp = _kv_structs(cfg, page_size, total_pages, kv_dtype)
     return (jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
             kp, kp,
@@ -570,7 +704,8 @@ def _decode_step_structs(params, cfg, page_size, slots, pages_per_seq,
 
 def decode_launch_stats(params, cfg, page_size, slots, pages_per_seq,
                         total_pages, fused, layer_group=0,
-                        mode="interpret", sharding=None):
+                        mode="interpret", sharding=None, quant=None,
+                        kv_dtype="float32"):
     """Static launch census of one decode step (the dispatch-count
     audit): traces the chosen step program and counts launch-class
     primitives with ``fused_cell.count_launches`` — deterministic and
@@ -582,17 +717,20 @@ def decode_launch_stats(params, cfg, page_size, slots, pages_per_seq,
     pallas_per_group}.
     """
     S = int(page_size)
-    if fused:
+    quantized = quant is not None or str(kv_dtype) != "float32"
+    if fused and not quantized:
         fn = make_decode_step_fused(cfg, S, layer_group, mode,
                                     sharding=sharding)
         n_groups = len(_group_bounds(cfg.num_layers, layer_group))
         if tp_plan(cfg, sharding) is not None:
             n_groups = cfg.num_layers      # per-layer phase kernels
     else:
-        fn = make_decode_step(cfg, S, sharding=sharding)
+        fused = False                      # quant forces the per-op path
+        fn = make_decode_step(cfg, S, sharding=sharding, quant=quant,
+                              kv_dtype=kv_dtype)
         n_groups = cfg.num_layers
     args = _decode_step_structs(params, cfg, S, slots, pages_per_seq,
-                                total_pages)
+                                total_pages, kv_dtype=kv_dtype)
     jaxpr = jax.make_jaxpr(fn)(*args)
     launches = _fused.count_launches(jaxpr)
     pallas = _fused.count_pallas_calls(jaxpr)
@@ -604,7 +742,8 @@ def decode_launch_stats(params, cfg, page_size, slots, pages_per_seq,
 
 def decode_collective_stats(params, cfg, page_size, slots, pages_per_seq,
                             total_pages, sharding, fused=False,
-                            layer_group=0, mode="interpret"):
+                            layer_group=0, mode="interpret", quant=None,
+                            kv_dtype="float32"):
     """Static COLLECTIVE census of one sharded decode step: lowers the
     shard_map program through the partitioner and counts HLO collectives
     per class (``parallel.shardcfg.collective_census``).  Like the
@@ -620,19 +759,21 @@ def decode_collective_stats(params, cfg, page_size, slots, pages_per_seq,
         raise ValueError("decode_collective_stats needs a sharding with "
                          "an active tp axis that divides the geometry")
     S = int(page_size)
-    if fused:
+    if fused and quant is None and str(kv_dtype) == "float32":
         fn = make_decode_step_fused(cfg, S, layer_group, mode,
                                     sharding=sharding)
     else:
-        fn = make_decode_step(cfg, S, sharding=sharding)
+        fn = make_decode_step(cfg, S, sharding=sharding, quant=quant,
+                              kv_dtype=kv_dtype)
     args = _decode_step_structs(params, cfg, S, slots, pages_per_seq,
-                                total_pages)
+                                total_pages, kv_dtype=kv_dtype)
     census = _shardcfg.collective_census(fn.lower(*args))
     return {"mesh": sharding.describe(), "tp": plan.tp,
             "fused": bool(fused), "collectives": census}
 
 
-def make_prefill_chunk(cfg, page_size, chunk, sharding=None):
+def make_prefill_chunk(cfg, page_size, chunk, sharding=None, quant=None,
+                       kv_dtype="float32"):
     """Build (or fetch) the jitted single-sequence chunk prefill for
     (cfg, page_size, chunk) — cached in the bounded per-geometry LRU.
 
@@ -654,9 +795,11 @@ def make_prefill_chunk(cfg, page_size, chunk, sharding=None):
     bit-compatible with the sharded decode step's pages.
     """
     key = ("prefill", cfg, int(page_size), int(chunk),
-           _shard_token(sharding))
+           _shard_token(sharding), quant, str(kv_dtype))
     return _fn_cache.get(key, lambda: _build_prefill_chunk(
-        cfg, int(page_size), int(chunk), tp_plan(cfg, sharding)))
+        cfg, int(page_size), int(chunk),
+        tp_plan(cfg, sharding, quant=quant,
+                kv_int8=(kv_dtype == "int8"))))
 
 
 def _build_prefill_chunk(cfg, page_size, chunk, plan=None):
@@ -677,12 +820,12 @@ def _build_prefill_chunk(cfg, page_size, chunk, plan=None):
         ws = jnp.where(valid, idx % S, 0)
         for li, lp in enumerate(params["layers"]):
             q, k, v = _qkv(x, lp, qcfg)                 # (P, H/KVH, D)
-            k_pages = k_pages.at[li, :, wp, ws, :].set(k)
-            v_pages = v_pages.at[li, :, wp, ws, :].set(v)
+            k_pages = _kv_append(k_pages, li, wp, ws, k)
+            v_pages = _kv_append(v_pages, li, wp, ws, v)
             # gather THIS sequence's pages (prefix + the chunk just
             # written) back to a contiguous (C, KVH, D) view
-            kc = _paged.gather_pages(k_pages[li], page_row[None])[0]
-            vc = _paged.gather_pages(v_pages[li], page_row[None])[0]
+            kc = _gather_kv(_kv_layer(k_pages, li), page_row[None])[0]
+            vc = _gather_kv(_kv_layer(v_pages, li), page_row[None])[0]
             kr = jnp.repeat(kc, g, axis=0)              # (H, C, D)
             vr = jnp.repeat(vc, g, axis=0)
             qf = q.astype(jnp.float32).swapaxes(0, 1) * scale  # (H, P, D)
@@ -707,7 +850,8 @@ def _build_prefill_chunk(cfg, page_size, chunk, plan=None):
     return plan.wrap(prefill, n_rest=4, n_out_rest=2)
 
 
-def make_verify_step(cfg, page_size, width, sharding=None):
+def make_verify_step(cfg, page_size, width, sharding=None, quant=None,
+                     kv_dtype="float32"):
     """Build (or fetch) the jitted wide VERIFY step for speculative
     decoding — cached per (cfg, page_size, width) in the same bounded
     per-geometry LRU as the decode/prefill programs.
@@ -739,9 +883,11 @@ def make_verify_step(cfg, page_size, width, sharding=None):
     unmodified (the acceptance logic only sees replicated out_tokens).
     """
     key = ("verify", cfg, int(page_size), int(width),
-           _shard_token(sharding))
+           _shard_token(sharding), quant, str(kv_dtype))
     return _fn_cache.get(key, lambda: _build_verify_step(
-        cfg, int(page_size), int(width), tp_plan(cfg, sharding)))
+        cfg, int(page_size), int(width),
+        tp_plan(cfg, sharding, quant=quant,
+                kv_int8=(kv_dtype == "int8"))))
 
 
 def _build_verify_step(cfg, page_size, width, plan=None):
@@ -769,10 +915,10 @@ def _build_verify_step(cfg, page_size, width, plan=None):
         ws = jnp.where(valid, idx % S, 0)
         for li, lp in enumerate(params["layers"]):
             q, k, v = _qkv(x, lp, qcfg)                 # (B, W, H/KVH, D)
-            k_pages = k_pages.at[li, :, wp, ws, :].set(k)
-            v_pages = v_pages.at[li, :, wp, ws, :].set(v)
-            kc = _paged.gather_pages(k_pages[li], page_tables)
-            vc = _paged.gather_pages(v_pages[li], page_tables)
+            k_pages = _kv_append(k_pages, li, wp, ws, k)
+            v_pages = _kv_append(v_pages, li, wp, ws, v)
+            kc = _gather_kv(_kv_layer(k_pages, li), page_tables)
+            vc = _gather_kv(_kv_layer(v_pages, li), page_tables)
             kr = jnp.repeat(kc, g, axis=1)              # (B, H, C, D)
             vr = jnp.repeat(vc, g, axis=1)
             qf = q.astype(jnp.float32).transpose(0, 2, 1, 3) * scale
@@ -798,7 +944,8 @@ def _build_verify_step(cfg, page_size, width, plan=None):
 
 
 def verify_launch_stats(params, cfg, page_size, width, slots,
-                        pages_per_seq, total_pages):
+                        pages_per_seq, total_pages, quant=None,
+                        kv_dtype="float32"):
     """Static launch census of one wide verify step (the speculative
     analog of :func:`decode_launch_stats`): traced, deterministic, and
     independent of acceptance — the launch count is a property of
@@ -809,10 +956,8 @@ def verify_launch_stats(params, cfg, page_size, width, slots,
     full acceptance (``width`` tokens emitted by the one launch)."""
     S = int(page_size)
     W = int(width)
-    fn = make_verify_step(cfg, S, W)
-    shape = (cfg.num_layers, cfg.num_kv_heads, int(total_pages), S,
-             cfg.head_dim)
-    kp = jax.ShapeDtypeStruct(shape, jnp.float32)
+    fn = make_verify_step(cfg, S, W, quant=quant, kv_dtype=kv_dtype)
+    kp = _kv_structs(cfg, S, total_pages, kv_dtype)
     args = (jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
             kp, kp,

@@ -33,12 +33,39 @@ and epilogue kernels do.
 from __future__ import annotations
 
 import os
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["paged_attention", "paged_attention_reference", "copy_page",
-           "last_path"]
+           "QPages", "gather_pages_deq", "last_path"]
+
+
+class QPages(NamedTuple):
+    """int8 KV page pool + parallel per-(page, head) scales pool.
+
+    ``q``: int8 codes, the fp page layout with the same axes —
+    ``(KVH, P, S, D)`` per layer or ``(L, KVH, P, S, D)`` stacked.
+    ``s``: f32 scales, one per (page, kv-head) — ``(KVH, P)`` /
+    ``(L, KVH, P)``; ``token ≈ q * s`` for every token in the page.
+
+    A page's scale is LATCHED by the write landing at page slot 0
+    (``amax(token)/127``); later writes into the page reuse it with
+    codes clipped to [-127, 127].  That makes each page's scale a
+    deterministic function of the token that opened it — speculative
+    rollback (``PageAllocator.trim``) frees whole pages past the
+    accepted prefix, and the boundary page's scale was latched by an
+    already-confirmed token, so spec-vs-plain and migrated-vs-unmigrated
+    decode stay bit-identical under int8 KV exactly as in fp.  (A
+    running-max-with-rescale scheme would rewrite history on every
+    append and break both batteries.)
+
+    A NamedTuple is an automatic JAX pytree: QPages flows through
+    ``jit`` donation, ``device_put``, and ``shard_map`` in_specs like
+    the fp page array it replaces."""
+    q: jax.Array
+    s: jax.Array
 
 # Which path the last call took: "pallas" | "pallas-interpret" | "xla".
 # Tests assert on this to guarantee the kernel is actually exercised.
@@ -120,8 +147,35 @@ def copy_page(pages, src, dst):
     engine's stacked ``(L, KVH, P, S, D)``.  This is the device half of
     a copy-on-write fork (``PageAllocator.fork`` is the bookkeeping
     half): the writer copies the shared page into its fresh private one
-    before the first divergent write."""
+    before the first divergent write.
+
+    :class:`QPages` copies both pools — the codes page AND its scale
+    entry (page axis is LAST in the scales pool), so a CoW fork of an
+    int8 page carries the latched scale with it."""
+    if isinstance(pages, QPages):
+        return QPages(
+            q=pages.q.at[..., dst, :, :].set(pages.q[..., src, :, :]),
+            s=pages.s.at[..., dst].set(pages.s[..., src]))
     return pages.at[..., dst, :, :].set(pages[..., src, :, :])
+
+
+def gather_pages_deq(codes, scales, page_indices):
+    """Gather + dequantize int8 pages into contiguous fp32 caches.
+
+    codes: (KVH, P, S, D) int8; scales: (KVH, P) f32;
+    page_indices: (B, pages_per_seq) int32
+    -> (B, KVH, pages_per_seq * S, D) f32 — the same contiguous layout
+    :func:`gather_pages` produces, with each page's tokens scaled by its
+    latched per-head scale.  This dequant-at-read is the int8-KV
+    counterpart of the fp gather reference and shares its bit-exactness
+    role: every consumer (decode read, prefill re-read, verify re-read)
+    sees identical fp values for identical pages."""
+    kvh, _, s, d = codes.shape
+    b, pps = page_indices.shape
+    g = jnp.swapaxes(codes[:, page_indices], 0, 1)     # (B,KVH,pps,S,D)
+    sg = jnp.swapaxes(scales[:, page_indices], 0, 1)   # (B,KVH,pps)
+    ctx = g.astype(jnp.float32) * sg[..., None, None]
+    return ctx.reshape(b, kvh, pps * s, d)
 
 
 def attend_ctx(q, k_ctx, v_ctx, lengths, scale):
@@ -180,6 +234,17 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices, scale=None):
     awareness at all; attention is embarrassingly parallel over heads.
     """
     global last_path, _fallback_warned
+    if isinstance(k_pages, QPages):
+        # int8 KV pages: dequant-at-read through the gather reference —
+        # the contiguous fp view is exactly what a full-cache decoder
+        # holding the dequantized tokens would attend over, so the
+        # paged==full-cache bit statement survives quantization
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / (d ** 0.5)
+        k_ctx = gather_pages_deq(k_pages.q, k_pages.s, page_indices)
+        v_ctx = gather_pages_deq(v_pages.q, v_pages.s, page_indices)
+        last_path = "xla"
+        return attend_ctx(q, k_ctx, v_ctx, lengths, s)
     mode = _mode()
     if mode is not None:
         try:

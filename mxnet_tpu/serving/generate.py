@@ -81,6 +81,7 @@ from .. import config as _config
 from .. import faults
 from ..models import decoder as _decoder
 from ..ops.pallas import fused_cell as _fused_cell
+from ..ops.pallas import paged_attention as _paged
 from ..ops.pallas.paged_attention import copy_page as _copy_page
 from .errors import (BadRequestError, DeadlineExceededError, QueueFullError,
                      ServerClosedError, ServingError, SessionResetError)
@@ -188,7 +189,28 @@ class DecodeEngine:
                  static_batching=False, session_ttl_s=None,
                  prefix_cache=None, role=None, migrate=None,
                  pagestore=None, speculate=None, spec_k=None,
-                 drafter=None, draft_model=None, sharding=None):
+                 drafter=None, draft_model=None, sharding=None,
+                 quantize=None, quant_group=None, kv_dtype=None):
+        # quantized serving (weight-only int8/int4 + int8 KV pages):
+        # accept a pre-wrapped serving.quantize.QuantizedLM, or wrap
+        # here from the kwarg/env knob.  Weights and KV cache quantize
+        # independently — each is its own program-cache key axis.
+        qmode = getattr(model, "quant_mode", None)
+        want = str(quantize if quantize is not None
+                   else _config.get("MXNET_QUANT_WEIGHTS") or "")
+        if qmode is None and want:
+            from .quantize import quantize_lm
+            model = quantize_lm(model, want, group=int(
+                quant_group if quant_group is not None
+                else _config.get("MXNET_QUANT_GROUP")))
+            qmode = model.quant_mode
+        self.quant = model.quant_token() if qmode is not None else None
+        self.kv_dtype = str(kv_dtype if kv_dtype is not None
+                            else _config.get("MXNET_QUANT_KV")
+                            or "float32")
+        if self.kv_dtype not in ("float32", "int8"):
+            raise ValueError("kv_dtype must be float32 or int8, got %r"
+                             % (self.kv_dtype,))
         self.model = model
         self.name = name
         self.cfg = model.config
@@ -216,8 +238,14 @@ class DecodeEngine:
             session_ttl_s if session_ttl_s is not None
             else _config.get("MXNET_GEN_SESSION_TTL"))
 
-        self.alloc = PageAllocator(total, self.page_size)
         cfg = self.cfg
+        elems = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim
+        self.alloc = PageAllocator(
+            total, self.page_size, kv_dtype=self.kv_dtype,
+            page_bytes=elems * self.page_size
+            * (1 if self.kv_dtype == "int8" else 4),
+            scale_page_bytes=(2 * cfg.num_layers * cfg.num_kv_heads * 4
+                              if self.kv_dtype == "int8" else 0))
         shape = (cfg.num_layers, cfg.num_kv_heads, total, self.page_size,
                  cfg.head_dim)
         # tensor-parallel serving (ISSUE 13): resolve the sharding into a
@@ -228,13 +256,20 @@ class DecodeEngine:
         # this geometry resolves to None (decoder.tp_plan warns loudly)
         # and the engine serves replicated.  PageAllocator bookkeeping is
         # host-side and shard-agnostic either way.
-        self._tp_plan = _decoder.tp_plan(cfg, sharding)
+        self._tp_plan = _decoder.tp_plan(
+            cfg, sharding, quant=self.quant,
+            kv_int8=self.kv_dtype == "int8")
         self.sharding = sharding if self._tp_plan is not None else None
         self.tp = self._tp_plan.tp if self._tp_plan is not None else 1
+        if self.quant is not None and self.quant[0] == "int4" \
+                and self.tp > 1:
+            # int4 scale groups must not straddle row-parallel shards:
+            # re-derive the quantized params with the shard-local group
+            self.params = self.model.jax_params(tp=self.tp)
         if self._tp_plan is not None:
             self.params = self._tp_plan.place_params(self.params)
-        self._kp = self._place_kv(jnp.zeros(shape, jnp.float32))
-        self._vp = self._place_kv(jnp.zeros(shape, jnp.float32))
+        self._kp = self._place_kv(self._fresh_pool(shape))
+        self._vp = self._place_kv(self._fresh_pool(shape))
         self._tables = onp.zeros((self.slots, self.pages_per_seq),
                                  onp.int32)
         self._tables_dev = None  # device copy, rebuilt when rows change
@@ -243,6 +278,12 @@ class DecodeEngine:
         # launch census is static (trace-time) and exported as the
         # engine's dispatch-count metric — the _bulk-flush analog.
         self.decode_fused_mode = _fused_cell.decode_mode()
+        if self.decode_fused_mode is not None and (
+                self.quant is not None or self.kv_dtype != "float32"):
+            _log.info("decode engine %r: the fused decode cell is "
+                      "fp-only; quantized serving (quant=%r kv=%s) runs "
+                      "the per-op path", name, self.quant, self.kv_dtype)
+            self.decode_fused_mode = None
         self.layer_group = (int(_config.get("MXNET_DECODE_LAYER_GROUP"))
                             or cfg.num_layers)
         if self.decode_fused_mode is not None:
@@ -251,11 +292,13 @@ class DecodeEngine:
                 self.decode_fused_mode, sharding=self.sharding)
         else:
             self._decode_fn = _decoder.make_decode_step(
-                cfg, self.page_size, sharding=self.sharding)
+                cfg, self.page_size, sharding=self.sharding,
+                quant=self.quant, kv_dtype=self.kv_dtype)
         self._decode_fn_unfused = None   # lazy fallback (compile fail)
         self._prefill_fn = _decoder.make_prefill_chunk(
             cfg, self.page_size, self.prefill_chunk,
-            sharding=self.sharding)
+            sharding=self.sharding, quant=self.quant,
+            kv_dtype=self.kv_dtype)
         try:
             self.launch_stats = _decoder.decode_launch_stats(
                 self.params, cfg, self.page_size, self.slots,
@@ -263,7 +306,8 @@ class DecodeEngine:
                 fused=self.decode_fused_mode is not None,
                 layer_group=self.layer_group,
                 mode=self.decode_fused_mode or "interpret",
-                sharding=self.sharding)
+                sharding=self.sharding, quant=self.quant,
+                kv_dtype=self.kv_dtype)
         except Exception:  # pragma: no cover - tracing is best-effort
             _log.exception("decode launch census failed")
             self.launch_stats = {"fused": self.decode_fused_mode
@@ -281,7 +325,8 @@ class DecodeEngine:
                     self.pages_per_seq, total, self.sharding,
                     fused=self.decode_fused_mode is not None,
                     layer_group=self.layer_group,
-                    mode=self.decode_fused_mode or "interpret")
+                    mode=self.decode_fused_mode or "interpret",
+                    quant=self.quant, kv_dtype=self.kv_dtype)
             except Exception:  # pragma: no cover - census is best-effort
                 _log.exception("decode collective census failed")
                 self.collective_stats = {
@@ -444,7 +489,9 @@ class DecodeEngine:
         kv = self.alloc.stats()
         self.metrics.observe_kv_cache(
             self.name, kv["used_pages"], kv["total_pages"],
-            kv["shared_pages"], kv["leaked_pages"])
+            kv["shared_pages"], kv["leaked_pages"],
+            tokens_resident=self._tokens_resident(),
+            bytes_per_token=kv.get("kv_bytes_per_token", 0.0))
         self.metrics.observe_fn_cache(self.name,
                                       _decoder.fn_cache_stats())
         self.steps += 1
@@ -576,10 +623,22 @@ class DecodeEngine:
 
     def _install_pages(self, sid, blob, gen=None):
         """Unpack a ``pack_session`` blob into fresh pool pages and park
-        the session (worker thread only)."""
-        meta, k, v = unpack_session(blob)
+        the session (worker thread only).  A KV-dtype mismatch between
+        the blob and this engine raises typed: int8 codes are only
+        meaningful next to their page scales and the latch that wrote
+        them, and re-quantizing an fp blob here would silently change
+        cached values — the transcript-replay path recomputes the right
+        cache instead."""
+        meta, k, v, ks, vs = unpack_session(blob, with_scales=True)
         sid = sid if sid is not None else meta["sid"]
         cfg = self.cfg
+        blob_kv = "int8" if ks is not None else "float32"
+        if blob_kv != self.kv_dtype:
+            raise ValueError(
+                "imported session KV dtype %r does not match this "
+                "engine's %r (weight-only requantization is lossy; "
+                "resume via transcript replay instead)"
+                % (blob_kv, self.kv_dtype))
         want = (cfg.num_layers, cfg.num_kv_heads, self.page_size,
                 cfg.head_dim)
         got = (k.shape[0], k.shape[1], k.shape[3], k.shape[4])
@@ -599,10 +658,18 @@ class DecodeEngine:
                     raise
         if n:
             idx = jnp.asarray(onp.asarray(pages, onp.int32))
-            self._kp = self._place_kv(
-                self._kp.at[:, :, idx].set(jnp.asarray(k)))
-            self._vp = self._place_kv(
-                self._vp.at[:, :, idx].set(jnp.asarray(v)))
+            if ks is not None:
+                self._kp = self._place_kv(_paged.QPages(
+                    q=self._kp.q.at[:, :, idx].set(jnp.asarray(k)),
+                    s=self._kp.s.at[:, :, idx].set(jnp.asarray(ks))))
+                self._vp = self._place_kv(_paged.QPages(
+                    q=self._vp.q.at[:, :, idx].set(jnp.asarray(v)),
+                    s=self._vp.s.at[:, :, idx].set(jnp.asarray(vs))))
+            else:
+                self._kp = self._place_kv(
+                    self._kp.at[:, :, idx].set(jnp.asarray(k)))
+                self._vp = self._place_kv(
+                    self._vp.at[:, :, idx].set(jnp.asarray(v)))
         sess = _Session(sid, owner)
         sess.pos = int(meta["pos"])
         sess.pending = (int(meta["pending"])
@@ -621,20 +688,37 @@ class DecodeEngine:
         faults.check("session.export")
         pages = self.alloc.pages(owner)
         cfg = self.cfg
+        ks = vs = None
         if pages:
             idx = jnp.asarray(onp.asarray(pages, onp.int32))
-            k = onp.asarray(jnp.take(self._kp, idx, axis=2))
-            v = onp.asarray(jnp.take(self._vp, idx, axis=2))
+            if self.kv_dtype == "int8":
+                # quantized pages ship as-is: codes + per-page scales
+                # (format v2) — the importer scatters them back without
+                # a single dequant/requant round trip, so migration
+                # stays bit-identical like the fp path
+                k = onp.asarray(jnp.take(self._kp.q, idx, axis=2))
+                v = onp.asarray(jnp.take(self._vp.q, idx, axis=2))
+                ks = onp.asarray(jnp.take(self._kp.s, idx, axis=2))
+                vs = onp.asarray(jnp.take(self._vp.s, idx, axis=2))
+            else:
+                k = onp.asarray(jnp.take(self._kp, idx, axis=2))
+                v = onp.asarray(jnp.take(self._vp, idx, axis=2))
         else:
             shape = (cfg.num_layers, cfg.num_kv_heads, 0, self.page_size,
                      cfg.head_dim)
-            k = onp.zeros(shape, onp.float32)
-            v = onp.zeros(shape, onp.float32)
+            if self.kv_dtype == "int8":
+                k = onp.zeros(shape, onp.int8)
+                v = onp.zeros(shape, onp.int8)
+                ks = onp.zeros(shape[:3], onp.float32)
+                vs = onp.zeros(shape[:3], onp.float32)
+            else:
+                k = onp.zeros(shape, onp.float32)
+                v = onp.zeros(shape, onp.float32)
         meta = {"sid": sid, "pos": int(pos),
                 "pending": int(pending) if pending is not None else None,
                 "history": [int(t) for t in history],
                 "gen": int(gen)}
-        return pack_session(meta, k, v)
+        return pack_session(meta, k, v, ks, vs)
 
     def export_session(self, session):
         """Serialize a parked session into a flat buffer;
@@ -977,6 +1061,16 @@ class DecodeEngine:
             self._tables_dev = jnp.asarray(self._tables)
         return self._tables_dev
 
+    def _fresh_pool(self, shape):
+        """A zeroed KV page pool: a plain fp32 array, or an int8
+        ``QPages`` (codes, per-page-per-head scales) pair.  Scales
+        initialize to ONE so untouched pages (the scratch page,
+        inactive slots) dequantize to exact zeros, like the fp pool."""
+        if self.kv_dtype == "int8":
+            return _paged.QPages(q=jnp.zeros(shape, jnp.int8),
+                                 s=jnp.ones(shape[:3], jnp.float32))
+        return jnp.zeros(shape, jnp.float32)
+
     def _place_kv(self, pages):
         """Pin (or re-pin) a page array to the TP KV sharding.  No-op
         when serving replicated.  Host-side page mutations (`.at[].set`
@@ -1006,11 +1100,13 @@ class DecodeEngine:
                 "per-op decode step for this engine")
             self.decode_fused_mode = None
             self._decode_fn_unfused = _decoder.make_decode_step(
-                self.cfg, self.page_size, sharding=self.sharding)
+                self.cfg, self.page_size, sharding=self.sharding,
+                quant=self.quant, kv_dtype=self.kv_dtype)
             self.launch_stats = _decoder.decode_launch_stats(
                 self.params, self.cfg, self.page_size, self.slots,
                 self.pages_per_seq, self.alloc.total_pages, fused=False,
-                sharding=self.sharding)
+                sharding=self.sharding, quant=self.quant,
+                kv_dtype=self.kv_dtype)
             self.metrics.observe_decode_launches(self.name,
                                                  self.launch_stats)
             return self._decode_fn_unfused(*args)
@@ -1292,7 +1388,9 @@ class DecodeEngine:
         width = 1 + max(len(d) for d in plan.values())
         verify_fn = _decoder.make_verify_step(self.cfg, self.page_size,
                                               width,
-                                              sharding=self.sharding)
+                                              sharding=self.sharding,
+                                              quant=self.quant,
+                                              kv_dtype=self.kv_dtype)
         tokens = onp.zeros((self.slots, width), onp.int32)
         positions = onp.zeros(self.slots, onp.int32)
         n_valid = onp.zeros(self.slots, onp.int32)
@@ -1494,7 +1592,9 @@ class DecodeEngine:
             # a mid-stream XLA compile
             for w in range(2, self._spec.k_cap + 2):
                 vf = _decoder.make_verify_step(self.cfg, self.page_size,
-                                               w, sharding=self.sharding)
+                                               w, sharding=self.sharding,
+                                               quant=self.quant,
+                                               kv_dtype=self.kv_dtype)
                 self._kp, self._vp, out = vf(
                     self.params, self._kp, self._vp,
                     jnp.zeros((self.slots, w), jnp.int32),
@@ -1554,6 +1654,16 @@ class DecodeEngine:
             self._store_client.close()
         return ok
 
+    def _tokens_resident(self):
+        """Logical tokens currently cached in pool pages: live slots'
+        positions plus parked sessions' (replay-pending sessions hold a
+        transcript, not pages)."""
+        with self._cond:
+            toks = sum(s.pos for s in self._slots if s.active)
+            toks += sum(s.pos for s in self._sessions.values()
+                        if not s.busy and s.replay is None)
+        return toks
+
     def stats(self):
         with self._cond:
             active = sum(1 for s in self._slots if s.active)
@@ -1568,6 +1678,13 @@ class DecodeEngine:
                "max_ctx": self.max_ctx,
                "role": self.role,
                "kv": self.alloc.stats(),
+               "quant": {
+                   "weights": self.quant[0] if self.quant else None,
+                   "group": (self.quant[1] if self.quant
+                             and len(self.quant) > 1 else None),
+                   "kv_dtype": self.kv_dtype,
+                   "tokens_resident": self._tokens_resident(),
+               },
                "migration": {"enabled": self._migration_active(),
                              "pagestore": self._pagestore_addr or None},
                "decode_fused": self.decode_fused_mode,

@@ -94,13 +94,27 @@ class PageAllocator:
     physically-resident page exactly once however many tables map it.
     """
 
-    def __init__(self, total_pages, page_size):
+    def __init__(self, total_pages, page_size, kv_dtype="float32",
+                 page_bytes=0, scale_page_bytes=0):
         if total_pages < 2:
             raise ValueError("need >= 2 pages (page 0 is the scratch page)")
         if page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if str(kv_dtype) not in ("float32", "int8"):
+            raise ValueError("kv_dtype must be float32 or int8, got %r"
+                             % (kv_dtype,))
         self.total_pages = int(total_pages)
         self.page_size = int(page_size)
+        # quantized pools (ISSUE 16): int8 pages carry a parallel scales
+        # pool indexed by the SAME page ids, so one refcount/free-list
+        # conservation check covers both pools — check_leaks needs no
+        # second ledger.  The byte costs are optional engine-supplied
+        # geometry (k+v codes per page, k+v scales per page) so stats()
+        # can report physical bytes and the per-token cost with the
+        # scales amortized over the page.
+        self.kv_dtype = str(kv_dtype)
+        self.page_bytes = int(page_bytes)
+        self.scale_page_bytes = int(scale_page_bytes)
         self._lock = threading.Lock()
         # LIFO: freshly freed pages go back out first (warm reuse)
         self._free = list(range(self.total_pages - 1, SCRATCH_PAGE, -1))
@@ -269,7 +283,11 @@ class PageAllocator:
     def check_leaks(self):
         """Conservation check: every allocatable page is either in the
         free list (refcount 0) or referenced by at least one owner list,
-        with refcounts exactly matching the table references.  Raises
+        with refcounts exactly matching the table references.  With an
+        int8 pool the per-page scales ride the SAME page ids as the
+        codes (``QPages`` keeps the two device arrays parallel), so
+        this single check conserves the scales pool too — a page id can
+        no more leak its scale row than its code block.  Raises
         the typed :class:`KVLeakError` (leaked/duplicated page ids
         attached) on violation; returns the owner count when clean."""
         with self._lock:
@@ -308,7 +326,7 @@ class PageAllocator:
         with self._lock:
             cap = self.total_pages - 1
             used = self._used_locked()
-            return {
+            out = {
                 "page_size": self.page_size,
                 "total_pages": cap,
                 "used_pages": used,
@@ -318,15 +336,29 @@ class PageAllocator:
                 "owners": len(self._owned),
                 "shared_pages": self._shared_locked(),
                 "leaked_pages": len(self.last_leak),
+                "kv_dtype": self.kv_dtype,
                 "counters": dict(self.counters),
             }
+            if self.page_bytes:
+                # physical footprint incl. the int8 scales pool, and the
+                # per-resident-token cost with scales amortized over the
+                # page — the capacity lever the bench's 1.9x gate pins
+                per_page = self.page_bytes + self.scale_page_bytes
+                out["scale_page_bytes"] = self.scale_page_bytes
+                out["pool_bytes"] = per_page * cap
+                out["used_bytes"] = per_page * used
+                out["kv_bytes_per_token"] = round(
+                    per_page / self.page_size, 2)
+            return out
 
 
 # -- session wire format --------------------------------------------------
 #
 # One exported session is a flat self-describing buffer:
 #
-#   b"MXKV" | u32 header_len | header JSON | k_pages bytes | v_pages bytes
+#   v1: b"MXKV" | u32 header_len | header JSON | k_pages | v_pages
+#   v2: b"MXKV" | u32 header_len | header JSON | k_pages | v_pages
+#                                              | k_scales | v_scales
 #
 # The header carries the session metadata dict, the block shape/dtype of
 # the gathered pages (layers, kv_heads, n_pages, page_size, head_dim),
@@ -334,33 +366,64 @@ class PageAllocator:
 # import instead of decoding against garbage.  numpy round-trips the
 # bytes exactly, so serialize -> ship -> import is bit-identical (the
 # oracle the migration tests pin).
+#
+# Format v2 (ISSUE 16) carries an int8-quantized cache: the header gains
+# ``kv_dtype`` plus the scales blocks' dtype/shape and their OWN CRC —
+# scales are ~1/(4*head_dim) of the payload but corrupting one poisons a
+# whole page of tokens, so they fail independently and loudly.  A v1
+# blob (no ``kv_dtype`` key) still unpacks: old fp sessions keep
+# migrating into new replicas unchanged.
 
 _MAGIC = b"MXKV"
 _U32 = struct.Struct(">I")
 
 
-def pack_session(meta, k_block, v_block):
+def pack_session(meta, k_block, v_block, k_scales=None, v_scales=None):
     """Serialize one session: ``meta`` (JSON-safe dict) plus the k/v
-    page blocks (numpy arrays, identical shape/dtype) into one buffer."""
+    page blocks (numpy arrays, identical shape/dtype) into one buffer.
+    With ``k_scales``/``v_scales`` (int8 pages: per-(layer, kv_head,
+    page) f32 scales) the blob is format v2; without, the v1 wire is
+    emitted byte-for-byte as before."""
     k = onp.ascontiguousarray(k_block)
     v = onp.ascontiguousarray(v_block)
     if k.shape != v.shape or k.dtype != v.dtype:
         raise ValueError("pack_session: k/v block shape or dtype mismatch")
     kb, vb = k.tobytes(), v.tobytes()
-    header = json.dumps({
+    head = {
         "v": 1,
         "meta": meta,
         "dtype": k.dtype.str,
         "shape": list(k.shape),
         "crc": zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF,
-    }).encode("utf-8")
-    return b"".join([_MAGIC, _U32.pack(len(header)), header, kb, vb])
+    }
+    tail = []
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pack_session: k/v scales must come together")
+    if k_scales is not None:
+        ks = onp.ascontiguousarray(k_scales)
+        vs = onp.ascontiguousarray(v_scales)
+        if ks.shape != vs.shape or ks.dtype != vs.dtype:
+            raise ValueError(
+                "pack_session: k/v scales shape or dtype mismatch")
+        ksb, vsb = ks.tobytes(), vs.tobytes()
+        head["v"] = 2
+        head["kv_dtype"] = onp.dtype(k.dtype).name
+        head["s_dtype"] = ks.dtype.str
+        head["s_shape"] = list(ks.shape)
+        head["s_crc"] = zlib.crc32(vsb, zlib.crc32(ksb)) & 0xFFFFFFFF
+        tail = [ksb, vsb]
+    header = json.dumps(head).encode("utf-8")
+    return b"".join([_MAGIC, _U32.pack(len(header)), header, kb, vb]
+                    + tail)
 
 
-def unpack_session(blob):
+def unpack_session(blob, with_scales=False):
     """Inverse of :func:`pack_session`; returns ``(meta, k_block,
-    v_block)``.  Raises ``ValueError`` on a torn or corrupt buffer
-    (bad magic, truncation, CRC mismatch)."""
+    v_block)``, or ``(meta, k_block, v_block, k_scales, v_scales)``
+    with ``with_scales=True`` (the scales are ``None`` for a v1/fp
+    blob).  Raises ``ValueError`` on a torn or corrupt buffer (bad
+    magic, truncation, CRC mismatch on either the page payload or the
+    v2 scales payload)."""
     if len(blob) < len(_MAGIC) + _U32.size or blob[:4] != _MAGIC:
         raise ValueError("unpack_session: bad magic (torn transfer?)")
     (hlen,) = _U32.unpack_from(blob, 4)
@@ -372,9 +435,18 @@ def unpack_session(blob):
     dtype = onp.dtype(header["dtype"])
     shape = tuple(header["shape"])
     nbytes = dtype.itemsize * int(onp.prod(shape)) if shape else 0
-    if len(blob) != off + 2 * nbytes:
+    quantized = "kv_dtype" in header
+    if quantized:
+        s_dtype = onp.dtype(header["s_dtype"])
+        s_shape = tuple(header["s_shape"])
+        snbytes = (s_dtype.itemsize * int(onp.prod(s_shape))
+                   if s_shape else 0)
+    else:
+        snbytes = 0
+    if len(blob) != off + 2 * nbytes + 2 * snbytes:
         raise ValueError("unpack_session: truncated page payload "
-                         "(%d != %d)" % (len(blob) - off, 2 * nbytes))
+                         "(%d != %d)"
+                         % (len(blob) - off, 2 * nbytes + 2 * snbytes))
     kb = blob[off:off + nbytes]
     vb = blob[off + nbytes:off + 2 * nbytes]
     crc = zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF
@@ -382,6 +454,19 @@ def unpack_session(blob):
         raise ValueError("unpack_session: CRC mismatch (torn transfer)")
     k = onp.frombuffer(kb, dtype=dtype).reshape(shape)
     v = onp.frombuffer(vb, dtype=dtype).reshape(shape)
+    ks = vs = None
+    if quantized:
+        soff = off + 2 * nbytes
+        ksb = blob[soff:soff + snbytes]
+        vsb = blob[soff + snbytes:soff + 2 * snbytes]
+        scrc = zlib.crc32(vsb, zlib.crc32(ksb)) & 0xFFFFFFFF
+        if scrc != header["s_crc"]:
+            raise ValueError(
+                "unpack_session: scales CRC mismatch (torn transfer)")
+        ks = onp.frombuffer(ksb, dtype=s_dtype).reshape(s_shape)
+        vs = onp.frombuffer(vsb, dtype=s_dtype).reshape(s_shape)
+    if with_scales:
+        return header["meta"], k, v, ks, vs
     return header["meta"], k, v
 
 

@@ -110,7 +110,8 @@ class ModelMetrics:
         self.verify_step = LatencyHistogram()
         self.kv_cache = {"used_pages": 0, "total_pages": 0,
                          "peak_used_pages": 0, "shared_pages": 0,
-                         "leaked_pages": 0}
+                         "leaked_pages": 0, "tokens_resident": 0,
+                         "bytes_per_token": 0.0}
         self.tokens_per_s = 0.0  # EMA over decode steps
         # static gauges (set once per engine): the dispatch-count audit
         # of one decode step (fused_cell.count_launches — deterministic,
@@ -150,6 +151,11 @@ class ModelMetrics:
                 "kv_occupancy": (round(
                     self.kv_cache["used_pages"] / total, 4)
                     if total else None),
+                # logical tokens resident in cache pages, and the
+                # physical cost per token (scales amortized) — the
+                # int8-KV capacity story in two numbers
+                "kv_tokens_resident": self.kv_cache["tokens_resident"],
+                "kv_bytes_per_token": self.kv_cache["bytes_per_token"],
                 "kv_cache": dict(self.kv_cache),
             }
             out["generate"]["tokens_per_step"] = (
@@ -316,7 +322,8 @@ class ServingMetrics:
             self._model(name).fn_cache = dict(stats)
 
     def observe_kv_cache(self, name, used_pages, total_pages,
-                         shared_pages=0, leaked_pages=0):
+                         shared_pages=0, leaked_pages=0,
+                         tokens_resident=None, bytes_per_token=None):
         with self._lock:
             kv = self._model(name).kv_cache
             kv["used_pages"] = int(used_pages)
@@ -325,6 +332,10 @@ class ServingMetrics:
             kv["leaked_pages"] = int(leaked_pages)
             kv["peak_used_pages"] = max(kv["peak_used_pages"],
                                         int(used_pages))
+            if tokens_resident is not None:
+                kv["tokens_resident"] = int(tokens_resident)
+            if bytes_per_token is not None:
+                kv["bytes_per_token"] = float(bytes_per_token)
         profiler.record_counter("serving::%s::kv_cache" % name,
                                 used_pages=used_pages)
 

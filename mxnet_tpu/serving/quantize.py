@@ -1,0 +1,209 @@
+"""Weight-only quantization for LLM serving.
+
+The LLM analog of the CNN tier's post-training quantization
+(``contrib.quantization``): decode GEMMs are memory-bandwidth-bound, so
+shrinking the WEIGHT bytes is the throughput lever — activations stay
+fp32, integer weights are dequantized on the fly inside the fused
+kernel (``ops/pallas/quant_matmul``).  Two rungs on the ladder:
+
+- ``int8`` — per-output-channel symmetric scales (the oneDNN scheme
+  ``contrib.quantization._quantize_weight`` uses), ~4x smaller weights,
+  agreement with fp32 greedy decode is near-perfect.
+- ``int4`` — per-group symmetric scales (group 128 by default, the
+  AWQ/GPTQ convention), ~8x smaller, measurably lossier — the serving
+  acceptance gate is greedy-token AGREEMENT against the fp32 engine
+  (thresholded), not bit-parity.
+
+:func:`quantize_lm` wraps a :class:`~..models.decoder.CausalLM` into a
+:class:`QuantizedLM` that duck-types the model surface the
+``DecodeEngine`` consumes (``config`` / ``eos_id`` / ``jax_params()``),
+with the qkv/proj/ffn weight leaves replaced by ``QuantW8``/``QuantW4``
+pytree nodes.  Everything downstream dispatches on the leaf type:
+``decoder._dot_t`` routes quantized leaves through ``quant_matmul``,
+``full_forward`` therefore scores with the SAME integer weights the
+decode programs serve (the in-engine bit-parity batteries — spec vs
+plain, migrated vs unmigrated — run unchanged under quantization), and
+the TP plan shards ``q``/``s`` per the Megatron split of the fp leaf.
+
+Under tensor parallelism int4 groups must not straddle row-parallel
+shards (a scale spans a contiguous input-dim range; shards own disjoint
+ranges), so :meth:`QuantizedLM.jax_params` takes the TP degree and
+shrinks the group to divide the per-shard input dim — scales stay
+shard-local and the packed codes split cleanly along the mesh.
+
+Embeddings, biases, and layernorms stay fp32: they are O(units) per
+token, not O(units^2) — quantizing them saves nothing and costs
+accuracy (the LLM.int8 ladder keeps them high-precision too).
+
+KV-cache quantization (``MXNET_QUANT_KV=int8``) is the engine's side:
+pages store int8 codes with one scale per (layer, kv_head, page),
+latched by the first token written to the page — see
+``ops/pallas/paged_attention.QPages``.  :func:`calibrate_kv_ranges`
+runs the shared ``contrib.calib`` observers over a token battery to
+report what static per-layer KV ranges would look like — the
+diagnostic for how much headroom the dynamic per-page latch buys.
+"""
+from __future__ import annotations
+
+from ..models import decoder as _decoder
+from ..ops.pallas import quant_matmul as _qmm
+
+__all__ = ["QuantizedLM", "quantize_lm", "quantize_params",
+           "calibrate_kv_ranges"]
+
+_MODES = ("int8", "int4")
+
+
+def quantize_params(params, mode="int8", group=128, tp=1):
+    """Quantize the GEMM weight leaves of a decoder param pytree.
+
+    ``params`` is the ``CausalLM.jax_params()`` dict; the qkv/proj/ffn
+    weights (``decoder._QUANT_KINDS``) become :class:`QuantW8` /
+    :class:`QuantW4` nodes, everything else is returned as-is.  With
+    ``tp > 1`` the int4 group shrinks to divide each weight's PER-SHARD
+    input dim (row-parallel leaves split the input axis ``tp`` ways),
+    so no scale group straddles a shard boundary."""
+    if mode not in _MODES:
+        raise ValueError("quantize mode must be one of %r, got %r"
+                         % (_MODES, mode))
+    tp = max(1, int(tp))
+    out = dict(params)
+    layers = []
+    for lp in params["layers"]:
+        qlp = dict(lp)
+        for kind in _decoder._QUANT_KINDS:
+            w = lp[kind]
+            if mode == "int8":
+                qlp[kind] = _qmm.quantize_w8(w)
+            else:
+                in_dim = int(w.shape[1])
+                # row-parallel leaves (wo, w2) shard the input dim
+                local = in_dim // tp if kind in ("wo", "w2") else in_dim
+                qlp[kind] = _qmm.quantize_w4(
+                    w, group=_qmm.group_for(local, group))
+        layers.append(qlp)
+    out["layers"] = layers
+    return out
+
+
+class QuantizedLM:
+    """A served LM with weight-only quantized GEMMs.
+
+    Duck-types what ``DecodeEngine`` (and ``decoder_draft``) read off a
+    model: ``config``, ``eos_id``, ``jax_params()``.  The engine
+    detects the ``quant_mode`` attribute and threads the quantization
+    token into every decode/prefill/verify program build (the programs
+    retrace per weight structure anyway — the token keys the fn
+    cache)."""
+
+    def __init__(self, model, mode="int8", group=128):
+        if mode not in _MODES:
+            raise ValueError("quantize mode must be one of %r, got %r"
+                             % (_MODES, mode))
+        self.model = model
+        self.quant_mode = str(mode)
+        self.group = int(group)
+        self._params = {}        # tp degree -> quantized pytree
+
+    @property
+    def config(self):
+        return self.model.config
+
+    @property
+    def eos_id(self):
+        return getattr(self.model, "eos_id", None)
+
+    def quant_token(self):
+        """The hashable token keying program caches and TP plans:
+        ``("int8",)`` or ``("int4", group)``."""
+        if self.quant_mode == "int8":
+            return ("int8",)
+        return ("int4", self.group)
+
+    def __call__(self, *args, **kw):
+        # the registry lists an attached engine's LM as a served model
+        # (`ModelServer.attach_engine` -> `registry.load`), which
+        # requires a callable; score-path calls fall through to the fp
+        # module (weight-only quantization is a decode-GEMM concern)
+        return self.model(*args, **kw)
+
+    def jax_params(self, tp=1):
+        """Quantized param pytree (cached per TP degree — int4 group
+        boundaries depend on the shard-local input dims)."""
+        tp = max(1, int(tp))
+        key = tp if self.quant_mode == "int4" else 1
+        if key not in self._params:
+            self._params[key] = quantize_params(
+                self.model.jax_params(), self.quant_mode,
+                group=self.group, tp=key)
+        return self._params[key]
+
+    def __repr__(self):
+        return "QuantizedLM(%r, mode=%s%s)" % (
+            self.model, self.quant_mode,
+            ", group=%d" % self.group if self.quant_mode == "int4" else "")
+
+
+def quantize_lm(model, mode="int8", group=128):
+    """Wrap ``model`` for weight-only quantized serving.
+
+    Returns a :class:`QuantizedLM`; hand it to ``DecodeEngine`` in
+    place of the fp model.  ``mode`` is ``"int8"`` (per-output-channel)
+    or ``"int4"`` (per-group, ``group`` inputs per scale).  Quantizing
+    an already-quantized model re-wraps the underlying fp model (modes
+    don't compose — each quantizes from fp32)."""
+    if isinstance(model, QuantizedLM):
+        model = model.model
+    return QuantizedLM(model, mode=mode, group=group)
+
+
+def calibrate_kv_ranges(model, token_batches, mode="entropy"):
+    """Observe per-layer k/v activation ranges over a token battery.
+
+    Runs the model forward (fp32) on each batch of token ids and feeds
+    every layer's freshly-projected k/v activations through the shared
+    ``contrib.calib`` observers; returns ``{"L<i>/k" | "L<i>/v":
+    (min_range, max_range)}`` thresholds.  Purely diagnostic for the
+    serving path — the int8 KV cache latches a scale per page
+    dynamically — but it quantifies the headroom: a static range must
+    cover the worst token ever seen, a per-page scale only the worst
+    token in that page."""
+    import numpy as onp
+
+    from ..contrib.calib import CalibrationCollector
+
+    coll = CalibrationCollector(mode=mode)
+    m = model.model if isinstance(model, QuantizedLM) else model
+    params, cfg = m.jax_params(), m.config
+    for batch in token_batches:
+        toks = onp.asarray(batch, onp.int32)
+        if toks.ndim == 1:
+            toks = toks[None]
+        for li, kk, vv in _layer_kv(params, cfg, toks):
+            coll.track("L%d/k" % li)
+            coll.track("L%d/v" % li)
+            coll.observe("L%d/k" % li, onp.asarray(kk))
+            coll.observe("L%d/v" % li, onp.asarray(vv))
+    return coll.thresholds()
+
+
+def _layer_kv(params, cfg, tokens):
+    """Yield ``(layer_idx, k, v)`` activations of a full fp forward —
+    the observation points :func:`calibrate_kv_ranges` feeds to the
+    calibrator (mirrors ``decoder.full_forward`` layer by layer)."""
+    import jax.numpy as jnp
+
+    from ..ops import attention as _attention
+
+    B, L = tokens.shape
+    g = cfg.num_heads // cfg.num_kv_heads
+    x = params["embed"][tokens] + params["pos"][:L]
+    for li, lp in enumerate(params["layers"]):
+        q, k, v = _decoder._qkv(x, lp, cfg)
+        yield li, k, v
+        q4 = jnp.transpose(q, (0, 2, 1, 3))
+        k4 = jnp.repeat(jnp.transpose(k, (0, 2, 1, 3)), g, axis=1)
+        v4 = jnp.repeat(jnp.transpose(v, (0, 2, 1, 3)), g, axis=1)
+        att = _attention.flash_attention(q4, k4, v4, causal=True)
+        merged = jnp.transpose(att, (0, 2, 1, 3)).reshape(B, L, cfg.units)
+        x = _decoder._layer_tail(x, merged, lp)

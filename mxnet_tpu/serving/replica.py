@@ -36,6 +36,11 @@ names it explicitly — ``{"mesh_shape": [1, 2],
 ``for_transformer()`` rules apply (qkv/ffn1 column-parallel, proj/ffn2
 row-parallel) and the KV pages shard along KV heads.
 
+A generate spec may also carry a ``"quant"`` block (see
+:func:`resolve_quant`) booting the replica quantized: ``{"weights":
+"int8" | "int4", "group": 128, "kv": "int8"}`` — weight-only decode
+GEMMs and/or int8 KV-cache pages.
+
 Models are named by importable *builder path*, never shipped as code —
 only callables already on this process's PYTHONPATH can load (the
 restricted-unpickler stance, applied to serving).
@@ -62,7 +67,7 @@ import time
 import numpy as onp
 
 __all__ = ["main", "demo_affine", "demo_dense", "demo_faulty",
-           "resolve_sharding"]
+           "resolve_sharding", "resolve_quant"]
 
 
 # ---------------------------------------------------------------------------
@@ -136,6 +141,27 @@ def resolve_sharding(block):
         axis_names=tuple(axes) if axes else None)
 
 
+def resolve_quant(block):
+    """Resolve a generate-spec ``"quant"`` block into ``DecodeEngine``
+    kwargs.  ``{"weights": "int8" | "int4", "group": 128, "kv":
+    "int8"}`` — every key optional: ``weights`` picks the weight-only
+    mode (``group`` sizes the int4 scale groups), ``kv`` switches the
+    KV-cache pages to int8 codes + per-page scales.  ``None``/empty
+    resolves to ``{}`` (the engine then follows the
+    ``MXNET_QUANT_WEIGHTS``/``MXNET_QUANT_KV`` environment, which the
+    fleet supervisor can stamp per replica)."""
+    if not block:
+        return {}
+    out = {}
+    if block.get("weights"):
+        out["quantize"] = str(block["weights"])
+    if block.get("group") is not None:
+        out["quant_group"] = int(block["group"])
+    if block.get("kv"):
+        out["kv_dtype"] = str(block["kv"])
+    return out
+
+
 # ---------------------------------------------------------------------------
 # process entry
 # ---------------------------------------------------------------------------
@@ -182,6 +208,7 @@ def main(argv=None):
     for name, model, genkw in generators:
         from .generate import DecodeEngine
         genkw["sharding"] = resolve_sharding(genkw.get("sharding"))
+        genkw.update(resolve_quant(genkw.pop("quant", None)))
         server.attach_engine(name, DecodeEngine(model, name=name, **genkw))
     server.start()
     print("REPLICA_READY id=%s port=%d warm_s=%.2f cache=%s"

@@ -393,6 +393,8 @@ class ModelServer:
                             continue
                         lines.append("mxtpu_serving_%s_%s{%s} %g"
                                      % (hist, k, labels, v))
+                # (kv_tokens_resident / kv_bytes_per_token ride the
+                # kv_cache loop below — one sample per name)
                 for gauge in ("tokens_per_s", "decode_occupancy",
                               "kv_occupancy"):
                     if gen.get(gauge) is not None:
@@ -413,6 +415,6 @@ class ModelServer:
                 for k, v in sorted((gen.get("kv_cache") or {}).items()):
                     # used/total/peak_used/shared/leaked page gauges —
                     # leaked_pages nonzero is the alert condition
-                    lines.append("mxtpu_serving_kv_%s{%s} %d"
+                    lines.append("mxtpu_serving_kv_%s{%s} %g"
                                  % (k, labels, v))
         return "\n".join(lines) + "\n"
