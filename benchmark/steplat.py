@@ -221,6 +221,87 @@ def speculative_steplat(measure=True, iters=10, slots=8, page_size=8,
     return out
 
 
+def decode_async_steplat(slots=4, page_size=8, max_new=48, n_requests=8,
+                         model_kw=None):
+    """Sync vs async DecodeEngine A/B on one greedy workload (ISSUE 17).
+
+    Reports, per mode: end-to-end tokens/sec, inter-token p50, device
+    decode-step time (the ``decode_step`` histogram — launch→retire
+    wall for async, launch→force for sync), host-gap μs/step (host
+    scheduling time exposed between a result landing and the next
+    launch — the quantity pipelining hides), and the achieved dispatch
+    depth.  Each mode runs the workload once untimed (warm the box —
+    first-run wall clock is dominated by cache/turbo transients, which
+    otherwise bias the arm that runs second) before the measured pass.
+    ``host_cores`` keys the regime: overlap needs a second execution
+    unit, so on a 1-core host the async arm's ceiling is parity (total
+    work is conserved; the hidden host gap still burns the same core)
+    and the honest win signal is the host-gap-share collapse, which is
+    what the chip converts into throughput.  Two static properties
+    ride along for the tier-1 gate: the async launch census must be
+    IDENTICAL to sync (pipelining reorders dispatch, it adds no
+    programs) and the emitted token streams must be bit-equal."""
+    from mxnet_tpu.models import decoder as dec
+    from mxnet_tpu import serving
+
+    kw = dict(vocab_size=128, num_layers=2, units=64, hidden_size=128,
+              num_heads=4, num_kv_heads=2, max_length=128)
+    kw.update(model_kw or {})
+    lm = dec.decoder_tiny_lm(seed=0, **kw)
+    prompts = [[(3 * i + j) % 96 + 1 for j in range(4)]
+               for i in range(n_requests)]
+    # staggered budgets: uniform max_new would finish whole waves at
+    # once, draining the pipeline at every boundary and charging the
+    # async arm exposed gaps that sustained load never shows
+    budgets = [max_new - (7 * i) % 17 for i in range(n_requests)]
+    out = {"slots": slots, "max_new": max_new, "requests": n_requests,
+           "host_cores": os.cpu_count()}
+    census, streams = {}, {}
+    for mode, async_on in (("sync", False), ("async", True)):
+        eng = serving.DecodeEngine(
+            lm, name="steplat", slots=slots, page_size=page_size,
+            prefill_chunk=8, max_ctx=kw["max_length"],
+            prefix_cache=False, async_decode=async_on)
+        try:
+            eng.warmup()
+            # warm pass: identical workload, untimed — metrics reset
+            # after so the measured pass owns the histograms
+            for f in [eng.submit(list(p), max_new_tokens=n)
+                      for p, n in zip(prompts, budgets)]:
+                f.result(timeout=600)
+            eng.metrics.reset()
+            t0 = time.perf_counter()
+            futs = [eng.submit(list(p), max_new_tokens=n)
+                    for p, n in zip(prompts, budgets)]
+            res = [f.result(timeout=600) for f in futs]
+            wall = time.perf_counter() - t0
+        finally:
+            eng.stop(drain=False)
+        census[mode] = dict(eng.launch_stats)
+        streams[mode] = [r["tokens"] for r in res]
+        m = eng.metrics.snapshot()["models"]["steplat"]
+        gen = m["generate"]
+        n_tok = sum(len(r["tokens"]) for r in res)
+        step_ms = gen["decode_step"].get("mean_ms", 0.0)
+        gap_us = gen.get("host_gap_us", {}).get("mean_us", 0.0)
+        row = {"tokens_per_sec": round(n_tok / wall, 1),
+               "inter_token_p50_ms": gen["inter_token"].get("p50_ms"),
+               "device_step_us": round(step_ms * 1e3, 2),
+               "host_gap_us_per_step": round(gap_us, 2),
+               "host_gap_share": (round(gap_us / (step_ms * 1e3), 4)
+                                  if step_ms else None),
+               "deferred_reads": m["counters"].get(
+                   "deferred_reads_total", 0)}
+        if async_on:
+            dd = gen.get("dispatch_depth", {})
+            row["dispatch_depth_mean"] = dd.get("mean", 0)
+            row["dispatch_depth_max"] = dd.get("max", 0)
+        out[mode] = row
+    out["launch_census_identical"] = census["async"] == census["sync"]
+    out["bit_identical_streams"] = streams["async"] == streams["sync"]
+    return out
+
+
 def sharded_steplat(mesh_shape=(4, 2), axis_names=("dp", "tp"), B=8, L=32,
                     units=64, hidden=128, heads=2, measure=True, iters=10,
                     zero=0, remat=None):
@@ -323,6 +404,7 @@ def main():
         "lstm": lstm_steplat(),
         "decode": decode_steplat(),
         "speculative": speculative_steplat(),
+        "decode_async": decode_async_steplat(),
     }
     sharded = {}
     for name, shape, axes, kw in (

@@ -409,6 +409,18 @@ register("MXNET_GEN_FN_CACHE", int, 16, "honored",
          "geometries cannot grow compiled-program memory unboundedly; "
          "compile/evict counts are exported in ServingMetrics",
          "models.decoder._FnCache")
+register("MXNET_GEN_ASYNC", int, 1, "honored",
+         "1 = async decode engine: the host pipelines scheduling "
+         "against the in-flight device step (JAX async dispatch — "
+         "sampled tokens stay on-device and are read only once the "
+         "next launch is in flight; emission/metrics/EOS shift to "
+         "retire time).  0 restores the fully synchronous step loop",
+         "serving.DecodeEngine")
+register("MXNET_GEN_DISPATCH_AHEAD", int, 1, "honored",
+         "async decode dispatch depth: launched-but-unretired decode "
+         "steps the engine keeps in flight (1 = classic double "
+         "buffering; raise only when a slow host cannot fill one "
+         "device step of schedule work)", "serving.DecodeEngine")
 register("MXNET_QUANT_WEIGHTS", str, "", "honored",
          "weight-only quantized LLM serving: 'int8' (per-output-channel "
          "scales) or 'int4' (per-group, see MXNET_QUANT_GROUP) "

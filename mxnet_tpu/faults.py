@@ -41,6 +41,10 @@ Sites (grep for ``faults.check``):
   decode.step        LLM decode engine, before one whole-batch decode
                      iteration (exception kinds poison the in-flight
                      decode batch typed; the engine keeps serving)
+  engine.retire      async decode engine, before one in-flight step's
+                     deferred host read (exception kinds typed-fail only
+                     that step's batch, the pipeline flushes, and the
+                     engine keeps serving)
   kvcache.alloc      paged KV-cache page allocation (exception kinds fail
                      only the allocating sequence; genuine exhaustion is
                      NOT a fault — it triggers preemption)
@@ -113,7 +117,8 @@ _SOFT_KINDS = ("drop", "torn", "preempt", "kill")
 KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
                "server.membership", "trainer.step", "checkpoint.write",
                "router.dispatch", "replica.crash", "decode.step",
-               "kvcache.alloc", "session.export", "session.import",
+               "engine.retire", "kvcache.alloc",
+               "session.export", "session.import",
                "speculate.draft", "speculate.verify",
                "mesh.reshard", "checkpoint.shard_read")
 

@@ -55,7 +55,8 @@ warnings.filterwarnings(
 
 __all__ = ["DecoderConfig", "CausalLM", "full_forward", "make_decode_step",
            "make_decode_step_fused", "make_prefill_chunk",
-           "make_verify_step", "fn_cache_stats", "decode_launch_stats",
+           "make_verify_step", "make_token_combine",
+           "fn_cache_stats", "decode_launch_stats",
            "verify_launch_stats", "decode_collective_stats", "tp_plan",
            "TPPlan", "decoder_tiny", "decoder_tiny_lm", "decoder_draft"]
 
@@ -565,6 +566,25 @@ def _build_decode_step(cfg, page_size, plan=None):
     if plan is None:
         return jax.jit(step, donate_argnums=(1, 2))
     return plan.wrap(step, n_rest=4, n_out_rest=2)
+
+
+def make_token_combine(slots):
+    """Build (or fetch) the async engine's lane-merge program: the next
+    step's input tokens without a host read.
+
+    Continuing lanes chain on the in-flight step's on-device
+    ``next_tokens`` (``carry`` true); lanes that joined the batch since
+    (fresh prefills) feed their host-staged pending token.  Keeping the
+    merge on-device is what lets the launch half of a pipelined step go
+    out before anyone has forced the previous step's result — the decode
+    program itself is untouched, so the static launch census is too.
+
+    fn(chained (B,) int32, staged (B,) int32, carry (B,) bool)
+      -> (B,) int32
+    """
+    key = ("combine", int(slots))
+    return _fn_cache.get(key, lambda: jax.jit(
+        lambda chained, staged, carry: jnp.where(carry, chained, staged)))
 
 
 def _group_bounds(num_layers, layer_group):

@@ -57,11 +57,29 @@ The Orca + vLLM serving recipe, grown onto this repo's serving stack:
   replica to claim (DistServe/Splitwise disaggregation); the fleet
   router splits long fresh prompts across the two pools.
 
+- **Async step pipelining** (``MXNET_GEN_ASYNC``, default on) — the
+  decode step splits into a *launch* half and a *retire* half with a
+  depth-``MXNET_GEN_DISPATCH_AHEAD`` in-flight queue.  JAX dispatch is
+  asynchronous: a launched step returns device futures immediately, so
+  the sampled tokens stay on-device and the next step's token input
+  CHAINS on them (``decoder.make_token_combine``) — the host forces a
+  result only once the next launch is already in flight.  Admission,
+  eviction, EOS, emission, and metrics shift to retire time; deadlines
+  are checked at launch time so pipelining never extends one; pages an
+  in-flight step writes are pinned (frees defer to that step's retire).
+  Under speculation the verify input depends on host-side acceptance,
+  so verify steps retire-then-relaunch instead of chaining — but
+  drafting overlaps the in-flight verify (``reuse_predraft``) and the
+  deferred bookkeeping runs while the next launch computes.
+  ``MXNET_GEN_ASYNC=0`` restores the fully synchronous loop; either
+  way the emitted greedy streams are bit-identical.
+
 Admission control mirrors ``DynamicBatcher`` exactly (and composes with
 it via ``DynamicBatcher.register_engine``): bounded queue sheds with
 ``QueueFullError``, draining rejects with ``ServerClosedError``,
 deadlines expire typed, and a failed sequence poisons only its own
-future.  Fault sites: ``decode.step`` (one decode iteration) and
+future.  Fault sites: ``decode.step`` (one decode iteration),
+``engine.retire`` (one in-flight step's deferred read) and
 ``kvcache.alloc`` (page allocation) — see ``tools/chaos.py
 --scenario llm``.
 """
@@ -75,6 +93,7 @@ from concurrent.futures import Future
 
 import numpy as onp
 
+import jax
 import jax.numpy as jnp
 
 from .. import config as _config
@@ -119,16 +138,41 @@ class _Request:
 class _Slot:
     __slots__ = ("req", "state", "owner", "prompt", "done", "pos",
                  "history", "generated", "pending", "t_last", "admit_seq",
-                 "idx", "cacheable")
+                 "idx", "cacheable", "flight", "predraft")
 
     def __init__(self, idx):
         self.idx = idx
         self.req = None
-        self.state = "idle"   # idle | prefill | decode
+        self.state = "idle"   # idle | prefill | decode | finishing
+        self.flight = 0       # launched-but-unretired lanes (async)
+        self.predraft = None  # overlapped draft awaiting the next launch
 
     @property
     def active(self):
         return self.state != "idle"
+
+
+class _Flight:
+    """One launched-but-unretired decode step (async engine).
+
+    Holds the on-device results (forced only at retire), the lanes it
+    carries as ``(slot, admit_seq-at-launch)`` pairs — a slot recycled
+    since launch fails the seq check and its lane is discarded — the
+    owners whose pages the step writes (pinned: the allocator must not
+    recycle them until this retire), and deferred page-release callbacks
+    from sequences that ended while the step was still in flight."""
+
+    __slots__ = ("kind", "out", "t_launch", "lanes", "owners", "fed",
+                 "on_retire")
+
+    def __init__(self, kind, out, t_launch, lanes, owners, fed=None):
+        self.kind = kind          # "plain" | "verify"
+        self.out = out            # jax.Array device future(s)
+        self.t_launch = t_launch
+        self.lanes = lanes
+        self.owners = owners
+        self.fed = fed or {}      # slot idx -> fed token row (spec path)
+        self.on_retire = []
 
 
 class _Session:
@@ -190,7 +234,8 @@ class DecodeEngine:
                  prefix_cache=None, role=None, migrate=None,
                  pagestore=None, speculate=None, spec_k=None,
                  drafter=None, draft_model=None, sharding=None,
-                 quantize=None, quant_group=None, kv_dtype=None):
+                 quantize=None, quant_group=None, kv_dtype=None,
+                 async_decode=None, dispatch_ahead=None):
         # quantized serving (weight-only int8/int4 + int8 KV pages):
         # accept a pre-wrapped serving.quantize.QuantizedLM, or wrap
         # here from the kwarg/env knob.  Weights and KV cache quantize
@@ -373,6 +418,31 @@ class DecodeEngine:
         if use_spec and self.role != "prefill":
             self._spec = self._build_spec(drafter, draft_model, spec_k)
 
+        # async step pipelining (MXNET_GEN_ASYNC): the decode step
+        # splits into launch/retire halves with a bounded in-flight
+        # queue — see the module docstring and _decode_async below
+        self.async_decode = (bool(async_decode) if async_decode is not None
+                             else bool(_config.get("MXNET_GEN_ASYNC")))
+        self.dispatch_ahead = max(1, int(
+            dispatch_ahead if dispatch_ahead is not None
+            else _config.get("MXNET_GEN_DISPATCH_AHEAD")))
+        self._pipe = collections.deque()  # in-flight _Flight entries
+        self._flight_owners = {}          # owner -> in-flight refcount
+        self._t_force_end = None          # last forced-read end (host gap)
+        self._t_last_retire = None        # retire cadence (decode_step)
+        # pinned staging buffers, reused every step: batch formation
+        # fills these in place instead of allocating fresh numpy arrays,
+        # and the device active mask re-uploads only when it changes.
+        # Uploads go through jnp.array (an explicit copy): jnp.asarray
+        # zero-copy-aliases numpy memory on CPU, and a buffer an
+        # in-flight launch still reads must never be mutated in place.
+        self._stage_tokens = onp.zeros(self.slots, onp.int32)
+        self._stage_positions = onp.zeros(self.slots, onp.int32)
+        self._stage_active = onp.zeros(self.slots, bool)
+        self._stage_carry = onp.zeros(self.slots, bool)
+        self._active_dev = None
+        self._active_key = None
+
     # -- admission --------------------------------------------------------
     @property
     def draining(self):
@@ -461,13 +531,13 @@ class DecodeEngine:
         while True:
             with self._cond:
                 while (not self._stopping and not self._queue
-                       and not self._ops
+                       and not self._ops and not self._pipe
                        and not any(s.active for s in self._slots)):
                     self._cond.wait(0.1)
                     self._expire_sessions_locked()
                 if self._stopping:
                     busy = (any(s.active for s in self._slots)
-                            or self._ops
+                            or self._ops or self._pipe
                             or (self._drain_mode and self._queue))
                     if not busy:
                         return
@@ -479,6 +549,14 @@ class DecodeEngine:
 
     def _step(self):
         now = time.perf_counter()
+        if self._pipe:
+            with self._cond:
+                ops = bool(self._ops)
+            if ops:
+                # worker ops (session imports/exports) read or rewrite
+                # the page pools and tables; run them against retired,
+                # fully materialized state
+                self._flush_pipe()
         self._drain_ops()
         self._expire_queued(now)
         with self._cond:
@@ -785,7 +863,7 @@ class DecodeEngine:
                              gen=sess.gen):
                     with self._cond:
                         self._sessions.pop(sess.sid, None)
-                    self.alloc.free(sess.owner)
+                    self._free_owner(sess.owner)
                     self._spec_release(sess.owner, sess.sid)
                     moved += 1
                     self.metrics.count(self.name, "migrations_out_total")
@@ -834,7 +912,7 @@ class DecodeEngine:
             return False
         with self._cond:
             self._sessions.pop(req.session, None)
-        self.alloc.free(slot.owner)
+        self._free_owner(slot.owner)
         self._spec_release(slot.owner, req.session)
         self.metrics.count(self.name, "migrations_out_total")
         return True
@@ -857,7 +935,7 @@ class DecodeEngine:
         for sid in [sid for sid, s in self._sessions.items()
                     if not s.busy and s.last_used < cutoff]:
             sess = self._sessions.pop(sid)
-            self.alloc.free(sess.owner)
+            self._free_owner(sess.owner)
             self._spec_release(sess.owner, sid)
 
     # -- scheduling -------------------------------------------------------
@@ -982,6 +1060,8 @@ class DecodeEngine:
         slot.history = history
         slot.generated = []
         slot.pending = None
+        slot.flight = 0
+        slot.predraft = None
         slot.t_last = time.perf_counter()
         slot.admit_seq = self._seq
         slot.cacheable = (self.prefix_cache is not None
@@ -1034,7 +1114,7 @@ class DecodeEngine:
                 return False
             victim = min(idle, key=lambda s: s.last_used)
             del self._sessions[victim.sid]
-        self.alloc.free(victim.owner)
+        self._free_owner(victim.owner)
         self._spec_release(victim.owner, victim.sid)
         return True
 
@@ -1058,8 +1138,50 @@ class DecodeEngine:
 
     def _tables_device(self):
         if self._tables_dev is None:
-            self._tables_dev = jnp.asarray(self._tables)
+            # jnp.array, not asarray: the device copy must be a real
+            # copy — an in-flight launch keeps reading it after the
+            # host mutates self._tables for the next step
+            self._tables_dev = jnp.array(self._tables)
         return self._tables_dev
+
+    def _active_device(self, mask):
+        """Device copy of the active mask, re-uploaded only when the
+        membership actually changes (steady-state steps reuse it)."""
+        key = mask.tobytes()
+        if self._active_key != key:
+            self._active_dev = jnp.array(mask)
+            self._active_key = key
+        return self._active_dev
+
+    # -- in-flight page pinning (async pipeline) --------------------------
+    def _pin_owners(self, fl):
+        for o in fl.owners:
+            self._flight_owners[o] = self._flight_owners.get(o, 0) + 1
+
+    def _unpin_owners(self, fl):
+        for o in fl.owners:
+            n = self._flight_owners.get(o, 0) - 1
+            if n > 0:
+                self._flight_owners[o] = n
+            else:
+                self._flight_owners.pop(o, None)
+
+    def _free_owner(self, owner):
+        """Release an owner's pool pages, deferred past any in-flight
+        step that still writes them: the free list must never recycle a
+        page an unretired launch targets.  The release callback runs in
+        the pinning step's retire (or the pipeline flush), so
+        ``check_leaks`` is conserved once the pipe is empty."""
+        if owner is None:
+            return
+        with self._cond:
+            if self._flight_owners.get(owner):
+                for fl in reversed(self._pipe):
+                    if owner in fl.owners:
+                        fl.on_retire.append(
+                            lambda o=owner: self.alloc.free(o))
+                        return
+        self.alloc.free(owner)
 
     def _fresh_pool(self, shape):
         """A zeroed KV page pool: a plain fp32 array, or an int8
@@ -1148,7 +1270,10 @@ class DecodeEngine:
     def _preempt_victim(self, exclude):
         victim = None
         for s in self._slots:
-            if s.active and s is not exclude:
+            # "finishing" slots are done — their result is decided and
+            # their pages release in the imminent deferred phase;
+            # preempt-recompute would replay a completed stream
+            if s.active and s is not exclude and s.state != "finishing":
                 if victim is None or s.admit_seq > victim.admit_seq:
                     victim = s
         return victim
@@ -1169,7 +1294,7 @@ class DecodeEngine:
         new.prefix = req.prefix + slot.generated
         new.ttft_recorded = req.ttft_recorded
         new.prompt_tokens = req.prompt_tokens
-        self.alloc.free(slot.owner)
+        self._free_owner(slot.owner)
         self._spec_release(slot.owner)  # draft cache is stale with the pages
         if req.session is not None:
             # the parked context is gone with the pages; the requeued
@@ -1239,6 +1364,8 @@ class DecodeEngine:
 
     # -- decode -----------------------------------------------------------
     def _decode(self):
+        if self.async_decode:
+            return self._decode_async()
         batch = [s for s in self._slots if s.state == "decode"]
         if not batch:
             return
@@ -1264,20 +1391,33 @@ class DecodeEngine:
             return
         if self._spec is not None and self._decode_speculative(live):
             return
-        tokens = onp.zeros(self.slots, onp.int32)
-        positions = onp.zeros(self.slots, onp.int32)
-        active = onp.zeros(self.slots, bool)
+        tokens = self._stage_tokens
+        positions = self._stage_positions
+        active = self._stage_active
+        tokens.fill(0)
+        positions.fill(0)
+        active.fill(False)
         for s in live:
             tokens[s.idx] = s.pending
             positions[s.idx] = s.pos
             active[s.idx] = True
         t0 = time.perf_counter()
+        if self._t_force_end is not None:
+            # host gap: wall time this step spent on scheduling between
+            # the previous result landing and this launch going out (the
+            # quantity async mode hides behind the in-flight step)
+            self.metrics.observe_host_gap(
+                self.name, max(0.0, t0 - self._t_force_end))
+        # staging buffers are reused next step: uploads must copy
+        # (jnp.array), never alias (jnp.asarray aliases host memory on
+        # CPU and the dispatch reads it after we mutate)
         self._kp, self._vp, next_tokens, _ = self._run_decode_fn(
-            self.params, self._kp, self._vp, jnp.asarray(tokens),
-            jnp.asarray(positions), self._tables_device(),
-            jnp.asarray(active))
+            self.params, self._kp, self._vp, jnp.array(tokens),
+            jnp.array(positions), self._tables_device(),
+            self._active_device(active))
         next_tokens = onp.asarray(next_tokens)
         now = time.perf_counter()
+        self._t_force_end = now
         for s in live:
             tok = int(next_tokens[s.idx])
             s.history.append(s.pending)
@@ -1290,6 +1430,508 @@ class DecodeEngine:
         self.metrics.observe_decode_step(
             self.name, now - t0, now - t0, len(live), self.slots,
             len(live))
+
+    # -- async decode pipeline --------------------------------------------
+    def _decode_async(self):
+        """Double-buffered decode: launch step N+1 while step N's result
+        is still materializing on device, then retire launches down to
+        the configured dispatch depth.  Sampled tokens stay on device as
+        jax.Arrays and chain into the next launch through a jitted
+        ``where(carry, chained, staged)`` — the host reads a step's
+        result (one ``jax.device_get``) only once the next launch is
+        already in flight, so scheduling overhead hides behind device
+        compute instead of serializing with it."""
+        if self._spec is not None:
+            return self._decode_async_spec()
+        launched = self._launch_decode()
+        limit = self.dispatch_ahead if launched else 0
+        while len(self._pipe) > limit:
+            self._retire_one()
+
+    def _launch_decode(self):
+        """Dispatch one plain decode step without waiting for in-flight
+        results.  Lanes with work in flight take their input token from
+        the newest launch's on-device output (``carry``); fresh lanes
+        stage theirs from the host.  Launch-time exclusions (budget,
+        context, deadline) count in-flight lanes, and they are monotone
+        until a retire runs — so every carried lane is guaranteed to be
+        riding ``self._pipe[-1]``.  Returns True when a step launched."""
+        now = time.perf_counter()
+        batch = []
+        for s in self._slots:
+            if s.state != "decode":
+                continue
+            req = s.req
+            if req.expired(now):
+                # deadline is judged against launch time; a slot with
+                # lanes still in flight expires at its retire instead
+                if s.flight == 0:
+                    self._finish(s, "deadline")
+                continue
+            if len(s.generated) + s.flight + len(req.prefix) >= req.max_new:
+                continue  # in-flight lanes already cover the budget
+            if s.pos + s.flight >= self.max_ctx:
+                continue
+            batch.append(s)
+        if not batch:
+            return False
+        try:
+            faults.check("decode.step")
+        except Exception as e:
+            for s in batch:
+                self._fail_slot(s, ServingError(
+                    "decode step failed: %r" % (e,)))
+            return False
+        depth0 = len(self._pipe)
+        live = []
+        for s in batch:
+            if s.state != "decode":
+                continue  # a peer's page scramble took it down
+            ok = self._grow_pages_inflight(s)
+            if len(self._pipe) != depth0:
+                # growth flushed the pipeline (OOM relief); every flight
+                # count is stale now — abandon this launch and let the
+                # next step rebuild from quiesced state
+                return False
+            if ok and s.state == "decode":
+                live.append(s)
+        live = [s for s in live if s.state == "decode"]
+        if not live:
+            return False
+        st = self._stage_tokens
+        sp = self._stage_positions
+        sa = self._stage_active
+        carry = self._stage_carry
+        st.fill(0)
+        sp.fill(0)
+        sa.fill(False)
+        carry.fill(False)
+        chain = False
+        for s in live:
+            sp[s.idx] = s.pos + s.flight
+            sa[s.idx] = True
+            if s.flight > 0:
+                carry[s.idx] = True  # input is the in-flight step's output
+                chain = True
+            else:
+                st[s.idx] = s.pending
+        # reused staging buffers: upload must COPY (jnp.array) — the
+        # dispatch reads host memory asynchronously and we refill these
+        # arrays before it completes
+        if chain and onp.array_equal(carry, sa):
+            # steady state: every live lane chains, so the combine is
+            # the identity — feed the in-flight output straight in.
+            # Inactive lanes see that step's garbage rows, which the
+            # active mask already quarantines (scratch-page writes,
+            # outputs nobody retires).
+            tokens = self._pipe[-1].out
+        elif chain:
+            tokens = _decoder.make_token_combine(self.slots)(
+                self._pipe[-1].out, jnp.array(st), jnp.array(carry))
+        else:
+            tokens = jnp.array(st)
+        t0 = time.perf_counter()
+        if self._t_force_end is not None:
+            # with lanes in flight the host gap is hidden (0 by
+            # construction); an empty pipe exposes it like sync mode
+            self.metrics.observe_host_gap(
+                self.name,
+                0.0 if depth0 else max(0.0, t0 - self._t_force_end))
+        self._kp, self._vp, out, _ = self._run_decode_fn(
+            self.params, self._kp, self._vp, tokens, jnp.array(sp),
+            self._tables_device(), self._active_device(sa))
+        fl = _Flight("plain", out, t0, [(s, s.admit_seq) for s in live],
+                     set(s.owner for s in live))
+        for s in live:
+            s.flight += 1
+        with self._cond:
+            self._pipe.append(fl)
+            self._pin_owners(fl)
+        self.metrics.observe_dispatch_depth(self.name, len(self._pipe))
+        return True
+
+    def _retire_one(self):
+        """Force the oldest in-flight step's tokens to the host and run
+        its bookkeeping (history/pos advance, emission, inter-token +
+        decode-step metrics, EOS/length/deadline finishes).  Lanes whose
+        slot was recycled since launch (admit-seq mismatch) are
+        discarded — their tokens were never promised to anyone."""
+        with self._cond:
+            if not self._pipe:
+                return
+            fl = self._pipe.popleft()
+        try:
+            faults.check("engine.retire")
+        except Exception as e:
+            self._retire_poisoned(fl, e)
+            return
+        toks = jax.device_get(fl.out)
+        now = time.perf_counter()
+        self._t_force_end = now
+        self.metrics.count(self.name, "deferred_reads_total")
+        with self._cond:
+            self._unpin_owners(fl)
+        live = 0
+        for s, seq in fl.lanes:
+            if s.req is None or s.admit_seq != seq or s.state != "decode":
+                continue
+            s.flight = max(0, s.flight - 1)
+            tok = int(toks[s.idx])
+            s.history.append(s.pending)
+            s.pos += 1
+            s.generated.append(tok)
+            s.pending = tok
+            self.metrics.observe_inter_token(self.name, now - s.t_last)
+            s.t_last = now
+            live += 1
+            self._maybe_finish(s, now)
+        for cb in fl.on_retire:
+            cb()
+        if live:
+            # step wall = retire cadence in steady state (launch→retire
+            # spans the whole pipeline depth and would read ~depth× the
+            # true per-step time); first retire after an idle pipe falls
+            # back to its own launch→retire wall
+            base = max(fl.t_launch, self._t_last_retire or 0.0)
+            self.metrics.observe_decode_step(
+                self.name, now - base, now - base, live,
+                self.slots, live)
+        self._t_last_retire = now
+
+    def _retire_poisoned(self, fl, exc):
+        """An ``engine.retire`` fault (or a real device-read failure)
+        poisons exactly one flight: its live lanes fail typed, its pins
+        release, and the REST of the pipeline is discarded unread —
+        chained launches downstream consumed this step's now-unreadable
+        tokens, and surviving slots simply relaunch from their last
+        confirmed token (greedy decode recomputes the identical
+        stream).  The engine keeps serving."""
+        with self._cond:
+            self._unpin_owners(fl)
+        err = ServingError("decode retire failed: %r" % (exc,))
+        for s, seq in fl.lanes:
+            if s.req is not None and s.admit_seq == seq \
+                    and s.state in ("decode", "finishing"):
+                self._fail_slot(s, err)
+        for cb in fl.on_retire:
+            cb()
+        self._flush_pipe(discard=True)
+
+    def _flush_pipe(self, discard=False):
+        """Drain every in-flight launch.  ``discard=True`` drops results
+        without reading them (downstream of a poisoned flight): valid
+        lanes just lose their in-flight count and relaunch from their
+        last confirmed token."""
+        while self._pipe:
+            if not discard:
+                self._retire_oldest()
+                continue
+            with self._cond:
+                if not self._pipe:
+                    break
+                fl = self._pipe.popleft()
+                self._unpin_owners(fl)
+            for s, seq in fl.lanes:
+                if s.req is not None and s.admit_seq == seq:
+                    s.flight = max(0, s.flight - 1)
+            for cb in fl.on_retire:
+                cb()
+
+    def _retire_oldest(self):
+        if self._spec is not None:
+            rec = self._retire_spec()
+            if rec is not None:
+                self._run_spec_deferred(rec)
+            return
+        self._retire_one()
+
+    def _grow_pages_inflight(self, s):
+        """Page growth for an async launch: the slot's cache must cover
+        ``pos + flight + 1`` positions (every unretired lane writes one).
+        The happy path allocates from the free list without touching
+        peers; on pressure the pipeline is flushed FIRST so the sync
+        preemption machinery (:meth:`_ensure_pages`) runs against a
+        quiesced engine whose flight counts are all zero."""
+        need = (pages_for(s.pos + s.flight + 1, self.page_size)
+                - len(self.alloc.pages(s.owner)))
+        if need <= 0:
+            return True
+        try:
+            self.alloc.alloc(s.owner, need)
+            self._sync_table(s)
+            return True
+        except CacheOOM:
+            self._flush_pipe()
+            if s.req is None or s.state != "decode":
+                return False  # the flush finished / failed / preempted it
+            return self._ensure_pages(s, 1)
+        except Exception as e:
+            self._fail_slot(s, e if isinstance(e, ServingError)
+                            else ServingError(
+                                "kv page allocation failed: %r" % (e,)))
+            return False
+
+    # -- async speculative pipeline ---------------------------------------
+    def _decode_async_spec(self):
+        """Speculative pipelining.  A verify's input depends on host-side
+        acceptance, so spec mode cannot stack two launches — instead the
+        overlap comes from reordering: retire the in-flight step with
+        only the state updates the next launch needs, launch immediately
+        (its draft was pre-computed while the step ran on device), and
+        do the remaining bookkeeping (metric emission, future
+        resolution, transcript pushes) behind the fresh launch."""
+        rec = self._retire_spec()
+        self._launch_spec()
+        if rec is not None:
+            self._run_spec_deferred(rec)
+
+    def _retire_spec(self):
+        """Retire the in-flight spec step: force the wide output, run
+        longest-prefix acceptance, advance slot state, roll back
+        rejected cache positions, feed adaptive-k, validate the
+        pre-draft, and DECIDE finishes (slots park in ``finishing``
+        state so the next launch skips them).  Returns the deferred
+        record for :meth:`_run_spec_deferred`, or None."""
+        with self._cond:
+            if not self._pipe:
+                return None
+            fl = self._pipe.popleft()
+        try:
+            faults.check("engine.retire")
+        except Exception as e:
+            self._retire_poisoned(fl, e)
+            return None
+        out = jax.device_get(fl.out)
+        now = time.perf_counter()
+        self._t_force_end = now
+        self.metrics.count(self.name, "deferred_reads_total")
+        with self._cond:
+            self._unpin_owners(fl)
+        spec = self._spec
+        lanes = []
+        emitted_total = 0
+        for s, seq in fl.lanes:
+            if s.req is None or s.admit_seq != seq or s.state != "decode":
+                continue
+            s.flight = 0
+            row = fl.fed[s.idx]
+            nv = len(row)
+            pos0 = s.pos
+            if fl.kind == "verify":
+                preds = [int(t) for t in out[s.idx, :nv]]
+                accepted = 0
+                while accepted < nv - 1 \
+                        and row[accepted + 1] == preds[accepted]:
+                    accepted += 1
+                emitted = preds[:accepted + 1]
+            else:
+                accepted = 0
+                emitted = [int(out[s.idx])]
+            budget = (s.req.max_new - len(s.req.prefix)
+                      - len(s.generated))
+            emitted = emitted[:max(1, budget)]
+            if self.eos_id is not None and self.eos_id in emitted:
+                emitted = emitted[:emitted.index(self.eos_id) + 1]
+            gap = (now - s.t_last) / len(emitted)
+            for tok in emitted:
+                s.history.append(s.pending)
+                s.pos += 1
+                s.generated.append(tok)
+                s.pending = tok
+            s.t_last = now
+            emitted_total += len(emitted)
+            drafted = nv - 1
+            if drafted:
+                # adaptive-k learns the outcome BEFORE the next launch
+                # budgets its draft width — same ordering as sync mode
+                spec.observe(self._spec_key(s), drafted, accepted)
+            if fl.kind == "verify":
+                self._rollback_kv(s, pos0 + nv)
+            # pre-draft validation: keep the overlapped draft's tail iff
+            # its prediction of this step's emission was exact — any
+            # draft is correctness-safe (verify gates it), this only
+            # decides whether the next launch re-drafts
+            s.predraft = spec.reuse_predraft(s.predraft, emitted,
+                                             spec.k_cap)
+            reason = None
+            if self.eos_id is not None and s.pending == self.eos_id:
+                reason = "eos"
+            elif len(s.generated) + len(s.req.prefix) >= s.req.max_new:
+                reason = "length"
+            elif s.req.expired(now):
+                reason = "deadline"
+            if reason is not None:
+                s.state = "finishing"
+            lanes.append((s, len(emitted), gap, drafted, accepted,
+                          reason))
+        for cb in fl.on_retire:
+            cb()
+        return {"lanes": lanes, "kind": fl.kind, "t_launch": fl.t_launch,
+                "now": now, "emitted_total": emitted_total}
+
+    def _launch_spec(self):
+        """Launch the next spec step (wide verify, or a plain staged
+        step when nothing drafted), then pre-draft the step after it
+        while this one runs on device.  Mirrors the sync
+        :meth:`_decode_speculative` admission/gate/page-growth order so
+        the emitted streams stay bit-identical."""
+        spec = self._spec
+        now = time.perf_counter()
+        batch = []
+        for s in self._slots:
+            if s.state != "decode":
+                continue
+            if s.req.expired(now):
+                self._finish(s, "deadline")
+                continue
+            batch.append(s)
+        if not batch:
+            return False
+        try:
+            faults.check("decode.step")
+        except Exception as e:
+            for s in batch:
+                self._fail_slot(s, ServingError(
+                    "decode step failed: %r" % (e,)))
+            return False
+        live = []
+        for s in batch:
+            if s.state != "decode":
+                continue
+            if self._ensure_pages(s, 1) and s.state == "decode":
+                live.append(s)
+        live = [s for s in live if s.state == "decode"]
+        if not live:
+            return False
+        plan = {}
+        for s in live:
+            req = s.req
+            budget = req.max_new - len(req.prefix) - len(s.generated)
+            max_k = min(spec.k_cap, budget - 1, self.max_ctx - s.pos - 1)
+            k = spec.budget(self._spec_key(s), max_k)
+            if k <= 0:
+                continue
+            pre, s.predraft = s.predraft, None
+            if pre:
+                draft = pre[:k]  # overlapped draft, validated at retire
+            else:
+                t0 = time.perf_counter()
+                draft = spec.propose(self._spec_key(s), s.owner,
+                                     list(s.history) + [s.pending], k)
+                self.metrics.observe_draft(self.name,
+                                           time.perf_counter() - t0)
+            if draft:
+                plan[s.idx] = [int(t) for t in draft]
+        if plan and not spec.verify_gate([self._spec_key(s) for s in live
+                                          if s.idx in plan]):
+            plan = {}
+        survivors = []
+        for s in live:
+            if s.state != "decode":
+                plan.pop(s.idx, None)
+                continue
+            if self._ensure_pages(s, 1 + len(plan.get(s.idx, ()))):
+                if s.state == "decode":
+                    survivors.append(s)
+                    continue
+            plan.pop(s.idx, None)
+        live = [s for s in survivors if s.state == "decode"]
+        if not live:
+            return False
+        fed = {}
+        t0 = time.perf_counter()
+        if self._t_force_end is not None:
+            self.metrics.observe_host_gap(
+                self.name, max(0.0, t0 - self._t_force_end))
+        if plan:
+            width = 1 + max(len(d) for d in plan.values())
+            verify_fn = _decoder.make_verify_step(
+                self.cfg, self.page_size, width, sharding=self.sharding,
+                quant=self.quant, kv_dtype=self.kv_dtype)
+            tokens = onp.zeros((self.slots, width), onp.int32)
+            positions = onp.zeros(self.slots, onp.int32)
+            n_valid = onp.zeros(self.slots, onp.int32)
+            active = onp.zeros(self.slots, bool)
+            for s in live:
+                row = [s.pending] + plan.get(s.idx, [])
+                fed[s.idx] = row
+                tokens[s.idx, :len(row)] = row
+                positions[s.idx] = s.pos
+                n_valid[s.idx] = len(row)
+                active[s.idx] = True
+            t0 = time.perf_counter()
+            self._kp, self._vp, out = verify_fn(
+                self.params, self._kp, self._vp, jnp.array(tokens),
+                jnp.array(positions), jnp.array(n_valid),
+                self._tables_device(), jnp.array(active))
+            kind = "verify"
+        else:
+            st = self._stage_tokens
+            sp = self._stage_positions
+            sa = self._stage_active
+            st.fill(0)
+            sp.fill(0)
+            sa.fill(False)
+            for s in live:
+                fed[s.idx] = [s.pending]
+                st[s.idx] = s.pending
+                sp[s.idx] = s.pos
+                sa[s.idx] = True
+            t0 = time.perf_counter()
+            self._kp, self._vp, out, _ = self._run_decode_fn(
+                self.params, self._kp, self._vp, jnp.array(st),
+                jnp.array(sp), self._tables_device(),
+                self._active_device(sa))
+            kind = "plain"
+        fl = _Flight(kind, out, t0, [(s, s.admit_seq) for s in live],
+                     set(s.owner for s in live), fed)
+        for s in live:
+            s.flight = 1
+        with self._cond:
+            self._pipe.append(fl)
+            self._pin_owners(fl)
+        self.metrics.observe_dispatch_depth(self.name, len(self._pipe))
+        # overlapped drafting: propose the NEXT step's continuation from
+        # the current confirmed context while this launch runs on
+        # device.  The proposal covers this step's maximum emission plus
+        # a k-deep tail; retire keeps the tail iff the emission prefix
+        # matched exactly.  (propose swallows drafter faults itself.)
+        for s in live:
+            k = spec.budget(self._spec_key(s), spec.k_cap)
+            if k <= 0:
+                continue
+            t0 = time.perf_counter()
+            s.predraft = spec.propose(self._spec_key(s), s.owner,
+                                      list(s.history) + [s.pending],
+                                      len(fed[s.idx]) + k)
+            self.metrics.observe_draft(self.name,
+                                       time.perf_counter() - t0)
+        return True
+
+    def _run_spec_deferred(self, rec):
+        """The retired spec step's remaining bookkeeping, run AFTER the
+        next launch is in flight: metric emission, verify/step
+        histograms, and the actual finishes (future resolution,
+        transcript pushes — the expensive host work)."""
+        now = rec["now"]
+        if not rec["lanes"]:
+            return
+        for s, n_emitted, gap, drafted, accepted, reason in rec["lanes"]:
+            for _ in range(n_emitted):
+                self.metrics.observe_inter_token(self.name, gap)
+            if drafted:
+                self.metrics.count(self.name, "spec_draft_tokens_total",
+                                   drafted)
+                self.metrics.count(self.name,
+                                   "spec_accepted_tokens_total", accepted)
+            if reason is not None:
+                self._finish(s, reason)
+        if rec["kind"] == "verify":
+            self.metrics.observe_verify(self.name, now - rec["t_launch"])
+            self.metrics.count(self.name, "spec_verify_steps_total")
+        self.metrics.observe_decode_step(
+            self.name, now - rec["t_launch"], now - rec["t_launch"],
+            len(rec["lanes"]), self.slots, rec["emitted_total"])
 
     # -- speculative decoding ---------------------------------------------
     def _build_spec(self, drafter, draft_model, spec_k):
@@ -1530,7 +2172,7 @@ class DecodeEngine:
                 # SIGKILL of this replica
                 self._push_transcript(sess)
         else:
-            self.alloc.free(slot.owner)
+            self._free_owner(slot.owner)
             self._spec_release(slot.owner, slot.owner)
         self.metrics.count(self.name, "sequences_completed_total")
         self.metrics.observe_generate_done(self.name, now - req.t_enqueue)
@@ -1547,7 +2189,7 @@ class DecodeEngine:
 
     def _fail_slot(self, slot, exc):
         req = slot.req
-        self.alloc.free(slot.owner)
+        self._free_owner(slot.owner)
         self._spec_release(slot.owner, self._spec_key(slot))
         if req.session is not None:
             self._sessions.pop(req.session, None)
@@ -1562,6 +2204,8 @@ class DecodeEngine:
         slot.generated = []
         slot.history = []
         slot.pending = None
+        slot.flight = 0
+        slot.predraft = None
         self._tables[slot.idx, :] = 0
         self._tables_dev = None
 
@@ -1586,6 +2230,14 @@ class DecodeEngine:
             jnp.zeros(self.slots, bool))
         jax.block_until_ready(toks)
         compiled = 2
+        if self.async_decode:
+            # the chaining combine is part of the steady-state launch
+            # sequence — compile it now too
+            combo = _decoder.make_token_combine(self.slots)(
+                toks, jnp.zeros(self.slots, jnp.int32),
+                jnp.zeros(self.slots, bool))
+            jax.block_until_ready(combo)
+            compiled += 1
         if self._spec is not None:
             # pre-compile every verify width the adaptive-k controller
             # can reach (2 .. k_cap + 1) so acceptance swings never pay
@@ -1626,7 +2278,7 @@ class DecodeEngine:
                     if s.active:
                         s.req.future.set_exception(ServerClosedError(
                             "decode engine stopped mid-generation"))
-                        self.alloc.free(s.owner)
+                        self._free_owner(s.owner)
                         self._spec_release(s.owner, self._spec_key(s))
                         self._clear(s)
             self._cond.notify_all()
@@ -1645,7 +2297,7 @@ class DecodeEngine:
                 _log.exception("migrate_out on stop failed")
         with self._cond:
             for sess in self._sessions.values():
-                self.alloc.free(sess.owner)
+                self._free_owner(sess.owner)
                 self._spec_release(sess.owner, sess.sid)
             self._sessions.clear()
         if self.prefix_cache is not None:
@@ -1677,6 +2329,9 @@ class DecodeEngine:
                "prefill_chunk": self.prefill_chunk,
                "max_ctx": self.max_ctx,
                "role": self.role,
+               "async": {"enabled": self.async_decode,
+                         "dispatch_ahead": self.dispatch_ahead,
+                         "inflight": len(self._pipe)},
                "kv": self.alloc.stats(),
                "quant": {
                    "weights": self.quant[0] if self.quant else None,

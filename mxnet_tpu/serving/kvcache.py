@@ -44,6 +44,17 @@ carry FULL-head pages: the engine gathers shards to host on export and
 re-pins to the mesh on import, so a session migrates freely between
 replicated and TP replicas of any degree.
 
+The allocator is synchronous and oblivious to device timing: a freed
+page goes back on the (LIFO) free list immediately and may be handed
+out on the very next ``alloc``.  Callers that overlap host scheduling
+with device decode steps (the async engine, ISSUE 17) must therefore
+treat pages referenced by a launched-but-unretired step as PINNED —
+``DecodeEngine`` defers such frees onto the pinning step's retire
+(``generate._free_owner``) so the free list never recycles a page an
+in-flight launch still writes.  Once the pipeline drains, the usual
+invariant holds: occupancy returns to zero and ``check_leaks`` is
+clean.
+
 Fault site ``kvcache.alloc`` (``mxnet_tpu.faults``) trips inside
 :meth:`PageAllocator.alloc`, so chaos tests can fail allocations
 deterministically; genuine exhaustion raises :class:`CacheOOM`, which

@@ -87,7 +87,11 @@ class ModelMetrics:
                 "migrations_in_total", "migrations_replayed_total",
                 # speculative decoding (PR 12)
                 "spec_draft_tokens_total", "spec_accepted_tokens_total",
-                "spec_verify_steps_total", "spec_rollbacks_total")
+                "spec_verify_steps_total", "spec_rollbacks_total",
+                # async decode engine (PR 17): device-array reads that
+                # happened at retire time, after the next launch was
+                # already in flight
+                "deferred_reads_total")
 
     def __init__(self):
         self.counters = dict.fromkeys(self.COUNTERS, 0)
@@ -108,6 +112,12 @@ class ModelMetrics:
         self.tokens_per_step = LatencyHistogram()
         self.draft_step = LatencyHistogram()
         self.verify_step = LatencyHistogram()
+        # async decode engine: host gap = wall time the device sat with
+        # no decode work queued between steps (the async win is this
+        # collapsing toward zero); dispatch_depth = launched-but-
+        # unretired steps at each launch (achieved pipelining depth)
+        self.host_gap = LatencyHistogram()
+        self.dispatch_depth = LatencyHistogram()
         self.kv_cache = {"used_pages": 0, "total_pages": 0,
                          "peak_used_pages": 0, "shared_pages": 0,
                          "leaked_pages": 0, "tokens_resident": 0,
@@ -160,6 +170,10 @@ class ModelMetrics:
             }
             out["generate"]["tokens_per_step"] = (
                 self.tokens_per_step.snapshot(scale=1, suffix=""))
+            out["generate"]["host_gap_us"] = self.host_gap.snapshot(
+                scale=1e6, suffix="_us")
+            out["generate"]["dispatch_depth"] = (
+                self.dispatch_depth.snapshot(scale=1, suffix=""))
             drafted = self.counters["spec_draft_tokens_total"]
             if drafted or self.counters["spec_verify_steps_total"]:
                 out["generate"]["speculative"] = {
@@ -277,6 +291,20 @@ class ServingMetrics:
                                     device_s)
         profiler.record_counter("serving::%s::decode" % name,
                                 active=active, tokens=new_tokens)
+
+    def observe_host_gap(self, name, gap_s):
+        """Device-idle gap before one decode launch: wall time since the
+        engine last blocked on (and received) a step result with nothing
+        left in flight.  Zero when the launch went out while a previous
+        step was still unretired — the pipelined steady state."""
+        with self._lock:
+            self._model(name).host_gap.observe(gap_s)
+
+    def observe_dispatch_depth(self, name, depth):
+        """Launched-but-unretired decode steps right after one launch
+        (the achieved dispatch-ahead depth, histogrammed)."""
+        with self._lock:
+            self._model(name).dispatch_depth.observe(float(depth))
 
     def observe_draft(self, name, draft_s):
         """Wall time of one slot's draft proposal (speculative path)."""

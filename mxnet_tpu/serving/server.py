@@ -387,7 +387,8 @@ class ModelServer:
             gen = stats.get("generate")
             if gen:
                 for hist in ("ttft", "inter_token", "decode_step",
-                             "tokens_per_step"):
+                             "tokens_per_step", "host_gap_us",
+                             "dispatch_depth"):
                     for k, v in sorted((gen.get(hist) or {}).items()):
                         if k == "count":
                             continue

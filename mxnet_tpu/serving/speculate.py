@@ -310,7 +310,8 @@ class SpeculativeScheduler:
         self.name = name
         self._ctl = collections.OrderedDict()
         self.counters = {"proposals": 0, "empty_drafts": 0,
-                         "draft_faults": 0, "verify_faults": 0}
+                         "draft_faults": 0, "verify_faults": 0,
+                         "predraft_hits": 0, "predraft_misses": 0}
 
     def _controller(self, key):
         c = self._ctl.get(key)
@@ -359,6 +360,28 @@ class SpeculativeScheduler:
             _log.warning("verify fault: %r (step degraded to plain "
                          "decode)", e)
             return False
+
+    def reuse_predraft(self, pre, emitted, k):
+        """Overlapped drafting (async engine): ``pre`` was proposed from
+        the LAUNCH-time context — before the verify it overlapped with
+        had emitted anything — with extra lookahead.  If its head
+        predicted this step's emissions exactly, the tail is a valid
+        draft for the post-emission context and the next verify launches
+        without a fresh host drafting pass.  Any draft is correctness-
+        safe under longest-prefix greedy acceptance, so a miss only
+        costs the overlap (the engine re-drafts synchronously).
+
+        Returns the reusable tail (possibly empty) on a hit, or None."""
+        if pre is None or k <= 0:
+            return None
+        m = len(emitted)
+        tail = [int(t) for t in pre[m:m + int(k)]]
+        if len(pre) > m and tail \
+                and list(pre[:m]) == [int(t) for t in emitted]:
+            self.counters["predraft_hits"] += 1
+            return tail
+        self.counters["predraft_misses"] += 1
+        return None
 
     def observe(self, key, drafted, accepted):
         self._controller(key).update(drafted, accepted)

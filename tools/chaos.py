@@ -573,6 +573,10 @@ def scenario_llm(args):
 
     sys.path.insert(0, REPO)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the drill runs the shipped engine config: async step pipelining
+    # ON (ISSUE 17) — the zero-reset bar must hold with launches in
+    # flight at every SIGKILL, drain, and rollout point
+    os.environ["MXNET_GEN_ASYNC"] = "1"
 
     from mxnet_tpu import serving
     from mxnet_tpu.serving.errors import (FleetUnavailableError,
