@@ -256,6 +256,56 @@ register("MXNET_FLEET_RESTART_BACKOFF_MS", float, 200.0, "honored",
          "serving fleet supervisor: crash-loop restart backoff base, "
          "doubled per consecutive crash (reset after a healthy run)",
          "serving.supervisor.ReplicaSupervisor")
+register("MXNET_AUTOSCALE_INTERVAL_MS", float, 1000.0, "honored",
+         "fleet autoscaler: control-loop tick interval (each tick "
+         "aggregates replica stats, smooths them, and decides at most "
+         "one action)", "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_EMA_ALPHA", float, 0.4, "honored",
+         "fleet autoscaler: EMA smoothing factor for the queue/KV "
+         "signals (higher = reacts faster, flaps easier)",
+         "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_UP_QUEUE", float, 4.0, "honored",
+         "fleet autoscaler: scale-up band — smoothed queued requests "
+         "per live replica above which a replica is spawned",
+         "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_DOWN_QUEUE", float, 0.5, "honored",
+         "fleet autoscaler: scale-down band — smoothed queued requests "
+         "per live replica below which an idle replica is drained "
+         "(hysteresis: between the bands the fleet holds)",
+         "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_UP_KV", float, 0.85, "honored",
+         "fleet autoscaler: scale-up band on mean KV-page occupancy "
+         "(fraction of pages in use across live replicas)",
+         "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_DOWN_KV", float, 0.3, "honored",
+         "fleet autoscaler: scale-down band on mean KV-page occupancy "
+         "(scale-down requires BOTH queue and KV below their bands)",
+         "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_COOLDOWN_SEC", float, 5.0, "honored",
+         "fleet autoscaler: minimum time between actions (spawn / drain "
+         "/ role flip) — the anti-flap brake",
+         "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_MIN_REPLICAS", int, 1, "honored",
+         "fleet autoscaler: floor the fleet never drains below",
+         "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_CHIP_BUDGET", int, 4, "honored",
+         "fleet autoscaler: hard ceiling on live replicas (one replica "
+         "= one chip's worth of accelerator) — scale-up past it is "
+         "refused and recorded as a hold",
+         "serving.autoscale.Autoscaler")
+register("MXNET_AUTOSCALE_ROLE_IMBALANCE", float, 3.0, "honored",
+         "fleet autoscaler: prefill/decode pool load ratio beyond which "
+         "a replica from the lighter pool is flipped to the heavier one "
+         "(runtime /v1/admin/set_role; requires a role-split fleet)",
+         "serving.autoscale.Autoscaler")
+register("MXNET_SLO_DEFAULT_TIER", str, "latency", "honored",
+         "SLO admission: tier assigned to requests that carry none "
+         "('latency' is protected; 'bulk' is shed first under overload)",
+         "serving.autoscale.SLOPolicy")
+register("MXNET_SLO_TENANT_WEIGHTS", str, "", "honored",
+         "SLO admission: weighted-fair-queueing tenant weights as "
+         "'tenant=weight,...' (e.g. 'free=1,pro=4'); unlisted tenants "
+         "weigh 1", "serving.autoscale.SLOPolicy")
 register("MXNET_COMPILE_CACHE_DIR", str, "", "honored",
          "persistent XLA compile cache directory (jax compilation "
          "cache): registry per-bucket precompile writes it, so a "

@@ -120,7 +120,8 @@ KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
                "engine.retire", "kvcache.alloc",
                "session.export", "session.import",
                "speculate.draft", "speculate.verify",
-               "mesh.reshard", "checkpoint.shard_read")
+               "mesh.reshard", "checkpoint.shard_read",
+               "autoscale.decide", "replica.spawn")
 
 
 class FaultRule:

@@ -78,11 +78,12 @@ Quick start::
 from __future__ import annotations
 
 from .errors import (BadRequestError, DeadlineExceededError,
-                     FleetUnavailableError, KVLeakError,
-                     ModelNotFoundError, QueueFullError,
+                     DeadlineInfeasibleError, FleetUnavailableError,
+                     KVLeakError, ModelNotFoundError, QueueFullError,
                      RolloutAbortedError, ServerClosedError,
                      ServingError, SessionResetError)
 from .metrics import LatencyHistogram, ModelMetrics, ServingMetrics
+from .autoscale import Autoscaler, SLOPolicy
 from .registry import (ModelRegistry, ServedModel, default_buckets,
                        load_model_spec, maybe_enable_compile_cache,
                        resolve_builder)
@@ -100,7 +101,8 @@ __all__ = [
     "ServingError", "BadRequestError", "ModelNotFoundError",
     "QueueFullError", "ServerClosedError", "DeadlineExceededError",
     "SessionResetError", "FleetUnavailableError", "RolloutAbortedError",
-    "KVLeakError",
+    "KVLeakError", "DeadlineInfeasibleError",
+    "Autoscaler", "SLOPolicy",
     "ServingMetrics", "ModelMetrics", "LatencyHistogram",
     "ModelRegistry", "ServedModel", "default_buckets",
     "load_model_spec", "maybe_enable_compile_cache", "resolve_builder",

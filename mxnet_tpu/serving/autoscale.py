@@ -1,0 +1,510 @@
+"""Fleet autoscaling + SLO-aware admission policy.
+
+Two cooperating pieces close the control loop over the metrics the
+fleet already exports (queue depth, KV-page occupancy, per-pool
+saturation):
+
+:class:`SLOPolicy` — the admission-side half, owned by each replica's
+``DynamicBatcher``/``DecodeEngine``:
+
+- **Tiers**: every request carries a ``tier`` — ``latency`` (protected)
+  or ``bulk`` (shed first).  Unlabelled requests default to
+  ``MXNET_SLO_DEFAULT_TIER``.
+- **Weighted-fair queueing**: within a tier, tenants share capacity by
+  weight (``MXNET_SLO_TENANT_WEIGHTS``, ``"free=1,pro=4"``) via
+  start-time fair queueing: each admission stamps a virtual start tag
+  ``max(v_server, tenant_finish)``, the queue serves the smallest tag,
+  and a heavy tenant cannot starve a light one.  With one tenant (or no
+  weights) the tags degrade to exact FIFO order.
+- **Deadline infeasibility**: the policy keeps an EMA of the observed
+  service rate; a request whose deadline provably lands before the
+  queue ahead of it can drain is shed at submit with a typed 503
+  (:class:`~.errors.DeadlineInfeasibleError`) carrying ``retry_after``
+  = the drain estimate — shedding it early costs nothing, serving it
+  would burn capacity on a guaranteed 504.
+
+:class:`Autoscaler` — the fleet-side half, a control loop inside
+:class:`~.fleet.ServingFleet` (or driven synchronously via ``tick()``
+in tests):
+
+- watches aggregated replica stats (queue depth per live replica, mean
+  KV occupancy, per-pool saturation), **EMA-smoothed** so one bursty
+  sample can't trigger an action;
+- decides inside **hysteresis bands** (scale up above
+  ``MXNET_AUTOSCALE_UP_*``, down below ``MXNET_AUTOSCALE_DOWN_*``,
+  hold in between) with a **cooldown** between actions — the loop
+  never flaps;
+- under a fixed **chip budget**: spawns a replica when the up band is
+  crossed, drains the idlest replica when the fleet is idle (drain =
+  migrate every parked session through the PageStore, never reset),
+  and **flips replica roles** prefill↔decode at runtime when the two
+  pools are imbalanced beyond ``MXNET_AUTOSCALE_ROLE_IMBALANCE``;
+- records every decision (including holds) in a ring buffer surfaced
+  at ``/v1/stats`` (``autoscale`` block), as Prometheus gauges, and as
+  profiler fleet events — each action is auditable after the fact.
+
+Fault sites: ``autoscale.decide`` (an exception kind aborts the tick —
+the loop recovers on the next one; the soft ``drop`` kind INVERTS the
+scale decision, the forced-mis-scaling chaos drill) and
+``replica.spawn`` (scale-up failure path).
+
+The Autoscaler takes injectable ``clock``/``collect``/action hooks so
+tier-1 tests drive the loop on fake clocks and fake replica stats with
+no sleeps and no processes.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from .. import config as _config
+from .. import faults, profiler
+from .errors import BadRequestError, DeadlineInfeasibleError
+
+__all__ = ["SLOPolicy", "Autoscaler", "TIERS"]
+
+TIERS = ("latency", "bulk")
+
+#: minimum completed-request samples before the service-rate EMA is
+#: trusted for infeasibility shedding (a cold estimator must not shed)
+_MIN_RATE_SAMPLES = 3
+
+
+def _parse_weights(spec):
+    """'a=1,b=4' -> {'a': 1.0, 'b': 4.0} (bad entries ignored)."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        tenant, _, w = part.partition("=")
+        try:
+            w = float(w)
+        except ValueError:
+            continue
+        if tenant.strip() and w > 0:
+            out[tenant.strip()] = w
+    return out
+
+
+class SLOPolicy:
+    """Admission policy: tier classification, per-tenant weighted-fair
+    queueing tags, and deadline-infeasibility shedding.
+
+    One instance per replica, shared between the batcher and its decode
+    engines, so both request kinds queue under one fairness regime."""
+
+    def __init__(self, *, tenant_weights=None, default_tier=None,
+                 ema_alpha=0.3):
+        self.weights = (_parse_weights(tenant_weights)
+                        if isinstance(tenant_weights, str)
+                        else dict(tenant_weights)
+                        if tenant_weights is not None
+                        else _parse_weights(
+                            _config.get("MXNET_SLO_TENANT_WEIGHTS")))
+        self.default_tier = str(default_tier
+                                or _config.get("MXNET_SLO_DEFAULT_TIER"))
+        if self.default_tier not in TIERS:
+            self.default_tier = "latency"
+        self.ema_alpha = float(ema_alpha)
+        self._lock = threading.Lock()
+        self._finish = {}      # tenant -> virtual finish tag
+        self._vserver = 0.0    # virtual time of the last dispatched tag
+        self._rate = 0.0       # EMA completions/s
+        self._rate_t = None    # last completion timestamp
+        self._rate_samples = 0
+
+    # -- classification ---------------------------------------------------
+    def normalize_tier(self, tier):
+        if tier is None:
+            return self.default_tier
+        tier = str(tier)
+        if tier not in TIERS:
+            raise BadRequestError(
+                "unknown tier %r (known: %s)" % (tier, "|".join(TIERS)))
+        return tier
+
+    @staticmethod
+    def rank(tier):
+        """Dispatch priority: latency (0) strictly before bulk (1)."""
+        return TIERS.index(tier)
+
+    def weight(self, tenant):
+        return self.weights.get(tenant, 1.0) if tenant else 1.0
+
+    # -- weighted-fair queueing (start-time fair queueing) ----------------
+    def stamp(self, tier, tenant):
+        """Admit one request: returns ``(rank, vstart)`` — the queue's
+        sort key.  ``vstart`` is the SFQ start tag: a tenant's tags
+        advance by ``1/weight`` per request, so a weight-4 tenant earns
+        4 slots for every 1 a weight-1 tenant gets under contention."""
+        tier = self.normalize_tier(tier)
+        with self._lock:
+            start = max(self._vserver,
+                        self._finish.get(tenant, 0.0))
+            self._finish[tenant] = start + 1.0 / self.weight(tenant)
+        return self.rank(tier), start
+
+    def on_dispatch(self, vstart):
+        """Advance virtual server time to the dispatched request's tag
+        (new arrivals can't be stamped into the served past)."""
+        with self._lock:
+            if vstart > self._vserver:
+                self._vserver = vstart
+
+    # -- service-rate estimation / infeasibility --------------------------
+    def observe_served(self, n=1, now=None):
+        """Feed one service completion (n requests) into the rate EMA."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            if self._rate_t is not None:
+                dt = now - self._rate_t
+                if dt > 1e-9:
+                    inst = n / dt
+                    self._rate = (inst if self._rate_samples == 0
+                                  else self.ema_alpha * inst
+                                  + (1.0 - self.ema_alpha) * self._rate)
+                    self._rate_samples += 1
+            self._rate_t = now
+
+    def service_rate(self):
+        """Observed service rate (requests/s EMA); 0.0 until warm."""
+        with self._lock:
+            return (self._rate
+                    if self._rate_samples >= _MIN_RATE_SAMPLES else 0.0)
+
+    def drain_eta_s(self, depth):
+        """Estimated seconds for ``depth`` queued requests to drain at
+        the observed service rate; None while the estimator is cold."""
+        rate = self.service_rate()
+        if rate <= 0.0 or depth <= 0:
+            return None
+        return depth / rate
+
+    def check_deadline(self, depth, deadline_s):
+        """Shed (typed 503) a request whose deadline provably lands
+        before the queue ahead of it drains.  No-op while the rate
+        estimator is cold or the deadline is comfortably feasible."""
+        if deadline_s is None:
+            return
+        eta = self.drain_eta_s(depth)
+        if eta is not None and eta > float(deadline_s):
+            raise DeadlineInfeasibleError(
+                "deadline %.0f ms is infeasible: %d queued ahead drain "
+                "in ~%.0f ms at the observed service rate"
+                % (float(deadline_s) * 1e3, depth, eta * 1e3),
+                retry_after=max(0.05, eta - float(deadline_s)))
+
+
+class Autoscaler:
+    """EMA-smoothed, hysteresis-banded, cooled-down fleet control loop.
+
+    All inputs and outputs are injectable so the loop is testable on
+    fake clocks with zero sleeps:
+
+    ``collect()``  -> ``{"replicas": {rid: {"role", "routable",
+    "queued", "active", "slots", "kv_frac"}}}`` — the aggregated view
+    of ``/v1/stats`` across the fleet.
+    ``scale_up(role)`` — spawn one replica into ``role``.
+    ``scale_down(rid)`` — drain (migrate) + stop one replica.
+    ``flip_role(rid, role)`` — runtime prefill↔decode flip.
+
+    ``tick()`` makes at most ONE decision; ``start()`` runs it on a
+    background thread every ``interval_ms``.
+    """
+
+    def __init__(self, *, chip_budget=None, min_replicas=None,
+                 up_queue=None, down_queue=None, up_kv=None, down_kv=None,
+                 cooldown_s=None, interval_ms=None, ema_alpha=None,
+                 role_imbalance=None, clock=time.monotonic,
+                 collect=None, scale_up=None, scale_down=None,
+                 flip_role=None):
+        g = _config.get
+        self.chip_budget = int(chip_budget if chip_budget is not None
+                               else g("MXNET_AUTOSCALE_CHIP_BUDGET"))
+        self.min_replicas = max(1, int(
+            min_replicas if min_replicas is not None
+            else g("MXNET_AUTOSCALE_MIN_REPLICAS")))
+        self.up_queue = float(up_queue if up_queue is not None
+                              else g("MXNET_AUTOSCALE_UP_QUEUE"))
+        self.down_queue = float(down_queue if down_queue is not None
+                                else g("MXNET_AUTOSCALE_DOWN_QUEUE"))
+        self.up_kv = float(up_kv if up_kv is not None
+                           else g("MXNET_AUTOSCALE_UP_KV"))
+        self.down_kv = float(down_kv if down_kv is not None
+                             else g("MXNET_AUTOSCALE_DOWN_KV"))
+        self.cooldown_s = float(cooldown_s if cooldown_s is not None
+                                else g("MXNET_AUTOSCALE_COOLDOWN_SEC"))
+        self.interval_s = float(
+            interval_ms if interval_ms is not None
+            else g("MXNET_AUTOSCALE_INTERVAL_MS")) / 1e3
+        self.ema_alpha = float(ema_alpha if ema_alpha is not None
+                               else g("MXNET_AUTOSCALE_EMA_ALPHA"))
+        self.role_imbalance = float(
+            role_imbalance if role_imbalance is not None
+            else g("MXNET_AUTOSCALE_ROLE_IMBALANCE"))
+        self._clock = clock
+        self._collect = collect
+        self._scale_up = scale_up
+        self._scale_down = scale_down
+        self._flip_role = flip_role
+        self._lock = threading.Lock()
+        self._q_ema = None
+        self._kv_ema = None
+        self._live = 0
+        self._last_action_t = None
+        self._decisions = collections.deque(maxlen=64)
+        self.counters = {"ticks": 0, "scale_up": 0, "scale_down": 0,
+                         "role_flip": 0, "holds": 0, "errors": 0}
+        self._stop_evt = threading.Event()
+        self._thread = None
+
+    # -- signals ----------------------------------------------------------
+    def _signals(self, stats):
+        replicas = (stats or {}).get("replicas") or {}
+        live = {rid: r for rid, r in replicas.items()
+                if r.get("routable", True)}
+        n = max(1, len(live))
+        queued = sum(int(r.get("queued") or 0) for r in live.values())
+        kvs = [float(r["kv_frac"]) for r in live.values()
+               if r.get("kv_frac") is not None]
+        pool_load = {}
+        for pool in ("prefill", "decode"):
+            members = [r for r in live.values() if r.get("role") == pool]
+            if members:
+                slots = sum(max(1, int(r.get("slots") or 1))
+                            for r in members)
+                busy = sum(int(r.get("queued") or 0)
+                           + int(r.get("active") or 0) for r in members)
+                pool_load[pool] = busy / float(slots)
+        return {"live": len(live),
+                # booting/draining replicas still occupy chips: the
+                # budget check counts them, the load signals don't
+                "total": len(replicas),
+                "queued_total": queued,
+                "queue_per_replica": queued / float(n),
+                "kv_frac": sum(kvs) / len(kvs) if kvs else 0.0,
+                "pool_load": pool_load,
+                "replicas": live}
+
+    def _smooth(self, sig):
+        a = self.ema_alpha
+        with self._lock:
+            self._q_ema = (sig["queue_per_replica"] if self._q_ema is None
+                           else a * sig["queue_per_replica"]
+                           + (1 - a) * self._q_ema)
+            self._kv_ema = (sig["kv_frac"] if self._kv_ema is None
+                            else a * sig["kv_frac"]
+                            + (1 - a) * self._kv_ema)
+            self._live = sig["live"]
+            return self._q_ema, self._kv_ema
+
+    # -- decision ---------------------------------------------------------
+    def _pick_drain(self, replicas):
+        """Idlest live replica, keeping specialized pools non-empty."""
+        by_role = collections.Counter(r.get("role", "mixed")
+                                      for r in replicas.values())
+        candidates = []
+        for rid, r in replicas.items():
+            role = r.get("role", "mixed")
+            if role in ("prefill", "decode") and by_role[role] <= 1 \
+                    and len(by_role) > 1:
+                continue  # last of a specialized pool: keep it
+            load = int(r.get("queued") or 0) + int(r.get("active") or 0)
+            candidates.append((load, rid))
+        if not candidates:
+            return None
+        return min(candidates)[1]
+
+    def _pick_flip(self, replicas, pool_load):
+        """(rid, new_role) rebalancing the heavier pool, or None."""
+        if len(pool_load) < 2:
+            return None
+        hi = max(pool_load, key=pool_load.get)
+        lo = min(pool_load, key=pool_load.get)
+        if hi == lo or pool_load[hi] < 1.0 \
+                or pool_load[lo] * self.role_imbalance > pool_load[hi]:
+            # a flip needs the heavy pool actually saturated (load >= 1
+            # slot-equivalent) AND the ratio past the imbalance band
+            return None
+        donors = [(int(r.get("queued") or 0) + int(r.get("active") or 0),
+                   rid) for rid, r in replicas.items()
+                  if r.get("role") == lo]
+        if len(donors) <= 1:
+            return None  # never empty the lighter pool entirely
+        return min(donors)[1], hi
+
+    def _decide(self, sig, q_ema, kv_ema):
+        live = sig["live"]
+        if q_ema > self.up_queue or kv_ema > self.up_kv:
+            why = ("queue %.2f > %.2f" % (q_ema, self.up_queue)
+                   if q_ema > self.up_queue
+                   else "kv %.2f > %.2f" % (kv_ema, self.up_kv))
+            if sig.get("total", live) < self.chip_budget:
+                return {"action": "scale_up", "reason": why}
+            flip = self._pick_flip(sig["replicas"], sig["pool_load"])
+            if flip is not None:
+                return {"action": "role_flip", "rid": flip[0],
+                        "role": flip[1],
+                        "reason": why + "; at chip budget, rebalancing"}
+            return {"action": "hold",
+                    "reason": why + "; at chip budget %d"
+                    % self.chip_budget}
+        if q_ema < self.down_queue and kv_ema < self.down_kv:
+            if live > self.min_replicas:
+                rid = self._pick_drain(sig["replicas"])
+                if rid is not None:
+                    return {"action": "scale_down", "rid": rid,
+                            "reason": "idle: queue %.2f < %.2f, kv %.2f "
+                            "< %.2f" % (q_ema, self.down_queue,
+                                        kv_ema, self.down_kv)}
+            return {"action": "hold",
+                    "reason": "idle but at min_replicas=%d"
+                    % self.min_replicas}
+        flip = self._pick_flip(sig["replicas"], sig["pool_load"])
+        if flip is not None:
+            return {"action": "role_flip", "rid": flip[0],
+                    "role": flip[1],
+                    "reason": "pool imbalance %s > %gx"
+                    % (dict(sig["pool_load"]), self.role_imbalance)}
+        return {"action": "hold", "reason": "within hysteresis bands"}
+
+    _INVERT = {"scale_up": "scale_down", "scale_down": "scale_up"}
+
+    def tick(self):
+        """One control-loop pass; returns the recorded decision dict."""
+        now = self._clock()
+        self.counters["ticks"] += 1
+        try:
+            soft = faults.check("autoscale.decide")
+        except Exception as e:
+            # an injected decide failure aborts THIS tick only; the loop
+            # recovers on the next one
+            self.counters["errors"] += 1
+            return self._record(now, {"action": "error",
+                                      "reason": "decide fault: %r" % e})
+        try:
+            sig = self._signals(self._collect())
+        except Exception as e:
+            self.counters["errors"] += 1
+            return self._record(now, {"action": "error",
+                                      "reason": "collect failed: %r" % e})
+        q_ema, kv_ema = self._smooth(sig)
+        decision = self._decide(sig, q_ema, kv_ema)
+        if soft == "drop" and decision["action"] in self._INVERT:
+            # chaos drill: force the WRONG scaling direction; the safety
+            # guards (min_replicas / chip budget / migration-only drain)
+            # still apply, and the smoothed signals steer the loop back
+            inverted = self._INVERT[decision["action"]]
+            decision = {"action": inverted,
+                        "reason": "fault-inverted from %s (%s)"
+                        % (decision["action"], decision["reason"])}
+            if inverted == "scale_down":
+                if sig["live"] <= self.min_replicas:
+                    decision = {"action": "hold",
+                                "reason": "fault-inverted scale_down "
+                                "refused at min_replicas"}
+                else:
+                    decision["rid"] = self._pick_drain(sig["replicas"])
+            elif sig.get("total", sig["live"]) >= self.chip_budget:
+                decision = {"action": "hold",
+                            "reason": "fault-inverted scale_up refused "
+                            "at chip budget"}
+        if decision["action"] not in ("hold", "error") \
+                and self._last_action_t is not None \
+                and now - self._last_action_t < self.cooldown_s:
+            decision = {"action": "hold",
+                        "reason": "cooldown (%.1fs of %.1fs) after last "
+                        "action; wanted %s"
+                        % (now - self._last_action_t, self.cooldown_s,
+                           decision["action"])}
+        decision = self._execute(now, decision)
+        decision["signals"] = {"queue_per_replica": round(q_ema, 4),
+                               "kv_frac": round(kv_ema, 4),
+                               "live": sig["live"],
+                               "pool_load": {k: round(v, 4) for k, v
+                                             in sig["pool_load"].items()}}
+        return self._record(now, decision)
+
+    def _execute(self, now, decision):
+        action = decision["action"]
+        try:
+            if action == "scale_up":
+                role = decision.get("role", "mixed")
+                if self._scale_up is not None:
+                    decision["spawned"] = self._scale_up(role)
+                self._last_action_t = now
+                self.counters["scale_up"] += 1
+            elif action == "scale_down":
+                if self._scale_down is not None:
+                    decision["migrated"] = self._scale_down(
+                        decision["rid"])
+                self._last_action_t = now
+                self.counters["scale_down"] += 1
+            elif action == "role_flip":
+                if self._flip_role is not None:
+                    self._flip_role(decision["rid"], decision["role"])
+                self._last_action_t = now
+                self.counters["role_flip"] += 1
+            else:
+                self.counters["holds"] += 1
+        except Exception as e:
+            self.counters["errors"] += 1
+            decision = dict(decision, action="error",
+                            reason="%s failed: %r (wanted: %s)"
+                            % (action, e, decision["reason"]))
+        return decision
+
+    def _record(self, now, decision):
+        decision = dict(decision, t=round(now, 4))
+        with self._lock:
+            self._decisions.append(decision)
+        action = decision["action"]
+        profiler.record_fleet_stat("autoscale.%s" % action)
+        if action not in ("hold",):
+            profiler.record_event_stat("fleet.autoscale_%s" % action)
+        return decision
+
+    # -- observability ----------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            decisions = list(self._decisions)
+            q_ema, kv_ema, live = self._q_ema, self._kv_ema, self._live
+        return {"counters": dict(self.counters),
+                "signals": {"queue_per_replica": q_ema,
+                            "kv_frac": kv_ema, "live": live},
+                "config": {"chip_budget": self.chip_budget,
+                           "min_replicas": self.min_replicas,
+                           "up_queue": self.up_queue,
+                           "down_queue": self.down_queue,
+                           "up_kv": self.up_kv, "down_kv": self.down_kv,
+                           "cooldown_s": self.cooldown_s,
+                           "role_imbalance": self.role_imbalance},
+                "last_decision": decisions[-1] if decisions else None,
+                "decisions": decisions}
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+
+        def _loop():
+            while not self._stop_evt.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # pragma: no cover - defensive
+                    self.counters["errors"] += 1
+
+        self._thread = threading.Thread(target=_loop,
+                                        name="mxtpu-fleet-autoscale",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(max(1.0, self.interval_s * 4))
+            self._thread = None

@@ -26,13 +26,13 @@ gets its result, and the worker thread keeps serving.
 """
 from __future__ import annotations
 
-import collections
 import threading
 import time
 from concurrent.futures import Future
 
 import numpy as onp
 
+from .autoscale import SLOPolicy
 from .errors import DeadlineExceededError, QueueFullError, ServerClosedError
 from .metrics import ServingMetrics
 
@@ -40,14 +40,24 @@ __all__ = ["DynamicBatcher"]
 
 
 class _Request:
-    __slots__ = ("item", "future", "t_enqueue", "deadline", "version")
+    __slots__ = ("item", "future", "t_enqueue", "deadline", "version",
+                 "tier", "tenant", "rank", "vstart")
 
-    def __init__(self, item, version, deadline):
+    def __init__(self, item, version, deadline, tier="latency",
+                 tenant=None, rank=0, vstart=0.0):
         self.item = item
         self.future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline = deadline  # absolute perf_counter time or None
         self.version = version    # pinned version or None (= latest)
+        self.tier = tier          # "latency" | "bulk" (SLO class)
+        self.tenant = tenant
+        self.rank = rank          # tier priority (0 = latency, first)
+        self.vstart = vstart      # weighted-fair-queueing start tag
+
+    @property
+    def sort_key(self):
+        return (self.rank, self.vstart)
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline
@@ -66,15 +76,19 @@ class DynamicBatcher:
     """
 
     def __init__(self, registry, *, flush_ms=5.0, max_queue_depth=256,
-                 max_batch_size=None, metrics=None):
+                 max_batch_size=None, metrics=None, slo=None):
         self.registry = registry
         self.flush_s = float(flush_ms) / 1e3
         self.max_queue_depth = int(max_queue_depth)
         self._max_batch_override = max_batch_size
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # one SLO policy per replica (shared with registered engines):
+        # tier classification, weighted-fair tenant tags, and the
+        # service-rate estimate behind deadline-infeasibility shedding
+        self.slo = slo if slo is not None else SLOPolicy()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._queues = {}   # model -> {key: deque[_Request]}
+        self._queues = {}   # model -> {key: sorted list[_Request]}
         self._depth = {}    # model -> queued request count
         self._workers = {}  # model -> Thread
         self._engines = {}  # model -> DecodeEngine (generation path)
@@ -87,17 +101,60 @@ class DynamicBatcher:
         return self._stopping
 
     # -- admission --------------------------------------------------------
-    def submit(self, model, item, *, version=None, deadline_ms=None):
+    @staticmethod
+    def _insert(q, req):
+        """Priority insertion: queues stay sorted by ``(rank, vstart)``
+        — latency tier strictly before bulk, weighted-fair within a
+        tier.  All-default traffic degenerates to an append (FIFO)."""
+        i = len(q)
+        while i > 0 and q[i - 1].sort_key > req.sort_key:
+            i -= 1
+        q.insert(i, req)
+
+    def _evict_bulk_locked(self, model):
+        """Degradation ladder rung 1: a full queue admits a latency-tier
+        request by evicting the NEWEST bulk-tier one (typed 503 — it
+        retries later; the latency SLO is protected now).  Returns True
+        when a victim was found."""
+        victim = victim_q = None
+        for q in (self._queues.get(model) or {}).values():
+            for r in q:
+                if r.rank > 0 and (victim is None
+                                   or r.vstart > victim.vstart):
+                    victim, victim_q = r, q
+        if victim is None:
+            return False
+        victim_q.remove(victim)
+        self._depth[model] -= 1
+        self.metrics.count(model, "shed_total")
+        self.metrics.count(model, "bulk_evicted_total")
+        victim.future.set_exception(QueueFullError(
+            "bulk-tier request evicted to admit a latency-tier one "
+            "(queue at max_queue_depth=%d)" % self.max_queue_depth,
+            queued=self._depth.get(model, 0)))
+        return True
+
+    def submit(self, model, item, *, version=None, deadline_ms=None,
+               tier=None, tenant=None):
         """Enqueue one item; returns a ``concurrent.futures.Future`` that
         resolves to the model output for this item (the exception
         transport: a failed/shed/expired request rethrows at
-        ``future.result()``)."""
+        ``future.result()``).
+
+        ``tier`` ("latency"|"bulk") and ``tenant`` drive SLO-aware
+        admission: bulk is evicted first under overload, tenants share
+        capacity by their configured weights, and a deadline that
+        provably cannot be met at the observed service rate sheds
+        synchronously (``DeadlineInfeasibleError``)."""
         served = self.registry.get(model, version)  # ModelNotFound early
+        rank, vstart = self.slo.stamp(tier, tenant)  # BadRequest early
         arr = served.check_item(item)               # BadRequest early
         self.metrics.count(model, "requests_total")
         deadline = (time.perf_counter() + float(deadline_ms) / 1e3
                     if deadline_ms is not None else None)
-        req = _Request(arr, version, deadline)
+        req = _Request(arr, version, deadline,
+                       tier=self.slo.normalize_tier(tier), tenant=tenant,
+                       rank=rank, vstart=vstart)
         key = (version, tuple(arr.shape), str(arr.dtype))
         with self._cond:
             if self._stopping:
@@ -106,12 +163,29 @@ class DynamicBatcher:
                     "batcher is draining; not accepting new requests")
             depth = self._depth.get(model, 0)
             if depth >= self.max_queue_depth:
-                self.metrics.count(model, "shed_total")
-                raise QueueFullError(
-                    "model %r queue full (%d queued >= max_queue_depth=%d)"
-                    % (model, depth, self.max_queue_depth))
-            self._queues.setdefault(model, {}).setdefault(
-                key, collections.deque()).append(req)
+                # a latency-tier arrival evicts the newest bulk request
+                # instead of being shed itself (bulk sheds first)
+                if req.rank > 0 or not self._evict_bulk_locked(model):
+                    self.metrics.count(model, "shed_total")
+                    raise QueueFullError(
+                        "model %r queue full (%d queued >= "
+                        "max_queue_depth=%d)"
+                        % (model, depth, self.max_queue_depth),
+                        queued=depth)
+                depth = self._depth.get(model, 0)
+            if deadline_ms is not None and depth:
+                # rung 2: provably-late requests shed at admission with
+                # an honest drain estimate (no-op while the rate
+                # estimator is cold)
+                try:
+                    self.slo.check_deadline(depth,
+                                            float(deadline_ms) / 1e3)
+                except Exception:
+                    self.metrics.count(model, "shed_total")
+                    self.metrics.count(model, "infeasible_shed_total")
+                    raise
+            self._insert(self._queues.setdefault(model, {}).setdefault(
+                key, []), req)
             self._depth[model] = depth + 1
             if model not in self._workers:
                 t = threading.Thread(target=self._worker, args=(model,),
@@ -135,6 +209,7 @@ class DynamicBatcher:
         policy for both request kinds."""
         engine.metrics = self.metrics
         engine.max_queue_depth = self.max_queue_depth
+        engine.slo = self.slo  # one fairness/shed regime per replica
         with self._cond:
             self._engines[model] = engine
         return engine
@@ -188,9 +263,10 @@ class DynamicBatcher:
                 if self._stopping:
                     return None
                 self._cond.wait()
-            # serve the shape key whose head request is oldest (FIFO
-            # across buckets at the granularity of batches)
-            key = min(queues, key=lambda k: queues[k][0].t_enqueue)
+            # serve the shape key whose head request sorts first under
+            # the SLO order — latency tier before bulk, weighted-fair
+            # start tags within a tier (pure FIFO for untiered traffic)
+            key = min(queues, key=lambda k: queues[k][0].sort_key)
             q = queues[key]
             try:
                 served = self.registry.get(model, key[0])
@@ -220,7 +296,7 @@ class DynamicBatcher:
             now = time.perf_counter()
             expired = []
             while q and q[0].expired(now):
-                expired.append(q.popleft())
+                expired.append(q.pop(0))
             if expired:
                 self._depth[model] -= len(expired)
                 for r in expired:
@@ -233,11 +309,12 @@ class DynamicBatcher:
                     self._cond.notify_all()
                     return []
             n = min(len(q), target)
-            batch = [q.popleft() for _ in range(n)]
+            batch = [q.pop(0) for _ in range(n)]
             if not q:
                 del queues[key]
             self._depth[model] -= n
             self._cond.notify_all()
+        self.slo.on_dispatch(max(r.vstart for r in batch))
         return batch
 
     def _execute(self, model, batch):
@@ -265,6 +342,7 @@ class DynamicBatcher:
             out, bucket, device_s = served.run_batch(stacked)
             self.metrics.observe_batch(model, len(live), bucket, device_s)
             done = time.perf_counter()
+            self.slo.observe_served(len(live))
             for i, r in enumerate(live):
                 self.metrics.observe_request(
                     model, t_dispatch - r.t_enqueue, done - r.t_enqueue)

@@ -117,7 +117,8 @@ class ServingClient:
 
     # -- API --------------------------------------------------------------
     def predict(self, model, data, version=None, deadline_ms=None,
-                affinity_key=None, idempotent=None):
+                affinity_key=None, idempotent=None, tier=None,
+                tenant=None):
         """Run inference on a BATCH: ``data`` is a list of instances or
         an array whose leading axis is the batch (each instance must have
         the model's item shape — wrap a single item in a length-1 list).
@@ -143,11 +144,16 @@ class ServingClient:
             body["affinity_key"] = str(affinity_key)
         if idempotent is not None:
             body["idempotent"] = bool(idempotent)
+        if tier is not None:
+            body["tier"] = str(tier)
+        if tenant is not None:
+            body["tenant"] = str(tenant)
         doc = self._request("POST", path, body)
         return onp.asarray(doc["predictions"])
 
     def generate(self, model, prompt, max_tokens=16, *, session=None,
-                 resume=False, resume_on_reset=False, deadline_ms=None):
+                 resume=False, resume_on_reset=False, deadline_ms=None,
+                 tier=None, tenant=None):
         """Autoregressive generation: ``prompt`` is a list of token ids;
         returns the server's result dict (``tokens``, ``finish_reason``,
         token counts).
@@ -174,6 +180,10 @@ class ServingClient:
         body = {"prompt": prompt, "max_tokens": int(max_tokens)}
         if deadline_ms is not None:
             body["deadline_ms"] = float(deadline_ms)
+        if tier is not None:
+            body["tier"] = str(tier)
+        if tenant is not None:
+            body["tenant"] = str(tenant)
         if session is not None:
             body["session"] = str(session)
             body["affinity_key"] = str(session)

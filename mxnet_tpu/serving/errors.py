@@ -33,9 +33,31 @@ class ModelNotFoundError(ServingError):
 
 class QueueFullError(ServingError):
     """Load shed: the model's request queue is at max depth.  Raised
-    synchronously at submit() — fast-fail 503, never unbounded latency."""
+    synchronously at submit() — fast-fail 503, never unbounded latency.
+    ``queued`` (when known) carries the queue depth observed at shed
+    time; the router aggregates it across shedding replicas to compute
+    an honest ``Retry-After`` from the fleet's drain estimate."""
     http_status = 503
     code = "queue_full"
+
+    def __init__(self, message, queued=None):
+        super().__init__(message)
+        self.queued = queued
+
+
+class DeadlineInfeasibleError(ServingError):
+    """SLO-aware admission shed: at the current observed service rate
+    the queue ahead of this request drains AFTER its deadline, so
+    admitting it would only burn capacity on a guaranteed 504.  Sheds
+    synchronously at submit with ``retry_after`` = the queue drain
+    estimate — the honest earliest time a retry could succeed."""
+    http_status = 503
+    code = "deadline_infeasible"
+
+    def __init__(self, message, retry_after=None):
+        super().__init__(message)
+        if retry_after is not None:
+            self.retry_after = retry_after
 
 
 class ServerClosedError(ServingError):
@@ -95,8 +117,8 @@ CODE_TO_ERROR = {
     cls.code: cls
     for cls in (ServingError, BadRequestError, ModelNotFoundError,
                 QueueFullError, ServerClosedError, DeadlineExceededError,
-                SessionResetError, KVLeakError, FleetUnavailableError,
-                RolloutAbortedError)
+                DeadlineInfeasibleError, SessionResetError, KVLeakError,
+                FleetUnavailableError, RolloutAbortedError)
 }
 
 

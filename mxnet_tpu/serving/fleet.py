@@ -55,8 +55,10 @@ import time
 import numpy as onp
 
 from .. import config as _config
+from .. import faults
 from .. import profiler
 from ..kvstore.pagestore import PageStoreServer
+from .autoscale import Autoscaler
 from .errors import RolloutAbortedError, ServingError
 from .metrics import LatencyHistogram
 from .router import Router, RouterServer
@@ -257,7 +259,7 @@ class ServingFleet:
     def __init__(self, spec, *, replicas=None, policy="least_loaded",
                  host="127.0.0.1", port=0, env=None, roles=None,
                  sharding=None, router_kwargs=None,
-                 supervisor_kwargs=None):
+                 supervisor_kwargs=None, autoscale=None):
         self.supervisor = ReplicaSupervisor(
             spec, replicas=replicas, host=host, env=env,
             **(supervisor_kwargs or {}))
@@ -301,9 +303,13 @@ class ServingFleet:
         self._router_kwargs = dict(router_kwargs or {})
         self._host = host
         self._port = int(port)
+        # autoscale=True enables the control loop with config-knob
+        # defaults; a dict supplies Autoscaler(**kwargs) overrides
+        self._autoscale_cfg = autoscale
         self.router = None
         self.server = None
         self.pagestore = None
+        self.autoscaler = None
 
     @property
     def address(self):
@@ -323,18 +329,110 @@ class ServingFleet:
                              policy=self._policy, roles=self._roles,
                              **self._router_kwargs)
         self.server = RouterServer(self.router, host=self._host,
-                                   port=self._port)
+                                   port=self._port,
+                                   supervisor=self.supervisor)
         self.server.start()
+        if self._autoscale_cfg:
+            kwargs = (dict(self._autoscale_cfg)
+                      if isinstance(self._autoscale_cfg, dict) else {})
+            self.autoscaler = Autoscaler(
+                collect=self._autoscale_collect,
+                scale_up=self._autoscale_up,
+                scale_down=self._autoscale_down,
+                flip_role=self._autoscale_flip, **kwargs)
+            self.server.autoscaler = self.autoscaler
+            self.autoscaler.start()
         return self.address
 
     def rollout(self, model_spec, **kwargs):
         return rollout(self.router, model_spec, **kwargs)
 
+    # -- autoscaler hooks -------------------------------------------------
+    # The Autoscaler is deliberately fleet-agnostic: it sees a stats
+    # dict and calls back into these four hooks, so tier-1 tests can
+    # drive the same control loop on fake stats with no processes.
+
+    def _autoscale_collect(self):
+        """Fleet-wide load signals: router membership + each routable
+        replica's own /v1/stats (queue depth, busy slots, KV occupancy)."""
+        out = {}
+        for rid, st in self.router.states().items():
+            routable = (st.get("state") == "healthy" and st.get("ready")
+                        and not st.get("draining"))
+            row = {"role": st.get("role", "mixed"), "routable": routable,
+                   "queued": 0, "active": 0, "slots": 0, "kv_frac": 0.0}
+            if routable:
+                host, _, port = rid.rpartition(":")
+                try:
+                    status, doc = _replica_request(host, int(port), "GET",
+                                                   "/v1/stats", timeout=5.0)
+                except (OSError, ValueError):
+                    status, doc = 0, {}
+                if status == 200:
+                    for g in (doc.get("generators") or {}).values():
+                        row["queued"] += int(g.get("queued", 0))
+                        row["active"] += int(g.get("active", 0))
+                        row["slots"] += int(g.get("slots", 0))
+                        kv = g.get("kv") or {}
+                        row["kv_frac"] = max(row["kv_frac"],
+                                             float(kv.get("occupancy",
+                                                          0.0)))
+                    for depth in (doc.get("queue_depths") or {}).values():
+                        row["queued"] += int(depth)
+            out[rid] = row
+        return {"replicas": out}
+
+    def _autoscale_up(self, role="mixed"):
+        """Spawn one replica under the chip budget and register it with
+        the router unroutable; the probe loop admits it on /readyz."""
+        faults.check("replica.spawn")
+        env = {"MXNET_GEN_ROLE": role} if role != "mixed" else None
+        r = self.supervisor.add_replica(env=env)
+        self.router.add_replica(r.addr, role=role, ready=False)
+        return r.addr
+
+    def _autoscale_down(self, rid):
+        """Drain one replica without resetting anyone: stop new traffic,
+        park every decode session in the page store, then retire the
+        process.  Returns the number of sessions migrated out."""
+        self.router.set_drain(rid, True)
+        host, _, port = rid.rpartition(":")
+        migrated = _migrate_sessions(host, int(port))
+        self.router.remove_replica(rid)
+        for r in list(self.supervisor.replicas):
+            if r.addr == rid:
+                self.supervisor.stop_replica(r.rid)
+                break
+        return migrated
+
+    def _autoscale_flip(self, rid, role):
+        """Repurpose one replica prefill<->decode at runtime: flip the
+        engine's own role gate, then the router's pool assignment, then
+        the supervisor env so a crash-restart keeps the new role."""
+        host, _, port = rid.rpartition(":")
+        _replica_request(host, int(port), "POST", "/v1/admin/set_role",
+                         {"role": role}, timeout=10.0)
+        self.router.set_role(rid, role)
+        for r in self.supervisor.replicas:
+            if r.addr == rid:
+                renv = self.supervisor.env_by_rid.setdefault(r.rid, {})
+                if role == "mixed":
+                    renv.pop("MXNET_GEN_ROLE", None)
+                else:
+                    renv["MXNET_GEN_ROLE"] = role
+                break
+        return role
+
     def status(self):
         return {"router": self.router.snapshot() if self.router else None,
-                "supervisor": self.supervisor.states()}
+                "supervisor": self.supervisor.states(),
+                "autoscale": (self.autoscaler.snapshot()
+                              if self.autoscaler else None)}
 
     def stop(self):
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+            self.autoscaler = None
         if self.server is not None:
             self.server.stop()  # stops the router's probe loop too
             self.server = None

@@ -102,6 +102,7 @@ from ..models import decoder as _decoder
 from ..ops.pallas import fused_cell as _fused_cell
 from ..ops.pallas import paged_attention as _paged
 from ..ops.pallas.paged_attention import copy_page as _copy_page
+from .autoscale import SLOPolicy
 from .errors import (BadRequestError, DeadlineExceededError, QueueFullError,
                      ServerClosedError, ServingError, SessionResetError)
 from .kvcache import (CacheOOM, PageAllocator, PrefixCache, pack_session,
@@ -116,9 +117,11 @@ _log = logging.getLogger(__name__)
 class _Request:
     __slots__ = ("prompt", "max_new", "deadline", "future", "session",
                  "resume", "t_enqueue", "prefix", "ttft_recorded",
-                 "prompt_tokens", "started")
+                 "prompt_tokens", "started", "tier", "tenant", "rank",
+                 "vstart")
 
-    def __init__(self, prompt, max_new, deadline, session, resume):
+    def __init__(self, prompt, max_new, deadline, session, resume,
+                 tier="latency", tenant=None, rank=0, vstart=0.0):
         self.prompt = list(prompt)
         self.prompt_tokens = len(self.prompt)  # as submitted (reporting)
         self.max_new = int(max_new)
@@ -130,6 +133,14 @@ class _Request:
         self.prefix = []                  # tokens emitted before a preempt
         self.ttft_recorded = False
         self.started = False              # future already marked running
+        self.tier = tier                  # "latency" | "bulk" (SLO class)
+        self.tenant = tenant
+        self.rank = rank                  # tier priority (0 = latency)
+        self.vstart = vstart              # weighted-fair start tag
+
+    @property
+    def sort_key(self):
+        return (self.rank, self.vstart)
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline
@@ -235,7 +246,7 @@ class DecodeEngine:
                  pagestore=None, speculate=None, spec_k=None,
                  drafter=None, draft_model=None, sharding=None,
                  quantize=None, quant_group=None, kv_dtype=None,
-                 async_decode=None, dispatch_ahead=None):
+                 async_decode=None, dispatch_ahead=None, slo=None):
         # quantized serving (weight-only int8/int4 + int8 KV pages):
         # accept a pre-wrapped serving.quantize.QuantizedLM, or wrap
         # here from the kwarg/env knob.  Weights and KV cache quantize
@@ -382,6 +393,10 @@ class DecodeEngine:
         self._slots = [_Slot(i) for i in range(self.slots)]
         self._sessions = {}           # sid -> _Session (parked or busy)
         self._queue = collections.deque()
+        # SLO admission policy (tiers / weighted-fair tags / deadline
+        # infeasibility); DynamicBatcher.register_engine replaces it
+        # with the replica-wide shared instance
+        self.slo = slo if slo is not None else SLOPolicy()
         self._cond = threading.Condition()
         self._worker = None
         self._stopping = False
@@ -456,13 +471,54 @@ class DecodeEngine:
         with self._cond:
             return sum(1 for s in self._slots if s.active)
 
+    def set_role(self, role):
+        """Runtime prefill↔decode role flip (the autoscaler's pool
+        rebalance): the role is read per-request at the disaggregation
+        handoff, so in-flight work finishes under the OLD role and new
+        admissions follow the new one.  Returns the previous role."""
+        role = str(role)
+        if role not in ("prefill", "decode", "mixed"):
+            raise BadRequestError(
+                "role must be prefill|decode|mixed, got %r" % (role,))
+        with self._cond:
+            prev, self.role = self.role, role
+        return prev
+
+    def _evict_bulk_locked(self):
+        """Degradation ladder rung 1 (generate path): a full queue
+        admits a latency-tier request by evicting the newest queued
+        bulk-tier one.  Returns True when a victim was found."""
+        victim = None
+        for r in self._queue:
+            if r.rank > 0 and (victim is None
+                               or r.vstart > victim.vstart):
+                victim = r
+        if victim is None:
+            return False
+        self._queue.remove(victim)
+        self.metrics.count(self.name, "shed_total")
+        self.metrics.count(self.name, "bulk_evicted_total")
+        victim.future.set_exception(QueueFullError(
+            "bulk-tier generate evicted to admit a latency-tier one "
+            "(queue at max_queue_depth=%d)" % self.max_queue_depth,
+            queued=len(self._queue)))
+        return True
+
     def submit(self, prompt, max_new_tokens=16, *, deadline_ms=None,
-               session=None, resume=False):
+               session=None, resume=False, tier=None, tenant=None):
         """Enqueue one generation; returns a Future resolving to
         ``{"tokens", "finish_reason", "session", "prompt_tokens",
         "completion_tokens"}``.  Shed/deadline/reset failures rethrow
         typed at ``future.result()`` (or synchronously at submit for
-        admission-time refusals), matching the batcher's contract."""
+        admission-time refusals), matching the batcher's contract.
+
+        ``tier``/``tenant`` drive SLO-aware admission (see
+        :class:`~.autoscale.SLOPolicy`): latency-tier requests queue
+        ahead of (and under overload evict) bulk-tier ones, tenants
+        share by weight, and a provably-unmeetable deadline sheds
+        synchronously with a drain-estimate ``retry_after``."""
+        rank, vstart = self.slo.stamp(tier, tenant)
+        tier = self.slo.normalize_tier(tier)
         prompt = [int(t) for t in prompt]
         if not prompt and not (resume and session is not None):
             # an empty prompt is legal only as a resume continuation
@@ -489,10 +545,26 @@ class DecodeEngine:
                 raise ServerClosedError(
                     "decode engine is draining; not accepting new requests")
             if len(self._queue) >= self.max_queue_depth:
-                self.metrics.count(self.name, "shed_total")
-                raise QueueFullError(
-                    "model %r generate queue full (%d >= %d)"
-                    % (self.name, len(self._queue), self.max_queue_depth))
+                # bulk sheds first: a latency-tier arrival evicts the
+                # newest bulk request instead of being refused itself
+                if rank > 0 or not self._evict_bulk_locked():
+                    self.metrics.count(self.name, "shed_total")
+                    raise QueueFullError(
+                        "model %r generate queue full (%d >= %d)"
+                        % (self.name, len(self._queue),
+                           self.max_queue_depth),
+                        queued=len(self._queue))
+            if deadline_ms is not None and self._queue:
+                # provably-late requests shed at admission (no-op while
+                # the service-rate estimator is cold)
+                try:
+                    self.slo.check_deadline(len(self._queue),
+                                            float(deadline_ms) / 1e3)
+                except Exception:
+                    self.metrics.count(self.name, "shed_total")
+                    self.metrics.count(self.name,
+                                       "infeasible_shed_total")
+                    raise
             missing = (session is not None
                        and session not in self._sessions
                        and session not in self._pending_imports)
@@ -513,8 +585,15 @@ class DecodeEngine:
                 raise SessionResetError(
                     "session %r is not held by this replica (restarted or "
                     "expired); restart generation" % (session,))
-            req = _Request(prompt, max_new, deadline, session, resume)
-            self._queue.append(req)
+            req = _Request(prompt, max_new, deadline, session, resume,
+                           tier=tier, tenant=tenant, rank=rank,
+                           vstart=vstart)
+            # priority insertion: latency tier ahead of bulk, weighted-
+            # fair tags within a tier (all-default traffic appends)
+            i = len(self._queue)
+            while i > 0 and self._queue[i - 1].sort_key > req.sort_key:
+                i -= 1
+            self._queue.insert(i, req)
             self._ensure_worker_locked()
             self._cond.notify_all()
         return req.future
@@ -966,6 +1045,7 @@ class DecodeEngine:
                 if sess is not None and sess.busy:
                     return  # head-of-line: continuation waits for its turn
                 self._queue.popleft()
+            self.slo.on_dispatch(req.vstart)
             if not self._activate(slot, req, sess):
                 return
 
@@ -2176,6 +2256,7 @@ class DecodeEngine:
             self._spec_release(slot.owner, slot.owner)
         self.metrics.count(self.name, "sequences_completed_total")
         self.metrics.observe_generate_done(self.name, now - req.t_enqueue)
+        self.slo.observe_served(1)  # feeds the drain-rate estimator
         self._clear(slot)
         req.future.set_result({
             "tokens": tokens,
@@ -2329,6 +2410,8 @@ class DecodeEngine:
                "prefill_chunk": self.prefill_chunk,
                "max_ctx": self.max_ctx,
                "role": self.role,
+               "slo": {"service_rate": self.slo.service_rate(),
+                       "default_tier": self.slo.default_tier},
                "async": {"enabled": self.async_decode,
                          "dispatch_ahead": self.dispatch_ahead,
                          "inflight": len(self._pipe)},

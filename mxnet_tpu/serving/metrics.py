@@ -76,6 +76,10 @@ class ModelMetrics:
     COUNTERS = ("requests_total", "responses_total", "shed_total",
                 "deadline_expired_total", "errors_total", "batches_total",
                 "items_total", "bucket_slots_total",
+                # SLO-aware admission (PR 18): bulk-tier requests evicted
+                # to admit latency-tier ones, and requests shed because
+                # they provably could not meet their deadline
+                "bulk_evicted_total", "infeasible_shed_total",
                 # generation (continuous-batching decode engine)
                 "tokens_generated_total", "prefill_tokens_total",
                 "sequences_total", "sequences_completed_total",

@@ -136,27 +136,48 @@ class FleetMetrics:
     (what clients experience THROUGH the router, retries included) plus
     per-replica counters, mirrored into the profiler fleet table."""
 
+    #: EMA factor for the observed fleet service rate (responses/s)
+    RATE_ALPHA = 0.2
+
     def __init__(self):
         self._lock = threading.Lock()
         self._latency = LatencyHistogram()
         self.counters = {"requests_total": 0, "responses_total": 0,
                          "retries_total": 0, "shed_total": 0,
                          "errors_total": 0}
+        self._rate = 0.0       # responses/s EMA (drain-rate estimate)
+        self._rate_t = None    # last response timestamp (monotonic)
 
     def count(self, name, n=1):
         with self._lock:
             self.counters[name] += n
 
     def observe(self, dt_s):
+        now = time.monotonic()
         with self._lock:
             self.counters["responses_total"] += 1
             self._latency.observe(dt_s)
+            if self._rate_t is not None:
+                gap = now - self._rate_t
+                if gap > 1e-9:
+                    inst = 1.0 / gap
+                    self._rate = (inst if self._rate == 0.0
+                                  else self.RATE_ALPHA * inst
+                                  + (1 - self.RATE_ALPHA) * self._rate)
+            self._rate_t = now
         profiler.record_fleet_stat("router.dispatch", dt_s)
+
+    def service_rate(self):
+        """Observed fleet-wide service rate (responses/s EMA) — the
+        denominator of the router's honest Retry-After computation."""
+        with self._lock:
+            return self._rate
 
     def snapshot(self):
         with self._lock:
             return {"counters": dict(self.counters),
-                    "latency": self._latency.snapshot()}
+                    "latency": self._latency.snapshot(),
+                    "service_rate": self._rate}
 
 
 class Router:
@@ -202,8 +223,13 @@ class Router:
             self._probe_thread.start()
 
     # -- membership -------------------------------------------------------
-    def add_replica(self, spec, role="mixed"):
+    def add_replica(self, spec, role="mixed", ready=True):
+        """``ready=False`` adds the replica unroutable (a replica still
+        booting — the autoscaler's scale-up path); the probe loop flips
+        it routable on the first /readyz success, so no request ever
+        strikes a replica for the crime of starting up."""
         r = Replica(spec, role=role)
+        r.ready = bool(ready)
         with self._lock:
             if r.rid in self._replicas:
                 return self._replicas[r.rid]
@@ -229,6 +255,20 @@ class Router:
         model warmup runs undisturbed) but is not struck or ejected."""
         with self._lock:
             self._replicas[rid].draining = bool(draining)
+
+    def set_role(self, rid, role):
+        """Runtime prefill↔decode re-pooling: role is read at ``_pick``
+        time, so the flip takes effect on the next dispatch with no
+        membership churn (the autoscaler pairs this with the replica's
+        own ``/v1/admin/set_role``)."""
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError("role must be prefill|decode|mixed, got %r"
+                             % (role,))
+        with self._lock:
+            prev = self._replicas[rid].role
+            self._replicas[rid].role = str(role)
+        profiler.record_event_stat("fleet.role_flip")
+        return prev
 
     def role_split(self):
         """True when the fleet has specialized prefill/decode replicas
@@ -411,15 +451,18 @@ class Router:
 
     # -- dispatch ---------------------------------------------------------
     def dispatch(self, path, body=None, *, method="POST", deadline_s=None,
-                 affinity_key=None, idempotent=True, pool=None):
+                 affinity_key=None, idempotent=True, pool=None,
+                 tier=None):
         """Forward one request; returns ``(status, doc)``.
 
         Transport failures fail over to the next replica (each tried at
         most once) inside the deadline; reply-phase losses fail over only
         when ``idempotent``.  Replica sheds retry once on the
-        least-loaded alternative; when everyone sheds, raises
-        :class:`QueueFullError` with ``retry_after`` set — the router's
-        own socket-level shed."""
+        least-loaded alternative (``tier="bulk"`` requests skip that
+        retry — under overload the retry capacity belongs to the latency
+        tier); when everyone sheds, raises :class:`QueueFullError` with
+        ``retry_after`` computed from the aggregate shed queue depth /
+        observed service rate — the router's own socket-level shed."""
         if isinstance(body, (dict, list)):
             body = json.dumps(body).encode()
         self.metrics.count("requests_total")
@@ -428,6 +471,7 @@ class Router:
                          else self.timeout)
         tried = set()
         sheds = 0
+        shed_queued = 0   # queue depth reported by shedding replicas
         last_exc = None
         last_5xx = None
         while True:
@@ -479,7 +523,11 @@ class Router:
                 profiler.record_fleet_stat("router.shed.%s" % r.rid)
                 tried.add(r.rid)
                 sheds += 1
-                if sheds == 1:
+                try:
+                    shed_queued += int(doc.get("queued") or 0)
+                except (TypeError, ValueError):
+                    pass
+                if sheds == 1 and tier != "bulk":
                     self.metrics.count("retries_total")
                     continue
                 break  # second shed: propagate instead of hammering on
@@ -511,7 +559,7 @@ class Router:
         if sheds:  # overload: every routable replica load-shed
             exc = QueueFullError(
                 "all %d routable replica(s) shed this request — fleet at "
-                "capacity" % sheds)
+                "capacity" % sheds, queued=shed_queued)
         elif last_exc is not None:  # failures, and no replica left to try
             exc = FleetUnavailableError(
                 "no replica left to try after %d failure(s); last: %r"
@@ -520,8 +568,19 @@ class Router:
             exc = FleetUnavailableError(
                 "no routable replica (%d registered)"
                 % len(self.replica_ids()))
-        exc.retry_after = max(0.1, min(1.0, self.probe_s * 2))
+        exc.retry_after = self._retry_after(shed_queued)
         raise exc
+
+    def _retry_after(self, shed_queued):
+        """Honest Retry-After: the shedding replicas' aggregate queue
+        depth over the observed fleet service rate — the drain estimate
+        — so a deeper backlog tells clients to back off longer.  Falls
+        back to a probe-interval heuristic while the rate estimator (or
+        the depth report) is cold."""
+        rate = self.metrics.service_rate()
+        if shed_queued > 0 and rate > 0.0:
+            return max(0.05, min(60.0, shed_queued / rate))
+        return max(0.1, min(1.0, self.probe_s * 2))
 
     # -- stats / lifecycle ------------------------------------------------
     def states(self):
@@ -555,12 +614,21 @@ class RouterServer:
 
     Router-specific endpoints: ``/v1/stats`` reports the fleet snapshot
     (router latency histogram + per-replica states/counters + each live
-    replica's own labelled stats), ``/readyz`` is 200 iff at least one
-    replica is routable, and a router-level shed carries a
-    ``Retry-After`` header."""
+    replica's own labelled stats, plus ``supervisor`` crash-loop state
+    and the ``autoscale`` decision log when those are attached),
+    ``/readyz`` is 200 iff at least one replica is routable, and a
+    router-level shed carries a ``Retry-After`` header computed from
+    the fleet's queue drain estimate.
 
-    def __init__(self, router, *, host="127.0.0.1", port=0):
+    ``supervisor`` / ``autoscaler`` (optional, settable after
+    construction — ``ServingFleet`` wires them) feed the extra
+    ``/v1/stats`` blocks and Prometheus gauges."""
+
+    def __init__(self, router, *, host="127.0.0.1", port=0,
+                 supervisor=None, autoscaler=None):
         self.router = router
+        self.supervisor = supervisor
+        self.autoscaler = autoscaler
         self._host = host
         self._port = int(port)
         self._httpd = None
@@ -599,12 +667,15 @@ class RouterServer:
             def _reply_error(self, exc):
                 status = getattr(exc, "http_status", 500)
                 code = getattr(exc, "code", "internal")
+                payload = {"error": str(exc), "code": code}
+                queued = getattr(exc, "queued", None)
+                if queued is not None:
+                    payload["queued"] = int(queued)
                 headers = {}
                 retry_after = getattr(exc, "retry_after", None)
                 if retry_after is not None:
                     headers["Retry-After"] = "%g" % retry_after
-                self._reply(status, {"error": str(exc), "code": code},
-                            headers)
+                self._reply(status, payload, headers)
 
             def do_GET(self):
                 try:
@@ -667,6 +738,13 @@ class RouterServer:
         if path in ("/v1/stats", "/stats"):
             snap = self.router.snapshot()
             snap["replica_stats"] = self._collect_replica_stats()
+            if self.supervisor is not None:
+                # per-replica crash-loop state: restart budget left,
+                # backoff stage, window counters (visible BEFORE a
+                # replica goes "failed", not only after)
+                snap["supervisor"] = self.supervisor.states()
+            if self.autoscaler is not None:
+                snap["autoscale"] = self.autoscaler.snapshot()
             return 200, snap
         if path == "/metrics":
             return 200, {"text": self._prometheus_text()}
@@ -675,11 +753,14 @@ class RouterServer:
         return self.router.dispatch(path, method="GET")
 
     def _handle_post(self, path, raw_body):
+        if path == "/v1/admin/set_role":
+            return self._handle_set_role(raw_body)
         if not _PREDICT_RE.match(path):
             raise ModelNotFoundError("no route %r" % (path,))
         deadline_s = None
         affinity_key = None
         idempotent = True
+        tier = None
         body = None
         if raw_body:
             try:
@@ -697,6 +778,7 @@ class RouterServer:
                             or body.get("session"))
             idempotent = bool(body.get(
                 "idempotent", body.get("session") is None))
+            tier = body.get("tier")
         pool = None
         if (path.endswith(":generate") and isinstance(body, dict)
                 and self.router.role_split()):
@@ -712,7 +794,36 @@ class RouterServer:
             pool = "decode"
         return self.router.dispatch(
             path, raw_body, deadline_s=deadline_s,
-            affinity_key=affinity_key, idempotent=idempotent, pool=pool)
+            affinity_key=affinity_key, idempotent=idempotent, pool=pool,
+            tier=tier)
+
+    def _handle_set_role(self, raw_body):
+        """``POST /v1/admin/set_role`` at the router: flip one
+        replica's role on the replica itself (its engines re-pool their
+        disaggregation handoff) AND in the router's own pools — the two
+        views move together."""
+        try:
+            body = json.loads(raw_body.decode() or "{}")
+        except (ValueError, TypeError):
+            body = {}
+        rid = body.get("replica")
+        role = body.get("role")
+        if role not in ("prefill", "decode", "mixed") or not rid:
+            raise ServingError(
+                'set_role needs {"replica": "<host:port>", "role": '
+                '"prefill|decode|mixed"}')
+        with self.router._lock:
+            replica = self.router._replicas.get(rid)
+        if replica is None:
+            raise ModelNotFoundError("no replica %r" % (rid,))
+        status, doc = self.router._forward(
+            replica, "POST", "/v1/admin/set_role",
+            json.dumps({"role": role}).encode(), timeout=10.0)
+        if status != 200:
+            return status, doc
+        previous = self.router.set_role(rid, role)
+        return 200, {"ok": True, "replica": rid, "role": role,
+                     "previous": previous, "engines": doc.get("previous")}
 
     def _disagg_generate(self, path, body, deadline_s):
         """DistServe-style two-phase generate: the prefill pool chunks
@@ -801,6 +912,9 @@ class RouterServer:
             if k == "count":
                 continue
             lines.append("mxtpu_fleet_latency_%s %g" % (k, v))
+        if snap.get("service_rate") is not None:
+            lines.append("mxtpu_fleet_service_rate %g"
+                         % snap["service_rate"])
         for rid, st in sorted(snap["replicas"].items()):
             labels = 'replica="%s"' % rid
             lines.append('mxtpu_fleet_replica_up{%s} %d'
@@ -810,4 +924,30 @@ class RouterServer:
             for cname, v in sorted(st["counters"].items()):
                 lines.append("mxtpu_fleet_replica_%s{%s} %d"
                              % (cname, labels, v))
+        if self.supervisor is not None:
+            for rid, st in sorted(self.supervisor.states().items()):
+                labels = 'replica="%s"' % st.get("addr", rid)
+                for gauge in ("restart_budget_remaining",
+                              "restarts_in_window", "backoff_stage"):
+                    if st.get(gauge) is not None:
+                        lines.append("mxtpu_fleet_replica_%s{%s} %g"
+                                     % (gauge, labels, st[gauge]))
+                lines.append('mxtpu_fleet_replica_failed{%s} %d'
+                             % (labels,
+                                1 if st.get("state") == "failed" else 0))
+        if self.autoscaler is not None:
+            asnap = self.autoscaler.snapshot()
+            for cname, v in sorted(asnap["counters"].items()):
+                lines.append("mxtpu_fleet_autoscale_%s_total %d"
+                             % (cname, v))
+            sig = asnap["signals"]
+            if sig.get("live") is not None:
+                lines.append("mxtpu_fleet_autoscale_replicas_live %d"
+                             % sig["live"])
+            for gauge in ("queue_per_replica", "kv_frac"):
+                if sig.get(gauge) is not None:
+                    lines.append("mxtpu_fleet_autoscale_%s %g"
+                                 % (gauge, sig[gauge]))
+            lines.append("mxtpu_fleet_autoscale_chip_budget %d"
+                         % asnap["config"]["chip_budget"])
         return "\n".join(lines) + "\n"

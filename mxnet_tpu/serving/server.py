@@ -110,18 +110,31 @@ class ModelServer:
             def log_message(self, *args):  # quiet: metrics are the log
                 pass
 
-            def _reply(self, status, payload):
+            def _reply(self, status, payload, headers=None):
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
             def _reply_error(self, exc):
                 status = getattr(exc, "http_status", 500)
                 code = getattr(exc, "code", "internal")
-                self._reply(status, {"error": str(exc), "code": code})
+                payload = {"error": str(exc), "code": code}
+                # a shed reply reports the queue depth it saw, so the
+                # fleet router can compute an honest aggregate
+                # Retry-After from the drain estimate
+                queued = getattr(exc, "queued", None)
+                if queued is not None:
+                    payload["queued"] = int(queued)
+                headers = {}
+                retry_after = getattr(exc, "retry_after", None)
+                if retry_after is not None:
+                    headers["Retry-After"] = "%g" % retry_after
+                self._reply(status, payload, headers)
 
             def do_GET(self):
                 try:
@@ -186,6 +199,11 @@ class ModelServer:
                        for name, e in self.batcher._engines.items()}
             if engines:
                 snap["generators"] = engines
+            # live (not counter-derived) queue depths: what the fleet
+            # autoscaler's control loop aggregates each tick
+            snap["queue_depths"] = {
+                name: self.batcher.queue_depth(name)
+                for name in list(self.batcher._queues)}
             return 200, snap
         if path == "/metrics":
             return 200, {"text": self._prometheus_text()}
@@ -220,8 +238,11 @@ class ModelServer:
             raise BadRequestError(
                 'body must carry "instances": [<item>, ...]')
         deadline_ms = body.get("deadline_ms")
+        tier = body.get("tier")
+        tenant = body.get("tenant")
         futures = [self.batcher.submit(name, inst, version=version,
-                                       deadline_ms=deadline_ms)
+                                       deadline_ms=deadline_ms,
+                                       tier=tier, tenant=tenant)
                    for inst in instances]
         timeout = (float(deadline_ms) / 1e3 + 1.0 if deadline_ms is not None
                    else self.request_timeout_s)
@@ -272,7 +293,9 @@ class ModelServer:
             max_new_tokens=body.get("max_tokens", 16),
             deadline_ms=deadline_ms,
             session=body.get("session"),
-            resume=resume)
+            resume=resume,
+            tier=body.get("tier"),
+            tenant=body.get("tenant"))
         timeout = (float(deadline_ms) / 1e3 + 1.0 if deadline_ms is not None
                    else self.request_timeout_s)
         try:
@@ -326,6 +349,22 @@ class ModelServer:
                 raise ModelNotFoundError(
                     "no decode engine %r on this replica" % (name,))
             return 200, {"ok": True, "migrated": engine.migrate_out()}
+        if path == "/v1/admin/set_role":
+            # runtime prefill↔decode flip (the autoscaler's pool
+            # rebalance): flips every decode engine on this replica (or
+            # one, with "name"); the router re-pools on its own copy
+            role = body.get("role")
+            if role not in ("prefill", "decode", "mixed"):
+                raise BadRequestError(
+                    'set_role needs {"role": "prefill|decode|mixed"}')
+            name = body.get("model") or body.get("name")
+            engines = (list(self.batcher._engines.items()) if name is None
+                       else [(name, self.batcher._engines.get(name))])
+            if not engines or any(e is None for _, e in engines):
+                raise ModelNotFoundError(
+                    "no decode engine %r on this replica" % (name,))
+            previous = {n: e.set_role(role) for n, e in engines}
+            return 200, {"ok": True, "role": role, "previous": previous}
         raise ModelNotFoundError("no admin route %r" % (path,))
 
     def _admin_load_generate(self, body):

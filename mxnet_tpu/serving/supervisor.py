@@ -124,6 +124,7 @@ class ReplicaSupervisor:
             raise ValueError("need %d ports, got %d" % (self.n, len(ports)))
         self.replicas = [ReplicaProcess("r%d" % i, host, p)
                          for i, p in enumerate(ports)]
+        self._next_idx = self.n   # rid counter for autoscale add_replica
         self._spec_path = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -193,7 +194,7 @@ class ReplicaSupervisor:
         warmup included); raises with the laggard's log tail on timeout."""
         deadline = time.monotonic() + (timeout if timeout is not None
                                        else self.startup_timeout_s)
-        for r in self.replicas:
+        for r in list(self.replicas):
             while not self._ready(r):
                 if not r.alive():
                     raise RuntimeError(
@@ -216,11 +217,53 @@ class ReplicaSupervisor:
         except OSError:
             return "<no log>"
 
+    # -- elastic membership (autoscaler hooks) ----------------------------
+    def add_replica(self, env=None, spawn=True):
+        """Scale-up: reserve a fresh port, register a new replica slot,
+        and (by default) spawn its process immediately.  The monitor
+        loop adopts it — same restart budget and backoff as the boot
+        cohort.  Returns the new :class:`ReplicaProcess` (the caller
+        waits on readiness through the router's probe loop, not here)."""
+        port = _reserve_ports(1, self.host)[0]
+        with self._lock:
+            rid = "r%d" % self._next_idx
+            self._next_idx += 1
+            r = ReplicaProcess(rid, self.host, port)
+            if env:
+                self.env_by_rid[rid] = dict(env)
+            self.replicas.append(r)
+        if spawn and self._spec_path is not None:
+            self._spawn(r)
+        profiler.record_event_stat("fleet.replica_spawn")
+        return r
+
+    def stop_replica(self, rid, timeout=15.0):
+        """Scale-down: remove one replica from supervision (no restart)
+        and terminate its process.  The caller is responsible for
+        draining/migrating its sessions FIRST — this is the mechanical
+        tail of the autoscaler's drain-by-migration path."""
+        with self._lock:
+            r = next((x for x in self.replicas if x.rid == rid), None)
+            if r is None:
+                return None
+            self.replicas.remove(r)
+            self.env_by_rid.pop(rid, None)
+        r.state = "stopped"
+        if r.alive():
+            r.proc.send_signal(signal.SIGTERM)
+            try:
+                r.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                r.proc.kill()
+                r.proc.wait(5.0)
+        profiler.record_event_stat("fleet.replica_drained")
+        return r
+
     # -- monitor / restart ------------------------------------------------
     def _monitor_loop(self):
         while not self._stop.wait(0.1):
             now = time.monotonic()
-            for r in self.replicas:
+            for r in list(self.replicas):
                 if self._stop.is_set():
                     return
                 if r.state == "failed" or r.alive():
@@ -260,14 +303,35 @@ class ReplicaSupervisor:
                     profiler.record_event_stat("fleet.replica_restart")
 
     def alive_count(self):
-        return sum(1 for r in self.replicas if r.alive())
+        return sum(1 for r in list(self.replicas) if r.alive())
 
     def ready_count(self):
-        return sum(1 for r in self.replicas
+        return sum(1 for r in list(self.replicas)
                    if r.alive() and self._ready(r))
 
     def states(self):
-        return {r.rid: r.describe() for r in self.replicas}
+        """Per-replica process + crash-loop state: on top of
+        ``describe()``, each entry carries the restart-discipline
+        internals (budget remaining in the sliding window, backoff
+        stage, pending-restart countdown) so the crash-loop brake is
+        observable BEFORE a replica hits ``failed``."""
+        now = time.monotonic()
+        out = {}
+        for r in list(self.replicas):
+            d = r.describe()
+            in_window = sum(1 for t in r.restart_times
+                            if now - t <= self.restart_window_s)
+            d["restart_budget"] = self.restart_budget
+            d["restarts_in_window"] = in_window
+            d["restart_budget_remaining"] = max(
+                0, self.restart_budget - in_window)
+            d["backoff_stage"] = r.consecutive_crashes
+            d["restart_window_s"] = self.restart_window_s
+            d["next_restart_in_s"] = (
+                round(max(0.0, r.next_restart - now), 3)
+                if r.next_restart else 0.0)
+            out[r.rid] = d
+        return out
 
     # -- chaos hooks ------------------------------------------------------
     def kill(self, index, sig=signal.SIGKILL):
@@ -283,12 +347,12 @@ class ReplicaSupervisor:
         if self._monitor is not None:
             self._monitor.join(5.0)
             self._monitor = None
-        for r in self.replicas:
+        for r in list(self.replicas):
             r.state = "stopped"
             if r.alive():
                 r.proc.send_signal(signal.SIGTERM)
         deadline = time.monotonic() + timeout
-        for r in self.replicas:
+        for r in list(self.replicas):
             if r.proc is None:
                 continue
             try:

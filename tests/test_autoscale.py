@@ -1,0 +1,1052 @@
+"""Fleet autoscaling + SLO-aware admission (`autoscale` marker).
+
+The tier-1 matrix for ISSUE 18:
+
+- SLOPolicy units: tier classification, tenant-weight parsing, start-
+  time-fair-queueing tags (FIFO degeneration, weighted shares, rank
+  dominance), service-rate EMA and deadline-infeasibility shedding;
+- Autoscaler control loop on FAKE clocks and FAKE replica stats (no
+  sleeps, no processes): hysteresis bands, EMA smoothing, cooldown
+  anti-flap, chip budget, min-replicas floor, idlest-drain selection,
+  prefill<->decode role flips, fault-site behaviour (exception kind
+  aborts one tick, soft `drop` inverts the decision under guards),
+  decision ring + profiler audit trail;
+- admission ladder through the real batcher and decode engine: bulk
+  evicted for latency, infeasible deadlines shed typed with an honest
+  retry_after, priority dispatch order;
+- router: Retry-After computed from shed queue depth / observed service
+  rate (deeper queue => larger Retry-After — the satellite regression),
+  bulk tier skips the shed retry, runtime set_role re-pools;
+- monotonic-clock audit: an NTP wall-clock step must not eject replicas;
+- supervisor crash-loop observability ( /v1/stats + Prometheus);
+- rollout x session-migration x async-engine composed in one pass;
+- the 10x diurnal ramp chaos drill (slow lane).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import faults, profiler, serving
+from mxnet_tpu.kvstore.pagestore import PageStoreServer
+from mxnet_tpu.serving.replica import demo_affine
+
+pytestmark = [pytest.mark.serving, pytest.mark.autoscale]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ITEM = (4,)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from mxnet_tpu.models import decoder
+    return decoder.decoder_tiny_lm(seed=0, vocab_size=128)
+
+
+def make_engine(lm, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("max_ctx", 64)
+    return serving.DecodeEngine(lm, name="llm", **kw)
+
+
+def greedy_oracle(lm, prompt, n):
+    import jax.numpy as jnp
+
+    from mxnet_tpu.models import decoder
+    params, cfg = lm.jax_params(), lm.config
+    toks = list(prompt)
+    for _ in range(n):
+        logits = decoder.full_forward(params, cfg,
+                                      jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# SLOPolicy: tiers, weights, SFQ tags
+# ---------------------------------------------------------------------------
+def test_slo_tier_normalization_and_weight_parsing():
+    p = serving.SLOPolicy(
+        tenant_weights="free=1, pro=4, bad, neg=-2, x=oops",
+        default_tier="bulk")
+    assert p.weights == {"free": 1.0, "pro": 4.0}  # junk entries dropped
+    assert p.normalize_tier(None) == "bulk"
+    assert p.normalize_tier("latency") == "latency"
+    with pytest.raises(serving.BadRequestError):
+        p.normalize_tier("turbo")
+    assert p.rank("latency") == 0 and p.rank("bulk") == 1
+    assert p.weight("pro") == 4.0
+    assert p.weight("unknown") == 1.0 and p.weight(None) == 1.0
+    # an unknown default tier falls back to latency, never crashes
+    assert serving.SLOPolicy(default_tier="nope").default_tier == "latency"
+
+
+def test_sfq_degenerates_to_fifo_for_default_traffic():
+    """All-default traffic (no tier, no tenant) must order exactly FIFO
+    — the regression guard that SLO admission changes nothing for
+    existing single-tenant callers."""
+    p = serving.SLOPolicy()
+    tags = [p.stamp(None, None) for _ in range(6)]
+    assert tags == sorted(tags)
+    assert all(rank == 0 for rank, _ in tags)
+    assert len({v for _, v in tags}) == 6  # strictly increasing: stable
+
+
+def test_sfq_weighted_fair_share_under_contention():
+    p = serving.SLOPolicy(tenant_weights={"pro": 4.0, "free": 1.0})
+    reqs = [("pro", p.stamp("latency", "pro")) for _ in range(8)]
+    reqs += [("free", p.stamp("latency", "free")) for _ in range(8)]
+    order = [t for t, _ in sorted(reqs, key=lambda x: x[1])]
+    # weight 4 earns ~4 slots per free slot; free is never starved
+    assert order[:10].count("pro") == 8
+    assert "free" in order[:2]
+
+
+def test_bulk_ranks_behind_latency_regardless_of_arrival():
+    p = serving.SLOPolicy()
+    bulk = p.stamp("bulk", None)
+    lat = p.stamp("latency", None)
+    assert lat < bulk  # rank dominates vstart
+
+
+def test_on_dispatch_advances_virtual_server_time():
+    p = serving.SLOPolicy()
+    tags = [p.stamp(None, "a") for _ in range(3)]
+    p.on_dispatch(tags[-1][1])
+    # a fresh tenant cannot be stamped into the already-served past
+    assert p.stamp(None, "b")[1] >= tags[-1][1]
+    p.on_dispatch(0.0)  # never regresses
+    assert p.stamp(None, "c")[1] >= tags[-1][1]
+
+
+def test_service_rate_cold_then_warm_and_infeasibility():
+    p = serving.SLOPolicy(ema_alpha=0.5)
+    assert p.service_rate() == 0.0
+    p.check_deadline(1000, 0.001)  # cold estimator NEVER sheds
+    t = 100.0
+    for _ in range(5):
+        p.observe_served(1, now=t)
+        t += 0.1
+    assert p.service_rate() == pytest.approx(10.0, rel=0.01)
+    assert p.drain_eta_s(20) == pytest.approx(2.0, rel=0.01)
+    p.check_deadline(20, 10.0)  # comfortably feasible
+    with pytest.raises(serving.DeadlineInfeasibleError) as ei:
+        p.check_deadline(20, 0.5)  # 20 queued drain in ~2s, deadline .5s
+    assert ei.value.http_status == 503
+    assert ei.value.code == "deadline_infeasible"
+    assert ei.value.retry_after == pytest.approx(1.5, rel=0.05)
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: the control loop on fake clocks + fake stats
+# ---------------------------------------------------------------------------
+def _row(queued=0, active=0, slots=4, kv=0.0, role="mixed",
+         routable=True):
+    return {"role": role, "routable": routable, "queued": queued,
+            "active": active, "slots": slots, "kv_frac": kv}
+
+
+class _FakeFleet:
+    """Scriptable replica-stats source + action recorder — drives the
+    Autoscaler with zero processes and zero sleeps."""
+
+    def __init__(self, replicas):
+        self.replicas = dict(replicas)
+        self.actions = []
+        self._next_port = 9100
+
+    def collect(self):
+        return {"replicas": {rid: dict(r)
+                             for rid, r in self.replicas.items()}}
+
+    def scale_up(self, role):
+        rid = "127.0.0.1:%d" % self._next_port
+        self._next_port += 1
+        self.replicas[rid] = _row(role=role)
+        self.actions.append(("up", role))
+        return rid
+
+    def scale_down(self, rid):
+        self.replicas.pop(rid)
+        self.actions.append(("down", rid))
+        return 0
+
+    def flip_role(self, rid, role):
+        self.replicas[rid]["role"] = role
+        self.actions.append(("flip", rid, role))
+        return role
+
+
+def _make_as(fleet, clock, **kw):
+    kw.setdefault("ema_alpha", 1.0)   # no smoothing lag unless the
+    kw.setdefault("cooldown_s", 0.0)  # test is ABOUT smoothing/cooldown
+    kw.setdefault("chip_budget", 4)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("up_queue", 4.0)
+    kw.setdefault("down_queue", 0.5)
+    kw.setdefault("up_kv", 0.85)
+    kw.setdefault("down_kv", 0.3)
+    kw.setdefault("interval_ms", 1000.0)
+    return serving.Autoscaler(
+        clock=clock, collect=fleet.collect, scale_up=fleet.scale_up,
+        scale_down=fleet.scale_down, flip_role=fleet.flip_role, **kw)
+
+
+def test_autoscaler_scales_up_on_queue_band():
+    fl = _FakeFleet({"r0": _row(queued=12, active=4)})
+    a = _make_as(fl, lambda: 0.0)
+    d = a.tick()
+    assert d["action"] == "scale_up"
+    assert fl.actions == [("up", "mixed")]
+    assert d["spawned"] in fl.replicas
+    assert a.counters["scale_up"] == 1
+    assert d["signals"]["queue_per_replica"] == 12.0
+
+
+def test_autoscaler_scales_up_on_kv_band():
+    fl = _FakeFleet({"r0": _row(kv=0.95)})
+    a = _make_as(fl, lambda: 0.0)
+    d = a.tick()
+    assert d["action"] == "scale_up"
+    assert d["reason"].startswith("kv")
+
+
+def test_autoscaler_holds_inside_hysteresis_bands():
+    # queue 2/replica: above the down band, below the up band — and a
+    # down-scale needs BOTH signals low (kv alone keeps it alive)
+    fl = _FakeFleet({"r0": _row(queued=2)})
+    a = _make_as(fl, lambda: 0.0)
+    assert a.tick()["action"] == "hold"
+    fl2 = _FakeFleet({"r0": _row(queued=0, kv=0.6),
+                      "r1": _row(queued=0, kv=0.6)})
+    a2 = _make_as(fl2, lambda: 0.0)
+    assert a2.tick()["action"] == "hold"  # idle queue but busy KV
+    assert a2.counters["holds"] == 1 and fl2.actions == []
+
+
+def test_autoscaler_ema_absorbs_one_burst():
+    """One bursty sample must not trigger an action: the EMA needs the
+    signal to PERSIST across ticks before it crosses the band."""
+    fl2 = _FakeFleet({"r0": _row(queued=0)})
+    a2 = _make_as(fl2, lambda: 0.0, ema_alpha=0.05)
+    a2.tick()
+    fl2.replicas["r0"] = _row(queued=40)
+    assert a2.tick()["action"] == "hold"  # 0.05*40 = 2 < 4: absorbed
+    fl2.replicas["r0"] = _row(queued=40)
+    for _ in range(40):  # but a SUSTAINED ramp does cross the band
+        d = a2.tick()
+        if d["action"] == "scale_up":
+            break
+    assert d["action"] == "scale_up"
+
+
+def test_autoscaler_cooldown_prevents_flap():
+    clk = [0.0]
+    fl = _FakeFleet({"r0": _row(queued=40)})
+    a = _make_as(fl, lambda: clk[0], cooldown_s=5.0)
+    assert a.tick()["action"] == "scale_up"
+    fl.replicas = {"r0": _row(queued=40), "r1": _row(queued=40)}
+    clk[0] = 2.0  # inside the cooldown: wants to act, must hold
+    d = a.tick()
+    assert d["action"] == "hold" and "cooldown" in d["reason"]
+    clk[0] = 6.0  # past the cooldown: acts again
+    assert a.tick()["action"] == "scale_up"
+    assert a.counters["scale_up"] == 2 and a.counters["holds"] == 1
+
+
+def test_autoscaler_respects_chip_budget():
+    fl = _FakeFleet({"r0": _row(queued=40), "r1": _row(queued=40)})
+    a = _make_as(fl, lambda: 0.0, chip_budget=2)
+    d = a.tick()
+    assert d["action"] == "hold" and "chip budget" in d["reason"]
+    assert fl.actions == []
+
+
+def test_autoscaler_booting_replicas_count_toward_chip_budget():
+    """A spawned-but-not-yet-routable replica still occupies a chip:
+    the up band must not keep spawning past the budget while one boots
+    (the diurnal-ramp overshoot bug)."""
+    fl = _FakeFleet({"r0": _row(queued=40),
+                     "b0": _row(routable=False),
+                     "b1": _row(routable=False)})
+    a = _make_as(fl, lambda: 0.0, chip_budget=3)
+    d = a.tick()
+    assert d["action"] == "hold" and "chip budget" in d["reason"]
+    assert d["signals"]["live"] == 1  # load signals still ignore boots
+
+
+def test_autoscaler_scale_down_picks_idlest_and_floors_at_min():
+    clk = [0.0]
+    fl = _FakeFleet({"r0": _row(active=2), "r1": _row(), "r2": _row()})
+    a = _make_as(fl, lambda: clk[0], min_replicas=2)
+    d = a.tick()
+    assert d["action"] == "scale_down"
+    assert d["rid"] in ("r1", "r2")  # never the busy one
+    assert d["migrated"] == 0
+    clk[0] = 10.0
+    d2 = a.tick()  # now AT the floor
+    assert d2["action"] == "hold" and "min_replicas" in d2["reason"]
+    assert len(fl.replicas) == 2
+
+
+def test_autoscaler_drain_keeps_specialized_pools_nonempty():
+    fl = _FakeFleet({"p0": _row(role="prefill"), "m0": _row()})
+    a = _make_as(fl, lambda: 0.0)
+    d = a.tick()
+    # both idle, but the LAST prefill replica is not a drain candidate
+    assert d["action"] == "scale_down" and d["rid"] == "m0"
+
+
+def test_autoscaler_role_flip_rebalances_at_chip_budget():
+    fl = _FakeFleet({
+        "p0": _row(role="prefill"),
+        "p1": _row(role="prefill", active=1),
+        "d0": _row(role="decode", queued=10, active=4)})
+    a = _make_as(fl, lambda: 0.0, chip_budget=3)
+    d = a.tick()
+    assert d["action"] == "role_flip"
+    assert d["rid"] == "p0" and d["role"] == "decode"  # idlest donor
+    assert fl.replicas["p0"]["role"] == "decode"
+    assert a.counters["role_flip"] == 1
+
+
+def test_autoscaler_role_flip_never_empties_a_pool():
+    fl = _FakeFleet({"p0": _row(role="prefill"),
+                     "d0": _row(role="decode", queued=10, active=4)})
+    a = _make_as(fl, lambda: 0.0, chip_budget=2)
+    d = a.tick()
+    assert d["action"] == "hold"  # only donor is the last prefill
+    assert fl.replicas["p0"]["role"] == "prefill"
+
+
+def test_autoscaler_role_flip_needs_saturation():
+    # imbalance ratio alone is not enough: the heavy pool must be
+    # saturated (load >= 1 slot-equivalent) before a flip is worth it
+    # (signals sit mid-band so neither scale direction preempts)
+    fl = _FakeFleet({"p0": _row(role="prefill"),
+                     "p1": _row(role="prefill"),
+                     "d0": _row(role="decode", queued=2, active=1)})
+    a = _make_as(fl, lambda: 0.0, chip_budget=3)
+    d = a.tick()
+    assert d["action"] == "hold" and "hysteresis" in d["reason"]
+
+
+def test_autoscaler_fault_exception_aborts_one_tick_only():
+    fl = _FakeFleet({"r0": _row(queued=40)})
+    a = _make_as(fl, lambda: 0.0)
+    with faults.inject("autoscale.decide", "error", n=1, max_trips=1):
+        d = a.tick()
+    assert d["action"] == "error" and "decide fault" in d["reason"]
+    assert a.counters["errors"] == 1 and fl.actions == []
+    assert a.tick()["action"] == "scale_up"  # next tick recovers
+
+
+def test_autoscaler_fault_drop_inverts_decision_with_guards():
+    # the chaos mis-scaling drill: soft `drop` forces the WRONG
+    # direction — but the safety guards still clamp it
+    fl = _FakeFleet({"r0": _row(queued=40), "r1": _row(queued=40)})
+    a = _make_as(fl, lambda: 0.0)
+    with faults.inject("autoscale.decide", "drop", n=1):
+        d = a.tick()
+    assert d["action"] == "scale_down"
+    assert "fault-inverted" in d["reason"]
+    assert len(fl.replicas) == 1
+    # at min_replicas the inverted drain is refused outright
+    fl2 = _FakeFleet({"r0": _row(queued=40)})
+    a2 = _make_as(fl2, lambda: 0.0)
+    with faults.inject("autoscale.decide", "drop", n=1):
+        d2 = a2.tick()
+    assert d2["action"] == "hold" and "refused" in d2["reason"]
+    assert len(fl2.replicas) == 1
+
+
+def test_autoscaler_collect_and_hook_failures_are_typed_errors():
+    a = _make_as(_FakeFleet({}), lambda: 0.0)
+    a._collect = lambda: (_ for _ in ()).throw(OSError("replica gone"))
+    d = a.tick()
+    assert d["action"] == "error" and "collect failed" in d["reason"]
+    fl = _FakeFleet({"r0": _row(queued=40)})
+    a2 = _make_as(fl, lambda: 0.0)
+    a2._scale_up = lambda role: (_ for _ in ()).throw(
+        RuntimeError("spawn refused"))
+    d2 = a2.tick()
+    assert d2["action"] == "error" and "scale_up failed" in d2["reason"]
+    assert a2.counters["errors"] == 1 and a2.counters["scale_up"] == 0
+
+
+def test_autoscaler_decisions_ring_and_profiler_audit():
+    profiler.reset_stats()
+    clk = [0.0]
+    fl = _FakeFleet({"r0": _row(queued=40)})
+    a = _make_as(fl, lambda: clk[0])
+    a.tick()                              # scale_up
+    fl.replicas = {rid: _row(queued=2) for rid in fl.replicas}
+    clk[0] = 10.0
+    a.tick()                              # hold
+    snap = a.snapshot()
+    assert [d["action"] for d in snap["decisions"]] == ["scale_up",
+                                                        "hold"]
+    assert snap["last_decision"]["action"] == "hold"
+    assert snap["counters"]["ticks"] == 2
+    assert snap["signals"]["live"] == 2
+    assert snap["config"]["chip_budget"] == 4
+    # every decision lands in the profiler fleet table; non-holds are
+    # also first-class fleet events
+    agg = profiler.aggregate_stats()
+    assert agg["fleet"]["autoscale.scale_up"]["count"] == 1
+    assert agg["fleet"]["autoscale.hold"]["count"] == 1
+    assert agg["events"]["fleet.autoscale_scale_up"] == 1
+    assert "fleet.autoscale_hold" not in agg["events"]
+
+
+def test_autoscaler_background_thread_runs_and_stops():
+    fl = _FakeFleet({"r0": _row(queued=2)})
+    a = _make_as(fl, time.monotonic, interval_ms=5.0)
+    a.start()
+    a.start()  # idempotent
+    deadline = time.monotonic() + 5.0
+    while a.counters["ticks"] < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    a.stop()
+    assert a.counters["ticks"] >= 3
+    n = a.counters["ticks"]
+    time.sleep(0.05)
+    assert a.counters["ticks"] == n  # stopped means stopped
+
+
+def test_new_fault_sites_are_registered():
+    assert "autoscale.decide" in faults.KNOWN_SITES
+    assert "replica.spawn" in faults.KNOWN_SITES
+
+
+# ---------------------------------------------------------------------------
+# admission ladder through the real batcher
+# ---------------------------------------------------------------------------
+def _blocked_batcher(max_queue_depth=2):
+    """Registry + batcher whose model fn blocks on a gate, so queued
+    requests stay queued deterministically."""
+    order = []
+    gate = threading.Event()
+
+    def fn(x):
+        gate.wait(10)
+        order.append(float(onp.asarray(x)[0][0]))
+        return x
+
+    reg = serving.ModelRegistry()
+    reg.load("m", fn, item_shape=ITEM, max_batch_size=1, warmup=False)
+    b = serving.DynamicBatcher(reg, flush_ms=1,
+                               max_queue_depth=max_queue_depth)
+    return b, gate, order
+
+
+def _item(v=0.0):
+    return onp.full(ITEM, v, dtype="float32")
+
+
+def _wait_drained(b, model="m", timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while b.queue_depth(model) and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert b.queue_depth(model) == 0
+
+
+def test_batcher_bulk_evicted_to_admit_latency():
+    b, gate, _ = _blocked_batcher(max_queue_depth=2)
+    try:
+        f0 = b.submit("m", _item())        # worker grabs it, blocks
+        _wait_drained(b)
+        fb1 = b.submit("m", _item(1.0), tier="bulk")
+        fb2 = b.submit("m", _item(2.0), tier="bulk")
+        # a BULK arrival at a full queue sheds itself, evicting no one
+        with pytest.raises(serving.QueueFullError):
+            b.submit("m", _item(9.0), tier="bulk")
+        # a LATENCY arrival evicts the newest bulk request instead
+        fl_ = b.submit("m", _item(3.0), tier="latency")
+        with pytest.raises(serving.QueueFullError) as ei:
+            fb2.result(5)
+        assert ei.value.queued is not None  # honest depth in the 503
+        gate.set()
+        for f in (f0, fb1, fl_):
+            f.result(10)
+        ctr = b.metrics.snapshot()["models"]["m"]["counters"]
+        assert ctr["bulk_evicted_total"] == 1
+        assert ctr["shed_total"] == 2  # the self-shed + the eviction
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_batcher_latency_dispatches_before_queued_bulk():
+    b, gate, order = _blocked_batcher(max_queue_depth=16)
+    try:
+        f0 = b.submit("m", _item(0.0))
+        _wait_drained(b)
+        futs = [b.submit("m", _item(1.0), tier="bulk"),
+                b.submit("m", _item(2.0), tier="bulk"),
+                b.submit("m", _item(3.0), tier="latency")]
+        gate.set()
+        f0.result(10)
+        for f in futs:
+            f.result(10)
+        # head-of-line: the latency request jumped both queued bulks
+        assert order[0] == 0.0 and order[1] == 3.0
+        assert sorted(order[2:]) == [1.0, 2.0]
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_batcher_infeasible_deadline_sheds_with_drain_estimate():
+    b, gate, _ = _blocked_batcher(max_queue_depth=64)
+    try:
+        t = 0.0
+        for _ in range(5):  # prime the estimator at 1 req/s (fed clock)
+            b.slo.observe_served(1, now=t)
+            t += 1.0
+        f0 = b.submit("m", _item())
+        _wait_drained(b)
+        futs = [b.submit("m", _item()) for _ in range(10)]
+        with pytest.raises(serving.DeadlineInfeasibleError) as ei:
+            b.submit("m", _item(), deadline_ms=500.0)  # ~10s of queue
+        assert ei.value.retry_after >= 5.0  # honest drain estimate
+        ctr = b.metrics.snapshot()["models"]["m"]["counters"]
+        assert ctr["infeasible_shed_total"] == 1
+        # a generous deadline still admits
+        f_ok = b.submit("m", _item(), deadline_ms=60000.0)
+        gate.set()
+        f0.result(10)
+        f_ok.result(20)
+        for f in futs:
+            f.result(20)
+    finally:
+        gate.set()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission ladder through the real decode engine
+# ---------------------------------------------------------------------------
+def test_engine_set_role_runtime_and_slo_stats(lm):
+    eng = make_engine(lm)
+    try:
+        assert eng.set_role("prefill") == "mixed"
+        st = eng.stats()
+        assert st["role"] == "prefill"
+        assert "service_rate" in st["slo"]
+        assert eng.set_role("mixed") == "prefill"
+        with pytest.raises(serving.BadRequestError):
+            eng.set_role("turbo")
+    finally:
+        eng.stop()
+
+
+def test_engine_bulk_eviction_and_priority_order(lm):
+    eng = make_engine(lm)
+    eng.max_queue_depth = 2
+    eng._ensure_worker_locked = lambda: None  # hold requests in queue
+    with pytest.raises(serving.BadRequestError):
+        eng.submit([1, 2], 2, tier="turbo")
+    fb1 = eng.submit([1, 2], 2, tier="bulk")
+    fb2 = eng.submit([3, 4], 2, tier="bulk")
+    with pytest.raises(serving.QueueFullError):
+        eng.submit([5, 6], 2, tier="bulk")  # bulk cannot evict bulk
+    eng.submit([5, 6], 2, tier="latency")   # evicts the NEWEST bulk
+    with pytest.raises(serving.QueueFullError) as ei:
+        fb2.result(5)
+    assert ei.value.queued == 1
+    assert [r.tier for r in eng._queue] == ["latency", "bulk"]
+    assert not fb1.done()
+    ctr = eng.metrics.snapshot()["models"]["llm"]["counters"]
+    assert ctr["bulk_evicted_total"] == 1
+    eng.stop()
+
+
+def test_engine_infeasible_deadline_sheds_typed(lm):
+    eng = make_engine(lm)
+    eng._ensure_worker_locked = lambda: None
+    t = 0.0
+    for _ in range(5):
+        eng.slo.observe_served(1, now=t)
+        t += 1.0  # 1 generation/s
+    for _ in range(5):
+        eng.submit([1, 2], 2)
+    with pytest.raises(serving.DeadlineInfeasibleError) as ei:
+        eng.submit([1, 2], 2, deadline_ms=1000.0)  # 5 ahead at 1/s
+    assert ei.value.retry_after >= 3.0
+    ctr = eng.metrics.snapshot()["models"]["llm"]["counters"]
+    assert ctr["infeasible_shed_total"] == 1
+    eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# router: honest Retry-After + tier-aware dispatch + runtime re-pooling
+# ---------------------------------------------------------------------------
+def _shed_server(queued):
+    """A replica that always sheds, reporting its queue depth."""
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            if n:
+                self.rfile.read(n)
+            body = json.dumps({"error": "full", "code": "queue_full",
+                               "queued": queued}).encode()
+            self.send_response(503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _shed_addrs(servers):
+    return ["127.0.0.1:%d" % s.server_address[1] for s in servers]
+
+
+def test_router_retry_after_grows_with_shed_queue_depth():
+    """The satellite regression: Retry-After is computed from the
+    aggregate shed queue depth / observed service rate — a deeper
+    backlog tells clients to back off LONGER (the old code said 'try
+    again in probe_s*2' no matter what)."""
+
+    def run(queued, rate):
+        servers = [_shed_server(queued) for _ in range(2)]
+        router = serving.Router(_shed_addrs(servers), probe_ms=0)
+        router.metrics._rate = rate
+        try:
+            with pytest.raises(serving.QueueFullError) as ei:
+                router.dispatch("/v1/models/m:predict",
+                                {"instances": [[0.0] * 4]})
+            return ei.value
+        finally:
+            router.stop()
+            for s in servers:
+                s.shutdown()
+                s.server_close()
+
+    shallow = run(5, rate=10.0)    # 2 replicas shed: 10 queued total
+    deep = run(200, rate=10.0)     # 400 queued total
+    assert shallow.queued == 10 and deep.queued == 400
+    assert shallow.retry_after == pytest.approx(1.0, rel=0.01)
+    assert deep.retry_after == pytest.approx(40.0, rel=0.01)
+    assert deep.retry_after > shallow.retry_after
+    # cold rate estimator: falls back to the bounded probe heuristic
+    cold = run(200, rate=0.0)
+    assert 0.1 <= cold.retry_after <= 1.0
+    # the estimate is clamped to a sane ceiling
+    assert run(100000, rate=0.1).retry_after == 60.0
+
+
+def test_router_bulk_tier_skips_the_shed_retry():
+    shed = _shed_server(7)
+    reg = serving.ModelRegistry()
+    reg.load("m", demo_affine(scale=2.0), item_shape=ITEM,
+             max_batch_size=4, warmup=False)
+    good = serving.ModelServer(reg, flush_ms=2)
+    good.start()
+    body = {"instances": [[0.0] * 4]}
+    try:
+        # latency (default) tier: the shed retries onto the healthy
+        # replica and succeeds
+        r1 = serving.Router(_shed_addrs([shed])
+                            + ["127.0.0.1:%d" % good.port], probe_ms=0)
+        hits = 0
+        for _ in range(8):
+            try:
+                status, _ = r1.dispatch("/v1/models/m:predict", body)
+                assert status == 200
+                hits += 1
+            except serving.QueueFullError:
+                pass  # picked the healthy replica twice: no shed seen
+        assert hits == 8  # every dispatch that shed got its retry
+        r1.stop()
+        # bulk tier: first shed propagates — the retry capacity belongs
+        # to the latency tier
+        r2 = serving.Router(_shed_addrs([shed]), probe_ms=0)
+        with pytest.raises(serving.QueueFullError) as ei:
+            r2.dispatch("/v1/models/m:predict", body, tier="bulk")
+        assert ei.value.queued == 7
+        assert r2.metrics.counters["retries_total"] == 0
+        r2.stop()
+    finally:
+        good.stop()
+        shed.shutdown()
+        shed.server_close()
+
+
+def test_router_set_role_repools_and_admin_endpoint(lm):
+    eng = make_engine(lm)
+    srv = serving.ModelServer(serving.ModelRegistry(), admin=True)
+    srv.start()
+    srv.attach_engine("llm", eng)
+    rid = "127.0.0.1:%d" % srv.port
+    router = serving.Router([rid], probe_ms=0)
+    rs = serving.RouterServer(router)
+    rs.start()
+    try:
+        status, doc = rs._handle_post(
+            "/v1/admin/set_role",
+            json.dumps({"replica": rid, "role": "decode"}).encode())
+        assert status == 200 and doc["ok"]
+        assert doc["previous"] == "mixed"
+        assert doc["engines"] == {"llm": "mixed"}
+        assert router.states()[rid]["role"] == "decode"
+        assert eng.role == "decode"  # engine and router moved together
+        with pytest.raises(serving.ServingError):
+            rs._handle_post("/v1/admin/set_role",
+                            json.dumps({"role": "prefill"}).encode())
+        with pytest.raises(serving.ModelNotFoundError):
+            rs._handle_post(
+                "/v1/admin/set_role",
+                json.dumps({"replica": "1.2.3.4:1",
+                            "role": "prefill"}).encode())
+    finally:
+        rs.stop()
+        srv.stop()
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# monotonic clocks: an NTP step must not eject anyone
+# ---------------------------------------------------------------------------
+def test_wall_clock_step_does_not_eject_replicas(monkeypatch):
+    """Jump time.time() an hour forward mid-traffic: every fleet timer
+    (probe cadence, strike backoff, eject/readmit) runs on monotonic
+    clocks, so nothing is ejected and traffic keeps flowing."""
+    reg = serving.ModelRegistry()
+    reg.load("m", demo_affine(scale=2.0), item_shape=ITEM,
+             max_batch_size=4, warmup=False)
+    servers = []
+    for _ in range(2):
+        s = serving.ModelServer(reg, flush_ms=2)
+        s.start()
+        servers.append(s)
+    router = serving.Router(["127.0.0.1:%d" % s.port for s in servers],
+                            probe_ms=30)
+    body = {"instances": [[1.0] * 4]}
+    try:
+        status, _ = router.dispatch("/v1/models/m:predict", body)
+        assert status == 200
+        real_time = time.time
+        monkeypatch.setattr(time, "time",
+                            lambda: real_time() + 3600.0)
+        time.sleep(0.15)  # several probe cycles under the skewed clock
+        for _ in range(6):
+            status, _ = router.dispatch("/v1/models/m:predict", body)
+            assert status == 200
+        for rid, st in router.states().items():
+            assert st["state"] == "healthy" and st["ready"], (rid, st)
+            assert st["strikes"] == 0
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_fleet_timers_never_read_wall_clock():
+    """Source audit: the fleet's timing logic (probes, strikes, backoff,
+    batching deadlines, autoscale cooldowns) must be wall-clock-free —
+    time.time() is only legal as a human-facing label elsewhere."""
+    src_dir = os.path.join(REPO, "mxnet_tpu", "serving")
+    for mod in ("router.py", "supervisor.py", "batcher.py",
+                "autoscale.py", "generate.py", "fleet.py", "server.py"):
+        with open(os.path.join(src_dir, mod)) as f:
+            assert "time.time(" not in f.read(), (
+                "%s uses wall-clock time in fleet logic" % mod)
+
+
+# ---------------------------------------------------------------------------
+# observability: supervisor crash-loop state + autoscale at the router
+# ---------------------------------------------------------------------------
+def test_supervisor_states_expose_crash_loop_internals():
+    from mxnet_tpu.serving.supervisor import ReplicaSupervisor
+    sup = ReplicaSupervisor({"models": []}, replicas=2,
+                            restart_budget=5, restart_window_s=60.0)
+    st = sup.states()
+    assert set(st) == {"r0", "r1"}
+    for d in st.values():
+        assert d["restart_budget"] == 5
+        assert d["restart_budget_remaining"] == 5
+        assert d["restarts_in_window"] == 0
+        assert d["backoff_stage"] == 0
+        assert d["next_restart_in_s"] == 0.0
+    # simulate a crash-looping replica
+    r = sup.replicas[0]
+    now = time.monotonic()
+    r.restart_times.extend([now - 100.0, now - 5.0, now - 1.0])
+    r.consecutive_crashes = 2
+    r.next_restart = now + 0.8
+    d = sup.states()["r0"]
+    assert d["restarts_in_window"] == 2  # the -100s one aged out
+    assert d["restart_budget_remaining"] == 3
+    assert d["backoff_stage"] == 2
+    assert 0.0 < d["next_restart_in_s"] <= 0.8
+
+
+def test_router_stats_and_prometheus_carry_fleet_control_state():
+    from mxnet_tpu.serving.supervisor import ReplicaSupervisor
+    reg = serving.ModelRegistry()
+    reg.load("m", demo_affine(scale=2.0), item_shape=ITEM,
+             max_batch_size=4, warmup=False)
+    srv = serving.ModelServer(reg, flush_ms=2)
+    srv.start()
+    router = serving.Router(["127.0.0.1:%d" % srv.port], probe_ms=0)
+    sup = ReplicaSupervisor({"models": []}, replicas=1)
+    fl = _FakeFleet({"r0": _row(queued=40)})
+    scaler = _make_as(fl, lambda: 0.0)
+    scaler.tick()
+    rs = serving.RouterServer(router, supervisor=sup, autoscaler=scaler)
+    try:
+        status, snap = rs._handle_get("/v1/stats")
+        assert status == 200
+        assert snap["supervisor"]["r0"]["restart_budget_remaining"] >= 0
+        assert snap["autoscale"]["counters"]["scale_up"] == 1
+        assert snap["autoscale"]["last_decision"]["action"] == "scale_up"
+        text = rs._prometheus_text()
+        assert "mxtpu_fleet_service_rate" in text
+        assert "mxtpu_fleet_replica_restart_budget_remaining" in text
+        assert "mxtpu_fleet_replica_failed" in text
+        assert "mxtpu_fleet_autoscale_scale_up_total 1" in text
+        # `live` is the signal the decision SAW (pre-spawn): 1 replica
+        assert "mxtpu_fleet_autoscale_replicas_live 1" in text
+        assert "mxtpu_fleet_autoscale_chip_budget 4" in text
+    finally:
+        router.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ServingFleet autoscaler hooks (in-process replicas; no subprocesses)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def store():
+    s = PageStoreServer()
+    s.start()
+    yield s
+    s.stop()
+
+
+def _fleet_shell(replica_servers, replicas=2):
+    """A ServingFleet wired to IN-PROCESS replicas: supervisor built but
+    never started, router pointed at live ModelServers — enough to
+    exercise the autoscale hooks without subprocess spawns."""
+    fleet = serving.ServingFleet({"models": []}, replicas=replicas)
+    fleet.router = serving.Router(
+        ["127.0.0.1:%d" % s.port for s in replica_servers], probe_ms=0)
+    return fleet
+
+
+def test_fleet_collect_aggregates_replica_signals(lm):
+    eng = make_engine(lm)
+    srv = serving.ModelServer(serving.ModelRegistry(), admin=True)
+    srv.start()
+    srv.attach_engine("llm", eng)
+    fleet = _fleet_shell([srv])
+    try:
+        eng.submit([1, 2, 3], 3).result(30)
+        stats = fleet._autoscale_collect()
+        rid = "127.0.0.1:%d" % srv.port
+        row = stats["replicas"][rid]
+        assert row["routable"] and row["role"] == "mixed"
+        assert row["slots"] == 4 and row["queued"] == 0
+        assert 0.0 <= row["kv_frac"] <= 1.0
+        # a drained replica reports unroutable and is not polled
+        fleet.router.set_drain(rid, True)
+        row2 = fleet._autoscale_collect()["replicas"][rid]
+        assert not row2["routable"] and row2["slots"] == 0
+    finally:
+        fleet.router.stop()
+        srv.stop()
+        eng.stop()
+
+
+def test_fleet_scale_up_hook_registers_unroutable_replica():
+    srv = serving.ModelServer(serving.ModelRegistry(), admin=True)
+    srv.start()
+    fleet = _fleet_shell([srv], replicas=1)
+    try:
+        n0 = len(fleet.supervisor.replicas)
+        addr = fleet._autoscale_up("decode")
+        st = fleet.router.states()[addr]
+        assert st["role"] == "decode"
+        assert not st["ready"]  # unroutable until /readyz says so
+        assert len(fleet.supervisor.replicas) == n0 + 1
+        new = fleet.supervisor.replicas[-1]
+        assert fleet.supervisor.env_by_rid[new.rid] == {
+            "MXNET_GEN_ROLE": "decode"}
+        with faults.inject("replica.spawn", "error", n=1):
+            with pytest.raises(Exception):
+                fleet._autoscale_up("mixed")
+    finally:
+        fleet.router.stop()
+        srv.stop()
+
+
+def test_fleet_scale_down_drains_by_migration_not_reset(lm, store):
+    """The drain path of a scale-down: every parked session rides the
+    page store to a survivor, bit-identically — never reset."""
+    engines, servers = [], []
+    for _ in range(2):
+        e = make_engine(lm, pagestore=store.address)
+        s = serving.ModelServer(serving.ModelRegistry(), admin=True)
+        s.start()
+        s.attach_engine("llm", e)
+        engines.append(e)
+        servers.append(s)
+    fleet = _fleet_shell(servers)
+    rid0 = "127.0.0.1:%d" % servers[0].port
+    prompt = [5, 4, 3, 2, 1]
+    try:
+        r1 = engines[0].submit(prompt, 4, session="ride").result(30)
+        migrated = fleet._autoscale_down(rid0)
+        assert migrated == 1
+        assert rid0 not in fleet.router.replica_ids()
+        hist = prompt + r1["tokens"]
+        r2 = engines[1].submit([8], 4, session="ride",
+                               resume=True).result(30)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [8], 4)
+    finally:
+        fleet.router.stop()
+        for s in servers:
+            s.stop()
+        for e in engines:
+            e.stop()
+
+
+def test_fleet_flip_role_moves_engine_router_and_restart_env(lm):
+    eng = make_engine(lm)
+    srv = serving.ModelServer(serving.ModelRegistry(), admin=True)
+    srv.start()
+    srv.attach_engine("llm", eng)
+    fleet = _fleet_shell([srv], replicas=1)
+    rid = "127.0.0.1:%d" % srv.port
+    # align the (unstarted) supervisor's slot with the live replica so
+    # the hook's restart-env stamping is observable
+    fleet.supervisor.replicas[0].port = srv.port
+    try:
+        fleet._autoscale_flip(rid, "prefill")
+        assert eng.role == "prefill"
+        assert fleet.router.states()[rid]["role"] == "prefill"
+        srid = fleet.supervisor.replicas[0].rid
+        assert fleet.supervisor.env_by_rid[srid] == {
+            "MXNET_GEN_ROLE": "prefill"}
+        fleet._autoscale_flip(rid, "mixed")  # flipping back clears it
+        assert fleet.supervisor.env_by_rid[srid] == {}
+    finally:
+        fleet.router.stop()
+        srv.stop()
+        eng.stop()
+
+
+def test_serving_fleet_accepts_autoscale_config():
+    fleet = serving.ServingFleet({"models": []}, replicas=1,
+                                 autoscale={"chip_budget": 2,
+                                            "interval_ms": 50.0})
+    assert fleet.autoscaler is None  # built at start(), stopped at stop
+    assert fleet._autoscale_cfg == {"chip_budget": 2,
+                                    "interval_ms": 50.0}
+    assert fleet.status()["autoscale"] is None
+
+
+# ---------------------------------------------------------------------------
+# composed: rollout x session migration x async engine, one pass
+# ---------------------------------------------------------------------------
+def test_rollout_migration_async_composed(lm, store, monkeypatch):
+    """Satellite 4: one pass through rollout WITH parked sessions WITH
+    the async decode engine forced on — the three features compose, the
+    session survives the rollout bit-identically, zero resets."""
+    monkeypatch.setenv("MXNET_GEN_ASYNC", "1")
+    engines, servers = [], []
+    for _ in range(2):
+        e = make_engine(lm, pagestore=store.address, async_decode=True)
+        s = serving.ModelServer(serving.ModelRegistry(), admin=True)
+        s.start()
+        s.attach_engine("llm", e)
+        engines.append(e)
+        servers.append(s)
+    router = serving.Router(["127.0.0.1:%d" % s.port for s in servers],
+                            probe_ms=0)
+    rs = serving.RouterServer(router)
+    rs.start()
+    prompt = [7, 6, 5, 4, 3, 2]
+    try:
+        assert engines[0].stats()["async"]["enabled"]
+        cli = serving.ServingClient(*rs.address, timeout=60)
+        r1 = cli.generate("llm", prompt, max_tokens=4, session="ride")
+        from mxnet_tpu.serving.fleet import rollout
+        report = rollout(router, {
+            "name": "llm",
+            "builder": "mxnet_tpu.models.decoder:decoder_tiny_lm",
+            "kwargs": {"seed": 0, "vocab_size": 128},
+            "generate": {"slots": 4, "page_size": 8, "prefill_chunk": 8,
+                         "max_ctx": 64, "pagestore": store.address}})
+        assert not report["aborted"]
+        # the parked session MIGRATED through the rollout (each drained
+        # replica pushed its sessions before the engine swap)
+        assert sum(r["migrated_sessions"]
+                   for r in report["replicas"]) >= 1
+        # the swapped-in engines still run async pipelining
+        for s in servers:
+            eng = s.batcher._engines["llm"]
+            assert eng.stats()["async"]["enabled"]
+        # resume the pre-rollout session: bit-identical continuation,
+        # no SessionResetError anywhere
+        hist = list(prompt) + list(r1["tokens"])
+        r2 = cli.generate("llm", [9], max_tokens=4, session="ride",
+                          resume=True)
+        assert r2["tokens"] == greedy_oracle(lm, hist + [9], 4)
+        cli.close()
+    finally:
+        rs.stop()
+        for s in servers:
+            s.stop()
+        for e in engines:
+            e.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: the 10x diurnal ramp (slow lane)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_ramp_10x_diurnal():
+    """The ISSUE acceptance: a 10x two-tier, three-tenant traffic ramp
+    against an autoscaling fleet — latency-tier p99 bounded, bulk shed
+    first, zero session resets, replica count tracks load under the
+    chip budget, every decision auditable."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--scenario", "ramp"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    sys.stdout.write(out.stdout[-4000:])
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "chaos: PASS" in out.stdout

@@ -46,6 +46,16 @@ Scenarios (--scenario):
            recover through the page store: pages when pushed, replayed
            transcripts otherwise), the supervisor restores the fleet,
            fresh sessions work, and router-level failures are zero.
+  ramp     fleet autoscaling + SLO admission: a 10x diurnal traffic
+           ramp (two tiers, three tenants) against one replica under a
+           chip budget of 3.  PASS when the autoscaler scales out on
+           the ramp and back in after the drop (never exceeding the
+           budget), drains migrate every parked session (ZERO resets —
+           dawn's sessions resume after the full cycle), bulk is shed
+           at least as often as latency with honest Retry-After on
+           every shed, latency-tier p99 during the scaled-up hold
+           stays <= 5x steady-state, and /v1/stats carries the full
+           auditable decision ring.
 
 Usage:
   python tools/chaos.py                       # default spec, 2 workers
@@ -891,6 +901,298 @@ def scenario_llm(args):
     return 0 if ok else 1
 
 
+def scenario_ramp(args):
+    """10x diurnal traffic ramp against an autoscaling fleet: two tiers
+    (latency | bulk), three tenants (pro=4, free=1, batch), one replica
+    at dawn, a chip budget of 3.
+
+    PASS conditions (the fleet-autoscaling + SLO-admission bar):
+    (1) the autoscaler spawns replicas as the ramp crosses the up band
+        (>= 1 scale_up, peak live replicas > 1) and NEVER exceeds the
+        chip budget; after the drop it drains back down (>= 1
+        scale_down, final live < peak) — and a drain MIGRATES parked
+        sessions, so (2) ZERO SessionResetErrors anywhere: every
+        session parked at dawn resumes after the full ramp/drop cycle;
+    (3) the degradation ladder holds: bulk requests are shed at least
+        as often as latency requests, every shed is TYPED (503
+        queue_full / deadline_infeasible) and carries a Retry-After;
+    (4) latency-tier p99 during the scaled-up hold stays <= 5x the
+        steady-state p99;
+    (5) every decision is auditable after the fact: /v1/stats carries
+        the autoscale counters + decision ring."""
+    import tempfile
+    import threading
+
+    import numpy as onp
+
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_GEN_ASYNC"] = "1"
+    os.environ["MXNET_SLO_TENANT_WEIGHTS"] = "free=1,pro=4"
+    # the replica cold-start cut: a scaled-up replica re-serves from
+    # the persistent compile cache instead of cold XLA compiles
+    cache_dir = tempfile.mkdtemp(prefix="chaos-ramp-cache-")
+    os.environ["MXNET_COMPILE_CACHE_DIR"] = cache_dir
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.errors import (DeadlineInfeasibleError,
+                                          QueueFullError,
+                                          SessionResetError)
+
+    budget = 3
+    spec = {"models": [{
+        "name": "llm",
+        "builder": "mxnet_tpu.models.decoder:decoder_tiny_lm",
+        "kwargs": {"seed": 0},
+        # a small engine queue so the 10x peak actually exercises the
+        # shed ladder while the fleet is still scaling up
+        "generate": {"slots": 4, "page_size": 8, "prefill_chunk": 8,
+                     "max_ctx": 64, "total_pages": 513,
+                     "max_queue_depth": 8}}]}
+    fleet = serving.ServingFleet(
+        spec, replicas=1, policy="hash",
+        router_kwargs={"probe_ms": 50},
+        supervisor_kwargs={"restart_backoff_ms": 100,
+                           "startup_timeout_s": 300},
+        autoscale={"chip_budget": budget, "min_replicas": 1,
+                   "up_queue": 1.5, "down_queue": 0.25,
+                   "up_kv": 0.85, "down_kv": 0.5,
+                   "cooldown_s": 2.0, "interval_ms": 250.0,
+                   "ema_alpha": 0.5})
+    print("chaos-ramp: starting 1 replica under a chip budget of %d "
+          "(compiling decode programs, cache=%s)" % (budget, cache_dir))
+    fleet.start()
+    ok = True
+    stop = threading.Event()
+    peak_on = threading.Event()  # gates the 9 extra ramp clients
+    phase = {"name": "warmup"}
+    lock = threading.Lock()
+    counters = {"ok": 0, "reset": 0, "shed_latency": 0, "shed_bulk": 0,
+                "infeasible": 0, "shed_untagged": 0, "other": 0}
+    samples = {"steady": [], "hold": []}
+
+    def bump(key):
+        with lock:
+            counters[key] += 1
+
+    def load_client(cid, tier, tenant, ramp_only):
+        cli = serving.ServingClient(*fleet.address, timeout=120,
+                                    retries=0)
+        i = 0
+        epoch = [0, 0, 0]  # rotating session slots (llm-drill idiom)
+        while not stop.is_set():
+            if ramp_only and not peak_on.is_set():
+                peak_on.wait(0.2)
+                continue
+            i += 1
+            sid = None
+            if tier == "latency" and i % 5 == 0:
+                slot = (i // 5) % 3
+                sid = "s%d-%d-e%d" % (cid, slot, epoch[slot])
+            t0 = time.monotonic()
+            try:
+                cli.generate("llm", [cid % 96 + 1, 2, 3], max_tokens=4,
+                             tier=tier, tenant=tenant, session=sid,
+                             resume=False,
+                             deadline_ms=60000 if tier == "bulk"
+                             else None)
+                dt = time.monotonic() - t0
+                bump("ok")
+                if tier == "latency":
+                    with lock:
+                        ph = phase["name"]
+                        if ph in samples:
+                            samples[ph].append(dt)
+            except serving.BadRequestError as e:
+                if sid is not None and "max_ctx" in str(e):
+                    epoch[(i // 5) % 3] += 1  # conversation full: rotate
+                else:
+                    bump("other")
+                    print("chaos-ramp: UNTYPED failure: %r" % (e,))
+            except QueueFullError as e:
+                bump("shed_%s" % tier)
+                ra = getattr(e, "retry_after", None)
+                if ra is None:
+                    bump("shed_untagged")
+                stop.wait(min(float(ra or 0.2), 2.0))  # honor it
+            except DeadlineInfeasibleError as e:
+                bump("infeasible")
+                stop.wait(min(float(
+                    getattr(e, "retry_after", None) or 0.2), 2.0))
+            except SessionResetError:
+                bump("reset")
+                print("chaos-ramp: session RESET (must be zero)")
+            except Exception as e:
+                bump("other")
+                print("chaos-ramp: UNTYPED failure: %r" % (e,))
+        cli.close()
+
+    # dawn traffic (~1x): 1 latency client + 1 bulk client.  Peak
+    # (~10x): +9 latency (pro/free mix) and +3 bulk (batch tenant).
+    plan = [(0, "latency", "pro", False), (1, "bulk", "batch", False)]
+    plan += [(10 + i, "latency", "pro" if i % 2 else "free", True)
+             for i in range(9)]
+    plan += [(30 + i, "bulk", "batch", True) for i in range(3)]
+    threads = [threading.Thread(target=load_client, args=p, daemon=True)
+               for p in plan]
+
+    live_seen = {"max": 0}
+
+    def monitor():
+        while not stop.is_set():
+            snap = fleet.autoscaler.snapshot()
+            live = (snap["signals"]["live"] or 0)
+            if live > live_seen["max"]:
+                live_seen["max"] = live
+            stop.wait(0.25)
+
+    mon = threading.Thread(target=monitor, daemon=True)
+
+    def _router_stats():
+        import http.client as _http
+        c = _http.HTTPConnection(*fleet.address, timeout=10)
+        c.request("GET", "/v1/stats")
+        doc = json.loads(c.getresponse().read())
+        c.close()
+        return doc
+
+    try:
+        # park sessions at dawn: they must survive the whole cycle
+        warm_cli = serving.ServingClient(*fleet.address, timeout=120)
+        warm = ["warm-%d" % i for i in range(6)]
+        for sid in warm:
+            warm_cli.generate("llm", [1, 2, 3], max_tokens=3,
+                              session=sid)
+        for t in threads:
+            t.start()
+        mon.start()
+        time.sleep(3.0)  # warmup: everything compiled and flowing
+        with lock:
+            phase["name"] = "steady"
+        steady_s = 8.0
+        time.sleep(steady_s)
+        with lock:
+            phase["name"] = "ramp"
+        print("chaos-ramp: steady done (%d latency samples); ramping "
+              "traffic 10x" % len(samples["steady"]))
+        peak_on.set()
+        # the fleet must scale OUT under the ramp; wait for it, then
+        # measure the scaled-up hold window
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if fleet.autoscaler.counters["scale_up"] >= 1 \
+                    and fleet.autoscaler.snapshot()["signals"]["live"] > 1:
+                break
+            time.sleep(0.25)
+        with lock:
+            phase["name"] = "hold"
+        hold_s = 12.0
+        time.sleep(hold_s)
+        snap = fleet.autoscaler.snapshot()
+        print("chaos-ramp: hold done at live=%s (%d hold samples); "
+              "dropping traffic" % (snap["signals"]["live"],
+                                    len(samples["hold"])))
+        with lock:
+            phase["name"] = "drop"
+        peak_on.clear()  # ramp clients idle again; dawn traffic stays
+        stop_extra = time.monotonic() + 90
+        while time.monotonic() < stop_extra:
+            if fleet.autoscaler.counters["scale_down"] >= 1:
+                break
+            time.sleep(0.25)
+        time.sleep(1.0)
+        stop.set()
+        peak_on.set()  # release ramp clients parked on the gate
+        for t in threads:
+            t.join(120)
+        mon.join(5)
+
+        # dawn's parked sessions resume after the full cycle — the
+        # drains MIGRATED them, nothing was reset
+        resumed, resets = 0, 0
+        for sid in warm:
+            try:
+                warm_cli.generate("llm", [7], max_tokens=3, session=sid,
+                                  resume=True)
+                resumed += 1
+            except SessionResetError:
+                resets += 1
+                print("chaos-ramp: warm session %s RESET" % sid)
+            except Exception as e:
+                print("chaos-ramp: warm resume failed: %r" % (e,))
+        warm_cli.close()
+
+        doc = _router_stats()
+        audit = doc.get("autoscale") or {}
+        acts = audit.get("counters") or {}
+        final_live = (audit.get("signals") or {}).get("live") or 0
+        p99s = (onp.percentile(samples["steady"], 99)
+                if samples["steady"] else 0.0)
+        p99h = (onp.percentile(samples["hold"], 99)
+                if samples["hold"] else 0.0)
+        print("chaos-ramp: load %s; autoscale %s; live peak=%d "
+              "final=%d; latency p99 steady=%.3fs hold=%.3fs"
+              % (counters, acts, live_seen["max"], final_live,
+                 p99s, p99h))
+        for d in (audit.get("decisions") or [])[-8:]:
+            print("chaos-ramp: decision %s" % d)
+
+        if acts.get("scale_up", 0) < 1 or live_seen["max"] < 2:
+            print("FAIL: the ramp never scaled out (scale_up=%s, "
+                  "peak live=%d)" % (acts.get("scale_up"),
+                                     live_seen["max"]))
+            ok = False
+        if live_seen["max"] > budget:
+            print("FAIL: %d live replicas exceeded the chip budget %d"
+                  % (live_seen["max"], budget))
+            ok = False
+        if acts.get("scale_down", 0) < 1 or final_live >= live_seen["max"]:
+            print("FAIL: the drop never scaled in (scale_down=%s, "
+                  "final live=%d, peak=%d)"
+                  % (acts.get("scale_down"), final_live,
+                     live_seen["max"]))
+            ok = False
+        if counters["reset"] or resets:
+            print("FAIL: %d session reset(s) — drains must migrate, "
+                  "never reset" % (counters["reset"] + resets))
+            ok = False
+        if resumed < len(warm):
+            print("FAIL: only %d/%d dawn sessions resumed after the "
+                  "cycle" % (resumed, len(warm)))
+            ok = False
+        if counters["shed_latency"] > counters["shed_bulk"]:
+            print("FAIL: latency tier shed more than bulk (%d > %d) — "
+                  "the ladder sheds bulk first"
+                  % (counters["shed_latency"], counters["shed_bulk"]))
+            ok = False
+        if counters["shed_untagged"]:
+            print("FAIL: %d shed(s) carried no Retry-After"
+                  % counters["shed_untagged"])
+            ok = False
+        if counters["other"]:
+            print("FAIL: %d untyped failure(s)" % counters["other"])
+            ok = False
+        if not (audit.get("decisions") or []):
+            print("FAIL: no auditable decisions at /v1/stats")
+            ok = False
+        if samples["steady"] and samples["hold"] \
+                and p99h > 5.0 * max(p99s, 0.5):
+            # the 0.5s floor absorbs scheduler noise when the steady
+            # p99 is a few milliseconds on an idle CPU host
+            print("FAIL: hold p99 %.3fs > 5x steady p99 %.3fs"
+                  % (p99h, p99s))
+            ok = False
+        if not counters["ok"]:
+            print("FAIL: load generator completed no requests")
+            ok = False
+    finally:
+        stop.set()
+        peak_on.set()
+        fleet.stop()
+    print("chaos: %s" % ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -898,7 +1200,8 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--scenario", default="faults",
-                    choices=["faults", "preempt", "mesh", "fleet", "llm"],
+                    choices=["faults", "preempt", "mesh", "fleet", "llm",
+                             "ramp"],
                     help="faults = transport chaos (bit-identical check);"
                          " preempt = SIGTERM + relaunch + rejoin drill;"
                          " mesh = SIGKILL a worker holding irreplaceable"
@@ -908,7 +1211,10 @@ def main():
                          " + rolling rollout (-n = replica count);"
                          " llm = SIGKILL a replica under sustained"
                          " continuous-batching decode traffic (typed"
-                         " session resets, lossless sessionless traffic)")
+                         " session resets, lossless sessionless traffic);"
+                         " ramp = 10x diurnal traffic ramp against the"
+                         " autoscaler (scale out/in under a chip budget,"
+                         " bulk shed first, zero session resets)")
     ap.add_argument("--spec", default=DEFAULT_SPEC,
                     help="MXNET_FAULT_SPEC for the chaos run "
                          "(default: %(default)s)")
@@ -923,6 +1229,8 @@ def main():
         return scenario_fleet(args)
     if args.scenario == "llm":
         return scenario_llm(args)
+    if args.scenario == "ramp":
+        return scenario_ramp(args)
 
     ok = True
     with tempfile.TemporaryDirectory(prefix="chaos-") as tmp:
