@@ -385,11 +385,49 @@ register("MXNET_GEN_MIGRATE", int, 1, "honored",
          "0 = sessions die with their replica (pre-PR-11 behavior)",
          "serving.DecodeEngine")
 register("MXNET_GEN_PAGESTORE", str, "", "honored",
-         "host:port of the fleet page store (kvstore-framed transport "
-         "for KV session blobs); empty = no store, migration disabled. "
-         "ServingFleet starts one in-process and stamps this into "
-         "every replica",
+         "address(es) of the fleet page store (kvstore-framed transport "
+         "for KV session blobs): one host:port, or a comma-joined list "
+         "(primary first) when the store is replicated — clients fail "
+         "over down the list on transport loss or a not_primary "
+         "refusal. Empty = no store, migration disabled. ServingFleet "
+         "stamps this into every replica",
          "serving.DecodeEngine")
+register("MXNET_PAGESTORE_DIR", str, "", "honored",
+         "durability directory for the page store: every accepted "
+         "put/take/delete is CRC-framed into an append-only WAL here "
+         "and periodically compacted into atomic snapshots; restart "
+         "replays WAL over the newest verifying snapshot, recovering "
+         "records AND per-key generation fences. Empty = in-memory "
+         "only (a store crash loses parked sessions)",
+         "kvstore.PageStoreServer")
+register("MXNET_PAGESTORE_REPLICAS", int, 0, "honored",
+         "N>0 = ServingFleet boots N supervised PageStore processes "
+         "with synchronous primary->follower replication, epoch-fenced "
+         "failover, and restart healing; 0 = single in-process store "
+         "(pre-PR-20 behavior)",
+         "serving.ServingFleet")
+register("MXNET_PAGESTORE_BYTES", int, 0, "honored",
+         "page-store memory budget in bytes (encoded record size); "
+         "past it the LRU record is evicted (counted, gen fence kept) "
+         "and a single put larger than the whole budget is rejected "
+         "typed ('over_budget' — the engine keeps the session local). "
+         "0 = unlimited",
+         "kvstore.PageStoreServer")
+register("MXNET_PAGESTORE_TTL", float, 0.0, "honored",
+         "seconds a parked record may sit unclaimed before TTL "
+         "eviction (orphaned sessions from clients that never resume); "
+         "eviction keeps the generation fence. 0 = never",
+         "kvstore.PageStoreServer")
+register("MXNET_PAGESTORE_SNAPSHOT_OPS", int, 256, "honored",
+         "WAL compaction cadence: after this many logged mutations the "
+         "store writes an atomic full-state snapshot and rolls the WAL "
+         "(two generations are always kept recoverable)",
+         "kvstore.PageStoreServer")
+register("MXNET_PAGESTORE_FSYNC", int, 1, "honored",
+         "1 = fsync the WAL after every appended record (full "
+         "crash-safety); 0 = flush only (cheaper; an OS crash may lose "
+         "the tail, a process crash does not)",
+         "kvstore.PageStoreServer")
 register("MXNET_GEN_ROLE", str, "mixed", "honored",
          "replica specialization: 'prefill' (chunk long prompts, hand "
          "finished KV pages to a decode replica via the page store), "

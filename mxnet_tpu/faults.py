@@ -62,6 +62,18 @@ Sites (grep for ``faults.check``):
                      (exception kinds degrade the whole step to plain
                      decode and poison the planned sequences' controllers
                      — no tokens are lost, no resets)
+  pagestore.wal      page-store WAL append, before the record is framed
+                     ("torn" writes a truncated tail record and latches
+                     the journal dead — the crash-at-tail recovery
+                     drill; error kinds reject the op typed, so the
+                     engine keeps the session local)
+  pagestore.replicate  primary->follower replication of one committed
+                     entry ("drop"/timeout read as follower loss: the
+                     follower is dropped and later healed back in via
+                     full-state install — never fails the client op)
+  pagestore.promote  store promotion, before a follower adopts the new
+                     epoch (exception kinds abort THIS promotion; the
+                     fleet monitor retries next tick)
 
 Kinds: ``reset`` (ConnectionResetError), ``timeout`` (socket.timeout),
 ``error``/``crash`` (RuntimeError), plus site-interpreted kinds that
@@ -121,7 +133,9 @@ KNOWN_SITES = ("kvstore.send", "kvstore.recv", "server.apply",
                "session.export", "session.import",
                "speculate.draft", "speculate.verify",
                "mesh.reshard", "checkpoint.shard_read",
-               "autoscale.decide", "replica.spawn")
+               "autoscale.decide", "replica.spawn",
+               "pagestore.wal", "pagestore.replicate",
+               "pagestore.promote")
 
 
 class FaultRule:

@@ -1,15 +1,15 @@
-"""Fleet page store: the rendezvous for migrating decode-session KV.
+"""Fleet page store: the durable, replicated rendezvous for migrating
+decode-session KV.
 
 Session migration (serving PR 11) needs a place a dying, draining, or
 prefill-specialized replica can PUSH a session's state and a surviving
 (or decode-specialized) replica can PULL it — without the two ever
 talking directly, because the puller usually outlives the pusher.  This
-module is that store: a tiny in-memory record server speaking the
-kvstore framed wire protocol (``dist._encode_msg``/``_recv_msg`` — the
-same 8-byte length-prefixed JSON header + raw frames that carries
-parameter shards), with clients riding ``dist._ServerConn`` so pushes
-and pulls inherit the kvstore's bounded-retry / reconnect / backoff
-machinery for free.
+module is that store: a keyed record server speaking the kvstore framed
+wire protocol (``dist._encode_msg``/``_recv_msg`` — the same 8-byte
+length-prefixed JSON header + raw frames that carries parameter shards),
+with clients riding ``dist._ServerConn`` so pushes and pulls inherit the
+kvstore's bounded-retry / reconnect / backoff machinery for free.
 
 Records are keyed ``"<model>/<session-id>"`` and are one of
 
@@ -31,46 +31,380 @@ Two properties the migration protocol leans on:
   claims ``gen + 1`` for the taker — so a lagging replica (e.g. a
   drained one exporting after a survivor already claimed the session)
   can never re-push state the taker has superseded.
+
+The store itself must be at least as survivable as the replicas it
+backs (it is the single rendezvous every migration routes through), so
+three more layers sit on top of the in-memory dict:
+
+- **Durability** (``_Journal``): every accepted mutation is framed
+  (length + CRC32 + wire-codec payload — the checkpoint.py per-record
+  pattern) and appended to a write-ahead log *before* it is applied;
+  every ``MXNET_PAGESTORE_SNAPSHOT_OPS`` mutations the state is
+  compacted into an atomically-written snapshot (tmp + fsync + rename +
+  dir fsync) and the WAL rolls.  Restart replays the WAL over the
+  newest *verifying* snapshot — recovering the records AND the per-key
+  generation fences, because a store that forgets its high-water marks
+  would silently un-fence the whole migration design (a drained dead
+  holder's late put must still bounce after a crash).
+- **Replication + store epoch**: a primary replicates every committed
+  entry synchronously to its followers.  Failover promotes a follower
+  under a monotone **store epoch**; replication and install messages
+  from a lower epoch are refused (``"fenced"``), which a deposed
+  primary takes as its cue to stop accepting writes — its late writes
+  can never clobber post-promotion state.
+- **Budget + TTL** (``MXNET_PAGESTORE_BYTES`` / ``MXNET_PAGESTORE_TTL``):
+  orphaned parked sessions from clients that never resume are
+  LRU-evicted (typed over-budget rejection for a single oversized put);
+  eviction drops the record but KEEPS the gen fence.
+
+``PageStoreFleet`` wires it together: N store processes under the
+ReplicaSupervisor restart machinery, primary election by
+(epoch, seq), a monitor that promotes on primary death and heals
+restarted members back in via full-state install.  ``PageStoreClient``
+accepts the comma-joined address list and fails over primary→follower.
 """
 from __future__ import annotations
 
 import logging
+import os
+import shutil
 import socket
+import struct
+import tempfile
 import threading
+import time
+import zlib
+from collections import OrderedDict
 
-from .dist import _ServerConn, _recv_msg, _send_msg
+from .. import config as _config
+from .. import faults
+from .dist import _ServerConn, _encode_msg, _recv_msg, _send_msg
 
-__all__ = ["PageStoreServer", "PageStoreClient"]
+__all__ = ["PageStoreServer", "PageStoreClient", "PageStoreFleet"]
 
 _log = logging.getLogger(__name__)
 
+_RLEN = struct.Struct(">Q")   # framed record: payload length
+_RCRC = struct.Struct(">I")   # framed record: payload crc32
+_HDR = _RLEN.size + _RCRC.size
+_MAX_RECORD = 1 << 31         # sanity bound on one framed record
 
+
+# ---------------------------------------------------------------------------
+# WAL / snapshot journal
+# ---------------------------------------------------------------------------
+class _BytesReader:
+    """Socket-shaped shim over bytes so ``_recv_msg`` decodes WAL and
+    snapshot payloads with the exact wire codec (no second format)."""
+
+    def __init__(self, data):
+        self._data = data
+        self._pos = 0
+
+    def recv(self, n):
+        chunk = self._data[self._pos:self._pos + n]
+        self._pos += len(chunk)
+        return chunk
+
+
+def _decode_payload(payload):
+    return _recv_msg(_BytesReader(payload))
+
+
+def _frame(payload):
+    return (_RLEN.pack(len(payload))
+            + _RCRC.pack(zlib.crc32(payload) & 0xFFFFFFFF) + payload)
+
+
+def _iter_records(data):
+    """Yield ``(entry, end_offset)`` per valid framed record; stops at
+    the first torn or corrupt record (longest-valid-prefix recovery)."""
+    pos, n = 0, len(data)
+    while pos + _HDR <= n:
+        (ln,) = _RLEN.unpack_from(data, pos)
+        (crc,) = _RCRC.unpack_from(data, pos + _RLEN.size)
+        if ln > _MAX_RECORD or pos + _HDR + ln > n:
+            return  # torn tail
+        payload = bytes(data[pos + _HDR:pos + _HDR + ln])
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return  # bit rot / torn overwrite
+        try:
+            entry = _decode_payload(payload)
+        except (ValueError, KeyError, ConnectionError):
+            return
+        pos += _HDR + ln
+        yield entry, pos
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class _Journal:
+    """Append-only WAL + compaction snapshots for one store.
+
+    Files in ``dir``: ``wal-%08d.log`` (framed mutation entries) and
+    ``snap-%08d`` (one framed record holding the full state as of the
+    matching WAL's birth).  Invariant: state == load(snap-k) +
+    replay(wal-k, wal-k+1, ...).  Compaction keeps the previous
+    generation too, so a snapshot torn by the crash it is meant to
+    survive still recovers from (snap-prev + its WALs)."""
+
+    def __init__(self, dir, *, fsync=True):
+        self.dir = dir
+        self.fsync = bool(fsync)
+        self.dead = False         # torn-tail fault latched: no more appends
+        self.seq = 0              # current WAL generation
+        self.wal_bytes = 0
+        self.snapshot_ts = 0.0    # wall clock of newest snapshot (0 = none)
+        self._fh = None
+        os.makedirs(dir, exist_ok=True)
+
+    def _snap(self, seq):
+        return os.path.join(self.dir, "snap-%08d" % seq)
+
+    def _wal(self, seq):
+        return os.path.join(self.dir, "wal-%08d.log" % seq)
+
+    def _list(self, prefix):
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(prefix) and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name[len(prefix):].split(".")[0]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def recover(self):
+        """Load the newest verifying snapshot, replay WALs over it, and
+        open the tail WAL for append (truncated past any torn record).
+        Returns ``(snapshot_doc_or_None, [replay entries])``."""
+        base, doc = 0, None
+        for seq in reversed(self._list("snap-")):
+            try:
+                with open(self._snap(seq), "rb") as fh:
+                    data = fh.read()
+            except OSError:
+                continue
+            recs = list(_iter_records(data))
+            # a valid snapshot is exactly one framed record spanning the file
+            if len(recs) == 1 and recs[0][1] == len(data):
+                doc, base = recs[0][0], seq
+                self.snapshot_ts = os.path.getmtime(self._snap(seq))
+                break
+            _log.warning("pagestore: snapshot %d fails verification, "
+                         "falling back", seq)
+        entries, torn_at = [], None
+        wals = [s for s in self._list("wal-") if s >= base]
+        for seq in wals:
+            try:
+                with open(self._wal(seq), "rb") as fh:
+                    data = fh.read()
+            except OSError:
+                data = b""
+            end = 0
+            for entry, off in _iter_records(data):
+                entries.append(entry)
+                end = off
+            if end != len(data):
+                torn_at = (seq, end)
+                break  # nothing after a tear is trustworthy
+        if torn_at is not None:
+            self.seq = torn_at[0]
+        else:
+            self.seq = wals[-1] if wals else max(base, 1)
+        self._fh = open(self._wal(self.seq), "ab")
+        if torn_at is not None:
+            _log.warning("pagestore: WAL %d torn at byte %d — truncating "
+                         "to longest valid prefix", *torn_at)
+            self._fh.truncate(torn_at[1])
+            self._fh.seek(0, os.SEEK_END)
+        self.wal_bytes = self._fh.tell()
+        return doc, entries
+
+    def append(self, entry):
+        """Durably log one mutation BEFORE it is applied.  Raises
+        OSError/RuntimeError on failure (the caller rejects the op with
+        a typed error — never applies what it could not log).  An
+        injected ``torn`` fault writes a truncated record and latches
+        the journal dead: the crash-at-tail model recovery must cope
+        with."""
+        if self.dead:
+            raise RuntimeError("pagestore WAL latched dead (torn tail)")
+        kind = faults.check("pagestore.wal")
+        framed = _frame(_encode_msg(entry))
+        if kind == "torn":
+            self._fh.write(framed[:len(framed) - 4])
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.dead = True
+            raise RuntimeError("injected torn WAL tail")
+        self._fh.write(framed)
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+        self.wal_bytes += len(framed)
+
+    def snapshot(self, doc):
+        """Compact: atomically write the full state as snap-(seq+1),
+        roll to wal-(seq+1), prune generations older than the previous
+        one (two generations always recoverable)."""
+        new = self.seq + 1
+        tmp = self._snap(new) + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(_frame(_encode_msg(doc)))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._snap(new))
+        _fsync_dir(self.dir)
+        old_fh, prev = self._fh, self.seq
+        self._fh = open(self._wal(new), "ab")
+        self.seq = new
+        self.wal_bytes = 0
+        self.snapshot_ts = time.time()
+        try:
+            old_fh.close()
+        except OSError:
+            pass
+        for prefix in ("snap-", "wal-"):
+            for s in self._list(prefix):
+                if s < prev:
+                    path = (self._snap(s) if prefix == "snap-"
+                            else self._wal(s))
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+
+    def close(self):
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+# ---------------------------------------------------------------------------
+# connection helper
+# ---------------------------------------------------------------------------
+def _mk_conn(addr, wait=2.0, sock_timeout=10.0, retries=0):
+    """A ``_ServerConn`` tuned for failover: short connect window, no
+    internal retries (the caller owns the retry/rotation policy) —
+    the config-default 300 s kvstore deadline would otherwise turn a
+    dead store into a five-minute stall."""
+    host, _, port = str(addr).rpartition(":")
+    conn = _ServerConn(host or "127.0.0.1", int(port), timeout=wait)
+    conn.sock_timeout = float(sock_timeout)
+    conn.retries = int(retries)
+    if conn.sock is not None:
+        conn.sock.settimeout(float(sock_timeout))
+    return conn
+
+
+def _ask(addr, msg, timeout=5.0):
+    """One-shot request/reply to a store member (no retry, own socket:
+    safe from monitor threads without sharing client conn locks)."""
+    host, _, port = str(addr).rpartition(":")
+    with socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(s, msg)
+        return _recv_msg(s)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
 class PageStoreServer:
-    """In-memory keyed record store over the kvstore wire protocol.
+    """Durable keyed record store over the kvstore wire protocol.
 
     One accept loop + one thread per connection (replica counts are
-    small); all state is a dict under one lock.  Ops:
+    small); all state under one lock.  Client ops:
 
       {"op": "put", "key", "gen", "rec"} -> {"ok": bool}   (gen fencing)
       {"op": "take", "key"}             -> {"rec": rec|None, "gen": int}
       {"op": "delete", "key"}           -> {"ok": True}
-      {"op": "stats"}                   -> {"records", "gens", counters}
-    """
+      {"op": "stats"}                   -> {"records", "gens", counters, ...}
 
-    def __init__(self, host="127.0.0.1", port=0):
+    Replication / fleet ops (epoch-fenced):
+
+      {"op": "replicate", "epoch", "seq", "entry"}   primary -> follower
+      {"op": "promote", "epoch", "followers"}        fleet -> new primary
+      {"op": "add_follower", "addr"}                 fleet -> primary
+      {"op": "install", "epoch", "seq", "doc"}       primary -> follower
+
+    With ``dir`` set every accepted mutation is WAL'd before it is
+    applied and the state is periodically snapshotted; restart recovers
+    records AND generation fences (see module docstring)."""
+
+    def __init__(self, host="127.0.0.1", port=0, *, dir=None,
+                 role="primary", epoch=0, max_bytes=None, ttl_s=None,
+                 snapshot_every=None, fsync=None, rid=None):
         self.host = host
+        self.rid = rid
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, int(port)))
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._lock = threading.Lock()
-        self._records = {}   # key -> (gen, rec)
-        self._gens = {}      # key -> high-water gen (survives take)
+        self._records = OrderedDict()  # key -> {gen, rec, ts, nbytes} (LRU)
+        self._gens = {}                # key -> high-water gen (survives take)
         self.counters = {"puts": 0, "stale_puts": 0, "takes": 0,
-                         "misses": 0, "deletes": 0}
+                         "misses": 0, "deletes": 0, "evicted": 0,
+                         "over_budget": 0, "wal_errors": 0,
+                         "repl_errors": 0, "fenced": 0, "promotions": 0,
+                         "installs": 0}
+        self.role = role
+        self.epoch = int(epoch)
+        self.deposed = False
+        self._bytes = 0
+        if max_bytes is None:
+            max_bytes = int(_config.get("MXNET_PAGESTORE_BYTES") or 0)
+        if ttl_s is None:
+            ttl_s = float(_config.get("MXNET_PAGESTORE_TTL") or 0.0)
+        if snapshot_every is None:
+            snapshot_every = int(
+                _config.get("MXNET_PAGESTORE_SNAPSHOT_OPS") or 256)
+        if fsync is None:
+            fsync = int(_config.get("MXNET_PAGESTORE_FSYNC") or 0)
+        self._max_bytes = int(max_bytes) or None
+        self._ttl_s = float(ttl_s) or None
+        self._snapshot_every = max(1, int(snapshot_every))
+        self._last_sweep = 0.0
+        # replication
+        self._followers = {}       # addr -> _ServerConn
+        self._follower_acked = {}  # addr -> last acked repl seq
+        self.repl_seq = 0          # entries committed as primary
+        self.applied_seq = 0       # last replicated seq applied as follower
+        self._ops_since_snap = 0
+        # durability
+        if dir is None:
+            dir = str(_config.get("MXNET_PAGESTORE_DIR") or "") or None
+        self._journal = None
+        if dir:
+            self._journal = _Journal(dir, fsync=bool(fsync))
+            doc, entries = self._journal.recover()
+            if doc is not None:
+                self._load_doc_locked(doc)
+            for entry in entries:
+                self._apply_entry(entry)
+        # lifecycle
         self._stop = threading.Event()
         self._accept = None
+        self._conn_lock = threading.Lock()
+        self._conns = set()
+        self._threads = []
 
     @property
     def address(self):
@@ -85,12 +419,50 @@ class PageStoreServer:
 
     def stop(self):
         self._stop.set()
+        # closing a socket another thread is blocked in accept() on does
+        # NOT reliably wake it (Linux keeps the fd alive under the
+        # accept); shutdown does, with a self-connect as belt-and-braces
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            try:
+                host = self.host if self.host not in ("", "0.0.0.0") \
+                    else "127.0.0.1"
+                socket.create_connection((host, self.port),
+                                         timeout=1.0).close()
+            except OSError:
+                pass
         try:
             self._sock.close()
         except OSError:
             pass
         if self._accept is not None:
             self._accept.join(5.0)
+            self._accept = None
+        # close live per-conn sockets so their serve threads unblock,
+        # then join every conn thread ever started (zero leaks)
+        with self._conn_lock:
+            conns, threads = list(self._conns), list(self._threads)
+            self._threads = []
+        for conn in conns:
+            try:
+                # same story as the listener: shutdown() wakes a serve
+                # thread blocked in recv(); close() alone may not
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t in threads:
+            t.join(5.0)
+        with self._lock:
+            for conn in self._followers.values():
+                conn.close()
+            self._followers.clear()
+            if self._journal is not None:
+                self._journal.close()
 
     # -- server loop ------------------------------------------------------
     def _accept_loop(self):
@@ -99,8 +471,15 @@ class PageStoreServer:
                 conn, _addr = self._sock.accept()
             except OSError:
                 return  # socket closed by stop()
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            with self._conn_lock:
+                # prune finished threads as we go (the PR-3 kvstore
+                # serve() idiom) so a long-lived store doesn't hoard them
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
+                self._conns.add(conn)
+            t.start()
 
     def _serve_conn(self, conn):
         try:
@@ -111,26 +490,231 @@ class PageStoreServer:
         except (OSError, ValueError):
             pass  # client went away / torn frame: drop the conn
         finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
                 pass
 
+    # -- state application (shared by live ops, replication, replay) ------
+    def _apply_entry(self, entry):
+        e = entry.get("e")
+        key = entry.get("key")
+        if e == "put":
+            gen = int(entry.get("gen", 0))
+            old = self._records.pop(key, None)
+            if old is not None:
+                self._bytes -= old["nbytes"]
+            item = {"gen": gen, "rec": entry["rec"],
+                    "ts": float(entry.get("ts", 0.0)),
+                    "nbytes": int(entry.get("nbytes", 0))}
+            self._records[key] = item  # append = most-recently-used
+            self._bytes += item["nbytes"]
+            self._gens[key] = max(self._gens.get(key, -1), gen)
+        elif e == "take":
+            old = self._records.pop(key, None)
+            if old is not None:
+                self._bytes -= old["nbytes"]
+            self._gens[key] = max(self._gens.get(key, -1),
+                                  int(entry.get("claimed", 0)))
+        elif e == "delete":
+            old = self._records.pop(key, None)
+            if old is not None:
+                self._bytes -= old["nbytes"]
+            self._gens.pop(key, None)
+        elif e == "evict":
+            # drops the record but KEEPS the gen fence: an evicted
+            # session's dead holder must still bounce off the high-water
+            old = self._records.pop(key, None)
+            if old is not None:
+                self._bytes -= old["nbytes"]
+        elif e == "epoch":
+            self.epoch = max(self.epoch, int(entry.get("epoch", 0)))
+
+    def _state_doc_locked(self):
+        return {"v": 1, "epoch": self.epoch,
+                "gens": {k: int(v) for k, v in self._gens.items()},
+                "records": [{"key": k, "gen": it["gen"], "ts": it["ts"],
+                             "nbytes": it["nbytes"], "rec": it["rec"]}
+                            for k, it in self._records.items()]}
+
+    def _load_doc_locked(self, doc):
+        self.epoch = max(self.epoch, int(doc.get("epoch", 0)))
+        self._gens = {str(k): int(v)
+                      for k, v in (doc.get("gens") or {}).items()}
+        self._records = OrderedDict()
+        self._bytes = 0
+        for it in doc.get("records") or []:
+            item = {"gen": int(it["gen"]), "rec": it["rec"],
+                    "ts": float(it.get("ts", 0.0)),
+                    "nbytes": int(it.get("nbytes", 0))}
+            self._records[it["key"]] = item
+            self._bytes += item["nbytes"]
+
+    # -- commit path ------------------------------------------------------
+    def _commit_locked(self, entry):
+        """WAL -> apply -> replicate.  Returns an error token (the op is
+        rejected typed, nothing applied) or None on success."""
+        if self._journal is not None:
+            try:
+                self._journal.append(entry)
+            except (OSError, RuntimeError) as e:
+                self.counters["wal_errors"] += 1
+                _log.error("pagestore %s: WAL append failed: %r",
+                           self.rid or self.address, e)
+                return "wal_error"
+        self._apply_entry(entry)
+        self.repl_seq += 1
+        if self._followers and not self._replicate_locked(entry):
+            return "deposed"
+        self._maybe_snapshot_locked()
+        return None
+
+    def _maybe_snapshot_locked(self):
+        self._ops_since_snap += 1
+        if (self._journal is not None
+                and self._ops_since_snap >= self._snapshot_every):
+            self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        try:
+            self._journal.snapshot(self._state_doc_locked())
+        except OSError as e:
+            self.counters["wal_errors"] += 1
+            _log.error("pagestore %s: snapshot failed: %r",
+                       self.rid or self.address, e)
+        self._ops_since_snap = 0
+
+    def _replicate_locked(self, entry):
+        """Synchronously replicate one committed entry.  A dead follower
+        is dropped (the fleet heals it back in via install); a 'fenced'
+        reply means a higher epoch exists — we are deposed."""
+        msg = {"op": "replicate", "epoch": self.epoch,
+               "seq": self.repl_seq, "entry": entry}
+        for addr in list(self._followers):
+            conn = self._followers[addr]
+            try:
+                kind = faults.check("pagestore.replicate")
+                if kind == "drop":
+                    raise OSError("injected replicate drop")
+                rep = conn.request(msg) or {}
+            except (OSError, RuntimeError):
+                self.counters["repl_errors"] += 1
+                self._drop_follower_locked(addr)
+                continue
+            if rep.get("error") == "fenced":
+                self.counters["fenced"] += 1
+                self.deposed = True
+                _log.warning("pagestore %s: fenced by follower %s "
+                             "(epoch %s > %d) — deposed",
+                             self.rid or self.address, addr,
+                             rep.get("epoch"), self.epoch)
+                return False
+            self._follower_acked[addr] = int(rep.get("seq", 0))
+        return True
+
+    def _drop_follower_locked(self, addr):
+        conn = self._followers.pop(addr, None)
+        self._follower_acked.pop(addr, None)
+        if conn is not None:
+            conn.close()
+
+    def _add_follower_locked(self, addr):
+        """Register a follower: push the full state (install) so a fresh
+        or restarted member joins exactly consistent, then replicate to
+        it synchronously from here on."""
+        addr = str(addr)
+        if addr == self.address:
+            return False
+        conn = self._followers.get(addr)
+        try:
+            if conn is None:
+                conn = _mk_conn(addr)
+            rep = conn.request({"op": "install", "epoch": self.epoch,
+                                "seq": self.repl_seq, "primary": self.address,
+                                "doc": self._state_doc_locked()}) or {}
+        except (OSError, RuntimeError):
+            if conn is not None:
+                conn.close()
+            self._followers.pop(addr, None)
+            return False
+        if not rep.get("ok"):
+            if rep.get("error") == "fenced":
+                self.counters["fenced"] += 1
+                self.deposed = True
+            self._drop_follower_locked(addr)
+            return False
+        self._followers[addr] = conn
+        self._follower_acked[addr] = self.repl_seq
+        return True
+
+    def _log_epoch_locked(self):
+        if self._journal is None:
+            return
+        try:
+            self._journal.append({"e": "epoch", "epoch": self.epoch})
+        except (OSError, RuntimeError):
+            self.counters["wal_errors"] += 1
+
+    # -- eviction ---------------------------------------------------------
+    def _sweep_ttl_locked(self):
+        if self._ttl_s is None:
+            return
+        now = time.time()
+        if now - self._last_sweep < 1.0:
+            return
+        self._last_sweep = now
+        expired = [k for k, it in self._records.items()
+                   if it["ts"] and now - it["ts"] > self._ttl_s]
+        for key in expired:
+            if self._commit_locked({"e": "evict", "key": key}) is None:
+                self.counters["evicted"] += 1
+
+    def _evict_for_budget_locked(self, incoming):
+        while (self._records
+               and self._bytes + incoming > self._max_bytes):
+            key = next(iter(self._records))  # LRU head
+            if self._commit_locked({"e": "evict", "key": key}) is not None:
+                break
+            self.counters["evicted"] += 1
+
+    # -- op dispatch ------------------------------------------------------
     def _handle(self, msg):
         op = msg.get("op")
         key = msg.get("key")
         with self._lock:
             if op == "put":
+                if self.role != "primary" or self.deposed:
+                    return {"ok": False, "error": "not_primary",
+                            "epoch": self.epoch}
+                self._sweep_ttl_locked()
                 gen = int(msg.get("gen", 0))
                 if gen <= self._gens.get(key, -1):
                     self.counters["stale_puts"] += 1
-                    return {"ok": False, "gen": self._gens[key]}
-                self._gens[key] = gen
-                self._records[key] = (gen, msg["rec"])
+                    return {"ok": False, "error": "stale",
+                            "gen": self._gens[key]}
+                rec = msg["rec"]
+                nbytes = len(_encode_msg(rec))
+                if self._max_bytes and nbytes > self._max_bytes:
+                    self.counters["over_budget"] += 1
+                    return {"ok": False, "error": "over_budget",
+                            "bytes": nbytes}
+                if self._max_bytes:
+                    self._evict_for_budget_locked(nbytes)
+                err = self._commit_locked(
+                    {"e": "put", "key": key, "gen": gen, "rec": rec,
+                     "ts": time.time(), "nbytes": nbytes})
+                if err:
+                    return {"ok": False, "error": err}
                 self.counters["puts"] += 1
                 return {"ok": True, "gen": gen}
             if op == "take":
-                item = self._records.pop(key, None)
+                if self.role != "primary" or self.deposed:
+                    return {"rec": None, "gen": 0, "error": "not_primary",
+                            "epoch": self.epoch}
+                self._sweep_ttl_locked()
+                item = self._records.get(key)
                 if item is None:
                     self.counters["misses"] += 1
                     return {"rec": None, "gen": self._gens.get(key, 0)}
@@ -138,22 +722,138 @@ class PageStoreServer:
                 # to gen+1, so a lagging previous holder (a drained
                 # replica exporting after the handoff) can never re-push
                 # state the taker has already superseded
-                claimed = item[0] + 1
-                self._gens[key] = max(self._gens.get(key, -1), claimed)
+                claimed = item["gen"] + 1
+                err = self._commit_locked(
+                    {"e": "take", "key": key, "claimed": claimed})
+                if err:
+                    return {"rec": None, "gen": self._gens.get(key, 0),
+                            "error": err}
                 self.counters["takes"] += 1
-                return {"rec": item[1], "gen": claimed}
+                return {"rec": item["rec"], "gen": claimed}
             if op == "delete":
-                self._records.pop(key, None)
-                self._gens.pop(key, None)
+                if self.role != "primary" or self.deposed:
+                    return {"ok": False, "error": "not_primary",
+                            "epoch": self.epoch}
+                err = self._commit_locked({"e": "delete", "key": key})
+                if err:
+                    return {"ok": False, "error": err}
                 self.counters["deletes"] += 1
                 return {"ok": True}
             if op == "stats":
-                return {"records": len(self._records),
-                        "gens": len(self._gens),
-                        "counters": dict(self.counters)}
+                return self._stats_locked()
+            if op == "replicate":
+                return self._handle_replicate_locked(msg)
+            if op == "promote":
+                return self._handle_promote_locked(msg)
+            if op == "add_follower":
+                if self.role != "primary" or self.deposed:
+                    return {"ok": False, "error": "not_primary",
+                            "epoch": self.epoch}
+                ok = self._add_follower_locked(msg.get("addr"))
+                return {"ok": ok, "followers": sorted(self._followers)}
+            if op == "install":
+                return self._handle_install_locked(msg)
             return {"error": "unknown op %r" % (op,)}
 
+    def _handle_replicate_locked(self, msg):
+        ep = int(msg.get("epoch", 0))
+        if ep < self.epoch:
+            self.counters["fenced"] += 1
+            return {"error": "fenced", "epoch": self.epoch}
+        if ep > self.epoch:
+            self.epoch = ep
+            self._log_epoch_locked()
+        entry = msg.get("entry") or {}
+        if self._journal is not None:
+            try:
+                self._journal.append(entry)
+            except (OSError, RuntimeError):
+                # a follower with a sick disk still serves from memory;
+                # its next install re-seats durability
+                self.counters["wal_errors"] += 1
+        self._apply_entry(entry)
+        self.applied_seq = max(self.applied_seq, int(msg.get("seq", 0)))
+        self._maybe_snapshot_locked()
+        return {"ok": True, "seq": self.applied_seq}
 
+    def _handle_promote_locked(self, msg):
+        ep = int(msg.get("epoch", 0))
+        if ep <= self.epoch:
+            return {"ok": False, "error": "stale_epoch",
+                    "epoch": self.epoch}
+        try:
+            faults.check("pagestore.promote")
+        except (OSError, RuntimeError):
+            return {"ok": False, "error": "promote_fault",
+                    "epoch": self.epoch}
+        self.epoch = ep
+        self.role = "primary"
+        self.deposed = False
+        self.repl_seq = max(self.repl_seq, self.applied_seq)
+        self._log_epoch_locked()
+        self.counters["promotions"] += 1
+        for addr in msg.get("followers") or []:
+            self._add_follower_locked(addr)
+        return {"ok": True, "epoch": ep,
+                "followers": sorted(self._followers)}
+
+    def _handle_install_locked(self, msg):
+        ep = int(msg.get("epoch", 0))
+        if ep < self.epoch:
+            self.counters["fenced"] += 1
+            return {"ok": False, "error": "fenced", "epoch": self.epoch}
+        self.epoch = ep
+        self.role = "follower"
+        self.deposed = False
+        for addr in list(self._followers):
+            self._drop_follower_locked(addr)
+        self._load_doc_locked(msg.get("doc") or {})
+        self.applied_seq = int(msg.get("seq", 0))
+        self.counters["installs"] += 1
+        if self._journal is not None:
+            self._snapshot_locked()  # durable join point
+        return {"ok": True, "epoch": self.epoch}
+
+    def _stats_locked(self):
+        out = {"records": len(self._records),
+               "gens": len(self._gens),
+               "counters": dict(self.counters),
+               "bytes": self._bytes,
+               "epoch": self.epoch,
+               "role": self.role,
+               "deposed": self.deposed,
+               "rid": self.rid,
+               "repl_seq": self.repl_seq,
+               "applied_seq": self.applied_seq,
+               "followers": sorted(self._followers),
+               "repl_lag": (self.repl_seq
+                            - min(self._follower_acked.values())
+                            if self._follower_acked else 0),
+               "wal_bytes": 0, "wal_seq": 0, "snapshot_age_s": -1.0}
+        if self._journal is not None:
+            out["wal_bytes"] = self._journal.wal_bytes
+            out["wal_seq"] = self._journal.seq
+            if self._journal.snapshot_ts:
+                out["snapshot_age_s"] = round(
+                    time.time() - self._journal.snapshot_ts, 3)
+        return out
+
+    def stats_summary(self):
+        """The gauge block routers export (single-store deployment;
+        PageStoreFleet aggregates the same shape across members)."""
+        with self._lock:
+            st = self._stats_locked()
+        return {"replicas": 1, "primary": self.address,
+                "epoch": st["epoch"], "records": st["records"],
+                "bytes": st["bytes"], "wal_bytes": st["wal_bytes"],
+                "snapshot_age_s": st["snapshot_age_s"],
+                "replication_lag": st["repl_lag"], "failovers_total": 0,
+                "evicted_total": st["counters"]["evicted"]}
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
 class PageStoreClient:
     """One replica's handle on the page store (lazy, self-healing).
 
@@ -161,16 +861,42 @@ class PageStoreClient:
     transparent reconnects, so a store hiccup degrades to latency, not
     session loss.  All methods swallow transport failure into a soft
     result (put -> False, take -> None): migration is best-effort by
-    contract; the typed ``SessionResetError`` fallback still exists."""
+    contract; the typed ``SessionResetError`` fallback still exists.
 
-    def __init__(self, host, port, timeout=10.0):
-        self.host, self.port = host, int(port)
+    ``host`` may be a comma-joined address list (the form
+    ``PageStoreFleet.start`` returns, primary first): the client then
+    fails over — it rotates to the next address on transport failure or
+    a ``not_primary``/``deposed`` refusal, with a few short passes to
+    ride out a promotion window.  ``last_refusal`` records why the most
+    recent call was refused (``"stale"``, ``"over_budget"``,
+    ``"wal_error"``, ``"transport"``, ...) so engines can count their
+    degrade paths instead of guessing."""
+
+    def __init__(self, host, port=None, timeout=10.0):
+        if port is None or (isinstance(host, str) and "," in host):
+            addrs = (list(host) if isinstance(host, (list, tuple))
+                     else [a.strip() for a in str(host).split(",")
+                           if a.strip()])
+        else:
+            addrs = ["%s:%d" % (host, int(port))]
+        if not addrs:
+            raise ValueError("PageStoreClient needs at least one address")
+        self._addrs = addrs
+        self._multi = len(addrs) > 1
+        h, _, p = addrs[0].rpartition(":")
+        self.host, self.port = h or "127.0.0.1", int(p)
         self._timeout = float(timeout)
-        self._conn = None
+        self._conn = None      # single-addr legacy path
+        self._conns = {}       # multi-addr: index -> _ServerConn
+        self._cur = 0
         self._lock = threading.Lock()
+        self.failovers = 0
+        self.last_refusal = None
 
     @classmethod
     def from_addr(cls, addr, timeout=10.0):
+        if isinstance(addr, (list, tuple)) or "," in addr:
+            return cls(addr, None, timeout)
         host, _, port = addr.rpartition(":")
         return cls(host or "127.0.0.1", int(port), timeout)
 
@@ -182,27 +908,74 @@ class PageStoreClient:
             return self._conn
 
     def _request(self, msg):
-        return self._connection().request(msg)
+        if not self._multi:
+            return self._connection().request(msg)
+        with self._lock:
+            return self._request_multi_locked(msg)
+
+    def _request_multi_locked(self, msg):
+        n = len(self._addrs)
+        last = None
+        # keep rotating until the timeout budget is spent: a failover is
+        # a window (kill detection + promotion), not an instant, and the
+        # contract is that a store failover degrades to latency
+        deadline = time.monotonic() + max(3.0, self._timeout)
+        while True:
+            for k in range(n):
+                i = (self._cur + k) % n
+                try:
+                    conn = self._conns.get(i)
+                    if conn is None:
+                        conn = _mk_conn(self._addrs[i], wait=1.5,
+                                        sock_timeout=self._timeout,
+                                        retries=0)
+                        self._conns[i] = conn
+                    rep = conn.request(msg) or {}
+                except (OSError, RuntimeError) as e:
+                    last = e
+                    dead = self._conns.pop(i, None)
+                    if dead is not None:
+                        dead.close()
+                    continue
+                if rep.get("error") in ("not_primary", "deposed"):
+                    last = RuntimeError("store %s refused: %s"
+                                        % (self._addrs[i], rep["error"]))
+                    continue
+                if i != self._cur:
+                    self.failovers += 1
+                    self._cur = i
+                return rep
+            if time.monotonic() > deadline:
+                raise ConnectionError(
+                    "no reachable pagestore primary in %s (%r)"
+                    % (self._addrs, last))
+            time.sleep(0.25)
 
     def put(self, key, rec, gen=0):
         """Store ``rec`` under ``key`` unless the store has seen a newer
         generation; returns True when accepted."""
+        self.last_refusal = None
         try:
-            return bool(self._request({"op": "put", "key": key,
-                                       "gen": int(gen),
-                                       "rec": rec}).get("ok"))
+            rep = self._request({"op": "put", "key": key,
+                                 "gen": int(gen), "rec": rec})
         except (OSError, RuntimeError) as e:
             _log.warning("pagestore put %s failed: %r", key, e)
+            self.last_refusal = "transport"
             return False
+        if not rep.get("ok"):
+            self.last_refusal = rep.get("error") or "stale"
+        return bool(rep.get("ok"))
 
     def take(self, key):
         """Atomically claim and remove ``key``'s record; returns
         ``(rec, gen)`` or ``(None, gen)`` when absent/unreachable."""
+        self.last_refusal = None
         try:
             out = self._request({"op": "take", "key": key})
             return out.get("rec"), int(out.get("gen", 0))
         except (OSError, RuntimeError) as e:
             _log.warning("pagestore take %s failed: %r", key, e)
+            self.last_refusal = "transport"
             return None, 0
 
     def delete(self, key):
@@ -223,3 +996,346 @@ class PageStoreClient:
             if self._conn is not None:
                 self._conn.close()
                 self._conn = None
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()
+
+
+# ---------------------------------------------------------------------------
+# fleet: N supervised stores, election, failover, healing
+# ---------------------------------------------------------------------------
+class PageStoreFleet:
+    """N replicated PageStore members behind one address list.
+
+    ``processes=True`` runs each member as a ``python -m
+    mxnet_tpu.kvstore.pagestore`` subprocess under the
+    ReplicaSupervisor restart-budget/backoff machinery (the SIGKILL
+    target for chaos); ``processes=False`` runs in-process servers —
+    same election/failover/healing logic, cheap enough for tier-1.
+
+    ``start()`` recovers each member from its WAL dir, elects the most
+    advanced member (epoch, applied seq, records) as primary at
+    max(epochs)+1, installs the rest as followers, and returns the
+    comma-joined address list (primary first) to stamp into
+    ``MXNET_GEN_PAGESTORE``.  A monitor thread probes the primary:
+    repeated failures promote the best reachable follower under a
+    fresh epoch (clients rotate on ``not_primary``), and restarted
+    members are healed back in via a full-state install."""
+
+    def __init__(self, *, replicas=2, host="127.0.0.1", dir=None,
+                 processes=True, probe_interval_s=0.2, strikes=2,
+                 supervisor_kwargs=None):
+        self.n = max(1, int(replicas))
+        self.host = host
+        self.dir = dir
+        self.processes = bool(processes)
+        self._probe_interval = float(probe_interval_s)
+        self._strikes_limit = max(1, int(strikes))
+        self._sup_kwargs = dict(supervisor_kwargs or {})
+        self.supervisor = None
+        self.servers = {}          # in-proc: rid -> PageStoreServer
+        self._members = []         # [(rid, addr)] fixed boot order
+        self.primary = None
+        self.failovers_total = 0
+        self.rejoins = 0
+        self._max_epoch = 0
+        self._mon = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._owns_dir = False
+
+    # -- lifecycle --------------------------------------------------------
+    def _member_dir(self, rid):
+        return os.path.join(self.dir, rid)
+
+    def start(self, timeout=60.0):
+        if self.dir is None:
+            self.dir = tempfile.mkdtemp(prefix="mxtpu-pagestore-")
+            self._owns_dir = True
+        if self.processes:
+            self._start_processes()
+        else:
+            self._start_inproc()
+        self._wait_members(timeout)
+        self._elect()
+        self._mon = threading.Thread(target=self._monitor_loop,
+                                     name="mxtpu-pagestore-fleet",
+                                     daemon=True)
+        self._mon.start()
+        return self.address_list()
+
+    def _start_inproc(self):
+        for i in range(self.n):
+            rid = "store-%d" % i
+            srv = PageStoreServer(self.host, 0, dir=self._member_dir(rid),
+                                  role="follower", rid=rid)
+            srv.start()
+            self.servers[rid] = srv
+            self._members.append((rid, srv.address))
+
+    def _start_processes(self):
+        from ..serving.supervisor import ReplicaSupervisor
+        fleet = self
+
+        def command(r, _spec_path):
+            import sys as _sys
+            return [_sys.executable, "-m", "mxnet_tpu.kvstore.pagestore",
+                    "--host", r.host, "--port", str(r.port),
+                    "--id", r.rid, "--dir", fleet._member_dir(r.rid),
+                    "--role", "follower"]
+
+        def probe(r, timeout=1.0):
+            try:
+                _ask(r.addr, {"op": "stats"}, timeout=timeout)
+                return True
+            except (OSError, RuntimeError):
+                return False
+
+        kw = dict(restart_budget=6, restart_window_s=60.0,
+                  restart_backoff_ms=50.0, startup_timeout_s=60.0)
+        kw.update(self._sup_kwargs)
+        self.supervisor = ReplicaSupervisor(
+            {"kind": "pagestore"}, replicas=self.n, host=self.host,
+            command_builder=command, ready_probe=probe, **kw)
+        self.supervisor.start(wait_ready=True)
+        for r in self.supervisor.replicas:
+            self._members.append((r.rid, r.addr))
+
+    def _wait_members(self, timeout):
+        deadline = time.monotonic() + timeout
+        for rid, addr in self._members:
+            while True:
+                try:
+                    _ask(addr, {"op": "stats"}, timeout=1.0)
+                    break
+                except (OSError, RuntimeError):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "pagestore member %s (%s) not ready"
+                            % (rid, addr))
+                    time.sleep(0.05)
+
+    def _elect(self):
+        """Initial election: the most advanced member (it may have just
+        recovered a WAL from a previous life) becomes primary under a
+        fresh epoch; everyone else gets a full-state install."""
+        scored = []
+        for _rid, addr in self._members:
+            try:
+                st = _ask(addr, {"op": "stats"}, timeout=2.0)
+            except (OSError, RuntimeError):
+                continue
+            ep = int(st.get("epoch", 0))
+            self._max_epoch = max(self._max_epoch, ep)
+            scored.append((ep,
+                           max(int(st.get("repl_seq", 0)),
+                               int(st.get("applied_seq", 0))),
+                           int(st.get("records", 0)), addr))
+        if not scored:
+            raise RuntimeError("no pagestore member reachable for election")
+        scored.sort()
+        best = scored[-1][-1]
+        self._max_epoch += 1
+        rep = _ask(best, {"op": "promote", "epoch": self._max_epoch,
+                          "followers": [a for _r, a in self._members
+                                        if a != best]}, timeout=15.0)
+        if not rep.get("ok"):
+            raise RuntimeError("pagestore election failed: %r" % (rep,))
+        self.primary = best
+
+    def address_list(self):
+        """Comma-joined member addresses, primary first — the value for
+        ``MXNET_GEN_PAGESTORE``."""
+        with self._lock:
+            rest = [a for _r, a in self._members if a != self.primary]
+            return ",".join([self.primary] + rest)
+
+    # -- monitor ----------------------------------------------------------
+    def _monitor_loop(self):
+        strikes = 0
+        while not self._stop.wait(self._probe_interval):
+            with self._lock:
+                primary = self.primary
+            try:
+                st = _ask(primary, {"op": "stats"}, timeout=1.0)
+                # a restarted process answering on the primary's port
+                # boots as a follower: reachable, but not a primary —
+                # that MUST count as primary loss or no failover happens
+                if st.get("deposed") or st.get("role") != "primary":
+                    raise RuntimeError("primary deposed or demoted")
+                strikes = 0
+                self._max_epoch = max(self._max_epoch,
+                                      int(st.get("epoch", 0)))
+                self._heal(primary, st.get("followers") or [])
+            except (OSError, RuntimeError):
+                strikes += 1
+                if strikes >= self._strikes_limit:
+                    if self._failover():
+                        strikes = 0
+            if not self.processes:
+                self._revive_inproc()
+
+    def _heal(self, primary, follower_set):
+        """Re-admit ready members the primary is not replicating to
+        (restarted processes, previously dropped followers)."""
+        for _rid, addr in self._members:
+            if addr == primary or addr in follower_set:
+                continue
+            try:
+                _ask(addr, {"op": "stats"}, timeout=0.5)
+                rep = _ask(primary, {"op": "add_follower", "addr": addr},
+                           timeout=10.0)
+            except (OSError, RuntimeError):
+                continue
+            if rep.get("ok"):
+                self.rejoins += 1
+                _log.info("pagestore fleet: healed %s back in as "
+                          "follower of %s", addr, primary)
+
+    def _failover(self):
+        """Primary is gone (or deposed): promote the best reachable
+        other member under a strictly higher epoch."""
+        with self._lock:
+            old = self.primary
+            scored = []
+            for _rid, addr in self._members:
+                if addr == old:
+                    continue
+                try:
+                    st = _ask(addr, {"op": "stats"}, timeout=1.0)
+                except (OSError, RuntimeError):
+                    continue
+                ep = int(st.get("epoch", 0))
+                self._max_epoch = max(self._max_epoch, ep)
+                scored.append((ep,
+                               max(int(st.get("repl_seq", 0)),
+                                   int(st.get("applied_seq", 0))),
+                               int(st.get("records", 0)), addr))
+            if not scored:
+                return False  # nobody reachable; retry next tick
+            scored.sort()
+            best = scored[-1][-1]
+            new_epoch = self._max_epoch + 1
+            followers = [a for e, s, r, a in scored if a != best]
+            try:
+                rep = _ask(best, {"op": "promote", "epoch": new_epoch,
+                                  "followers": followers}, timeout=15.0)
+            except (OSError, RuntimeError):
+                return False
+            if not rep.get("ok"):
+                return False
+            self._max_epoch = new_epoch
+            self.primary = best
+            self.failovers_total += 1
+            _log.warning("pagestore fleet: failover %s -> %s (epoch %d)",
+                         old, best, new_epoch)
+            return True
+
+    def _revive_inproc(self):
+        """In-process mode: a member stopped by chaos is rebuilt on the
+        same port + WAL dir (the analog of a supervisor restart)."""
+        with self._lock:
+            members = list(self._members)
+        for rid, addr in members:
+            srv = self.servers.get(rid)
+            if srv is not None and not srv._stop.is_set():
+                continue
+            _h, _, port = addr.rpartition(":")
+            try:
+                fresh = PageStoreServer(self.host, int(port),
+                                        dir=self._member_dir(rid),
+                                        role="follower", rid=rid)
+                fresh.start()
+                self.servers[rid] = fresh
+            except OSError:
+                continue  # port not free yet; next tick
+
+    # -- chaos hooks ------------------------------------------------------
+    def kill_primary(self, sig=None):
+        """SIGKILL (process mode) or hard-stop (in-proc) the current
+        primary; returns its address.  The monitor promotes a follower
+        and later heals the restarted member back in."""
+        import signal as _signal
+        sig = _signal.SIGKILL if sig is None else sig
+        with self._lock:
+            primary = self.primary
+            rid = next((r for r, a in self._members if a == primary), None)
+        if rid is None:
+            return None
+        if self.processes:
+            idx = next(i for i, r in enumerate(self.supervisor.replicas)
+                       if r.rid == rid)
+            self.supervisor.kill(idx, sig)
+        else:
+            self.servers[rid].stop()
+        return primary
+
+    # -- observability ----------------------------------------------------
+    def stats_summary(self):
+        out = {"replicas": len(self._members), "primary": self.primary,
+               "failovers_total": self.failovers_total,
+               "rejoins": self.rejoins, "epoch": 0, "records": 0,
+               "bytes": 0, "wal_bytes": 0, "snapshot_age_s": -1.0,
+               "replication_lag": 0, "evicted_total": 0}
+        try:
+            st = _ask(self.primary, {"op": "stats"}, timeout=1.0)
+        except (OSError, RuntimeError):
+            return out
+        out.update(epoch=int(st.get("epoch", 0)),
+                   records=int(st.get("records", 0)),
+                   bytes=int(st.get("bytes", 0)),
+                   wal_bytes=int(st.get("wal_bytes", 0)),
+                   snapshot_age_s=st.get("snapshot_age_s", -1.0),
+                   replication_lag=int(st.get("repl_lag", 0)),
+                   evicted_total=int(st.get("counters", {})
+                                     .get("evicted", 0)))
+        return out
+
+    def stop(self, timeout=15.0):
+        self._stop.set()
+        if self._mon is not None:
+            self._mon.join(5.0)
+            self._mon = None
+        if self.supervisor is not None:
+            self.supervisor.stop(timeout)
+            self.supervisor = None
+        for srv in self.servers.values():
+            srv.stop()
+        self.servers.clear()
+        if self._owns_dir and self.dir:
+            shutil.rmtree(self.dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# standalone entrypoint (PageStoreFleet process mode / manual ops)
+# ---------------------------------------------------------------------------
+def main(argv=None):
+    import argparse
+    import signal as _signal
+    p = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.kvstore.pagestore",
+        description="Run one PageStore member (durable when --dir is set)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--id", default=None)
+    p.add_argument("--dir", default=None)
+    p.add_argument("--role", default="primary",
+                   choices=("primary", "follower"))
+    args = p.parse_args(argv)
+    srv = PageStoreServer(args.host, args.port, dir=args.dir or None,
+                          role=args.role, rid=args.id)
+    addr = srv.start()
+    print("pagestore %s (%s) listening on %s"
+          % (args.id or "-", args.role, addr), flush=True)
+    stop = threading.Event()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(sig, lambda *_a: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -34,12 +34,17 @@ moment, N-1 (or N, via the last-resort drain route) replicas serving
 the whole time, and an abort path that converges back to the old
 version without restarting anything.
 
-Session migration (serving PR 11): the fleet boots a
-:class:`~mxnet_tpu.kvstore.pagestore.PageStoreServer` and hands its
-address to every replica (``MXNET_GEN_PAGESTORE``), so decode sessions
-outlive any single replica — a drained/rolled/killed replica's parked
-sessions are pushed (or, after SIGKILL, recovered from their replayed
-transcripts) and pulled by whichever survivor the router picks next.
+Session migration (serving PR 11): the fleet boots a page store and
+hands its address(es) to every replica (``MXNET_GEN_PAGESTORE``), so
+decode sessions outlive any single replica — a drained/rolled/killed
+replica's parked sessions are pushed (or, after SIGKILL, recovered from
+their replayed transcripts) and pulled by whichever survivor the router
+picks next.  The store itself is survivable too: with
+``MXNET_PAGESTORE_REPLICAS`` (or ``pagestore={"replicas": N}``) the
+fleet runs a :class:`~mxnet_tpu.kvstore.pagestore.PageStoreFleet` — N
+supervised, WAL-durable store processes with synchronous replication
+and epoch-fenced failover — instead of the single in-process
+:class:`~mxnet_tpu.kvstore.pagestore.PageStoreServer`.
 ``rollout`` migrates each replica's parked sessions out before the
 admin load instead of resetting them, and ``roles=`` specializes
 replicas into prefill/decode pools (``router.Router`` routes fresh long
@@ -57,7 +62,7 @@ import numpy as onp
 from .. import config as _config
 from .. import faults
 from .. import profiler
-from ..kvstore.pagestore import PageStoreServer
+from ..kvstore.pagestore import PageStoreFleet, PageStoreServer
 from .autoscale import Autoscaler
 from .errors import RolloutAbortedError, ServingError
 from .metrics import LatencyHistogram
@@ -259,7 +264,7 @@ class ServingFleet:
     def __init__(self, spec, *, replicas=None, policy="least_loaded",
                  host="127.0.0.1", port=0, env=None, roles=None,
                  sharding=None, router_kwargs=None,
-                 supervisor_kwargs=None, autoscale=None):
+                 supervisor_kwargs=None, autoscale=None, pagestore=None):
         self.supervisor = ReplicaSupervisor(
             spec, replicas=replicas, host=host, env=env,
             **(supervisor_kwargs or {}))
@@ -306,6 +311,10 @@ class ServingFleet:
         # autoscale=True enables the control loop with config-knob
         # defaults; a dict supplies Autoscaler(**kwargs) overrides
         self._autoscale_cfg = autoscale
+        # pagestore={"replicas": N, "dir": ..., "processes": bool, ...}
+        # opts into the durable, replicated store (PageStoreFleet);
+        # None defers to MXNET_PAGESTORE_REPLICAS / _DIR config knobs
+        self._pagestore_cfg = dict(pagestore or {})
         self.router = None
         self.server = None
         self.pagestore = None
@@ -321,16 +330,33 @@ class ServingFleet:
         # override of MXNET_GEN_PAGESTORE wins — e.g. an external store)
         if (int(_config.get("MXNET_GEN_MIGRATE"))
                 and "MXNET_GEN_PAGESTORE" not in self.supervisor.env):
-            self.pagestore = PageStoreServer(host=self._host)
-            self.supervisor.env["MXNET_GEN_PAGESTORE"] = (
-                self.pagestore.start())
+            n_store = int(self._pagestore_cfg.get(
+                "replicas", _config.get("MXNET_PAGESTORE_REPLICAS")))
+            if n_store >= 1:
+                # durable, replicated store: N supervised members,
+                # epoch-fenced failover; replicas get the full address
+                # list (primary first) and fail over client-side
+                cfg = dict(self._pagestore_cfg)
+                cfg.pop("replicas", None)
+                cfg.setdefault("host", self._host)
+                self.pagestore = PageStoreFleet(replicas=n_store, **cfg)
+                self.supervisor.env["MXNET_GEN_PAGESTORE"] = (
+                    self.pagestore.start())
+            else:
+                # single in-process store (durable when
+                # MXNET_PAGESTORE_DIR is set — the dir is read by the
+                # PageStoreServer constructor)
+                self.pagestore = PageStoreServer(host=self._host)
+                self.supervisor.env["MXNET_GEN_PAGESTORE"] = (
+                    self.pagestore.start())
         self.supervisor.start()
         self.router = Router(self.supervisor.addresses(),
                              policy=self._policy, roles=self._roles,
                              **self._router_kwargs)
         self.server = RouterServer(self.router, host=self._host,
                                    port=self._port,
-                                   supervisor=self.supervisor)
+                                   supervisor=self.supervisor,
+                                   pagestore=self.pagestore)
         self.server.start()
         if self._autoscale_cfg:
             kwargs = (dict(self._autoscale_cfg)
@@ -427,7 +453,9 @@ class ServingFleet:
         return {"router": self.router.snapshot() if self.router else None,
                 "supervisor": self.supervisor.states(),
                 "autoscale": (self.autoscaler.snapshot()
-                              if self.autoscaler else None)}
+                              if self.autoscaler else None),
+                "pagestore": (self.pagestore.stats_summary()
+                              if self.pagestore else None)}
 
     def stop(self):
         if self.autoscaler is not None:

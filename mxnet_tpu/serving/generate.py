@@ -698,6 +698,14 @@ class DecodeEngine:
     def _store_key(self, sid):
         return "%s/%s" % (self.name, sid)
 
+    def _count_store_refusal(self, store):
+        """A refused store put degrades (the session stays local) but is
+        never silent: count it, and count the budget-eviction flavor
+        separately so capacity pressure is visible as itself."""
+        self.metrics.count(self.name, "store_rejected_total")
+        if getattr(store, "last_refusal", None) == "over_budget":
+            self.metrics.count(self.name, "store_over_budget_total")
+
     def _run_op(self, fn, timeout=30.0):
         """Run ``fn`` on the worker thread — the only thread allowed to
         touch the donated ``_kp``/``_vp`` arrays.  Runs inline when no
@@ -947,9 +955,10 @@ class DecodeEngine:
                     moved += 1
                     self.metrics.count(self.name, "migrations_out_total")
                 else:
-                    _log.warning("migrate_out: store rejected session %r "
-                                 "(stale gen or unreachable); kept local",
-                                 sess.sid)
+                    self._count_store_refusal(store)
+                    _log.warning("migrate_out: store refused session %r "
+                                 "(%s); kept local", sess.sid,
+                                 getattr(store, "last_refusal", None))
             return moved
         return self._run_op(op, timeout=60.0)
 
@@ -967,8 +976,9 @@ class DecodeEngine:
                "pending": (int(sess.pending)
                            if sess.pending is not None else None)}
         if not store.put(self._store_key(sess.sid), rec, gen=sess.gen):
-            _log.warning("transcript push for session %r rejected",
-                         sess.sid)
+            self._count_store_refusal(store)
+            _log.warning("transcript push for session %r refused (%s)",
+                         sess.sid, getattr(store, "last_refusal", None))
 
     def _handoff(self, slot, req):
         """Prefill-role disaggregation: ship the freshly prefilled
@@ -988,6 +998,7 @@ class DecodeEngine:
             return False
         if not store.put(self._store_key(req.session),
                          {"kind": "pages", "blob": blob}, gen=gen):
+            self._count_store_refusal(store)
             return False
         with self._cond:
             self._sessions.pop(req.session, None)

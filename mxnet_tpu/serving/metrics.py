@@ -95,7 +95,11 @@ class ModelMetrics:
                 # async decode engine (PR 17): device-array reads that
                 # happened at retire time, after the next launch was
                 # already in flight
-                "deferred_reads_total")
+                "deferred_reads_total",
+                # page-store refusals (PR 20): the engine kept the
+                # session local instead of shipping it — degrade paths
+                # are counted, never silent
+                "store_rejected_total", "store_over_budget_total")
 
     def __init__(self):
         self.counters = dict.fromkeys(self.COUNTERS, 0)

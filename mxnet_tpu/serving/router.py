@@ -625,10 +625,11 @@ class RouterServer:
     ``/v1/stats`` blocks and Prometheus gauges."""
 
     def __init__(self, router, *, host="127.0.0.1", port=0,
-                 supervisor=None, autoscaler=None):
+                 supervisor=None, autoscaler=None, pagestore=None):
         self.router = router
         self.supervisor = supervisor
         self.autoscaler = autoscaler
+        self.pagestore = pagestore  # PageStoreServer | PageStoreFleet
         self._host = host
         self._port = int(port)
         self._httpd = None
@@ -745,6 +746,10 @@ class RouterServer:
                 snap["supervisor"] = self.supervisor.states()
             if self.autoscaler is not None:
                 snap["autoscale"] = self.autoscaler.snapshot()
+            if self.pagestore is not None:
+                # session-store durability/replication gauges (single
+                # server and replicated fleet export the same shape)
+                snap["pagestore"] = self.pagestore.stats_summary()
             return 200, snap
         if path == "/metrics":
             return 200, {"text": self._prometheus_text()}
@@ -950,4 +955,13 @@ class RouterServer:
                                  % (gauge, sig[gauge]))
             lines.append("mxtpu_fleet_autoscale_chip_budget %d"
                          % asnap["config"]["chip_budget"])
+        if self.pagestore is not None:
+            ps = self.pagestore.stats_summary()
+            for gauge in ("replicas", "epoch", "records", "bytes",
+                          "wal_bytes", "replication_lag",
+                          "failovers_total", "evicted_total"):
+                lines.append("mxtpu_pagestore_%s %d"
+                             % (gauge, int(ps.get(gauge) or 0)))
+            lines.append("mxtpu_pagestore_snapshot_age_s %g"
+                         % float(ps.get("snapshot_age_s", -1.0)))
         return "\n".join(lines) + "\n"

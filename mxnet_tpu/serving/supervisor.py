@@ -102,8 +102,15 @@ class ReplicaSupervisor:
     def __init__(self, spec, *, replicas=None, host="127.0.0.1",
                  ports=None, restart_budget=None, restart_window_s=None,
                  restart_backoff_ms=None, env=None,
-                 startup_timeout_s=120.0):
+                 startup_timeout_s=120.0, command_builder=None,
+                 ready_probe=None):
         self.spec = dict(spec)
+        # the supervision machinery (ports, budget/backoff, monitor) is
+        # process-kind agnostic: command_builder(r, spec_path) -> argv
+        # and ready_probe(r, timeout) -> bool let non-HTTP processes
+        # (e.g. PageStore members) ride the same restart discipline
+        self.command_builder = command_builder
+        self.ready_probe = ready_probe
         self.n = int(replicas if replicas is not None
                      else _config.get("MXNET_FLEET_REPLICAS"))
         self.host = host
@@ -163,13 +170,16 @@ class ReplicaSupervisor:
             r.log_path = os.path.join(
                 tempfile.gettempdir(),
                 "mxtpu-replica-%s-%d.log" % (r.rid, os.getpid()))
+        if self.command_builder is not None:
+            argv = list(self.command_builder(r, self._spec_path))
+        else:
+            argv = [sys.executable, "-m", "mxnet_tpu.serving.replica",
+                    "--spec", self._spec_path, "--port", str(r.port),
+                    "--host", r.host, "--id", r.rid]
         log = open(r.log_path, "ab")
         try:
             r.proc = subprocess.Popen(
-                [sys.executable, "-m", "mxnet_tpu.serving.replica",
-                 "--spec", self._spec_path, "--port", str(r.port),
-                 "--host", r.host, "--id", r.rid],
-                stdout=log, stderr=subprocess.STDOUT, env=env)
+                argv, stdout=log, stderr=subprocess.STDOUT, env=env)
         finally:
             log.close()
         r.state = "running"
@@ -177,6 +187,11 @@ class ReplicaSupervisor:
         return r
 
     def _ready(self, r, timeout=1.0):
+        if self.ready_probe is not None:
+            try:
+                return bool(self.ready_probe(r, timeout))
+            except (OSError, RuntimeError):
+                return False
         import http.client
         try:
             conn = http.client.HTTPConnection(r.host, r.port,

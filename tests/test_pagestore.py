@@ -1,0 +1,449 @@
+"""Durable, replicated page store (`pytest -m pagestore`).
+
+The store is the session-migration rendezvous — if it loses a record or
+a generation fence, a session resets somewhere.  This suite proves it
+can't, layer by layer:
+
+  - WAL + snapshot durability: restart recovers every record AND every
+    generation fence; the corruption matrix (torn tail, CRC flip,
+    truncated snapshot) recovers the longest valid prefix instead of
+    refusing to start.
+  - Generation fencing survives restart and epoch-fenced failover: a
+    deposed primary's late writes never clobber post-promotion state.
+  - Budget/TTL eviction is typed and counted, and eviction keeps the
+    fence (an evicted key's stale writer still bounces).
+  - Lifecycle: stop() joins the accept loop and every connection
+    thread — zero leaks, no 5 s stalls.
+  - PageStoreClient fails over across an address list.
+  - PageStoreFleet (in-process members) promotes on primary death and
+    heals the revived member back in.
+
+The kill-the-store-process chaos acceptance (SIGKILL mid-drain and
+mid-rollout under live session traffic) is the `slow` test at the end.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu import faults
+from mxnet_tpu.kvstore.pagestore import (PageStoreClient, PageStoreFleet,
+                                         PageStoreServer, _ask, _frame,
+                                         _iter_records, _Journal)
+from mxnet_tpu.kvstore.dist import _encode_msg
+
+pytestmark = pytest.mark.pagestore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _store_threads():
+    return [t for t in threading.enumerate() if "pagestore" in t.name]
+
+
+def _serve(tmp=None, **kw):
+    srv = PageStoreServer(host="127.0.0.1", dir=str(tmp) if tmp else None,
+                          **kw)
+    addr = srv.start()
+    return srv, addr
+
+
+# ---------------------------------------------------------------------------
+# durability: restart recovers records and fences
+# ---------------------------------------------------------------------------
+def test_restart_recovers_records_and_fences(tmp_path):
+    blob = bytes(range(256)) * 11
+    srv, addr = _serve(tmp_path)
+    cli = PageStoreClient.from_addr(addr)
+    assert cli.put("s/pages", {"kind": "pages", "blob": blob}, gen=3)
+    assert cli.put("s/tr", {"history": [4, 1, 9], "pending": 2}, gen=1)
+    assert cli.put("s/fence", {"history": [7]}, gen=4)
+    rec, claimed = cli.take("s/fence")  # fence moves to 5
+    assert claimed == 5 and rec == {"history": [7]}
+    cli.close()
+    srv.stop()
+
+    srv, addr = _serve(tmp_path)
+    try:
+        cli = PageStoreClient.from_addr(addr)
+        rec, gen = cli.take("s/pages")
+        assert gen == 4 and bytes(rec["blob"]) == blob
+        rec, gen = cli.take("s/tr")
+        assert rec == {"history": [4, 1, 9], "pending": 2} and gen == 2
+        # the pre-crash holder of s/fence is still fenced out
+        assert not cli.put("s/fence", {"history": [7]}, gen=5)
+        assert cli.last_refusal == "stale"
+        assert cli.put("s/fence", {"history": [7, 8]}, gen=6)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_durable_matches_inmemory_semantics(tmp_path):
+    """The same op sequence gives byte-identical outcomes with and
+    without a WAL dir — durability must not change semantics."""
+    def drive(addr):
+        cli = PageStoreClient.from_addr(addr)
+        out = []
+        out.append(cli.put("k", {"blob": b"\x00\x01\x02"}, gen=1))
+        out.append(cli.put("k", {"blob": b"\x00\x01\x02"}, gen=1))  # stale
+        out.append(cli.put("k", {"blob": b"\xff" * 9}, gen=2))
+        out.append(cli.take("k"))
+        out.append(cli.take("k"))     # miss, fence visible
+        out.append(cli.put("j", {"x": 1}, gen=0))
+        out.append(cli.delete("j"))
+        cli.close()
+        return out
+
+    mem_srv, mem_addr = _serve()
+    dur_srv, dur_addr = _serve(tmp_path)
+    try:
+        a, b = drive(mem_addr), drive(dur_addr)
+        assert _encode_msg(a) == _encode_msg(b)
+    finally:
+        mem_srv.stop()
+        dur_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# corruption matrix
+# ---------------------------------------------------------------------------
+def test_torn_wal_tail_is_typed_latched_and_recoverable(tmp_path):
+    srv, addr = _serve(tmp_path)
+    try:
+        cli = PageStoreClient.from_addr(addr)
+        assert cli.put("good", {"x": 1}, gen=1)
+        faults.install(faults.FaultRule("pagestore.wal", "torn",
+                                        n=1, max_trips=1))
+        # the op whose WAL append tore is rejected typed — never applied
+        assert not cli.put("torn", {"x": 2}, gen=1)
+        assert cli.last_refusal == "wal_error"
+        assert srv.counters["wal_errors"] == 1
+        # crash-at-tail model: the journal is latched dead from here on
+        faults.reset()
+        assert not cli.put("after", {"x": 3}, gen=1)
+        assert cli.last_refusal == "wal_error"
+        cli.close()
+    finally:
+        srv.stop()
+
+    srv, addr = _serve(tmp_path)
+    try:
+        cli = PageStoreClient.from_addr(addr)
+        rec, _ = cli.take("good")
+        assert rec == {"x": 1}
+        assert cli.take("torn") == (None, 0)  # rejected op left no trace
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_wal_crc_flip_recovers_longest_valid_prefix(tmp_path):
+    j = _Journal(str(tmp_path), fsync=False)
+    j.recover()  # opens the live WAL
+    entries = [{"e": "put", "key": "k%d" % i, "gen": i,
+                "rec": {"i": i}, "ts": 0.0, "nbytes": 8}
+               for i in range(5)]
+    for e in entries:
+        j.append(e)
+    wal = j._wal(j.seq)
+    j.close()
+    # flip one payload byte inside record 3
+    skip = sum(len(_frame(_encode_msg(e))) for e in entries[:2])
+    with open(wal, "r+b") as fh:
+        fh.seek(skip + 12 + 1)  # header + 1 byte into the payload
+        byte = fh.read(1)
+        fh.seek(skip + 12 + 1)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+
+    doc, recovered = _Journal(str(tmp_path), fsync=False).recover()
+    assert doc is None
+    assert recovered == entries[:2]  # nothing after the tear is trusted
+
+
+def test_truncated_snapshot_falls_back_a_generation(tmp_path):
+    srv, addr = _serve(tmp_path, snapshot_every=3, fsync=False)
+    cli = PageStoreClient.from_addr(addr)
+    for i in range(10):
+        assert cli.put("k%d" % i, {"i": i}, gen=i + 1)
+    cli.close()
+    srv.stop()
+    snaps = sorted(f for f in os.listdir(tmp_path)
+                   if f.startswith("snap-"))
+    assert len(snaps) >= 2  # two generations always recoverable
+    with open(tmp_path / snaps[-1], "r+b") as fh:
+        fh.truncate(max(0, fh.seek(0, os.SEEK_END) - 9))
+
+    srv, addr = _serve(tmp_path)
+    try:
+        cli = PageStoreClient.from_addr(addr)
+        for i in range(10):
+            rec, gen = cli.take("k%d" % i)
+            assert rec == {"i": i} and gen == i + 2
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_snapshot_compaction_bounds_the_wal(tmp_path):
+    srv, addr = _serve(tmp_path, snapshot_every=4, fsync=False)
+    try:
+        cli = PageStoreClient.from_addr(addr)
+        for i in range(20):
+            assert cli.put("k", {"i": i}, gen=i + 1)
+        st = cli.stats()
+        assert st["wal_seq"] >= 4          # the WAL rolled
+        assert st["snapshot_age_s"] >= 0   # a snapshot exists
+        # pruning keeps at most two snapshot/wal generations around
+        assert len([f for f in os.listdir(tmp_path)
+                    if f.startswith("wal-")]) <= 2
+        cli.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# replication + epoch fencing
+# ---------------------------------------------------------------------------
+def _pair(tmp_path=None):
+    a, a_addr = _serve(tmp_path / "a" if tmp_path else None)
+    b, b_addr = _serve(tmp_path / "b" if tmp_path else None,
+                       role="follower")
+    assert _ask(a_addr, {"op": "add_follower", "addr": b_addr})["ok"]
+    return a, a_addr, b, b_addr
+
+
+def test_mutations_replicate_synchronously():
+    a, a_addr, b, b_addr = _pair()
+    try:
+        cli = PageStoreClient.from_addr(a_addr)
+        assert cli.put("k", {"x": 1}, gen=2)
+        assert cli.put("j", {"y": 2}, gen=1)
+        st = _ask(b_addr, {"op": "stats"})
+        assert st["records"] == 2 and st["role"] == "follower"
+        rec, claimed = cli.take("k")
+        assert claimed == 3
+        assert cli.delete("j")
+        st = _ask(b_addr, {"op": "stats"})
+        # take/delete replicated too; the take's fence is on the follower
+        assert st["records"] == 0 and st["gens"] >= 1
+        assert st["repl_lag"] == 0
+        cli.close()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_deposed_primary_cannot_clobber(tmp_path):
+    """The failover correctness core: after B is promoted at a higher
+    epoch, the old primary A discovers it is deposed via the epoch
+    fence on its next replicated write — which is REJECTED, and A stops
+    serving, so post-promotion state is never clobbered."""
+    a, a_addr, b, b_addr = _pair(tmp_path)
+    try:
+        cli = PageStoreClient.from_addr(a_addr)
+        assert cli.put("s", {"v": "pre"}, gen=5)
+        assert _ask(b_addr, {"op": "promote", "epoch": 2,
+                             "followers": []})["ok"]
+        # A's late write replicates, gets fenced, and A deposes itself
+        assert not cli.put("s", {"v": "late"}, gen=6)
+        assert cli.last_refusal in ("deposed", "not_primary")
+        assert a.deposed
+        assert not cli.put("t", {"v": "later"}, gen=1)  # A refuses now
+        cli.close()
+
+        bcli = PageStoreClient.from_addr(b_addr)
+        rec, gen = bcli.take("s")
+        assert rec == {"v": "pre"} and gen == 6  # fence came across
+        # and the replicated fence survived the promotion
+        assert not bcli.put("s", {"v": "stale"}, gen=5)
+        assert bcli.last_refusal == "stale"
+        bcli.close()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_stale_promote_and_replicate_drop():
+    a, a_addr, b, b_addr = _pair()
+    try:
+        # promote at a non-advancing epoch is refused
+        rep = _ask(b_addr, {"op": "promote", "epoch": 0, "followers": []})
+        assert not rep["ok"] and rep["error"] == "stale_epoch"
+        # a dropped replicate never fails the client op — the follower
+        # is dropped and healed back in by the fleet via install
+        faults.install(faults.FaultRule("pagestore.replicate", "drop",
+                                        n=1, max_trips=1))
+        cli = PageStoreClient.from_addr(a_addr)
+        assert cli.put("k", {"x": 1}, gen=1)
+        assert a.counters["repl_errors"] == 1
+        assert not a._followers
+        # heal: add_follower re-installs the FULL state
+        assert _ask(a_addr, {"op": "add_follower", "addr": b_addr})["ok"]
+        st = _ask(b_addr, {"op": "stats"})
+        assert st["records"] == 1 and st["counters"]["installs"] >= 2
+        cli.close()
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# budget + TTL eviction
+# ---------------------------------------------------------------------------
+def test_over_budget_put_is_typed_and_counted():
+    srv, addr = _serve(max_bytes=4096)
+    try:
+        cli = PageStoreClient.from_addr(addr)
+        assert not cli.put("big", {"blob": b"\x00" * 8192}, gen=1)
+        assert cli.last_refusal == "over_budget"
+        assert srv.counters["over_budget"] == 1
+        assert cli.take("big") == (None, 0)  # never applied, no fence
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_lru_eviction_keeps_the_fence():
+    srv, addr = _serve(max_bytes=4096)
+    try:
+        cli = PageStoreClient.from_addr(addr)
+        assert cli.put("old", {"blob": b"\x01" * 1800}, gen=3)
+        assert cli.put("new", {"blob": b"\x02" * 1800}, gen=1)
+        assert cli.put("newer", {"blob": b"\x03" * 1800}, gen=1)
+        assert srv.counters["evicted"] >= 1
+        rec, gen = cli.take("old")
+        assert rec is None and gen == 3  # record gone, fence kept
+        # the evicted key's old holder is STILL fenced out
+        assert not cli.put("old", {"blob": b"\x01"}, gen=3)
+        assert cli.last_refusal == "stale"
+        rec, _ = cli.take("newer")  # LRU head went first, newest stayed
+        assert rec is not None
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_ttl_eviction():
+    srv, addr = _serve(ttl_s=0.2)
+    try:
+        cli = PageStoreClient.from_addr(addr)
+        assert cli.put("ephemeral", {"x": 1}, gen=1)
+        time.sleep(1.2)  # sweeps are rate-limited to one per second
+        assert cli.put("fresh", {"x": 2}, gen=1)  # put triggers the sweep
+        assert srv.counters["evicted"] == 1
+        assert cli.take("ephemeral")[0] is None
+        assert cli.take("fresh")[0] is not None
+        cli.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + client failover
+# ---------------------------------------------------------------------------
+def test_stop_joins_every_thread():
+    before = set(_store_threads())
+    srv, addr = _serve()
+    clients = [PageStoreClient.from_addr(addr) for _ in range(3)]
+    for i, cli in enumerate(clients):
+        assert cli.put("k%d" % i, {"i": i}, gen=1)
+    t0 = time.monotonic()
+    srv.stop()
+    assert time.monotonic() - t0 < 2.0  # no accept() stall
+    for cli in clients:
+        cli.close()
+    deadline = time.monotonic() + 5.0
+    while set(_store_threads()) - before and time.monotonic() < deadline:
+        time.sleep(0.02)
+    leaked = [t.name for t in set(_store_threads()) - before]
+    assert not leaked, "pagestore leaked threads after stop(): %s" % leaked
+
+
+def test_client_fails_over_across_address_list():
+    srv, addr = _serve()
+    try:
+        # first address is dead; the client must rotate and succeed
+        cli = PageStoreClient.from_addr("127.0.0.1:1," + addr)
+        assert cli.put("k", {"x": 1}, gen=1)
+        assert cli.failovers >= 1
+        assert cli.take("k")[0] == {"x": 1}
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_client_single_addr_unreachable_is_soft():
+    cli = PageStoreClient("127.0.0.1", 1, timeout=0.5)
+    assert not cli.put("k", {"x": 1}, gen=1)
+    assert cli.take("k") == (None, 0)
+    cli.close()
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet: promotion + heal
+# ---------------------------------------------------------------------------
+def test_fleet_inproc_failover_and_heal(tmp_path):
+    before = set(_store_threads())
+    fleet = PageStoreFleet(replicas=3, dir=str(tmp_path), processes=False,
+                           probe_interval_s=0.05, strikes=2)
+    addrs = fleet.start()
+    assert addrs.count(",") == 2
+    cli = PageStoreClient.from_addr(addrs)
+    try:
+        assert cli.put("s", {"v": "survives"}, gen=1)
+        old = fleet.kill_primary()
+        deadline = time.monotonic() + 30
+        while fleet.failovers_total < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fleet.failovers_total == 1
+        assert fleet.primary != old
+        # the record AND its fence live on the promoted follower
+        rec, gen = cli.take("s")
+        assert rec == {"v": "survives"} and gen == 2
+        assert not cli.put("s", {"v": "stale"}, gen=1)
+        assert cli.last_refusal == "stale"
+        # the revived member heals back in as a follower
+        deadline = time.monotonic() + 30
+        while fleet.rejoins < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fleet.rejoins >= 1
+        summary = fleet.stats_summary()
+        assert summary["replicas"] == 3
+        assert summary["failovers_total"] == 1
+        assert summary["epoch"] >= 2
+    finally:
+        cli.close()
+        fleet.stop()
+    deadline = time.monotonic() + 5.0
+    while set(_store_threads()) - before and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not set(_store_threads()) - before
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance (slow lane): kill the store itself under traffic
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_store_scenario():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--scenario", "store", "-n", "2"],
+        env=env, capture_output=True, text=True, timeout=900)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0
+    assert "chaos: PASS" in proc.stdout

@@ -56,6 +56,15 @@ Scenarios (--scenario):
            every shed, latency-tier p99 during the scaled-up hold
            stays <= 5x steady-state, and /v1/stats carries the full
            auditable decision ring.
+  store    durable, replicated page store: (A) SIGKILL -9 a single
+           store process and restart it on the same WAL dir — every
+           record AND every generation fence must come back (a stale
+           put from a pre-crash holder still bounces); (B) SIGKILL the
+           store PRIMARY of a 3-member replicated store under session
+           traffic, mid-autoscale-drain and again mid-rollout.  PASS
+           when zero sessions reset, warm transcripts stay
+           bit-identical to the greedy oracle, the store fails over
+           both times (epoch-fenced), and killed members heal back in.
 
 Usage:
   python tools/chaos.py                       # default spec, 2 workers
@@ -901,6 +910,396 @@ def scenario_llm(args):
     return 0 if ok else 1
 
 
+def scenario_store(args):
+    """SIGKILL the page store ITSELF — the process every migration,
+    drain, and rollout routes through.
+
+    Phase A (durability): a single store process with a WAL dir takes
+    records at several generations (including a take, which advances a
+    fence), dies by SIGKILL -9, and restarts on the same dir.  PASS:
+    every record is served byte-identical, and a stale-generation put
+    from a pre-crash holder still bounces — the fences were recovered,
+    not just the payloads.
+
+    Phase B (replication): a ServingFleet with a 3-member replicated
+    store (subprocesses under the supervisor) serves sustained session
+    traffic; the store PRIMARY is SIGKILLed mid-autoscale-drain and
+    again mid-rollout.  PASS: zero ``SessionResetError``s anywhere,
+    every warm session resumes with a transcript bit-identical to the
+    greedy full-forward oracle, the store fails over both times
+    (epoch-fenced promotion), and the killed member is healed back in.
+    """
+    import socket as _socket
+    import threading
+
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from mxnet_tpu.kvstore.pagestore import PageStoreClient, _ask
+
+    ok = True
+
+    def _wait_store(addr, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return _ask(addr, {"op": "stats"}, timeout=1.0)
+            except (OSError, RuntimeError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+
+    # -- phase A: kill -9 + restart of one durable, unreplicated store --
+    print("chaos-store: phase A — WAL durability across SIGKILL")
+    s = _socket.socket()
+    s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    with tempfile.TemporaryDirectory(prefix="chaos-store-") as wal_dir:
+        argv = [sys.executable, "-m", "mxnet_tpu.kvstore.pagestore",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--dir", wal_dir, "--role", "primary"]
+        proc = subprocess.Popen(argv, env=env)
+        addr = "127.0.0.1:%d" % port
+        _wait_store(addr)
+        cli = PageStoreClient.from_addr(addr)
+        blob = bytes(range(256)) * 17
+        assert cli.put("llm/pages", {"kind": "pages", "blob": blob},
+                       gen=3)
+        assert cli.put("llm/tr", {"kind": "transcript",
+                                  "history": [5, 9, 2], "pending": 7},
+                       gen=1)
+        assert cli.put("llm/fence", {"kind": "transcript",
+                                     "history": [1]}, gen=4)
+        rec, claimed = cli.take("llm/fence")  # fence advances to 5
+        assert claimed == 5, claimed
+        cli.close()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(30)
+        print("chaos-store: store SIGKILLed (rc=%s); restarting on the "
+              "same WAL dir" % proc.returncode)
+        proc = subprocess.Popen(argv, env=env)
+        try:
+            _wait_store(addr)
+            cli = PageStoreClient.from_addr(addr)
+            rec, gen = cli.take("llm/pages")
+            if rec is None or bytes(rec["blob"]) != blob or gen != 4:
+                print("FAIL: pages record not recovered byte-identical "
+                      "(gen=%s)" % gen)
+                ok = False
+            rec, gen = cli.take("llm/tr")
+            if (rec is None or list(rec["history"]) != [5, 9, 2]
+                    or rec["pending"] != 7):
+                print("FAIL: transcript record not recovered: %r" % (rec,))
+                ok = False
+            # the correctness subtlety: the PRE-CRASH holder's late put
+            # (stale generation) must still bounce after recovery
+            if cli.put("llm/fence", {"kind": "transcript",
+                                     "history": [1]}, gen=5):
+                print("FAIL: stale-gen put accepted after restart — the "
+                      "WAL lost the generation fences")
+                ok = False
+            elif cli.last_refusal != "stale":
+                print("FAIL: expected 'stale' refusal, got %r"
+                      % cli.last_refusal)
+                ok = False
+            if not cli.put("llm/fence", {"kind": "transcript",
+                                         "history": [1, 2]}, gen=6):
+                print("FAIL: next-gen put refused after restart (%r)"
+                      % cli.last_refusal)
+                ok = False
+            cli.close()
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    print("chaos-store: phase A %s" % ("ok" if ok else "FAILED"))
+
+    # -- phase B: kill the replicated store primary under traffic -------
+    import jax.numpy as jnp
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.models import decoder
+    from mxnet_tpu.serving.errors import (FleetUnavailableError,
+                                          SessionResetError)
+
+    n = max(2, args.num_workers)
+    spec = {"models": [{"name": "llm",
+                        "builder":
+                            "mxnet_tpu.models.decoder:decoder_tiny_lm",
+                        "kwargs": {"seed": 0},
+                        "generate": {"slots": 4, "page_size": 8,
+                                     "prefill_chunk": 8, "max_ctx": 64,
+                                     "total_pages": 513}}],
+            "max_queue_depth": 512}
+    fleet = serving.ServingFleet(
+        spec, replicas=n, policy="hash",
+        router_kwargs={"probe_ms": 50},
+        supervisor_kwargs={"restart_backoff_ms": 100,
+                           "startup_timeout_s": 300},
+        pagestore={"replicas": 3, "processes": True,
+                   "probe_interval_s": 0.2, "strikes": 2})
+    print("chaos-store: phase B — %d LLM replicas + 3-member "
+          "replicated store (compiling decode programs)" % n)
+    fleet.start()
+    store_addrs = fleet.supervisor.env["MXNET_GEN_PAGESTORE"]
+    print("chaos-store: store members %s (primary %s)"
+          % (store_addrs, fleet.pagestore.primary))
+
+    stop = threading.Event()
+    counters = {"ok": 0, "reset": 0, "typed_midflight": 0, "ctx_full": 0,
+                "router": 0, "other": 0}
+    lock = threading.Lock()
+
+    def bump(key):
+        with lock:
+            counters[key] += 1
+
+    def load_client(cid):
+        cli = serving.ServingClient(*fleet.address, timeout=60, retries=0)
+        i = 0
+        epoch = [0, 0, 0, 0]
+        while not stop.is_set():
+            i += 1
+            slot = i % 4
+            sid = "c%d-%d-e%d" % (cid, slot, epoch[slot])
+            try:
+                if i % 3:
+                    cli.generate("llm", [cid + 1, 2, 3], max_tokens=6)
+                else:
+                    cli.generate("llm", [cid + 1, 2, 3], max_tokens=4,
+                                 session=sid)
+                    cli.generate("llm", [5], max_tokens=4, session=sid,
+                                 resume=True)
+                bump("ok")
+            except serving.BadRequestError as e:
+                if "max_ctx" in str(e):
+                    epoch[slot] += 1
+                    bump("ctx_full")
+                else:
+                    bump("other")
+                    print("chaos-store: UNTYPED failure: %r" % (e,))
+            except SessionResetError:
+                bump("reset")
+                print("chaos-store: session RESET under load "
+                      "(must be zero)")
+            except FleetUnavailableError:
+                bump("router")
+                print("chaos-store: ROUTER-LEVEL failure (must be zero)")
+            except serving.ServingError as e:
+                if "non-idempotent" in str(e):
+                    bump("typed_midflight")
+                else:
+                    bump("other")
+                    print("chaos-store: UNTYPED failure: %r" % (e,))
+            except Exception as e:
+                bump("other")
+                print("chaos-store: UNTYPED failure: %r" % (e,))
+        cli.close()
+
+    threads = [threading.Thread(target=load_client, args=(c,),
+                                daemon=True) for c in range(3)]
+
+    # warm sessions with client-side transcript tracking: hist[sid] is
+    # the exact (prompt, output) sequence the greedy oracle must replay
+    hist = {}
+    tainted = set()
+
+    def warm_turn(cli, sid, prompt, max_tokens):
+        for attempt in (0, 1):
+            try:
+                out = cli.generate("llm", prompt, max_tokens=max_tokens,
+                                   session=sid, resume=sid in hist)
+                hist.setdefault(sid, []).append(
+                    (list(prompt), [int(t) for t in out["tokens"]]))
+                return True
+            except SessionResetError:
+                raise
+            except serving.ServingError as e:
+                # ambiguous non-idempotent loss: one re-resume resolves
+                # it, but the session may have advanced server-side, so
+                # exclude it from the bit-identity oracle
+                if "non-idempotent" in str(e) and attempt == 0:
+                    tainted.add(sid)
+                    continue
+                print("chaos-store: warm turn on %s FAILED: %r"
+                      % (sid, e))
+                return False
+        return False
+
+    resets, warm_fail = 0, 0
+    try:
+        warm_cli = serving.ServingClient(*fleet.address, timeout=60)
+        warm = ["warm-%d" % i for i in range(3 * n)]
+        for sid in warm:
+            if not warm_turn(warm_cli, sid, [1, 2, 3], 3):
+                warm_fail += 1
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+
+        # -- kill 1: mid-autoscale-drain ----------------------------
+        # drain a session-holding replica (parked sessions push to the
+        # store) and SIGKILL the store primary while the drain runs
+        import http.client as _http
+        import json as _json
+
+        def _session_count(port_):
+            try:
+                c = _http.HTTPConnection("127.0.0.1", port_, timeout=10)
+                c.request("GET", "/v1/stats")
+                doc = _json.loads(c.getresponse().read())
+                c.close()
+                return (doc.get("generators", {}).get("llm", {})
+                        .get("sessions", 0))
+            except Exception:
+                return 0
+
+        counts = [_session_count(r.port)
+                  for r in fleet.supervisor.replicas]
+        victim = fleet.supervisor.replicas[
+            max(range(n), key=lambda i: counts[i])]
+        drained = []
+
+        def _drain():
+            drained.append(fleet._autoscale_down(victim.addr))
+
+        dr = threading.Thread(target=_drain, daemon=True)
+        dr.start()
+        time.sleep(0.05)
+        killed = fleet.pagestore.kill_primary()
+        print("chaos-store: SIGKILL store primary %s mid-drain of "
+              "replica %s (%d sessions held)"
+              % (killed, victim.rid, counts[
+                  fleet.supervisor.replicas.index(victim)]))
+        dr.join(120)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and \
+                fleet.pagestore.failovers_total < 1:
+            time.sleep(0.2)
+        print("chaos-store: drain migrated %s session(s); store "
+              "failovers=%d, new primary %s"
+              % (drained, fleet.pagestore.failovers_total,
+                 fleet.pagestore.primary))
+        if fleet.pagestore.failovers_total < 1:
+            print("FAIL: store never failed over after the kill")
+            ok = False
+        # every warm session must resume — the drained replica's were
+        # parked in the store ACROSS the primary kill
+        for sid in warm:
+            try:
+                if not warm_turn(warm_cli, sid, [7], 3):
+                    warm_fail += 1
+            except SessionResetError:
+                resets += 1
+
+        # -- kill 2: mid-rollout ------------------------------------
+        # wait for the restarted member to heal back in first, so the
+        # second failover has a follower to promote
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and \
+                fleet.pagestore.rejoins < 1:
+            time.sleep(0.2)
+        if fleet.pagestore.rejoins < 1:
+            print("FAIL: killed store member never healed back in")
+            ok = False
+        roll_err = []
+
+        def _roll():
+            try:
+                fleet.rollout(dict(spec["models"][0]))
+            except Exception as e:
+                roll_err.append(e)
+
+        rt = threading.Thread(target=_roll, daemon=True)
+        rt.start()
+        time.sleep(0.5)
+        killed = fleet.pagestore.kill_primary()
+        print("chaos-store: SIGKILL store primary %s mid-rollout"
+              % killed)
+        rt.join(300)
+        if roll_err:
+            print("FAIL: rollout raised across the store kill: %r"
+                  % (roll_err[0],))
+            ok = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and \
+                fleet.pagestore.failovers_total < 2:
+            time.sleep(0.2)
+        if fleet.pagestore.failovers_total < 2:
+            print("FAIL: store did not fail over a second time")
+            ok = False
+        stop.set()
+        for t in threads:
+            t.join(60)
+        for sid in warm:
+            try:
+                if not warm_turn(warm_cli, sid, [9], 3):
+                    warm_fail += 1
+            except SessionResetError:
+                resets += 1
+        warm_cli.close()
+
+        # -- greedy-oracle bit-identity over the whole run ----------
+        lm = decoder.decoder_tiny_lm(seed=0)
+        params, cfg = lm.jax_params(), lm.config
+        mismatches = 0
+        for sid in warm:
+            if sid in tainted:
+                continue
+            toks = []
+            for prompt, out in hist.get(sid, []):
+                toks += prompt
+                for want in out:
+                    logits = decoder.full_forward(
+                        params, cfg, jnp.asarray([toks], jnp.int32))
+                    got = int(jnp.argmax(logits[0, -1]))
+                    if got != want:
+                        mismatches += 1
+                        print("chaos-store: session %s DIVERGED from "
+                              "the greedy oracle (%d != %d)"
+                              % (sid, want, got))
+                        break
+                    toks.append(got)
+                else:
+                    continue
+                break
+        summary = fleet.pagestore.stats_summary()
+        print("chaos-store: load %s; warm failures: %d; resets: %d; "
+              "oracle: %d/%d sessions bit-identical (%d ambiguous "
+              "excluded); store %s"
+              % (counters, warm_fail, resets,
+                 len(warm) - len(tainted) - mismatches,
+                 len(warm) - len(tainted), len(tainted), summary))
+        if counters["reset"] or resets:
+            print("FAIL: %d session reset(s) — killing the store must "
+                  "lose ZERO sessions (WAL + replication + failover)"
+                  % (counters["reset"] + resets))
+            ok = False
+        if counters["router"] or counters["other"]:
+            print("FAIL: router-level or untyped failures under load")
+            ok = False
+        if warm_fail:
+            print("FAIL: %d warm turn(s) failed outright" % warm_fail)
+            ok = False
+        if mismatches:
+            print("FAIL: warm sessions diverged from the greedy oracle")
+            ok = False
+        if not counters["ok"]:
+            print("FAIL: load generator completed no requests")
+            ok = False
+    finally:
+        stop.set()
+        fleet.stop()
+    print("chaos: %s" % ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
 def scenario_ramp(args):
     """10x diurnal traffic ramp against an autoscaling fleet: two tiers
     (latency | bulk), three tenants (pro=4, free=1, batch), one replica
@@ -1201,7 +1600,7 @@ def main():
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--scenario", default="faults",
                     choices=["faults", "preempt", "mesh", "fleet", "llm",
-                             "ramp"],
+                             "ramp", "store"],
                     help="faults = transport chaos (bit-identical check);"
                          " preempt = SIGTERM + relaunch + rejoin drill;"
                          " mesh = SIGKILL a worker holding irreplaceable"
@@ -1214,7 +1613,10 @@ def main():
                          " session resets, lossless sessionless traffic);"
                          " ramp = 10x diurnal traffic ramp against the"
                          " autoscaler (scale out/in under a chip budget,"
-                         " bulk shed first, zero session resets)")
+                         " bulk shed first, zero session resets);"
+                         " store = SIGKILL the page store itself (WAL"
+                         " recovery, then replicated failover mid-drain"
+                         " and mid-rollout, zero session resets)")
     ap.add_argument("--spec", default=DEFAULT_SPEC,
                     help="MXNET_FAULT_SPEC for the chaos run "
                          "(default: %(default)s)")
@@ -1231,6 +1633,8 @@ def main():
         return scenario_llm(args)
     if args.scenario == "ramp":
         return scenario_ramp(args)
+    if args.scenario == "store":
+        return scenario_store(args)
 
     ok = True
     with tempfile.TemporaryDirectory(prefix="chaos-") as tmp:
